@@ -1,0 +1,25 @@
+"""Benchmark driver — prints ONE JSON line with the headline metric.
+
+Headline: end-to-end `train` throughput (rows/sec) of the flagship NN trainer
+on a synthetic fraud-style dataset, vs the YARN-cluster-derived baseline.
+Runs on whatever jax.devices() offers (one real TPU chip under the driver).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.bench import run_benchmark
+
+    result = run_benchmark()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

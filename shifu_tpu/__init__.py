@@ -1,0 +1,15 @@
+"""shifu-tpu: a TPU-native, end-to-end tabular ML pipeline framework.
+
+A from-scratch rebuild of the capabilities of DataS07/shifu (reference:
+``/root/reference``) on JAX/XLA/pjit/Pallas: the pipeline
+``new -> init -> stats -> norm -> varselect -> train -> posttrain -> eval -> export``
+for fraud-style tabular modeling, where the reference's Hadoop/Pig/Guagua/Encog
+stack collapses into
+
+- a columnar data plane (sharded readers -> device arrays),
+- a compiled compute plane (jit/pjit step functions, Pallas kernels), and
+- a pipeline driver speaking the same ``ModelConfig.json`` / ``ColumnConfig.json``
+  contract as the reference (reference: ``container/obj/ModelConfig.java:57-95``).
+"""
+
+__version__ = "0.1.0"

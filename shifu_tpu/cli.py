@@ -1,0 +1,145 @@
+"""shifu-tpu CLI — the reference's ``shifu`` launcher + ``ShifuCLI``.
+
+Commands mirror reference ``ShifuCLI.java:818-866``:
+``new | init | stats | norm | varselect | train | posttrain | eval | export |
+test | encode | combo | convert``.  ``-Dkey=value`` properties go to the
+Environment tier (reference ``ShifuCLI.java:430-453``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from typing import List, Optional
+
+from .config import environment
+
+
+def _split_props(argv: List[str]) -> List[str]:
+    """Pull ``-Dk=v`` pairs out of argv into Environment, return the rest."""
+    rest = []
+    for a in argv:
+        if a.startswith("-D") and "=" in a:
+            k, _, v = a[2:].partition("=")
+            environment.set_property(k, v)
+        else:
+            rest.append(a)
+    return rest
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="shifu-tpu",
+        description="TPU-native tabular ML pipeline (new→init→stats→norm→varselect"
+                    "→train→posttrain→eval→export)")
+    p.add_argument("--dir", default=".", help="model-set directory (default: cwd)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("new", help="create a new model-set scaffold")
+    sp.add_argument("name")
+    sp.add_argument("--alg", default="NN", help="NN|LR|GBT|RF|DT|WDL|SVM")
+
+    sub.add_parser("init", help="build initial ColumnConfig.json from header")
+
+    sp = sub.add_parser("stats", help="per-column stats + binning (+psi/correlation)")
+    sp.add_argument("-correlation", "-c", dest="correlation", action="store_true")
+    sp.add_argument("-psi", dest="psi", action="store_true")
+    sp.add_argument("-rebin", dest="rebin", action="store_true")
+
+    sp = sub.add_parser("norm", aliases=["normalize", "transform"],
+                        help="normalize training data")
+    sp.add_argument("-shuffle", dest="shuffle", action="store_true")
+
+    sp = sub.add_parser("varselect", aliases=["varsel"], help="variable selection")
+    sp.add_argument("-list", dest="list", action="store_true")
+    sp.add_argument("-reset", dest="reset", action="store_true")
+    sp.add_argument("-recover", dest="recover", action="store_true")
+
+    sp = sub.add_parser("train", help="train model(s)")
+    sp.add_argument("-dry", dest="dry", action="store_true")
+    sp.add_argument("-shuffle", dest="shuffle", action="store_true")
+
+    sub.add_parser("posttrain", help="bin-average scores + feature importance")
+
+    sp = sub.add_parser("eval", help="evaluate model on eval sets")
+    sp.add_argument("-run", dest="run_eval", metavar="EVALSET", nargs="?", const="")
+    sp.add_argument("-score", dest="score", metavar="EVALSET", nargs="?", const="")
+    sp.add_argument("-perf", dest="perf", metavar="EVALSET", nargs="?", const="")
+    sp.add_argument("-confmat", dest="confmat", metavar="EVALSET", nargs="?", const="")
+    sp.add_argument("-new", dest="new_eval", metavar="EVALSET")
+    sp.add_argument("-delete", dest="delete_eval", metavar="EVALSET")
+    sp.add_argument("-list", dest="list", action="store_true")
+
+    sp = sub.add_parser("export", help="export model (pmml|columnstats|woemapping|corr)")
+    sp.add_argument("-t", "--type", default="pmml")
+
+    sp = sub.add_parser("test", help="pipeline smoke test on a data sample")
+    sp = sub.add_parser("encode", help="encode dataset by tree-leaf index")
+    sp.add_argument("-evalset", dest="evalset", default=None)
+
+    sp = sub.add_parser("combo", help="multi-algorithm ensemble")
+    sp.add_argument("action", choices=["new", "init", "run", "eval"])
+    sp.add_argument("-alg", dest="algs", default=None,
+                    help="colon-separated list, e.g. NN:GBT:LR")
+
+    sp = sub.add_parser("convert", help="convert model spec zip<->binary")
+    sp.add_argument("-tozipb", dest="tozipb", action="store_true")
+    sp.add_argument("-tob", dest="tob", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = _split_props(list(argv if argv is not None else sys.argv[1:]))
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    cmd = args.command
+    if cmd == "new":
+        from .pipeline.create import create_new_model
+        create_new_model(args.name, base_dir=args.dir, algorithm=args.alg)
+        return 0
+    if cmd == "init":
+        from .pipeline.create import InitProcessor
+        return InitProcessor(args.dir).run()
+    if cmd == "stats":
+        from .pipeline.stats import StatsProcessor
+        return StatsProcessor(args.dir, params=vars(args)).run()
+    if cmd in ("norm", "normalize", "transform"):
+        from .pipeline.norm import NormalizeProcessor
+        return NormalizeProcessor(args.dir, params=vars(args)).run()
+    if cmd in ("varselect", "varsel"):
+        from .pipeline.varselect import VarSelectProcessor
+        return VarSelectProcessor(args.dir, params=vars(args)).run()
+    if cmd == "train":
+        from .pipeline.train import TrainProcessor
+        return TrainProcessor(args.dir, params=vars(args)).run()
+    if cmd == "posttrain":
+        from .pipeline.posttrain import PostTrainProcessor
+        return PostTrainProcessor(args.dir, params=vars(args)).run()
+    if cmd == "eval":
+        from .pipeline.evaluate import EvalProcessor
+        return EvalProcessor(args.dir, params=vars(args)).run()
+    if cmd == "export":
+        from .pipeline.export import ExportProcessor
+        return ExportProcessor(args.dir, params=vars(args)).run()
+    if cmd == "test":
+        from .pipeline.smoke import SmokeTestProcessor
+        return SmokeTestProcessor(args.dir, params=vars(args)).run()
+    if cmd == "encode":
+        from .pipeline.encode import EncodeProcessor
+        return EncodeProcessor(args.dir, params=vars(args)).run()
+    if cmd == "combo":
+        from .pipeline.combo import run_combo
+        return run_combo(args.dir, args.action, args.algs)
+    if cmd == "convert":
+        from .pipeline.convert import run_convert
+        return run_convert(args.dir, vars(args))
+    raise SystemExit(f"unknown command {cmd}")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

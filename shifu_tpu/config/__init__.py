@@ -1,0 +1,12 @@
+from .model_config import (  # noqa: F401
+    Algorithm, BinningAlgorithm, BinningMethod, CustomPaths, EvalConfig,
+    FilterBy, ModelBasicConf, ModelConfig, ModelNormalizeConf, ModelStatsConf,
+    ModelTrainConf, ModelVarSelectConf, MultipleClassification, NormType,
+    PrecisionType, RawSourceData, RunMode, SourceType,
+)
+from .column_config import (  # noqa: F401
+    ColumnBinning, ColumnConfig, ColumnFlag, ColumnStats, ColumnType,
+    build_initial_column_configs, candidate_columns, load_column_configs,
+    save_column_configs, selected_columns, target_column,
+)
+from .path_finder import PathFinder  # noqa: F401

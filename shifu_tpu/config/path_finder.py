@@ -1,0 +1,150 @@
+"""PathFinder — canonical layout of every pipeline artifact.
+
+TPU-native analogue of reference ``fs/PathFinder.java:38,94-630``: one place
+that knows where each step reads/writes inside a model-set directory.  The
+reference's LOCAL/HDFS duality collapses to plain paths (a GCS/posix prefix
+both work through fsspec-style string paths; everything here is os.path based
+and works on any mounted filesystem).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .model_config import ModelConfig
+
+MODEL_CONFIG_JSON = "ModelConfig.json"
+COLUMN_CONFIG_JSON = "ColumnConfig.json"
+
+
+class PathFinder:
+    def __init__(self, model_config: ModelConfig, model_set_dir: str = "."):
+        self.model_config = model_config
+        self.root = os.path.abspath(model_set_dir)
+
+    # ------------------------------------------------------------- configs
+    @property
+    def model_config_path(self) -> str:
+        return os.path.join(self.root, MODEL_CONFIG_JSON)
+
+    @property
+    def column_config_path(self) -> str:
+        return os.path.join(self.root, COLUMN_CONFIG_JSON)
+
+    # --------------------------------------------------------------- steps
+    @property
+    def tmp_dir(self) -> str:
+        return os.path.join(self.root, "tmp")
+
+    @property
+    def stats_dir(self) -> str:
+        return os.path.join(self.tmp_dir, "stats")
+
+    @property
+    def prebin_path(self) -> str:
+        """Sketch/quantile output of the binning pass."""
+        return os.path.join(self.stats_dir, "prebinning.json")
+
+    @property
+    def correlation_path(self) -> str:
+        return os.path.join(self.root, "correlation.csv")
+
+    @property
+    def psi_path(self) -> str:
+        return os.path.join(self.stats_dir, "psi.json")
+
+    @property
+    def norm_dir(self) -> str:
+        """Normalized (float) dataset shards — NN/LR/WDL input."""
+        return os.path.join(self.tmp_dir, "NormalizedData")
+
+    @property
+    def clean_dir(self) -> str:
+        """Binned (int) dataset shards — tree-model input.  The reference keeps
+        the same duality (cleaned vs normalized data,
+        ``TrainModelProcessor.java:1366-1372``)."""
+        return os.path.join(self.tmp_dir, "CleanedData")
+
+    @property
+    def models_dir(self) -> str:
+        return os.path.join(self.root, "models")
+
+    @property
+    def tmp_models_dir(self) -> str:
+        return os.path.join(self.tmp_dir, "modelsTmp")
+
+    @property
+    def varsel_dir(self) -> str:
+        return os.path.join(self.root, "varsels")
+
+    @property
+    def varsel_history_path(self) -> str:
+        return os.path.join(self.varsel_dir, "varsel.history")
+
+    def model_path(self, index: int, alg: Optional[str] = None) -> str:
+        alg = (alg or self.model_config.train.algorithm.name).lower()
+        return os.path.join(self.models_dir, f"model{index}.{alg}")
+
+    def tmp_model_path(self, index: int, epoch: int, alg: Optional[str] = None) -> str:
+        alg = (alg or self.model_config.train.algorithm.name).lower()
+        return os.path.join(self.tmp_models_dir, f"model{index}-{epoch}.{alg}")
+
+    @property
+    def checkpoint_dir(self) -> str:
+        return os.path.join(self.tmp_dir, "checkpoints")
+
+    @property
+    def progress_path(self) -> str:
+        return os.path.join(self.tmp_dir, "train.progress")
+
+    @property
+    def val_error_path(self) -> str:
+        return os.path.join(self.tmp_dir, "val.error")
+
+    # ---------------------------------------------------------------- eval
+    def eval_dir(self, eval_name: str) -> str:
+        return os.path.join(self.root, "evals", eval_name)
+
+    def eval_score_path(self, eval_name: str) -> str:
+        return os.path.join(self.eval_dir(eval_name), "EvalScore")
+
+    def eval_confusion_path(self, eval_name: str) -> str:
+        return os.path.join(self.eval_dir(eval_name), "EvalConfusionMatrix")
+
+    def eval_performance_path(self, eval_name: str) -> str:
+        return os.path.join(self.eval_dir(eval_name), "EvalPerformance.json")
+
+    def eval_norm_path(self, eval_name: str) -> str:
+        return os.path.join(self.eval_dir(eval_name), "EvalNormalized")
+
+    # ------------------------------------------------------------ posttrain
+    @property
+    def post_train_dir(self) -> str:
+        return os.path.join(self.root, "posttrain")
+
+    @property
+    def bin_avg_score_path(self) -> str:
+        return os.path.join(self.post_train_dir, "binAvgScore.csv")
+
+    @property
+    def feature_importance_path(self) -> str:
+        return os.path.join(self.post_train_dir, "featureImportance.csv")
+
+    # -------------------------------------------------------------- export
+    @property
+    def export_dir(self) -> str:
+        return os.path.join(self.root, "export")
+
+    def pmml_path(self, index: int) -> str:
+        return os.path.join(self.export_dir, f"{self.model_config.basic.name}{index}.pmml")
+
+    # ------------------------------------------------------------- backups
+    @property
+    def backup_dir(self) -> str:
+        return os.path.join(self.root, ".backup")
+
+    def ensure_dirs(self) -> None:
+        for d in (self.tmp_dir, self.stats_dir, self.models_dir,
+                  self.tmp_models_dir, self.checkpoint_dir):
+            os.makedirs(d, exist_ok=True)

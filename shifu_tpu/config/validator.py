@@ -1,0 +1,99 @@
+"""ModelInspector — per-step semantic validation of ModelConfig.
+
+Analogue of reference ``core/validator/ModelInspector.java:57,93``: each
+pipeline step calls ``probe(model_config, step)`` before running; failures
+raise ``ValidationError`` with every problem listed.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from typing import List
+
+from .model_config import Algorithm, ModelConfig
+
+
+class ModelStep(enum.Enum):
+    NEW = "NEW"
+    INIT = "INIT"
+    STATS = "STATS"
+    NORMALIZE = "NORMALIZE"
+    VARSELECT = "VARSELECT"
+    TRAIN = "TRAIN"
+    POSTTRAIN = "POSTTRAIN"
+    EVAL = "EVAL"
+    EXPORT = "EXPORT"
+
+
+class ValidationError(ValueError):
+    def __init__(self, problems: List[str]):
+        self.problems = problems
+        super().__init__("ModelConfig validation failed:\n  - " + "\n  - ".join(problems))
+
+
+def probe(mc: ModelConfig, step: ModelStep, model_set_dir: str = ".") -> None:
+    problems: List[str] = []
+
+    if not mc.basic.name:
+        problems.append("basic.name must not be empty")
+
+    if step in (ModelStep.INIT, ModelStep.STATS, ModelStep.NORMALIZE,
+                ModelStep.VARSELECT, ModelStep.TRAIN, ModelStep.POSTTRAIN):
+        ds = mc.dataSet
+        if not ds.dataPath:
+            problems.append("dataSet.dataPath must be set")
+        if not ds.targetColumnName:
+            problems.append("dataSet.targetColumnName must be set")
+        if not ds.posTags and not ds.negTags:
+            problems.append("dataSet.posTags/negTags must define the target classes")
+        overlap = set(map(str, ds.posTags)) & set(map(str, ds.negTags))
+        if overlap:
+            problems.append(f"posTags and negTags overlap: {sorted(overlap)}")
+
+    if step == ModelStep.STATS:
+        if mc.stats.maxNumBin < 2:
+            problems.append("stats.maxNumBin must be >= 2")
+        if not (0.0 < mc.stats.sampleRate <= 1.0):
+            problems.append("stats.sampleRate must be in (0, 1]")
+
+    if step == ModelStep.NORMALIZE:
+        if mc.normalize.stdDevCutOff <= 0:
+            problems.append("normalize.stdDevCutOff must be > 0")
+
+    if step == ModelStep.TRAIN:
+        tr = mc.train
+        if tr.baggingNum < 1:
+            problems.append("train.baggingNum must be >= 1")
+        if tr.numTrainEpochs < 1:
+            problems.append("train.numTrainEpochs must be >= 1")
+        if not (0.0 <= tr.validSetRate < 1.0):
+            problems.append("train.validSetRate must be in [0, 1)")
+        if tr.isCrossValidation and tr.numKFold < 2:
+            problems.append("train.numKFold must be >= 2 when isCrossValidation")
+        if not (0.0 < tr.baggingSampleRate <= 1.0):
+            problems.append("train.baggingSampleRate must be in (0, 1]")
+        if tr.algorithm in (Algorithm.GBT, Algorithm.RF, Algorithm.DT):
+            depth = tr.params.get("MaxDepth", 10)
+            if not (1 <= int(depth) <= 20):
+                problems.append("train.params.MaxDepth must be in [1, 20]")
+        if tr.algorithm == Algorithm.NN:
+            layers = tr.params.get("NumHiddenLayers")
+            nodes = tr.params.get("NumHiddenNodes")
+            acts = tr.params.get("ActivationFunc")
+            if layers is not None and nodes is not None and int(layers) != len(nodes):
+                problems.append("NumHiddenLayers must equal len(NumHiddenNodes)")
+            if layers is not None and acts is not None and int(layers) != len(acts):
+                problems.append("NumHiddenLayers must equal len(ActivationFunc)")
+
+    if step == ModelStep.EVAL:
+        if not mc.evals:
+            problems.append("no eval sets configured")
+        for e in mc.evals:
+            if not e.name:
+                problems.append("eval set without a name")
+            if not e.dataSet.dataPath:
+                problems.append(f"eval {e.name}: dataSet.dataPath must be set")
+
+    if problems:
+        raise ValidationError(problems)

@@ -1,0 +1,5 @@
+from .reader import (  # noqa: F401
+    DataSource, RawChunk, parse_numeric, parse_weight, read_header,
+    resolve_data_files, tag_to_target,
+)
+from .purifier import DataPurifier, sample_mask  # noqa: F401

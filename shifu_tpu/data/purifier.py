@@ -1,0 +1,52 @@
+"""Row filtering + sampling — analogue of reference ``core/DataPurifier.java``
+(JEXL expressions) and ``core/DataSampler.java``.
+
+Filter expressions are evaluated vectorized via ``pandas.eval`` over the
+chunk's columns (numeric where parseable, else string), so
+``"bad_num > 2 and is_fraud == 'T'"`` style expressions work.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+
+class DataPurifier:
+    def __init__(self, filter_expression: Optional[str]):
+        self.expr = (filter_expression or "").strip()
+
+    def mask(self, df: pd.DataFrame) -> np.ndarray:
+        """Boolean keep-mask for the chunk; invalid expressions keep all rows
+        (the reference logs and ignores bad filters)."""
+        n = len(df)
+        if not self.expr:
+            return np.ones(n, dtype=bool)
+        env = {}
+        for col in df.columns:
+            vals = df[col]
+            num = pd.to_numeric(vals, errors="coerce")
+            env[col] = num if not num.isna().all() else vals
+        try:
+            res = pd.eval(self.expr, local_dict=env)
+            arr = np.asarray(res, dtype=bool)
+            if arr.shape != (n,):
+                return np.ones(n, dtype=bool)
+            return arr
+        except Exception:
+            return np.ones(n, dtype=bool)
+
+
+def sample_mask(n: int, rate: float, seed: int, neg_only: bool = False,
+                targets: Optional[np.ndarray] = None) -> np.ndarray:
+    """Bernoulli sampling mask; with ``neg_only`` positives are always kept
+    (reference stats/norm ``sampleNegOnly`` semantics)."""
+    if rate >= 1.0:
+        return np.ones(n, dtype=bool)
+    rng = np.random.default_rng(seed)
+    keep = rng.random(n) < rate
+    if neg_only and targets is not None:
+        keep |= targets == 1.0
+    return keep

@@ -1,0 +1,175 @@
+"""Columnar streaming reader — the data plane's ingest edge.
+
+Replaces the reference's Pig/HDFS ETL input path (``ShifuPigStorage``,
+``CombineInputFormat``): delimited text shards (optionally gzipped) are
+streamed chunk-by-chunk into columnar numpy arrays, ready to be binned /
+normalized on device.  Directories of part files, single files, and glob
+patterns are all accepted, mirroring the reference's part-file scanning
+(``fs/ShifuFileUtils.java``).
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import io
+import os
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+
+def resolve_data_files(data_path: str) -> List[str]:
+    """Expand a file / directory / glob into an ordered list of data files.
+
+    Skips hidden files (``.pig_header``, ``_SUCCESS``), like the reference's
+    part-file scanners.
+    """
+    if os.path.isdir(data_path):
+        files = sorted(
+            os.path.join(data_path, f) for f in os.listdir(data_path)
+            if not f.startswith(".") and not f.startswith("_"))
+        return [f for f in files if os.path.isfile(f)]
+    if os.path.isfile(data_path):
+        return [data_path]
+    files = sorted(glob.glob(data_path))
+    if not files:
+        raise FileNotFoundError(f"no data files at {data_path}")
+    return files
+
+
+def read_header(header_path: Optional[str], header_delimiter: str,
+                data_files: Optional[Sequence[str]] = None,
+                data_delimiter: str = "|") -> List[str]:
+    """Read column names from a header file, or fall back to the first data
+    line (named or synthesized), reference ``InitModelProcessor`` behavior."""
+    if header_path and os.path.isfile(header_path):
+        with _open_text(header_path) as f:
+            line = f.readline().rstrip("\r\n")
+        return [c.strip() for c in line.split(header_delimiter)]
+    if not data_files:
+        raise ValueError("neither header file nor data files to infer header from")
+    with _open_text(data_files[0]) as f:
+        line = f.readline().rstrip("\r\n")
+    fields = line.split(data_delimiter)
+    # Heuristic: if no field parses as a number, treat the first row as header.
+    def _is_num(s: str) -> bool:
+        try:
+            float(s)
+            return True
+        except ValueError:
+            return False
+    if any(_is_num(x) for x in fields):
+        return [f"column_{i}" for i in range(len(fields))]
+    return [c.strip() for c in fields]
+
+
+def _open_text(path: str):
+    if path.endswith(".gz"):
+        return io.TextIOWrapper(gzip.open(path, "rb"), encoding="utf-8", errors="replace")
+    return open(path, encoding="utf-8", errors="replace")
+
+
+@dataclass
+class RawChunk:
+    """A chunk of raw rows in columnar string form."""
+    columns: List[str]
+    data: pd.DataFrame  # all-string columns, "" for empty
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    def col(self, name: str) -> np.ndarray:
+        return self.data[name].to_numpy()
+
+
+class DataSource:
+    """Streaming columnar reader over one dataset (dataPath + delimiter)."""
+
+    def __init__(self, data_path: str, data_delimiter: str = "|",
+                 header: Optional[List[str]] = None,
+                 header_path: Optional[str] = None,
+                 header_delimiter: str = "|"):
+        self.files = resolve_data_files(data_path)
+        self.delimiter = data_delimiter or "|"
+        if header is None:
+            header = read_header(header_path, header_delimiter or self.delimiter,
+                                 self.files, self.delimiter)
+        self.header = header
+
+    def iter_chunks(self, chunk_rows: int = 262144) -> Iterator[RawChunk]:
+        """Yield RawChunks of up to ``chunk_rows`` rows across all files."""
+        for path in self.files:
+            reader = pd.read_csv(
+                path, sep=self.delimiter, engine="c", header=None,
+                names=self.header, dtype=str, chunksize=chunk_rows,
+                keep_default_na=False, na_filter=False, quoting=3,
+                on_bad_lines="skip", compression="infer")
+            first = True
+            for df in reader:
+                if first:
+                    first = False
+                    # drop a literal header row if present in the data file
+                    row0 = df.iloc[0].tolist()
+                    if row0 == list(self.header):
+                        df = df.iloc[1:]
+                        if df.empty:
+                            continue
+                if len(df.columns) != len(self.header):
+                    raise ValueError(
+                        f"{path}: {len(df.columns)} fields vs {len(self.header)} header cols")
+                yield RawChunk(columns=self.header, data=df)
+
+    def read_all(self) -> RawChunk:
+        dfs = [c.data for c in self.iter_chunks()]
+        if not dfs:
+            return RawChunk(self.header, pd.DataFrame({c: [] for c in self.header}, dtype=str))
+        return RawChunk(self.header, pd.concat(dfs, ignore_index=True))
+
+
+# ------------------------------------------------------------------ parsing
+def parse_numeric(values: np.ndarray, missing_values: Sequence[str] = ()) -> tuple:
+    """Vectorized string->float parse.
+
+    Returns ``(floats, valid_mask)`` where invalid/missing entries are NaN and
+    masked out.  This is the analogue of the reference's per-value
+    try/parse-with-missing-list (``NormalizeUDF``/``CalculateStatsUDF``).
+    """
+    s = pd.Series(values, dtype=str).str.strip()
+    floats = pd.to_numeric(s, errors="coerce").to_numpy(dtype=np.float64)
+    valid = ~np.isnan(floats)
+    if len(missing_values):
+        missing_set = {m.strip().lower() for m in missing_values}
+        is_missing = s.str.lower().isin(missing_set).to_numpy()
+        valid &= ~is_missing
+        floats = np.where(is_missing, np.nan, floats)
+    return floats, valid
+
+
+def tag_to_target(values: np.ndarray, pos_tags: Sequence[str],
+                  neg_tags: Sequence[str]) -> np.ndarray:
+    """Map tag strings -> {1.0 pos, 0.0 neg, NaN neither}.
+
+    Rows with unknown tags are later filtered, matching the reference's
+    invalid-tag filtering in its UDF layer.
+    """
+    s = pd.Series(values, dtype=str).str.strip()
+    pos = set(str(t).strip() for t in pos_tags)
+    neg = set(str(t).strip() for t in neg_tags)
+    out = np.full(len(s), np.nan, dtype=np.float64)
+    out[s.isin(pos).to_numpy()] = 1.0
+    if neg:
+        out[s.isin(neg).to_numpy()] = 0.0
+    elif len(pos):  # multi-class handled elsewhere; binary w/o negTags: rest=0
+        out[(~s.isin(pos)).to_numpy()] = 0.0
+    return out
+
+
+def parse_weight(values: Optional[np.ndarray], n: int) -> np.ndarray:
+    if values is None:
+        return np.ones(n, dtype=np.float64)
+    w, valid = parse_numeric(values)
+    w = np.where(valid & (w > 0), w, 1.0)
+    return w

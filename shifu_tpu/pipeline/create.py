@@ -1,0 +1,135 @@
+"""`new` + `init` steps.
+
+Reference: ``CreateModelProcessor.java`` (scaffold a model-set dir with a
+template ModelConfig.json) and ``InitModelProcessor.java:74,89`` (build the
+initial ColumnConfig.json from the header, with auto-type inference standing
+in for the reference's HyperLogLog distinct-count MR job,
+``InitModelProcessor.java:334-347``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional
+
+import numpy as np
+import pandas as pd
+
+from ..config import (ColumnConfig, ColumnFlag, ColumnType, ModelConfig,
+                      build_initial_column_configs, save_column_configs)
+from ..config.validator import ModelStep
+from ..data import DataSource, parse_numeric
+from .processor import BasicProcessor
+
+log = logging.getLogger(__name__)
+
+
+def create_new_model(name: str, base_dir: str = ".", algorithm: str = "NN") -> str:
+    """``shifu-tpu new <name>``: scaffold the model-set directory."""
+    model_dir = os.path.join(base_dir, name)
+    os.makedirs(model_dir, exist_ok=True)
+    mc_path = os.path.join(model_dir, "ModelConfig.json")
+    if os.path.isfile(mc_path):
+        raise FileExistsError(f"{mc_path} already exists")
+    mc = ModelConfig.create(name)
+    from ..config.jsonbean import parse_enum
+    from ..config.model_config import Algorithm
+    mc.train.algorithm = parse_enum(Algorithm, algorithm)
+    mc.save(mc_path)
+    log.info("created model set at %s", model_dir)
+    return model_dir
+
+
+def _read_column_file(path: Optional[str], base_dir: str) -> List[str]:
+    if not path:
+        return []
+    p = path if os.path.isabs(path) else os.path.join(base_dir, path)
+    if not os.path.isfile(p):
+        return []
+    out = []
+    with open(p) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.append(line)
+    return out
+
+
+class InitProcessor(BasicProcessor):
+    step = ModelStep.INIT
+
+    # Columns whose distinct count / numeric-parse rate crosses these are
+    # auto-typed categorical, standing in for the reference's
+    # CountAndFrequentItemsWritable + 0.1*count heuristics (core/autotype).
+    CATE_FREQ_THRESHOLD = 0.95
+
+    def run(self) -> int:
+        self.setup(require_columns=False)
+        return self.process()
+
+    def process(self) -> int:
+        mc = self.model_config
+        ds = mc.dataSet
+        source = DataSource(self._abs(ds.dataPath), ds.dataDelimiter,
+                            header_path=self._abs(ds.headerPath),
+                            header_delimiter=ds.headerDelimiter)
+        header = source.header
+        if ds.targetColumnName and ds.targetColumnName not in header:
+            raise ValueError(f"target column {ds.targetColumnName!r} not in header "
+                             f"({len(header)} columns)")
+        meta = _read_column_file(ds.metaColumnNameFile, self.dir)
+        cate = _read_column_file(ds.categoricalColumnNameFile, self.dir)
+        configs = build_initial_column_configs(
+            header, ds.targetColumnName, meta_cols=meta, categorical_cols=cate,
+            weight_col=ds.weightColumnName)
+        if not cate:
+            self._auto_type(source, configs)
+        self.column_configs = configs
+        self.backup(self.paths.column_config_path)
+        self.save_column_configs()
+        log.info("init: %d columns (%d categorical, %d meta)", len(configs),
+                 sum(c.is_categorical() for c in configs), len(meta))
+        return 0
+
+    def _abs(self, p: Optional[str]) -> Optional[str]:
+        if p is None:
+            return None
+        return p if os.path.isabs(p) else os.path.normpath(os.path.join(self.dir, p))
+
+    def _auto_type(self, source: DataSource, configs: List[ColumnConfig],
+                   sample_rows: int = 200_000) -> None:
+        """Numeric/categorical inference from a data sample (analogue of the
+        reference's distinct-count MR auto-type job)."""
+        seen = 0
+        parse_ok = None
+        non_empty = None
+        samples = [set() for _ in configs]
+        for chunk in source.iter_chunks(chunk_rows=min(sample_rows, 262144)):
+            df = chunk.data
+            if parse_ok is None:
+                parse_ok = np.zeros(len(configs), dtype=np.int64)
+                non_empty = np.zeros(len(configs), dtype=np.int64)
+            for i, cc in enumerate(configs):
+                vals = df[cc.columnName].to_numpy()
+                floats, valid = parse_numeric(vals)
+                s = pd.Series(vals, dtype=str).str.strip()
+                ne = (s != "").to_numpy()
+                parse_ok[i] += int(valid.sum())
+                non_empty[i] += int(ne.sum())
+                if len(samples[i]) < 1000:
+                    samples[i].update(s[ne][:200].tolist())
+            seen += len(df)
+            if seen >= sample_rows:
+                break
+        if parse_ok is None:
+            return
+        for i, cc in enumerate(configs):
+            if cc.is_target() or cc.is_meta():
+                continue
+            if cc.columnType != ColumnType.N or non_empty[i] == 0:
+                continue
+            rate = parse_ok[i] / max(1, non_empty[i])
+            if rate < self.CATE_FREQ_THRESHOLD:
+                cc.columnType = ColumnType.C
+            cc.sampleValues = sorted(samples[i])[:20] if rate < 1.0 else None

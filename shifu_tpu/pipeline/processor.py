@@ -1,0 +1,76 @@
+"""Pipeline processors — step orchestration.
+
+Analogue of the reference's processor layer (``core/processor/``): one
+processor per CLI step with shared setup/teardown (config load, validation,
+ColumnConfig save) in ``BasicProcessor`` (reference
+``BasicModelProcessor.java``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import time
+from typing import List, Optional
+
+from ..config import (ColumnConfig, ModelConfig, PathFinder,
+                      load_column_configs, save_column_configs)
+from ..config.validator import ModelStep, probe
+
+log = logging.getLogger(__name__)
+
+
+class BasicProcessor:
+    """Shared step setup/teardown (reference ``BasicModelProcessor.java``)."""
+
+    step: ModelStep = ModelStep.NEW
+
+    def __init__(self, model_set_dir: str = ".", params: Optional[dict] = None):
+        self.dir = os.path.abspath(model_set_dir)
+        self.params = params or {}
+        self.model_config: Optional[ModelConfig] = None
+        self.column_configs: List[ColumnConfig] = []
+        self.paths: Optional[PathFinder] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def setup(self, require_columns: bool = True) -> None:
+        mc_path = os.path.join(self.dir, "ModelConfig.json")
+        if not os.path.isfile(mc_path):
+            raise FileNotFoundError(
+                f"{mc_path} not found — run `shifu-tpu new <name>` first")
+        self.model_config = ModelConfig.load(mc_path)
+        self.paths = PathFinder(self.model_config, self.dir)
+        probe(self.model_config, self.step, self.dir)
+        cc_path = self.paths.column_config_path
+        if os.path.isfile(cc_path):
+            self.column_configs = load_column_configs(cc_path)
+        elif require_columns:
+            raise FileNotFoundError(
+                f"{cc_path} not found — run `shifu-tpu init` first")
+        self.paths.ensure_dirs()
+
+    def save_column_configs(self) -> None:
+        save_column_configs(self.column_configs, self.paths.column_config_path)
+
+    def save_model_config(self) -> None:
+        self.model_config.save(self.paths.model_config_path)
+
+    def run(self) -> int:
+        t0 = time.time()
+        log.info("step %s start", self.step.name)
+        self.setup()
+        code = self.process()
+        log.info("step %s done in %.2fs", self.step.name, time.time() - t0)
+        return code
+
+    def process(self) -> int:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # -------------------------------------------------------------- helpers
+    def backup(self, path: str) -> None:
+        """Keep one backup generation of a config file before overwrite."""
+        if os.path.isfile(path):
+            bdir = self.paths.backup_dir
+            os.makedirs(bdir, exist_ok=True)
+            shutil.copy2(path, os.path.join(bdir, os.path.basename(path)))

@@ -1,0 +1,74 @@
+"""Test rig: force an 8-device virtual CPU mesh before jax initializes.
+
+The reference only unit-tests master/worker math separately (SURVEY.md §4);
+here every distributed code path runs for real on a virtual multi-device mesh.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def fraud_csv(tmp_path_factory):
+    """Synthetic fraud-style dataset: mixed numeric/categorical, missing
+    values, a weight column, '|' delimited like the reference's tutorial data."""
+    rng = np.random.default_rng(7)
+    n = 4000
+    amount = rng.lognormal(3.0, 1.2, n)
+    velocity = rng.poisson(3, n).astype(float)
+    age_days = rng.integers(0, 2000, n).astype(float)
+    country = rng.choice(["US", "GB", "DE", "CN", "BR"], n, p=[.5, .15, .15, .1, .1])
+    channel = rng.choice(["web", "app", "pos"], n)
+    noise = rng.normal(0, 1, n)
+    logit = (0.8 * np.log1p(amount) - 0.004 * age_days + 0.35 * velocity
+             + (country == "BR") * 1.2 + (channel == "web") * 0.4 - 4.0)
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(int)
+    tag = np.where(y == 1, "bad", "good")
+    weight = np.round(rng.uniform(0.5, 2.0, n), 3)
+    miss = rng.random(n) < 0.05
+    amount_s = np.round(amount, 4).astype(str)
+    amount_s[miss] = ""
+    rows = ["txn_id|amount|velocity|age_days|country|channel|noise|weight|tag"]
+    for i in range(n):
+        rows.append(f"t{i}|{amount_s[i]}|{velocity[i]:.0f}|{age_days[i]:.0f}|"
+                    f"{country[i]}|{channel[i]}|{noise[i]:.5f}|{weight[i]}|{tag[i]}")
+    d = tmp_path_factory.mktemp("fraud")
+    path = d / "part-000.csv"
+    path.write_text("\n".join(rows) + "\n")
+    return str(path)
+
+
+@pytest.fixture
+def model_set(tmp_path, fraud_csv):
+    """A scaffolded model set over the synthetic fraud data, ready for init."""
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.pipeline.create import create_new_model
+
+    mdir = create_new_model("fraudtest", base_dir=str(tmp_path))
+    mc = ModelConfig.load(os.path.join(mdir, "ModelConfig.json"))
+    mc.dataSet.dataPath = fraud_csv
+    mc.dataSet.dataDelimiter = "|"
+    mc.dataSet.targetColumnName = "tag"
+    mc.dataSet.posTags = ["bad"]
+    mc.dataSet.negTags = ["good"]
+    mc.dataSet.weightColumnName = "weight"
+    mc.dataSet.metaColumnNameFile = None
+    mc.train.baggingNum = 1
+    mc.train.numTrainEpochs = 30
+    mc.evals[0].dataSet.dataPath = fraud_csv
+    mc.evals[0].dataSet.dataDelimiter = "|"
+    mc.save(os.path.join(mdir, "ModelConfig.json"))
+    return mdir

@@ -1,0 +1,112 @@
+"""Config substrate tests: JSON round-trip + reference-contract compatibility."""
+
+import json
+import os
+
+import pytest
+
+from shifu_tpu.config import (Algorithm, ColumnConfig, ColumnFlag, ColumnType,
+                              ModelConfig, NormType,
+                              build_initial_column_configs,
+                              load_column_configs, save_column_configs)
+from shifu_tpu.config.jsonbean import parse_enum
+from shifu_tpu.config.validator import ModelStep, ValidationError, probe
+
+REFERENCE_STYLE_MODEL_CONFIG = {
+    "basic": {"name": "cancer-judgement", "author": "", "description": None,
+              "runMode": "local", "customPaths": None},
+    "dataSet": {"source": "LOCAL", "dataPath": "./data", "dataDelimiter": "|",
+                "headerPath": "./data/.pig_header", "headerDelimiter": "|",
+                "filterExpressions": "", "weightColumnName": "column_3",
+                "targetColumnName": "diagnosis", "posTags": ["M"], "negTags": ["B"],
+                "metaColumnNameFile": None, "categoricalColumnNameFile": None},
+    "stats": {"maxNumBin": 10, "binningMethod": "EqualPositive", "sampleRate": 1.0,
+              "sampleNegOnly": False},
+    "varSelect": {"forceEnable": True, "filterEnable": True, "filterNum": 200,
+                  "filterBy": "KS",
+                  "params": {"worker_sample_rate": 0.5}},
+    "normalize": {"stdDevCutOff": 4.0, "sampleRate": 1.0, "sampleNegOnly": False},
+    "train": {"baggingNum": 5, "baggingWithReplacement": True,
+              "baggingSampleRate": 1.0, "validSetRate": 0.1, "trainOnDisk": False,
+              "numTrainEpochs": 100, "algorithm": "NN",
+              "params": {"NumHiddenLayers": 2, "ActivationFunc": ["Sigmoid", "Sigmoid"],
+                         "NumHiddenNodes": [45, 45], "LearningRate": 0.1,
+                         "Propagation": "Q"}},
+    "evals": [{"name": "EvalA",
+               "dataSet": {"source": "LOCAL", "dataPath": "./evaldata",
+                           "dataDelimiter": "|"},
+               "performanceBucketNum": 10, "performanceScoreSelector": "mean"}],
+}
+
+
+def test_model_config_loads_reference_style_json():
+    mc = ModelConfig.from_dict(REFERENCE_STYLE_MODEL_CONFIG)
+    assert mc.basic.name == "cancer-judgement"
+    assert mc.dataSet.posTags == ["M"] and mc.dataSet.negTags == ["B"]
+    assert mc.train.algorithm == Algorithm.NN
+    assert mc.train.params["NumHiddenNodes"] == [45, 45]
+    assert mc.stats.binningMethod.name == "EqualPositive"
+    assert len(mc.evals) == 1 and mc.evals[0].name == "EvalA"
+
+
+def test_model_config_round_trip(tmp_path):
+    mc = ModelConfig.from_dict(REFERENCE_STYLE_MODEL_CONFIG)
+    p = str(tmp_path / "ModelConfig.json")
+    mc.save(p)
+    mc2 = ModelConfig.load(p)
+    assert mc2.to_dict()["dataSet"]["targetColumnName"] == "diagnosis"
+    assert mc2.train.params == mc.train.params
+    assert mc2.normalize.normType == NormType.ZSCALE  # default preserved
+
+
+def test_unknown_keys_survive_round_trip(tmp_path):
+    d = dict(REFERENCE_STYLE_MODEL_CONFIG)
+    d["someFutureSection"] = {"a": 1}
+    mc = ModelConfig.from_dict(d)
+    p = str(tmp_path / "m.json")
+    mc.save(p)
+    with open(p) as f:
+        out = json.load(f)
+    assert out["someFutureSection"] == {"a": 1}
+
+
+def test_enum_parse_case_insensitive():
+    assert parse_enum(NormType, "zscale") == NormType.ZSCALE
+    assert parse_enum(Algorithm, "gbt") == Algorithm.GBT
+    with pytest.raises(ValueError):
+        parse_enum(Algorithm, "nope")
+
+
+def test_column_config_init_and_round_trip(tmp_path):
+    header = ["id", "amount", "country", "tag", "w"]
+    ccs = build_initial_column_configs(header, target="tag",
+                                      meta_cols=["id"], categorical_cols=["country"],
+                                      weight_col="w")
+    assert ccs[0].columnFlag == ColumnFlag.Meta
+    assert ccs[2].columnType == ColumnType.C
+    assert ccs[3].is_target() and ccs[4].is_weight()
+    ccs[1].columnStats.mean = 3.5
+    ccs[1].columnBinning.binBoundary = [float("-inf"), 1.0, 2.0]
+    p = str(tmp_path / "ColumnConfig.json")
+    save_column_configs(ccs, p)
+    back = load_column_configs(p)
+    assert back[1].columnStats.mean == 3.5
+    assert back[1].columnBinning.binBoundary[1] == 1.0
+    assert back[3].columnFlag == ColumnFlag.Target
+
+
+def test_validator_catches_problems():
+    mc = ModelConfig.from_dict(REFERENCE_STYLE_MODEL_CONFIG)
+    probe(mc, ModelStep.TRAIN)  # valid
+    mc.train.baggingNum = 0
+    mc.train.validSetRate = 1.5
+    with pytest.raises(ValidationError) as e:
+        probe(mc, ModelStep.TRAIN)
+    assert len(e.value.problems) == 2
+
+
+def test_nn_param_consistency_validated():
+    mc = ModelConfig.from_dict(REFERENCE_STYLE_MODEL_CONFIG)
+    mc.train.params["NumHiddenLayers"] = 3  # mismatch with 2 nodes/act lists
+    with pytest.raises(ValidationError):
+        probe(mc, ModelStep.TRAIN)

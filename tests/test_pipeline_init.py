@@ -1,0 +1,44 @@
+"""`new` + `init` pipeline steps over the synthetic fraud dataset."""
+
+import os
+
+import pytest
+
+from shifu_tpu.config import ColumnFlag, ColumnType, ModelConfig, load_column_configs
+from shifu_tpu.data import DataSource, parse_numeric, tag_to_target
+from shifu_tpu.pipeline.create import InitProcessor, create_new_model
+
+
+def test_new_scaffolds_model_config(tmp_path):
+    mdir = create_new_model("m1", base_dir=str(tmp_path), algorithm="GBT")
+    mc = ModelConfig.load(os.path.join(mdir, "ModelConfig.json"))
+    assert mc.basic.name == "m1"
+    assert mc.train.algorithm.name == "GBT"
+    with pytest.raises(FileExistsError):
+        create_new_model("m1", base_dir=str(tmp_path))
+
+
+def test_init_builds_column_config(model_set):
+    assert InitProcessor(model_set).run() == 0
+    ccs = load_column_configs(os.path.join(model_set, "ColumnConfig.json"))
+    by_name = {c.columnName: c for c in ccs}
+    assert by_name["tag"].columnFlag == ColumnFlag.Target
+    assert by_name["weight"].columnFlag == ColumnFlag.Weight
+    # auto-type: country/channel/txn_id categorical, amount numeric
+    assert by_name["country"].columnType == ColumnType.C
+    assert by_name["channel"].columnType == ColumnType.C
+    assert by_name["txn_id"].columnType == ColumnType.C
+    assert by_name["amount"].columnType == ColumnType.N
+    assert by_name["noise"].columnType == ColumnType.N
+
+
+def test_reader_and_target_parse(fraud_csv):
+    src = DataSource(fraud_csv, "|")
+    assert src.header[0] == "txn_id" and src.header[-1] == "tag"
+    chunk = src.read_all()
+    assert len(chunk) == 4000
+    y = tag_to_target(chunk.col("tag"), ["bad"], ["good"])
+    assert set(y.tolist()) <= {0.0, 1.0}
+    amt, valid = parse_numeric(chunk.col("amount"), missing_values=["", "?"])
+    assert valid.sum() < len(valid)  # some missing
+    assert amt[valid].min() >= 0
