@@ -1,0 +1,82 @@
+"""Chunk extraction: raw string chunk -> typed arrays per the ColumnConfig list.
+
+Shared by stats / normalize / eval: applies the row filter, parses the target
+tag, weight column, numeric candidate columns into one [R, C] matrix and
+leaves categorical columns as string arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..config import ColumnConfig, ModelConfig
+from .purifier import DataPurifier
+from .reader import RawChunk, parse_numeric, parse_weight, tag_to_target
+
+
+@dataclass
+class ExtractedChunk:
+    n: int
+    target: np.ndarray                 # [R] 1/0
+    weight: np.ndarray                 # [R]
+    numeric: np.ndarray                # [R, C_num] float64 (NaN = missing)
+    numeric_valid: np.ndarray          # [R, C_num] bool
+    numeric_cols: List[ColumnConfig]
+    categorical: Dict[str, np.ndarray]  # name -> [R] str values
+    categorical_cols: List[ColumnConfig]
+    raw: Optional[RawChunk] = None
+
+
+class ChunkExtractor:
+    def __init__(self, model_config: ModelConfig, column_configs: List[ColumnConfig],
+                 columns: Optional[List[ColumnConfig]] = None,
+                 for_eval_set: Optional[int] = None):
+        self.mc = model_config
+        ds = model_config.dataSet if for_eval_set is None else \
+            model_config.evals[for_eval_set].dataSet
+        self.ds = ds
+        self.purifier = DataPurifier(ds.filterExpressions)
+        self.missing_values = model_config.dataSet.missingOrInvalidValues
+        if columns is None:
+            columns = [c for c in column_configs if c.is_candidate()]
+        self.numeric_cols = [c for c in columns if not c.is_categorical()]
+        self.categorical_cols = [c for c in columns if c.is_categorical()]
+        self.target_name = model_config.dataSet.targetColumnName
+        self.weight_name = ds.weightColumnName
+
+    def extract(self, chunk: RawChunk, keep_raw: bool = False) -> ExtractedChunk:
+        df = chunk.data
+        keep = self.purifier.mask(df)
+        if self.target_name and self.target_name in df.columns:
+            y = tag_to_target(df[self.target_name].to_numpy(),
+                              self.mc.dataSet.posTags, self.mc.dataSet.negTags)
+            keep &= ~np.isnan(y)  # drop rows with unknown tags
+        else:
+            y = np.zeros(len(df))
+        df = df[keep]
+        y = y[keep]
+        n = len(df)
+        w = parse_weight(
+            df[self.weight_name].to_numpy() if self.weight_name and
+            self.weight_name in df.columns else None, n)
+        if self.numeric_cols:
+            mats, valids = [], []
+            for cc in self.numeric_cols:
+                f, v = parse_numeric(df[cc.columnName].to_numpy(), self.missing_values)
+                mats.append(f)
+                valids.append(v)
+            numeric = np.stack(mats, axis=1)
+            numeric_valid = np.stack(valids, axis=1)
+        else:
+            numeric = np.zeros((n, 0))
+            numeric_valid = np.zeros((n, 0), dtype=bool)
+        categorical = {cc.columnName: df[cc.columnName].to_numpy()
+                       for cc in self.categorical_cols}
+        return ExtractedChunk(
+            n=n, target=y, weight=w, numeric=numeric, numeric_valid=numeric_valid,
+            numeric_cols=self.numeric_cols, categorical=categorical,
+            categorical_cols=self.categorical_cols,
+            raw=RawChunk(chunk.columns, df) if keep_raw else None)

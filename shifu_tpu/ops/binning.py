@@ -1,0 +1,320 @@
+"""Device-side streaming binning + per-column stats accumulation.
+
+TPU-native replacement for the reference's stats data path (SURVEY.md §3.2):
+the SPDT/MunroPat streaming-sketch binning (``core/binning/``) plus the
+``UpdateBinningInfo`` MR second pass become two SPMD passes over columnar
+chunks:
+
+  pass 1 (moments): per-column count/min/max + centered moments M2..M4
+          (Chan et al. pairwise combine, so f32 device sums stay accurate),
+  pass 2 (sketch):  a fine equal-width histogram per column (pos/neg counts
+          and weighted counts via one scatter-add ``segment_sum``).
+
+Bin boundaries for every binning method (EqualPositive/Total/Negative/
+Interval + weighted variants, ``ModelStatsConf.java:34-35``) are read off the
+fine histogram's cumulative sums; final per-bin pos/neg counts are exact
+segment-sums of fine buckets (boundaries always land on fine-bucket edges).
+Categorical bins are exact dict aggregations.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.model_config import BinningMethod
+
+NEG_INF = float("-inf")
+
+
+# ----------------------------------------------------------------- kernels
+@jax.jit
+def _moments_kernel(x: jnp.ndarray, valid: jnp.ndarray):
+    """Per-column count/sum/min/max + centered M2/M3/M4 for one chunk.
+
+    x: [R, C] float32 with arbitrary values where invalid; valid: [R, C] bool.
+    Centering by the chunk mean keeps f32 power sums small enough for TPU.
+    """
+    v = valid.astype(x.dtype)
+    cnt = v.sum(axis=0)
+    safe_cnt = jnp.maximum(cnt, 1.0)
+    xv = jnp.where(valid, x, 0.0)
+    s1 = xv.sum(axis=0)
+    mean = s1 / safe_cnt
+    d = jnp.where(valid, x - mean, 0.0)
+    m2 = (d * d).sum(axis=0)
+    m3 = (d * d * d).sum(axis=0)
+    m4 = (d * d * d * d).sum(axis=0)
+    big = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
+    mn = jnp.where(valid, x, big).min(axis=0)
+    mx = jnp.where(valid, x, -big).max(axis=0)
+    return cnt, mean, m2, m3, m4, mn, mx
+
+
+@functools.partial(jax.jit, static_argnames=("num_buckets",))
+def _histogram_kernel(x: jnp.ndarray, valid: jnp.ndarray, target: jnp.ndarray,
+                      weight: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
+                      num_buckets: int):
+    """Fine-histogram scatter-add for one chunk.
+
+    Returns [C, num_buckets, 4]: (#pos, #neg, w_pos, w_neg) per fine bucket.
+    One flattened ``segment_sum`` — the TPU analogue of the reference's
+    per-(column,bin) reducer accumulation.
+    """
+    R, C = x.shape
+    scale = num_buckets / jnp.maximum(hi - lo, 1e-30)
+    idx = jnp.clip(((x - lo) * scale), 0, num_buckets - 1).astype(jnp.int32)
+    flat = idx + jnp.arange(C, dtype=jnp.int32) * num_buckets
+    flat = jnp.where(valid, flat, C * num_buckets)  # overflow slot for invalid
+    is_pos = (target >= 0.5)[:, None]
+    w = weight[:, None]
+    ones = jnp.ones((R, 1), x.dtype)
+    vals = jnp.concatenate([
+        jnp.where(is_pos, ones, 0.0), jnp.where(is_pos, 0.0, ones),
+        jnp.where(is_pos, w, 0.0), jnp.where(is_pos, 0.0, w)], axis=1)  # [R,4]
+    data = jnp.broadcast_to(vals[:, None, :], (R, C, 4)).reshape(R * C, 4)
+    seg = jax.ops.segment_sum(data, flat.reshape(-1),
+                              num_segments=C * num_buckets + 1)
+    return seg[:-1].reshape(C, num_buckets, 4)
+
+
+# ------------------------------------------------------- moment combination
+def _combine_moments(a: dict, b: Tuple[np.ndarray, ...]) -> dict:
+    """Chan et al. pairwise combination of (count, mean, M2, M3, M4)."""
+    cb, mb, M2b, M3b, M4b, mnb, mxb = [np.asarray(t, np.float64) for t in b]
+    if not a:
+        return {"count": cb, "mean": mb, "M2": M2b, "M3": M3b, "M4": M4b,
+                "min": mnb, "max": mxb}
+    ca, ma, M2a, M3a, M4a = a["count"], a["mean"], a["M2"], a["M3"], a["M4"]
+    n = ca + cb
+    safe_n = np.maximum(n, 1.0)
+    delta = mb - ma
+    mean = ma + delta * cb / safe_n
+    M2 = M2a + M2b + delta ** 2 * ca * cb / safe_n
+    M3 = (M3a + M3b + delta ** 3 * ca * cb * (ca - cb) / safe_n ** 2
+          + 3 * delta * (ca * M2b - cb * M2a) / safe_n)
+    M4 = (M4a + M4b
+          + delta ** 4 * ca * cb * (ca ** 2 - ca * cb + cb ** 2) / safe_n ** 3
+          + 6 * delta ** 2 * (ca ** 2 * M2b + cb ** 2 * M2a) / safe_n ** 2
+          + 4 * delta * (ca * M3b - cb * M3a) / safe_n)
+    return {"count": n, "mean": np.where(n > 0, mean, 0.0), "M2": M2, "M3": M3,
+            "M4": M4, "min": np.minimum(a["min"], mnb),
+            "max": np.maximum(a["max"], mxb)}
+
+
+# ------------------------------------------------------------- accumulators
+@dataclass
+class NumericAccumulator:
+    """Streaming accumulator over numeric columns (both passes)."""
+    n_cols: int
+    num_buckets: int = 4096
+    moments: dict = field(default_factory=dict)
+    total_rows: int = 0
+    missing: Optional[np.ndarray] = None
+    hist: Optional[np.ndarray] = None          # [C, K, 4] float64
+    missing_agg: Optional[np.ndarray] = None   # [C, 4] pos/neg/wpos/wneg of missing
+    lo: Optional[np.ndarray] = None
+    hi: Optional[np.ndarray] = None
+
+    # ---- pass 1
+    def update_moments(self, x: np.ndarray, valid: np.ndarray) -> None:
+        out = _moments_kernel(jnp.asarray(x, jnp.float32), jnp.asarray(valid))
+        self.moments = _combine_moments(self.moments, out)
+        self.total_rows += x.shape[0]
+        miss = (~valid).sum(axis=0).astype(np.float64)
+        self.missing = miss if self.missing is None else self.missing + miss
+
+    def finalize_range(self) -> None:
+        mn, mx = self.moments["min"].copy(), self.moments["max"].copy()
+        empty = self.moments["count"] == 0
+        mn[empty], mx[empty] = 0.0, 1.0
+        same = mx <= mn
+        mx[same] = mn[same] + 1.0
+        self.lo, self.hi = mn, mx
+
+    # ---- pass 2
+    def update_histogram(self, x: np.ndarray, valid: np.ndarray,
+                         target: np.ndarray, weight: np.ndarray) -> None:
+        assert self.lo is not None, "call finalize_range() after pass 1"
+        h = _histogram_kernel(
+            jnp.asarray(x, jnp.float32), jnp.asarray(valid),
+            jnp.asarray(target, jnp.float32), jnp.asarray(weight, jnp.float32),
+            jnp.asarray(self.lo, jnp.float32), jnp.asarray(self.hi, jnp.float32),
+            self.num_buckets)
+        h = np.asarray(h, np.float64)
+        self.hist = h if self.hist is None else self.hist + h
+        # missing-bin aggregation (invalid entries)
+        is_pos = target >= 0.5
+        inval = ~valid
+        magg = np.stack([
+            (inval & is_pos[:, None]).sum(0),
+            (inval & ~is_pos[:, None]).sum(0),
+            (inval * (weight * is_pos)[:, None]).sum(0),
+            (inval * (weight * ~is_pos)[:, None]).sum(0)], axis=1).astype(np.float64)
+        self.missing_agg = magg if self.missing_agg is None else self.missing_agg + magg
+
+    # ---- boundary derivation
+    def bucket_edges(self, col: int) -> np.ndarray:
+        return np.linspace(self.lo[col], self.hi[col], self.num_buckets + 1)
+
+    def compute_boundaries(self, method: BinningMethod, max_bins: int) -> List[np.ndarray]:
+        """Per-column bin boundaries; element 0 is -inf like the reference's
+        ``binBoundary`` (value v falls in bin i when b[i] <= v < b[i+1])."""
+        assert self.hist is not None
+        out = []
+        for c in range(self.n_cols):
+            h = self.hist[c]  # [K, 4]
+            if method == BinningMethod.EqualInterval:
+                inner = np.linspace(self.lo[c], self.hi[c], max_bins + 1)[:-1]
+                bnds = np.concatenate([[NEG_INF], inner[1:]])
+                out.append(_dedupe(bnds))
+                continue
+            weight_col = {
+                BinningMethod.EqualTotal: h[:, 0] + h[:, 1],
+                BinningMethod.EqualPositive: h[:, 0],
+                BinningMethod.EqualNegtive: h[:, 1],
+                BinningMethod.WeightEqualTotal: h[:, 2] + h[:, 3],
+                BinningMethod.WeightEqualPositive: h[:, 2],
+                BinningMethod.WeightEqualNegative: h[:, 3],
+                BinningMethod.WeightEqualInterval: h[:, 0] + h[:, 1],
+            }.get(method, h[:, 0] + h[:, 1])
+            total = weight_col.sum()
+            if total <= 0:
+                out.append(np.array([NEG_INF]))
+                continue
+            cum = np.cumsum(weight_col)
+            targets = total * np.arange(1, max_bins) / max_bins
+            # first fine-bucket index where cum >= target -> boundary at its right edge
+            pos = np.searchsorted(cum, targets, side="left")
+            edges = self.bucket_edges(c)
+            bnds = np.concatenate([[NEG_INF], edges[pos + 1]])
+            out.append(_dedupe(bnds))
+        return out
+
+    def bin_counts(self, col: int, boundaries: np.ndarray) -> np.ndarray:
+        """Exact per-bin (pos, neg, wpos, wneg) counts incl. trailing missing
+        bin, derived by segment-summing fine buckets."""
+        edges = self.bucket_edges(col)
+        # fine bucket k covers [edges[k], edges[k+1]); assign to final bin
+        bucket_bin = np.searchsorted(boundaries, edges[:-1], side="right") - 1
+        bucket_bin = np.clip(bucket_bin, 0, len(boundaries) - 1)
+        n_bins = len(boundaries)
+        agg = np.zeros((n_bins + 1, 4))
+        np.add.at(agg, bucket_bin, self.hist[col])
+        if self.missing_agg is not None:
+            agg[n_bins] = self.missing_agg[col]
+        return agg
+
+    def percentile(self, col: int, q: Sequence[float]) -> np.ndarray:
+        """Approximate percentiles (to fine-bucket resolution) from the sketch."""
+        h = self.hist[col][:, 0] + self.hist[col][:, 1]
+        total = h.sum()
+        if total <= 0:
+            return np.full(len(q), np.nan)
+        cum = np.cumsum(h)
+        edges = self.bucket_edges(col)
+        pos = np.searchsorted(cum, np.asarray(q) * total, side="left")
+        return edges[np.minimum(pos + 1, self.num_buckets)]
+
+    def distinct_estimate(self, col: int) -> int:
+        """Lower-bound distinct estimate = occupied fine buckets (the
+        reference uses HyperLogLog; this is the sketch-native analogue)."""
+        return int((self.hist[col].sum(axis=1) > 0).sum())
+
+
+def _dedupe(bnds: np.ndarray) -> np.ndarray:
+    keep = np.ones(len(bnds), dtype=bool)
+    keep[1:] = np.diff(bnds) > 0
+    return bnds[keep]
+
+
+@dataclass
+class CategoricalAccumulator:
+    """Exact per-category pos/neg/weight aggregation (dict-based, streamed)."""
+    stats: Dict[str, Dict[str, np.ndarray]] = field(default_factory=dict)
+
+    def update(self, col_name: str, values: np.ndarray, valid: np.ndarray,
+               target: np.ndarray, weight: np.ndarray) -> None:
+        import pandas as pd
+        d = self.stats.setdefault(col_name, {})
+        is_pos = target >= 0.5
+        df = pd.DataFrame({
+            "cat": pd.Series(values, dtype=str).str.strip(),
+            "pos": is_pos & valid, "neg": (~is_pos) & valid,
+            "wpos": weight * is_pos * valid, "wneg": weight * (~is_pos) * valid,
+            "valid": valid})
+        g = df[df["valid"]].groupby("cat", sort=False)[["pos", "neg", "wpos", "wneg"]].sum()
+        for cat, row in g.iterrows():
+            prev = d.get(cat)
+            arr = row.to_numpy(dtype=np.float64)
+            d[cat] = arr if prev is None else prev + arr
+        # missing accumulated under the reserved key
+        inval = ~valid
+        if inval.any():
+            m = np.array([
+                (inval & is_pos).sum(), (inval & ~is_pos).sum(),
+                (weight * (inval & is_pos)).sum(), (weight * (inval & ~is_pos)).sum()],
+                dtype=np.float64)
+            prev = d.get(_MISSING_KEY)
+            d[_MISSING_KEY] = m if prev is None else prev + m
+
+    def finalize(self, col_name: str, max_cates: int = 0):
+        """Return (categories, counts[cats+1, 4]) — last row = missing bin.
+        Categories ordered by columnNum-stable frequency desc; if
+        ``max_cates``>0, overflow categories are folded into the missing bin
+        (the reference caps via ``cateMaxNumBin``)."""
+        d = self.stats.get(col_name, {})
+        items = [(k, v) for k, v in d.items() if k != _MISSING_KEY]
+        items.sort(key=lambda kv: (-(kv[1][0] + kv[1][1]), kv[0]))
+        missing = d.get(_MISSING_KEY, np.zeros(4))
+        if max_cates and len(items) > max_cates:
+            for _, v in items[max_cates:]:
+                missing = missing + v
+            items = items[:max_cates]
+        cats = [k for k, _ in items]
+        counts = np.stack([v for _, v in items] + [missing]) if items else \
+            missing[None, :]
+        return cats, counts
+
+
+_MISSING_KEY = "\x00__missing__"
+
+
+# ----------------------------------------------------------------- binner
+class ColumnBinner:
+    """Maps raw column values -> bin indices given finalized binning.
+
+    Numeric: searchsorted over binBoundary (boundary[0] = -inf); categorical:
+    exact category index; missing/unseen -> ``num_bins`` (the trailing missing
+    bin), matching reference ``BinUtils.getBinNum`` semantics.
+    """
+
+    def __init__(self, boundaries: Optional[np.ndarray] = None,
+                 categories: Optional[List[str]] = None):
+        assert (boundaries is None) != (categories is None)
+        self.boundaries = None if boundaries is None else np.asarray(boundaries, np.float64)
+        self.categories = categories
+        self.cat_index = None if categories is None else \
+            {c: i for i, c in enumerate(categories)}
+
+    @property
+    def num_bins(self) -> int:
+        if self.boundaries is not None:
+            return len(self.boundaries)
+        return len(self.categories)
+
+    def bin_numeric(self, x: np.ndarray, valid: np.ndarray) -> np.ndarray:
+        idx = np.searchsorted(self.boundaries, x, side="right") - 1
+        idx = np.clip(idx, 0, self.num_bins - 1)
+        return np.where(valid, idx, self.num_bins).astype(np.int32)
+
+    def bin_categorical(self, values: np.ndarray) -> np.ndarray:
+        import pandas as pd
+        s = pd.Series(values, dtype=str).str.strip()
+        idx = s.map(self.cat_index).fillna(self.num_bins).to_numpy(dtype=np.int64)
+        return idx.astype(np.int32)

@@ -1,0 +1,50 @@
+"""Pearson correlation across candidate columns, on device.
+
+Replaces the reference's Correlation MR job (``core/correlation/``,
+``CorrelationWritable.java:36-52`` running sums): each chunk contributes
+``X^T X`` cross-products via one MXU matmul; missing values are imputed with
+the column mean (pass-1 stats) so they contribute zero deviation — the dense,
+TPU-friendly version of the reference's pairwise ``adjustCount`` bookkeeping.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _corr_kernel(x: jnp.ndarray, valid: jnp.ndarray, mean: jnp.ndarray):
+    xc = jnp.where(valid, x - mean, 0.0)
+    return xc.T @ xc, valid.astype(x.dtype).T @ valid.astype(x.dtype)
+
+
+@dataclass
+class CorrelationAccumulator:
+    mean: np.ndarray                      # [C] per-column mean from pass 1
+    xtx: Optional[np.ndarray] = None      # [C, C] sum of centered cross-products
+    nn: Optional[np.ndarray] = None       # [C, C] pairwise valid counts
+
+    def update(self, x: np.ndarray, valid: np.ndarray) -> None:
+        a, b = _corr_kernel(jnp.asarray(x, jnp.float32), jnp.asarray(valid),
+                            jnp.asarray(self.mean, jnp.float32))
+        a = np.asarray(a, np.float64)
+        b = np.asarray(b, np.float64)
+        self.xtx = a if self.xtx is None else self.xtx + a
+        self.nn = b if self.nn is None else self.nn + b
+
+    def finalize(self) -> np.ndarray:
+        """[C, C] Pearson matrix; columns with ~zero variance give NaN."""
+        if self.xtx is None:
+            return np.zeros((len(self.mean), len(self.mean)))
+        var = np.diag(self.xtx).copy()
+        denom = np.sqrt(np.outer(var, var))
+        with np.errstate(invalid="ignore", divide="ignore"):
+            corr = np.where(denom > 1e-12, self.xtx / np.where(denom == 0, 1, denom),
+                            np.nan)
+        np.fill_diagonal(corr, 1.0)
+        return corr

@@ -1,0 +1,76 @@
+"""Pure stats math: KS / IV / WOE / PSI / pos-rate from per-bin counts.
+
+Formula parity with reference ``core/ColumnStatsCalculator.java`` (long[]
+variant, the one used by ``UpdateBinningInfoReducer.java:345``):
+
+- per-bin WOE = ln((n_i + eps) / (p_i + eps)) with p_i, n_i the bin's share of
+  total positives / negatives,
+- IV = sum (n_i - p_i) * woe_i,
+- column WOE = ln((sumNeg + eps) / (sumPos + eps)),
+- KS = 100 * max_i |cum_p - cum_n|.
+
+All functions are numpy-vectorized over the bin axis and over columns, so the
+whole ColumnConfig list is computed in one shot.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+EPS = 1e-10
+
+
+class ColumnMetrics(NamedTuple):
+    ks: np.ndarray          # [cols]
+    iv: np.ndarray          # [cols]
+    woe: np.ndarray         # [cols]
+    bin_woe: np.ndarray     # [cols, bins]
+
+
+def column_metrics(neg: np.ndarray, pos: np.ndarray) -> ColumnMetrics:
+    """KS/IV/WOE for count (or weighted-count) bin arrays.
+
+    Args:
+      neg, pos: [cols, bins] arrays (missing bin included as the last entry,
+        as the reference does).
+    Columns with zero total pos or neg get NaN metrics (reference returns null).
+    """
+    neg = np.asarray(neg, dtype=np.float64)
+    pos = np.asarray(pos, dtype=np.float64)
+    sum_n = neg.sum(axis=-1, keepdims=True)
+    sum_p = pos.sum(axis=-1, keepdims=True)
+    ok = (sum_n[..., 0] > 0) & (sum_p[..., 0] > 0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        p = pos / np.where(sum_p == 0, 1, sum_p)
+        n = neg / np.where(sum_n == 0, 1, sum_n)
+        bin_woe = np.log((n + EPS) / (p + EPS))
+        iv = ((n - p) * bin_woe).sum(axis=-1)
+        woe = np.log((sum_n[..., 0] + EPS) / (sum_p[..., 0] + EPS))
+        ks = 100.0 * np.abs(np.cumsum(p, axis=-1) - np.cumsum(n, axis=-1)).max(axis=-1)
+    nanify = lambda a: np.where(ok, a, np.nan)
+    return ColumnMetrics(ks=nanify(ks), iv=nanify(iv), woe=nanify(woe),
+                         bin_woe=np.where(ok[..., None], bin_woe, np.nan))
+
+
+def pos_rate(pos: np.ndarray, neg: np.ndarray) -> np.ndarray:
+    """binPosRate — reference ``UpdateBinningInfoReducer.computePosRate``:
+    pos/(pos+neg), NaN for empty bins."""
+    pos = np.asarray(pos, dtype=np.float64)
+    neg = np.asarray(neg, dtype=np.float64)
+    tot = pos + neg
+    with np.errstate(invalid="ignore", divide="ignore"):
+        return np.where(tot > 0, pos / np.where(tot == 0, 1, tot), np.nan)
+
+
+def psi(expected: np.ndarray, actual: np.ndarray) -> np.ndarray:
+    """Population stability index between two per-bin count vectors
+    (reference ``udf/PSICalculatorUDF``): sum((a%-e%)*ln(a%/e%))."""
+    e = np.asarray(expected, dtype=np.float64)
+    a = np.asarray(actual, dtype=np.float64)
+    e = e / np.maximum(e.sum(axis=-1, keepdims=True), EPS)
+    a = a / np.maximum(a.sum(axis=-1, keepdims=True), EPS)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        term = (a - e) * np.log((a + EPS) / (e + EPS))
+    return term.sum(axis=-1)

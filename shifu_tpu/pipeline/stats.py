@@ -1,0 +1,272 @@
+"""`stats` step: per-column statistics + binning (+ PSI, correlation).
+
+Replaces the reference's Pig/MR stats chain (SURVEY.md §3.2:
+``StatsSpdtI.pig`` -> ``UpdateBinningInfo`` MR -> ColumnConfig update,
+``MapReducerStatsWorker.java:104-176``) with two streamed device passes; see
+``ops/binning.py``.  Fills every ``ColumnStats``/``ColumnBinning`` field the
+reference writes: mean/std/min/max/median/p25/p75, missing counts, KS/IV/WOE
+(count + weighted), per-bin counts/pos-rates/woe, skewness/kurtosis, PSI.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..config import ColumnConfig
+from ..config.validator import ModelStep
+from ..data import DataSource
+from ..data.extract import ChunkExtractor
+from ..ops.binning import (CategoricalAccumulator, ColumnBinner,
+                           NumericAccumulator)
+from ..ops.correlation import CorrelationAccumulator
+from ..ops.stats_math import column_metrics, pos_rate, psi
+from .processor import BasicProcessor
+
+log = logging.getLogger(__name__)
+
+
+class StatsProcessor(BasicProcessor):
+    step = ModelStep.STATS
+
+    def process(self) -> int:
+        mc = self.model_config
+        extractor = ChunkExtractor(mc, self.column_configs)
+        num_cols = extractor.numeric_cols
+        cat_cols = extractor.categorical_cols
+        source = DataSource(self._abs(mc.dataSet.dataPath), mc.dataSet.dataDelimiter,
+                            header_path=self._abs(mc.dataSet.headerPath),
+                            header_delimiter=mc.dataSet.headerDelimiter)
+
+        num_acc = NumericAccumulator(n_cols=len(num_cols))
+        cat_acc = CategoricalAccumulator()
+        psi_col = mc.stats.psiColumnName if self.params.get("psi") or \
+            mc.stats.psiColumnName else None
+
+        # ---------------- pass 1: moments/min/max (numeric)
+        total_rows = 0
+        for chunk in source.iter_chunks():
+            ex = extractor.extract(chunk)
+            if ex.n == 0:
+                continue
+            total_rows += ex.n
+            if num_cols:
+                num_acc.update_moments(ex.numeric, ex.numeric_valid)
+        if total_rows == 0:
+            raise RuntimeError("stats: dataset is empty after filtering")
+        if num_cols:
+            num_acc.finalize_range()
+
+        # ---------------- pass 2: fine histograms + categorical + correlation
+        want_corr = bool(self.params.get("correlation"))
+        corr_acc = CorrelationAccumulator(mean=num_acc.moments["mean"]) \
+            if (want_corr and num_cols) else None
+        psi_units: Dict[str, Dict[str, np.ndarray]] = {}
+        for chunk in source.iter_chunks():
+            ex = extractor.extract(chunk, keep_raw=psi_col is not None)
+            if ex.n == 0:
+                continue
+            if num_cols:
+                num_acc.update_histogram(ex.numeric, ex.numeric_valid,
+                                         ex.target, ex.weight)
+                if corr_acc is not None:
+                    corr_acc.update(ex.numeric, ex.numeric_valid)
+            for cc in cat_cols:
+                vals = ex.categorical[cc.columnName]
+                import pandas as pd
+                s = pd.Series(vals, dtype=str).str.strip()
+                valid = (~s.str.lower().isin(
+                    {m.strip().lower() for m in extractor.missing_values})).to_numpy()
+                cat_acc.update(cc.columnName, vals, valid, ex.target, ex.weight)
+
+        # ---------------- finalize numeric columns
+        if num_cols:
+            self._finalize_numeric(num_cols, num_acc, total_rows)
+        self._finalize_categorical(cat_cols, cat_acc, total_rows)
+
+        if corr_acc is not None:
+            self._write_correlation(corr_acc, num_cols)
+        if psi_col:
+            self._compute_psi(source, extractor, psi_col)
+
+        self.save_column_configs()
+        log.info("stats: %d rows, %d numeric, %d categorical columns",
+                 total_rows, len(num_cols), len(cat_cols))
+        return 0
+
+    def _abs(self, p: Optional[str]) -> Optional[str]:
+        if p is None:
+            return None
+        return p if os.path.isabs(p) else os.path.normpath(os.path.join(self.dir, p))
+
+    # ------------------------------------------------------------- numeric
+    def _finalize_numeric(self, num_cols: List[ColumnConfig],
+                          acc: NumericAccumulator, total_rows: int) -> None:
+        mc = self.model_config
+        boundaries = acc.compute_boundaries(mc.stats.binningMethod, mc.stats.maxNumBin)
+        # skew/kurt directly from central moments (more stable than power sums)
+        cnt = np.maximum(acc.moments["count"], 1.0)
+        m2 = acc.moments["M2"] / cnt
+        m3 = acc.moments["M3"] / cnt
+        m4 = acc.moments["M4"] / cnt
+        with np.errstate(invalid="ignore", divide="ignore"):
+            skew = np.where(m2 > 0, m3 / np.power(np.maximum(m2, 1e-300), 1.5), 0.0)
+            kurt = np.where(m2 > 0, m4 / np.maximum(m2 ** 2, 1e-300) - 3.0, 0.0)
+            std = np.sqrt(acc.moments["M2"] / np.maximum(cnt - 1, 1.0))
+
+        for i, cc in enumerate(num_cols):
+            bnds = boundaries[i]
+            agg = acc.bin_counts(i, bnds)  # [bins+1, 4]
+            cpos, cneg, wpos, wneg = agg[:, 0], agg[:, 1], agg[:, 2], agg[:, 3]
+            cm = column_metrics(cneg[None, :], cpos[None, :])
+            wm = column_metrics(wneg[None, :], wpos[None, :])
+            st, bn = cc.columnStats, cc.columnBinning
+            count = float(acc.moments["count"][i])
+            st.totalCount = total_rows
+            st.validNumCount = int(count)
+            st.missingCount = int(acc.missing[i])
+            st.missingPercentage = float(acc.missing[i] / max(total_rows, 1))
+            st.min = _f(acc.moments["min"][i] if count else None)
+            st.max = _f(acc.moments["max"][i] if count else None)
+            st.mean = _f(acc.moments["mean"][i] if count else None)
+            st.stdDev = _f(std[i] if count > 1 else None)
+            st.skewness = _f(skew[i])
+            st.kurtosis = _f(kurt[i])
+            p = acc.percentile(i, [0.25, 0.5, 0.75])
+            st.p25th, st.median, st.p75th = _f(p[0]), _f(p[1]), _f(p[2])
+            st.distinctCount = acc.distinct_estimate(i)
+            st.ks = _f(cm.ks[0])
+            st.iv = _f(cm.iv[0])
+            st.woe = _f(cm.woe[0])
+            st.weightedKs = _f(wm.ks[0])
+            st.weightedIv = _f(wm.iv[0])
+            st.weightedWoe = _f(wm.woe[0])
+            bn.length = len(bnds) + 1
+            bn.binBoundary = [float(b) for b in bnds]
+            bn.binCategory = None
+            bn.binCountNeg = [int(x) for x in cneg]
+            bn.binCountPos = [int(x) for x in cpos]
+            bn.binWeightedNeg = [float(x) for x in wneg]
+            bn.binWeightedPos = [float(x) for x in wpos]
+            bn.binPosRate = _fl(pos_rate(cpos, cneg))
+            bn.binCountWoe = _fl(cm.bin_woe[0])
+            bn.binWeightedWoe = _fl(wm.bin_woe[0])
+
+    # --------------------------------------------------------- categorical
+    def _finalize_categorical(self, cat_cols: List[ColumnConfig],
+                              acc: CategoricalAccumulator, total_rows: int) -> None:
+        mc = self.model_config
+        max_cates = mc.stats.cateMaxNumBin or 0
+        for cc in cat_cols:
+            cats, counts = acc.finalize(cc.columnName, max_cates)
+            cpos, cneg, wpos, wneg = (counts[:, 0], counts[:, 1],
+                                      counts[:, 2], counts[:, 3])
+            cm = column_metrics(cneg[None, :], cpos[None, :])
+            wm = column_metrics(wneg[None, :], wpos[None, :])
+            st, bn = cc.columnStats, cc.columnBinning
+            valid_count = int((cpos[:-1] + cneg[:-1]).sum())
+            missing = int((cpos[-1] + cneg[-1]))
+            st.totalCount = total_rows
+            st.validNumCount = valid_count
+            st.missingCount = missing
+            st.missingPercentage = missing / max(total_rows, 1)
+            st.distinctCount = len(cats)
+            pr = pos_rate(cpos, cneg)
+            st.ks = _f(cm.ks[0])
+            st.iv = _f(cm.iv[0])
+            st.woe = _f(cm.woe[0])
+            st.weightedKs = _f(wm.ks[0])
+            st.weightedIv = _f(wm.iv[0])
+            st.weightedWoe = _f(wm.woe[0])
+            # categorical "mean/std": pos-rate weighted stats, as the reference
+            # reuses posRate as the numeric encoding of a category
+            tot = cpos + cneg
+            if tot.sum() > 0:
+                mean = float(np.nansum(pr * tot) / tot.sum())
+                st.mean = mean
+                st.stdDev = float(np.sqrt(
+                    np.nansum((np.nan_to_num(pr) - mean) ** 2 * tot) / max(tot.sum() - 1, 1)))
+            bn.length = len(cats) + 1
+            bn.binCategory = list(cats)
+            bn.binBoundary = None
+            bn.binCountNeg = [int(x) for x in cneg]
+            bn.binCountPos = [int(x) for x in cpos]
+            bn.binWeightedNeg = [float(x) for x in wneg]
+            bn.binWeightedPos = [float(x) for x in wpos]
+            bn.binPosRate = _fl(pr)
+            bn.binCountWoe = _fl(cm.bin_woe[0])
+            bn.binWeightedWoe = _fl(wm.bin_woe[0])
+
+    # -------------------------------------------------------------- extras
+    def _write_correlation(self, corr_acc: CorrelationAccumulator,
+                           num_cols: List[ColumnConfig]) -> None:
+        corr = corr_acc.finalize()
+        path = self.paths.correlation_path
+        names = [c.columnName for c in num_cols]
+        with open(path, "w") as f:
+            f.write("," + ",".join(names) + "\n")
+            for i, n in enumerate(names):
+                f.write(n + "," + ",".join(f"{corr[i, j]:.6f}" for j in range(len(names)))
+                        + "\n")
+        log.info("correlation matrix -> %s", path)
+
+    def _compute_psi(self, source: DataSource, extractor: ChunkExtractor,
+                     psi_col: str) -> None:
+        """PSI across units of ``psiColumnName`` (e.g. a time bucket):
+        per-unit bin distributions vs the overall distribution."""
+        binners = {}
+        for cc in self.column_configs:
+            if not cc.is_candidate() or cc.num_bins() == 0:
+                continue
+            if cc.is_categorical():
+                binners[cc.columnName] = (cc, ColumnBinner(categories=cc.bin_category))
+            else:
+                binners[cc.columnName] = (cc, ColumnBinner(
+                    boundaries=np.asarray(cc.bin_boundary)))
+        unit_hists: Dict[str, Dict[str, np.ndarray]] = {}
+        for chunk in source.iter_chunks():
+            df = chunk.data
+            if psi_col not in df.columns:
+                log.warning("psi column %s not found; skipping PSI", psi_col)
+                return
+            ex = extractor.extract(chunk, keep_raw=True)
+            if ex.n == 0:
+                continue
+            units = ex.raw.data[psi_col].to_numpy()
+            num_index = {c.columnName: i for i, c in enumerate(ex.numeric_cols)}
+            for name, (cc, binner) in binners.items():
+                if cc.is_categorical():
+                    idx = binner.bin_categorical(ex.categorical[name])
+                else:
+                    j = num_index[name]
+                    idx = binner.bin_numeric(ex.numeric[:, j], ex.numeric_valid[:, j])
+                nb = binner.num_bins + 1
+                for u in np.unique(units):
+                    h = np.bincount(idx[units == u], minlength=nb).astype(np.float64)
+                    unit_hists.setdefault(name, {})
+                    prev = unit_hists[name].get(u)
+                    unit_hists[name][u] = h if prev is None else prev + h
+        for cc in self.column_configs:
+            hists = unit_hists.get(cc.columnName)
+            if not hists:
+                continue
+            overall = np.sum(list(hists.values()), axis=0)
+            vals = [psi(overall, h) for h in hists.values()]
+            cc.columnStats.psi = _f(np.nanmax(vals)) if vals else None
+            cc.columnStats.unitStats = [f"{u}:{psi(overall, h):.6f}"
+                                        for u, h in sorted(hists.items())]
+
+
+def _f(x) -> Optional[float]:
+    if x is None:
+        return None
+    x = float(x)
+    return None if math.isnan(x) or math.isinf(x) else x
+
+
+def _fl(arr) -> List[Optional[float]]:
+    return [(_f(x) if x == x else None) for x in np.asarray(arr, dtype=np.float64)]

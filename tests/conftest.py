@@ -6,11 +6,27 @@ here every distributed code path runs for real on a virtual multi-device mesh.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU regardless of inherited JAX_PLATFORMS (e.g. a live TPU tunnel):
+# unit tests must run on the virtual 8-device host mesh, deterministically.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+# If a TPU-tunnel PJRT plugin (e.g. "axon") was registered by a sitecustomize
+# hook before this conftest ran, deregister it: otherwise the first jax op
+# dials the tunnel and can block for minutes even under JAX_PLATFORMS=cpu.
+try:
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    for _name in [n for n in list(getattr(_xb, "_backend_factories", {}))
+                  if n not in ("cpu",)]:
+        _xb._backend_factories.pop(_name, None)
+    jax.config.update("jax_platforms", "cpu")  # sitecustomize may have set "axon"
+except Exception:
+    pass
 
 import numpy as np
 import pytest

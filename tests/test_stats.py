@@ -1,0 +1,149 @@
+"""Stats/binning engine tests: math parity + end-to-end stats step."""
+
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.config import load_column_configs
+from shifu_tpu.ops.binning import (CategoricalAccumulator, ColumnBinner,
+                                   NumericAccumulator)
+from shifu_tpu.ops.stats_math import column_metrics, pos_rate, psi
+from shifu_tpu.config.model_config import BinningMethod
+from shifu_tpu.pipeline.create import InitProcessor
+from shifu_tpu.pipeline.stats import StatsProcessor
+
+
+# ------------------------------------------------------------ pure math
+def test_column_metrics_reference_formulas():
+    """Hand-checked against ColumnStatsCalculator.java (long[] variant)."""
+    neg = np.array([[80.0, 20.0, 0.0]])
+    pos = np.array([[10.0, 30.0, 0.0]])
+    m = column_metrics(neg, pos)
+    p = pos[0] / 40.0
+    n = neg[0] / 100.0
+    eps = 1e-10
+    exp_woe_bins = np.log((n + eps) / (p + eps))
+    assert np.allclose(m.bin_woe[0], exp_woe_bins)
+    assert np.isclose(m.iv[0], ((n - p) * exp_woe_bins).sum())
+    assert np.isclose(m.woe[0], np.log((100 + eps) / (40 + eps)))
+    cump, cumn = np.cumsum(p), np.cumsum(n)
+    assert np.isclose(m.ks[0], 100 * np.abs(cump - cumn).max())
+
+
+def test_column_metrics_degenerate_returns_nan():
+    m = column_metrics(np.array([[5.0, 5.0]]), np.array([[0.0, 0.0]]))
+    assert np.isnan(m.ks[0]) and np.isnan(m.iv[0])
+
+
+def test_pos_rate_and_psi():
+    pr = pos_rate(np.array([1.0, 0.0]), np.array([3.0, 0.0]))
+    assert pr[0] == 0.25 and np.isnan(pr[1])
+    assert psi(np.array([50, 50.0]), np.array([50, 50.0])) < 1e-12
+    assert psi(np.array([90, 10.0]), np.array([10, 90.0])) > 1.0
+
+
+# ------------------------------------------------------- streaming sketch
+def test_numeric_accumulator_quantile_binning(rng):
+    x = rng.normal(10, 3, size=(20000, 1))
+    valid = np.ones_like(x, dtype=bool)
+    y = (rng.random(20000) < 0.3).astype(float)
+    w = np.ones(20000)
+    acc = NumericAccumulator(n_cols=1)
+    for s in range(0, 20000, 5000):  # streamed in 4 chunks
+        acc.update_moments(x[s:s + 5000], valid[s:s + 5000])
+    acc.finalize_range()
+    for s in range(0, 20000, 5000):
+        acc.update_histogram(x[s:s + 5000], valid[s:s + 5000], y[s:s + 5000],
+                             w[s:s + 5000])
+    assert np.isclose(acc.moments["mean"][0], x.mean(), atol=0.01)
+    assert np.isclose(np.sqrt(acc.moments["M2"][0] / (20000 - 1)), x.std(ddof=1),
+                      atol=0.01)
+    bnds = acc.compute_boundaries(BinningMethod.EqualTotal, 10)[0]
+    assert bnds[0] == float("-inf") and len(bnds) == 10
+    # roughly equal population per bin
+    counts = acc.bin_counts(0, bnds)
+    tot = counts[:-1, 0] + counts[:-1, 1]
+    assert tot.sum() == 20000
+    assert tot.min() > 0.6 * 2000 and tot.max() < 1.6 * 2000
+    # quantiles close to true
+    q = acc.percentile(0, [0.5])
+    assert abs(q[0] - np.median(x)) < 0.05
+
+
+def test_equal_positive_binning_balances_positives(rng):
+    n = 30000
+    x = rng.exponential(5, size=(n, 1))
+    y = (rng.random(n) < np.clip(x[:, 0] / 20, 0, 1)).astype(float)
+    acc = NumericAccumulator(n_cols=1)
+    acc.update_moments(x, np.ones_like(x, dtype=bool))
+    acc.finalize_range()
+    acc.update_histogram(x, np.ones_like(x, dtype=bool), y, np.ones(n))
+    bnds = acc.compute_boundaries(BinningMethod.EqualPositive, 8)[0]
+    counts = acc.bin_counts(0, bnds)
+    pos_per_bin = counts[:-1, 0]
+    assert pos_per_bin.sum() == y.sum()
+    assert pos_per_bin.std() / pos_per_bin.mean() < 0.35
+
+
+def test_missing_values_go_to_last_bin(rng):
+    x = rng.normal(size=(1000, 1))
+    valid = rng.random((1000, 1)) > 0.2
+    y = np.zeros(1000); y[:100] = 1
+    acc = NumericAccumulator(n_cols=1)
+    acc.update_moments(x, valid)
+    acc.finalize_range()
+    acc.update_histogram(x, valid, y, np.ones(1000))
+    bnds = acc.compute_boundaries(BinningMethod.EqualTotal, 5)[0]
+    counts = acc.bin_counts(0, bnds)
+    assert counts[-1].sum() > 0
+    assert counts[-1, 0] + counts[-1, 1] == (~valid).sum()
+
+
+def test_column_binner_semantics():
+    b = ColumnBinner(boundaries=np.array([float("-inf"), 1.0, 2.0]))
+    idx = b.bin_numeric(np.array([0.5, 1.0, 1.5, 5.0]), np.array([True, True, True, False]))
+    assert idx.tolist() == [0, 1, 1, 3]
+    cb = ColumnBinner(categories=["US", "GB"])
+    assert cb.bin_categorical(np.array(["US", "GB", "XX"])).tolist() == [0, 1, 2]
+
+
+def test_categorical_accumulator_counts():
+    acc = CategoricalAccumulator()
+    vals = np.array(["a", "b", "a", "", "c"])
+    valid = np.array([True, True, True, False, True])
+    y = np.array([1.0, 0.0, 1.0, 1.0, 0.0])
+    w = np.ones(5)
+    acc.update("col", vals, valid, y, w)
+    acc.update("col", vals, valid, y, w)  # streamed twice
+    cats, counts = acc.finalize("col")
+    assert cats[0] == "a"  # most frequent first
+    a = counts[cats.index("a")]
+    assert a[0] == 4 and a[1] == 0  # 2 pos x 2 updates
+    assert counts[-1][0] == 2  # missing row was positive, twice
+
+
+# ---------------------------------------------------------- end-to-end
+def test_stats_step_end_to_end(model_set):
+    InitProcessor(model_set).run()
+    proc = StatsProcessor(model_set, params={"correlation": True})
+    assert proc.run() == 0
+    ccs = load_column_configs(os.path.join(model_set, "ColumnConfig.json"))
+    by_name = {c.columnName: c for c in ccs}
+    amt = by_name["amount"]
+    assert amt.columnStats.mean is not None and amt.columnStats.mean > 0
+    assert amt.columnStats.missingCount > 0
+    assert amt.columnStats.ks is not None and amt.columnStats.ks > 5
+    assert amt.columnStats.iv is not None and amt.columnStats.iv > 0.01
+    assert amt.columnBinning.binBoundary[0] == float("-inf")
+    assert len(amt.columnBinning.binCountPos) == len(amt.columnBinning.binBoundary) + 1
+    country = by_name["country"]
+    assert set(country.columnBinning.binCategory) == {"US", "GB", "DE", "CN", "BR"}
+    assert country.columnStats.ks is not None
+    # weighted stats populated
+    assert amt.columnStats.weightedIv is not None
+    # target/meta/weight columns untouched by binning
+    assert by_name["tag"].columnBinning.binBoundary is None
+    assert os.path.isfile(os.path.join(model_set, "correlation.csv"))
+    # noise column should carry ~no signal
+    assert by_name["noise"].columnStats.iv < amt.columnStats.iv
