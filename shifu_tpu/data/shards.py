@@ -1,0 +1,39 @@
+"""Shard IO for the materialized norm/clean datasets."""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class Shards:
+    directory: str
+    schema: dict
+    files: List[str]
+
+    @classmethod
+    def open(cls, directory: str) -> "Shards":
+        with open(os.path.join(directory, "schema.json")) as f:
+            schema = json.load(f)
+        files = sorted(os.path.join(directory, f) for f in os.listdir(directory)
+                       if f.endswith(".npz"))
+        return cls(directory, schema, files)
+
+    def iter_shards(self) -> Iterator[Dict[str, np.ndarray]]:
+        for f in self.files:
+            yield dict(np.load(f))
+
+    def load_all(self) -> Dict[str, np.ndarray]:
+        parts = list(self.iter_shards())
+        if not parts:
+            raise FileNotFoundError(f"no shards in {self.directory}")
+        return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+
+    @property
+    def num_rows(self) -> int:
+        return sum(len(np.load(f)["y"]) for f in self.files)

@@ -1,0 +1,112 @@
+"""`norm` step: materialize normalized + binned training shards.
+
+Replaces reference ``NormalizeModelProcessor.java:48,67-95`` +
+``Normalize.pig`` + ``NormalizeUDF``: streams the training data through the
+DatasetTransformer and writes npz shards of (x float32, bins int32, target,
+weight) to ``tmp/NormalizedData`` / ``tmp/CleanedData``, plus a schema json.
+The optional ``-shuffle`` reshuffles rows across shards (reference
+``MapReduceShuffle``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..config.validator import ModelStep
+from ..data import DataSource, sample_mask
+from ..data.transform import DatasetTransformer
+from .processor import BasicProcessor
+
+log = logging.getLogger(__name__)
+
+SHARD_ROWS = 1 << 18
+
+
+class NormalizeProcessor(BasicProcessor):
+    step = ModelStep.NORMALIZE
+
+    def process(self) -> int:
+        mc = self.model_config
+        transformer = DatasetTransformer(mc, self.column_configs)
+        source = DataSource(self._abs(mc.dataSet.dataPath), mc.dataSet.dataDelimiter,
+                            header_path=self._abs(mc.dataSet.headerPath),
+                            header_delimiter=mc.dataSet.headerDelimiter)
+        norm_dir, clean_dir = self.paths.norm_dir, self.paths.clean_dir
+        for d in (norm_dir, clean_dir):
+            os.makedirs(d, exist_ok=True)
+            for f in os.listdir(d):
+                os.remove(os.path.join(d, f))
+
+        rate = mc.normalize.sampleRate
+        neg_only = mc.normalize.sampleNegOnly
+        shard, rows, seen = 0, 0, 0
+        bufx, bufb, bufy, bufw = [], [], [], []
+        for chunk in source.iter_chunks():
+            tc = transformer.transform(chunk)
+            if tc.n == 0:
+                continue
+            keep = sample_mask(tc.n, rate, seed=seen, neg_only=neg_only,
+                               targets=tc.target)
+            seen += tc.n
+            bufx.append(tc.x[keep]); bufb.append(tc.bins[keep])
+            bufy.append(tc.target[keep]); bufw.append(tc.weight[keep])
+            rows += int(keep.sum())
+            if rows >= SHARD_ROWS:
+                self._flush(norm_dir, clean_dir, shard, bufx, bufb, bufy, bufw)
+                shard += 1; rows = 0
+                bufx, bufb, bufy, bufw = [], [], [], []
+        if rows:
+            self._flush(norm_dir, clean_dir, shard, bufx, bufb, bufy, bufw)
+            shard += 1
+        if self.params.get("shuffle"):
+            self._shuffle(norm_dir)
+            self._shuffle(clean_dir)
+        schema = {
+            "outputNames": transformer.output_names,
+            "columnNums": [c.columnNum for c in transformer.columns],
+            "columnNames": [c.columnName for c in transformer.columns],
+            "normType": mc.normalize.normType.name,
+            "numShards": shard,
+        }
+        with open(os.path.join(norm_dir, "schema.json"), "w") as f:
+            json.dump(schema, f, indent=2)
+        with open(os.path.join(clean_dir, "schema.json"), "w") as f:
+            json.dump(schema, f, indent=2)
+        log.info("norm: %d shards, %d input cols -> %d features",
+                 shard, len(transformer.columns), transformer.width)
+        return 0
+
+    def _flush(self, norm_dir: str, clean_dir: str, shard: int,
+               bufx: List[np.ndarray], bufb, bufy, bufw) -> None:
+        x = np.concatenate(bufx); b = np.concatenate(bufb)
+        y = np.concatenate(bufy); w = np.concatenate(bufw)
+        np.savez(os.path.join(norm_dir, f"part-{shard:05d}.npz"),
+                 x=x, y=y, w=w)
+        np.savez(os.path.join(clean_dir, f"part-{shard:05d}.npz"),
+                 bins=b.astype(np.int16), y=y, w=w)
+
+    def _shuffle(self, d: str) -> None:
+        """Load all shards, permute rows globally, rewrite (reference
+        ``core/shuffle/MapReduceShuffle.java``)."""
+        files = sorted(f for f in os.listdir(d) if f.endswith(".npz"))
+        if not files:
+            return
+        datas = [dict(np.load(os.path.join(d, f))) for f in files]
+        keys = datas[0].keys()
+        merged = {k: np.concatenate([dd[k] for dd in datas]) for k in keys}
+        n = len(next(iter(merged.values())))
+        perm = np.random.default_rng(12345).permutation(n)
+        splits = np.array_split(np.arange(n), len(files))
+        for i, f in enumerate(files):
+            sel = perm[splits[i]]
+            np.savez(os.path.join(d, f), **{k: merged[k][sel] for k in keys})
+
+    def _abs(self, p: Optional[str]) -> Optional[str]:
+        if p is None:
+            return None
+        return p if os.path.isabs(p) else os.path.normpath(os.path.join(self.dir, p))

@@ -1,0 +1,150 @@
+"""Normalizer semantics + the norm pipeline step."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.config import ColumnConfig, ColumnType
+from shifu_tpu.config.model_config import NormType, PrecisionType
+from shifu_tpu.data.shards import Shards
+from shifu_tpu.ops.normalize import (NormalizedColumn, apply_precision,
+                                     woe_mean_std, z_score)
+from shifu_tpu.pipeline.create import InitProcessor
+from shifu_tpu.pipeline.norm import NormalizeProcessor
+from shifu_tpu.pipeline.stats import StatsProcessor
+
+
+def _numeric_cc() -> ColumnConfig:
+    cc = ColumnConfig(columnNum=1, columnName="x")
+    cc.columnStats.mean = 10.0
+    cc.columnStats.stdDev = 2.0
+    cc.columnStats.min = 4.0
+    cc.columnBinning.binBoundary = [float("-inf"), 8.0, 12.0]
+    cc.columnBinning.binCountPos = [5, 10, 5, 2]
+    cc.columnBinning.binCountNeg = [20, 10, 5, 1]
+    cc.columnBinning.binCountWoe = [0.5, -0.2, -0.9, -1.5]
+    cc.columnBinning.binWeightedWoe = [0.4, -0.1, -0.8, -1.2]
+    cc.columnBinning.binPosRate = [0.2, 0.5, 0.5, 2 / 3]
+    return cc
+
+
+def _cate_cc() -> ColumnConfig:
+    cc = ColumnConfig(columnNum=2, columnName="c", columnType=ColumnType.C)
+    cc.columnStats.mean = 0.3
+    cc.columnStats.stdDev = 0.1
+    cc.columnBinning.binCategory = ["US", "GB"]
+    cc.columnBinning.binPosRate = [0.25, 0.5, 0.1]
+    cc.columnBinning.binCountWoe = [0.7, -0.3, 0.05]
+    cc.columnBinning.binWeightedWoe = [0.6, -0.2, 0.04]
+    cc.columnBinning.binCountPos = [10, 20, 1]
+    cc.columnBinning.binCountNeg = [30, 20, 9]
+    return cc
+
+
+def test_zscore_clips_at_cutoff():
+    v = np.array([10.0, 20.0, -20.0, 11.0])
+    z = z_score(v, 10.0, 2.0, 4.0)
+    assert z.tolist() == [0.0, 4.0, -4.0, 0.5]
+    assert z_score(v, 10.0, 0.0, 4.0).tolist() == [0, 0, 0, 0]
+
+
+def test_numeric_zscale_missing_is_zero():
+    nc = NormalizedColumn(_numeric_cc(), NormType.ZSCALE, 4.0)
+    vals = np.array([12.0, np.nan])
+    valid = np.array([True, False])
+    bidx = np.array([2, 3])
+    out = nc.transform(vals, valid, bidx)
+    assert out.shape == (2, 1)
+    assert out[0, 0] == 1.0   # (12-10)/2
+    assert out[1, 0] == 0.0   # missing -> mean -> z=0
+
+
+def test_numeric_woe_lookup_and_missing_bin():
+    nc = NormalizedColumn(_numeric_cc(), NormType.WOE, 4.0)
+    out = nc.transform(np.array([5.0, 9.0, np.nan]),
+                       np.array([True, True, False]),
+                       np.array([0, 1, 3]))
+    assert out[:, 0].tolist() == [0.5, -0.2, -1.5]
+
+
+def test_weight_woe_uses_weighted_table():
+    nc = NormalizedColumn(_numeric_cc(), NormType.WEIGHT_WOE, 4.0)
+    out = nc.transform(np.array([5.0]), np.array([True]), np.array([0]))
+    assert out[0, 0] == 0.4
+
+
+def test_woe_zscore_standardizes_woe():
+    cc = _numeric_cc()
+    nc = NormalizedColumn(cc, NormType.WOE_ZSCALE, 4.0)
+    wmean, wstd = woe_mean_std(cc, False)
+    out = nc.transform(np.array([5.0]), np.array([True]), np.array([0]))
+    assert np.isclose(out[0, 0], (0.5 - wmean) / wstd)
+
+
+def test_categorical_zscale_posrate():
+    nc = NormalizedColumn(_cate_cc(), NormType.ZSCALE, 4.0)
+    out = nc.transform(np.zeros(3), np.zeros(3, bool), np.array([0, 1, 2]))
+    # posrate z-scored with mean=.3 std=.1
+    assert np.allclose(out[:, 0], [(0.25 - .3) / .1, (0.5 - .3) / .1, (0.1 - .3) / .1])
+
+
+def test_categorical_index_norm():
+    nc = NormalizedColumn(_cate_cc(), NormType.ZSCALE_INDEX, 4.0)
+    out = nc.transform(np.zeros(3), np.zeros(3, bool), np.array([0, 1, 2]))
+    assert out[:, 0].tolist() == [0.0, 1.0, 2.0]  # missing -> last index
+
+
+def test_onehot_includes_missing_bin():
+    nc = NormalizedColumn(_cate_cc(), NormType.ONEHOT, 4.0)
+    out = nc.transform(np.zeros(2), np.zeros(2, bool), np.array([1, 2]))
+    assert out.shape == (2, 3)
+    assert out[0].tolist() == [0, 1, 0]
+    assert out[1].tolist() == [0, 0, 1]
+    assert nc.output_names() == ["c_0", "c_1", "c_2"]
+
+
+def test_discrete_zscore_uses_bin_left_boundary():
+    cc = _numeric_cc()
+    nc = NormalizedColumn(cc, NormType.DISCRETE_ZSCALE, 4.0)
+    out = nc.transform(np.array([5.0, 9.0]), np.array([True, True]),
+                       np.array([0, 1]))
+    # bin0 -> min (4.0) -> z=-3 ; bin1 -> boundary 8.0 -> z=-1
+    assert np.allclose(out[:, 0], [-3.0, -1.0])
+
+
+def test_hybrid_numeric_zscore_categorical_woe():
+    n = NormalizedColumn(_numeric_cc(), NormType.HYBRID, 4.0)
+    c = NormalizedColumn(_cate_cc(), NormType.HYBRID, 4.0)
+    out_n = n.transform(np.array([12.0]), np.array([True]), np.array([2]))
+    out_c = c.transform(np.zeros(1), np.zeros(1, bool), np.array([0]))
+    assert out_n[0, 0] == 1.0
+    assert out_c[0, 0] == 0.7
+
+
+def test_apply_precision():
+    x = np.array([0.123456789])
+    assert apply_precision(x, PrecisionType.FLOAT7)[0] == 0.1234568
+    assert abs(apply_precision(x, PrecisionType.FLOAT16)[0] - 0.1235) < 1e-3
+    assert apply_precision(x, PrecisionType.DOUBLE64)[0] == 0.123456789
+
+
+def test_norm_step_end_to_end(model_set):
+    InitProcessor(model_set).run()
+    StatsProcessor(model_set).run()
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    norm = Shards.open(os.path.join(model_set, "tmp", "NormalizedData"))
+    clean = Shards.open(os.path.join(model_set, "tmp", "CleanedData"))
+    data = norm.load_all()
+    bins = clean.load_all()
+    n = len(data["y"])
+    assert n > 3500  # rows with unknown tags dropped only
+    assert data["x"].shape[0] == n and data["x"].dtype == np.float32
+    assert set(np.unique(data["y"])) == {0.0, 1.0}
+    assert (data["w"] > 0).all()
+    assert bins["bins"].dtype == np.int16
+    assert bins["bins"].min() >= 0
+    # zscaled features should be roughly centered
+    assert abs(np.nanmean(data["x"])) < 1.0
+    assert norm.schema["outputNames"] == clean.schema["outputNames"]
