@@ -1,0 +1,222 @@
+"""NN model: jitted MLP forward/backprop — the Encog flat-network replacement.
+
+Covers the reference's NN stack (``core/dtrain/nn/``): custom activations
+(``nn/Activation*.java`` — leakyrelu/ptanh/relu/swish plus Encog
+sigmoid/tanh/linear), losses (``nn/*ErrorCalculation.java`` — log / squared /
+absolute), weight init randomizers (Xavier/He/Lecun,
+``core/dtrain/random/``), dropout (``BasicDropoutLayer``), and the standalone
+scorer role of ``IndependentNNModel.java`` (a saved spec scores with no
+trainer dependencies).
+
+Params are a list-of-layers pytree ``[{"w": [in,out], "b": [out]}, ...]`` —
+matmul-shaped for the MXU; batched rows hit one fused kernel per layer.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+SPEC_VERSION = 1
+
+# ----------------------------------------------------------- activations
+ACTIVATIONS: Dict[str, Callable] = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "leakyrelu": lambda x: jnp.where(x >= 0, x, 0.01 * x),
+    "ptanh": lambda x: jnp.where(x >= 0, jnp.tanh(x), 0.25 * jnp.tanh(x)),
+    "swish": lambda x: x * jax.nn.sigmoid(x),
+    "linear": lambda x: x,
+    "log": lambda x: jnp.where(x >= 0, jnp.log1p(x), -jnp.log1p(-x)),
+    "sin": jnp.sin,
+}
+
+
+def activation(name: str) -> Callable:
+    key = (name or "sigmoid").lower()
+    if key not in ACTIVATIONS:
+        raise ValueError(f"unknown activation {name!r}; one of {sorted(ACTIVATIONS)}")
+    return ACTIVATIONS[key]
+
+
+@dataclass
+class NNModelSpec:
+    """Network shape + metadata; serialized alongside weights so the saved
+    model scores standalone (reference ``IndependentNNModel.java``)."""
+    input_dim: int
+    hidden_nodes: List[int]
+    activations: List[str]
+    output_dim: int = 1
+    output_activation: str = "sigmoid"
+    loss: str = "squared"           # reference default squared error
+    column_nums: Optional[List[int]] = None
+    feature_names: Optional[List[str]] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def layer_dims(self) -> List[Tuple[int, int]]:
+        dims = [self.input_dim] + list(self.hidden_nodes) + [self.output_dim]
+        return list(zip(dims[:-1], dims[1:]))
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": SPEC_VERSION, "kind": "nn",
+            "input_dim": self.input_dim, "hidden_nodes": self.hidden_nodes,
+            "activations": self.activations, "output_dim": self.output_dim,
+            "output_activation": self.output_activation, "loss": self.loss,
+            "column_nums": self.column_nums, "feature_names": self.feature_names,
+            "extra": self.extra})
+
+    @classmethod
+    def from_json(cls, s: str) -> "NNModelSpec":
+        d = json.loads(s)
+        return cls(input_dim=d["input_dim"], hidden_nodes=d["hidden_nodes"],
+                   activations=d["activations"], output_dim=d.get("output_dim", 1),
+                   output_activation=d.get("output_activation", "sigmoid"),
+                   loss=d.get("loss", "squared"),
+                   column_nums=d.get("column_nums"),
+                   feature_names=d.get("feature_names"),
+                   extra=d.get("extra", {}))
+
+
+# ------------------------------------------------------------------- init
+def init_params(key, spec: NNModelSpec, initializer: str = "xavier") -> List[Dict]:
+    """Weight init per reference randomizers (``core/dtrain/random/``:
+    Xavier/He/Lecun; default Xavier)."""
+    init = (initializer or "xavier").lower()
+    params = []
+    for fan_in, fan_out in spec.layer_dims():
+        key, sub = jax.random.split(key)
+        if init in ("he", "herandomizer"):
+            scale = np.sqrt(2.0 / fan_in)
+            w = jax.random.normal(sub, (fan_in, fan_out)) * scale
+        elif init in ("lecun", "lecunrandomizer"):
+            scale = np.sqrt(1.0 / fan_in)
+            w = jax.random.normal(sub, (fan_in, fan_out)) * scale
+        else:  # xavier uniform
+            limit = np.sqrt(6.0 / (fan_in + fan_out))
+            w = jax.random.uniform(sub, (fan_in, fan_out), minval=-limit, maxval=limit)
+        params.append({"w": w.astype(jnp.float32),
+                       "b": jnp.zeros((fan_out,), jnp.float32)})
+    return params
+
+
+# ---------------------------------------------------------------- forward
+def forward(params: List[Dict], spec: NNModelSpec, x, *,
+            dropout_rate: float = 0.0, rng=None):
+    """MLP forward.  Hidden dropout (inverted scaling) only when a key is
+    given — eval path stays deterministic."""
+    acts = [activation(a) for a in spec.activations]
+    h = x
+    n_hidden = len(params) - 1
+    for i, layer in enumerate(params[:-1]):
+        h = acts[i % max(1, len(acts))](h @ layer["w"] + layer["b"])
+        if dropout_rate > 0.0 and rng is not None:
+            rng, sub = jax.random.split(rng)
+            keep = jax.random.bernoulli(sub, 1.0 - dropout_rate, h.shape)
+            h = jnp.where(keep, h / (1.0 - dropout_rate), 0.0)
+    out = h @ params[-1]["w"] + params[-1]["b"]
+    return activation(spec.output_activation)(out)
+
+
+LOSSES = {
+    "squared": lambda p, y: (p - y) ** 2,
+    "absolute": lambda p, y: jnp.abs(p - y),
+    "log": lambda p, y: -(y * jnp.log(jnp.clip(p, 1e-7, 1.0))
+                          + (1 - y) * jnp.log(jnp.clip(1 - p, 1e-7, 1.0))),
+}
+
+
+def weighted_loss(params, spec: NNModelSpec, x, y, w, *,
+                  l2: float = 0.0, l1: float = 0.0,
+                  dropout_rate: float = 0.0, rng=None):
+    """Per-batch mean weighted loss + L1/L2 (reference ``Weight.java:201-213``
+    applies reg in the update; applying it in the loss is equivalent under
+    gradient descent and lets XLA fuse it)."""
+    pred = forward(params, spec, x, dropout_rate=dropout_rate, rng=rng)
+    lfn = LOSSES.get(spec.loss, LOSSES["squared"])
+    per_row = lfn(pred, y).sum(axis=-1)
+    denom = jnp.maximum(w.sum(), 1e-9)
+    loss = (per_row * w).sum() / denom
+    if l2:
+        loss = loss + l2 * sum((layer["w"] ** 2).sum() for layer in params)
+    if l1:
+        loss = loss + l1 * sum(jnp.abs(layer["w"]).sum() for layer in params)
+    return loss
+
+
+# --------------------------------------------------------------- training
+def make_train_step(spec: NNModelSpec, params, optimizer: str = "adam",
+                    learning_rate: float = 0.1, l2: float = 0.0, l1: float = 0.0,
+                    dropout_rate: float = 0.0, **opt_kwargs):
+    """Single-model jitted train step: ``(params, opt_state, x, y, w[, rng])
+    -> (params, opt_state, loss)``.  Gradient aggregation across a sharded
+    batch is XLA's psum — the NNMaster accumulate step
+    (``NNMaster.java:240-249``) with no master."""
+    from ..train.optimizers import make_optimizer
+
+    opt = make_optimizer(optimizer, learning_rate, **opt_kwargs)
+    opt_state = opt.init(params)
+
+    def step(params, opt_state, x, y, w, rng=None):
+        loss, grads = jax.value_and_grad(weighted_loss)(
+            params, spec, x, y, w, l2=l2, l1=l1,
+            dropout_rate=dropout_rate, rng=rng)
+        delta, opt_state = opt.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, d: p + d, params, delta)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1)), opt_state
+
+
+# ------------------------------------------------------------- save/load
+def save_model(path: str, spec: NNModelSpec, params) -> None:
+    """Self-contained .nn file: npz of weight arrays + the spec json.
+
+    Role of ``BinaryNNSerializer.java`` / ``PersistBasicFloatNetwork``; format
+    is ours (npz), not Encog's."""
+    arrays = {}
+    for i, layer in enumerate(params):
+        arrays[f"w{i}"] = np.asarray(layer["w"], np.float32)
+        arrays[f"b{i}"] = np.asarray(layer["b"], np.float32)
+    arrays["__spec__"] = np.frombuffer(spec.to_json().encode(), dtype=np.uint8)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+
+def load_model(path: str) -> Tuple[NNModelSpec, List[Dict]]:
+    data = np.load(path)
+    spec = NNModelSpec.from_json(bytes(data["__spec__"]).decode())
+    params = []
+    for i in range(len(spec.layer_dims())):
+        params.append({"w": jnp.asarray(data[f"w{i}"]),
+                       "b": jnp.asarray(data[f"b{i}"])})
+    return spec, params
+
+
+class IndependentNNModel:
+    """Dependency-light scorer over a saved spec (reference
+    ``IndependentNNModel.java``: load once, ``compute()`` per batch)."""
+
+    def __init__(self, spec: NNModelSpec, params):
+        self.spec = spec
+        self.params = params
+        self._fwd = jax.jit(lambda p, x: forward(p, spec, x))
+
+    @classmethod
+    def load(cls, path: str) -> "IndependentNNModel":
+        return cls(*load_model(path))
+
+    def compute(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self._fwd(self.params, jnp.asarray(x, jnp.float32)))

@@ -1,0 +1,3 @@
+"""Mesh/sharding substrate (see mesh.py)."""
+
+from .mesh import device_mesh  # noqa: F401

@@ -1,0 +1,48 @@
+"""Device mesh substrate — the Guagua-BSP replacement.
+
+The reference's distributed backbone is a Guagua master/worker BSP loop on
+YARN (workers compute local gradients/histograms, master sums and broadcasts;
+``NNMaster.java:240-286``, ``TrainModelProcessor.java:661-1029``).  Here that
+whole stack collapses into SPMD under ``jax.jit`` over a ``Mesh``:
+
+- the ``data`` axis shards rows (the worker shards); gradient aggregation is
+  the ``psum`` XLA inserts for replicated-param grads — the master's
+  accumulate step, but on ICI instead of ZooKeeper/Netty;
+- the ``ensemble`` axis shards bagging/grid-search members (the reference's
+  N parallel YARN jobs, ``TrainModelProcessor.java:684-945``) — members train
+  simultaneously as one vmapped program, sharded across devices.
+
+Quorum/straggler logic (97% + 2s timeout) has no analogue: the mesh is
+synchronous.  Fail-over maps to checkpoint/restore instead.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def device_mesh(n_ensemble: int = 1,
+                devices: Optional[Sequence] = None) -> "jax.sharding.Mesh":
+    """Build a 2D ``(ensemble, data)`` mesh over the available devices.
+
+    The ensemble axis gets ``gcd(n_devices, n_ensemble)`` devices (never more
+    than there are members to train); the rest go to data parallelism.  With
+    one ensemble member this degenerates to a pure data-parallel layout.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    e = math.gcd(n, max(1, n_ensemble))
+    grid = np.asarray(devs).reshape(e, n // e)
+    return Mesh(grid, ("ensemble", "data"))
+
+
+def pad_rows(n: int, multiple: int) -> int:
+    """Rows to add so n divides the data-axis extent."""
+    r = n % multiple
+    return 0 if r == 0 else multiple - r

@@ -1,0 +1,223 @@
+"""`train` step — reference ``TrainModelProcessor.java:105`` re-imagined.
+
+Loads the materialized norm (NN/LR/WDL) or cleaned-binned (GBT/RF) shards,
+expands grid-search trials, builds bagging/k-fold row-weight matrices, and
+runs the vmapped SPMD ensemble trainer.  The reference's N-YARN-job fan-out
+(``runDistributedTrain``, ``:661-1029``) becomes ensemble members on the mesh;
+progress lines replace the HDFS progress file + TailThread (``:1862``);
+per-N-epoch tmp models land in ``models/tmp`` like ``NNOutput.postIteration``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..config.model_config import Algorithm
+from ..config.validator import ModelStep
+from ..data.shards import Shards
+from ..models import nn as nn_model
+from ..train import grid_search
+from ..train.nn_trainer import TrainSettings, train_ensemble
+from ..train.sampling import member_masks
+from .processor import BasicProcessor
+
+log = logging.getLogger(__name__)
+
+
+def settings_from_params(params: Dict[str, Any], train_conf,
+                         defaults: Optional[Dict[str, Any]] = None) -> TrainSettings:
+    """Map reference ``train#params`` keys (``GridSearch``-compatible names:
+    Propagation/LearningRate/RegularizedConstant/DropoutRate/...) onto
+    TrainSettings."""
+    p = dict(defaults or {})
+    p.update(params or {})
+    return TrainSettings(
+        optimizer=str(p.get("Propagation", p.get("Optimizer", "R"))),
+        learning_rate=float(p.get("LearningRate", 0.1)),
+        learning_decay=float(p.get("LearningDecay", 0.0)),
+        l2=float(p.get("RegularizedConstant", p.get("L2Const", 0.0))),
+        l1=float(p.get("L1Const", 0.0)),
+        dropout_rate=float(p.get("DropoutRate", 0.0)),
+        epochs=int(train_conf.numTrainEpochs),
+        batch_size=int(p.get("MiniBatchs", 0) or 0),
+        early_stop_window=int(p.get("WindowSize", 10)
+                              if train_conf.earlyStopEnable else 0),
+        weight_initializer=str(p.get("WeightInitializer", "xavier")),
+        seed=int(p.get("Seed", 0)),
+        tmp_model_every=int(p.get("TmpModelEpochs", 0) or 0),
+    )
+
+
+def nn_spec_from_params(input_dim: int, params: Dict[str, Any],
+                        column_nums: List[int],
+                        feature_names: List[str]) -> nn_model.NNModelSpec:
+    """Reference NN shape keys: NumHiddenLayers / NumHiddenNodes /
+    ActivationFunc (``NNMaster``/``DTrainUtils`` param names)."""
+    nodes = params.get("NumHiddenNodes", [50])
+    acts = params.get("ActivationFunc", ["tanh"] * len(nodes))
+    n_layers = int(params.get("NumHiddenLayers", len(nodes)))
+    nodes = [int(v) for v in nodes][:n_layers] or [50]
+    acts = [str(a).lower() for a in acts][:n_layers] or ["tanh"]
+    while len(acts) < len(nodes):
+        acts.append(acts[-1])
+    return nn_model.NNModelSpec(
+        input_dim=input_dim, hidden_nodes=nodes, activations=acts,
+        output_dim=1, output_activation="sigmoid",
+        loss=str(params.get("Loss", "squared")).lower(),
+        column_nums=column_nums, feature_names=feature_names)
+
+
+def lr_spec(input_dim: int, params: Dict[str, Any], column_nums: List[int],
+            feature_names: List[str]) -> nn_model.NNModelSpec:
+    """LR as the degenerate 0-hidden-layer net: one sigmoid(xW+b) matmul —
+    exactly ``LogisticRegressionWorker.java:302-346``'s model."""
+    return nn_model.NNModelSpec(
+        input_dim=input_dim, hidden_nodes=[], activations=[],
+        output_dim=1, output_activation="sigmoid", loss="log",
+        column_nums=column_nums, feature_names=feature_names,
+        extra={"algorithm": "LR"})
+
+
+class TrainProcessor(BasicProcessor):
+    step = ModelStep.TRAIN
+
+    def process(self) -> int:
+        mc = self.model_config
+        alg = mc.train.algorithm
+        if self.params.get("dry"):
+            log.info("dry run: algorithm=%s bags=%d epochs=%d", alg.name,
+                     mc.train.baggingNum, mc.train.numTrainEpochs)
+            return 0
+        if alg in (Algorithm.NN, Algorithm.LR, Algorithm.SVM):
+            return self._train_nn_family(alg)
+        if alg in (Algorithm.GBT, Algorithm.RF, Algorithm.DT):
+            from ..train.dt_trainer import run_tree_training
+            return run_tree_training(self)
+        if alg == Algorithm.WDL:
+            from ..train.wdl_trainer import run_wdl_training
+            return run_wdl_training(self)
+        raise ValueError(f"unsupported algorithm {alg}")
+
+    # ------------------------------------------------------------ NN / LR
+    def _train_nn_family(self, alg: Algorithm) -> int:
+        mc = self.model_config
+        data = Shards.open(self.paths.norm_dir).load_all()
+        x, y, w = data["x"], data["y"], data["w"]
+        schema = Shards.open(self.paths.norm_dir).schema
+        column_nums = schema.get("columnNums", [])
+        feature_names = schema.get("outputNames", [])
+        n, d = x.shape
+        log.info("train %s: %d rows x %d features", alg.name, n, d)
+
+        params = dict(mc.train.params or {})
+        trials = grid_search.expand(params) if grid_search.is_grid_search(params) \
+            else [params]
+        is_gs = len(trials) > 1
+        kfold = mc.train.numKFold if mc.train.isCrossValidation else -1
+        bags = 1 if is_gs else max(1, mc.train.baggingNum)
+
+        os.makedirs(self.paths.tmp_models_dir, exist_ok=True)
+        progress_path = self.paths.progress_path
+        t0 = time.time()
+
+        results = []
+        with open(progress_path, "w") as pf:
+            for group in grid_search.group_by_shape(trials):
+                # one run per grid trial (settings differ inside a shape
+                # group); non-grid mode = one run with all bagging members
+                runs = [[m] for m in group] if is_gs else [list(range(bags))]
+                for run in runs:
+                    run_params = trials[run[0]] if is_gs else dict(params)
+                    if alg in (Algorithm.LR, Algorithm.SVM):
+                        spec = lr_spec(d, run_params, column_nums, feature_names)
+                    else:
+                        spec = nn_spec_from_params(d, run_params, column_nums,
+                                                   feature_names)
+                    settings = settings_from_params(run_params, mc.train)
+                    run_kfold = kfold if not is_gs else -1
+                    train_w, valid_w = member_masks(
+                        n, len(run) if is_gs else bags,
+                        valid_rate=mc.train.validSetRate,
+                        kfold=run_kfold,
+                        sample_rate=mc.train.baggingSampleRate,
+                        replacement=mc.train.baggingWithReplacement,
+                        stratified=mc.train.stratifiedSample,
+                        up_sample_weight=mc.train.upSampleWeight,
+                        targets=y, seed=settings.seed)
+                    n_members = train_w.shape[0]  # kfold mode yields numKFold
+                    train_w = train_w * w[None, :]
+                    valid_w = valid_w * w[None, :]
+                    init_list = self._continuous_init(spec, n_members, alg)
+
+                    def progress(epoch, tr, va, _pf=pf, _run=run):
+                        line = (f"Trial {_run} Epoch #{epoch + 1} "
+                                f"Train Error: {tr:.6f} Validation Error: {va:.6f}")
+                        _pf.write(line + "\n")
+                        _pf.flush()
+                        log.info(line)
+
+                    def checkpoint(epoch, params_list, _spec=spec, _alg=alg):
+                        for i, p in enumerate(params_list):
+                            path = self.paths.tmp_model_path(
+                                i, epoch + 1, _alg.name.lower())
+                            nn_model.save_model(path, _spec, p)
+
+                    res = train_ensemble(x, y, train_w, valid_w, spec, settings,
+                                         init_params_list=init_list,
+                                         progress=progress, checkpoint=checkpoint)
+                    results.append((run, spec, res, run_params))
+
+        self._write_models(results, alg, is_gs)
+        log.info("train done in %.1fs", time.time() - t0)
+        return 0
+
+    def _continuous_init(self, spec, n_members: int, alg: Algorithm):
+        """Continuous training: warm-start members from existing final models
+        (reference ``NNMaster.java:331-362``; structure fit-in not yet)."""
+        if not self.model_config.train.isContinuous:
+            return None
+        init = []
+        for i in range(n_members):
+            path = self.paths.model_path(i, alg.name.lower())
+            if not os.path.isfile(path):
+                return None
+            old_spec, params = nn_model.load_model(path)
+            if old_spec.layer_dims() != spec.layer_dims():
+                log.warning("continuous: model%d shape changed, fresh init", i)
+                return None
+            init.append(params)
+        log.info("continuous training: warm-started %d members", n_members)
+        return init
+
+    def _write_models(self, results, alg: Algorithm, is_gs: bool) -> None:
+        ext = alg.name.lower() if alg != Algorithm.SVM else "lr"
+        os.makedirs(self.paths.models_dir, exist_ok=True)
+        if is_gs:
+            # grid search: pick the best trial by validation error
+            # (reference re-trains the winner; our members ARE full runs)
+            flat = []
+            for run, spec, res, run_params in results:
+                for j, trial_idx in enumerate(run):
+                    flat.append((res.valid_errors[j], trial_idx, spec,
+                                 res.params[j], run_params))
+            flat.sort(key=lambda t: t[0])
+            best = flat[0]
+            log.info("grid search: best trial #%d valid error %.6f params %s",
+                     best[1], best[0], best[4])
+            nn_model.save_model(self.paths.model_path(0, ext), best[2], best[3])
+            report = [{"trial": t[1], "validError": float(t[0]),
+                       "params": {k: v for k, v in t[4].items()}} for t in flat]
+            with open(os.path.join(self.paths.tmp_dir, "grid_search.json"), "w") as f:
+                json.dump(report, f, indent=2, default=str)
+            return
+        run, spec, res, _ = results[0]
+        for i, p in enumerate(res.params):
+            nn_model.save_model(self.paths.model_path(i, ext), spec, p)
+        log.info("saved %d model(s); valid errors %s", len(res.params),
+                 np.round(res.valid_errors, 6).tolist())

@@ -1,0 +1,1 @@
+"""Training layer: trainers, optimizers, sampling, early stop, grid search."""

@@ -1,0 +1,63 @@
+"""Early-stop strategies (reference ``core/dtrain/earlystop/``).
+
+``WindowEarlyStop``: stop when validation error hasn't improved for
+``windowSize`` epochs (``earlystop/WindowEarlyStop.java:23``).
+``ConvergeAndValidToleranceEarlyStop``: stop when |train - valid| error and
+train error both fall under the convergence threshold.
+These run host-side between jitted epochs — matching the reference's
+master-side check (``NNMaster.java:310-316``) — so the jitted step stays
+branch-free.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class WindowEarlyStop:
+    window_size: int = 10
+    best: float = math.inf
+    since_best: int = 0
+
+    def should_stop(self, valid_error: float) -> bool:
+        if valid_error < self.best - 1e-12:
+            self.best = valid_error
+            self.since_best = 0
+        else:
+            self.since_best += 1
+        return self.since_best >= self.window_size
+
+
+@dataclass
+class ConvergeAndValidToleranceEarlyStop:
+    threshold: float = 0.0
+    tolerance: float = 0.01
+
+    def should_stop(self, train_error: float, valid_error: float) -> bool:
+        if self.threshold <= 0:
+            return False
+        return (abs(train_error - valid_error) < self.tolerance
+                and train_error < self.threshold)
+
+
+@dataclass
+class GBTEarlyStopDecider:
+    """Moving-average + trend halt for boosted trees (reference
+    ``dt/DTEarlyStopDecider.java``): stop when the smoothed validation error
+    has been rising for ``patience`` consecutive trees."""
+    window: int = 5
+    patience: int = 3
+    history: List[float] = field(default_factory=list)
+    rising: int = 0
+
+    def add(self, valid_error: float) -> bool:
+        self.history.append(valid_error)
+        if len(self.history) < 2 * self.window:
+            return False
+        cur = sum(self.history[-self.window:]) / self.window
+        prev = sum(self.history[-2 * self.window:-self.window]) / self.window
+        self.rising = self.rising + 1 if cur > prev else 0
+        return self.rising >= self.patience

@@ -1,0 +1,57 @@
+"""Grid search / hyper-param fan-out (reference ``core/dtrain/gs/GridSearch.java:62``).
+
+List-valued entries in ``train#params`` expand cartesian-product style into
+flattened trial param dicts; a ``gridConfigFile`` contributes extra axes.  In
+the reference each combo becomes its own Guagua YARN job; here trials join
+the ensemble axis of the vmapped trainer when shapes agree, else run
+sequentially.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List
+
+# keys that alter network SHAPE — trials differing here can't share a vmap
+SHAPE_KEYS = {"NumHiddenLayers", "NumHiddenNodes", "ActivationFunc"}
+
+
+def is_grid_search(params: Dict[str, Any]) -> bool:
+    return any(isinstance(v, list) and _is_axis(k, v) for k, v in params.items())
+
+
+def _is_axis(key: str, v: list) -> bool:
+    """A list value is a grid axis unless the key naturally takes a list
+    (hidden node counts / activations), where only list-of-list is an axis."""
+    if key in ("NumHiddenNodes", "ActivationFunc", "FixedLayers"):
+        return bool(v) and isinstance(v[0], list)
+    return True
+
+
+def expand(params: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Flatten list-valued params into trial dicts (order = reference's
+    row-major cartesian iteration)."""
+    axes, fixed = [], {}
+    for k, v in params.items():
+        if isinstance(v, list) and _is_axis(k, v):
+            axes.append((k, v))
+        else:
+            fixed[k] = v
+    if not axes:
+        return [dict(params)]
+    trials = []
+    for combo in itertools.product(*(v for _, v in axes)):
+        t = dict(fixed)
+        t.update({k: c for (k, _), c in zip(axes, combo)})
+        trials.append(t)
+    return trials
+
+
+def group_by_shape(trials: List[Dict[str, Any]]) -> List[List[int]]:
+    """Indices of trials grouped by identical network shape — each group is
+    one vmapped ensemble run."""
+    groups: Dict[str, List[int]] = {}
+    for i, t in enumerate(trials):
+        sig = repr(sorted((k, repr(t.get(k))) for k in SHAPE_KEYS))
+        groups.setdefault(sig, []).append(i)
+    return list(groups.values())

@@ -1,0 +1,205 @@
+"""Weight-update rules — reference ``core/dtrain/Weight.java`` re-done as pure
+JAX pytree transforms.
+
+The reference exposes two families (``Weight.java:39,48-56``):
+
+- propagation algorithms: ``B`` backprop+momentum, ``Q`` quickprop,
+  ``R`` resilient RPROP, ``M`` manhattan;
+- update rules: ``ADAM | MOMENTUM | RMSPROP | ADAGRAD | NESTEROV``
+  (``nn/update/*.java``).
+
+Each rule here is an ``(init, update)`` pair over arbitrary param pytrees,
+jit-safe (state is a pytree of arrays, no Python branching on values).
+``update`` returns a delta to ADD to params.  L1/L2 regularization
+(``Weight.java:201-213``) is applied in the loss, not here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Optimizer(NamedTuple):
+    init: Callable[[Any], Any]
+    update: Callable[[Any, Any, Any], Tuple[Any, Any]]  # (grads, state, params)
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def _zeros_like(params):
+    return _tmap(jnp.zeros_like, params)
+
+
+# ------------------------------------------------------------ update rules
+def sgd(learning_rate: float) -> Optimizer:
+    def init(params):
+        return ()
+
+    def update(grads, state, params):
+        return _tmap(lambda g: -learning_rate * g, grads), state
+
+    return Optimizer(init, update)
+
+
+def momentum(learning_rate: float, beta: float = 0.9,
+             nesterov_mode: bool = False) -> Optimizer:
+    """MOMENTUM / NESTEROV update rules (``nn/update/MomentumUpdate.java``,
+    ``NesterovUpdate.java``)."""
+    def init(params):
+        return {"v": _zeros_like(params)}
+
+    def update(grads, state, params):
+        v = _tmap(lambda v_, g: beta * v_ - learning_rate * g, state["v"], grads)
+        if nesterov_mode:
+            delta = _tmap(lambda v_, g: beta * v_ - learning_rate * g, v, grads)
+        else:
+            delta = v
+        return delta, {"v": v}
+
+    return Optimizer(init, update)
+
+
+def adagrad(learning_rate: float, eps: float = 1e-8) -> Optimizer:
+    def init(params):
+        return {"g2": _zeros_like(params)}
+
+    def update(grads, state, params):
+        g2 = _tmap(lambda a, g: a + g * g, state["g2"], grads)
+        delta = _tmap(lambda g, a: -learning_rate * g / (jnp.sqrt(a) + eps),
+                      grads, g2)
+        return delta, {"g2": g2}
+
+    return Optimizer(init, update)
+
+
+def rmsprop(learning_rate: float, decay: float = 0.9, eps: float = 1e-8) -> Optimizer:
+    def init(params):
+        return {"g2": _zeros_like(params)}
+
+    def update(grads, state, params):
+        g2 = _tmap(lambda a, g: decay * a + (1 - decay) * g * g,
+                   state["g2"], grads)
+        delta = _tmap(lambda g, a: -learning_rate * g / (jnp.sqrt(a) + eps),
+                      grads, g2)
+        return delta, {"g2": g2}
+
+    return Optimizer(init, update)
+
+
+def adam(learning_rate: float, beta1: float = 0.9, beta2: float = 0.999,
+         eps: float = 1e-8) -> Optimizer:
+    def init(params):
+        return {"m": _zeros_like(params), "v": _zeros_like(params),
+                "t": jnp.zeros((), jnp.float32)}
+
+    def update(grads, state, params):
+        t = state["t"] + 1.0
+        m = _tmap(lambda m_, g: beta1 * m_ + (1 - beta1) * g, state["m"], grads)
+        v = _tmap(lambda v_, g: beta2 * v_ + (1 - beta2) * g * g, state["v"], grads)
+        mh = _tmap(lambda m_: m_ / (1 - beta1 ** t), m)
+        vh = _tmap(lambda v_: v_ / (1 - beta2 ** t), v)
+        delta = _tmap(lambda m_, v_: -learning_rate * m_ / (jnp.sqrt(v_) + eps),
+                      mh, vh)
+        return delta, {"m": m, "v": v, "t": t}
+
+    return Optimizer(init, update)
+
+
+# ----------------------------------------------- propagation algos (B/Q/R/M)
+def backprop(learning_rate: float, momentum_term: float = 0.5) -> Optimizer:
+    """``B``: plain backprop + momentum (``Weight.java`` B branch)."""
+    return momentum(learning_rate, beta=momentum_term)
+
+
+def manhattan(learning_rate: float) -> Optimizer:
+    """``M``: fixed step in the gradient's sign direction."""
+    def init(params):
+        return ()
+
+    def update(grads, state, params):
+        return _tmap(lambda g: -learning_rate * jnp.sign(g), grads), state
+
+    return Optimizer(init, update)
+
+
+def rprop(init_step: float = 0.1, eta_plus: float = 1.2, eta_minus: float = 0.5,
+          max_step: float = 50.0, min_step: float = 1e-6) -> Optimizer:
+    """``R``: resilient propagation — per-weight adaptive step from gradient
+    sign agreement; the reference NN default (``Weight.java`` R branch,
+    Encog ResilientPropagation constants)."""
+    def init(params):
+        return {"step": _tmap(lambda p: jnp.full_like(p, init_step), params),
+                "prev_g": _zeros_like(params)}
+
+    def update(grads, state, params):
+        def one(g, pg, st):
+            agree = g * pg
+            new_st = jnp.where(agree > 0, jnp.minimum(st * eta_plus, max_step),
+                               jnp.where(agree < 0,
+                                         jnp.maximum(st * eta_minus, min_step), st))
+            # on sign flip: no move this step, zero the remembered gradient
+            delta = jnp.where(agree < 0, 0.0, -jnp.sign(g) * new_st)
+            carry_g = jnp.where(agree < 0, 0.0, g)
+            return delta, new_st, carry_g
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_pg = treedef.flatten_up_to(state["prev_g"])
+        flat_st = treedef.flatten_up_to(state["step"])
+        outs = [one(g, pg, st) for g, pg, st in zip(flat_g, flat_pg, flat_st)]
+        delta = treedef.unflatten([o[0] for o in outs])
+        step = treedef.unflatten([o[1] for o in outs])
+        prev = treedef.unflatten([o[2] for o in outs])
+        return delta, {"step": step, "prev_g": prev}
+
+    return Optimizer(init, update)
+
+
+def quickprop(learning_rate: float, mu: float = 1.75,
+              eps: float = 1e-10) -> Optimizer:
+    """``Q``: quickprop — quadratic step from consecutive gradients
+    (``Weight.java`` Q branch), clamped by the maximum-growth factor ``mu``."""
+    def init(params):
+        return {"prev_g": _zeros_like(params), "prev_d": _zeros_like(params)}
+
+    def update(grads, state, params):
+        def one(g, pg, pd):
+            quick = g / (pg - g + jnp.where(pg == g, eps, 0.0)) * pd
+            quick = jnp.clip(quick, -mu * jnp.abs(pd) - eps, mu * jnp.abs(pd) + eps)
+            grad_step = -learning_rate * g
+            first = pd == 0.0
+            d = jnp.where(first, grad_step, quick + grad_step)
+            return d
+
+        delta = _tmap(one, grads, state["prev_g"], state["prev_d"])
+        return delta, {"prev_g": grads, "prev_d": delta}
+
+    return Optimizer(init, update)
+
+
+# ----------------------------------------------------------------- factory
+_RULES = {
+    "ADAM": lambda lr, kw: adam(lr, **kw),
+    "MOMENTUM": lambda lr, kw: momentum(lr, **kw),
+    "NESTEROV": lambda lr, kw: momentum(lr, nesterov_mode=True, **kw),
+    "RMSPROP": lambda lr, kw: rmsprop(lr, **kw),
+    "ADAGRAD": lambda lr, kw: adagrad(lr, **kw),
+    "SGD": lambda lr, kw: sgd(lr),
+    # propagation letters (reference train#params "Propagation")
+    "B": lambda lr, kw: backprop(lr, **kw),
+    "M": lambda lr, kw: manhattan(lr),
+    "R": lambda lr, kw: rprop(**kw),
+    "Q": lambda lr, kw: quickprop(lr, **kw),
+}
+
+
+def make_optimizer(name: str, learning_rate: float = 0.1, **kwargs) -> Optimizer:
+    key = (name or "R").upper()
+    if key not in _RULES:
+        raise ValueError(f"unknown optimizer/propagation {name!r}; "
+                         f"one of {sorted(_RULES)}")
+    return _RULES[key](learning_rate, kwargs)

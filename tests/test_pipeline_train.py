@@ -1,0 +1,84 @@
+"""End-to-end `train` step over the synthetic fraud model set — the
+reference's shell-test pattern (new→init→stats→norm→train) in-process."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.config import ModelConfig
+
+
+def run_steps(model_set, upto_train_params=None, algorithm=None):
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    if algorithm or upto_train_params is not None:
+        mc = ModelConfig.load(mc_path)
+        if algorithm:
+            mc.train.algorithm = algorithm
+        if upto_train_params is not None:
+            mc.train.params = upto_train_params
+        mc.save(mc_path)
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    assert TrainProcessor(model_set, params={}).run() == 0
+
+
+def test_train_nn_end_to_end(model_set):
+    run_steps(model_set, upto_train_params={
+        "Propagation": "R", "LearningRate": 0.1,
+        "NumHiddenNodes": [12], "ActivationFunc": ["tanh"]})
+    model_path = os.path.join(model_set, "models", "model0.nn")
+    assert os.path.isfile(model_path)
+
+    # the saved model separates classes on the training data
+    from shifu_tpu.models import load_any
+    from shifu_tpu.data.shards import Shards
+    m = load_any(model_path)
+    data = Shards.open(os.path.join(model_set, "tmp", "NormalizedData")).load_all()
+    scores = m.compute(data["x"])[:, 0]
+    pos, neg = scores[data["y"] == 1], scores[data["y"] == 0]
+    assert pos.mean() > neg.mean() + 0.1
+
+    progress = os.path.join(model_set, "tmp", "train.progress")
+    assert os.path.isfile(progress) and "Validation Error" in open(progress).read()
+
+
+def test_train_lr_end_to_end(model_set):
+    from shifu_tpu.config.model_config import Algorithm
+    run_steps(model_set, algorithm=Algorithm.LR)
+    model_path = os.path.join(model_set, "models", "model0.lr")
+    assert os.path.isfile(model_path)
+    from shifu_tpu.models import load_any
+    from shifu_tpu.data.shards import Shards
+    m = load_any(model_path)
+    data = Shards.open(os.path.join(model_set, "tmp", "NormalizedData")).load_all()
+    scores = m.compute(data["x"])[:, 0]
+    pos, neg = scores[data["y"] == 1], scores[data["y"] == 0]
+    assert pos.mean() > neg.mean() + 0.1
+
+
+def test_train_grid_search(model_set):
+    run_steps(model_set, upto_train_params={
+        "Propagation": "R", "LearningRate": [0.1, 0.25],
+        "NumHiddenNodes": [8], "ActivationFunc": ["tanh"]})
+    assert os.path.isfile(os.path.join(model_set, "models", "model0.nn"))
+    report = json.load(open(os.path.join(model_set, "tmp", "grid_search.json")))
+    assert len(report) == 2
+    assert report[0]["validError"] <= report[1]["validError"]
+
+
+def test_train_bagging(model_set):
+    mc = ModelConfig.load(os.path.join(model_set, "ModelConfig.json"))
+    mc.train.baggingNum = 3
+    mc.train.numTrainEpochs = 10
+    mc.save(os.path.join(model_set, "ModelConfig.json"))
+    run_steps(model_set)
+    for i in range(3):
+        assert os.path.isfile(os.path.join(model_set, "models", f"model{i}.nn"))
