@@ -1,0 +1,1 @@
+"""Eval layer: batch scorer, metrics sweep, reports."""

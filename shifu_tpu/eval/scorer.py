@@ -1,0 +1,95 @@
+"""Scorer + ModelRunner — reference ``core/Scorer.java:53`` /
+``core/ModelRunner.java:54`` batched.
+
+The reference scores one normalized row at a time across bagged models
+(thread pool per model, ``Scorer.java:163-200``); here all rows × all models
+run as batched jitted forwards — the per-model thread pool becomes the MXU's
+batch dimension.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..models import load_any
+
+SCORE_SCALE = 1000.0  # reference scales [0,1] raw scores by 1000
+
+
+@dataclass
+class CaseScoreResult:
+    """Batch analogue of reference ``container/CaseScoreResult``: per-row
+    aggregate + per-model scores (already scaled)."""
+    scores: np.ndarray       # [n, models] scaled
+    mean: np.ndarray         # [n]
+    max: np.ndarray
+    min: np.ndarray
+    median: np.ndarray
+
+    def select(self, selector: str) -> np.ndarray:
+        s = (selector or "mean").lower()
+        if s in ("mean", "avg"):
+            return self.mean
+        if s == "max":
+            return self.max
+        if s == "min":
+            return self.min
+        if s == "median":
+            return self.median
+        if s.startswith("model"):
+            return self.scores[:, int(s[5:])]
+        raise ValueError(f"unknown score selector {selector!r}")
+
+
+class Scorer:
+    """Multi-model batch scorer over normalized feature matrices."""
+
+    def __init__(self, models: Sequence, scale: float = SCORE_SCALE):
+        if not models:
+            raise ValueError("no models to score with")
+        self.models = list(models)
+        self.scale = scale
+
+    @classmethod
+    def from_dir(cls, models_dir: str, scale: float = SCORE_SCALE) -> "Scorer":
+        def index_key(p: str) -> tuple:
+            stem = os.path.splitext(os.path.basename(p))[0]
+            digits = "".join(ch for ch in stem if ch.isdigit())
+            return (int(digits) if digits else 0, p)
+
+        paths = sorted(glob.glob(os.path.join(models_dir, "model*.*")),
+                       key=index_key)
+        models = [load_any(p) for p in paths]
+        if not models:
+            raise FileNotFoundError(f"no model files in {models_dir}")
+        return cls(models, scale)
+
+    def score(self, x: np.ndarray) -> CaseScoreResult:
+        cols = [np.asarray(m.compute(x))[:, 0] for m in self.models]
+        raw = np.stack(cols, axis=1) * self.scale
+        return CaseScoreResult(scores=raw, mean=raw.mean(axis=1),
+                               max=raw.max(axis=1), min=raw.min(axis=1),
+                               median=np.median(raw, axis=1))
+
+
+class ModelRunner:
+    """raw chunk -> normalize -> score (reference ``ModelRunner.compute``,
+    also the engine inside ``EvalScoreUDF``)."""
+
+    def __init__(self, model_config, column_configs, models: Sequence,
+                 for_eval_set: Optional[int] = None, scale: float = SCORE_SCALE):
+        from ..data.transform import DatasetTransformer
+        self.transformer = DatasetTransformer(model_config, column_configs,
+                                              for_eval_set=for_eval_set)
+        self.scorer = Scorer(models, scale)
+
+    def compute(self, chunk) -> Dict[str, np.ndarray]:
+        tc = self.transformer.transform(chunk)
+        res = self.scorer.score(tc.x)
+        return {"result": res, "target": tc.target, "weight": tc.weight,
+                "n": tc.n}

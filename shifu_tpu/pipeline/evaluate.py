@@ -1,0 +1,179 @@
+"""`eval` step — reference ``EvalModelProcessor.java:67,159`` without the
+cluster: eval-set CRUD + streaming scoring + confusion/performance report.
+
+The reference submits ``Eval.pig``/``EvalScore.pig`` (``:424-436``) whose
+mappers run ``EvalScoreUDF`` → ``ModelRunner`` per record with Hadoop
+counters; here each eval set streams through the same ModelRunner batched on
+device, and the counter totals fall out of the sweep.  Outputs mirror
+``PathFinder``: EvalScore tsv, EvalConfusionMatrix csv,
+EvalPerformance.json, gain-chart csv.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import logging
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..config.model_config import EvalConfig, RawSourceData
+from ..config.validator import ModelStep
+from ..data import DataSource
+from ..eval.metrics import evaluate_scores, gain_chart_rows
+from ..eval.scorer import ModelRunner, Scorer
+from .processor import BasicProcessor
+
+log = logging.getLogger(__name__)
+
+
+class EvalProcessor(BasicProcessor):
+    step = ModelStep.EVAL
+
+    def process(self) -> int:
+        p = self.params
+        if p.get("list"):
+            for ev in self.model_config.evals:
+                log.info("eval set: %s (%s)", ev.name, ev.dataSet.dataPath)
+            return 0
+        if p.get("new_eval"):
+            return self._new_eval(p["new_eval"])
+        if p.get("delete_eval"):
+            return self._delete_eval(p["delete_eval"])
+        for key in ("run_eval", "score", "perf", "confmat"):
+            if p.get(key) is not None:
+                return self._run(p[key] or None, action=key)
+        # bare `eval` = run all sets (reference default)
+        return self._run(None, action="run_eval")
+
+    # -------------------------------------------------------------- CRUD
+    def _new_eval(self, name: str) -> int:
+        if any(e.name == name for e in self.model_config.evals):
+            log.error("eval set %s already exists", name)
+            return 1
+        ev = EvalConfig(name=name, dataSet=RawSourceData())
+        # inherit the training source as the template (reference copies
+        # dataSet section on `eval -new`)
+        base = self.model_config.dataSet
+        for f in ("dataPath", "dataDelimiter", "headerPath", "headerDelimiter",
+                  "targetColumnName", "posTags", "negTags", "missingOrInvalidValues",
+                  "weightColumnName"):
+            setattr(ev.dataSet, f, getattr(base, f))
+        self.model_config.evals.append(ev)
+        self.save_model_config()
+        log.info("created eval set %s", name)
+        return 0
+
+    def _delete_eval(self, name: str) -> int:
+        before = len(self.model_config.evals)
+        self.model_config.evals = [e for e in self.model_config.evals
+                                   if e.name != name]
+        if len(self.model_config.evals) == before:
+            log.error("no eval set named %s", name)
+            return 1
+        self.save_model_config()
+        return 0
+
+    # --------------------------------------------------------------- run
+    def _eval_sets(self, name: Optional[str]) -> List[int]:
+        evals = self.model_config.evals
+        if name:
+            idx = [i for i, e in enumerate(evals) if e.name == name]
+            if not idx:
+                raise ValueError(f"no eval set named {name}")
+            return idx
+        return list(range(len(evals)))
+
+    def _run(self, name: Optional[str], action: str) -> int:
+        scorer = Scorer.from_dir(self.paths.models_dir)  # load models once
+        rc = 0
+        for i in self._eval_sets(name):
+            rc |= self._run_one(i, action, scorer)
+        return rc
+
+    def _run_one(self, idx: int, action: str, scorer: Scorer) -> int:
+        mc = self.model_config
+        ev = mc.evals[idx]
+        runner = ModelRunner(mc, self.column_configs, scorer.models,
+                             for_eval_set=idx)
+        ds = ev.dataSet
+        source = DataSource(self._abs(ds.dataPath), ds.dataDelimiter,
+                            header_path=self._abs(ds.headerPath),
+                            header_delimiter=ds.headerDelimiter)
+        eval_dir = self.paths.eval_dir(ev.name)
+        os.makedirs(eval_dir, exist_ok=True)
+
+        sel = ev.performanceScoreSelector or "mean"
+        all_scores, all_targets, all_weights = [], [], []
+        score_path = self.paths.eval_score_path(ev.name)
+        n_models = len(scorer.models)
+        with open(score_path, "w") as sf:
+            w = csv.writer(sf, delimiter="|")
+            w.writerow(["tag", "weight", "mean", "max", "min", "median"]
+                       + [f"model{i}" for i in range(n_models)])
+            for chunk in source.iter_chunks():
+                out = runner.compute(chunk)
+                if out["n"] == 0:
+                    continue
+                res = out["result"]
+                chosen = res.select(sel)
+                all_scores.append(chosen)
+                all_targets.append(out["target"])
+                all_weights.append(out["weight"])
+                for r in range(out["n"]):
+                    w.writerow([int(out["target"][r]), out["weight"][r],
+                                f"{res.mean[r]:.3f}", f"{res.max[r]:.3f}",
+                                f"{res.min[r]:.3f}", f"{res.median[r]:.3f}"]
+                               + [f"{res.scores[r, m]:.3f}"
+                                  for m in range(n_models)])
+        if not all_scores:
+            log.error("eval %s: no records scored", ev.name)
+            return 1
+        scores = np.concatenate(all_scores)
+        targets = np.concatenate(all_targets)
+        weights = np.concatenate(all_weights)
+        log.info("eval %s: scored %d records (%d pos / %d neg) with %d model(s)",
+                 ev.name, len(scores), int(targets.sum()),
+                 int((1 - targets).sum()), n_models)
+        if action == "score":
+            return 0
+
+        result = evaluate_scores(scores, targets, weights,
+                                 buckets=ev.performanceBucketNum)
+        result.modelCount = n_models
+        with open(self.paths.eval_performance_path(ev.name), "w") as f:
+            json.dump(result.to_dict(), f, indent=2)
+        self._write_confusion(ev.name, result)
+        self._write_gains(eval_dir, result)
+        log.info("eval %s: AUC %.6f weighted AUC %.6f PR-AUC %.6f",
+                 ev.name, result.areaUnderRoc, result.weightedAuc,
+                 result.areaUnderPr)
+        return 0
+
+    def _write_confusion(self, name: str, result) -> None:
+        path = self.paths.eval_confusion_path(name)
+        with open(path, "w") as f:
+            w = csv.writer(f)
+            cols = ["binLowestScore", "tp", "fp", "fn", "tn", "precision",
+                    "recall", "fpr", "actionRate", "liftUnit", "weightedTp",
+                    "weightedFp", "weightedFn", "weightedTn",
+                    "weightedPrecision", "weightedRecall", "weightedFpr"]
+            w.writerow(cols)
+            for pt in result.points:
+                w.writerow([getattr(pt, c) for c in cols])
+
+    def _write_gains(self, eval_dir: str, result) -> None:
+        with open(os.path.join(eval_dir, "gainchart.csv"), "w") as f:
+            rows = gain_chart_rows(result)
+            if not rows:
+                return
+            w = csv.DictWriter(f, fieldnames=list(rows[0]))
+            w.writeheader()
+            w.writerows(rows)
+
+    def _abs(self, p: Optional[str]) -> Optional[str]:
+        if p is None:
+            return None
+        return p if os.path.isabs(p) else os.path.normpath(os.path.join(self.dir, p))

@@ -1,0 +1,87 @@
+"""`posttrain` step — reference ``PostTrainModelProcessor.java`` +
+``core/posttrain/PostTrainMapper.java``: score the training data with the
+final models and write per-(column, bin) average scores into
+``ColumnConfig.binAvgScore``, plus a feature-importance ranking.
+
+The reference runs an MR job over raw data; here the cleaned binned matrix
+and the norm matrix are already materialized, so it is one streamed
+scatter-mean on device-scored batches.  Feature importance for NN/LR models
+is the per-column score spread (max bin avg − min bin avg, weighted by bin
+population) — tree models get split-gain FI from their own trainer.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List
+
+import numpy as np
+
+from ..config.validator import ModelStep
+from ..data.shards import Shards
+from ..eval.scorer import Scorer
+from .processor import BasicProcessor
+
+log = logging.getLogger(__name__)
+
+
+class PostTrainProcessor(BasicProcessor):
+    step = ModelStep.POSTTRAIN
+
+    def process(self) -> int:
+        scorer = Scorer.from_dir(self.paths.models_dir)
+        norm = Shards.open(self.paths.norm_dir)
+        clean = Shards.open(self.paths.clean_dir)
+        col_nums: List[int] = clean.schema.get("columnNums", [])
+        by_num = {c.columnNum: c for c in self.column_configs}
+
+        sums: Dict[int, np.ndarray] = {}
+        counts: Dict[int, np.ndarray] = {}
+        for nshard, cshard in zip(norm.iter_shards(), clean.iter_shards()):
+            scores = scorer.score(nshard["x"]).mean
+            bins = cshard["bins"]
+            for j, cnum in enumerate(col_nums):
+                cc = by_num.get(cnum)
+                if cc is None:
+                    continue
+                nb = cc.num_bins() + 1  # + missing bin
+                b = bins[:, j].astype(np.int64)
+                b = np.clip(b, 0, nb - 1)
+                s = np.bincount(b, weights=scores, minlength=nb)
+                c = np.bincount(b, minlength=nb)
+                if cnum not in sums:
+                    sums[cnum], counts[cnum] = s, c.astype(np.float64)
+                else:
+                    sums[cnum] += s
+                    counts[cnum] += c
+
+        fi: Dict[str, float] = {}
+        for cnum in col_nums:
+            cc = by_num.get(cnum)
+            if cc is None or cnum not in sums:
+                continue
+            avg = sums[cnum] / np.maximum(counts[cnum], 1)
+            cc.columnBinning.binAvgScore = [int(round(v)) for v in avg]
+            pop = counts[cnum] / max(counts[cnum].sum(), 1)
+            seen = counts[cnum] > 0
+            if seen.any():
+                spread = float(avg[seen].max() - avg[seen].min())
+                fi[cc.columnName] = spread * float(1 - pop.max())
+        self.save_column_configs()
+
+        os.makedirs(self.paths.post_train_dir, exist_ok=True)
+        ranked = sorted(fi.items(), key=lambda kv: -kv[1])
+        with open(self.paths.feature_importance_path, "w") as f:
+            for name, v in ranked:
+                f.write(f"{name}\t{v:.4f}\n")
+        with open(self.paths.bin_avg_score_path, "w") as f:
+            for cnum in col_nums:
+                cc = by_num.get(cnum)
+                if cc and cc.columnBinning.binAvgScore:
+                    f.write(f"{cnum}|{cc.columnName}|"
+                            + ",".join(map(str, cc.columnBinning.binAvgScore))
+                            + "\n")
+        log.info("posttrain: bin avg scores for %d columns; top features: %s",
+                 len(sums), [n for n, _ in ranked[:5]])
+        return 0

@@ -107,9 +107,15 @@ class TrainProcessor(BasicProcessor):
     # ------------------------------------------------------------ NN / LR
     def _train_nn_family(self, alg: Algorithm) -> int:
         mc = self.model_config
-        data = Shards.open(self.paths.norm_dir).load_all()
+        shards = Shards.open(self.paths.norm_dir)
+        data = shards.load_all()
         x, y, w = data["x"], data["y"], data["w"]
-        schema = Shards.open(self.paths.norm_dir).schema
+        if self.params.get("shuffle"):
+            # reference `train -shuffle` re-randomizes row order before
+            # training (MapReduceShuffle re-run)
+            perm = np.random.default_rng(0).permutation(len(y))
+            x, y, w = x[perm], y[perm], w[perm]
+        schema = shards.schema
         column_nums = schema.get("columnNums", [])
         feature_names = schema.get("outputNames", [])
         n, d = x.shape
@@ -128,50 +134,50 @@ class TrainProcessor(BasicProcessor):
 
         results = []
         with open(progress_path, "w") as pf:
-            for group in grid_search.group_by_shape(trials):
-                # one run per grid trial (settings differ inside a shape
-                # group); non-grid mode = one run with all bagging members
-                runs = [[m] for m in group] if is_gs else [list(range(bags))]
-                for run in runs:
-                    run_params = trials[run[0]] if is_gs else dict(params)
-                    if alg in (Algorithm.LR, Algorithm.SVM):
-                        spec = lr_spec(d, run_params, column_nums, feature_names)
-                    else:
-                        spec = nn_spec_from_params(d, run_params, column_nums,
-                                                   feature_names)
-                    settings = settings_from_params(run_params, mc.train)
-                    run_kfold = kfold if not is_gs else -1
-                    train_w, valid_w = member_masks(
-                        n, len(run) if is_gs else bags,
-                        valid_rate=mc.train.validSetRate,
-                        kfold=run_kfold,
-                        sample_rate=mc.train.baggingSampleRate,
-                        replacement=mc.train.baggingWithReplacement,
-                        stratified=mc.train.stratifiedSample,
-                        up_sample_weight=mc.train.upSampleWeight,
-                        targets=y, seed=settings.seed)
-                    n_members = train_w.shape[0]  # kfold mode yields numKFold
-                    train_w = train_w * w[None, :]
-                    valid_w = valid_w * w[None, :]
-                    init_list = self._continuous_init(spec, n_members, alg)
+            # one run per grid trial; non-grid = one run with all bagging
+            # members vmapped together
+            runs = [[t] for t in range(len(trials))] if is_gs \
+                else [list(range(bags))]
+            for run in runs:
+                run_params = trials[run[0]] if is_gs else dict(params)
+                if alg in (Algorithm.LR, Algorithm.SVM):
+                    spec = lr_spec(d, run_params, column_nums, feature_names)
+                else:
+                    spec = nn_spec_from_params(d, run_params, column_nums,
+                                               feature_names)
+                settings = settings_from_params(run_params, mc.train)
+                run_kfold = kfold if not is_gs else -1
+                train_w, valid_w = member_masks(
+                    n, len(run) if is_gs else bags,
+                    valid_rate=mc.train.validSetRate,
+                    kfold=run_kfold,
+                    sample_rate=mc.train.baggingSampleRate,
+                    replacement=mc.train.baggingWithReplacement,
+                    stratified=mc.train.stratifiedSample,
+                    up_sample_weight=mc.train.upSampleWeight,
+                    targets=y, seed=settings.seed)
+                n_members = train_w.shape[0]  # kfold mode yields numKFold
+                train_w = train_w * w[None, :]
+                valid_w = valid_w * w[None, :]
+                init_list = self._continuous_init(spec, n_members, alg)
 
-                    def progress(epoch, tr, va, _pf=pf, _run=run):
-                        line = (f"Trial {_run} Epoch #{epoch + 1} "
-                                f"Train Error: {tr:.6f} Validation Error: {va:.6f}")
-                        _pf.write(line + "\n")
-                        _pf.flush()
-                        log.info(line)
+                def progress(epoch, tr, va, _pf=pf, _run=run):
+                    line = (f"Trial {_run} Epoch #{epoch + 1} "
+                            f"Train Error: {tr:.6f} Validation Error: {va:.6f}")
+                    _pf.write(line + "\n")
+                    _pf.flush()
+                    log.info(line)
 
-                    def checkpoint(epoch, params_list, _spec=spec, _alg=alg):
-                        for i, p in enumerate(params_list):
-                            path = self.paths.tmp_model_path(
-                                i, epoch + 1, _alg.name.lower())
-                            nn_model.save_model(path, _spec, p)
+                def checkpoint(epoch, params_list, _spec=spec, _alg=alg):
+                    for i, p in enumerate(params_list):
+                        path = self.paths.tmp_model_path(
+                            i, epoch + 1, _alg.name.lower())
+                        nn_model.save_model(path, _spec, p)
 
-                    res = train_ensemble(x, y, train_w, valid_w, spec, settings,
-                                         init_params_list=init_list,
-                                         progress=progress, checkpoint=checkpoint)
-                    results.append((run, spec, res, run_params))
+                res = train_ensemble(x, y, train_w, valid_w, spec, settings,
+                                     init_params_list=init_list,
+                                     progress=progress, checkpoint=checkpoint)
+                results.append((run, spec, res, run_params))
 
         self._write_models(results, alg, is_gs)
         log.info("train done in %.1fs", time.time() - t0)
@@ -198,6 +204,11 @@ class TrainProcessor(BasicProcessor):
     def _write_models(self, results, alg: Algorithm, is_gs: bool) -> None:
         ext = alg.name.lower() if alg != Algorithm.SVM else "lr"
         os.makedirs(self.paths.models_dir, exist_ok=True)
+        # clear stale models from previous runs (fewer bags / other algs) so
+        # eval's glob never mixes ensembles
+        for f in os.listdir(self.paths.models_dir):
+            if f.startswith("model"):
+                os.remove(os.path.join(self.paths.models_dir, f))
         if is_gs:
             # grid search: pick the best trial by validation error
             # (reference re-trains the winner; our members ARE full runs)

@@ -2,18 +2,15 @@
 
 List-valued entries in ``train#params`` expand cartesian-product style into
 flattened trial param dicts; a ``gridConfigFile`` contributes extra axes.  In
-the reference each combo becomes its own Guagua YARN job; here trials join
-the ensemble axis of the vmapped trainer when shapes agree, else run
-sequentially.
+the reference each combo becomes its own Guagua YARN job; here each trial is
+one ensemble-trainer run (a future optimization could vmap same-shape trials
+together, but per-trial settings feed the optimizer closure today).
 """
 
 from __future__ import annotations
 
 import itertools
 from typing import Any, Dict, List
-
-# keys that alter network SHAPE — trials differing here can't share a vmap
-SHAPE_KEYS = {"NumHiddenLayers", "NumHiddenNodes", "ActivationFunc"}
 
 
 def is_grid_search(params: Dict[str, Any]) -> bool:
@@ -45,13 +42,3 @@ def expand(params: Dict[str, Any]) -> List[Dict[str, Any]]:
         t.update({k: c for (k, _), c in zip(axes, combo)})
         trials.append(t)
     return trials
-
-
-def group_by_shape(trials: List[Dict[str, Any]]) -> List[List[int]]:
-    """Indices of trials grouped by identical network shape — each group is
-    one vmapped ensemble run."""
-    groups: Dict[str, List[int]] = {}
-    for i, t in enumerate(trials):
-        sig = repr(sorted((k, repr(t.get(k))) for k in SHAPE_KEYS))
-        groups.setdefault(sig, []).append(i)
-    return list(groups.values())

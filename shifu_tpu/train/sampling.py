@@ -44,10 +44,11 @@ def bagging_weights(n: int, bags: int, sample_rate: float = 1.0,
     """[bags, n] per-row sample weights.
 
     with replacement → Poisson(rate) counts (the classic bootstrap
-    approximation the reference's per-record re-draw converges to);
-    without → Bernoulli(rate) 0/1 mask.  Bag 0 of a baggingNum=1 run sees all
-    rows (reference trains the single model on the full sample).
-    ``upSampleWeight`` multiplies positive rows (reference up-sampling)."""
+    approximation the reference's per-record re-draw converges to) — even for
+    baggingNum=1, matching the reference's per-job sampling; without
+    replacement → Bernoulli(rate) 0/1 mask, except a single bag at full rate
+    sees every row.  ``upSampleWeight`` multiplies positive rows (reference
+    up-sampling)."""
     rng = np.random.default_rng(seed)
     if bags == 1 and sample_rate >= 1.0 and not replacement:
         w = np.ones((1, n), np.float32)

@@ -1,0 +1,157 @@
+"""Eval stack tests — AUC parity cases (reference
+``core/evaluation/AreaUnderCurveTest.java`` pattern) + end-to-end eval run."""
+
+import csv
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.eval.metrics import auc_trapezoid, evaluate_scores
+from shifu_tpu.eval.scorer import Scorer, CaseScoreResult
+
+
+def test_auc_perfect_classifier():
+    scores = np.array([0.9, 0.8, 0.7, 0.2, 0.1])
+    targets = np.array([1, 1, 1, 0, 0])
+    res = evaluate_scores(scores, targets)
+    assert res.areaUnderRoc == pytest.approx(1.0)
+
+
+def test_auc_random_is_half():
+    rng = np.random.default_rng(0)
+    scores = rng.random(20000)
+    targets = (rng.random(20000) < 0.3).astype(float)
+    res = evaluate_scores(scores, targets)
+    assert res.areaUnderRoc == pytest.approx(0.5, abs=0.02)
+
+
+def test_auc_matches_rank_statistic():
+    """AUC == P(score_pos > score_neg) (Mann-Whitney), the textbook identity."""
+    rng = np.random.default_rng(1)
+    scores = rng.normal(size=500)
+    targets = (rng.random(500) < 0.4).astype(float)
+    scores[targets == 1] += 1.0
+    res = evaluate_scores(scores, targets)
+    pos = scores[targets == 1]
+    neg = scores[targets == 0]
+    mw = (pos[:, None] > neg[None, :]).mean() + \
+        0.5 * (pos[:, None] == neg[None, :]).mean()
+    assert res.areaUnderRoc == pytest.approx(mw, abs=1e-6)
+
+
+def test_weighted_auc_reweights():
+    scores = np.array([0.9, 0.8, 0.3, 0.2])
+    targets = np.array([1.0, 0.0, 1.0, 0.0])
+    unweighted = evaluate_scores(scores, targets)
+    # weight the high-score pair heavily -> weighted AUC improves
+    weighted = evaluate_scores(scores, targets,
+                               np.array([10.0, 0.1, 0.1, 10.0]))
+    assert weighted.weightedAuc > unweighted.areaUnderRoc
+
+
+def test_bucket_points_monotone():
+    rng = np.random.default_rng(2)
+    scores = rng.random(5000)
+    targets = (scores + rng.normal(0, 0.3, 5000) > 0.6).astype(float)
+    res = evaluate_scores(scores, targets, buckets=10)
+    assert len(res.points) == 10
+    recalls = [p.recall for p in res.points]
+    actions = [p.actionRate for p in res.points]
+    assert recalls == sorted(recalls)
+    assert actions == sorted(actions)
+    assert res.points[-1].recall == pytest.approx(1.0)
+    # threshold column is descending in score
+    ths = [p.binLowestScore for p in res.points]
+    assert ths == sorted(ths, reverse=True)
+
+
+def test_degenerate_single_class():
+    res = evaluate_scores(np.array([0.5, 0.6]), np.array([1.0, 1.0]))
+    assert np.isnan(res.areaUnderRoc)
+
+
+class _ConstModel:
+    def __init__(self, v):
+        self.v = v
+
+    def compute(self, x):
+        return np.full((len(x), 1), self.v)
+
+
+def test_scorer_aggregates_and_scale():
+    sc = Scorer([_ConstModel(0.2), _ConstModel(0.6)])
+    res = sc.score(np.zeros((3, 4)))
+    assert res.scores.shape == (3, 2)
+    np.testing.assert_allclose(res.mean, 400.0)
+    np.testing.assert_allclose(res.max, 600.0)
+    np.testing.assert_allclose(res.min, 200.0)
+    assert res.select("model1")[0] == 600.0
+
+
+def test_eval_pipeline_end_to_end(model_set):
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+    from shifu_tpu.pipeline.evaluate import EvalProcessor
+
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    assert TrainProcessor(model_set, params={}).run() == 0
+    assert EvalProcessor(model_set, params={"run_eval": ""}).run() == 0
+
+    eval_dir = os.path.join(model_set, "evals", "Eval1")
+    perf = json.load(open(os.path.join(eval_dir, "EvalPerformance.json")))
+    # the model learned something real: AUC well above chance on train data
+    assert perf["areaUnderRoc"] > 0.7
+    assert perf["recordCount"] == 4000
+    assert len(perf["performance"]) == 10
+
+    with open(os.path.join(eval_dir, "EvalScore")) as f:
+        rows = list(csv.reader(f, delimiter="|"))
+    assert len(rows) == 4001  # header + all records
+    assert rows[0][:3] == ["tag", "weight", "mean"]
+
+    assert os.path.isfile(os.path.join(eval_dir, "EvalConfusionMatrix"))
+    assert os.path.isfile(os.path.join(eval_dir, "gainchart.csv"))
+
+
+def test_eval_crud(model_set):
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.evaluate import EvalProcessor
+    assert InitProcessor(model_set).run() == 0
+    assert EvalProcessor(model_set, params={"new_eval": "EvalX"}).run() == 0
+    from shifu_tpu.config import ModelConfig
+    mc = ModelConfig.load(os.path.join(model_set, "ModelConfig.json"))
+    assert any(e.name == "EvalX" for e in mc.evals)
+    assert EvalProcessor(model_set, params={"delete_eval": "EvalX"}).run() == 0
+    mc = ModelConfig.load(os.path.join(model_set, "ModelConfig.json"))
+    assert not any(e.name == "EvalX" for e in mc.evals)
+    assert EvalProcessor(model_set, params={"delete_eval": "nope"}).run() == 1
+
+
+def test_posttrain_bin_avg_scores(model_set):
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+    from shifu_tpu.pipeline.posttrain import PostTrainProcessor
+    from shifu_tpu.config import load_column_configs
+
+    assert InitProcessor(model_set).run() == 0
+    for P in (StatsProcessor, NormalizeProcessor, TrainProcessor):
+        assert P(model_set, params={}).run() == 0
+    assert PostTrainProcessor(model_set, params={}).run() == 0
+    ccs = load_column_configs(os.path.join(model_set, "ColumnConfig.json"))
+    scored = [c for c in ccs if c.columnBinning.binAvgScore]
+    assert scored, "no binAvgScore written"
+    fi_path = os.path.join(model_set, "posttrain", "featureImportance.csv")
+    assert os.path.isfile(fi_path)
+    lines = open(fi_path).read().strip().splitlines()
+    assert len(lines) >= 3
+    # ranked descending
+    vals = [float(l.split("\t")[1]) for l in lines]
+    assert vals == sorted(vals, reverse=True)

@@ -130,8 +130,6 @@ def test_grid_search_expand():
     # shape-changing axis: list of lists
     params2 = {"NumHiddenNodes": [[10], [20, 20]]}
     assert len(grid_search.expand(params2)) == 2
-    groups = grid_search.group_by_shape(grid_search.expand(params2))
-    assert len(groups) == 2
 
 
 def test_minibatch_mode():
