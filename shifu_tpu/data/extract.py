@@ -39,12 +39,18 @@ class ChunkExtractor:
             model_config.evals[for_eval_set].dataSet
         self.ds = ds
         self.purifier = DataPurifier(ds.filterExpressions)
-        self.missing_values = model_config.dataSet.missingOrInvalidValues
+        # eval sets may rename the target / use different tags or missing
+        # markers than the training source (reference EvalConfig dataSet)
+        self.missing_values = ds.missingOrInvalidValues \
+            or model_config.dataSet.missingOrInvalidValues
         if columns is None:
             columns = [c for c in column_configs if c.is_candidate()]
         self.numeric_cols = [c for c in columns if not c.is_categorical()]
         self.categorical_cols = [c for c in columns if c.is_categorical()]
-        self.target_name = model_config.dataSet.targetColumnName
+        self.target_name = ds.targetColumnName \
+            or model_config.dataSet.targetColumnName
+        self.pos_tags = ds.posTags or model_config.dataSet.posTags
+        self.neg_tags = ds.negTags or model_config.dataSet.negTags
         self.weight_name = ds.weightColumnName
 
     def extract(self, chunk: RawChunk, keep_raw: bool = False) -> ExtractedChunk:
@@ -52,7 +58,7 @@ class ChunkExtractor:
         keep = self.purifier.mask(df)
         if self.target_name and self.target_name in df.columns:
             y = tag_to_target(df[self.target_name].to_numpy(),
-                              self.mc.dataSet.posTags, self.mc.dataSet.negTags)
+                              self.pos_tags, self.neg_tags)
             keep &= ~np.isnan(y)  # drop rows with unknown tags
         else:
             y = np.zeros(len(df))

@@ -51,10 +51,14 @@ class PerformanceResult:
     negCount: float = 0.0
 
     def to_dict(self) -> Dict:
+        def clean(v):
+            # NaN is not legal JSON — degenerate (single-class) sweeps
+            # serialize as null
+            return None if isinstance(v, float) and np.isnan(v) else v
         return {
-            "areaUnderRoc": self.areaUnderRoc,
-            "weightedAuc": self.weightedAuc,
-            "areaUnderPr": self.areaUnderPr,
+            "areaUnderRoc": clean(self.areaUnderRoc),
+            "weightedAuc": clean(self.weightedAuc),
+            "areaUnderPr": clean(self.areaUnderPr),
             "recordCount": self.recordCount,
             "posCount": self.posCount,
             "negCount": self.negCount,

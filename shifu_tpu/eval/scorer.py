@@ -69,8 +69,19 @@ class Scorer:
             raise FileNotFoundError(f"no model files in {models_dir}")
         return cls(models, scale)
 
-    def score(self, x: np.ndarray) -> CaseScoreResult:
-        cols = [np.asarray(m.compute(x))[:, 0] for m in self.models]
+    def score(self, x: np.ndarray,
+              bins: Optional[np.ndarray] = None) -> CaseScoreResult:
+        """Tree models consume the binned matrix (``input_kind == 'bins'``),
+        NN/LR the normalized floats — both come from one transform pass."""
+        cols = []
+        for m in self.models:
+            if getattr(m, "input_kind", "norm") == "bins":
+                if bins is None:
+                    raise ValueError("tree model requires binned input — "
+                                     "pass bins= to Scorer.score")
+                cols.append(np.asarray(m.compute(bins))[:, 0])
+            else:
+                cols.append(np.asarray(m.compute(x))[:, 0])
         raw = np.stack(cols, axis=1) * self.scale
         return CaseScoreResult(scores=raw, mean=raw.mean(axis=1),
                                max=raw.max(axis=1), min=raw.min(axis=1),
@@ -90,6 +101,6 @@ class ModelRunner:
 
     def compute(self, chunk) -> Dict[str, np.ndarray]:
         tc = self.transformer.transform(chunk)
-        res = self.scorer.score(tc.x)
+        res = self.scorer.score(tc.x, bins=tc.bins)
         return {"result": res, "target": tc.target, "weight": tc.weight,
                 "n": tc.n}

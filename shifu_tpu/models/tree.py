@@ -1,0 +1,116 @@
+"""Tree-ensemble model spec (GBT / RF) — reference
+``dt/IndependentTreeModel.java`` + ``BinaryDTSerializer``: a saved forest
+scores standalone.
+
+Trees live as complete-binary arrays (split_feat / per-bin left_mask /
+leaf_value), so scoring is `depth` gathers over the whole batch — no
+per-row recursion.  Input is the binned int matrix (the cleaned data plane);
+bin boundaries/categories needed to bin raw data travel in ColumnConfig, and
+eval's ModelRunner already produces bins for every row.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ops.tree import TreeArrays, predict_tree
+
+
+@dataclass
+class TreeModelSpec:
+    algorithm: str                      # "GBT" | "RF"
+    n_trees: int
+    depth: int
+    n_bins: int
+    loss: str = "squared"               # GBT leaf-to-score link
+    learning_rate: float = 0.1          # GBT shrinkage
+    init_score: float = 0.0             # GBT prior (f_0)
+    column_nums: Optional[List[int]] = None
+    feature_names: Optional[List[str]] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps({"version": 1, "kind": "tree",
+                           "algorithm": self.algorithm, "n_trees": self.n_trees,
+                           "depth": self.depth, "n_bins": self.n_bins,
+                           "loss": self.loss, "learning_rate": self.learning_rate,
+                           "init_score": self.init_score,
+                           "column_nums": self.column_nums,
+                           "feature_names": self.feature_names,
+                           "extra": self.extra})
+
+    @classmethod
+    def from_json(cls, s: str) -> "TreeModelSpec":
+        d = json.loads(s)
+        return cls(algorithm=d["algorithm"], n_trees=d["n_trees"],
+                   depth=d["depth"], n_bins=d["n_bins"],
+                   loss=d.get("loss", "squared"),
+                   learning_rate=d.get("learning_rate", 0.1),
+                   init_score=d.get("init_score", 0.0),
+                   column_nums=d.get("column_nums"),
+                   feature_names=d.get("feature_names"),
+                   extra=d.get("extra", {}))
+
+
+def save_model(path: str, spec: TreeModelSpec, trees: List[TreeArrays]) -> None:
+    arrays = {"__spec__": np.frombuffer(spec.to_json().encode(), np.uint8)}
+    for i, t in enumerate(trees):
+        arrays[f"sf{i}"] = t.split_feat
+        arrays[f"lm{i}"] = np.packbits(t.left_mask, axis=1)
+        arrays[f"lv{i}"] = t.leaf_value
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+
+def load_model(path: str) -> Tuple[TreeModelSpec, List[TreeArrays]]:
+    data = np.load(path)
+    spec = TreeModelSpec.from_json(bytes(data["__spec__"]).decode())
+    trees = []
+    for i in range(spec.n_trees):
+        lm = np.unpackbits(data[f"lm{i}"], axis=1)[:, :spec.n_bins].astype(bool)
+        trees.append(TreeArrays(split_feat=data[f"sf{i}"], left_mask=lm,
+                                leaf_value=data[f"lv{i}"], depth=spec.depth))
+    return spec, trees
+
+
+class IndependentTreeModel:
+    """Standalone forest scorer (reference ``IndependentTreeModel.compute``).
+    ``input_kind = 'bins'``: consumes the binned int matrix."""
+
+    input_kind = "bins"
+
+    def __init__(self, spec: TreeModelSpec, trees: List[TreeArrays]):
+        self.spec = spec
+        self.trees = trees
+
+    @classmethod
+    def load(cls, path: str) -> "IndependentTreeModel":
+        return cls(*load_model(path))
+
+    def compute(self, bins: np.ndarray) -> np.ndarray:
+        b = jnp.asarray(bins, jnp.int32)
+        preds = np.stack([
+            np.asarray(predict_tree(jnp.asarray(t.split_feat),
+                                    jnp.asarray(t.left_mask),
+                                    jnp.asarray(t.leaf_value), b, t.depth))
+            for t in self.trees], axis=0)
+        if self.spec.algorithm == "GBT":
+            f = self.spec.init_score + self.spec.learning_rate * preds.sum(axis=0)
+            if self.spec.loss == "log":
+                out = 1.0 / (1.0 + np.exp(-f))
+            else:
+                out = np.clip(f, 0.0, 1.0)
+            return out[:, None].astype(np.float32)
+        # RF: mean leaf pos-rate across trees
+        return preds.mean(axis=0)[:, None].astype(np.float32)

@@ -1,0 +1,244 @@
+"""Decision-tree kernels: histogram build + split-gain scan + batched predict.
+
+Reference mapping (``core/dtrain/dt/``):
+- per-(node,feature,bin) stats accumulation (``DTWorker.java:763-884``, the
+  thread-parallel ``impurity.featureUpdate`` hot loop at ``:844-854``) →
+  one ``segment_sum`` scatter-add per feature over the whole row shard, all
+  features vmapped;
+- ``Impurity.computeImpurity`` split scan (``dt/Impurity.java:38-734``:
+  Variance:106, FriedmanMSE:255, Entropy:368, Gini:553) → vectorized prefix
+  sums over the bin axis for every (node, feature) at once;
+- categorical splits sort bins by response rate then scan prefixes
+  (``Impurity.java:33`` comment) → per-(node,feature) ``argsort`` + gather;
+- trees are complete binary arrays with positional ids (``dt/Node.java``
+  ``indexToLevel`` layout): ``split_feat[node]``, per-bin ``left_mask`` —
+  one uniform representation for numeric (bin <= k) and categorical
+  (bin-subset) splits (``dt/Split.java`` numeric threshold / SimpleBitSet).
+
+Everything is binned (int bins from the cleaned data plane), so a split is
+always "bin ∈ left set" — scoring never touches raw floats.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-12
+
+
+@dataclass
+class TreeArrays:
+    """Complete binary tree, node i's children at 2i+1 / 2i+2."""
+    split_feat: np.ndarray   # [nodes] int32, -1 = leaf
+    left_mask: np.ndarray    # [nodes, n_bins] bool: bin goes left
+    leaf_value: np.ndarray   # [nodes] float32
+    depth: int
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.split_feat)
+
+
+def n_tree_nodes(depth: int) -> int:
+    return (1 << (depth + 1)) - 1
+
+
+# ------------------------------------------------------------- histograms
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins"))
+def build_histograms(bins, node_idx, stats, n_nodes: int, n_bins: int):
+    """Scatter-add per-row stats into (node, feature, bin) cells.
+
+    bins: [N, C] int32; node_idx: [N] int32 level-local (-1 = inactive);
+    stats: [N, S] float32 (S stat channels, e.g. [w, w*y, w*y^2]).
+    Returns [n_nodes, C, n_bins, S].
+    """
+    active = node_idx >= 0
+    seg_base = jnp.where(active, node_idx, 0) * n_bins
+    masked = stats * active[:, None].astype(stats.dtype)
+
+    def per_feature(bcol):
+        idx = seg_base + bcol
+        return jax.ops.segment_sum(masked, idx, num_segments=n_nodes * n_bins)
+
+    out = jax.vmap(per_feature, in_axes=1)(bins)        # [C, nodes*bins, S]
+    c = bins.shape[1]
+    return out.reshape(c, n_nodes, n_bins, -1).transpose(1, 0, 2, 3)
+
+
+# ------------------------------------------------------------- split scan
+def _impurity_score(w, wy, wy2, kind: str):
+    """Per-partition purity score; gain = score_L + score_R - score_P.
+    variance/friedman use sum^2/weight (equivalent to SSE reduction);
+    entropy/gini use binary class counts (pos = wy, neg = w - wy)."""
+    if kind in ("variance", "friedmanmse"):
+        return wy * wy / jnp.maximum(w, EPS)
+    pos = jnp.clip(wy, 0.0, None)
+    neg = jnp.clip(w - wy, 0.0, None)
+    tot = jnp.maximum(pos + neg, EPS)
+    p = pos / tot
+    if kind == "entropy":
+        h = -(jnp.where(p > 0, p * jnp.log2(jnp.maximum(p, EPS)), 0.0)
+              + jnp.where(1 - p > 0, (1 - p) * jnp.log2(jnp.maximum(1 - p, EPS)),
+                          0.0))
+        return -tot * h
+    if kind == "gini":
+        return -tot * 2.0 * p * (1 - p)
+    raise ValueError(f"unknown impurity {kind!r}")
+
+
+@partial(jax.jit, static_argnames=("impurity",))
+def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
+                min_instances: float = 1.0, min_gain: float = 0.0):
+    """Best split per node from the level histogram.
+
+    hist: [nodes, C, B, 3] (w, wy, wy2); cat_mask: [C] bool (categorical →
+    bins sorted by response before the prefix scan); feat_active: [C] bool
+    (feature sub-sampling, reference featureSubsetStrategy).
+
+    Returns (gain [nodes], feat [nodes], left_mask [nodes, B],
+             leaf_value [nodes], node_w [nodes]).
+    """
+    w, wy, wy2 = hist[..., 0], hist[..., 1], hist[..., 2]
+    n_nodes, c, b = w.shape
+
+    # ---- per-(node,feat) bin order: natural for numeric, response-sorted
+    # for categorical (empty bins pushed last so prefixes skip them)
+    rate = wy / jnp.maximum(w, EPS)
+    sort_key = jnp.where(w > 0, -rate, jnp.inf)
+    cat_order = jnp.argsort(sort_key, axis=-1)            # [nodes, C, B]
+    nat_order = jnp.broadcast_to(jnp.arange(b), (n_nodes, c, b))
+    order = jnp.where(cat_mask[None, :, None], cat_order, nat_order)
+
+    w_o = jnp.take_along_axis(w, order, axis=-1)
+    wy_o = jnp.take_along_axis(wy, order, axis=-1)
+    wy2_o = jnp.take_along_axis(wy2, order, axis=-1)
+
+    cw = jnp.cumsum(w_o, axis=-1)
+    cwy = jnp.cumsum(wy_o, axis=-1)
+    cwy2 = jnp.cumsum(wy2_o, axis=-1)
+    tw, twy, twy2 = cw[..., -1:], cwy[..., -1:], cwy2[..., -1:]
+
+    score_l = _impurity_score(cw, cwy, cwy2, impurity)
+    score_r = _impurity_score(tw - cw, twy - cwy, twy2 - cwy2, impurity)
+    score_p = _impurity_score(tw, twy, twy2, impurity)
+    gain = score_l + score_r - score_p                     # [nodes, C, B]
+
+    valid = (cw >= min_instances) & (tw - cw >= min_instances)
+    valid = valid & feat_active[None, :, None]
+    valid = valid.at[..., -1].set(False)                   # full prefix = no split
+    gain = jnp.where(valid, gain, -jnp.inf)
+
+    best_k = jnp.argmax(gain, axis=-1)                     # [nodes, C]
+    best_gain_f = jnp.take_along_axis(gain, best_k[..., None], axis=-1)[..., 0]
+    best_feat = jnp.argmax(best_gain_f, axis=-1)           # [nodes]
+    node_gain = jnp.take_along_axis(best_gain_f, best_feat[:, None],
+                                    axis=-1)[:, 0]
+
+    # ---- build left_mask for the winning (feat, k): order[:k+1] goes left
+    k_sel = jnp.take_along_axis(best_k, best_feat[:, None], axis=-1)  # [nodes,1]
+    order_sel = jnp.take_along_axis(
+        order, best_feat[:, None, None], axis=1)[:, 0]     # [nodes, B]
+    ranks = jnp.argsort(order_sel, axis=-1)                # bin -> position
+    left_mask = ranks <= k_sel
+
+    node_w = tw[..., 0, 0]
+    leaf_value = twy[..., 0, 0] / jnp.maximum(node_w, EPS)
+    ok = jnp.isfinite(node_gain) & (node_gain > min_gain)
+    feat = jnp.where(ok, best_feat, -1)
+    return node_gain, feat.astype(jnp.int32), left_mask & ok[:, None], \
+        leaf_value, node_w
+
+
+# ------------------------------------------------------------------ grow
+def grow_tree(bins, targets, weights, n_bins: int, depth: int,
+              impurity: str = "variance", min_instances: float = 1.0,
+              min_gain: float = 0.0, cat_mask: Optional[np.ndarray] = None,
+              feat_active: Optional[np.ndarray] = None) -> TreeArrays:
+    """Level-wise growth (reference ``DTMaster.java:543-600`` level mode):
+    every node of a level splits in one histogram+scan step; the per-row
+    node index update is the worker's tree traversal."""
+    n, c = bins.shape
+    bins = jnp.asarray(bins, jnp.int32)
+    t = jnp.asarray(targets, jnp.float32)
+    wt = jnp.asarray(weights, jnp.float32)
+    stats = jnp.stack([wt, wt * t, wt * t * t], axis=1)
+    cat = jnp.zeros(c, bool) if cat_mask is None else jnp.asarray(cat_mask)
+    fa = jnp.ones(c, bool) if feat_active is None else jnp.asarray(feat_active)
+
+    total = n_tree_nodes(depth)
+    split_feat = np.full(total, -1, np.int32)
+    left_mask = np.zeros((total, n_bins), bool)
+    leaf_value = np.zeros(total, np.float32)
+
+    node_idx = jnp.zeros(n, jnp.int32)       # level-local position, -1 done
+    for level in range(depth + 1):
+        n_nodes = 1 << level
+        hist = build_histograms(bins, node_idx, stats, n_nodes, n_bins)
+        gain, feat, lmask, leaf, node_w = best_splits(
+            hist, cat, fa, impurity, min_instances, min_gain)
+        feat = np.asarray(feat)
+        lmask = np.asarray(lmask)
+        leaf = np.asarray(leaf)
+        base = n_nodes - 1                   # global id of level start
+        is_last = level == depth
+        for i in range(n_nodes):
+            g = base + i
+            leaf_value[g] = leaf[i]
+            if not is_last and feat[i] >= 0:
+                split_feat[g] = feat[i]
+                left_mask[g] = lmask[i]
+        if is_last:
+            break
+        # rows whose node didn't split freeze; others descend
+        feat_d = jnp.asarray(feat)
+        lmask_d = jnp.asarray(lmask)
+        node_feat = feat_d[jnp.maximum(node_idx, 0)]
+        active = (node_idx >= 0) & (node_feat >= 0)
+        row_bin = jnp.take_along_axis(
+            bins, jnp.maximum(node_feat, 0)[:, None], axis=1)[:, 0]
+        goes_left = lmask_d[jnp.maximum(node_idx, 0), row_bin]
+        node_idx = jnp.where(active,
+                             2 * node_idx + jnp.where(goes_left, 0, 1), -1)
+        if not bool(jnp.any(node_idx >= 0)):
+            break
+    return TreeArrays(split_feat=split_feat, left_mask=left_mask,
+                      leaf_value=leaf_value, depth=depth)
+
+
+# ---------------------------------------------------------------- predict
+@partial(jax.jit, static_argnames=("depth",))
+def predict_tree(split_feat, left_mask, leaf_value, bins, depth: int):
+    """Batched traversal: one gather per level over all rows."""
+    n = bins.shape[0]
+    node = jnp.zeros(n, jnp.int32)           # global node ids
+    for _ in range(depth):
+        feat = split_feat[node]
+        is_split = feat >= 0
+        row_bin = jnp.take_along_axis(
+            bins, jnp.maximum(feat, 0)[:, None], axis=1)[:, 0]
+        goes_left = left_mask[node, row_bin]
+        child = jnp.where(goes_left, 2 * node + 1, 2 * node + 2)
+        node = jnp.where(is_split, child, node)
+    return leaf_value[node]
+
+
+def predict_forest(trees, bins, weights=None) -> np.ndarray:
+    """Weighted-average forest prediction (RF mean vote / GBT partial sums
+    are built by the caller)."""
+    bins = jnp.asarray(bins, jnp.int32)
+    preds = [np.asarray(predict_tree(jnp.asarray(t.split_feat),
+                                     jnp.asarray(t.left_mask),
+                                     jnp.asarray(t.leaf_value),
+                                     bins, t.depth)) for t in trees]
+    preds = np.stack(preds, axis=0)
+    if weights is None:
+        return preds.mean(axis=0)
+    w = np.asarray(weights)[:, None]
+    return (preds * w).sum(axis=0)

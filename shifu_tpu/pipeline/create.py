@@ -92,10 +92,6 @@ class InitProcessor(BasicProcessor):
                  sum(c.is_categorical() for c in configs), len(meta))
         return 0
 
-    def _abs(self, p: Optional[str]) -> Optional[str]:
-        if p is None:
-            return None
-        return p if os.path.isabs(p) else os.path.normpath(os.path.join(self.dir, p))
 
     def _auto_type(self, source: DataSource, configs: List[ColumnConfig],
                    sample_rows: int = 200_000) -> None:

@@ -60,7 +60,8 @@ class EvalProcessor(BasicProcessor):
         for f in ("dataPath", "dataDelimiter", "headerPath", "headerDelimiter",
                   "targetColumnName", "posTags", "negTags", "missingOrInvalidValues",
                   "weightColumnName"):
-            setattr(ev.dataSet, f, getattr(base, f))
+            v = getattr(base, f)
+            setattr(ev.dataSet, f, list(v) if isinstance(v, list) else v)
         self.model_config.evals.append(ev)
         self.save_model_config()
         log.info("created eval set %s", name)
@@ -122,12 +123,16 @@ class EvalProcessor(BasicProcessor):
                 all_scores.append(chosen)
                 all_targets.append(out["target"])
                 all_weights.append(out["weight"])
-                for r in range(out["n"]):
-                    w.writerow([int(out["target"][r]), out["weight"][r],
-                                f"{res.mean[r]:.3f}", f"{res.max[r]:.3f}",
-                                f"{res.min[r]:.3f}", f"{res.median[r]:.3f}"]
-                               + [f"{res.scores[r, m]:.3f}"
-                                  for m in range(n_models)])
+                # vectorized row formatting — the scoring is batched, the
+                # writing must not be the hot loop
+                block = np.column_stack(
+                    [out["target"].astype(int).astype(str),
+                     out["weight"].astype(str)]
+                    + [np.char.mod("%.3f", col) for col in
+                       (res.mean, res.max, res.min, res.median)]
+                    + [np.char.mod("%.3f", res.scores[:, m])
+                       for m in range(n_models)])
+                w.writerows(block.tolist())
         if not all_scores:
             log.error("eval %s: no records scored", ev.name)
             return 1
@@ -173,7 +178,3 @@ class EvalProcessor(BasicProcessor):
             w.writeheader()
             w.writerows(rows)
 
-    def _abs(self, p: Optional[str]) -> Optional[str]:
-        if p is None:
-            return None
-        return p if os.path.isabs(p) else os.path.normpath(os.path.join(self.dir, p))

@@ -106,7 +106,3 @@ class NormalizeProcessor(BasicProcessor):
             sel = perm[splits[i]]
             np.savez(os.path.join(d, f), **{k: merged[k][sel] for k in keys})
 
-    def _abs(self, p: Optional[str]) -> Optional[str]:
-        if p is None:
-            return None
-        return p if os.path.isabs(p) else os.path.normpath(os.path.join(self.dir, p))

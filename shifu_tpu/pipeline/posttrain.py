@@ -39,8 +39,8 @@ class PostTrainProcessor(BasicProcessor):
         sums: Dict[int, np.ndarray] = {}
         counts: Dict[int, np.ndarray] = {}
         for nshard, cshard in zip(norm.iter_shards(), clean.iter_shards()):
-            scores = scorer.score(nshard["x"]).mean
             bins = cshard["bins"]
+            scores = scorer.score(nshard["x"], bins=bins.astype(np.int32)).mean
             for j, cnum in enumerate(col_nums):
                 cc = by_num.get(cnum)
                 if cc is None:

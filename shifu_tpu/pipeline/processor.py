@@ -50,6 +50,13 @@ class BasicProcessor:
                 f"{cc_path} not found — run `shifu-tpu init` first")
         self.paths.ensure_dirs()
 
+    def _abs(self, p: Optional[str]) -> Optional[str]:
+        """Resolve a config-relative path against the model-set dir."""
+        if p is None:
+            return None
+        return p if os.path.isabs(p) else os.path.normpath(
+            os.path.join(self.dir, p))
+
     def save_column_configs(self) -> None:
         save_column_configs(self.column_configs, self.paths.column_config_path)
 

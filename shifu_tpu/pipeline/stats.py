@@ -98,10 +98,6 @@ class StatsProcessor(BasicProcessor):
                  total_rows, len(num_cols), len(cat_cols))
         return 0
 
-    def _abs(self, p: Optional[str]) -> Optional[str]:
-        if p is None:
-            return None
-        return p if os.path.isabs(p) else os.path.normpath(os.path.join(self.dir, p))
 
     # ------------------------------------------------------------- numeric
     def _finalize_numeric(self, num_cols: List[ColumnConfig],

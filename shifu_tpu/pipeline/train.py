@@ -188,9 +188,10 @@ class TrainProcessor(BasicProcessor):
         (reference ``NNMaster.java:331-362``; structure fit-in not yet)."""
         if not self.model_config.train.isContinuous:
             return None
+        ext = alg.name.lower() if alg != Algorithm.SVM else "lr"
         init = []
         for i in range(n_members):
-            path = self.paths.model_path(i, alg.name.lower())
+            path = self.paths.model_path(i, ext)
             if not os.path.isfile(path):
                 return None
             old_spec, params = nn_model.load_model(path)

@@ -1,0 +1,183 @@
+"""Tree kernels + GBT/RF trainer tests (reference ``core/dtrain/DTTest.java``
+pattern, on the virtual 8-device mesh)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.ops.tree import (TreeArrays, best_splits, build_histograms,
+                                grow_tree, n_tree_nodes, predict_tree)
+from shifu_tpu.train.dt_trainer import (DTSettings, subset_count, train_gbt,
+                                        train_rf)
+from shifu_tpu.models import tree as tree_model
+
+import jax.numpy as jnp
+
+
+def test_histograms_scatter_add():
+    bins = np.array([[0, 1], [1, 1], [2, 0], [0, 0]], np.int32)
+    node = np.array([0, 0, 1, -1], np.int32)          # row 3 inactive
+    stats = np.stack([np.ones(4), np.array([1., 0., 1., 5.]),
+                      np.zeros(4)], axis=1).astype(np.float32)
+    h = np.asarray(build_histograms(jnp.asarray(bins), jnp.asarray(node),
+                                    jnp.asarray(stats), 2, 3))
+    assert h.shape == (2, 2, 3, 3)
+    # node 0, feature 0: rows 0 (bin0) and 1 (bin1)
+    assert h[0, 0, 0, 0] == 1 and h[0, 0, 1, 0] == 1
+    assert h[0, 0, 0, 1] == 1.0 and h[0, 0, 1, 1] == 0.0
+    # node 1, feature 0: row 2 at bin 2
+    assert h[1, 0, 2, 0] == 1 and h[1, 0, 2, 1] == 1.0
+    # inactive row contributed nowhere
+    assert h[..., 0].sum() == 3 * 2  # 3 active rows x 2 features
+
+
+def test_perfect_numeric_split():
+    """y determined by bin <= 1 on feature 0 — tree must find it."""
+    rng = np.random.default_rng(0)
+    bins = rng.integers(0, 4, size=(400, 3)).astype(np.int32)
+    y = (bins[:, 0] <= 1).astype(np.float64)
+    w = np.ones(400)
+    t = grow_tree(bins, y, w, 4, depth=2, impurity="variance")
+    assert t.split_feat[0] == 0
+    pred = np.asarray(predict_tree(jnp.asarray(t.split_feat),
+                                   jnp.asarray(t.left_mask),
+                                   jnp.asarray(t.leaf_value),
+                                   jnp.asarray(bins), 2))
+    np.testing.assert_allclose(pred, y, atol=1e-6)
+
+
+def test_categorical_split_nonconsecutive():
+    """Categorical feature where categories {0, 2} are positive — a
+    bin-subset split numeric prefixes can't express."""
+    rng = np.random.default_rng(1)
+    bins = rng.integers(0, 4, size=(600, 2)).astype(np.int32)
+    y = np.isin(bins[:, 0], [0, 2]).astype(np.float64)
+    w = np.ones(600)
+    cat = np.array([True, False])
+    t = grow_tree(bins, y, w, 4, depth=1, impurity="variance", cat_mask=cat)
+    assert t.split_feat[0] == 0
+    pred = np.asarray(predict_tree(jnp.asarray(t.split_feat),
+                                   jnp.asarray(t.left_mask),
+                                   jnp.asarray(t.leaf_value),
+                                   jnp.asarray(bins), 1))
+    np.testing.assert_allclose(pred, y, atol=1e-6)
+    # left set is exactly {0, 2}
+    assert set(np.flatnonzero(t.left_mask[0])) == {0, 2}
+
+
+@pytest.mark.parametrize("impurity", ["variance", "entropy", "gini",
+                                      "friedmanmse"])
+def test_impurities_find_signal(impurity):
+    rng = np.random.default_rng(2)
+    bins = rng.integers(0, 8, size=(1000, 4)).astype(np.int32)
+    y = (bins[:, 2] >= 4).astype(np.float64)
+    t = grow_tree(bins, y, np.ones(1000), 8, depth=1, impurity=impurity)
+    assert t.split_feat[0] == 2
+
+
+def test_min_instances_blocks_tiny_split():
+    bins = np.array([[0], [1], [1], [1]], np.int32)
+    y = np.array([1.0, 0.0, 0.0, 0.0])
+    t = grow_tree(bins, y, np.ones(4), 2, depth=1, min_instances=2.0)
+    assert t.split_feat[0] == -1          # the 1-row split is disallowed
+
+
+def test_gbt_reduces_error_and_beats_single_tree():
+    rng = np.random.default_rng(3)
+    n = 3000
+    bins = rng.integers(0, 16, size=(n, 6)).astype(np.int32)
+    logit = (bins[:, 0] / 8.0 - 1) + ((bins[:, 1] > 8) & (bins[:, 2] < 4)) * 1.5
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float64)
+    s = DTSettings(n_trees=20, depth=4, loss="log", learning_rate=0.3,
+                   valid_rate=0.2, seed=0)
+    res = train_gbt(bins, y, np.ones(n), 16, np.zeros(6, bool), s)
+    assert res.trees_built == 20
+    errs = [h[1] for h in res.history]
+    assert errs[-1] < errs[0] * 0.98
+    assert res.feature_importance[:3].sum() > res.feature_importance[3:].sum()
+
+
+def test_rf_oob_error_reasonable():
+    rng = np.random.default_rng(4)
+    n = 2000
+    bins = rng.integers(0, 8, size=(n, 5)).astype(np.int32)
+    y = ((bins[:, 0] >= 4) ^ (bins[:, 1] < 2)).astype(np.float64)
+    s = DTSettings(n_trees=10, depth=5, impurity="gini",
+                   feature_subset="ALL", seed=0)
+    res = train_rf(bins, y, np.ones(n), 8, np.zeros(5, bool), s)
+    assert res.trees_built == 10
+    assert res.valid_error < 0.2          # oob mse well below chance 0.25
+
+
+def test_feature_subset_counts():
+    assert subset_count("ALL", 100) == 100
+    assert subset_count("HALF", 100) == 50
+    assert subset_count("SQRT", 100) == 10
+    assert subset_count("LOG2", 100) == 6
+    assert subset_count("ONETHIRD", 100) == 33
+
+
+def test_tree_model_save_load_roundtrip(tmp_path):
+    rng = np.random.default_rng(5)
+    bins = rng.integers(0, 8, size=(500, 4)).astype(np.int32)
+    y = (bins[:, 1] >= 4).astype(np.float64)
+    s = DTSettings(n_trees=5, depth=3, loss="squared", learning_rate=0.5)
+    res = train_gbt(bins, y, np.ones(500), 8, np.zeros(4, bool), s)
+    spec = tree_model.TreeModelSpec(n_trees=len(res.trees), depth=3, n_bins=8,
+                                    **res.spec_kwargs)
+    path = os.path.join(tmp_path, "model0.gbt")
+    tree_model.save_model(path, spec, res.trees)
+    m = tree_model.IndependentTreeModel.load(path)
+    pred = m.compute(bins)[:, 0]
+    assert pred.shape == (500,)
+    # roundtripped model still separates the classes
+    assert pred[y == 1].mean() > pred[y == 0].mean() + 0.3
+
+
+def test_gbt_pipeline_end_to_end(model_set):
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.config.model_config import Algorithm
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+    from shifu_tpu.pipeline.evaluate import EvalProcessor
+    import json
+
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.train.algorithm = Algorithm.GBT
+    mc.train.params = {"TreeNum": 15, "MaxDepth": 4, "Loss": "log",
+                       "LearningRate": 0.3}
+    mc.save(mc_path)
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    assert TrainProcessor(model_set, params={}).run() == 0
+    assert os.path.isfile(os.path.join(model_set, "models", "model0.gbt"))
+    assert EvalProcessor(model_set, params={"run_eval": ""}).run() == 0
+    perf = json.load(open(os.path.join(model_set, "evals", "Eval1",
+                                       "EvalPerformance.json")))
+    assert perf["areaUnderRoc"] > 0.75
+
+
+def test_rf_pipeline_end_to_end(model_set):
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.config.model_config import Algorithm
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.train.algorithm = Algorithm.RF
+    mc.train.params = {"TreeNum": 8, "MaxDepth": 5,
+                       "FeatureSubsetStrategy": "TWOTHIRDS"}
+    mc.save(mc_path)
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    assert TrainProcessor(model_set, params={}).run() == 0
+    assert os.path.isfile(os.path.join(model_set, "models", "model0.rf"))
