@@ -1,0 +1,150 @@
+"""`combo` step — reference ``ComboModelProcessor.java``: multi-algorithm
+ensemble.  ``combo new -alg NN:GBT:LR`` records the member algorithms;
+``combo run`` trains one sub-model set per algorithm (sharing the parent's
+stats/ColumnConfig); ``combo eval`` scores every member on the eval sets and
+reports the assembled (mean) performance.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+from typing import List, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+COMBO_FILE = "combo.json"
+
+
+def run_combo(model_set_dir: str, action: str, algs: Optional[str]) -> int:
+    d = os.path.abspath(model_set_dir)
+    if action == "new":
+        if not algs:
+            log.error("combo new requires -alg A:B:C")
+            return 1
+        members = [a.strip().upper() for a in algs.split(":") if a.strip()]
+        with open(os.path.join(d, COMBO_FILE), "w") as f:
+            json.dump({"algorithms": members}, f, indent=2)
+        log.info("combo: %s", members)
+        return 0
+
+    combo_path = os.path.join(d, COMBO_FILE)
+    if not os.path.isfile(combo_path):
+        log.error("no %s — run `combo new -alg ...` first", COMBO_FILE)
+        return 1
+    members: List[str] = json.load(open(combo_path))["algorithms"]
+
+    if action == "init":
+        return _init_members(d, members)
+    if action == "run":
+        rc = _init_members(d, members)
+        if rc:
+            return rc
+        return _train_members(d, members)
+    if action == "eval":
+        return _eval_members(d, members)
+    log.error("unknown combo action %s", action)
+    return 1
+
+
+def _member_dir(d: str, alg: str, i: int) -> str:
+    return os.path.join(d, f"combo_{i}_{alg}")
+
+
+def _init_members(d: str, members: List[str]) -> int:
+    """Each member = a sub model-set dir sharing the parent's configs/stats
+    but with its own train.algorithm (reference sub-model dirs)."""
+    from ..config import ModelConfig
+    for i, alg in enumerate(members):
+        md = _member_dir(d, alg, i)
+        os.makedirs(md, exist_ok=True)
+        mc = ModelConfig.load(os.path.join(d, "ModelConfig.json"))
+        from ..config.model_config import Algorithm
+        mc.train.algorithm = Algorithm[alg]
+        mc.basic.name = f"{mc.basic.name}_{alg}{i}"
+        # member-specific defaults: trees for DT family, nets for NN
+        if alg in ("GBT", "RF", "DT"):
+            mc.train.params = {k: v for k, v in (mc.train.params or {}).items()
+                               if k in ("TreeNum", "MaxDepth", "LearningRate",
+                                        "Loss", "Impurity")}
+        mc.save(os.path.join(md, "ModelConfig.json"))
+        shutil.copy(os.path.join(d, "ColumnConfig.json"),
+                    os.path.join(md, "ColumnConfig.json"))
+    log.info("combo init: %d member dirs", len(members))
+    return 0
+
+
+def _train_members(d: str, members: List[str]) -> int:
+    from .norm import NormalizeProcessor
+    from .train import TrainProcessor
+    for i, alg in enumerate(members):
+        md = _member_dir(d, alg, i)
+        log.info("combo: training member %d (%s)", i, alg)
+        rc = NormalizeProcessor(md, params={}).run()
+        if rc == 0:
+            rc = TrainProcessor(md, params={}).run()
+        if rc:
+            log.error("combo member %d (%s) failed", i, alg)
+            return rc
+    return 0
+
+
+def _eval_members(d: str, members: List[str]) -> int:
+    """Score each member on the parent's eval sets; assemble by mean
+    (reference assembles sub-model scores into a combined score column)."""
+    from ..config import ModelConfig, load_column_configs
+    from ..data import DataSource
+    from ..eval.metrics import evaluate_scores
+    from ..eval.scorer import ModelRunner, Scorer
+
+    mc = ModelConfig.load(os.path.join(d, "ModelConfig.json"))
+    ccs = load_column_configs(os.path.join(d, "ColumnConfig.json"))
+    rc = 0
+    for ei, ev in enumerate(mc.evals):
+        ds = ev.dataSet
+        if not ds.dataPath:
+            continue
+        member_scores = []
+        targets = weights = None
+        for i, alg in enumerate(members):
+            md = _member_dir(d, alg, i)
+            scorer = Scorer.from_dir(os.path.join(md, "models"))
+            runner = ModelRunner(mc, ccs, scorer.models, for_eval_set=ei)
+            path = ds.dataPath if os.path.isabs(ds.dataPath) else \
+                os.path.normpath(os.path.join(d, ds.dataPath))
+            source = DataSource(path, ds.dataDelimiter)
+            s_parts, t_parts, w_parts = [], [], []
+            for chunk in source.iter_chunks():
+                out = runner.compute(chunk)
+                if out["n"] == 0:
+                    continue
+                s_parts.append(out["result"].mean)
+                t_parts.append(out["target"])
+                w_parts.append(out["weight"])
+            member_scores.append(np.concatenate(s_parts))
+            if targets is None:
+                targets = np.concatenate(t_parts)
+                weights = np.concatenate(w_parts)
+        assembled = np.mean(np.stack(member_scores), axis=0)
+        res = evaluate_scores(assembled, targets, weights,
+                              buckets=ev.performanceBucketNum)
+        out_path = os.path.join(d, f"ComboEval.{ev.name}.json")
+        doc = res.to_dict()
+        doc["members"] = members
+        per_member = []
+        for i, (alg, ms) in enumerate(zip(members, member_scores)):
+            m_res = evaluate_scores(ms, targets, weights)
+            per_member.append({"member": f"{i}:{alg}",
+                               "areaUnderRoc": m_res.to_dict()["areaUnderRoc"]})
+        doc["memberAuc"] = per_member
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2)
+        log.info("combo eval %s: assembled AUC %.6f (members: %s)", ev.name,
+                 res.areaUnderRoc,
+                 {p["member"]: round(p["areaUnderRoc"], 4) if p["areaUnderRoc"]
+                  else None for p in per_member})
+    return rc

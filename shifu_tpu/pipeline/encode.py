@@ -1,0 +1,96 @@
+"""`encode` step — reference ``ModelDataEncodeProcessor.java``: re-emit a
+dataset with each row encoded as the tree-leaf index per tree of a trained
+forest (feature crosses for downstream linear models).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..config.validator import ModelStep
+from ..data import DataSource
+from ..data.transform import DatasetTransformer
+from ..models import load_any
+from ..ops.tree import predict_tree
+from .processor import BasicProcessor
+
+log = logging.getLogger(__name__)
+
+
+def leaf_indices(trees, bins: np.ndarray) -> np.ndarray:
+    """[n, n_trees] terminal-node id per tree (same traversal as predict,
+    returning the node instead of its value)."""
+    b = jnp.asarray(bins, jnp.int32)
+    cols = []
+    for t in trees:
+        sf = jnp.asarray(t.split_feat)
+        lm = jnp.asarray(t.left_mask)
+        node = jnp.zeros(bins.shape[0], jnp.int32)
+        for _ in range(t.depth):
+            feat = sf[node]
+            is_split = feat >= 0
+            row_bin = jnp.take_along_axis(
+                b, jnp.maximum(feat, 0)[:, None], axis=1)[:, 0]
+            child = jnp.where(lm[node, row_bin], 2 * node + 1, 2 * node + 2)
+            node = jnp.where(is_split, child, node)
+        cols.append(np.asarray(node))
+    return np.stack(cols, axis=1)
+
+
+class EncodeProcessor(BasicProcessor):
+    step = ModelStep.EVAL
+
+    def process(self) -> int:
+        mc = self.model_config
+        model_path = self.paths.model_path(0, None)
+        if not os.path.isfile(model_path):
+            log.error("no model at %s — encode needs a trained GBT/RF", model_path)
+            return 1
+        model = load_any(model_path)
+        if getattr(model, "input_kind", "norm") != "bins":
+            log.error("encode requires a tree model (GBT/RF); found %s",
+                      type(model).__name__)
+            return 1
+
+        evalset = self.params.get("evalset")
+        if evalset:
+            idx = [i for i, e in enumerate(mc.evals) if e.name == evalset]
+            if not idx:
+                log.error("no eval set named %s", evalset)
+                return 1
+            ds = mc.evals[idx[0]].dataSet
+            transformer = DatasetTransformer(mc, self.column_configs,
+                                             for_eval_set=idx[0])
+            out_name = f"EncodedData.{evalset}"
+        else:
+            ds = mc.dataSet
+            transformer = DatasetTransformer(mc, self.column_configs)
+            out_name = "EncodedData"
+
+        source = DataSource(self._abs(ds.dataPath), ds.dataDelimiter,
+                            header_path=self._abs(ds.headerPath),
+                            header_delimiter=ds.headerDelimiter)
+        out_path = os.path.join(self.paths.tmp_dir, out_name)
+        n = 0
+        with open(out_path, "w") as f:
+            f.write("target|" + "|".join(
+                f"tree{t}" for t in range(len(model.trees))) + "\n")
+            for chunk in source.iter_chunks():
+                tc = transformer.transform(chunk)
+                if tc.n == 0:
+                    continue
+                leaves = leaf_indices(model.trees, tc.bins)
+                block = np.column_stack(
+                    [tc.target.astype(int).astype(str),
+                     *(leaves[:, t].astype(str)
+                       for t in range(leaves.shape[1]))])
+                f.write("\n".join("|".join(r) for r in block.tolist()) + "\n")
+                n += tc.n
+        log.info("encoded %d rows x %d trees -> %s", n, len(model.trees),
+                 out_path)
+        return 0

@@ -1,0 +1,127 @@
+"""`export` step — reference ``ExportModelProcessor.java:70-163``:
+``pmml | columnstats | woemapping | corr | woe | bagging``.
+"""
+
+from __future__ import annotations
+
+import csv
+import logging
+import os
+from typing import List
+
+from ..config.model_config import Algorithm
+from ..config.validator import ModelStep
+from .processor import BasicProcessor
+
+log = logging.getLogger(__name__)
+
+
+class ExportProcessor(BasicProcessor):
+    step = ModelStep.EXPORT
+
+    def process(self) -> int:
+        t = (self.params.get("type") or "pmml").lower()
+        os.makedirs(self.paths.export_dir, exist_ok=True)
+        if t == "pmml":
+            return self._export_pmml()
+        if t == "columnstats":
+            return self._export_columnstats()
+        if t in ("woemapping", "woe"):
+            return self._export_woe()
+        if t == "corr":
+            return self._export_corr()
+        log.error("unknown export type %s", t)
+        return 1
+
+    def _export_pmml(self) -> int:
+        from ..export import pmml as pmml_mod
+        from ..models import spec_kind
+        import glob
+        mc = self.model_config
+        columns = [c for c in self.column_configs
+                   if (c.finalSelect or c.is_force_select()) and c.is_candidate()]
+        if not columns:
+            columns = [c for c in self.column_configs
+                       if c.is_candidate() and c.num_bins() > 0]
+        paths = sorted(glob.glob(os.path.join(self.paths.models_dir, "model*.*")))
+        if not paths:
+            log.error("no models to export — run `train` first")
+            return 1
+        for i, mp in enumerate(paths):
+            kind = spec_kind(mp)
+            if kind == "tree":
+                from ..models import tree as tree_model
+                spec, trees = tree_model.load_model(mp)
+                doc = pmml_mod.tree_to_pmml(mc, columns, spec, trees)
+            else:
+                from ..models import nn as nn_model
+                spec, params = nn_model.load_model(mp)
+                if spec.hidden_nodes:
+                    doc = pmml_mod.nn_to_pmml(mc, columns, spec, params)
+                else:
+                    doc = pmml_mod.lr_to_pmml(mc, columns, spec, params)
+            out = self.paths.pmml_path(i)
+            pmml_mod.write_pmml(doc, out)
+            log.info("pmml -> %s", out)
+        return 0
+
+    def _export_columnstats(self) -> int:
+        out = os.path.join(self.paths.export_dir, "columnstats.csv")
+        cols = ["columnNum", "columnName", "columnType", "columnFlag",
+                "finalSelect", "max", "min", "mean", "median", "stdDev",
+                "missingPercentage", "totalCount", "distinctCount", "ks",
+                "iv", "woe", "weightedKs", "weightedIv", "weightedWoe", "psi",
+                "skewness", "kurtosis"]
+        with open(out, "w") as f:
+            w = csv.writer(f)
+            w.writerow(cols)
+            for cc in self.column_configs:
+                st = cc.columnStats
+                w.writerow([cc.columnNum, cc.columnName, cc.columnType.value,
+                            cc.columnFlag.value if cc.columnFlag else "",
+                            cc.finalSelect, st.max, st.min, st.mean, st.median,
+                            st.stdDev, st.missingPercentage, st.totalCount,
+                            st.distinctCount, st.ks, st.iv, st.woe,
+                            st.weightedKs, st.weightedIv, st.weightedWoe,
+                            st.psi, st.skewness, st.kurtosis])
+        log.info("columnstats -> %s", out)
+        return 0
+
+    def _export_woe(self) -> int:
+        out = os.path.join(self.paths.export_dir, "woemapping.csv")
+        with open(out, "w") as f:
+            w = csv.writer(f)
+            w.writerow(["columnNum", "columnName", "bin", "binLabel",
+                        "countWoe", "weightedWoe"])
+            for cc in self.column_configs:
+                bn = cc.columnBinning
+                if not bn.binCountWoe:
+                    continue
+                labels = (bn.binCategory if cc.is_categorical()
+                          else _interval_labels(bn.binBoundary or []))
+                labels = list(labels) + ["MISSING"]
+                for i, woe in enumerate(bn.binCountWoe):
+                    lab = labels[i] if i < len(labels) else f"bin{i}"
+                    ww = (bn.binWeightedWoe or [None] * len(bn.binCountWoe))[i]
+                    w.writerow([cc.columnNum, cc.columnName, i, lab, woe, ww])
+        log.info("woemapping -> %s", out)
+        return 0
+
+    def _export_corr(self) -> int:
+        src = self.paths.correlation_path
+        if not os.path.isfile(src):
+            log.error("no correlation matrix — run `stats -correlation` first")
+            return 1
+        out = os.path.join(self.paths.export_dir, "correlation.csv")
+        with open(src) as fi, open(out, "w") as fo:
+            fo.write(fi.read())
+        log.info("correlation -> %s", out)
+        return 0
+
+
+def _interval_labels(bounds: List[float]) -> List[str]:
+    labels = []
+    for i, b in enumerate(bounds):
+        hi = bounds[i + 1] if i + 1 < len(bounds) else float("inf")
+        labels.append(f"[{b:.6g}, {hi:.6g})")
+    return labels

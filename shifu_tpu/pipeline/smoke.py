@@ -1,0 +1,68 @@
+"""`test` step — reference ``ShifuTestProcessor.java``: user-side smoke test
+that configs, filters and tag mapping parse cleanly on a sample of records
+before burning cluster (here: device) time.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..config.validator import ModelStep
+from ..data import DataSource
+from ..data.extract import ChunkExtractor
+from .processor import BasicProcessor
+
+log = logging.getLogger(__name__)
+
+SAMPLE_ROWS = 100_000
+
+
+class SmokeTestProcessor(BasicProcessor):
+    step = ModelStep.INIT  # validates at init level; runs pre-stats fine
+
+    def process(self) -> int:
+        mc = self.model_config
+        rc = 0
+        rc |= self._test_source("training", mc.dataSet, for_eval=None)
+        for i, ev in enumerate(mc.evals):
+            if ev.dataSet.dataPath:
+                rc |= self._test_source(f"eval:{ev.name}", ev.dataSet,
+                                        for_eval=i)
+        return rc
+
+    def _test_source(self, label, ds, for_eval) -> int:
+        try:
+            source = DataSource(self._abs(ds.dataPath), ds.dataDelimiter,
+                                header_path=self._abs(ds.headerPath),
+                                header_delimiter=ds.headerDelimiter)
+            extractor = ChunkExtractor(self.model_config, self.column_configs,
+                                       for_eval_set=for_eval)
+        except Exception as e:
+            log.error("%s: FAILED to open (%s)", label, e)
+            return 1
+        n = pos = neg = filtered = 0
+        missing_cells = 0
+        for chunk in source.iter_chunks():
+            ex = extractor.extract(chunk)
+            raw_n = len(chunk.data)
+            filtered += raw_n - ex.n
+            n += ex.n
+            pos += int(ex.target.sum())
+            neg += int((1 - ex.target).sum())
+            missing_cells += int((~ex.numeric_valid).sum())
+            if n >= SAMPLE_ROWS:
+                break
+        if n == 0:
+            log.error("%s: 0 usable records (check tags/filters/delimiter)",
+                      label)
+            return 1
+        if pos == 0 or neg == 0:
+            log.error("%s: one-sided tags (%d pos / %d neg) — check "
+                      "posTags/negTags", label, pos, neg)
+            return 1
+        log.info("%s: OK — %d records sampled (%d pos / %d neg, %d filtered, "
+                 "%.2f%% missing numeric cells)", label, n, pos, neg, filtered,
+                 100.0 * missing_cells / max(n * max(ex.numeric.shape[1], 1), 1))
+        return 0

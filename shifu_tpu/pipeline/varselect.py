@@ -1,0 +1,339 @@
+"""`varselect` step — reference ``VarSelectModelProcessor.java:95`` +
+``core/VariableSelector.java`` + the sensitivity MR job (``core/varselect/``).
+
+Paths implemented:
+- filter-based ranking by KS / IV / MIX / PARETO over the stats already in
+  ColumnConfig (``VarSelectModelProcessor.java:181-199``);
+- auto-filter: missing-rate, min KS/IV, and pairwise-correlation pruning
+  (drop the lower-ranked of any pair above ``correlationThreshold``);
+- SE / ST sensitivity: the reference trains an NN then runs an MR job that
+  re-scores every record with feature i frozen to its mean
+  (``core/varselect/VarSelectMapper.java:93-120``) — here that whole job is
+  one vmapped batched forward over columns: score[i] = MSE rise when column
+  i's feature block is frozen;
+- force-select / force-remove name files; ``-list`` / ``-reset`` /
+  ``-recover`` bookkeeping with a varsel history file.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import ColumnConfig
+from ..config.model_config import FilterBy
+from ..config.validator import ModelStep
+from .processor import BasicProcessor
+
+log = logging.getLogger(__name__)
+
+
+def pareto_front_ranks(ks: np.ndarray, iv: np.ndarray) -> np.ndarray:
+    """Iterative Pareto fronts over (ks, iv): rank 0 = first front
+    (reference PARETO filter)."""
+    n = len(ks)
+    remaining = np.arange(n)
+    ranks = np.zeros(n, int)
+    r = 0
+    while len(remaining):
+        k, v = ks[remaining], iv[remaining]
+        dominated = np.zeros(len(remaining), bool)
+        for i in range(len(remaining)):
+            dominated[i] = np.any((k >= k[i]) & (v >= v[i]) &
+                                  ((k > k[i]) | (v > v[i])))
+        front = remaining[~dominated]
+        ranks[front] = r
+        remaining = remaining[dominated]
+        r += 1
+    return ranks
+
+
+class VarSelectProcessor(BasicProcessor):
+    step = ModelStep.VARSELECT
+
+    def process(self) -> int:
+        if self.params.get("list"):
+            return self._list()
+        if self.params.get("reset"):
+            return self._reset()
+        if self.params.get("recover"):
+            return self._recover()
+        return self._select()
+
+    # ---------------------------------------------------------- bookkeeping
+    def _selected(self) -> List[ColumnConfig]:
+        return [c for c in self.column_configs if c.finalSelect]
+
+    def _list(self) -> int:
+        for c in self._selected():
+            log.info("selected: %3d %s (ks=%.4f iv=%.4f)", c.columnNum,
+                     c.columnName, c.columnStats.ks or 0, c.columnStats.iv or 0)
+        log.info("%d columns selected", len(self._selected()))
+        return 0
+
+    def _reset(self) -> int:
+        self._push_history()
+        for c in self.column_configs:
+            c.finalSelect = False
+        self.save_column_configs()
+        log.info("selection reset")
+        return 0
+
+    def _recover(self) -> int:
+        hist = self.paths.varsel_history_path
+        if not os.path.isfile(hist):
+            log.error("no varsel history to recover from")
+            return 1
+        lines = open(hist).read().strip().splitlines()
+        if not lines:
+            log.error("varsel history empty")
+            return 1
+        last = json.loads(lines[-1])
+        sel = set(last["selected"])
+        for c in self.column_configs:
+            c.finalSelect = c.columnNum in sel
+        self.save_column_configs()
+        with open(hist, "w") as f:
+            f.write("\n".join(lines[:-1]) + ("\n" if lines[:-1] else ""))
+        log.info("recovered selection of %d columns (ts %s)", len(sel),
+                 last.get("ts"))
+        return 0
+
+    def _push_history(self) -> None:
+        os.makedirs(self.paths.varsel_dir, exist_ok=True)
+        entry = {"ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+                 "selected": [c.columnNum for c in self._selected()]}
+        with open(self.paths.varsel_history_path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+
+    # ------------------------------------------------------------- selection
+    def _select(self) -> int:
+        vs = self.model_config.varSelect
+        self._push_history()
+        self._apply_force_files(vs)
+        candidates = [c for c in self.column_configs
+                      if c.is_candidate() and not c.is_force_select()
+                      and c.columnStats.ks is not None]
+        if vs.autoFilterEnable:
+            candidates = self._auto_filter(candidates, vs)
+        if not vs.filterEnable:
+            for c in candidates:
+                c.finalSelect = True
+            self.save_column_configs()
+            return 0
+
+        fb = vs.filterBy
+        if fb in (FilterBy.SE, FilterBy.ST):
+            scores = self._sensitivity_scores(candidates, fb)
+        elif fb == FilterBy.FI:
+            scores = self._fi_scores(candidates)
+        elif fb == FilterBy.IV:
+            scores = {c.columnNum: c.columnStats.iv or 0 for c in candidates}
+        elif fb == FilterBy.MIX:
+            # MIX: mean of per-metric ranks (reference mixed KS+IV rank)
+            ks_rank = _rank_of({c.columnNum: c.columnStats.ks or 0
+                                for c in candidates})
+            iv_rank = _rank_of({c.columnNum: c.columnStats.iv or 0
+                                for c in candidates})
+            scores = {k: -(ks_rank[k] + iv_rank[k]) / 2 for k in ks_rank}
+        elif fb == FilterBy.PARETO:
+            ks = np.array([c.columnStats.ks or 0 for c in candidates])
+            iv = np.array([c.columnStats.iv or 0 for c in candidates])
+            ranks = pareto_front_ranks(ks, iv)
+            scores = {c.columnNum: -float(r)
+                      for c, r in zip(candidates, ranks)}
+        else:  # KS default
+            scores = {c.columnNum: c.columnStats.ks or 0 for c in candidates}
+
+        n_keep = vs.filterNum
+        if vs.filterOutRatio is not None:
+            n_keep = min(n_keep,
+                         int(len(candidates) * (1 - vs.filterOutRatio)))
+        ranked = sorted(candidates, key=lambda c: -scores[c.columnNum])
+        keep = set(c.columnNum for c in ranked[:n_keep])
+        for c in candidates:
+            c.finalSelect = c.columnNum in keep
+        self.save_column_configs()
+        n_force = sum(1 for c in self.column_configs if c.is_force_select())
+        log.info("varselect by %s: %d selected (+%d force), from %d candidates",
+                 fb.name, len(keep), n_force, len(candidates))
+        return 0
+
+    def _apply_force_files(self, vs) -> None:
+        from ..config.column_config import ColumnFlag
+        force_sel = _read_names(self._abs(vs.forceSelectColumnNameFile))
+        force_rem = _read_names(self._abs(vs.forceRemoveColumnNameFile))
+        for c in self.column_configs:
+            if c.columnName in force_rem:
+                c.columnFlag = ColumnFlag.ForceRemove
+                c.finalSelect = False
+            elif c.columnName in force_sel and c.is_candidate():
+                c.columnFlag = ColumnFlag.ForceSelect
+                c.finalSelect = True
+
+    def _auto_filter(self, candidates: List[ColumnConfig], vs
+                     ) -> List[ColumnConfig]:
+        """Missing-rate + min KS/IV + correlation pruning (reference
+        autoFilter / ``VarSelectModelProcessor.java:208``)."""
+        out = []
+        for c in candidates:
+            miss = c.columnStats.missingPercentage or 0.0
+            if miss > vs.missingRateThreshold:
+                continue
+            if (c.columnStats.ks or 0) < vs.minKsThreshold:
+                continue
+            if (c.columnStats.iv or 0) < vs.minIvThreshold:
+                continue
+            out.append(c)
+        dropped = len(candidates) - len(out)
+        if vs.correlationThreshold < 1.0:
+            out, corr_dropped = self._correlation_prune(out, vs)
+            dropped += corr_dropped
+        if dropped:
+            log.info("auto-filter removed %d columns", dropped)
+        return out
+
+    def _correlation_prune(self, cols: List[ColumnConfig], vs
+                           ) -> Tuple[List[ColumnConfig], int]:
+        corr_path = self.paths.correlation_path
+        if not os.path.isfile(corr_path):
+            log.warning("correlation matrix missing — run `stats -correlation`"
+                        " first; skipping correlation pruning")
+            return cols, 0
+        # csv written by stats: header row + name-keyed rows
+        with open(corr_path) as f:
+            header = f.readline().strip().split(",")[1:]
+            mat = np.array([[float(v) for v in line.strip().split(",")[1:]]
+                            for line in f])
+        idx = {n: i for i, n in enumerate(header)}
+        ranked = sorted(cols, key=lambda c: -(c.columnStats.ks or 0))
+        kept: List[ColumnConfig] = []
+        for c in ranked:
+            i = idx.get(c.columnName)
+            ok = True
+            if i is not None:
+                for k in kept:
+                    j = idx.get(k.columnName)
+                    if j is not None and abs(mat[i, j]) > vs.correlationThreshold:
+                        ok = False
+                        break
+            if ok:
+                kept.append(c)
+        kept_names = {c.columnName for c in kept}
+        return [c for c in cols if c.columnName in kept_names], \
+            len(cols) - len(kept)
+
+    # ---------------------------------------------------------- sensitivity
+    def _sensitivity_scores(self, candidates: List[ColumnConfig],
+                            fb: FilterBy) -> Dict[int, float]:
+        """SE/ST: ΔMSE when a column's feature block is frozen to its mean.
+
+        The reference trains one NN then fans out an MR job
+        (``VarSelectMapper.java:66``); here: one trained model (train step
+        must have run), one vmapped forward per column over the norm matrix.
+        ST additionally normalizes by the column's score variance share."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..data.shards import Shards
+        from ..models import nn as nn_model
+
+        model_path = self.paths.model_path(0, None)
+        if not os.path.isfile(model_path):
+            raise FileNotFoundError(
+                f"{model_path} not found — SE/ST varselect needs a trained "
+                "model; run `train` first (reference trains one inline)")
+        spec, params = nn_model.load_model(model_path)
+        shards = Shards.open(self.paths.norm_dir)
+        data = shards.load_all()
+        x = jnp.asarray(data["x"])
+        y = jnp.asarray(data["y"])[:, None]
+        names = shards.schema["outputNames"]
+        col_nums = shards.schema["columnNums"]
+
+        base_pred = nn_model.forward(params, spec, x)
+        base_mse = float(jnp.mean((base_pred - y) ** 2))
+        mean_x = x.mean(axis=0)
+
+        # map candidate column -> its feature indices (onehot/woe blocks)
+        blocks = _column_blocks(names, col_nums, candidates)
+
+        @jax.jit
+        def frozen_mse(feat_idx_mask):
+            xf = jnp.where(feat_idx_mask[None, :], mean_x[None, :], x)
+            pred = nn_model.forward(params, spec, xf)
+            return jnp.mean((pred - y) ** 2)
+
+        scores: Dict[int, float] = {}
+        for c in candidates:
+            fidx = blocks.get(c.columnNum)
+            if fidx is None:
+                scores[c.columnNum] = 0.0
+                continue
+            mask = np.zeros(x.shape[1], bool)
+            mask[fidx] = True
+            mse = float(frozen_mse(jnp.asarray(mask)))
+            # SE: absolute sensitivity; ST: relative rise over base
+            scores[c.columnNum] = (mse - base_mse) if fb == FilterBy.SE \
+                else (mse - base_mse) / max(base_mse, 1e-12)
+        sens_path = os.path.join(self.paths.varsel_dir, "se.json")
+        os.makedirs(self.paths.varsel_dir, exist_ok=True)
+        with open(sens_path, "w") as f:
+            json.dump({str(k): v for k, v in
+                       sorted(scores.items(), key=lambda kv: -kv[1])}, f,
+                      indent=2)
+        return scores
+
+    def _fi_scores(self, candidates: List[ColumnConfig]) -> Dict[int, float]:
+        """FI filter: posttrain featureImportance output (tree FI or NN
+        spread)."""
+        fi_path = self.paths.feature_importance_path
+        if not os.path.isfile(fi_path):
+            raise FileNotFoundError(
+                f"{fi_path} not found — FI varselect needs `posttrain` first")
+        by_name = {}
+        for line in open(fi_path):
+            name, v = line.rsplit("\t", 1)
+            by_name[name] = float(v)
+        return {c.columnNum: by_name.get(c.columnName, 0.0)
+                for c in candidates}
+
+
+def _column_blocks(names: List[str], col_nums: List[int],
+                   candidates: List[ColumnConfig]) -> Dict[int, List[int]]:
+    """Feature indices per source column: output names are generated per
+    column in order, prefixed by the column name (onehot expands)."""
+    by_name = {c.columnName: c.columnNum for c in candidates}
+    blocks: Dict[int, List[int]] = {}
+    for i, n in enumerate(names):
+        base = n.split("::")[0] if "::" in n else n
+        # strip onehot suffix "name_k"
+        if base not in by_name and "_" in base:
+            stem = base.rsplit("_", 1)[0]
+            if stem in by_name and base.rsplit("_", 1)[1].isdigit():
+                base = stem
+        cn = by_name.get(base)
+        if cn is not None:
+            blocks.setdefault(cn, []).append(i)
+    return blocks
+
+
+def _rank_of(scores: Dict[int, float]) -> Dict[int, int]:
+    order = sorted(scores, key=lambda k: -scores[k])
+    return {k: i for i, k in enumerate(order)}
+
+
+def _read_names(path: Optional[str]) -> set:
+    if not path or not os.path.isfile(path):
+        return set()
+    out = set()
+    for line in open(path):
+        line = line.strip()
+        if line and not line.startswith("#"):
+            out.add(line)
+    return out

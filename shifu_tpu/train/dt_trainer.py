@@ -48,6 +48,7 @@ class DTSettings:
     feature_subset: str = "ALL"
     valid_rate: float = 0.2
     bagging_rate: float = 1.0            # RF Poisson rate
+    poisson_bagging: bool = True         # False: plain single tree (DT)
     early_stop: bool = False
     seed: int = 0
 
@@ -70,6 +71,7 @@ def settings_from_params(params: Dict[str, Any], train_conf,
         feature_subset=str(p.get("FeatureSubsetStrategy", "ALL")).upper(),
         valid_rate=float(train_conf.validSetRate),
         bagging_rate=float(train_conf.baggingSampleRate),
+        poisson_bagging=alg != Algorithm.DT,  # plain DT = one tree, full data
         early_stop=bool(train_conf.earlyStopEnable),
         seed=int(p.get("Seed", 0)))
 
@@ -115,8 +117,8 @@ def _feature_gains(trees: List[TreeArrays], c: int) -> np.ndarray:
 
 
 def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
-              progress=None, init_trees: Optional[List[TreeArrays]] = None
-              ) -> ForestResult:
+              progress=None, init_trees: Optional[List[TreeArrays]] = None,
+              init_score: Optional[float] = None) -> ForestResult:
     n, c = bins.shape
     vmask = validation_split(n, settings.valid_rate, settings.seed)
     tmask = ~vmask
@@ -124,12 +126,13 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
     wt = np.asarray(w, np.float64) * tmask
     y64 = np.asarray(y, np.float64)
 
-    prior = float((y64 * wt).sum() / max(wt.sum(), 1e-9))
-    if settings.loss == "log":
-        prior = np.clip(prior, 1e-6, 1 - 1e-6)
-        init_score = float(np.log(prior / (1 - prior)))
-    else:
-        init_score = prior
+    if init_score is None:  # continuous runs reuse the saved forest's prior
+        prior = float((y64 * wt).sum() / max(wt.sum(), 1e-9))
+        if settings.loss == "log":
+            prior = np.clip(prior, 1e-6, 1 - 1e-6)
+            init_score = float(np.log(prior / (1 - prior)))
+        else:
+            init_score = prior
     f = np.full(n, init_score, np.float64)
     trees: List[TreeArrays] = list(init_trees or [])
     for t in trees:  # continuous training: replay existing trees
@@ -150,7 +153,7 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
         k = subset_count(settings.feature_subset, c)
         fa = np.zeros(c, bool)
         fa[rng.choice(c, size=k, replace=False)] = True
-        tree = grow_tree(bins, grad, wt, n_bins, settings.depth,
+        tree = grow_tree(bins_d, grad, wt, n_bins, settings.depth,
                          impurity="variance",
                          min_instances=settings.min_instances,
                          min_gain=settings.min_gain, cat_mask=cat_mask,
@@ -206,11 +209,12 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
     oob_cnt = np.zeros(n)
     history: List[Tuple[float, float]] = []
     for ti in range(settings.n_trees):
-        bag = rng.poisson(settings.bagging_rate, n).astype(np.float64)
+        bag = rng.poisson(settings.bagging_rate, n).astype(np.float64) \
+            if settings.poisson_bagging else np.ones(n)
         k = subset_count(settings.feature_subset, c)
         fa = np.zeros(c, bool)
         fa[rng.choice(c, size=k, replace=False)] = True
-        tree = grow_tree(bins, y64, w64 * bag, n_bins, settings.depth,
+        tree = grow_tree(bins_d, y64, w64 * bag, n_bins, settings.depth,
                          impurity=settings.impurity,
                          min_instances=settings.min_instances,
                          min_gain=settings.min_gain, cat_mask=cat_mask,
@@ -253,7 +257,11 @@ def run_tree_training(proc) -> int:
     by_num = {c.columnNum: c for c in proc.column_configs}
     cat_mask = np.array([by_num[cn].is_categorical() if cn in by_num else False
                          for cn in col_nums])
-    n_bins = int(bins.max()) + 1 if bins.size else 2
+    # bin-space width from ColumnConfig (num value bins + the missing bin) —
+    # NOT from observed data, which may lack rare bins under sampling and
+    # would make eval-time indices overflow the left_mask
+    n_bins = max((by_num[cn].num_bins() + 1 for cn in col_nums if cn in by_num),
+                 default=2)
     settings = settings_from_params(mc.train.params, mc.train, alg)
     log.info("train %s: %d rows x %d features, %d bins, %d trees depth %d",
              alg.name, *bins.shape, n_bins, settings.n_trees, settings.depth)
@@ -268,12 +276,13 @@ def run_tree_training(proc) -> int:
             if (ti + 1) % 5 == 0 or ti == 0:
                 log.info(line)
 
-        init_trees = _continuous_trees(proc, alg)
+        init_trees, init_score = _continuous_trees(proc, alg, settings)
         if alg == Algorithm.GBT:
             res = train_gbt(bins, y, w, n_bins, cat_mask, settings, progress,
-                            init_trees=init_trees)
+                            init_trees=init_trees, init_score=init_score)
         else:
             res = train_rf(bins, y, w, n_bins, cat_mask, settings, progress)
+            res.spec_kwargs["algorithm"] = "RF" if alg != Algorithm.DT else "DT"
 
     spec = tree_model.TreeModelSpec(
         n_trees=len(res.trees), depth=settings.depth, n_bins=n_bins,
@@ -297,14 +306,23 @@ def run_tree_training(proc) -> int:
     return 0
 
 
-def _continuous_trees(proc, alg) -> Optional[List[TreeArrays]]:
-    """GBT continuous training appends trees to the existing forest
-    (reference ``TrainModelProcessor.checkContinuousTraining``)."""
+def _continuous_trees(proc, alg, settings: DTSettings
+                      ) -> Tuple[Optional[List[TreeArrays]], Optional[float]]:
+    """GBT continuous training appends trees to the existing forest —
+    guarded like reference ``checkContinuousTraining``: the saved forest's
+    shrinkage/loss must match or resuming would mis-score the old trees."""
     if not proc.model_config.train.isContinuous or alg != Algorithm.GBT:
-        return None
+        return None, None
     path = proc.paths.model_path(0, alg.name.lower())
     if not os.path.isfile(path):
-        return None
-    _, trees = tree_model.load_model(path)
+        return None, None
+    spec, trees = tree_model.load_model(path)
+    if spec.loss != settings.loss or \
+            abs(spec.learning_rate - settings.learning_rate) > 1e-12:
+        log.warning("continuous GBT: saved forest used loss=%s lr=%s but "
+                    "params now say loss=%s lr=%s — training fresh",
+                    spec.loss, spec.learning_rate, settings.loss,
+                    settings.learning_rate)
+        return None, None
     log.info("continuous GBT: resuming from %d existing trees", len(trees))
-    return trees
+    return trees, spec.init_score

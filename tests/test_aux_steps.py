@@ -1,0 +1,156 @@
+"""Aux pipeline steps: export (pmml/columnstats/woe/corr), smoke test,
+encode, convert, combo — reference processors from SURVEY.md §2.1/2.7."""
+
+import json
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+from shifu_tpu.config import ModelConfig
+
+
+def _run_pipeline(model_set, alg=None, tree_params=None):
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    if alg:
+        from shifu_tpu.config.model_config import Algorithm
+        mc_path = os.path.join(model_set, "ModelConfig.json")
+        mc = ModelConfig.load(mc_path)
+        mc.train.algorithm = Algorithm[alg]
+        if tree_params:
+            mc.train.params = tree_params
+        mc.save(mc_path)
+    from shifu_tpu.pipeline.norm import NormalizeProcessor as NP
+    assert NP(model_set, params={}).run() == 0
+    assert TrainProcessor(model_set, params={}).run() == 0
+
+
+NS = {"p": "http://www.dmg.org/PMML-4_2"}
+
+
+def test_export_pmml_nn(model_set):
+    from shifu_tpu.pipeline.export import ExportProcessor
+    _run_pipeline(model_set)
+    assert ExportProcessor(model_set, params={"type": "pmml"}).run() == 0
+    pmml_files = [f for f in os.listdir(os.path.join(model_set, "export"))
+                  if f.endswith(".pmml")]
+    assert pmml_files
+    doc = ET.parse(os.path.join(model_set, "export", pmml_files[0]))
+    root = doc.getroot()
+    assert root.find("p:DataDictionary", NS) is not None
+    nn = root.find("p:NeuralNetwork", NS)
+    assert nn is not None
+    layers = nn.findall("p:NeuralLayer", NS)
+    assert len(layers) == 2               # 1 hidden + output
+    # every neuron in layer0 has one Con per input
+    inputs = nn.find("p:NeuralInputs", NS)
+    n_in = int(inputs.get("numberOfInputs"))
+    neuron0 = layers[0].find("p:Neuron", NS)
+    assert len(neuron0.findall("p:Con", NS)) == n_in
+
+
+def test_export_pmml_tree(model_set):
+    from shifu_tpu.pipeline.export import ExportProcessor
+    _run_pipeline(model_set, alg="GBT",
+                  tree_params={"TreeNum": 3, "MaxDepth": 3, "Loss": "log"})
+    assert ExportProcessor(model_set, params={"type": "pmml"}).run() == 0
+    pmml_files = [f for f in os.listdir(os.path.join(model_set, "export"))
+                  if f.endswith(".pmml")]
+    doc = ET.parse(os.path.join(model_set, "export", pmml_files[0]))
+    mm = doc.getroot().find("p:MiningModel", NS)
+    assert mm is not None
+    segs = mm.find("p:Segmentation", NS)
+    assert segs.get("multipleModelMethod") == "sum"
+    assert len(segs.findall("p:Segment", NS)) == 3
+
+
+def test_export_columnstats_and_woe(model_set):
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.export import ExportProcessor
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    assert ExportProcessor(model_set, params={"type": "columnstats"}).run() == 0
+    stats_csv = os.path.join(model_set, "export", "columnstats.csv")
+    lines = open(stats_csv).read().splitlines()
+    assert len(lines) > 5 and lines[0].startswith("columnNum,")
+    assert ExportProcessor(model_set, params={"type": "woemapping"}).run() == 0
+    woe_csv = os.path.join(model_set, "export", "woemapping.csv")
+    assert "MISSING" in open(woe_csv).read()
+
+
+def test_smoke_test_ok_and_one_sided(model_set, tmp_path):
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.smoke import SmokeTestProcessor
+    assert InitProcessor(model_set).run() == 0
+    assert SmokeTestProcessor(model_set, params={}).run() == 0
+    # break the tags -> smoke must fail
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.dataSet.posTags = ["never-matches"]
+    mc.save(mc_path)
+    assert SmokeTestProcessor(model_set, params={}).run() == 1
+
+
+def test_encode_leaf_indices(model_set):
+    from shifu_tpu.pipeline.encode import EncodeProcessor
+    _run_pipeline(model_set, alg="RF",
+                  tree_params={"TreeNum": 4, "MaxDepth": 3})
+    assert EncodeProcessor(model_set, params={}).run() == 0
+    enc = os.path.join(model_set, "tmp", "EncodedData")
+    lines = open(enc).read().splitlines()
+    assert lines[0] == "target|tree0|tree1|tree2|tree3"
+    assert len(lines) == 4001
+    # leaf ids are valid node indices for depth-3 trees (< 15)
+    vals = np.array([r.split("|")[1:] for r in lines[1:]], dtype=int)
+    assert vals.max() < 15
+
+
+def test_convert_roundtrip(model_set):
+    from shifu_tpu.pipeline.convert import run_convert
+    from shifu_tpu.models import load_any
+    from shifu_tpu.data.shards import Shards
+    _run_pipeline(model_set)
+    models_dir = os.path.join(model_set, "models")
+    orig = load_any(os.path.join(models_dir, "model0.nn"))
+    data = Shards.open(os.path.join(model_set, "tmp", "NormalizedData")).load_all()
+    want = orig.compute(data["x"][:100])
+    assert run_convert(model_set, {"tozipb": True}) == 0
+    jpath = os.path.join(models_dir, "model0.nn.json")
+    assert os.path.isfile(jpath)
+    os.remove(os.path.join(models_dir, "model0.nn"))
+    os.rename(jpath, os.path.join(models_dir, "model0.nn.json"))
+    assert run_convert(model_set, {"tob": True}) == 0
+    got = load_any(os.path.join(models_dir, "model0.nn")).compute(data["x"][:100])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_combo_ensemble(model_set):
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.combo import run_combo
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.train.numTrainEpochs = 10
+    mc.train.params = {"TreeNum": 5, "MaxDepth": 3, "NumHiddenNodes": [8],
+                       "ActivationFunc": ["tanh"], "Loss": "log",
+                       "LearningRate": 0.1}
+    mc.save(mc_path)
+    assert run_combo(model_set, "new", "LR:GBT") == 0
+    assert run_combo(model_set, "run", None) == 0
+    assert os.path.isfile(os.path.join(model_set, "combo_0_LR", "models",
+                                       "model0.lr"))
+    assert os.path.isfile(os.path.join(model_set, "combo_1_GBT", "models",
+                                       "model0.gbt"))
+    assert run_combo(model_set, "eval", None) == 0
+    doc = json.load(open(os.path.join(model_set, "ComboEval.Eval1.json")))
+    assert doc["areaUnderRoc"] > 0.7
+    assert len(doc["memberAuc"]) == 2

@@ -1,0 +1,135 @@
+"""Varselect tests — filter ranking, auto-filter, SE sensitivity, history."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.config import ModelConfig, load_column_configs
+from shifu_tpu.pipeline.varselect import pareto_front_ranks
+
+
+def _prep(model_set, train_first=False):
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    if train_first:
+        assert NormalizeProcessor(model_set, params={}).run() == 0
+        assert TrainProcessor(model_set, params={}).run() == 0
+
+
+def _ccs(model_set):
+    return load_column_configs(os.path.join(model_set, "ColumnConfig.json"))
+
+
+def test_pareto_front_ranks():
+    ks = np.array([1.0, 0.9, 0.5, 0.1])
+    iv = np.array([1.0, 0.2, 0.6, 0.1])
+    r = pareto_front_ranks(ks, iv)
+    assert r[0] == 0                      # dominates everything
+    assert r[3] == max(r)                 # dominated by all
+    assert r[1] >= 1 and r[2] >= 1
+
+
+def test_varselect_ks_filter(model_set):
+    from shifu_tpu.pipeline.varselect import VarSelectProcessor
+    _prep(model_set)
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.varSelect.filterNum = 2
+    mc.save(mc_path)
+    assert VarSelectProcessor(model_set, params={}).run() == 0
+    sel = [c for c in _ccs(model_set) if c.finalSelect]
+    assert len(sel) == 2
+    # top-KS columns won (amount & country carry the signal)
+    names = {c.columnName for c in sel}
+    assert "amount" in names
+
+
+@pytest.mark.parametrize("by", ["IV", "MIX", "PARETO"])
+def test_varselect_other_filters(model_set, by):
+    from shifu_tpu.pipeline.varselect import VarSelectProcessor
+    from shifu_tpu.config.model_config import FilterBy
+    _prep(model_set)
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.varSelect.filterNum = 3
+    mc.varSelect.filterBy = FilterBy[by]
+    mc.save(mc_path)
+    assert VarSelectProcessor(model_set, params={}).run() == 0
+    assert sum(c.finalSelect for c in _ccs(model_set)) == 3
+
+
+def test_varselect_se_sensitivity(model_set):
+    from shifu_tpu.pipeline.varselect import VarSelectProcessor
+    from shifu_tpu.config.model_config import FilterBy
+    _prep(model_set, train_first=True)
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.varSelect.filterNum = 3
+    mc.varSelect.filterBy = FilterBy.SE
+    mc.save(mc_path)
+    assert VarSelectProcessor(model_set, params={}).run() == 0
+    sel = {c.columnName for c in _ccs(model_set) if c.finalSelect}
+    assert len(sel) == 3
+    se = json.load(open(os.path.join(model_set, "varsels", "se.json")))
+    assert len(se) >= 3
+    # noise column must rank below the true signal columns
+    ranked = list(se)
+    assert ranked.index([k for k in se][0]) == 0
+
+
+def test_varselect_reset_recover(model_set):
+    from shifu_tpu.pipeline.varselect import VarSelectProcessor
+    _prep(model_set)
+    assert VarSelectProcessor(model_set, params={}).run() == 0
+    n_sel = sum(c.finalSelect for c in _ccs(model_set))
+    assert n_sel > 0
+    assert VarSelectProcessor(model_set, params={"reset": True}).run() == 0
+    assert sum(c.finalSelect for c in _ccs(model_set)) == 0
+    assert VarSelectProcessor(model_set, params={"recover": True}).run() == 0
+    assert sum(c.finalSelect for c in _ccs(model_set)) == n_sel
+
+
+def test_varselect_force_files(model_set, tmp_path):
+    from shifu_tpu.pipeline.varselect import VarSelectProcessor
+    _prep(model_set)
+    fs = tmp_path / "force_select.names"
+    fs.write_text("noise\n")
+    fr = tmp_path / "force_remove.names"
+    fr.write_text("velocity\n")
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.varSelect.forceSelectColumnNameFile = str(fs)
+    mc.varSelect.forceRemoveColumnNameFile = str(fr)
+    mc.varSelect.filterNum = 2
+    mc.save(mc_path)
+    assert VarSelectProcessor(model_set, params={}).run() == 0
+    by_name = {c.columnName: c for c in _ccs(model_set)}
+    assert by_name["noise"].finalSelect          # force-selected despite low ks
+    assert not by_name["velocity"].finalSelect   # force-removed
+    assert by_name["velocity"].columnFlag is not None
+
+
+def test_varselect_auto_filter_missing_rate(model_set):
+    from shifu_tpu.pipeline.varselect import VarSelectProcessor
+    _prep(model_set)
+    ccs = _ccs(model_set)
+    # artificially mark one column as nearly-all-missing
+    for c in ccs:
+        if c.columnName == "noise":
+            c.columnStats.missingPercentage = 0.99
+    from shifu_tpu.config import save_column_configs
+    save_column_configs(ccs, os.path.join(model_set, "ColumnConfig.json"))
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.varSelect.autoFilterEnable = True
+    mc.varSelect.filterNum = 10
+    mc.save(mc_path)
+    assert VarSelectProcessor(model_set, params={}).run() == 0
+    by_name = {c.columnName: c for c in _ccs(model_set)}
+    assert not by_name["noise"].finalSelect
