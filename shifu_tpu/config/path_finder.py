@@ -83,8 +83,12 @@ class PathFinder:
         return os.path.join(self.varsel_dir, "varsel.history")
 
     def model_path(self, index: int, alg: Optional[str] = None) -> str:
-        alg = (alg or self.model_config.train.algorithm.name).lower()
-        return os.path.join(self.models_dir, f"model{index}.{alg}")
+        if alg is None:
+            alg = self.model_config.train.algorithm.name
+            # algorithms that train through another family share its
+            # extension (TENSORFLOW bridges to the NN path, SVM to LR)
+            alg = {"TENSORFLOW": "nn", "SVM": "lr"}.get(alg, alg)
+        return os.path.join(self.models_dir, f"model{index}.{alg.lower()}")
 
     def tmp_model_path(self, index: int, epoch: int, alg: Optional[str] = None) -> str:
         alg = (alg or self.model_config.train.algorithm.name).lower()

@@ -62,7 +62,8 @@ class Scorer:
             digits = "".join(ch for ch in stem if ch.isdigit())
             return (int(digits) if digits else 0, p)
 
-        paths = sorted(glob.glob(os.path.join(models_dir, "model*.*")),
+        paths = sorted((p for p in glob.glob(os.path.join(models_dir, "model*.*"))
+                        if not p.endswith(".json")),  # convert sidecars
                        key=index_key)
         models = [load_any(p) for p in paths]
         if not models:
@@ -75,11 +76,14 @@ class Scorer:
         NN/LR the normalized floats — both come from one transform pass."""
         cols = []
         for m in self.models:
-            if getattr(m, "input_kind", "norm") == "bins":
-                if bins is None:
-                    raise ValueError("tree model requires binned input — "
-                                     "pass bins= to Scorer.score")
+            kind = getattr(m, "input_kind", "norm")
+            if kind in ("bins", "both") and bins is None:
+                raise ValueError(f"{type(m).__name__} requires binned input "
+                                 "— pass bins= to Scorer.score")
+            if kind == "bins":
                 cols.append(np.asarray(m.compute(bins))[:, 0])
+            elif kind == "both":
+                cols.append(np.asarray(m.compute_full(x, bins))[:, 0])
             else:
                 cols.append(np.asarray(m.compute(x))[:, 0])
         raw = np.stack(cols, axis=1) * self.scale

@@ -1,11 +1,23 @@
 """PMML export — reference ``core/pmml/PMMLTranslator.java:47,77`` +
 ``core/pmml/builder/impl/`` (16 builder classes) reduced to three builders
 over ``xml.etree``: RegressionModel (LR), NeuralNetwork (NN),
-MiningModel/TreeModel segmentation (GBT/RF).
+MiningModel/TreeModel segmentation (GBT/RF), targeting PMML 4.2.
 
-The reference builds DataDictionary + LocalTransformations (zscore / woe
-derived fields) + per-family model elements, verified against
-jpmml-evaluator in its tests; here the same structure targets PMML 4.2.
+Score parity with the native scorer is the contract (the reference verifies
+against jpmml-evaluator): every DerivedField is computed from the SAME
+Normalizer tables used in training —
+
+- numeric z-score family → clamped zscore ``Apply`` (mapMissingTo=0 ≙
+  missing→mean);
+- numeric woe/discrete families → ``Discretize`` whose bins output the
+  exact per-bin normalized value;
+- categorical (any width-1 norm) → ``MapValues`` category→value computed by
+  ``NormalizedColumn.transform`` on each bin index;
+- GBT trees: leaf values pre-scaled by shrinkage, an init-score constant
+  segment, and a logistic-link OutputField for log loss.
+
+One-hot expanding norms are rejected with a clear error (mapping a widened
+net back to per-column fields is not yet supported).
 """
 
 from __future__ import annotations
@@ -17,8 +29,18 @@ import numpy as np
 
 from ..config import ColumnConfig
 from ..config.model_config import ModelConfig, NormType
+from ..ops.normalize import NormalizedColumn
 
 PMML_NS = "http://www.dmg.org/PMML-4_2"
+
+ZSCORE_FAMILY = {NormType.ZSCALE, NormType.ZSCORE, NormType.OLD_ZSCALE,
+                 NormType.OLD_ZSCORE, NormType.HYBRID, NormType.WEIGHT_HYBRID,
+                 NormType.ZSCALE_ONEHOT, NormType.ZSCALE_INDEX,
+                 NormType.ZSCORE_INDEX}
+
+
+class PmmlUnsupportedError(ValueError):
+    pass
 
 
 def _pmml_root() -> ET.Element:
@@ -56,43 +78,84 @@ def _derived_name(cc: ColumnConfig) -> str:
     return f"shifu::{cc.columnName}"
 
 
+def _categorical_value_table(cc: ColumnConfig, nc: NormalizedColumn
+                             ) -> np.ndarray:
+    """Exact per-bin normalized output (incl. the trailing missing bin)."""
+    nb = cc.num_bins() + 1
+    idx = np.arange(nb)
+    return nc.transform(np.zeros(nb), np.zeros(nb, bool), idx)[:, 0]
+
+
+def _numeric_bin_values(cc: ColumnConfig, nc: NormalizedColumn) -> np.ndarray:
+    nb = cc.num_bins() + 1
+    idx = np.arange(nb)
+    # values/valid only matter for zscore paths, which don't take this branch
+    return nc.transform(np.zeros(nb), np.ones(nb, bool), idx)[:, 0]
+
+
 def _local_transformations(parent: ET.Element, columns: List[ColumnConfig],
-                           norm_type: NormType, cutoff: float) -> None:
-    """Per-column DerivedField: woe lookup for categorical / woe norms,
-    clamped zscore for numeric (reference woe/zscore local-transform
-    creators)."""
+                           model_config: ModelConfig) -> None:
+    norm_type = model_config.normalize.normType
+    cutoff = model_config.normalize.stdDevCutOff
     lt = ET.SubElement(parent, "LocalTransformations")
-    woe_like = norm_type.name.startswith("WOE") or norm_type in (
-        NormType.HYBRID, NormType.WEIGHT_HYBRID)
     for cc in columns:
+        nc = NormalizedColumn(cc, norm_type, cutoff)
+        if nc.width != 1:
+            raise PmmlUnsupportedError(
+                f"column {cc.columnName}: norm type {norm_type.name} expands "
+                "to multiple features (onehot) — PMML export not supported "
+                "for onehot norms yet")
         df = ET.SubElement(lt, "DerivedField",
                            {"name": _derived_name(cc), "optype": "continuous",
                             "dataType": "double"})
-        if cc.is_categorical() or woe_like:
-            _woe_mapping(df, cc, weighted="WEIGHT" in norm_type.name)
-        else:
+        if cc.is_categorical():
+            vals = _categorical_value_table(cc, nc)
+            _map_values(df, cc, vals)
+        elif norm_type in ZSCORE_FAMILY:
             _zscore_transform(df, cc, cutoff)
+        else:
+            # per-bin table norms (WOE / WOE_ZSCALE / DISCRETE_* / ...)
+            vals = _numeric_bin_values(cc, nc)
+            _discretize(df, cc, vals)
 
 
-def _woe_mapping(df: ET.Element, cc: ColumnConfig, weighted: bool) -> None:
-    woes = (cc.columnBinning.binWeightedWoe if weighted
-            else cc.columnBinning.binCountWoe) or []
-    mv = ET.SubElement(df, "MapValues", {"outputColumn": "out",
-                                         "defaultValue": "0.0"})
+def _map_values(df: ET.Element, cc: ColumnConfig, vals: np.ndarray) -> None:
+    mv = ET.SubElement(df, "MapValues", {
+        "outputColumn": "out", "dataType": "double",
+        # unseen / missing category -> the missing-bin value
+        "defaultValue": f"{vals[-1]:.6f}", "mapMissingTo": f"{vals[-1]:.6f}"})
     ET.SubElement(mv, "FieldColumnPair", {"field": cc.columnName,
                                           "column": "in"})
     table = ET.SubElement(mv, "InlineTable")
-    cats = cc.bin_category or []
-    for cat, woe in zip(cats, woes):
+    for cat, v in zip(cc.bin_category or [], vals[:-1]):
         row = ET.SubElement(table, "row")
         ET.SubElement(row, "in").text = str(cat)
-        ET.SubElement(row, "out").text = f"{woe:.6f}"
+        ET.SubElement(row, "out").text = f"{v:.6f}"
+
+
+def _discretize(df: ET.Element, cc: ColumnConfig, vals: np.ndarray) -> None:
+    """Numeric bin-table norm: Discretize where each bin outputs its
+    normalized value directly (missing -> missing-bin value)."""
+    bounds = cc.bin_boundary or []
+    disc = ET.SubElement(df, "Discretize", {
+        "field": cc.columnName, "dataType": "double",
+        "defaultValue": f"{vals[-1]:.6f}", "mapMissingTo": f"{vals[-1]:.6f}"})
+    for i in range(len(bounds)):
+        b = ET.SubElement(disc, "DiscretizeBin",
+                          {"binValue": f"{vals[i]:.6f}"})
+        iv = {"closure": "closedOpen"}
+        if np.isfinite(bounds[i]):
+            iv["leftMargin"] = f"{bounds[i]:.6g}"
+        if i + 1 < len(bounds) and np.isfinite(bounds[i + 1]):
+            iv["rightMargin"] = f"{bounds[i + 1]:.6g}"
+        ET.SubElement(b, "Interval", iv)
 
 
 def _zscore_transform(df: ET.Element, cc: ColumnConfig, cutoff: float) -> None:
     mean, std = cc.mean(), cc.std_dev()
     lo, hi = mean - cutoff * std, mean + cutoff * std
-    apply_div = ET.SubElement(df, "Apply", {"function": "/"})
+    apply_div = ET.SubElement(df, "Apply", {"function": "/",
+                                            "mapMissingTo": "0"})
     apply_sub = ET.SubElement(apply_div, "Apply", {"function": "-"})
     apply_max = ET.SubElement(apply_sub, "Apply", {"function": "max"})
     apply_min = ET.SubElement(apply_max, "Apply", {"function": "min"})
@@ -107,7 +170,12 @@ def _zscore_transform(df: ET.Element, cc: ColumnConfig, cutoff: float) -> None:
 def nn_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
                spec, params) -> ET.ElementTree:
     """NeuralNetwork PMML (reference NNPmmlModelCreator +
-    NeuralNetworkModelIntegrator)."""
+    NeuralNetworkModelIntegrator).  Requires width-1 norms so net input i ==
+    column i's derived field."""
+    if spec.input_dim != len(columns):
+        raise PmmlUnsupportedError(
+            f"net input dim {spec.input_dim} != {len(columns)} columns — "
+            "onehot-expanded nets cannot be exported to PMML yet")
     target = model_config.dataSet.targetColumnName or "target"
     root = _pmml_root()
     _data_dictionary(root, columns, target)
@@ -116,26 +184,17 @@ def nn_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
         "activationFunction": _pmml_act(spec.activations[0]
                                         if spec.activations else "tanh")})
     _mining_schema(nn, columns, target)
-    _local_transformations(nn, columns, model_config.normalize.normType,
-                           model_config.normalize.stdDevCutOff)
+    _local_transformations(nn, columns, model_config)
 
     inputs = ET.SubElement(nn, "NeuralInputs",
                            {"numberOfInputs": str(spec.input_dim)})
     in_ids = []
-    for i, cc in enumerate(columns[:spec.input_dim]):
+    for i, cc in enumerate(columns):
         nid = f"0,{i}"
         ni = ET.SubElement(inputs, "NeuralInput", {"id": nid})
         df = ET.SubElement(ni, "DerivedField", {"optype": "continuous",
                                                 "dataType": "double"})
         ET.SubElement(df, "FieldRef", {"field": _derived_name(cc)})
-        in_ids.append(nid)
-    # pad ids for expanded (onehot) feature spaces
-    for i in range(len(in_ids), spec.input_dim):
-        nid = f"0,{i}"
-        ni = ET.SubElement(inputs, "NeuralInput", {"id": nid})
-        df = ET.SubElement(ni, "DerivedField", {"optype": "continuous",
-                                                "dataType": "double"})
-        ET.SubElement(df, "FieldRef", {"field": f"feature_{i}"})
         in_ids.append(nid)
 
     prev_ids = in_ids
@@ -171,18 +230,21 @@ def lr_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
                spec, params) -> ET.ElementTree:
     """RegressionModel PMML with logit normalization (reference
     RegressionPmmlModelCreator)."""
+    if spec.input_dim != len(columns):
+        raise PmmlUnsupportedError(
+            f"LR input dim {spec.input_dim} != {len(columns)} columns — "
+            "onehot-expanded models cannot be exported to PMML yet")
     target = model_config.dataSet.targetColumnName or "target"
     root = _pmml_root()
     _data_dictionary(root, columns, target)
     rm = ET.SubElement(root, "RegressionModel", {
         "functionName": "regression", "normalizationMethod": "logit"})
     _mining_schema(rm, columns, target)
-    _local_transformations(rm, columns, model_config.normalize.normType,
-                           model_config.normalize.stdDevCutOff)
+    _local_transformations(rm, columns, model_config)
     w = np.asarray(params[0]["w"])[:, 0]
     b = float(np.asarray(params[0]["b"])[0])
     table = ET.SubElement(rm, "RegressionTable", {"intercept": f"{b:.6f}"})
-    for i, cc in enumerate(columns[:len(w)]):
+    for i, cc in enumerate(columns):
         ET.SubElement(table, "NumericPredictor",
                       {"name": _derived_name(cc), "exponent": "1",
                        "coefficient": f"{w[i]:.6f}"})
@@ -191,17 +253,33 @@ def lr_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
 
 def tree_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
                  spec, trees) -> ET.ElementTree:
-    """MiningModel with TreeModel segments (reference TreeEnsemblePmml
-    translator): splits reference bin indices via derived discretized
-    fields."""
+    """MiningModel with TreeModel segments.  Split predicates test the
+    ``bin(col)`` derived fields defined in LocalTransformations (Discretize /
+    MapValues to bin index); GBT leaves are pre-scaled by shrinkage with an
+    init-score constant segment and a logistic OutputField for log loss —
+    scores match the native ``IndependentTreeModel.compute`` exactly (modulo
+    GBT squared-loss clipping, which PMML omits)."""
     target = model_config.dataSet.targetColumnName or "target"
+    is_gbt = spec.algorithm == "GBT"
     root = _pmml_root()
     _data_dictionary(root, columns, target)
     mm = ET.SubElement(root, "MiningModel", {"functionName": "regression"})
     _mining_schema(mm, columns, target)
+    _bin_index_transforms(mm, columns)
+    if is_gbt and spec.loss == "log":
+        _logistic_output(mm)
     seg = ET.SubElement(mm, "Segmentation", {
-        "multipleModelMethod": "sum" if spec.algorithm == "GBT" else "average"})
+        "multipleModelMethod": "sum" if is_gbt else "average"})
     col_by_idx = {j: cc for j, cc in enumerate(columns)}
+    scale = spec.learning_rate if is_gbt else 1.0
+    if is_gbt and spec.init_score:
+        s = ET.SubElement(seg, "Segment", {"id": "init"})
+        ET.SubElement(s, "True")
+        tm = ET.SubElement(s, "TreeModel", {"functionName": "regression"})
+        _mining_schema(tm, columns, target)
+        node = ET.SubElement(tm, "Node", {"id": "0",
+                                          "score": f"{spec.init_score:.6f}"})
+        ET.SubElement(node, "True")
     for ti, t in enumerate(trees):
         s = ET.SubElement(seg, "Segment", {"id": str(ti)})
         ET.SubElement(s, "True")
@@ -210,14 +288,69 @@ def tree_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
         _mining_schema(tm, columns, target)
         root_node = ET.SubElement(tm, "Node", {"id": "0", "score": "0"})
         ET.SubElement(root_node, "True")
-        _emit_tree_node(root_node, t, 0, col_by_idx, spec.n_bins)
+        _emit_tree_node(root_node, t, 0, col_by_idx, scale)
     return ET.ElementTree(root)
 
 
+def _bin_index_transforms(mm: ET.Element, columns: List[ColumnConfig]) -> None:
+    """DerivedField ``bin(col)`` = the bin index (integer), matching
+    ``ColumnBinner``: numeric Discretize over boundaries, categorical
+    MapValues; missing/unseen -> the trailing missing bin."""
+    lt = ET.SubElement(mm, "LocalTransformations")
+    for cc in columns:
+        nb = cc.num_bins()
+        df = ET.SubElement(lt, "DerivedField",
+                           {"name": f"bin({cc.columnName})",
+                            "optype": "categorical", "dataType": "integer"})
+        if cc.is_categorical():
+            mv = ET.SubElement(df, "MapValues", {
+                "outputColumn": "out", "dataType": "integer",
+                "defaultValue": str(nb), "mapMissingTo": str(nb)})
+            ET.SubElement(mv, "FieldColumnPair", {"field": cc.columnName,
+                                                  "column": "in"})
+            table = ET.SubElement(mv, "InlineTable")
+            for i, cat in enumerate(cc.bin_category or []):
+                row = ET.SubElement(table, "row")
+                ET.SubElement(row, "in").text = str(cat)
+                ET.SubElement(row, "out").text = str(i)
+        else:
+            bounds = cc.bin_boundary or []
+            disc = ET.SubElement(df, "Discretize", {
+                "field": cc.columnName, "dataType": "integer",
+                "defaultValue": str(nb), "mapMissingTo": str(nb)})
+            for i in range(len(bounds)):
+                b = ET.SubElement(disc, "DiscretizeBin", {"binValue": str(i)})
+                iv = {"closure": "closedOpen"}
+                if np.isfinite(bounds[i]):
+                    iv["leftMargin"] = f"{bounds[i]:.6g}"
+                if i + 1 < len(bounds) and np.isfinite(bounds[i + 1]):
+                    iv["rightMargin"] = f"{bounds[i + 1]:.6g}"
+                ET.SubElement(b, "Interval", iv)
+
+
+def _logistic_output(mm: ET.Element) -> None:
+    out = ET.SubElement(mm, "Output")
+    ET.SubElement(out, "OutputField", {"name": "rawSum", "optype": "continuous",
+                                       "dataType": "double",
+                                       "feature": "predictedValue"})
+    of = ET.SubElement(out, "OutputField", {"name": "score",
+                                            "optype": "continuous",
+                                            "dataType": "double",
+                                            "feature": "transformedValue"})
+    div = ET.SubElement(of, "Apply", {"function": "/"})
+    ET.SubElement(div, "Constant").text = "1"
+    plus = ET.SubElement(div, "Apply", {"function": "+"})
+    ET.SubElement(plus, "Constant").text = "1"
+    expo = ET.SubElement(plus, "Apply", {"function": "exp"})
+    neg = ET.SubElement(expo, "Apply", {"function": "*"})
+    ET.SubElement(neg, "Constant").text = "-1"
+    ET.SubElement(neg, "FieldRef", {"field": "rawSum"})
+
+
 def _emit_tree_node(parent: ET.Element, t, node: int, col_by_idx,
-                    n_bins: int) -> None:
+                    scale: float) -> None:
     feat = int(t.split_feat[node]) if node < len(t.split_feat) else -1
-    parent.set("score", f"{float(t.leaf_value[node]):.6f}")
+    parent.set("score", f"{float(t.leaf_value[node]) * scale:.6f}")
     if feat < 0:
         return
     cc = col_by_idx.get(feat)
@@ -234,7 +367,7 @@ def _emit_tree_node(parent: ET.Element, t, node: int, col_by_idx,
             arr.text = " ".join(bins_attr)
         else:
             ET.SubElement(n, "True")
-        _emit_tree_node(n, t, child, col_by_idx, n_bins)
+        _emit_tree_node(n, t, child, col_by_idx, scale)
 
 
 def _pmml_act(name: str) -> str:

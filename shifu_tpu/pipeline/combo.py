@@ -125,10 +125,18 @@ def _eval_members(d: str, members: List[str]) -> int:
                 s_parts.append(out["result"].mean)
                 t_parts.append(out["target"])
                 w_parts.append(out["weight"])
+            if not s_parts:
+                log.error("combo eval %s: no usable rows (check tags/filter) "
+                          "— skipping", ev.name)
+                rc = 1
+                member_scores = []
+                break
             member_scores.append(np.concatenate(s_parts))
             if targets is None:
                 targets = np.concatenate(t_parts)
                 weights = np.concatenate(w_parts)
+        if not member_scores:
+            continue
         assembled = np.mean(np.stack(member_scores), axis=0)
         res = evaluate_scores(assembled, targets, weights,
                               buckets=ev.performanceBucketNum)

@@ -43,23 +43,34 @@ class ExportProcessor(BasicProcessor):
         if not columns:
             columns = [c for c in self.column_configs
                        if c.is_candidate() and c.num_bins() > 0]
-        paths = sorted(glob.glob(os.path.join(self.paths.models_dir, "model*.*")))
+        paths = sorted(p for p in glob.glob(
+            os.path.join(self.paths.models_dir, "model*.*"))
+            if not p.endswith(".json"))
         if not paths:
             log.error("no models to export — run `train` first")
             return 1
+        from ..export.pmml import PmmlUnsupportedError
         for i, mp in enumerate(paths):
             kind = spec_kind(mp)
-            if kind == "tree":
-                from ..models import tree as tree_model
-                spec, trees = tree_model.load_model(mp)
-                doc = pmml_mod.tree_to_pmml(mc, columns, spec, trees)
-            else:
-                from ..models import nn as nn_model
-                spec, params = nn_model.load_model(mp)
-                if spec.hidden_nodes:
-                    doc = pmml_mod.nn_to_pmml(mc, columns, spec, params)
+            try:
+                if kind == "tree":
+                    from ..models import tree as tree_model
+                    spec, trees = tree_model.load_model(mp)
+                    doc = pmml_mod.tree_to_pmml(mc, columns, spec, trees)
+                elif kind == "wdl":
+                    raise PmmlUnsupportedError(
+                        "WDL (embedding) models have no PMML mapping yet — "
+                        "use the native .wdl spec")
                 else:
-                    doc = pmml_mod.lr_to_pmml(mc, columns, spec, params)
+                    from ..models import nn as nn_model
+                    spec, params = nn_model.load_model(mp)
+                    if spec.hidden_nodes:
+                        doc = pmml_mod.nn_to_pmml(mc, columns, spec, params)
+                    else:
+                        doc = pmml_mod.lr_to_pmml(mc, columns, spec, params)
+            except PmmlUnsupportedError as e:
+                log.error("pmml export of %s failed: %s", mp, e)
+                return 1
             out = self.paths.pmml_path(i)
             pmml_mod.write_pmml(doc, out)
             log.info("pmml -> %s", out)

@@ -94,8 +94,13 @@ class TrainProcessor(BasicProcessor):
             log.info("dry run: algorithm=%s bags=%d epochs=%d", alg.name,
                      mc.train.baggingNum, mc.train.numTrainEpochs)
             return 0
-        if alg in (Algorithm.NN, Algorithm.LR, Algorithm.SVM):
-            return self._train_nn_family(alg)
+        if alg in (Algorithm.NN, Algorithm.LR, Algorithm.SVM,
+                   Algorithm.TENSORFLOW):
+            # TENSORFLOW: the reference bridges to TF-on-YARN
+            # (TrainModelProcessor.java:395-449); tpu-native IS the bridge —
+            # the same net trains as the jitted NN path
+            return self._train_nn_family(
+                Algorithm.NN if alg == Algorithm.TENSORFLOW else alg)
         if alg in (Algorithm.GBT, Algorithm.RF, Algorithm.DT):
             from ..train.dt_trainer import run_tree_training
             return run_tree_training(self)

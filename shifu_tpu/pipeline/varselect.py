@@ -121,6 +121,12 @@ class VarSelectProcessor(BasicProcessor):
                       and c.columnStats.ks is not None]
         if vs.autoFilterEnable:
             candidates = self._auto_filter(candidates, vs)
+        # clear stale selection on every non-forced column first: columns
+        # pruned from `candidates` this run must not keep finalSelect from a
+        # previous run
+        for c in self.column_configs:
+            if not c.is_force_select():
+                c.finalSelect = False
         if not vs.filterEnable:
             for c in candidates:
                 c.finalSelect = True

@@ -1,0 +1,191 @@
+"""WDL trainer — reference ``WDLWorker``/``WDLMaster``/``WDLOutput``
+(``core/dtrain/wdl/``): the BSP gradient loop as jitted minibatch steps over
+the dual data planes (normalized numerics + categorical bin indices).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..config.model_config import Algorithm
+from ..data.shards import Shards
+from ..models import wdl as wdl_model
+from .early_stop import WindowEarlyStop
+from .optimizers import make_optimizer
+from .sampling import validation_split
+
+log = logging.getLogger(__name__)
+
+
+def split_planes(x: np.ndarray, bins: np.ndarray, schema: dict,
+                 column_configs) -> Tuple[np.ndarray, np.ndarray, List[int],
+                                          List[int], List[int], List[int]]:
+    """Split the materialized planes into (numeric features, categorical bin
+    indices) by column type: numerics keep their normalized block, each
+    categorical column contributes its bin index (embedding id)."""
+    col_nums = schema["columnNums"]
+    names = schema["outputNames"]
+    by_num = {c.columnNum: c for c in column_configs}
+    # map output features back to source columns by name prefix
+    name_to_num = {by_num[cn].columnName: cn for cn in col_nums if cn in by_num}
+    blocks: Dict[int, List[int]] = {}
+    for i, n in enumerate(names):
+        base = n
+        if base not in name_to_num and "_" in base:
+            stem, suf = base.rsplit("_", 1)
+            if stem in name_to_num and suf.isdigit():
+                base = stem
+        cn = name_to_num.get(base)
+        if cn is not None:
+            blocks.setdefault(cn, []).append(i)
+
+    num_feat_idx: List[int] = []
+    num_col_nums: List[int] = []
+    cat_col_idx: List[int] = []
+    cat_col_nums: List[int] = []
+    for j, cn in enumerate(col_nums):
+        cc = by_num.get(cn)
+        if cc is None:
+            continue
+        if cc.is_categorical():
+            cat_col_idx.append(j)
+            cat_col_nums.append(cn)
+        else:
+            num_feat_idx.extend(blocks.get(cn, []))
+            num_col_nums.append(cn)
+    x_num = x[:, num_feat_idx] if num_feat_idx else np.zeros((len(x), 0),
+                                                             np.float32)
+    x_cat = bins[:, cat_col_idx] if cat_col_idx else np.zeros((len(x), 0),
+                                                              np.int32)
+    return x_num, x_cat, num_feat_idx, cat_col_idx, num_col_nums, cat_col_nums
+
+
+def run_wdl_training(proc) -> int:
+    mc = proc.model_config
+    norm = Shards.open(proc.paths.norm_dir)
+    clean = Shards.open(proc.paths.clean_dir)
+    ndata = norm.load_all()
+    cdata = clean.load_all()
+    x, y, w = ndata["x"], ndata["y"], ndata["w"]
+    bins = cdata["bins"].astype(np.int32)
+    schema = norm.schema
+    x_num, x_cat, num_feat_idx, cat_col_idx, num_nums, cat_nums = \
+        split_planes(x, bins, schema, proc.column_configs)
+
+    by_num = {c.columnNum: c for c in proc.column_configs}
+    cards = [by_num[cn].num_bins() + 1 for cn in cat_nums]
+    p = mc.train.params or {}
+    spec = wdl_model.WDLModelSpec(
+        numeric_dim=x_num.shape[1], cat_cardinalities=cards,
+        embed_dim=int(p.get("EmbedColumnNum", p.get("EmbedDim", 8))),
+        hidden_nodes=[int(v) for v in p.get("NumHiddenNodes", [64, 32])],
+        activations=[str(a).lower()
+                     for a in p.get("ActivationFunc", ["relu", "relu"])],
+        wide_enable=bool(p.get("WideEnable", True)),
+        deep_enable=bool(p.get("DeepEnable", True)),
+        column_nums=num_nums, cat_column_nums=cat_nums,
+        extra={"num_feat_idx": num_feat_idx, "cat_col_idx": cat_col_idx})
+    n = len(y)
+    log.info("train WDL: %d rows, %d numeric + %d categorical cols "
+             "(embed %d)", n, x_num.shape[1], len(cards), spec.embed_dim)
+
+    settings = {
+        "lr": float(p.get("LearningRate", 0.002)),
+        "l2": float(p.get("RegularizedConstant", p.get("L2Const", 1e-5))),
+        "epochs": int(mc.train.numTrainEpochs),
+        "batch": int(p.get("MiniBatchs", 128)),
+        "optimizer": str(p.get("Optimizer", "ADAM")),
+        "window": int(p.get("WindowSize", 10)) if mc.train.earlyStopEnable else 0,
+    }
+    res = train_wdl(x_num, x_cat, y, w, spec, settings,
+                    valid_rate=mc.train.validSetRate,
+                    seed=int(p.get("Seed", 0)),
+                    progress_path=proc.paths.progress_path)
+
+    os.makedirs(proc.paths.models_dir, exist_ok=True)
+    for f in os.listdir(proc.paths.models_dir):
+        if f.startswith("model"):
+            os.remove(os.path.join(proc.paths.models_dir, f))
+    wdl_model.save_model(proc.paths.model_path(0, "wdl"), spec, res["params"])
+    log.info("train WDL done: valid error %.6f (%d epochs)",
+             res["valid_error"], res["epochs_run"])
+    return 0
+
+
+def train_wdl(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
+              settings: dict, valid_rate: float = 0.2, seed: int = 0,
+              progress_path: Optional[str] = None) -> dict:
+    n = len(y)
+    vmask = validation_split(n, valid_rate, seed)
+    tw = np.asarray(w, np.float32) * ~vmask
+    vw = np.asarray(w, np.float32) * vmask
+
+    xn = jnp.asarray(x_num, jnp.float32)
+    xc = jnp.asarray(x_cat, jnp.int32)
+    yj = jnp.asarray(y, jnp.float32)[:, None]
+    twj = jnp.asarray(tw)
+    vwj = jnp.asarray(vw)
+
+    key = jax.random.PRNGKey(seed)
+    params = wdl_model.init_params(key, spec)
+    opt = make_optimizer(settings["optimizer"], settings["lr"])
+    opt_state = opt.init(params)
+    l2 = settings["l2"]
+
+    @jax.jit
+    def step(params, opt_state, xn_b, xc_b, y_b, w_b):
+        loss, grads = jax.value_and_grad(wdl_model.weighted_loss)(
+            params, spec, xn_b, xc_b, y_b, w_b, l2)
+        delta, opt_state = opt.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda a, d: a + d, params, delta)
+        return params, opt_state, loss
+
+    @jax.jit
+    def errors(params):
+        p = wdl_model.forward(params, spec, xn, xc)
+        per = -(yj * jnp.log(jnp.clip(p, 1e-7, 1.0))
+                + (1 - yj) * jnp.log(jnp.clip(1 - p, 1e-7, 1.0)))[:, 0]
+        tr = (per * twj).sum() / jnp.maximum(twj.sum(), 1e-9)
+        va = (per * vwj).sum() / jnp.maximum(vwj.sum(), 1e-9)
+        return tr, va
+
+    bs = max(8, settings["batch"])
+    stop = WindowEarlyStop(settings["window"]) if settings["window"] else None
+    best_va, best_params = np.inf, params
+    pf = open(progress_path, "w") if progress_path else None
+    epochs_run = 0
+    history = []
+    rng = np.random.default_rng(seed)
+    try:
+        for epoch in range(settings["epochs"]):
+            perm = rng.permutation(n)
+            for s in range(0, n - bs + 1, bs):
+                idx = jnp.asarray(perm[s:s + bs])
+                params, opt_state, _ = step(params, opt_state, xn[idx],
+                                            xc[idx], yj[idx], twj[idx])
+            tr, va = errors(params)
+            tr, va = float(tr), float(va)
+            history.append((tr, va))
+            epochs_run = epoch + 1
+            if pf:
+                pf.write(f"Epoch #{epoch + 1} Train Error: {tr:.6f} "
+                         f"Validation Error: {va:.6f}\n")
+                pf.flush()
+            if va < best_va:
+                best_va = va
+                best_params = jax.tree_util.tree_map(np.asarray, params)
+            if stop and stop.should_stop(va):
+                log.info("WDL early stop at epoch %d", epoch)
+                break
+    finally:
+        if pf:
+            pf.close()
+    return {"params": best_params, "valid_error": best_va,
+            "epochs_run": epochs_run, "history": history}

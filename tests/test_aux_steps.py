@@ -67,7 +67,17 @@ def test_export_pmml_tree(model_set):
     assert mm is not None
     segs = mm.find("p:Segmentation", NS)
     assert segs.get("multipleModelMethod") == "sum"
-    assert len(segs.findall("p:Segment", NS)) == 3
+    # 3 tree segments + the GBT init-score constant segment
+    assert len(segs.findall("p:Segment", NS)) == 4
+    assert segs.find("p:Segment[@id='init']", NS) is not None
+    # every bin(col) split field is defined in LocalTransformations
+    lt = mm.find("p:LocalTransformations", NS)
+    defined = {df.get("name") for df in lt.findall("p:DerivedField", NS)}
+    used = {p.get("field") for p in mm.iter(f"{{{NS['p']}}}SimpleSetPredicate")}
+    assert used <= defined and used
+    # log loss -> logistic link output
+    out = mm.find("p:Output", NS)
+    assert out is not None and len(out.findall("p:OutputField", NS)) == 2
 
 
 def test_export_columnstats_and_woe(model_set):
