@@ -60,6 +60,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("train", help="train model(s)")
     sp.add_argument("-dry", dest="dry", action="store_true")
     sp.add_argument("-shuffle", dest="shuffle", action="store_true")
+    sp.add_argument("-resume", dest="resume", action="store_true",
+                    help="resume from the latest trainer-state checkpoint")
 
     sub.add_parser("posttrain", help="bin-average scores + feature importance")
 
@@ -87,6 +89,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("convert", help="convert model spec zip<->binary")
     sp.add_argument("-tozipb", dest="tozipb", action="store_true")
     sp.add_argument("-tob", dest="tob", action="store_true")
+
+    sp = sub.add_parser("save", help="snapshot model-set version")
+    sp.add_argument("name", nargs="?", default=None)
+    sp = sub.add_parser("switch", help="restore a saved model-set version")
+    sp.add_argument("name")
+    sub.add_parser("history", help="list saved model-set versions")
     return p
 
 
@@ -138,6 +146,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     if cmd == "convert":
         from .pipeline.convert import run_convert
         return run_convert(args.dir, vars(args))
+    if cmd == "save":
+        from .pipeline.manage import save_version
+        return save_version(args.dir, args.name)
+    if cmd == "switch":
+        from .pipeline.manage import switch_version
+        return switch_version(args.dir, args.name)
+    if cmd == "history":
+        from .pipeline.manage import show_history
+        return show_history(args.dir)
     raise SystemExit(f"unknown command {cmd}")
 
 

@@ -29,6 +29,9 @@ import numpy as np
 
 from ..config.model_config import BinningMethod
 
+# merged-category group separator (reference uses \u0001 in CategoricalBinInfo)
+CATEGORY_GROUP_SEP = "\x01"
+
 NEG_INF = float("-inf")
 
 
@@ -299,8 +302,15 @@ class ColumnBinner:
         assert (boundaries is None) != (categories is None)
         self.boundaries = None if boundaries is None else np.asarray(boundaries, np.float64)
         self.categories = categories
-        self.cat_index = None if categories is None else \
-            {c: i for i, c in enumerate(categories)}
+        if categories is None:
+            self.cat_index = None
+        else:
+            # a bin label may be a merged group of raw categories joined by
+            # CATEGORY_GROUP_SEP (dynamic rebin; reference CategoricalBinInfo)
+            self.cat_index = {}
+            for i, c in enumerate(categories):
+                for member in c.split(CATEGORY_GROUP_SEP):
+                    self.cat_index[member] = i
 
     @property
     def num_bins(self) -> int:

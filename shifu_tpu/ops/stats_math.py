@@ -74,3 +74,45 @@ def psi(expected: np.ndarray, actual: np.ndarray) -> np.ndarray:
     with np.errstate(divide="ignore", invalid="ignore"):
         term = (a - e) * np.log((a + EPS) / (e + EPS))
     return term.sum(axis=-1)
+
+
+# ----------------------------------------------------------- dynamic rebin
+def merge_adjacent_by_iv(neg: np.ndarray, pos: np.ndarray,
+                         target_bins: int, iv_keep: float = 0.95
+                         ) -> list:
+    """IV-driven adjacent bin merge (reference ``DynamicBinning`` /
+    ``AutoDynamicBinning``: merge bins while information value survives).
+
+    neg/pos: per-VALUE-bin counts (missing bin excluded).  Greedily merges
+    the adjacent pair whose merge preserves the most IV until ``target_bins``
+    is reached; continues below that only while IV stays above
+    ``iv_keep * original``.  Returns the list of merged index groups (each a
+    list of original bin indices, in order).
+    """
+    groups = [[i] for i in range(len(neg))]
+    neg = list(np.asarray(neg, np.float64))
+    pos = list(np.asarray(pos, np.float64))
+
+    def iv_of(n, p):
+        return float(np.nan_to_num(
+            column_metrics(np.asarray(n)[None, :], np.asarray(p)[None, :]).iv[0]))
+
+    iv0 = iv_of(neg, pos)
+    while len(groups) > 2:
+        best_i, best_iv = -1, -np.inf
+        for i in range(len(groups) - 1):
+            n2 = neg[:i] + [neg[i] + neg[i + 1]] + neg[i + 2:]
+            p2 = pos[:i] + [pos[i] + pos[i + 1]] + pos[i + 2:]
+            iv = iv_of(n2, p2)
+            if iv > best_iv:
+                best_i, best_iv = i, iv
+        need_shrink = len(groups) > target_bins
+        if not need_shrink and (iv0 <= 0 or best_iv < iv_keep * iv0):
+            break
+        i = best_i
+        neg[i] += neg[i + 1]
+        pos[i] += pos[i + 1]
+        del neg[i + 1], pos[i + 1]
+        groups[i] = groups[i] + groups[i + 1]
+        del groups[i + 1]
+    return groups

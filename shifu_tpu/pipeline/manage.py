@@ -1,0 +1,84 @@
+"""Model-set version management — reference ``ManageModelProcessor.java``
+(git-branch-like save/switch of model-set versions).
+
+``save [name]`` snapshots ModelConfig.json + ColumnConfig.json + models/
+into ``.backup/<name>/``; ``switch <name>`` restores a snapshot (saving the
+current state to ``.backup/autosave`` first); ``history`` lists versions.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import time
+from typing import List, Optional
+
+log = logging.getLogger(__name__)
+
+VERSIONED = ["ModelConfig.json", "ColumnConfig.json", "models"]
+
+
+def _backup_dir(model_set_dir: str) -> str:
+    return os.path.join(os.path.abspath(model_set_dir), ".backup")
+
+
+def list_versions(model_set_dir: str) -> List[str]:
+    bd = _backup_dir(model_set_dir)
+    if not os.path.isdir(bd):
+        return []
+    return sorted(d for d in os.listdir(bd)
+                  if os.path.isdir(os.path.join(bd, d)))
+
+
+def save_version(model_set_dir: str, name: Optional[str] = None) -> int:
+    d = os.path.abspath(model_set_dir)
+    if not os.path.isfile(os.path.join(d, "ModelConfig.json")):
+        log.error("no ModelConfig.json in %s", d)
+        return 1
+    name = name or time.strftime("v%Y%m%d-%H%M%S")
+    dst = os.path.join(_backup_dir(d), name)
+    if os.path.isdir(dst):
+        shutil.rmtree(dst)
+    os.makedirs(dst)
+    for item in VERSIONED:
+        src = os.path.join(d, item)
+        if os.path.isdir(src):
+            shutil.copytree(src, os.path.join(dst, item))
+        elif os.path.isfile(src):
+            shutil.copy2(src, os.path.join(dst, item))
+    log.info("saved model-set version %s", name)
+    return 0
+
+
+def switch_version(model_set_dir: str, name: str) -> int:
+    d = os.path.abspath(model_set_dir)
+    src = os.path.join(_backup_dir(d), name)
+    if not os.path.isdir(src):
+        log.error("no saved version %s (have: %s)", name,
+                  list_versions(model_set_dir) or "none")
+        return 1
+    save_version(model_set_dir, "autosave")  # never lose current state
+    for item in VERSIONED:
+        cur = os.path.join(d, item)
+        snap = os.path.join(src, item)
+        if os.path.isdir(cur):
+            shutil.rmtree(cur)
+        elif os.path.isfile(cur):
+            os.remove(cur)
+        if os.path.isdir(snap):
+            shutil.copytree(snap, cur)
+        elif os.path.isfile(snap):
+            shutil.copy2(snap, cur)
+    log.info("switched to model-set version %s", name)
+    return 0
+
+
+def show_history(model_set_dir: str) -> int:
+    versions = list_versions(model_set_dir)
+    if not versions:
+        log.info("no saved versions")
+        return 0
+    for v in versions:
+        log.info("version: %s", v)
+    return 0

@@ -92,6 +92,8 @@ class StatsProcessor(BasicProcessor):
             self._write_correlation(corr_acc, num_cols)
         if psi_col:
             self._compute_psi(source, extractor, psi_col)
+        if self.params.get("rebin"):
+            self._dynamic_rebin()
 
         self.save_column_configs()
         log.info("stats: %d rows, %d numeric, %d categorical columns",
@@ -266,3 +268,73 @@ def _f(x) -> Optional[float]:
 
 def _fl(arr) -> List[Optional[float]]:
     return [(_f(x) if x == x else None) for x in np.asarray(arr, dtype=np.float64)]
+
+
+def _merge_vals(vals, groups):
+    return [sum(vals[i] for i in g) for g in groups]
+
+
+# appended as a method via assignment below to keep the class block above
+# readable (the rebin pass is self-contained)
+def _dynamic_rebin(self) -> None:
+    """``stats -rebin``: IV-driven merge of adjacent value bins (reference
+    ``DynamicBinningUDF`` / ``AutoDynamicBinning``), honoring
+    ``-Dshifu.rebin.maxNumBin`` / ``-Dshifu.rebin.ivKeepRatio``."""
+    from ..config import environment
+    from ..ops.binning import CATEGORY_GROUP_SEP
+    from ..ops.stats_math import column_metrics, merge_adjacent_by_iv
+
+    target = int(environment.get_property("shifu.rebin.maxNumBin",
+                                          self.model_config.stats.maxNumBin))
+    iv_keep = float(environment.get_property("shifu.rebin.ivKeepRatio", 0.95))
+    merged_cols = 0
+    for cc in self.column_configs:
+        bn = cc.columnBinning
+        if not bn.binCountNeg or len(bn.binCountNeg) < 4:
+            continue
+        neg, pos = bn.binCountNeg[:-1], bn.binCountPos[:-1]  # drop missing bin
+        if cc.is_categorical():
+            # order categories by pos rate so "adjacent" is meaningful
+            rate = [(p / max(p + n, 1e-9)) for p, n in zip(pos, neg)]
+            order = sorted(range(len(rate)), key=lambda i: rate[i])
+        else:
+            order = list(range(len(neg)))
+        groups = merge_adjacent_by_iv(
+            np.asarray([neg[i] for i in order], np.float64),
+            np.asarray([pos[i] for i in order], np.float64),
+            target, iv_keep)
+        if len(groups) >= len(neg):
+            continue
+        merged_cols += 1
+        groups = [[order[i] for i in g] for g in groups]
+        if cc.is_categorical():
+            bn.binCategory = [CATEGORY_GROUP_SEP.join(
+                bn.binCategory[i] for i in g) for g in groups]
+        else:
+            bn.binBoundary = [bn.binBoundary[g[0]] for g in groups]
+        miss_n, miss_p = bn.binCountNeg[-1], bn.binCountPos[-1]
+        wmiss_n, wmiss_p = bn.binWeightedNeg[-1], bn.binWeightedPos[-1]
+        bn.binCountNeg = _merge_vals(bn.binCountNeg[:-1], groups) + [miss_n]
+        bn.binCountPos = _merge_vals(bn.binCountPos[:-1], groups) + [miss_p]
+        bn.binWeightedNeg = _merge_vals(bn.binWeightedNeg[:-1], groups) + [wmiss_n]
+        bn.binWeightedPos = _merge_vals(bn.binWeightedPos[:-1], groups) + [wmiss_p]
+        bn.length = len(groups) + 1
+        neg_a = np.asarray(bn.binCountNeg, np.float64)[None, :]
+        pos_a = np.asarray(bn.binCountPos, np.float64)[None, :]
+        wneg_a = np.asarray(bn.binWeightedNeg, np.float64)[None, :]
+        wpos_a = np.asarray(bn.binWeightedPos, np.float64)[None, :]
+        cm = column_metrics(neg_a, pos_a)
+        wm = column_metrics(wneg_a, wpos_a)
+        tot = neg_a + pos_a
+        bn.binPosRate = _fl(np.where(tot > 0, pos_a / np.maximum(tot, 1), np.nan)[0])
+        bn.binCountWoe = _fl(cm.bin_woe[0])
+        bn.binWeightedWoe = _fl(wm.bin_woe[0])
+        st = cc.columnStats
+        st.ks, st.iv, st.woe = _f(cm.ks[0]), _f(cm.iv[0]), _f(cm.woe[0])
+        st.weightedKs, st.weightedIv = _f(wm.ks[0]), _f(wm.iv[0])
+        st.weightedWoe = _f(wm.woe[0])
+    log.info("rebin: merged bins in %d columns (target %d, ivKeep %.2f)",
+             merged_cols, target, iv_keep)
+
+
+StatsProcessor._dynamic_rebin = _dynamic_rebin

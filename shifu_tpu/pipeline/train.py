@@ -51,6 +51,7 @@ def settings_from_params(params: Dict[str, Any], train_conf,
         weight_initializer=str(p.get("WeightInitializer", "xavier")),
         seed=int(p.get("Seed", 0)),
         tmp_model_every=int(p.get("TmpModelEpochs", 0) or 0),
+        checkpoint_every=int(p.get("CheckpointInterval", 25)),
     )
 
 
@@ -151,6 +152,11 @@ class TrainProcessor(BasicProcessor):
                     spec = nn_spec_from_params(d, run_params, column_nums,
                                                feature_names)
                 settings = settings_from_params(run_params, mc.train)
+                if not is_gs:
+                    # trainer-state fail-over checkpoints (grid trials are
+                    # cheap; only full runs checkpoint/resume)
+                    settings.checkpoint_dir = self.paths.checkpoint_dir
+                    settings.resume = bool(self.params.get("resume"))
                 run_kfold = kfold if not is_gs else -1
                 train_w, valid_w = member_masks(
                     n, len(run) if is_gs else bags,

@@ -1,0 +1,86 @@
+"""Trainer-state checkpointing — the fail-over story (SURVEY.md §5).
+
+The reference leans on Guagua restarting failed masters/workers from the
+last iteration state (``NNMaster.java:517-528``, DT ``doCheckPoint`` to HDFS
+``DTMaster.java:637``).  A synchronous mesh has no partial restart, so the
+equivalent is periodic full-state checkpoints (params + optimizer state +
+epoch + PRNG key) and resume-from-latest.
+
+Format: one npz per checkpoint with leaves in tree-flatten order; restore
+maps them back onto a freshly built template pytree, so arbitrary optimizer
+state trees round-trip without pickling.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import os
+import re
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+log = logging.getLogger(__name__)
+
+_NAME = re.compile(r"ckpt-(\d+)\.npz$")
+
+
+def save_state(directory: str, epoch: int, state: Any, keep: int = 3) -> str:
+    """state: arbitrary pytree of arrays (params, opt_state, rng key...)."""
+    os.makedirs(directory, exist_ok=True)
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    arrays = {f"leaf{i}": np.asarray(l) for i, l in enumerate(leaves)}
+    arrays["__meta__"] = np.frombuffer(json.dumps(
+        {"epoch": epoch, "n_leaves": len(leaves)}).encode(), np.uint8)
+    path = os.path.join(directory, f"ckpt-{epoch}.npz")
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    os.replace(tmp, path)  # atomic: a crash never leaves a torn checkpoint
+    _prune(directory, keep)
+    return path
+
+
+def latest_epoch(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    epochs = [int(m.group(1)) for f in os.listdir(directory)
+              if (m := _NAME.search(f))]
+    return max(epochs) if epochs else None
+
+
+def restore_state(directory: str, template: Any) -> Optional[Tuple[int, Any]]:
+    """Load the latest checkpoint onto ``template``'s structure.  Returns
+    (epoch, state) or None; shape mismatch (config changed) -> None."""
+    epoch = latest_epoch(directory)
+    if epoch is None:
+        return None
+    data = np.load(os.path.join(directory, f"ckpt-{epoch}.npz"))
+    meta = json.loads(bytes(data["__meta__"]).decode())
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    if meta["n_leaves"] != len(leaves):
+        log.warning("checkpoint %d has %d leaves, template %d — ignoring",
+                    epoch, meta["n_leaves"], len(leaves))
+        return None
+    new_leaves = []
+    for i, tmpl in enumerate(leaves):
+        a = data[f"leaf{i}"]
+        if tuple(a.shape) != tuple(np.shape(tmpl)):
+            log.warning("checkpoint leaf %d shape %s != template %s — "
+                        "ignoring checkpoint", i, a.shape, np.shape(tmpl))
+            return None
+        new_leaves.append(a)
+    return meta["epoch"], jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def _prune(directory: str, keep: int) -> None:
+    files = sorted(((int(m.group(1)), f) for f in os.listdir(directory)
+                    if (m := _NAME.search(f))))
+    for _, f in files[:-keep]:
+        os.remove(os.path.join(directory, f))

@@ -49,6 +49,9 @@ class TrainSettings:
     weight_initializer: str = "xavier"
     seed: int = 0
     tmp_model_every: int = 0           # epochs between tmp-model checkpoints
+    checkpoint_dir: str = ""           # "" disables trainer-state checkpoints
+    checkpoint_every: int = 25
+    resume: bool = False               # restore latest trainer state
     opt_kwargs: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -156,8 +159,22 @@ def train_ensemble(x: np.ndarray, y: np.ndarray,
     epochs_run = 0
     tr = va = np.zeros(bags)
 
+    start_epoch = 0
+    if settings.resume and settings.checkpoint_dir:
+        from . import checkpoint as ckpt
+        restored = ckpt.restore_state(settings.checkpoint_dir,
+                                      (stacked, opt_state, key))
+        if restored is not None:
+            start_epoch, (st_h, os_h, key_h) = restored
+            stacked = jax.device_put(st_h, sh_ens)
+            opt_state = jax.device_put(os_h, sh_ens)
+            key = jnp.asarray(key_h)
+            lr_scale = (1.0 - settings.learning_decay) ** start_epoch \
+                if settings.learning_decay > 0 else 1.0
+            log.info("resumed trainer state at epoch %d", start_epoch)
+
     n_padded = xd.shape[0]
-    for epoch in range(settings.epochs):
+    for epoch in range(start_epoch, settings.epochs):
         key, sub = jax.random.split(key)
         rngs = jax.random.split(sub, bags)
         if bs and bs < n_padded:
@@ -188,6 +205,13 @@ def train_ensemble(x: np.ndarray, y: np.ndarray,
         if checkpoint and settings.tmp_model_every and \
                 (epoch + 1) % settings.tmp_model_every == 0:
             checkpoint(epoch, _unstack(stacked, bags))
+        if settings.checkpoint_dir and settings.checkpoint_every and \
+                (epoch + 1) % settings.checkpoint_every == 0:
+            from . import checkpoint as ckpt
+            ckpt.save_state(settings.checkpoint_dir, epoch + 1,
+                            (jax.tree_util.tree_map(np.asarray, stacked),
+                             jax.tree_util.tree_map(np.asarray, opt_state),
+                             np.asarray(key)))
         if settings.learning_decay > 0:
             lr_scale *= (1.0 - settings.learning_decay)
         if settings.early_stop_window > 0:

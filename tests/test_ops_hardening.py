@@ -1,0 +1,125 @@
+"""Ops hardening: model-set versioning, dynamic rebin, trainer-state
+checkpoint/resume (SURVEY.md §5 aux subsystems)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.config import ModelConfig, load_column_configs
+from shifu_tpu.ops.stats_math import merge_adjacent_by_iv
+from shifu_tpu.pipeline.manage import (list_versions, save_version,
+                                       switch_version)
+
+
+def test_merge_adjacent_by_iv_groups_similar_bins():
+    neg = np.array([100, 98, 102, 10, 12])
+    pos = np.array([10, 11, 9, 90, 88])
+    groups = merge_adjacent_by_iv(neg, pos, target_bins=2)
+    assert groups == [[0, 1, 2], [3, 4]]
+
+
+def test_merge_respects_iv_keep():
+    # clearly distinct bins: merging below target would destroy IV, so with
+    # target >= current count nothing merges
+    neg = np.array([100, 50, 10, 5])
+    pos = np.array([5, 20, 60, 100])
+    groups = merge_adjacent_by_iv(neg, pos, target_bins=4, iv_keep=0.99)
+    assert len(groups) == 4
+
+
+def test_stats_rebin_reduces_bins(model_set):
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.config import environment
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    before = {c.columnName: c.num_bins()
+              for c in load_column_configs(
+                  os.path.join(model_set, "ColumnConfig.json"))}
+    environment.set_property("shifu.rebin.maxNumBin", "4")
+    try:
+        assert StatsProcessor(model_set, params={"rebin": True}).run() == 0
+    finally:
+        environment.set_property("shifu.rebin.maxNumBin", "")
+    after = load_column_configs(os.path.join(model_set, "ColumnConfig.json"))
+    shrunk = [c for c in after
+              if c.num_bins() <= 4 and before.get(c.columnName, 0) > 4]
+    assert shrunk, "no column was rebinned down to 4 bins"
+    # bin arrays stay consistent after merge
+    for c in after:
+        bn = c.columnBinning
+        if bn.binCountNeg:
+            assert len(bn.binCountNeg) == c.num_bins() + 1
+            assert len(bn.binCountWoe) == c.num_bins() + 1
+
+
+def test_rebinned_pipeline_still_trains(model_set):
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={"rebin": True}).run() == 0
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    assert TrainProcessor(model_set, params={}).run() == 0
+
+
+def test_manage_save_switch(model_set, caplog):
+    from shifu_tpu.pipeline.create import InitProcessor
+    assert InitProcessor(model_set).run() == 0
+    assert save_version(model_set, "v1") == 0
+    # mutate the config
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.train.numTrainEpochs = 777
+    mc.save(mc_path)
+    assert save_version(model_set, "v2") == 0
+    assert set(list_versions(model_set)) >= {"v1", "v2"}
+    assert switch_version(model_set, "v1") == 0
+    assert ModelConfig.load(mc_path).train.numTrainEpochs != 777
+    assert switch_version(model_set, "v2") == 0
+    assert ModelConfig.load(mc_path).train.numTrainEpochs == 777
+    assert switch_version(model_set, "nope") == 1
+
+
+def test_checkpoint_save_restore_roundtrip(tmp_path):
+    import jax
+    from shifu_tpu.train import checkpoint as ckpt
+    state = ({"w": np.arange(6, dtype=np.float32).reshape(2, 3)},
+             [np.zeros(4), np.ones(2)])
+    ckpt.save_state(str(tmp_path), 5, state)
+    ckpt.save_state(str(tmp_path), 10, state)
+    assert ckpt.latest_epoch(str(tmp_path)) == 10
+    template = jax.tree_util.tree_map(np.zeros_like, state)
+    epoch, restored = ckpt.restore_state(str(tmp_path), template)
+    assert epoch == 10
+    np.testing.assert_array_equal(restored[0]["w"], state[0]["w"])
+    # shape mismatch -> refused
+    bad = ({"w": np.zeros((3, 3))}, [np.zeros(4), np.ones(2)])
+    assert ckpt.restore_state(str(tmp_path), bad) is None
+
+
+def test_train_resume_continues_from_checkpoint():
+    from shifu_tpu.models import nn as nn_model
+    from shifu_tpu.train.nn_trainer import TrainSettings, train_ensemble
+    import tempfile
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    tw = np.ones((1, 256), np.float32)
+    spec = nn_model.NNModelSpec(input_dim=4, hidden_nodes=[8],
+                                activations=["tanh"])
+    with tempfile.TemporaryDirectory() as d:
+        s1 = TrainSettings(optimizer="ADAM", learning_rate=0.05, epochs=10,
+                           checkpoint_dir=d, checkpoint_every=5, seed=7)
+        res1 = train_ensemble(x, y, tw, tw, spec, s1)
+        from shifu_tpu.train import checkpoint as ckpt
+        assert ckpt.latest_epoch(d) == 10
+        # resume: runs epochs 10..20 only
+        s2 = TrainSettings(optimizer="ADAM", learning_rate=0.05, epochs=20,
+                           checkpoint_dir=d, checkpoint_every=5, seed=7,
+                           resume=True)
+        res2 = train_ensemble(x, y, tw, tw, spec, s2)
+        assert len(res2.history) == 10          # only the new epochs ran
+        assert res2.train_errors[0] <= res1.train_errors[0] + 1e-6
