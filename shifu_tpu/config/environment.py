@@ -54,7 +54,9 @@ def set_property(key: str, value: Any) -> None:
 
 def get_property(key: str, default: Optional[str] = None) -> Optional[str]:
     _ensure_loaded()
-    return _props.get(key, default)
+    v = _props.get(key)
+    # empty string = unset (clearing a property restores the default)
+    return default if v is None or v == "" else v
 
 
 def get_int(key: str, default: int) -> int:

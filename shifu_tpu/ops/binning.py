@@ -267,14 +267,18 @@ class CategoricalAccumulator:
             d[_MISSING_KEY] = m if prev is None else prev + m
 
     def finalize(self, col_name: str, max_cates: int = 0):
-        """Return (categories, counts[cats+1, 4]) — last row = missing bin.
-        Categories ordered by columnNum-stable frequency desc; if
+        """Return (categories, counts[cats+1, 4], n_distinct, n_missing) —
+        last counts row = missing bin.  Categories ordered frequency desc; if
         ``max_cates``>0, overflow categories are folded into the missing bin
-        (the reference caps via ``cateMaxNumBin``)."""
+        (the reference caps via ``cateMaxNumBin``).  ``n_distinct`` /
+        ``n_missing`` are the PRE-cap truths (the reference computes
+        distinctCount from the raw value set, not the capped bin list)."""
         d = self.stats.get(col_name, {})
         items = [(k, v) for k, v in d.items() if k != _MISSING_KEY]
+        n_distinct = len(items)
         items.sort(key=lambda kv: (-(kv[1][0] + kv[1][1]), kv[0]))
         missing = d.get(_MISSING_KEY, np.zeros(4))
+        n_missing = int(missing[0] + missing[1])
         if max_cates and len(items) > max_cates:
             for _, v in items[max_cates:]:
                 missing = missing + v
@@ -282,7 +286,7 @@ class CategoricalAccumulator:
         cats = [k for k, _ in items]
         counts = np.stack([v for _, v in items] + [missing]) if items else \
             missing[None, :]
-        return cats, counts
+        return cats, counts, n_distinct, n_missing
 
 
 _MISSING_KEY = "\x00__missing__"

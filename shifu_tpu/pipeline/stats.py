@@ -158,21 +158,22 @@ class StatsProcessor(BasicProcessor):
     def _finalize_categorical(self, cat_cols: List[ColumnConfig],
                               acc: CategoricalAccumulator, total_rows: int) -> None:
         mc = self.model_config
-        max_cates = mc.stats.cateMaxNumBin or 0
+        # reference hard cap regardless of cateMaxNumBin=0 ("uncapped"):
+        # Constants.MAX_CATEGORICAL_BINC_COUNT = 10000
+        max_cates = min(mc.stats.cateMaxNumBin or 10000, 10000)
         for cc in cat_cols:
-            cats, counts = acc.finalize(cc.columnName, max_cates)
+            cats, counts, n_distinct, n_missing = acc.finalize(
+                cc.columnName, max_cates)
             cpos, cneg, wpos, wneg = (counts[:, 0], counts[:, 1],
                                       counts[:, 2], counts[:, 3])
             cm = column_metrics(cneg[None, :], cpos[None, :])
             wm = column_metrics(wneg[None, :], wpos[None, :])
             st, bn = cc.columnStats, cc.columnBinning
-            valid_count = int((cpos[:-1] + cneg[:-1]).sum())
-            missing = int((cpos[-1] + cneg[-1]))
             st.totalCount = total_rows
-            st.validNumCount = valid_count
-            st.missingCount = missing
-            st.missingPercentage = missing / max(total_rows, 1)
-            st.distinctCount = len(cats)
+            st.validNumCount = total_rows - n_missing
+            st.missingCount = n_missing
+            st.missingPercentage = n_missing / max(total_rows, 1)
+            st.distinctCount = n_distinct
             pr = pos_rate(cpos, cneg)
             st.ks = _f(cm.ks[0])
             st.iv = _f(cm.iv[0])

@@ -1,0 +1,68 @@
+"""Guards the driver's entry points (`__graft_entry__`) and multi-device
+numerics — the round-1 headline failure was exactly this file not existing.
+
+Runs on the conftest-forced 8-device virtual CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+
+
+def _entry_module():
+    import __graft_entry__
+    return __graft_entry__
+
+
+def test_entry_compiles_and_runs():
+    fn, args = _entry_module().entry()
+    out = jax.jit(fn)(*args)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_dryrun_multichip_8():
+    # the exact call the driver makes
+    _entry_module().dryrun_multichip(8)
+
+
+def test_device_mesh_shape():
+    from shifu_tpu.parallel.mesh import device_mesh
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, "conftest must force an 8-device CPU platform"
+    mesh = device_mesh(n_ensemble=2, devices=devs[:8])
+    assert mesh.shape["ensemble"] == 2
+    assert mesh.shape["data"] == 4
+
+
+@pytest.mark.parametrize("bags", [1, 2])
+def test_one_vs_eight_device_equivalence(bags):
+    """Training on a 1-device mesh and an 8-device mesh must agree: the mesh
+    only changes WHERE the rows live, never the math (GSPMD inserts the
+    psum; full-batch + no dropout makes the run deterministic)."""
+    from shifu_tpu.models import nn as nn_model
+    from shifu_tpu.parallel.mesh import device_mesh
+    from shifu_tpu.train.nn_trainer import TrainSettings, train_ensemble
+    from shifu_tpu.train.sampling import member_masks
+
+    rng = np.random.default_rng(3)
+    n, d = 96, 6
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    train_w, valid_w = member_masks(n, bags, valid_rate=0.25, sample_rate=1.0,
+                                    replacement=False, targets=y, seed=0)
+    spec = nn_model.NNModelSpec(input_dim=d, hidden_nodes=[8],
+                                activations=["tanh"], loss="log")
+    settings = TrainSettings(optimizer="ADAM", learning_rate=0.05,
+                             epochs=5, seed=0)
+    devs = jax.devices("cpu")
+    res1 = train_ensemble(x, y, train_w, valid_w, spec, settings,
+                          mesh=device_mesh(n_ensemble=bags, devices=devs[:1]))
+    res8 = train_ensemble(x, y, train_w, valid_w, spec, settings,
+                          mesh=device_mesh(n_ensemble=bags, devices=devs[:8]))
+    np.testing.assert_allclose(res1.valid_errors, res8.valid_errors,
+                               rtol=1e-4, atol=1e-6)
+    for p1, p8 in zip(res1.params, res8.params):
+        flat1 = jax.tree_util.tree_leaves(p1)
+        flat8 = jax.tree_util.tree_leaves(p8)
+        for a, b in zip(flat1, flat8):
+            np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)

@@ -116,7 +116,7 @@ def test_categorical_accumulator_counts():
     w = np.ones(5)
     acc.update("col", vals, valid, y, w)
     acc.update("col", vals, valid, y, w)  # streamed twice
-    cats, counts = acc.finalize("col")
+    cats, counts, _, _ = acc.finalize("col")
     assert cats[0] == "a"  # most frequent first
     a = counts[cats.index("a")]
     assert a[0] == 4 and a[1] == 0  # 2 pos x 2 updates
