@@ -1,0 +1,248 @@
+"""Out-of-core streaming data plane — the ``MemoryDiskFloatMLDataSet``
+replacement (reference ``core/dtrain/dataset/MemoryDiskFloatMLDataSet.java:
+54-99,315-361``: fill heap to a fraction, spill to disk, chain iterators).
+
+TPU-native shape: the dataset never has to fit anywhere.  A ``ShardStream``
+re-batches npz shards into fixed-size row windows (one compiled program shape)
+while a background thread prefetches the next shard from disk, so the device
+computes while the host reads.  Epoch = one pass over all windows.
+
+Sampling masks cannot be materialized ``[bags, n_rows]`` when n_rows is
+unbounded, so ``window_member_masks`` derives every row's bag/validation
+assignment STATELESSLY from (seed, member, global row index) via a splitmix64
+hash — any window of rows can be masked independently and reproducibly,
+replacing the reference's load-time per-record assignment
+(``AbstractNNWorker.java:668-716``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .shards import Shards
+
+# ------------------------------------------------------------ hash uniforms
+_U64 = np.uint64
+
+
+def _splitmix64(z: np.ndarray) -> np.ndarray:
+    z = (z + _U64(0x9E3779B97F4A7C15))
+    z = (z ^ (z >> _U64(30))) * _U64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> _U64(27))) * _U64(0x94D049BB133111EB)
+    return z ^ (z >> _U64(31))
+
+
+def row_uniform(seed: int, stream: int, idx: np.ndarray) -> np.ndarray:
+    """Deterministic uniforms in [0,1) keyed by (seed, stream, row index)."""
+    with np.errstate(over="ignore"):
+        key = _splitmix64(_U64(seed & 0xFFFFFFFF) * _U64(0x100000001B3)
+                          + _U64(stream & 0xFFFFFFFF))
+        z = _splitmix64(np.asarray(idx, _U64) ^ key)
+    return (z >> _U64(11)).astype(np.float64) * (1.0 / (1 << 53))
+
+
+def _hash_poisson(lam: float, u: np.ndarray, kmax: int = 16) -> np.ndarray:
+    """Poisson(lam) counts via inverse CDF on hash uniforms (lam <= ~4)."""
+    out = np.zeros(u.shape, np.float32)
+    p = np.exp(-lam)
+    cdf = np.full(u.shape, p)
+    term = p
+    for k in range(1, kmax + 1):
+        out += (u >= cdf).astype(np.float32)
+        term = term * lam / k
+        cdf = cdf + term
+    return out
+
+
+def window_member_masks(idx: np.ndarray, bags: int, *, valid_rate: float,
+                        kfold: int = -1, sample_rate: float = 1.0,
+                        replacement: bool = False,
+                        up_sample_weight: float = 1.0,
+                        targets: Optional[np.ndarray] = None,
+                        seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """(train_w, valid_w): [bags, len(idx)] row weights for a row window.
+
+    Streaming analogue of ``train.sampling.member_masks``: same semantics
+    (k-fold partition / shared validation split + Poisson-or-Bernoulli
+    bagging / up-sampling) but every assignment is a pure function of the
+    global row index, so windows mask independently.  Stratified validation
+    degrades to plain Bernoulli(valid_rate) — exact per-class counts need a
+    global pass, which streaming by definition doesn't have.
+    """
+    idx = np.asarray(idx)
+    m = len(idx)
+    if kfold and kfold > 1:
+        fold = (row_uniform(seed, 101, idx) * kfold).astype(np.int64) % kfold
+        valid_w = np.stack([(fold == i).astype(np.float32) for i in range(kfold)])
+        train_w = 1.0 - valid_w
+    else:
+        vmask = row_uniform(seed, 11, idx) < valid_rate
+        if bags == 1 and sample_rate >= 1.0 and not replacement:
+            bag_w = np.ones((1, m), np.float32)
+        else:
+            bag_w = np.empty((bags, m), np.float32)
+            for b in range(bags):
+                u = row_uniform(seed, 1000 + b, idx)
+                bag_w[b] = _hash_poisson(sample_rate, u) if replacement \
+                    else (u < sample_rate).astype(np.float32)
+        train_w = bag_w * (~vmask)[None, :]
+        valid_w = np.broadcast_to(vmask.astype(np.float32),
+                                  (bags, m)).copy()
+    if up_sample_weight != 1.0 and targets is not None:
+        train_w = train_w * np.where(targets > 0.5, up_sample_weight,
+                                     1.0)[None, :]
+    return train_w.astype(np.float32), valid_w.astype(np.float32)
+
+
+# ----------------------------------------------------------------- windows
+@dataclass
+class Window:
+    """A fixed-size row window.  Arrays are padded to ``rows``; padded rows
+    have zero ``w`` (and must be ignored via weights by every consumer)."""
+    start: int                       # global index of first (real) row
+    n_valid: int                     # real rows (<= rows)
+    arrays: Dict[str, np.ndarray]    # each [rows, ...]
+
+    @property
+    def rows(self) -> int:
+        return len(next(iter(self.arrays.values())))
+
+    @property
+    def index(self) -> np.ndarray:
+        """Global row indices (padded tail gets past-the-end ids)."""
+        return np.arange(self.start, self.start + self.rows)
+
+
+class ShardStream:
+    """Windowed, prefetching iterator over npz shards.
+
+    - ``window_rows`` fixes every emitted window's row count (jit-stable
+      shapes; the last window is zero-padded).
+    - a daemon thread reads shard files ahead into a bounded queue
+      (``prefetch`` deep) so disk IO overlaps device compute.
+    - ``keys`` selects which arrays to materialize (e.g. ``("x","y","w")``
+      for the NN path, ``("bins","y","w")`` for trees).
+    """
+
+    def __init__(self, shards: Shards, keys: Sequence[str],
+                 window_rows: int, prefetch: int = 2):
+        assert window_rows > 0
+        self.shards = shards
+        self.keys = tuple(keys)
+        self.window_rows = int(window_rows)
+        self.prefetch = prefetch
+
+    # background shard reader
+    def _reader(self, q: "queue.Queue", stop: threading.Event) -> None:
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+        try:
+            for part in self.shards.iter_shards():
+                if not put({k: part[k] for k in self.keys}):
+                    return                    # consumer abandoned mid-epoch
+            put(None)
+        except BaseException as e:  # surface IO errors on the consumer side
+            put(e)
+
+    def windows(self) -> Iterator[Window]:
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        t = threading.Thread(target=self._reader, args=(q, stop), daemon=True)
+        t.start()
+        try:
+            buf: Dict[str, list] = {k: [] for k in self.keys}
+            buffered = 0
+            start = 0
+            W = self.window_rows
+            while True:
+                item = q.get()
+                if isinstance(item, BaseException):
+                    raise item
+                if item is None:
+                    break
+                n = len(next(iter(item.values())))
+                if n == 0:
+                    continue
+                for k in self.keys:
+                    buf[k].append(item[k])
+                buffered += n
+                while buffered >= W:
+                    arrays, buf, buffered = _take(buf, W, self.keys)
+                    yield Window(start=start, n_valid=W, arrays=arrays)
+                    start += W
+            if buffered:
+                arrays, buf, _ = _take(buf, buffered, self.keys)
+                yield Window(start=start, n_valid=buffered,
+                             arrays={k: _pad_rows(a, W)
+                                     for k, a in arrays.items()})
+        finally:
+            # unblock + retire the reader even when the generator is
+            # abandoned mid-iteration (jit error, early stop, interrupt)
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+
+    @property
+    def num_rows(self) -> int:
+        return self.shards.num_rows
+
+
+def _take(buf: Dict[str, list], rows: int, keys: Sequence[str]):
+    """Split ``rows`` rows off the buffer front (no copy when aligned)."""
+    arrays = {}
+    rest: Dict[str, list] = {}
+    for k in keys:
+        cat = buf[k][0] if len(buf[k]) == 1 else np.concatenate(buf[k])
+        arrays[k] = cat[:rows]
+        rest[k] = [cat[rows:]] if len(cat) > rows else []
+    remaining = sum(len(a) for a in rest[keys[0]])
+    return arrays, rest, remaining
+
+
+def _pad_rows(a: np.ndarray, rows: int) -> np.ndarray:
+    if len(a) >= rows:
+        return a
+    pad = np.zeros((rows - len(a),) + a.shape[1:], a.dtype)
+    return np.concatenate([a, pad])
+
+
+def auto_window_rows(row_bytes: int, budget_bytes: int,
+                     multiple: int = 8, lo: int = 1024,
+                     hi: int = 1 << 22) -> int:
+    """Window size from a device-memory budget (the reference's
+    ``guagua.data.memoryFraction`` analogue, ``AbstractNNWorker.java:
+    479-496``): as many rows as fit, clamped and rounded to ``multiple``."""
+    rows = int(budget_bytes // max(row_bytes, 1))
+    rows = max(lo, min(rows, hi))
+    return max(multiple, rows - rows % multiple)
+
+
+MaskFn = Callable[[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray]]
+
+
+def mask_fn_from_settings(bags: int, *, valid_rate: float, kfold: int = -1,
+                          sample_rate: float = 1.0, replacement: bool = False,
+                          up_sample_weight: float = 1.0,
+                          seed: int = 0) -> MaskFn:
+    """Bind sampling settings into a ``(index, targets) -> (train_w,
+    valid_w)`` window mask function for the streamed trainers."""
+    def fn(idx: np.ndarray, targets: np.ndarray):
+        return window_member_masks(
+            idx, bags, valid_rate=valid_rate, kfold=kfold,
+            sample_rate=sample_rate, replacement=replacement,
+            up_sample_weight=up_sample_weight, targets=targets, seed=seed)
+    return fn

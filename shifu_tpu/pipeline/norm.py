@@ -44,7 +44,7 @@ class NormalizeProcessor(BasicProcessor):
 
         rate = mc.normalize.sampleRate
         neg_only = mc.normalize.sampleNegOnly
-        shard, rows, seen = 0, 0, 0
+        shard, rows, seen, total_out = 0, 0, 0, 0
         bufx, bufb, bufy, bufw = [], [], [], []
         for chunk in source.iter_chunks():
             tc = transformer.transform(chunk)
@@ -56,6 +56,7 @@ class NormalizeProcessor(BasicProcessor):
             bufx.append(tc.x[keep]); bufb.append(tc.bins[keep])
             bufy.append(tc.target[keep]); bufw.append(tc.weight[keep])
             rows += int(keep.sum())
+            total_out += int(keep.sum())
             if rows >= SHARD_ROWS:
                 self._flush(norm_dir, clean_dir, shard, bufx, bufb, bufy, bufw)
                 shard += 1; rows = 0
@@ -72,6 +73,8 @@ class NormalizeProcessor(BasicProcessor):
             "columnNames": [c.columnName for c in transformer.columns],
             "normType": mc.normalize.normType.name,
             "numShards": shard,
+            "numRows": total_out,
+            "width": transformer.width,
         }
         with open(os.path.join(norm_dir, "schema.json"), "w") as f:
             json.dump(schema, f, indent=2)

@@ -114,6 +114,8 @@ class TrainProcessor(BasicProcessor):
     def _train_nn_family(self, alg: Algorithm) -> int:
         mc = self.model_config
         shards = Shards.open(self.paths.norm_dir)
+        if self._use_streaming(shards, shards.schema):
+            return self._train_nn_streamed(alg, shards)
         data = shards.load_all()
         x, y, w = data["x"], data["y"], data["w"]
         if self.params.get("shuffle"):
@@ -146,11 +148,8 @@ class TrainProcessor(BasicProcessor):
                 else [list(range(bags))]
             for run in runs:
                 run_params = trials[run[0]] if is_gs else dict(params)
-                if alg in (Algorithm.LR, Algorithm.SVM):
-                    spec = lr_spec(d, run_params, column_nums, feature_names)
-                else:
-                    spec = nn_spec_from_params(d, run_params, column_nums,
-                                               feature_names)
+                spec = self._make_spec(alg, d, run_params, column_nums,
+                                       feature_names)
                 settings = settings_from_params(run_params, mc.train)
                 if not is_gs:
                     # trainer-state fail-over checkpoints (grid trials are
@@ -172,27 +171,134 @@ class TrainProcessor(BasicProcessor):
                 valid_w = valid_w * w[None, :]
                 init_list = self._continuous_init(spec, n_members, alg)
 
-                def progress(epoch, tr, va, _pf=pf, _run=run):
-                    line = (f"Trial {_run} Epoch #{epoch + 1} "
-                            f"Train Error: {tr:.6f} Validation Error: {va:.6f}")
-                    _pf.write(line + "\n")
-                    _pf.flush()
-                    log.info(line)
-
-                def checkpoint(epoch, params_list, _spec=spec, _alg=alg):
-                    for i, p in enumerate(params_list):
-                        path = self.paths.tmp_model_path(
-                            i, epoch + 1, _alg.name.lower())
-                        nn_model.save_model(path, _spec, p)
-
                 res = train_ensemble(x, y, train_w, valid_w, spec, settings,
                                      init_params_list=init_list,
-                                     progress=progress, checkpoint=checkpoint)
+                                     progress=self._progress_fn(pf, run),
+                                     checkpoint=self._checkpoint_fn(spec, alg))
                 results.append((run, spec, res, run_params))
 
         self._write_models(results, alg, is_gs)
         log.info("train done in %.1fs", time.time() - t0)
         return 0
+
+    # -------------------------------------------------------- streaming
+    def _use_streaming(self, shards: Shards, schema: dict) -> bool:
+        """Out-of-core mode when the materialized data exceeds the memory
+        budget (reference ``guagua.data.memoryFraction`` role) or when
+        forced via ``-Dshifu.train.streaming=on``."""
+        from ..config import environment
+        mode = (environment.get_property("shifu.train.streaming", "auto")
+                or "auto").lower()
+        if mode in ("on", "true", "force"):
+            return True
+        if mode in ("off", "false"):
+            return False
+        budget = environment.get_int("shifu.train.memoryBudgetBytes", 1 << 31)
+        width = len(schema.get("outputNames") or []) or 1
+        n_rows = schema.get("numRows") or shards.num_rows
+        return n_rows * 4 * (width + 2) > budget
+
+    def _train_nn_streamed(self, alg: Algorithm, shards: Shards) -> int:
+        """Streamed counterpart of the in-RAM branch: windows flow through
+        ``train_ensemble_streamed``; sampling masks are stateless hashes of
+        the global row index (``data.streaming``)."""
+        from ..config import environment
+        from ..data.streaming import (ShardStream, auto_window_rows,
+                                      mask_fn_from_settings)
+        from ..parallel.mesh import device_mesh
+        from ..train.nn_trainer import train_ensemble_streamed
+
+        mc = self.model_config
+        schema = shards.schema
+        column_nums = schema.get("columnNums", [])
+        feature_names = schema.get("outputNames", [])
+        d = len(feature_names)
+        n_rows = schema.get("numRows") or shards.num_rows
+
+        params = dict(mc.train.params or {})
+        trials = grid_search.expand(params) if grid_search.is_grid_search(params) \
+            else [params]
+        is_gs = len(trials) > 1
+        kfold = mc.train.numKFold if mc.train.isCrossValidation else -1
+        bags = 1 if is_gs else max(1, mc.train.baggingNum)
+        if mc.train.stratifiedSample:
+            log.warning("streaming: stratified validation degrades to "
+                        "Bernoulli split (needs a global pass)")
+        if self.params.get("shuffle"):
+            log.warning("streaming: `train -shuffle` ignored; use "
+                        "`norm -shuffle` to reshuffle the materialized shards")
+
+        # members on the ensemble axis: k-fold overrides bagging count
+        mesh_members = kfold if (not is_gs and kfold and kfold > 1) else bags
+        mesh = device_mesh(n_ensemble=mesh_members)
+        data_size = mesh.shape["data"]
+        budget = environment.get_int("shifu.train.memoryBudgetBytes", 1 << 31)
+        window_rows = environment.get_int("shifu.train.windowRows", 0) or \
+            auto_window_rows(4 * (d + 2), budget)
+        window_rows = max(data_size, window_rows - window_rows % data_size)
+        log.info("train %s STREAMED: %d rows x %d features, window %d rows",
+                 alg.name, n_rows, d, window_rows)
+
+        os.makedirs(self.paths.tmp_models_dir, exist_ok=True)
+        t0 = time.time()
+        results = []
+        with open(self.paths.progress_path, "w") as pf:
+            runs = [[t] for t in range(len(trials))] if is_gs \
+                else [list(range(bags))]
+            for run in runs:
+                run_params = trials[run[0]] if is_gs else dict(params)
+                spec = self._make_spec(alg, d, run_params, column_nums,
+                                       feature_names)
+                settings = settings_from_params(run_params, mc.train)
+                if not is_gs:
+                    settings.checkpoint_dir = self.paths.checkpoint_dir
+                    settings.resume = bool(self.params.get("resume"))
+                run_kfold = kfold if not is_gs else -1
+                n_members = run_kfold if (run_kfold and run_kfold > 1) \
+                    else (len(run) if is_gs else bags)
+                mask_fn = mask_fn_from_settings(
+                    n_members, valid_rate=mc.train.validSetRate,
+                    kfold=run_kfold,
+                    sample_rate=mc.train.baggingSampleRate,
+                    replacement=mc.train.baggingWithReplacement,
+                    up_sample_weight=mc.train.upSampleWeight,
+                    seed=settings.seed)
+                stream = ShardStream(shards, ("x", "y", "w"), window_rows)
+                init_list = self._continuous_init(spec, n_members, alg)
+                res = train_ensemble_streamed(
+                    stream, spec, settings, n_members, mask_fn,
+                    init_params_list=init_list,
+                    progress=self._progress_fn(pf, run),
+                    checkpoint=self._checkpoint_fn(spec, alg), mesh=mesh)
+                results.append((run, spec, res, run_params))
+
+        self._write_models(results, alg, is_gs)
+        log.info("train done in %.1fs (streamed)", time.time() - t0)
+        return 0
+
+    # ---------------------------------------------------- shared run setup
+    def _make_spec(self, alg: Algorithm, d: int, run_params: Dict[str, Any],
+                   column_nums, feature_names):
+        if alg in (Algorithm.LR, Algorithm.SVM):
+            return lr_spec(d, run_params, column_nums, feature_names)
+        return nn_spec_from_params(d, run_params, column_nums, feature_names)
+
+    def _progress_fn(self, pf, run):
+        def progress(epoch, tr, va):
+            line = (f"Trial {run} Epoch #{epoch + 1} "
+                    f"Train Error: {tr:.6f} Validation Error: {va:.6f}")
+            pf.write(line + "\n")
+            pf.flush()
+            log.info(line)
+        return progress
+
+    def _checkpoint_fn(self, spec, alg: Algorithm):
+        def checkpoint(epoch, params_list):
+            for i, p in enumerate(params_list):
+                path = self.paths.tmp_model_path(i, epoch + 1,
+                                                 alg.name.lower())
+                nn_model.save_model(path, spec, p)
+        return checkpoint
 
     def _continuous_init(self, spec, n_members: int, alg: Algorithm):
         """Continuous training: warm-start members from existing final models

@@ -242,3 +242,256 @@ def _pad_all(x, y, train_w, valid_w, multiple):
         train_w = np.concatenate([train_w, zpad], axis=1)
         valid_w = np.concatenate([valid_w, zpad], axis=1)
     return x, y, train_w, valid_w
+
+
+# ------------------------------------------------------------- streaming
+def train_ensemble_streamed(stream, spec: nn_model.NNModelSpec,
+                            settings: TrainSettings, bags: int, mask_fn,
+                            init_params_list: Optional[List[Any]] = None,
+                            progress: Optional[ProgressFn] = None,
+                            checkpoint: Optional[Callable[[int, List[Any]], None]] = None,
+                            mesh=None) -> EnsembleResult:
+    """Out-of-core ensemble training: one pass over ``stream.windows()`` per
+    epoch, dataset never resident anywhere (the
+    ``MemoryDiskFloatMLDataSet.java`` role, done the streaming-SPMD way).
+
+    Full-batch semantics (RPROP & friends) hold exactly: per-window
+    UNNORMALIZED gradient sums accumulate on device across windows; the
+    optimizer applies once per epoch on ``sum(grads)/sum(weights)`` plus the
+    regularizer — bit-for-bit the math of :func:`train_ensemble` up to fp
+    reassociation.  With ``settings.batch_size > 0`` each window instead
+    yields minibatch updates (ADAM-style), like the reference's in-epoch
+    iteration.
+
+    ``mask_fn(global_row_index, targets) -> (train_w, valid_w)`` supplies
+    each window's ``[bags, rows]`` sampling masks (see
+    ``data.streaming.window_member_masks``); they are multiplied by the data
+    weight column inside.
+
+    Reported errors for epoch e are measured during pass e+1 (same params,
+    one pass later) so each epoch streams the data once, not twice; a final
+    eval-only pass closes the ledger.  Early stop therefore lags one epoch.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        mesh = meshlib.device_mesh(n_ensemble=bags)
+    data_size = mesh.shape["data"]
+    assert stream.window_rows % data_size == 0, \
+        f"window_rows {stream.window_rows} must divide data axis {data_size}"
+
+    key = jax.random.PRNGKey(settings.seed)
+    if init_params_list is None:
+        keys = jax.random.split(key, bags)
+        init_params_list = [nn_model.init_params(k, spec,
+                                                 settings.weight_initializer)
+                            for k in keys]
+    opt = make_optimizer(settings.optimizer, settings.learning_rate,
+                         **settings.opt_kwargs)
+    stacked = _stack(init_params_list)
+    opt_state = _stack([opt.init(p) for p in init_params_list])
+    sh_ens = NamedSharding(mesh, P("ensemble"))
+    sh_x = NamedSharding(mesh, P("data", None))
+    sh_y = NamedSharding(mesh, P("data"))
+    sh_w = NamedSharding(mesh, P("ensemble", "data"))
+    stacked = jax.device_put(stacked, sh_ens)
+    opt_state = jax.device_put(opt_state, sh_ens)
+
+    dropout = settings.dropout_rate
+    l1, l2 = settings.l1, settings.l2
+    lfn = nn_model.LOSSES.get(spec.loss, nn_model.LOSSES["squared"])
+
+    def _loss_sum(params, xb, yb, mw, rng):
+        pred = nn_model.forward(params, spec, xb,
+                                dropout_rate=dropout,
+                                rng=rng if dropout > 0 else None)
+        return (lfn(pred, yb[:, None]).sum(axis=-1) * mw).sum()
+
+    def _eval_sums(params, xb, yb, mw, vw):
+        pred = nn_model.forward(params, spec, xb)
+        per_row = lfn(pred, yb[:, None]).sum(axis=-1)
+        return jnp.stack([(per_row * mw).sum(), mw.sum(),
+                          (per_row * vw).sum(), vw.sum()])
+
+    @jax.jit
+    def grad_eval_window(stacked, grad_acc, stats_acc, xb, yb, tw, vw, rngs):
+        def one(params, mw, vwm, rng):
+            _, grads = jax.value_and_grad(_loss_sum)(params, xb, yb, mw, rng)
+            return grads, _eval_sums(params, xb, yb, mw, vwm)
+        grads, stats = jax.vmap(one)(stacked, tw, vw, rngs)
+        grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
+        return grad_acc, stats_acc + stats
+
+    @jax.jit
+    def eval_window(stacked, stats_acc, xb, yb, tw, vw):
+        stats = jax.vmap(_eval_sums, in_axes=(0, None, None, 0, 0))(
+            stacked, xb, yb, tw, vw)
+        return stats_acc + stats
+
+    @jax.jit
+    def apply_update(stacked, opt_state, grad_acc, train_wsum, lr_scale):
+        def one(params, ostate, grads, wsum):
+            inv = 1.0 / jnp.maximum(wsum, 1e-9)
+            g = [{"w": gl["w"] * inv + 2.0 * l2 * pl["w"]
+                       + l1 * jnp.sign(pl["w"]),
+                  "b": gl["b"] * inv}
+                 for gl, pl in zip(grads, params)]
+            delta, ostate = opt.update(g, ostate, params)
+            params = jax.tree_util.tree_map(lambda p, d: p + d * lr_scale,
+                                            params, delta)
+            return params, ostate
+        return jax.vmap(one)(stacked, opt_state, grad_acc, train_wsum)
+
+    @jax.jit
+    def minibatch_window(stacked, opt_state, xb, yb, tw, rngs, lr_scale):
+        def one(params, ostate, mw, rng):
+            def norm_loss(p):
+                return _loss_sum(p, xb, yb, mw, rng) / jnp.maximum(mw.sum(), 1e-9) \
+                    + l2 * sum((layer["w"] ** 2).sum() for layer in p) \
+                    + l1 * sum(jnp.abs(layer["w"]).sum() for layer in p)
+            grads = jax.grad(norm_loss)(params)
+            delta, ostate = opt.update(grads, ostate, params)
+            params = jax.tree_util.tree_map(lambda p, d: p + d * lr_scale,
+                                            params, delta)
+            return params, ostate
+        return jax.vmap(one, in_axes=(0, 0, 0, 0))(stacked, opt_state, tw, rngs)
+
+    zero_grads = jax.device_put(
+        jax.tree_util.tree_map(jnp.zeros_like, stacked), sh_ens)
+
+    full_batch = settings.batch_size == 0
+    W = stream.window_rows
+    if not full_batch:
+        # sub-slice each window into ~batch_size minibatches (same update
+        # granularity as the in-RAM loop); slice edges land on data_size
+        # multiples so every slice shards cleanly — at most 2 distinct slice
+        # shapes, so at most 2 compiles
+        bs = max(settings.batch_size - settings.batch_size % data_size,
+                 data_size)
+        n_slices = max(1, W // bs)
+        edges = [min(W, ((i * W // n_slices) // data_size) * data_size)
+                 for i in range(n_slices)] + [W]
+        slices = [(s, e) for s, e in zip(edges[:-1], edges[1:]) if e > s]
+    stops = [WindowEarlyStop(settings.early_stop_window) for _ in range(bags)]
+    best_valid = np.full(bags, np.inf)
+    best_train = np.full(bags, np.inf)
+    best_params: List[Any] = [None] * bags
+    history: List[Tuple[float, float]] = []
+    lr_scale = 1.0
+    start_epoch = 0
+    if settings.resume and settings.checkpoint_dir:
+        from . import checkpoint as ckpt
+        restored = ckpt.restore_state(settings.checkpoint_dir,
+                                      (stacked, opt_state, key))
+        if restored is not None:
+            start_epoch, (st_h, os_h, key_h) = restored
+            stacked = jax.device_put(st_h, sh_ens)
+            opt_state = jax.device_put(os_h, sh_ens)
+            key = jnp.asarray(key_h)
+            lr_scale = (1.0 - settings.learning_decay) ** start_epoch \
+                if settings.learning_decay > 0 else 1.0
+            log.info("resumed streamed trainer state at epoch %d", start_epoch)
+
+    def put_window(win):
+        xb = jax.device_put(win.arrays["x"].astype(np.float32), sh_x)
+        yb = jax.device_put(win.arrays["y"].astype(np.float32), sh_y)
+        tm, vm = mask_fn(win.index, win.arrays["y"])
+        wcol = win.arrays["w"].astype(np.float32)
+        if win.n_valid < win.rows:                 # zero out padded tail
+            wcol = wcol.copy()
+            wcol[win.n_valid:] = 0.0
+        tw = jax.device_put(tm * wcol[None, :], sh_w)
+        vw = jax.device_put(vm * wcol[None, :], sh_w)
+        return xb, yb, tw, vw
+
+    def bookkeep(epoch_done: int, stats: np.ndarray, params_snapshot) -> bool:
+        """Record errors for ``epoch_done`` measured on ``params_snapshot``
+        (device).  Returns True when every member's early-stop window fired."""
+        tr = stats[:, 0] / np.maximum(stats[:, 1], 1e-9)
+        va = stats[:, 2] / np.maximum(stats[:, 3], 1e-9)
+        history.append((float(tr.mean()), float(va.mean())))
+        improved = np.flatnonzero(va < best_valid)
+        if improved.size:
+            host = jax.tree_util.tree_map(np.asarray, params_snapshot)
+            for i in improved:
+                best_valid[i], best_train[i] = va[i], tr[i]
+                best_params[i] = jax.tree_util.tree_map(
+                    lambda a: a[i].copy(), host)
+        if progress:
+            progress(epoch_done, float(tr.mean()), float(va.mean()))
+        if settings.early_stop_window > 0:
+            flags = [s.should_stop(float(v)) for s, v in zip(stops, va)]
+            return all(flags)
+        return False
+
+    epochs_run = start_epoch
+    stopped = False
+    for epoch in range(start_epoch, settings.epochs):
+        key, sub = jax.random.split(key)
+        rngs = jax.random.split(sub, bags)
+        stats_acc = jnp.zeros((bags, 4))
+        grad_acc = zero_grads
+        params_entering = stacked   # params the epoch's stats are measured on
+        n_win = 0
+        for win in stream.windows():
+            xb, yb, tw, vw = put_window(win)
+            rngs_w = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+                rngs, n_win) if dropout > 0 else rngs
+            if full_batch:
+                grad_acc, stats_acc = grad_eval_window(
+                    stacked, grad_acc, stats_acc, xb, yb, tw, vw, rngs_w)
+            else:
+                stats_acc = eval_window(stacked, stats_acc, xb, yb, tw, vw)
+                for si, (s, e) in enumerate(slices):
+                    xs = jax.lax.slice_in_dim(xb, s, e, axis=0)
+                    ys = jax.lax.slice_in_dim(yb, s, e, axis=0)
+                    ts = jax.lax.slice_in_dim(tw, s, e, axis=1)
+                    rngs_s = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+                        rngs_w, si) if dropout > 0 else rngs_w
+                    stacked, opt_state = minibatch_window(
+                        stacked, opt_state, xs, ys, ts, rngs_s, lr_scale)
+            n_win += 1
+        if n_win == 0:
+            raise RuntimeError("streamed training: empty shard stream")
+        stats = np.asarray(stats_acc)
+        # stats were measured on the params entering this epoch => they close
+        # the ledger of the PREVIOUS epoch (snapshot the matching params, not
+        # the post-minibatch-update ones)
+        if epoch > start_epoch:
+            stopped = bookkeep(epoch - 1, stats, params_entering)
+        if full_batch:
+            stacked, opt_state = apply_update(
+                stacked, opt_state, grad_acc,
+                jnp.asarray(stats[:, 1]), lr_scale)
+        epochs_run = epoch + 1
+        if checkpoint and settings.tmp_model_every and \
+                (epoch + 1) % settings.tmp_model_every == 0:
+            checkpoint(epoch, _unstack(stacked, bags))
+        if settings.checkpoint_dir and settings.checkpoint_every and \
+                (epoch + 1) % settings.checkpoint_every == 0:
+            from . import checkpoint as ckpt
+            ckpt.save_state(settings.checkpoint_dir, epoch + 1,
+                            (jax.tree_util.tree_map(np.asarray, stacked),
+                             jax.tree_util.tree_map(np.asarray, opt_state),
+                             np.asarray(key)))
+        if settings.learning_decay > 0:
+            lr_scale *= (1.0 - settings.learning_decay)
+        if stopped:
+            log.info("early stop at epoch %d (window %d, streamed)",
+                     epoch, settings.early_stop_window)
+            break
+
+    # final eval-only pass: errors of the last params
+    stats_acc = jnp.zeros((bags, 4))
+    for win in stream.windows():
+        xb, yb, tw, vw = put_window(win)
+        stats_acc = eval_window(stacked, stats_acc, xb, yb, tw, vw)
+    bookkeep(epochs_run - 1, np.asarray(stats_acc), stacked)
+
+    final = jax.tree_util.tree_map(np.asarray, stacked)
+    for i in range(bags):
+        if best_params[i] is None:
+            best_params[i] = jax.tree_util.tree_map(lambda a: a[i], final)
+    return EnsembleResult(params=best_params, train_errors=best_train,
+                          valid_errors=best_valid, epochs_run=epochs_run,
+                          history=history)
