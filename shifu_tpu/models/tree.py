@@ -21,7 +21,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from ..ops.tree import TreeArrays, predict_tree
+from ..ops.tree import TreeArrays, predict_forest_stacked, stack_forest
 
 
 @dataclass
@@ -93,6 +93,7 @@ class IndependentTreeModel:
     def __init__(self, spec: TreeModelSpec, trees: List[TreeArrays]):
         self.spec = spec
         self.trees = trees
+        self._stacked = None                # lazy same-depth stacked arrays
 
     @classmethod
     def load(cls, path: str) -> "IndependentTreeModel":
@@ -100,11 +101,10 @@ class IndependentTreeModel:
 
     def compute(self, bins: np.ndarray) -> np.ndarray:
         b = jnp.asarray(bins, jnp.int32)
-        preds = np.stack([
-            np.asarray(predict_tree(jnp.asarray(t.split_feat),
-                                    jnp.asarray(t.left_mask),
-                                    jnp.asarray(t.leaf_value), b, t.depth))
-            for t in self.trees], axis=0)
+        if self._stacked is None:
+            self._stacked = stack_forest(self.trees)
+        preds = np.asarray(predict_forest_stacked(
+            *self._stacked, b, self.trees[0].depth))
         if self.spec.algorithm == "GBT":
             f = self.spec.init_score + self.spec.learning_rate * preds.sum(axis=0)
             if self.spec.loss == "log":

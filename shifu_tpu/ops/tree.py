@@ -75,9 +75,9 @@ def build_histograms(bins, node_idx, stats, n_nodes: int, n_bins: int):
 # ------------------------------------------------------------- split scan
 def _impurity_score(w, wy, wy2, kind: str):
     """Per-partition purity score; gain = score_L + score_R - score_P.
-    variance/friedman use sum^2/weight (equivalent to SSE reduction);
+    variance uses sum^2/weight (equivalent to SSE reduction);
     entropy/gini use binary class counts (pos = wy, neg = w - wy)."""
-    if kind in ("variance", "friedmanmse"):
+    if kind == "variance":
         return wy * wy / jnp.maximum(w, EPS)
     pos = jnp.clip(wy, 0.0, None)
     neg = jnp.clip(w - wy, 0.0, None)
@@ -125,10 +125,17 @@ def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
     cwy2 = jnp.cumsum(wy2_o, axis=-1)
     tw, twy, twy2 = cw[..., -1:], cwy[..., -1:], cwy2[..., -1:]
 
-    score_l = _impurity_score(cw, cwy, cwy2, impurity)
-    score_r = _impurity_score(tw - cw, twy - cwy, twy2 - cwy2, impurity)
-    score_p = _impurity_score(tw, twy, twy2, impurity)
-    gain = score_l + score_r - score_p                     # [nodes, C, B]
+    if impurity == "friedmanmse":
+        # Friedman's improvement (reference ``dt/Impurity.java:313-315``):
+        # (w_r*s_l - w_l*s_r)^2 / (w_l*w_r*(w_l+w_r))
+        wl, wr = cw, tw - cw
+        diff = wr * cwy - wl * (twy - cwy)
+        gain = diff * diff / jnp.maximum(wl * wr * (wl + wr), EPS)
+    else:
+        score_l = _impurity_score(cw, cwy, cwy2, impurity)
+        score_r = _impurity_score(tw - cw, twy - cwy, twy2 - cwy2, impurity)
+        score_p = _impurity_score(tw, twy, twy2, impurity)
+        gain = score_l + score_r - score_p                 # [nodes, C, B]
 
     valid = (cw >= min_instances) & (tw - cw >= min_instances)
     valid = valid & feat_active[None, :, None]
@@ -157,13 +164,59 @@ def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
 
 
 # ------------------------------------------------------------------ grow
+def _descend(bins, node_idx, feat, lmask):
+    """One level of worker tree traversal: rows whose node split move to a
+    child's level-local index; rows at leaves freeze at -1."""
+    node_feat = feat[jnp.maximum(node_idx, 0)]
+    active = (node_idx >= 0) & (node_feat >= 0)
+    row_bin = jnp.take_along_axis(
+        bins, jnp.maximum(node_feat, 0)[:, None], axis=1)[:, 0]
+    goes_left = lmask[jnp.maximum(node_idx, 0), row_bin]
+    return jnp.where(active, 2 * node_idx + jnp.where(goes_left, 0, 1), -1)
+
+
+@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity"))
+def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
+                  impurity: str, min_instances: float, min_gain: float):
+    """Whole-tree level-wise growth as ONE jitted program — zero host syncs
+    per level (reference ``DTMaster.java:543-600`` level mode; the round-1
+    build synced feat/lmask/leaf to host every level).
+
+    Returns (split_feat [total], left_mask [total, B], leaf_value [total],
+    gain_fi [C]) device arrays; per-level arrays concatenate into the
+    positional complete-binary-tree layout because level l starts at node
+    2^l - 1.  ``gain_fi`` accumulates realized split gains per feature
+    (gain-weighted FI, reference ``GainInfo`` aggregation).
+    """
+    n, c = bins.shape
+    feats, lmasks, leaves = [], [], []
+    gain_fi = jnp.zeros(c, jnp.float32)
+    node_idx = jnp.zeros(n, jnp.int32)       # level-local position, -1 done
+    for level in range(depth + 1):
+        n_nodes = 1 << level
+        hist = build_histograms(bins, node_idx, stats, n_nodes, n_bins)
+        gain, feat, lmask, leaf, node_w = best_splits(
+            hist, cat, fa, impurity, min_instances, min_gain)
+        if level == depth:                   # bottom level never splits
+            feat = jnp.full(n_nodes, -1, jnp.int32)
+            lmask = jnp.zeros((n_nodes, n_bins), bool)
+        feats.append(feat)
+        lmasks.append(lmask)
+        leaves.append(leaf)
+        gain_fi = gain_fi + jax.ops.segment_sum(
+            jnp.where(feat >= 0, jnp.maximum(gain, 0.0), 0.0).astype(jnp.float32),
+            jnp.maximum(feat, 0), num_segments=c)
+        if level < depth:
+            node_idx = _descend(bins, node_idx, feat, lmask)
+    return (jnp.concatenate(feats), jnp.concatenate(lmasks, axis=0),
+            jnp.concatenate(leaves), gain_fi)
+
+
 def grow_tree(bins, targets, weights, n_bins: int, depth: int,
               impurity: str = "variance", min_instances: float = 1.0,
               min_gain: float = 0.0, cat_mask: Optional[np.ndarray] = None,
               feat_active: Optional[np.ndarray] = None) -> TreeArrays:
-    """Level-wise growth (reference ``DTMaster.java:543-600`` level mode):
-    every node of a level splits in one histogram+scan step; the per-row
-    node index update is the worker's tree traversal."""
+    """Host-facing wrapper over :func:`grow_tree_jit`."""
     n, c = bins.shape
     bins = jnp.asarray(bins, jnp.int32)
     t = jnp.asarray(targets, jnp.float32)
@@ -171,45 +224,28 @@ def grow_tree(bins, targets, weights, n_bins: int, depth: int,
     stats = jnp.stack([wt, wt * t, wt * t * t], axis=1)
     cat = jnp.zeros(c, bool) if cat_mask is None else jnp.asarray(cat_mask)
     fa = jnp.ones(c, bool) if feat_active is None else jnp.asarray(feat_active)
+    split_feat, left_mask, leaf_value, _ = grow_tree_jit(
+        bins, stats, cat, fa, n_bins, depth, impurity,
+        float(min_instances), float(min_gain))
+    return TreeArrays(split_feat=np.asarray(split_feat),
+                      left_mask=np.asarray(left_mask),
+                      leaf_value=np.asarray(leaf_value), depth=depth)
 
-    total = n_tree_nodes(depth)
-    split_feat = np.full(total, -1, np.int32)
-    left_mask = np.zeros((total, n_bins), bool)
-    leaf_value = np.zeros(total, np.float32)
 
-    node_idx = jnp.zeros(n, jnp.int32)       # level-local position, -1 done
-    for level in range(depth + 1):
-        n_nodes = 1 << level
-        hist = build_histograms(bins, node_idx, stats, n_nodes, n_bins)
-        gain, feat, lmask, leaf, node_w = best_splits(
-            hist, cat, fa, impurity, min_instances, min_gain)
-        feat = np.asarray(feat)
-        lmask = np.asarray(lmask)
-        leaf = np.asarray(leaf)
-        base = n_nodes - 1                   # global id of level start
-        is_last = level == depth
-        for i in range(n_nodes):
-            g = base + i
-            leaf_value[g] = leaf[i]
-            if not is_last and feat[i] >= 0:
-                split_feat[g] = feat[i]
-                left_mask[g] = lmask[i]
-        if is_last:
-            break
-        # rows whose node didn't split freeze; others descend
-        feat_d = jnp.asarray(feat)
-        lmask_d = jnp.asarray(lmask)
-        node_feat = feat_d[jnp.maximum(node_idx, 0)]
-        active = (node_idx >= 0) & (node_feat >= 0)
-        row_bin = jnp.take_along_axis(
-            bins, jnp.maximum(node_feat, 0)[:, None], axis=1)[:, 0]
-        goes_left = lmask_d[jnp.maximum(node_idx, 0), row_bin]
-        node_idx = jnp.where(active,
-                             2 * node_idx + jnp.where(goes_left, 0, 1), -1)
-        if not bool(jnp.any(node_idx >= 0)):
-            break
-    return TreeArrays(split_feat=split_feat, left_mask=left_mask,
-                      leaf_value=leaf_value, depth=depth)
+@partial(jax.jit, static_argnames=("level",))
+def node_index_at_level(split_feat, left_mask, bins, level: int):
+    """Level-local node index of every row in a PARTIAL tree (levels above
+    ``level`` already decided); -1 where an ancestor froze.  The streaming
+    trainers re-derive window row positions from the tree instead of keeping
+    a per-row index resident (rows don't fit)."""
+    n = bins.shape[0]
+    node_idx = jnp.zeros(n, jnp.int32)
+    for l in range(level):
+        base = (1 << l) - 1
+        feat = jax.lax.dynamic_slice_in_dim(split_feat, base, 1 << l)
+        lmask = jax.lax.dynamic_slice_in_dim(left_mask, base, 1 << l, axis=0)
+        node_idx = _descend(bins, node_idx, feat, lmask)
+    return node_idx
 
 
 # ---------------------------------------------------------------- predict
@@ -229,15 +265,36 @@ def predict_tree(split_feat, left_mask, leaf_value, bins, depth: int):
     return leaf_value[node]
 
 
+def stack_forest(trees) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Stack same-depth trees into [T, ...] arrays for one vmapped predict."""
+    return (jnp.stack([jnp.asarray(t.split_feat) for t in trees]),
+            jnp.stack([jnp.asarray(t.left_mask) for t in trees]),
+            jnp.stack([jnp.asarray(t.leaf_value) for t in trees]))
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def predict_forest_stacked(split_feats, left_masks, leaf_values, bins,
+                           depth: int):
+    """[T, N] predictions of a stacked forest in one compiled call — the
+    per-tree Python loop (round-1 ``predict_tree`` per tree per model)
+    becomes a single vmap."""
+    return jax.vmap(predict_tree, in_axes=(0, 0, 0, None, None))(
+        split_feats, left_masks, leaf_values, bins, depth)
+
+
 def predict_forest(trees, bins, weights=None) -> np.ndarray:
     """Weighted-average forest prediction (RF mean vote / GBT partial sums
-    are built by the caller)."""
+    are built by the caller).  Trees stack per depth group (continuous runs
+    may append trees of a different depth)."""
     bins = jnp.asarray(bins, jnp.int32)
-    preds = [np.asarray(predict_tree(jnp.asarray(t.split_feat),
-                                     jnp.asarray(t.left_mask),
-                                     jnp.asarray(t.leaf_value),
-                                     bins, t.depth)) for t in trees]
-    preds = np.stack(preds, axis=0)
+    preds = np.empty((len(trees), bins.shape[0]), np.float32)
+    by_depth: dict = {}
+    for i, t in enumerate(trees):
+        by_depth.setdefault(t.depth, []).append(i)
+    for depth, idxs in by_depth.items():
+        sf, lm, lv = stack_forest([trees[i] for i in idxs])
+        preds[idxs] = np.asarray(
+            predict_forest_stacked(sf, lm, lv, bins, depth))
     if weights is None:
         return preds.mean(axis=0)
     w = np.asarray(weights)[:, None]

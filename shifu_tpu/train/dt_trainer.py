@@ -3,33 +3,49 @@
 
 - GBT (``DTWorker.java:582-686`` residual update, ``DTMaster.java:392-435``
   tree switching): sequential trees; per-tree gradients (squared: y − f,
-  log: y − sigmoid(f)) refit by a variance-impurity tree; shrinkage
+  log: y − sigmoid(f)) refit by a variance/Friedman tree; shrinkage
   ``learning_rate``; moving-average early stop
   (``dt/DTEarlyStopDecider.java``).
 - RF (``DTWorker`` Poisson bagging + oob-as-validation): independent trees
   over Poisson row weights, entropy/gini impurity, per-tree feature
   subsetting (featureSubsetStrategy ALL/HALF/SQRT/LOG2/ONETHIRD/TWOTHIRDS).
-- Feature importance from split gains (reference FI output for tree models).
-
-The row shard lives once in HBM as int bins; every tree/level reuses it —
-the reference's short[] bin-index worker memory (``DTWorker.java:100``).
+- Whole-tree growth is ONE jitted program per round (``ops.tree.
+  grow_tree_jit``); residuals/oob accumulators stay device-resident across
+  trees — one host sync per tree (errors + the tiny tree arrays), not per
+  level (the reference syncs worker↔master stats every level).
+- On a mesh, rows shard over the ``data`` axis and XLA's psum aggregates the
+  [nodes, C, B, S] histograms — the ``DTWorker``→``DTMaster`` merge
+  (``DTMaster.java:274-533``) on ICI.
+- Streaming mode (dataset > memory budget): per-level histogram accumulation
+  over ``ShardStream`` windows; per-row residual/oob state lives in compact
+  host caches (rows × 8B, ~100× smaller than the binned matrix).
+- Mid-forest checkpointing every N trees + ``train -resume`` (reference
+  ``DTMaster.doCheckPoint``, ``:637``); per-tree stateless RNG keys make a
+  resumed run bit-identical to an uninterrupted one.
+- Feature importance accumulates realized split GAINS (reference GainInfo
+  aggregation), not split counts.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..config.model_config import Algorithm
 from ..data.shards import Shards
 from ..models import tree as tree_model
-from ..ops.tree import TreeArrays, grow_tree, predict_tree
+from ..ops.tree import (TreeArrays, best_splits, build_histograms,
+                        grow_tree_jit, n_tree_nodes, node_index_at_level,
+                        predict_tree)
 from .early_stop import GBTEarlyStopDecider
 from .sampling import validation_split
 
@@ -51,6 +67,9 @@ class DTSettings:
     poisson_bagging: bool = True         # False: plain single tree (DT)
     early_stop: bool = False
     seed: int = 0
+    checkpoint_dir: str = ""             # "" disables mid-forest checkpoints
+    checkpoint_every: int = 25           # trees between checkpoints
+    resume: bool = False
 
 
 def settings_from_params(params: Dict[str, Any], train_conf,
@@ -73,7 +92,8 @@ def settings_from_params(params: Dict[str, Any], train_conf,
         bagging_rate=float(train_conf.baggingSampleRate),
         poisson_bagging=alg != Algorithm.DT,  # plain DT = one tree, full data
         early_stop=bool(train_conf.earlyStopEnable),
-        seed=int(p.get("Seed", 0)))
+        seed=int(p.get("Seed", 0)),
+        checkpoint_every=int(p.get("CheckpointInterval", 25)))
 
 
 def subset_count(strategy: str, c: int) -> int:
@@ -93,6 +113,19 @@ def subset_count(strategy: str, c: int) -> int:
     return c
 
 
+def _tree_rng(seed: int, tree_idx: int) -> np.random.Generator:
+    """Stateless per-tree RNG: resume from tree k reproduces the exact
+    feature subsets / bags an uninterrupted run would draw."""
+    return np.random.default_rng([seed, tree_idx])
+
+
+def _feat_subset(settings: DTSettings, c: int, tree_idx: int) -> np.ndarray:
+    k = subset_count(settings.feature_subset, c)
+    fa = np.zeros(c, bool)
+    fa[_tree_rng(settings.seed, tree_idx).choice(c, size=k, replace=False)] = True
+    return fa
+
+
 @dataclass
 class ForestResult:
     trees: List[TreeArrays]
@@ -104,26 +137,109 @@ class ForestResult:
     history: List[Tuple[float, float]] = field(default_factory=list)
 
 
-def _feature_gains(trees: List[TreeArrays], c: int) -> np.ndarray:
-    """FI = number-weighted presence of features in splits (gain values are
-    folded in during growth via leaf statistics; split counts are the
-    reference's simple FI mode)."""
-    fi = np.zeros(c)
-    for t in trees:
-        for f in t.split_feat:
-            if f >= 0:
-                fi[f] += 1.0
-    return fi
+# ---------------------------------------------------------------- jitted rounds
+def _loss_grad(y, f, loss: str):
+    if loss == "log":
+        return y - jax.nn.sigmoid(f)
+    if loss == "absolute":
+        return jnp.sign(y - f)
+    return y - f
+
+
+def _per_row_loss(y, f, loss: str):
+    if loss == "log":
+        p = jnp.clip(jax.nn.sigmoid(f), 1e-9, 1 - 1e-9)
+        return -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+    if loss == "absolute":
+        return jnp.abs(y - f)
+    return (y - f) ** 2
+
+
+@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss"))
+def _gbt_round(bins, y, tw, vw, f, fa, cat, lr, min_instances, min_gain,
+               n_bins: int, depth: int, impurity: str, loss: str):
+    """One GBT tree end-to-end on device: residual grad → grow → predict →
+    score update → train/valid error sums.  Only the tree arrays and two
+    scalars cross to the host."""
+    grad = _loss_grad(y, f, loss)
+    stats = jnp.stack([tw, tw * grad, tw * grad * grad], axis=1) \
+        .astype(jnp.float32)
+    sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
+                                    impurity, min_instances, min_gain)
+    pred = predict_tree(sf, lm, lv, bins, depth)
+    f2 = f + lr * pred
+    per = _per_row_loss(y, f2, loss)
+    tr = (per * tw).sum() / jnp.maximum(tw.sum(), 1e-9)
+    va = (per * vw).sum() / jnp.maximum(vw.sum(), 1e-9)
+    return sf, lm, lv, gfi, f2, tr, va
+
+
+@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
+                                   "poisson"))
+def _rf_round(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
+              min_instances, min_gain, n_bins: int, depth: int,
+              impurity: str, loss: str, poisson: bool):
+    """One RF tree on device: Poisson bag → grow → oob accumulate →
+    loss-consistent oob validation error (reference oob-as-validation,
+    ``DTWorker.java:582-616``; round 1 hardcoded squared error)."""
+    n = bins.shape[0]
+    bag = jax.random.poisson(key, bag_rate, (n,)).astype(jnp.float32) \
+        if poisson else jnp.ones(n, jnp.float32)
+    bw = w * bag
+    stats = jnp.stack([bw, bw * y, bw * y * y], axis=1).astype(jnp.float32)
+    sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
+                                    impurity, min_instances, min_gain)
+    pred = predict_tree(sf, lm, lv, bins, depth)
+    oob = (bag == 0) & (w > 0)
+    oob_sum = oob_sum + jnp.where(oob, pred, 0.0)
+    oob_cnt = oob_cnt + oob.astype(oob_cnt.dtype)
+    seen = oob_cnt > 0
+    oob_pred = oob_sum / jnp.maximum(oob_cnt, 1.0)
+    # RF votes average probabilities; log loss needs them clipped, not logit
+    if loss == "log":
+        p = jnp.clip(oob_pred, 1e-9, 1 - 1e-9)
+        per_v = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+    else:
+        per_v = _per_row_loss(y, oob_pred, loss)
+    wv = w * seen
+    va = (per_v * wv).sum() / jnp.maximum(wv.sum(), 1e-9)
+    per_t = _per_row_loss(y, pred, loss) if loss != "log" else \
+        -(y * jnp.log(jnp.clip(pred, 1e-9, 1 - 1e-9))
+          + (1 - y) * jnp.log(jnp.clip(1 - pred, 1e-9, 1 - 1e-9)))
+    tr = (per_t * w).sum() / jnp.maximum(w.sum(), 1e-9)
+    return sf, lm, lv, gfi, oob_sum, oob_cnt, tr, va
+
+
+def _device_put_rows(mesh, *arrays):
+    """Shard row-indexed arrays over the mesh's data axis (padding rows with
+    zeros so the extent divides; padded rows carry zero weight by
+    construction of the weight arrays)."""
+    if mesh is None:
+        return [jnp.asarray(a) for a in arrays]
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    data_size = mesh.shape["data"]
+    n = arrays[0].shape[0]
+    extra = (-n) % data_size
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        if extra:
+            pad = np.zeros((extra,) + a.shape[1:], a.dtype)
+            a = np.concatenate([a, pad])
+        spec = P("data") if a.ndim == 1 else P("data", None)
+        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+    return out
 
 
 def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
               progress=None, init_trees: Optional[List[TreeArrays]] = None,
-              init_score: Optional[float] = None) -> ForestResult:
+              init_score: Optional[float] = None, mesh=None,
+              checkpoint_fn: Optional[Callable] = None,
+              start_history: Optional[List] = None) -> ForestResult:
     n, c = bins.shape
     vmask = validation_split(n, settings.valid_rate, settings.seed)
-    tmask = ~vmask
-    bins_d = jnp.asarray(bins, jnp.int32)
-    wt = np.asarray(w, np.float64) * tmask
+    wt = np.asarray(w, np.float64) * ~vmask
+    wv = np.asarray(w, np.float64) * vmask
     y64 = np.asarray(y, np.float64)
 
     if init_score is None:  # continuous runs reuse the saved forest's prior
@@ -133,40 +249,44 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
             init_score = float(np.log(prior / (1 - prior)))
         else:
             init_score = prior
-    f = np.full(n, init_score, np.float64)
+
+    bins_d, y_d, tw_d, vw_d = _device_put_rows(
+        mesh, np.asarray(bins, np.int32), y64.astype(np.float32),
+        wt.astype(np.float32), wv.astype(np.float32))
+    f = jnp.full(bins_d.shape[0], init_score, jnp.float32)
+    cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
+
     trees: List[TreeArrays] = list(init_trees or [])
-    for t in trees:  # continuous training: replay existing trees
-        f += settings.learning_rate * np.asarray(
-            predict_tree(jnp.asarray(t.split_feat), jnp.asarray(t.left_mask),
-                         jnp.asarray(t.leaf_value), bins_d, t.depth))
+    for t in trees:  # continuous/resumed training: replay existing trees
+        f = f + settings.learning_rate * predict_tree(
+            jnp.asarray(t.split_feat), jnp.asarray(t.left_mask),
+            jnp.asarray(t.leaf_value), bins_d, t.depth)
 
     stopper = GBTEarlyStopDecider()
-    history: List[Tuple[float, float]] = []
-    rng = np.random.default_rng(settings.seed)
-    for ti in range(settings.n_trees):
-        if settings.loss == "log":
-            grad = y64 - 1.0 / (1.0 + np.exp(-f))
-        elif settings.loss == "absolute":
-            grad = np.sign(y64 - f)
-        else:
-            grad = y64 - f
-        k = subset_count(settings.feature_subset, c)
-        fa = np.zeros(c, bool)
-        fa[rng.choice(c, size=k, replace=False)] = True
-        tree = grow_tree(bins_d, grad, wt, n_bins, settings.depth,
-                         impurity="variance",
-                         min_instances=settings.min_instances,
-                         min_gain=settings.min_gain, cat_mask=cat_mask,
-                         feat_active=fa)
-        trees.append(tree)
-        pred = np.asarray(predict_tree(
-            jnp.asarray(tree.split_feat), jnp.asarray(tree.left_mask),
-            jnp.asarray(tree.leaf_value), bins_d, tree.depth))
-        f = f + settings.learning_rate * pred
-        tr_err, va_err = _gbt_errors(f, y64, w, tmask, vmask, settings.loss)
+    history: List[Tuple[float, float]] = list(start_history or [])
+    for tr_prev, va_prev in history:
+        stopper.add(va_prev)
+    fi = np.zeros(c)
+    for ti in range(len(trees), settings.n_trees):
+        fa = jnp.asarray(_feat_subset(settings, c, ti))
+        sf, lm, lv, gfi, f, tr, va = _gbt_round(
+            bins_d, y_d, tw_d, vw_d, f, fa, cat,
+            settings.learning_rate, settings.min_instances,
+            settings.min_gain, n_bins, settings.depth,
+            "friedmanmse" if settings.impurity == "friedmanmse" else "variance",
+            settings.loss)
+        trees.append(TreeArrays(split_feat=np.asarray(sf),
+                                left_mask=np.asarray(lm),
+                                leaf_value=np.asarray(lv),
+                                depth=settings.depth))
+        fi += np.asarray(gfi)
+        tr_err, va_err = float(tr), float(va)
         history.append((tr_err, va_err))
         if progress:
             progress(ti, tr_err, va_err)
+        if checkpoint_fn and settings.checkpoint_every and \
+                (ti + 1) % settings.checkpoint_every == 0:
+            checkpoint_fn(trees, history, init_score)
         if settings.early_stop and stopper.add(va_err):
             log.info("GBT early stop after %d trees", ti + 1)
             break
@@ -177,72 +297,377 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
                      "init_score": init_score},
         train_error=history[-1][0] if history else float("nan"),
         valid_error=history[-1][1] if history else float("nan"),
-        feature_importance=_feature_gains(trees, c),
+        feature_importance=fi,
         trees_built=len(trees), history=history)
 
 
-def _gbt_errors(f, y, w, tmask, vmask, loss: str) -> Tuple[float, float]:
-    if loss == "log":
-        p = 1.0 / (1.0 + np.exp(-f))
-        per = -(y * np.log(np.clip(p, 1e-9, 1)) +
-                (1 - y) * np.log(np.clip(1 - p, 1e-9, 1)))
-    else:
-        per = (y - f) ** 2
-    w = np.asarray(w, np.float64)
-    tw, vw = w * tmask, w * vmask
-    tr = float((per * tw).sum() / max(tw.sum(), 1e-9))
-    va = float((per * vw).sum() / max(vw.sum(), 1e-9)) if vmask.any() else tr
-    return tr, va
-
-
 def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
-             progress=None) -> ForestResult:
+             progress=None, mesh=None,
+             checkpoint_fn: Optional[Callable] = None,
+             init_trees: Optional[List[TreeArrays]] = None,
+             start_history: Optional[List] = None) -> ForestResult:
     """Independent Poisson-bagged trees; out-of-bag rows score validation
-    (reference RF oob-as-validation, ``DTWorker.java:582-616``)."""
+    with the configured loss."""
     n, c = bins.shape
-    bins_d = jnp.asarray(bins, jnp.int32)
-    y64 = np.asarray(y, np.float64)
-    w64 = np.asarray(w, np.float64)
-    rng = np.random.default_rng(settings.seed)
-    trees: List[TreeArrays] = []
-    oob_sum = np.zeros(n)
-    oob_cnt = np.zeros(n)
-    history: List[Tuple[float, float]] = []
-    for ti in range(settings.n_trees):
-        bag = rng.poisson(settings.bagging_rate, n).astype(np.float64) \
-            if settings.poisson_bagging else np.ones(n)
-        k = subset_count(settings.feature_subset, c)
-        fa = np.zeros(c, bool)
-        fa[rng.choice(c, size=k, replace=False)] = True
-        tree = grow_tree(bins_d, y64, w64 * bag, n_bins, settings.depth,
-                         impurity=settings.impurity,
-                         min_instances=settings.min_instances,
-                         min_gain=settings.min_gain, cat_mask=cat_mask,
-                         feat_active=fa)
-        trees.append(tree)
-        pred = np.asarray(predict_tree(
-            jnp.asarray(tree.split_feat), jnp.asarray(tree.left_mask),
-            jnp.asarray(tree.leaf_value), bins_d, tree.depth))
-        oob = bag == 0
-        oob_sum[oob] += pred[oob]
-        oob_cnt[oob] += 1
-        seen = oob_cnt > 0
-        if seen.any():
-            oob_pred = oob_sum[seen] / oob_cnt[seen]
-            per = (y64[seen] - oob_pred) ** 2
-            va = float((per * w64[seen]).sum() / max(w64[seen].sum(), 1e-9))
-        else:
-            va = float("nan")
-        tr = float((((y64 - pred) ** 2) * w64).sum() / max(w64.sum(), 1e-9))
-        history.append((tr, va))
+    bins_d, y_d, w_d = _device_put_rows(
+        mesh, np.asarray(bins, np.int32), np.asarray(y, np.float32),
+        np.asarray(w, np.float32))
+    cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
+    oob_sum = jnp.zeros(bins_d.shape[0], jnp.float32)
+    oob_cnt = jnp.zeros(bins_d.shape[0], jnp.float32)
+    trees: List[TreeArrays] = list(init_trees or [])
+    history: List[Tuple[float, float]] = list(start_history or [])
+    fi = np.zeros(c)
+    base_key = jax.random.PRNGKey(settings.seed)
+    start = len(trees)
+    if start:  # rebuild oob state by replaying stored trees with their bags
+        for ti, t_old in enumerate(trees):
+            key = jax.random.fold_in(base_key, ti)
+            bag = jax.random.poisson(key, settings.bagging_rate,
+                                     (bins_d.shape[0],)).astype(jnp.float32) \
+                if settings.poisson_bagging else jnp.ones(bins_d.shape[0])
+            pred = predict_tree(jnp.asarray(t_old.split_feat),
+                                jnp.asarray(t_old.left_mask),
+                                jnp.asarray(t_old.leaf_value), bins_d,
+                                t_old.depth)
+            oob = (bag == 0) & (w_d > 0)
+            oob_sum = oob_sum + jnp.where(oob, pred, 0.0)
+            oob_cnt = oob_cnt + oob.astype(jnp.float32)
+    for ti in range(start, settings.n_trees):
+        fa = jnp.asarray(_feat_subset(settings, c, ti))
+        key = jax.random.fold_in(base_key, ti)
+        sf, lm, lv, gfi, oob_sum, oob_cnt, tr, va = _rf_round(
+            bins_d, y_d, w_d, key, settings.bagging_rate,
+            oob_sum, oob_cnt, fa, cat, settings.min_instances,
+            settings.min_gain, n_bins, settings.depth, settings.impurity,
+            settings.loss, settings.poisson_bagging)
+        trees.append(TreeArrays(split_feat=np.asarray(sf),
+                                left_mask=np.asarray(lm),
+                                leaf_value=np.asarray(lv),
+                                depth=settings.depth))
+        fi += np.asarray(gfi)
+        tr_err, va_err = float(tr), float(va)
+        history.append((tr_err, va_err))
         if progress:
-            progress(ti, tr, va)
+            progress(ti, tr_err, va_err)
+        if checkpoint_fn and settings.checkpoint_every and \
+                (ti + 1) % settings.checkpoint_every == 0:
+            checkpoint_fn(trees, history, None)
     return ForestResult(
         trees=trees, spec_kwargs={"algorithm": "RF"},
         train_error=history[-1][0] if history else float("nan"),
         valid_error=history[-1][1] if history else float("nan"),
-        feature_importance=_feature_gains(trees, c),
+        feature_importance=fi,
         trees_built=len(trees), history=history)
+
+
+# ------------------------------------------------------------- streaming
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level", "loss"))
+def _gbt_window_hist(bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
+                     n_bins: int, level: int, loss: str):
+    """Streamed level step: window rows find their level-local node by
+    walking the partial tree, then scatter residual-gradient stats."""
+    node_idx = node_index_at_level(sf, lm, bins_w, level)
+    grad = _loss_grad(y_w, f_w, loss)
+    stats = jnp.stack([tw_w, tw_w * grad, tw_w * grad * grad], axis=1) \
+        .astype(jnp.float32)
+    return build_histograms(bins_w, node_idx, stats, n_nodes, n_bins)
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level"))
+def _rf_window_hist(bins_w, y_w, bw_w, sf, lm, n_nodes: int, n_bins: int,
+                    level: int):
+    node_idx = node_index_at_level(sf, lm, bins_w, level)
+    stats = jnp.stack([bw_w, bw_w * y_w, bw_w * y_w * y_w], axis=1) \
+        .astype(jnp.float32)
+    return build_histograms(bins_w, node_idx, stats, n_nodes, n_bins)
+
+
+@partial(jax.jit, static_argnames=("depth", "loss"))
+def _gbt_window_update(bins_w, y_w, tw_w, vw_w, f_w, sf, lm, lv, lr,
+                       depth: int, loss: str):
+    pred = predict_tree(sf, lm, lv, bins_w, depth)
+    f2 = f_w + lr * pred
+    per = _per_row_loss(y_w, f2, loss)
+    sums = jnp.stack([(per * tw_w).sum(), tw_w.sum(),
+                      (per * vw_w).sum(), vw_w.sum()])
+    return f2, sums
+
+
+def _stream_masks(idx: np.ndarray, n_valid: int, w_w: np.ndarray,
+                  valid_rate: float, seed: int):
+    """Hash-based train/valid weights for a window (stateless row split)."""
+    from ..data.streaming import row_uniform
+    vmask = row_uniform(seed, 11, idx) < valid_rate
+    live = np.zeros(len(idx), np.float32)
+    live[:n_valid] = 1.0
+    w = np.asarray(w_w, np.float32) * live
+    return (w * ~vmask).astype(np.float32), (w * vmask).astype(np.float32)
+
+
+def train_gbt_streamed(stream, n_bins: int, cat_mask,
+                       settings: DTSettings, progress=None,
+                       init_trees: Optional[List[TreeArrays]] = None,
+                       init_score: Optional[float] = None,
+                       checkpoint_fn: Optional[Callable] = None,
+                       start_history: Optional[List] = None) -> ForestResult:
+    """Out-of-core GBT: the binned matrix streams from disk every level; the
+    per-row score cache f (rows × 8B) is the only global row state.  One
+    tree costs depth+2 passes over the stream."""
+    first = True
+    n_rows = stream.num_rows
+    c = None
+    f = None
+    total = n_tree_nodes(settings.depth)
+    trees: List[TreeArrays] = list(init_trees or [])
+    history: List[Tuple[float, float]] = list(start_history or [])
+    stopper = GBTEarlyStopDecider()
+    for _, va_prev in history:
+        stopper.add(va_prev)
+
+    # probe width from the first window of a throwaway pass
+    for win in stream.windows():
+        c = win.arrays["bins"].shape[1]
+        break
+    if c is None:
+        raise RuntimeError("streamed GBT: empty shard stream")
+    cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
+    fi = np.zeros(c)
+
+    # init score + f cache (host, float32) — replay any existing trees
+    f = np.zeros(n_rows, np.float32)
+    if init_score is None:
+        sw = sy = 0.0
+        for win in stream.windows():
+            tw_w, _ = _stream_masks(win.index, win.n_valid, win.arrays["w"],
+                                    settings.valid_rate, settings.seed)
+            sw += float(tw_w.sum())
+            sy += float((tw_w * win.arrays["y"]).sum())
+        prior = sy / max(sw, 1e-9)
+        if settings.loss == "log":
+            prior = float(np.clip(prior, 1e-6, 1 - 1e-6))
+            init_score = float(np.log(prior / (1 - prior)))
+        else:
+            init_score = prior
+    f[:] = init_score
+    for t in trees:
+        sf, lm, lv = (jnp.asarray(t.split_feat), jnp.asarray(t.left_mask),
+                      jnp.asarray(t.leaf_value))
+        for win in stream.windows():
+            pred = predict_tree(sf, lm, lv,
+                                jnp.asarray(win.arrays["bins"], jnp.int32),
+                                t.depth)
+            s, e = win.start, win.start + win.n_valid
+            f[s:e] += settings.learning_rate * np.asarray(pred)[:win.n_valid]
+
+    for ti in range(len(trees), settings.n_trees):
+        fa = jnp.asarray(_feat_subset(settings, c, ti))
+        sf = jnp.full(total, -1, jnp.int32)
+        lm = jnp.zeros((total, n_bins), bool)
+        lv = jnp.zeros(total, jnp.float32)
+        for level in range(settings.depth + 1):
+            n_nodes = 1 << level
+            hist = jnp.zeros((n_nodes, c, n_bins, 3), jnp.float32)
+            for win in stream.windows():
+                s, e = win.start, win.start + win.rows
+                tw_w, _ = _stream_masks(win.index, win.n_valid,
+                                        win.arrays["w"],
+                                        settings.valid_rate, settings.seed)
+                f_w = _window_f(f, win)
+                hist = hist + _gbt_window_hist(
+                    jnp.asarray(win.arrays["bins"], jnp.int32),
+                    jnp.asarray(win.arrays["y"], jnp.float32),
+                    jnp.asarray(tw_w), jnp.asarray(f_w), sf, lm,
+                    n_nodes, n_bins, level, settings.loss)
+            gain, feat, lmask, leaf, _ = best_splits(
+                hist, cat, fa,
+                "friedmanmse" if settings.impurity == "friedmanmse"
+                else "variance",
+                settings.min_instances, settings.min_gain)
+            base = n_nodes - 1
+            if level == settings.depth:
+                feat = jnp.full(n_nodes, -1, jnp.int32)
+                lmask = jnp.zeros((n_nodes, n_bins), bool)
+            sf = sf.at[base:base + n_nodes].set(feat)
+            lm = lm.at[base:base + n_nodes].set(lmask)
+            lv = lv.at[base:base + n_nodes].set(leaf)
+            fi_add = jax.ops.segment_sum(
+                np.asarray(jnp.where(feat >= 0, jnp.maximum(gain, 0.0), 0.0)),
+                np.maximum(np.asarray(feat), 0), num_segments=c)
+            fi += np.asarray(fi_add)
+        # update pass: f cache + errors
+        sums = np.zeros(4)
+        for win in stream.windows():
+            tw_w, vw_w = _stream_masks(win.index, win.n_valid,
+                                       win.arrays["w"],
+                                       settings.valid_rate, settings.seed)
+            f_w = _window_f(f, win)
+            f2, s4 = _gbt_window_update(
+                jnp.asarray(win.arrays["bins"], jnp.int32),
+                jnp.asarray(win.arrays["y"], jnp.float32),
+                jnp.asarray(tw_w), jnp.asarray(vw_w), jnp.asarray(f_w),
+                sf, lm, lv, settings.learning_rate, settings.depth,
+                settings.loss)
+            s, e = win.start, win.start + win.n_valid
+            f[s:e] = np.asarray(f2)[:win.n_valid]
+            sums += np.asarray(s4)
+        trees.append(TreeArrays(split_feat=np.asarray(sf),
+                                left_mask=np.asarray(lm),
+                                leaf_value=np.asarray(lv),
+                                depth=settings.depth))
+        tr_err = sums[0] / max(sums[1], 1e-9)
+        va_err = sums[2] / max(sums[3], 1e-9)
+        history.append((tr_err, va_err))
+        if progress:
+            progress(ti, tr_err, va_err)
+        if checkpoint_fn and settings.checkpoint_every and \
+                (ti + 1) % settings.checkpoint_every == 0:
+            checkpoint_fn(trees, history, init_score)
+        if settings.early_stop and stopper.add(va_err):
+            log.info("GBT early stop after %d trees (streamed)", ti + 1)
+            break
+    return ForestResult(
+        trees=trees,
+        spec_kwargs={"algorithm": "GBT", "loss": settings.loss,
+                     "learning_rate": settings.learning_rate,
+                     "init_score": init_score},
+        train_error=history[-1][0] if history else float("nan"),
+        valid_error=history[-1][1] if history else float("nan"),
+        feature_importance=fi, trees_built=len(trees), history=history)
+
+
+def _window_f(f: np.ndarray, win) -> np.ndarray:
+    """Slice the row-score cache for a window, padding past the end."""
+    s = win.start
+    e = min(s + win.rows, len(f))
+    out = np.zeros(win.rows, np.float32)
+    out[:e - s] = f[s:e]
+    return out
+
+
+def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
+                      progress=None,
+                      checkpoint_fn: Optional[Callable] = None,
+                      init_trees: Optional[List[TreeArrays]] = None,
+                      start_history: Optional[List] = None) -> ForestResult:
+    """Out-of-core RF: hash-based Poisson bags per (tree, row); oob vote
+    caches (2 host arrays, rows × 4B) carry validation across trees."""
+    from ..data.streaming import _hash_poisson, row_uniform
+
+    n_rows = stream.num_rows
+    c = None
+    for win in stream.windows():
+        c = win.arrays["bins"].shape[1]
+        break
+    if c is None:
+        raise RuntimeError("streamed RF: empty shard stream")
+    cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
+    total = n_tree_nodes(settings.depth)
+    oob_sum = np.zeros(n_rows, np.float32)
+    oob_cnt = np.zeros(n_rows, np.float32)
+    trees: List[TreeArrays] = list(init_trees or [])
+    history: List[Tuple[float, float]] = list(start_history or [])
+    fi = np.zeros(c)
+
+    def window_bag(ti: int, win) -> np.ndarray:
+        u = row_uniform(settings.seed, 5000 + ti, win.index)
+        bag = _hash_poisson(settings.bagging_rate, u) \
+            if settings.poisson_bagging else np.ones(win.rows, np.float32)
+        bag[win.n_valid:] = 0.0
+        return bag
+
+    # resumed: replay oob accumulation for stored trees
+    for ti, t_old in enumerate(trees):
+        sf, lm, lv = (jnp.asarray(t_old.split_feat),
+                      jnp.asarray(t_old.left_mask),
+                      jnp.asarray(t_old.leaf_value))
+        for win in stream.windows():
+            bag = window_bag(ti, win)
+            pred = np.asarray(predict_tree(
+                sf, lm, lv, jnp.asarray(win.arrays["bins"], jnp.int32),
+                t_old.depth))
+            s, e = win.start, win.start + win.n_valid
+            oob = (bag[:win.n_valid] == 0) & (win.arrays["w"][:win.n_valid] > 0)
+            oob_sum[s:e][oob] += pred[:win.n_valid][oob]
+            oob_cnt[s:e][oob] += 1
+
+    for ti in range(len(trees), settings.n_trees):
+        fa = jnp.asarray(_feat_subset(settings, c, ti))
+        sf = jnp.full(total, -1, jnp.int32)
+        lm = jnp.zeros((total, n_bins), bool)
+        lv = jnp.zeros(total, jnp.float32)
+        for level in range(settings.depth + 1):
+            n_nodes = 1 << level
+            hist = jnp.zeros((n_nodes, c, n_bins, 3), jnp.float32)
+            for win in stream.windows():
+                bag = window_bag(ti, win)
+                bw = bag * np.asarray(win.arrays["w"], np.float32)
+                hist = hist + _rf_window_hist(
+                    jnp.asarray(win.arrays["bins"], jnp.int32),
+                    jnp.asarray(win.arrays["y"], jnp.float32),
+                    jnp.asarray(bw), sf, lm, n_nodes, n_bins, level)
+            gain, feat, lmask, leaf, _ = best_splits(
+                hist, cat, fa, settings.impurity,
+                settings.min_instances, settings.min_gain)
+            base = n_nodes - 1
+            if level == settings.depth:
+                feat = jnp.full(n_nodes, -1, jnp.int32)
+                lmask = jnp.zeros((n_nodes, n_bins), bool)
+            sf = sf.at[base:base + n_nodes].set(feat)
+            lm = lm.at[base:base + n_nodes].set(lmask)
+            lv = lv.at[base:base + n_nodes].set(leaf)
+            fi += np.asarray(jax.ops.segment_sum(
+                np.asarray(jnp.where(feat >= 0, jnp.maximum(gain, 0.0), 0.0)),
+                np.maximum(np.asarray(feat), 0), num_segments=c))
+        # oob update + errors pass
+        tr_n = tr_d = va_n = va_d = 0.0
+        for win in stream.windows():
+            bag = window_bag(ti, win)
+            w_w = np.asarray(win.arrays["w"], np.float32).copy()
+            w_w[win.n_valid:] = 0.0
+            y_w = np.asarray(win.arrays["y"], np.float32)
+            pred = np.asarray(predict_tree(
+                sf, lm, lv, jnp.asarray(win.arrays["bins"], jnp.int32),
+                settings.depth))
+            s, e = win.start, win.start + win.n_valid
+            nv = win.n_valid
+            oob = (bag[:nv] == 0) & (w_w[:nv] > 0)
+            oob_sum[s:e][oob] += pred[:nv][oob]
+            oob_cnt[s:e][oob] += 1
+            seen = oob_cnt[s:e] > 0
+            oob_pred = oob_sum[s:e] / np.maximum(oob_cnt[s:e], 1.0)
+            if settings.loss == "log":
+                p = np.clip(oob_pred, 1e-9, 1 - 1e-9)
+                per_v = -(y_w[:nv] * np.log(p)
+                          + (1 - y_w[:nv]) * np.log(1 - p))
+                pt = np.clip(pred[:nv], 1e-9, 1 - 1e-9)
+                per_t = -(y_w[:nv] * np.log(pt)
+                          + (1 - y_w[:nv]) * np.log(1 - pt))
+            else:
+                per_v = (y_w[:nv] - oob_pred) ** 2
+                per_t = (y_w[:nv] - pred[:nv]) ** 2
+            wv = w_w[:nv] * seen
+            va_n += float((per_v * wv).sum())
+            va_d += float(wv.sum())
+            tr_n += float((per_t * w_w[:nv]).sum())
+            tr_d += float(w_w[:nv].sum())
+        trees.append(TreeArrays(split_feat=np.asarray(sf),
+                                left_mask=np.asarray(lm),
+                                leaf_value=np.asarray(lv),
+                                depth=settings.depth))
+        tr_err = tr_n / max(tr_d, 1e-9)
+        va_err = va_n / max(va_d, 1e-9) if va_d > 0 else float("nan")
+        history.append((tr_err, va_err))
+        if progress:
+            progress(ti, tr_err, va_err)
+        if checkpoint_fn and settings.checkpoint_every and \
+                (ti + 1) % settings.checkpoint_every == 0:
+            checkpoint_fn(trees, history, None)
+    return ForestResult(
+        trees=trees, spec_kwargs={"algorithm": "RF"},
+        train_error=history[-1][0] if history else float("nan"),
+        valid_error=history[-1][1] if history else float("nan"),
+        feature_importance=fi, trees_built=len(trees), history=history)
 
 
 # -------------------------------------------------------- pipeline driver
@@ -251,8 +676,6 @@ def run_tree_training(proc) -> int:
     mc = proc.model_config
     alg = mc.train.algorithm
     shards = Shards.open(proc.paths.clean_dir)
-    data = shards.load_all()
-    bins, y, w = data["bins"].astype(np.int32), data["y"], data["w"]
     col_nums = shards.schema.get("columnNums", [])
     by_num = {c.columnNum: c for c in proc.column_configs}
     cat_mask = np.array([by_num[cn].is_categorical() if cn in by_num else False
@@ -263,8 +686,13 @@ def run_tree_training(proc) -> int:
     n_bins = max((by_num[cn].num_bins() + 1 for cn in col_nums if cn in by_num),
                  default=2)
     settings = settings_from_params(mc.train.params, mc.train, alg)
-    log.info("train %s: %d rows x %d features, %d bins, %d trees depth %d",
-             alg.name, *bins.shape, n_bins, settings.n_trees, settings.depth)
+    settings.resume = bool(proc.params.get("resume"))
+    settings.checkpoint_dir = proc.paths.checkpoint_dir
+
+    streaming = proc._use_streaming(shards, shards.schema) \
+        if hasattr(proc, "_use_streaming") else False
+    ckpt_fn = _forest_checkpoint_fn(proc, settings, alg, n_bins, col_nums,
+                                    shards)
 
     progress_path = proc.paths.progress_path
     with open(progress_path, "w") as pf:
@@ -276,12 +704,46 @@ def run_tree_training(proc) -> int:
             if (ti + 1) % 5 == 0 or ti == 0:
                 log.info(line)
 
-        init_trees, init_score = _continuous_trees(proc, alg, settings)
-        if alg == Algorithm.GBT:
-            res = train_gbt(bins, y, w, n_bins, cat_mask, settings, progress,
-                            init_trees=init_trees, init_score=init_score)
+        init_trees, init_score, start_history = _restore_or_continuous(
+            proc, alg, settings)
+        if streaming:
+            from ..config import environment
+            from ..data.streaming import ShardStream, auto_window_rows
+            budget = environment.get_int("shifu.train.memoryBudgetBytes",
+                                         1 << 31)
+            window_rows = environment.get_int("shifu.train.windowRows", 0) or \
+                auto_window_rows(2 * len(col_nums) + 8, budget)
+            stream = ShardStream(shards, ("bins", "y", "w"), window_rows)
+            log.info("train %s STREAMED: %d rows, window %d rows",
+                     alg.name, stream.num_rows, window_rows)
+            if alg == Algorithm.GBT:
+                res = train_gbt_streamed(stream, n_bins, cat_mask, settings,
+                                         progress, init_trees=init_trees,
+                                         init_score=init_score,
+                                         checkpoint_fn=ckpt_fn,
+                                         start_history=start_history)
+            else:
+                res = train_rf_streamed(stream, n_bins, cat_mask, settings,
+                                        progress, checkpoint_fn=ckpt_fn,
+                                        init_trees=init_trees,
+                                        start_history=start_history)
         else:
-            res = train_rf(bins, y, w, n_bins, cat_mask, settings, progress)
+            data = shards.load_all()
+            bins, y, w = data["bins"].astype(np.int32), data["y"], data["w"]
+            log.info("train %s: %d rows x %d features, %d bins, %d trees "
+                     "depth %d", alg.name, *bins.shape, n_bins,
+                     settings.n_trees, settings.depth)
+            if alg == Algorithm.GBT:
+                res = train_gbt(bins, y, w, n_bins, cat_mask, settings,
+                                progress, init_trees=init_trees,
+                                init_score=init_score, checkpoint_fn=ckpt_fn,
+                                start_history=start_history)
+            else:
+                res = train_rf(bins, y, w, n_bins, cat_mask, settings,
+                               progress, checkpoint_fn=ckpt_fn,
+                               init_trees=init_trees,
+                               start_history=start_history)
+        if alg != Algorithm.GBT:
             res.spec_kwargs["algorithm"] = "RF" if alg != Algorithm.DT else "DT"
 
     spec = tree_model.TreeModelSpec(
@@ -300,10 +762,61 @@ def run_tree_training(proc) -> int:
         ((shards.schema.get("columnNames", [str(cn) for cn in col_nums])[j],
           float(v)) for j, v in enumerate(res.feature_importance)),
         key=lambda kv: -kv[1])
+    with open(os.path.join(proc.paths.tmp_dir, "feature_importance.json"),
+              "w") as fjson:
+        json.dump({k: v for k, v in fi_named}, fjson, indent=2)
     log.info("train %s done: %d trees, train err %.6f valid err %.6f; "
              "top features %s", alg.name, res.trees_built, res.train_error,
              res.valid_error, [n for n, _ in fi_named[:5]])
     return 0
+
+
+def _forest_checkpoint_path(proc) -> str:
+    return os.path.join(proc.paths.checkpoint_dir, "forest_ckpt.npz")
+
+
+def _forest_checkpoint_fn(proc, settings: DTSettings, alg, n_bins, col_nums,
+                          shards):
+    """Mid-forest checkpoint (reference ``DTMaster.doCheckPoint`` every
+    checkpointInterval iterations): partial forest + history persist; a
+    killed run resumes from the last saved tree."""
+    def save(trees, history, init_score):
+        os.makedirs(proc.paths.checkpoint_dir, exist_ok=True)
+        spec = tree_model.TreeModelSpec(
+            n_trees=len(trees), depth=settings.depth, n_bins=n_bins,
+            column_nums=list(col_nums),
+            feature_names=shards.schema.get("columnNames"),
+            algorithm=alg.name, loss=settings.loss,
+            learning_rate=settings.learning_rate,
+            init_score=init_score if init_score is not None else 0.0)
+        path = _forest_checkpoint_path(proc)
+        tmp = path + ".tmp"
+        tree_model.save_model(tmp, spec, trees)
+        os.replace(tmp, path)
+        with open(path + ".meta.json", "w") as f:
+            json.dump({"trees_done": len(trees), "history": history,
+                       "seed": settings.seed}, f)
+        log.info("forest checkpoint: %d trees", len(trees))
+    return save
+
+
+def _restore_or_continuous(proc, alg, settings: DTSettings):
+    """Resume order: explicit ``train -resume`` from the mid-forest
+    checkpoint, else continuous training from the final saved model."""
+    if settings.resume:
+        path = _forest_checkpoint_path(proc)
+        if os.path.isfile(path):
+            spec, trees = tree_model.load_model(path)
+            meta = {}
+            if os.path.isfile(path + ".meta.json"):
+                with open(path + ".meta.json") as f:
+                    meta = json.load(f)
+            history = [tuple(h) for h in meta.get("history", [])]
+            log.info("resume: restored %d trees from forest checkpoint",
+                     len(trees))
+            return trees, spec.init_score, history
+    init_trees, init_score = _continuous_trees(proc, alg, settings)
+    return init_trees, init_score, None
 
 
 def _continuous_trees(proc, alg, settings: DTSettings
