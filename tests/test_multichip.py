@@ -25,6 +25,37 @@ def test_dryrun_multichip_8():
     _entry_module().dryrun_multichip(8)
 
 
+def test_dryrun_hermetic():
+    """Every buffer the dryrun creates must live on the backend it selected —
+    the r01/r02 failures were non-hermetic fallback (eager ops landing on a
+    broken default TPU backend)."""
+    mod = _entry_module()
+    devices = mod._pick_devices(8)
+    assert all(d.platform == "cpu" for d in devices), \
+        "CPU plane is large enough here, so it must be probed & chosen first"
+    before = {id(a) for a in jax.live_arrays()}
+    mod.dryrun_multichip(8)
+    leaked = [a for a in jax.live_arrays()
+              if id(a) not in before and a.devices()
+              and any(d.platform != "cpu" for d in a.devices())]
+    assert not leaked
+
+
+def test_dryrun_survives_broken_default_backend(monkeypatch):
+    """The exact recorded r02 failure: default backend init succeeds but every
+    op raises (libtpu client/terminal mismatch).  The dryrun must never reach
+    it when the CPU plane suffices."""
+    real_devices = jax.devices
+
+    def poisoned(*args, **kwargs):
+        if args or kwargs:          # explicit backend probe is fine
+            return real_devices(*args, **kwargs)
+        raise RuntimeError("FAILED_PRECONDITION: libtpu version mismatch")
+
+    monkeypatch.setattr(jax, "devices", poisoned)
+    _entry_module().dryrun_multichip(8)
+
+
 def test_device_mesh_shape():
     from shifu_tpu.parallel.mesh import device_mesh
     devs = jax.devices("cpu")
