@@ -33,11 +33,13 @@ def test_dryrun_hermetic():
     devices = mod._pick_devices(8)
     assert all(d.platform == "cpu" for d in devices), \
         "CPU plane is large enough here, so it must be probed & chosen first"
-    before = {id(a) for a in jax.live_arrays()}
+    before_refs = list(jax.live_arrays())   # hold refs: pin ids against reuse
+    before = {id(a) for a in before_refs}
     mod.dryrun_multichip(8)
     leaked = [a for a in jax.live_arrays()
               if id(a) not in before and a.devices()
               and any(d.platform != "cpu" for d in a.devices())]
+    del before_refs
     assert not leaked
 
 
