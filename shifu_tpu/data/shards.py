@@ -24,8 +24,8 @@ class Shards:
                        if f.endswith(".npz"))
         return cls(directory, schema, files)
 
-    def iter_shards(self) -> Iterator[Dict[str, np.ndarray]]:
-        for f in self.files:
+    def iter_shards(self, start: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        for f in self.files[start:]:
             yield dict(np.load(f))
 
     def load_all(self) -> Dict[str, np.ndarray]:

@@ -107,6 +107,7 @@ class Window:
     start: int                       # global index of first (real) row
     n_valid: int                     # real rows (<= rows)
     arrays: Dict[str, np.ndarray]    # each [rows, ...]
+    src: Optional[Tuple[int, int]] = None   # (shard idx, row offset) of row 0
 
     @property
     def rows(self) -> int:
@@ -138,7 +139,8 @@ class ShardStream:
         self.prefetch = prefetch
 
     # background shard reader
-    def _reader(self, q: "queue.Queue", stop: threading.Event) -> None:
+    def _reader(self, q: "queue.Queue", stop: threading.Event,
+                start_shard: int, shard_offset: int) -> None:
         def put(item) -> bool:
             while not stop.is_set():
                 try:
@@ -148,44 +150,77 @@ class ShardStream:
                     continue
             return False
         try:
-            for part in self.shards.iter_shards():
-                if not put({k: part[k] for k in self.keys}):
+            for si, part in enumerate(self.shards.iter_shards(start_shard)):
+                item = {k: part[k] for k in self.keys}
+                if si == 0 and shard_offset:
+                    item = {k: v[shard_offset:] for k, v in item.items()}
+                if not put((start_shard + si, shard_offset if si == 0 else 0,
+                            item)):
                     return                    # consumer abandoned mid-epoch
             put(None)
         except BaseException as e:  # surface IO errors on the consumer side
             put(e)
 
-    def windows(self) -> Iterator[Window]:
+    def windows(self, start_shard: int = 0, shard_offset: int = 0,
+                start_row: int = 0) -> Iterator[Window]:
+        """Window the shard sequence.  The three offsets resume mid-dataset
+        (the ResidentCache tail: skip fully-cached shard files entirely,
+        slice into the first partial one, keep global row ids aligned)."""
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
-        t = threading.Thread(target=self._reader, args=(q, stop), daemon=True)
+        t = threading.Thread(target=self._reader,
+                             args=(q, stop, start_shard, shard_offset),
+                             daemon=True)
         t.start()
         try:
             buf: Dict[str, list] = {k: [] for k in self.keys}
+            # (shard idx, offset of first unconsumed row, rows left) per
+            # buffered source chunk — gives each window its (shard, offset)
+            sources: list = []
             buffered = 0
-            start = 0
+            start = start_row
             W = self.window_rows
+
+            def consume(rows: int) -> Tuple[int, int]:
+                """Pop ``rows`` rows off the source list; return the (shard,
+                offset) of the first popped row."""
+                src = (sources[0][0], sources[0][1])
+                left = rows
+                while left > 0 and sources:
+                    si, off, n = sources[0]
+                    take = min(left, n)
+                    left -= take
+                    if take == n:
+                        sources.pop(0)
+                    else:
+                        sources[0] = (si, off + take, n - take)
+                return src
+
             while True:
                 item = q.get()
                 if isinstance(item, BaseException):
                     raise item
                 if item is None:
                     break
-                n = len(next(iter(item.values())))
+                si, off, part = item
+                n = len(next(iter(part.values())))
                 if n == 0:
                     continue
                 for k in self.keys:
-                    buf[k].append(item[k])
+                    buf[k].append(part[k])
+                sources.append((si, off, n))
                 buffered += n
                 while buffered >= W:
                     arrays, buf, buffered = _take(buf, W, self.keys)
-                    yield Window(start=start, n_valid=W, arrays=arrays)
+                    yield Window(start=start, n_valid=W, arrays=arrays,
+                                 src=consume(W))
                     start += W
             if buffered:
                 arrays, buf, _ = _take(buf, buffered, self.keys)
                 yield Window(start=start, n_valid=buffered,
                              arrays={k: _pad_rows(a, W)
-                                     for k, a in arrays.items()})
+                                     for k, a in arrays.items()},
+                             src=consume(buffered))
         finally:
             # unblock + retire the reader even when the generator is
             # abandoned mid-iteration (jit error, early stop, interrupt)
@@ -218,6 +253,78 @@ def _pad_rows(a: np.ndarray, rows: int) -> np.ndarray:
         return a
     pad = np.zeros((rows - len(a),) + a.shape[1:], a.dtype)
     return np.concatenate([a, pad])
+
+
+@dataclass
+class PreparedWindow:
+    """A window after the trainer's ``prepare`` hook — arrays may live on
+    device (sharded over a mesh) or host."""
+    start: int
+    n_valid: int
+    rows: int
+    index: np.ndarray
+    arrays: Dict[str, object]
+    resident: bool = False
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(getattr(a, "nbytes", 0)
+                       for a in self.arrays.values()))
+
+
+class ResidentCache:
+    """Two-tier window residency — the ``MemoryDiskFloatMLDataSet.java:54-99``
+    memoryFraction design, TPU-shaped: prepared (typically device-resident,
+    mesh-sharded) windows fill a byte budget; only the tail past the budget
+    re-streams from disk on every subsequent sweep, resuming at the recorded
+    (shard, offset) so fully-cached shard files are never re-read.
+
+    With the dataset under budget, a GBT tree's (depth+2) level sweeps cost
+    ZERO disk passes after the single warm pass — the round-2 design's
+    (depth+2) full re-reads collapse to ~1/forest.  ``disk_passes`` counts
+    actual stream traversals for tests/telemetry.
+    """
+
+    def __init__(self, stream: "ShardStream", budget_bytes: int,
+                 prepare: Callable[[Window], PreparedWindow]):
+        self.stream = stream
+        self.budget = int(budget_bytes)
+        self.prepare = prepare
+        self.cached: list = []
+        self.tail: Optional[Tuple[int, int, int]] = None  # shard, offset, row
+        self.disk_passes = 0
+        self._warm = False
+
+    def items(self) -> Iterator[PreparedWindow]:
+        if not self._warm:
+            used = 0
+            caching = True
+            self.disk_passes += 1
+            for win in self.stream.windows():
+                item = self.prepare(win)
+                if caching and used + item.nbytes <= self.budget:
+                    item.resident = True
+                    self.cached.append(item)
+                    used += item.nbytes
+                elif caching:
+                    caching = False
+                    self.tail = (win.src[0], win.src[1], win.start) \
+                        if win.src else (0, 0, 0)
+                yield item
+            self._warm = True
+        else:
+            yield from self.cached
+            if self.tail is not None:
+                self.disk_passes += 1
+                sh, off, row = self.tail
+                for win in self.stream.windows(start_shard=sh,
+                                               shard_offset=off,
+                                               start_row=row):
+                    yield self.prepare(win)
+
+    @property
+    def resident_rows(self) -> int:
+        return sum(it.n_valid for it in self.cached)
 
 
 def auto_window_rows(row_bytes: int, budget_bytes: int,

@@ -366,7 +366,9 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
 def _gbt_window_hist(bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
                      n_bins: int, level: int, loss: str):
     """Streamed level step: window rows find their level-local node by
-    walking the partial tree, then scatter residual-gradient stats."""
+    walking the partial tree, then scatter residual-gradient stats.  With
+    mesh-sharded window rows the [nodes, C, B, S] sum is XLA's psum over
+    the data axis — the DTWorker→DTMaster merge on ICI."""
     node_idx = node_index_at_level(sf, lm, bins_w, level)
     grad = _loss_grad(y_w, f_w, loss)
     stats = jnp.stack([tw_w, tw_w * grad, tw_w * grad * grad], axis=1) \
@@ -375,8 +377,9 @@ def _gbt_window_hist(bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level"))
-def _rf_window_hist(bins_w, y_w, bw_w, sf, lm, n_nodes: int, n_bins: int,
-                    level: int):
+def _rf_window_hist(bins_w, y_w, w_w, bag_w, sf, lm, n_nodes: int,
+                    n_bins: int, level: int):
+    bw_w = w_w * bag_w
     node_idx = node_index_at_level(sf, lm, bins_w, level)
     stats = jnp.stack([bw_w, bw_w * y_w, bw_w * y_w * y_w], axis=1) \
         .astype(jnp.float32)
@@ -394,6 +397,57 @@ def _gbt_window_update(bins_w, y_w, tw_w, vw_w, f_w, sf, lm, lv, lr,
     return f2, sums
 
 
+@partial(jax.jit, static_argnames=("depth", "loss"))
+def _rf_window_update(bins_w, y_w, w_w, bag_w, oob_sum_w, oob_cnt_w,
+                      sf, lm, lv, depth: int, loss: str):
+    """RF per-window oob accumulate + loss-consistent error sums on device
+    (the round-2 host-numpy loop, jitted)."""
+    pred = predict_tree(sf, lm, lv, bins_w, depth)
+    oob = (bag_w == 0) & (w_w > 0)
+    oob_sum2 = oob_sum_w + jnp.where(oob, pred, 0.0)
+    oob_cnt2 = oob_cnt_w + oob.astype(oob_cnt_w.dtype)
+    seen = oob_cnt2 > 0
+    oob_pred = oob_sum2 / jnp.maximum(oob_cnt2, 1.0)
+    if loss == "log":
+        p = jnp.clip(oob_pred, 1e-9, 1 - 1e-9)
+        per_v = -(y_w * jnp.log(p) + (1 - y_w) * jnp.log(1 - p))
+        pt = jnp.clip(pred, 1e-9, 1 - 1e-9)
+        per_t = -(y_w * jnp.log(pt) + (1 - y_w) * jnp.log(1 - pt))
+    else:
+        per_v = _per_row_loss(y_w, oob_pred, loss)
+        per_t = _per_row_loss(y_w, pred, loss)
+    wv = w_w * seen
+    sums = jnp.stack([(per_v * wv).sum(), wv.sum(),
+                      (per_t * w_w).sum(), w_w.sum()])
+    return oob_sum2, oob_cnt2, sums
+
+
+def _device_put_window(mesh, arrays: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """Place a window's arrays: mesh-sharded over the data axis when a mesh
+    is given (rows must divide the axis), plain device arrays otherwise."""
+    if mesh is None:
+        return {k: jnp.asarray(v) for k, v in arrays.items()}
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    out = {}
+    for k, a in arrays.items():
+        spec = P("data") if a.ndim == 1 else P("data", None)
+        out[k] = jax.device_put(a, NamedSharding(mesh, spec))
+    return out
+
+
+def _require_divisible(stream, mesh) -> None:
+    if mesh is not None and stream.window_rows % mesh.shape["data"] != 0:
+        raise ValueError(
+            f"window_rows {stream.window_rows} must divide the mesh data "
+            f"axis ({mesh.shape['data']}) — round it up at the call site")
+
+
+def _default_cache_budget() -> int:
+    from ..config import environment
+    return environment.get_int("shifu.train.deviceCacheBytes", 1 << 30)
+
+
 def _stream_masks(idx: np.ndarray, n_valid: int, w_w: np.ndarray,
                   valid_rate: float, seed: int):
     """Hash-based train/valid weights for a window (stateless row split)."""
@@ -405,19 +459,44 @@ def _stream_masks(idx: np.ndarray, n_valid: int, w_w: np.ndarray,
     return (w * ~vmask).astype(np.float32), (w * vmask).astype(np.float32)
 
 
+def _gbt_prepare(mesh, valid_rate: float, seed: int):
+    """Window prepare hook for streamed GBT: hash train/valid masks once,
+    arrays onto the device (mesh-sharded over the data axis)."""
+    from ..data.streaming import PreparedWindow
+
+    def prep(win):
+        tw, vw = _stream_masks(win.index, win.n_valid, win.arrays["w"],
+                               valid_rate, seed)
+        dev = _device_put_window(mesh, {
+            "bins": np.asarray(win.arrays["bins"], np.int32),
+            "y": np.asarray(win.arrays["y"], np.float32),
+            "tw": tw, "vw": vw})
+        return PreparedWindow(win.start, win.n_valid, win.rows,
+                              win.index, dev)
+    return prep
+
+
 def train_gbt_streamed(stream, n_bins: int, cat_mask,
                        settings: DTSettings, progress=None,
                        init_trees: Optional[List[TreeArrays]] = None,
                        init_score: Optional[float] = None,
                        checkpoint_fn: Optional[Callable] = None,
-                       start_history: Optional[List] = None) -> ForestResult:
-    """Out-of-core GBT: the binned matrix streams from disk every level; the
-    per-row score cache f (rows × 8B) is the only global row state.  One
-    tree costs depth+2 passes over the stream."""
-    first = True
+                       start_history: Optional[List] = None,
+                       mesh=None,
+                       cache_budget: Optional[int] = None) -> ForestResult:
+    """Out-of-core GBT over a ResidentCache: windows that fit the device
+    budget are mesh-sharded HBM residents (re-sweeping them costs no IO);
+    only the tail past the budget re-streams from disk per level.  The
+    per-row score cache f (rows × 4B host) is the only global row state.
+
+    When the dataset fits the budget a whole tree costs ZERO disk passes
+    (one warm pass total); the round-2 depth+2-passes-per-tree design is
+    gone.  (Reference: ``MemoryDiskFloatMLDataSet.java:54-99`` memory tier,
+    ``DTWorker.java:763-884`` histogram merge.)"""
+    from ..data.streaming import ResidentCache
+
+    _require_divisible(stream, mesh)
     n_rows = stream.num_rows
-    c = None
-    f = None
     total = n_tree_nodes(settings.depth)
     trees: List[TreeArrays] = list(init_trees or [])
     history: List[Tuple[float, float]] = list(start_history or [])
@@ -425,40 +504,41 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
     for _, va_prev in history:
         stopper.add(va_prev)
 
-    # probe width from the first window of a throwaway pass
-    for win in stream.windows():
-        c = win.arrays["bins"].shape[1]
-        break
+    cache = ResidentCache(stream,
+                          _default_cache_budget() if cache_budget is None
+                          else cache_budget,
+                          _gbt_prepare(mesh, settings.valid_rate,
+                                       settings.seed))
+
+    # warm pass: width probe + init-score sums in one sweep
+    c = None
+    sw = sy = 0.0
+    for it in cache.items():
+        if c is None:
+            c = int(it.arrays["bins"].shape[1])
+        if init_score is None:
+            sy += float((it.arrays["tw"] * it.arrays["y"]).sum())
+            sw += float(it.arrays["tw"].sum())
     if c is None:
         raise RuntimeError("streamed GBT: empty shard stream")
-    cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
-    fi = np.zeros(c)
-
-    # init score + f cache (host, float32) — replay any existing trees
-    f = np.zeros(n_rows, np.float32)
     if init_score is None:
-        sw = sy = 0.0
-        for win in stream.windows():
-            tw_w, _ = _stream_masks(win.index, win.n_valid, win.arrays["w"],
-                                    settings.valid_rate, settings.seed)
-            sw += float(tw_w.sum())
-            sy += float((tw_w * win.arrays["y"]).sum())
         prior = sy / max(sw, 1e-9)
         if settings.loss == "log":
             prior = float(np.clip(prior, 1e-6, 1 - 1e-6))
             init_score = float(np.log(prior / (1 - prior)))
         else:
             init_score = prior
-    f[:] = init_score
-    for t in trees:
+    cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
+    fi = np.zeros(c)
+
+    f = np.full(n_rows, init_score, np.float32)
+    for t in trees:  # resumed/continuous: replay stored trees over the cache
         sf, lm, lv = (jnp.asarray(t.split_feat), jnp.asarray(t.left_mask),
                       jnp.asarray(t.leaf_value))
-        for win in stream.windows():
-            pred = predict_tree(sf, lm, lv,
-                                jnp.asarray(win.arrays["bins"], jnp.int32),
-                                t.depth)
-            s, e = win.start, win.start + win.n_valid
-            f[s:e] += settings.learning_rate * np.asarray(pred)[:win.n_valid]
+        for it in cache.items():
+            pred = predict_tree(sf, lm, lv, it.arrays["bins"], t.depth)
+            s, e = it.start, it.start + it.n_valid
+            f[s:e] += settings.learning_rate * np.asarray(pred)[:it.n_valid]
 
     for ti in range(len(trees), settings.n_trees):
         fa = jnp.asarray(_feat_subset(settings, c, ti))
@@ -468,16 +548,10 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
         for level in range(settings.depth + 1):
             n_nodes = 1 << level
             hist = jnp.zeros((n_nodes, c, n_bins, 3), jnp.float32)
-            for win in stream.windows():
-                s, e = win.start, win.start + win.rows
-                tw_w, _ = _stream_masks(win.index, win.n_valid,
-                                        win.arrays["w"],
-                                        settings.valid_rate, settings.seed)
-                f_w = _window_f(f, win)
+            for it in cache.items():
                 hist = hist + _gbt_window_hist(
-                    jnp.asarray(win.arrays["bins"], jnp.int32),
-                    jnp.asarray(win.arrays["y"], jnp.float32),
-                    jnp.asarray(tw_w), jnp.asarray(f_w), sf, lm,
+                    it.arrays["bins"], it.arrays["y"], it.arrays["tw"],
+                    _window_f(f, it, mesh), sf, lm,
                     n_nodes, n_bins, level, settings.loss)
             gain, feat, lmask, leaf, _ = best_splits(
                 hist, cat, fa,
@@ -497,19 +571,14 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
             fi += np.asarray(fi_add)
         # update pass: f cache + errors
         sums = np.zeros(4)
-        for win in stream.windows():
-            tw_w, vw_w = _stream_masks(win.index, win.n_valid,
-                                       win.arrays["w"],
-                                       settings.valid_rate, settings.seed)
-            f_w = _window_f(f, win)
+        for it in cache.items():
             f2, s4 = _gbt_window_update(
-                jnp.asarray(win.arrays["bins"], jnp.int32),
-                jnp.asarray(win.arrays["y"], jnp.float32),
-                jnp.asarray(tw_w), jnp.asarray(vw_w), jnp.asarray(f_w),
+                it.arrays["bins"], it.arrays["y"], it.arrays["tw"],
+                it.arrays["vw"], _window_f(f, it, mesh),
                 sf, lm, lv, settings.learning_rate, settings.depth,
                 settings.loss)
-            s, e = win.start, win.start + win.n_valid
-            f[s:e] = np.asarray(f2)[:win.n_valid]
+            s, e = it.start, it.start + it.n_valid
+            f[s:e] = np.asarray(f2)[:it.n_valid]
             sums += np.asarray(s4)
         trees.append(TreeArrays(split_feat=np.asarray(sf),
                                 left_mask=np.asarray(lm),
@@ -533,16 +602,22 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                      "init_score": init_score},
         train_error=history[-1][0] if history else float("nan"),
         valid_error=history[-1][1] if history else float("nan"),
-        feature_importance=fi, trees_built=len(trees), history=history)
+        feature_importance=fi, trees_built=len(trees), history=history,
+        disk_passes=cache.disk_passes)
 
 
-def _window_f(f: np.ndarray, win) -> np.ndarray:
-    """Slice the row-score cache for a window, padding past the end."""
+def _window_f(f: np.ndarray, win, mesh=None):
+    """Slice the row-score cache for a window, padding past the end; shard
+    over the mesh data axis so it joins the window's arrays' layout."""
     s = win.start
     e = min(s + win.rows, len(f))
     out = np.zeros(win.rows, np.float32)
     out[:e - s] = f[s:e]
-    return out
+    if mesh is None:
+        return jnp.asarray(out)
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    return jax.device_put(out, NamedSharding(mesh, P("data")))
 
 
 def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
