@@ -115,6 +115,10 @@ def bench_gbt_streamed(n_rows: int = 1 << 16, n_features: int = 64,
                        "numShards": n_shards, "numRows": n_rows}, f)
         stream = ShardStream(Shards.open(td), ("bins", "y", "w"),
                              window_rows=16384)
+        # compile warmup (same shapes/levels as the timed run)
+        train_gbt_streamed(stream, n_bins, cat,
+                           DTSettings(n_trees=1, depth=depth, loss="log",
+                                      learning_rate=0.1))
         settings = DTSettings(n_trees=n_trees, depth=depth, loss="log",
                               learning_rate=0.1)
         t0 = time.perf_counter()

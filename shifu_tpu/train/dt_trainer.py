@@ -135,6 +135,7 @@ class ForestResult:
     feature_importance: np.ndarray       # [C] summed split gains
     trees_built: int = 0
     history: List[Tuple[float, float]] = field(default_factory=list)
+    disk_passes: int = 0                 # streamed mode: cold stream sweeps taken
 
 
 # ---------------------------------------------------------------- jitted rounds
@@ -613,60 +614,112 @@ def _window_f(f: np.ndarray, win, mesh=None):
     e = min(s + win.rows, len(f))
     out = np.zeros(win.rows, np.float32)
     out[:e - s] = f[s:e]
+    return _shard_rows(out, mesh)
+
+
+def _rf_prepare(mesh):
+    """Window prepare hook for streamed RF: zero weights past n_valid once,
+    arrays onto the device (mesh-sharded over the data axis)."""
+    from ..data.streaming import PreparedWindow
+
+    def prep(win):
+        w = np.asarray(win.arrays["w"], np.float32).copy()
+        w[win.n_valid:] = 0.0
+        dev = _device_put_window(mesh, {
+            "bins": np.asarray(win.arrays["bins"], np.int32),
+            "y": np.asarray(win.arrays["y"], np.float32),
+            "w": w})
+        return PreparedWindow(win.start, win.n_valid, win.rows,
+                              win.index, dev)
+    return prep
+
+
+def _shard_rows(a: np.ndarray, mesh=None):
+    """Place a per-window row array next to the window's (possibly
+    mesh-sharded) arrays so jitted window steps see one layout."""
     if mesh is None:
-        return jnp.asarray(out)
+        return jnp.asarray(a)
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
-    return jax.device_put(out, NamedSharding(mesh, P("data")))
+    return jax.device_put(a, NamedSharding(mesh, P("data")))
 
 
 def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                       progress=None,
                       checkpoint_fn: Optional[Callable] = None,
                       init_trees: Optional[List[TreeArrays]] = None,
-                      start_history: Optional[List] = None) -> ForestResult:
-    """Out-of-core RF: hash-based Poisson bags per (tree, row); oob vote
-    caches (2 host arrays, rows × 4B) carry validation across trees."""
-    from ..data.streaming import _hash_poisson, row_uniform
+                      start_history: Optional[List] = None,
+                      mesh=None,
+                      cache_budget: Optional[int] = None) -> ForestResult:
+    """Out-of-core RF over a ResidentCache: hash-based Poisson bags per
+    (tree, row) keep bagging stateless across sweeps; oob vote caches
+    (2 host arrays, rows x 4B) carry validation across trees.  Windows
+    under the device budget are mesh-sharded HBM residents (re-sweeping
+    them costs no IO); only the tail re-streams from disk.  (Reference:
+    ``DTWorker.java:763-884`` histogram merge, ``DTMaster.java:274-533``
+    split pick, ``MemoryDiskFloatMLDataSet.java:54-99`` memory tier.)"""
+    from ..data.streaming import ResidentCache, _hash_poisson, row_uniform
 
+    _require_divisible(stream, mesh)
     n_rows = stream.num_rows
+    total = n_tree_nodes(settings.depth)
+    trees: List[TreeArrays] = list(init_trees or [])
+    history: List[Tuple[float, float]] = list(start_history or [])
+
+    cache = ResidentCache(stream,
+                          _default_cache_budget() if cache_budget is None
+                          else cache_budget, _rf_prepare(mesh))
     c = None
-    for win in stream.windows():
-        c = win.arrays["bins"].shape[1]
-        break
+    for win in stream.windows():      # peek the first window for the width;
+        c = int(win.arrays["bins"].shape[1])   # cache warms during useful
+        break                                  # level-0 work, not here
     if c is None:
         raise RuntimeError("streamed RF: empty shard stream")
     cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
-    total = n_tree_nodes(settings.depth)
     oob_sum = np.zeros(n_rows, np.float32)
     oob_cnt = np.zeros(n_rows, np.float32)
-    trees: List[TreeArrays] = list(init_trees or [])
-    history: List[Tuple[float, float]] = list(start_history or [])
     fi = np.zeros(c)
 
-    def window_bag(ti: int, win) -> np.ndarray:
-        u = row_uniform(settings.seed, 5000 + ti, win.index)
-        bag = _hash_poisson(settings.bagging_rate, u) \
-            if settings.poisson_bagging else np.ones(win.rows, np.float32)
-        bag[win.n_valid:] = 0.0
-        return bag
+    # per-(tree, window) bags are deterministic; memoized so the depth+2
+    # sweeps of a tree hash/upload each window's bag once
+    bag_cache: Dict[Tuple[int, int], Any] = {}
 
-    # resumed: replay oob accumulation for stored trees
+    def window_bag(ti: int, it):
+        key = (ti, it.start)
+        dev = bag_cache.get(key)
+        if dev is None:
+            u = row_uniform(settings.seed, 5000 + ti, it.index)
+            bag = _hash_poisson(settings.bagging_rate, u) \
+                if settings.poisson_bagging else np.ones(it.rows, np.float32)
+            bag[it.n_valid:] = 0.0
+            dev = _shard_rows(bag.astype(np.float32), mesh)
+            if it.resident:      # tail bags would grow with the dataset
+                bag_cache[key] = dev
+        return dev
+
+    def accumulate_oob(ti: int, sf, lm, lv, depth: int) -> np.ndarray:
+        sums = np.zeros(4)
+        for it in cache.items():
+            os2, oc2, s4 = _rf_window_update(
+                it.arrays["bins"], it.arrays["y"], it.arrays["w"],
+                window_bag(ti, it), _window_f(oob_sum, it, mesh),
+                _window_f(oob_cnt, it, mesh), sf, lm, lv, depth,
+                settings.loss)
+            s, e = it.start, it.start + it.n_valid
+            oob_sum[s:e] = np.asarray(os2)[:it.n_valid]
+            oob_cnt[s:e] = np.asarray(oc2)[:it.n_valid]
+            sums += np.asarray(s4)
+        return sums
+
+    # resumed/continuous: replay oob accumulation for stored trees
     for ti, t_old in enumerate(trees):
-        sf, lm, lv = (jnp.asarray(t_old.split_feat),
-                      jnp.asarray(t_old.left_mask),
-                      jnp.asarray(t_old.leaf_value))
-        for win in stream.windows():
-            bag = window_bag(ti, win)
-            pred = np.asarray(predict_tree(
-                sf, lm, lv, jnp.asarray(win.arrays["bins"], jnp.int32),
-                t_old.depth))
-            s, e = win.start, win.start + win.n_valid
-            oob = (bag[:win.n_valid] == 0) & (win.arrays["w"][:win.n_valid] > 0)
-            oob_sum[s:e][oob] += pred[:win.n_valid][oob]
-            oob_cnt[s:e][oob] += 1
+        bag_cache.clear()
+        accumulate_oob(ti, jnp.asarray(t_old.split_feat),
+                       jnp.asarray(t_old.left_mask),
+                       jnp.asarray(t_old.leaf_value), t_old.depth)
 
     for ti in range(len(trees), settings.n_trees):
+        bag_cache.clear()
         fa = jnp.asarray(_feat_subset(settings, c, ti))
         sf = jnp.full(total, -1, jnp.int32)
         lm = jnp.zeros((total, n_bins), bool)
@@ -674,13 +727,10 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
         for level in range(settings.depth + 1):
             n_nodes = 1 << level
             hist = jnp.zeros((n_nodes, c, n_bins, 3), jnp.float32)
-            for win in stream.windows():
-                bag = window_bag(ti, win)
-                bw = bag * np.asarray(win.arrays["w"], np.float32)
+            for it in cache.items():
                 hist = hist + _rf_window_hist(
-                    jnp.asarray(win.arrays["bins"], jnp.int32),
-                    jnp.asarray(win.arrays["y"], jnp.float32),
-                    jnp.asarray(bw), sf, lm, n_nodes, n_bins, level)
+                    it.arrays["bins"], it.arrays["y"], it.arrays["w"],
+                    window_bag(ti, it), sf, lm, n_nodes, n_bins, level)
             gain, feat, lmask, leaf, _ = best_splits(
                 hist, cat, fa, settings.impurity,
                 settings.min_instances, settings.min_gain)
@@ -694,44 +744,13 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
             fi += np.asarray(jax.ops.segment_sum(
                 np.asarray(jnp.where(feat >= 0, jnp.maximum(gain, 0.0), 0.0)),
                 np.maximum(np.asarray(feat), 0), num_segments=c))
-        # oob update + errors pass
-        tr_n = tr_d = va_n = va_d = 0.0
-        for win in stream.windows():
-            bag = window_bag(ti, win)
-            w_w = np.asarray(win.arrays["w"], np.float32).copy()
-            w_w[win.n_valid:] = 0.0
-            y_w = np.asarray(win.arrays["y"], np.float32)
-            pred = np.asarray(predict_tree(
-                sf, lm, lv, jnp.asarray(win.arrays["bins"], jnp.int32),
-                settings.depth))
-            s, e = win.start, win.start + win.n_valid
-            nv = win.n_valid
-            oob = (bag[:nv] == 0) & (w_w[:nv] > 0)
-            oob_sum[s:e][oob] += pred[:nv][oob]
-            oob_cnt[s:e][oob] += 1
-            seen = oob_cnt[s:e] > 0
-            oob_pred = oob_sum[s:e] / np.maximum(oob_cnt[s:e], 1.0)
-            if settings.loss == "log":
-                p = np.clip(oob_pred, 1e-9, 1 - 1e-9)
-                per_v = -(y_w[:nv] * np.log(p)
-                          + (1 - y_w[:nv]) * np.log(1 - p))
-                pt = np.clip(pred[:nv], 1e-9, 1 - 1e-9)
-                per_t = -(y_w[:nv] * np.log(pt)
-                          + (1 - y_w[:nv]) * np.log(1 - pt))
-            else:
-                per_v = (y_w[:nv] - oob_pred) ** 2
-                per_t = (y_w[:nv] - pred[:nv]) ** 2
-            wv = w_w[:nv] * seen
-            va_n += float((per_v * wv).sum())
-            va_d += float(wv.sum())
-            tr_n += float((per_t * w_w[:nv]).sum())
-            tr_d += float(w_w[:nv].sum())
+        sums = accumulate_oob(ti, sf, lm, lv, settings.depth)
         trees.append(TreeArrays(split_feat=np.asarray(sf),
                                 left_mask=np.asarray(lm),
                                 leaf_value=np.asarray(lv),
                                 depth=settings.depth))
-        tr_err = tr_n / max(tr_d, 1e-9)
-        va_err = va_n / max(va_d, 1e-9) if va_d > 0 else float("nan")
+        va_err = sums[0] / max(sums[1], 1e-9) if sums[1] > 0 else float("nan")
+        tr_err = sums[2] / max(sums[3], 1e-9)
         history.append((tr_err, va_err))
         if progress:
             progress(ti, tr_err, va_err)
@@ -742,7 +761,8 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
         trees=trees, spec_kwargs={"algorithm": "RF"},
         train_error=history[-1][0] if history else float("nan"),
         valid_error=history[-1][1] if history else float("nan"),
-        feature_importance=fi, trees_built=len(trees), history=history)
+        feature_importance=fi, trees_built=len(trees), history=history,
+        disk_passes=cache.disk_passes)
 
 
 # -------------------------------------------------------- pipeline driver
@@ -781,27 +801,35 @@ def run_tree_training(proc) -> int:
 
         init_trees, init_score, start_history = _restore_or_continuous(
             proc, alg, settings)
-        if streaming:
+        from ..parallel.mesh import device_mesh
+        mesh = device_mesh(n_ensemble=1)   # trees are sequential: all devices
+        if streaming:                      # on the data axis
             from ..config import environment
             from ..data.streaming import ShardStream, auto_window_rows
             budget = environment.get_int("shifu.train.memoryBudgetBytes",
                                          1 << 31)
+            data_size = mesh.shape["data"]
             window_rows = environment.get_int("shifu.train.windowRows", 0) or \
-                auto_window_rows(2 * len(col_nums) + 8, budget)
+                auto_window_rows(2 * len(col_nums) + 8, budget,
+                                 multiple=data_size)
+            window_rows += (-window_rows) % data_size
             stream = ShardStream(shards, ("bins", "y", "w"), window_rows)
-            log.info("train %s STREAMED: %d rows, window %d rows",
-                     alg.name, stream.num_rows, window_rows)
+            log.info("train %s STREAMED: %d rows, window %d rows, mesh %s",
+                     alg.name, stream.num_rows, window_rows,
+                     dict(mesh.shape))
             if alg == Algorithm.GBT:
                 res = train_gbt_streamed(stream, n_bins, cat_mask, settings,
                                          progress, init_trees=init_trees,
                                          init_score=init_score,
                                          checkpoint_fn=ckpt_fn,
-                                         start_history=start_history)
+                                         start_history=start_history,
+                                         mesh=mesh)
             else:
                 res = train_rf_streamed(stream, n_bins, cat_mask, settings,
                                         progress, checkpoint_fn=ckpt_fn,
                                         init_trees=init_trees,
-                                        start_history=start_history)
+                                        start_history=start_history,
+                                        mesh=mesh)
         else:
             data = shards.load_all()
             bins, y, w = data["bins"].astype(np.int32), data["y"], data["w"]
@@ -812,12 +840,12 @@ def run_tree_training(proc) -> int:
                 res = train_gbt(bins, y, w, n_bins, cat_mask, settings,
                                 progress, init_trees=init_trees,
                                 init_score=init_score, checkpoint_fn=ckpt_fn,
-                                start_history=start_history)
+                                start_history=start_history, mesh=mesh)
             else:
                 res = train_rf(bins, y, w, n_bins, cat_mask, settings,
                                progress, checkpoint_fn=ckpt_fn,
                                init_trees=init_trees,
-                               start_history=start_history)
+                               start_history=start_history, mesh=mesh)
         if alg != Algorithm.GBT:
             res.spec_kwargs["algorithm"] = "RF" if alg != Algorithm.DT else "DT"
 
