@@ -51,14 +51,21 @@ class ChunkExtractor:
             or model_config.dataSet.targetColumnName
         self.pos_tags = ds.posTags or model_config.dataSet.posTags
         self.neg_tags = ds.negTags or model_config.dataSet.negTags
+        # multi-class: posTags lists every class, negTags empty — y becomes
+        # the class index instead of a 0/1 target
+        self.multiclass = len(self.pos_tags) > 1 and not self.neg_tags
         self.weight_name = ds.weightColumnName
 
     def extract(self, chunk: RawChunk, keep_raw: bool = False) -> ExtractedChunk:
         df = chunk.data
         keep = self.purifier.mask(df)
         if self.target_name and self.target_name in df.columns:
-            y = tag_to_target(df[self.target_name].to_numpy(),
-                              self.pos_tags, self.neg_tags)
+            raw_tags = df[self.target_name].to_numpy()
+            if self.multiclass:
+                from .reader import tag_to_class
+                y = tag_to_class(raw_tags, self.pos_tags)
+            else:
+                y = tag_to_target(raw_tags, self.pos_tags, self.neg_tags)
             keep &= ~np.isnan(y)  # drop rows with unknown tags
         else:
             y = np.zeros(len(df))

@@ -167,6 +167,21 @@ def tag_to_target(values: np.ndarray, pos_tags: Sequence[str],
     return out
 
 
+def tag_to_class(values: np.ndarray, tags: Sequence[str]) -> np.ndarray:
+    """Map tag strings -> float class index (position in ``tags``), NaN for
+    unknown tags (filtered like binary invalid tags).
+
+    Multi-class tagging per the reference convention (``ModelConfig.java:
+    429-447`` getTags): posTags lists every class, negTags empty; the class
+    id is the tag's position.
+    """
+    s = pd.Series(values, dtype=str).str.strip()
+    out = np.full(len(s), np.nan, dtype=np.float64)
+    for k, t in enumerate(tags):
+        out[(s == str(t).strip()).to_numpy()] = float(k)
+    return out
+
+
 def parse_weight(values: Optional[np.ndarray], n: int) -> np.ndarray:
     if values is None:
         return np.ones(n, dtype=np.float64)

@@ -91,6 +91,50 @@ class Scorer:
                                max=raw.max(axis=1), min=raw.min(axis=1),
                                median=np.median(raw, axis=1))
 
+    # ------------------------------------------------------- multi-class
+    def n_classes(self) -> int:
+        """K from any model's spec extra (``n_classes`` is stamped by both
+        the NATIVE and OVA training paths); 0 = binary ensemble."""
+        for m in self.models:
+            spec = getattr(m, "spec", None)
+            if spec is not None:
+                k = (getattr(spec, "extra", None) or {}).get("n_classes")
+                if k:
+                    return int(k)
+        return 0
+
+    def score_classes(self, x: np.ndarray,
+                      bins: Optional[np.ndarray] = None) -> np.ndarray:
+        """[n, K] class scores: NATIVE models contribute their whole
+        softmax/distribution row, OVA binary models their ``class_index``
+        column; contributors average per class (reference
+        ``MultiClsTagPredictor`` assembles scores the same way)."""
+        k = self.n_classes()
+        if k < 2:
+            raise ValueError("score_classes needs multi-class models")
+        sums = cnts = None
+        for m in self.models:
+            kind = getattr(m, "input_kind", "norm")
+            inp = bins if kind == "bins" else x
+            out = np.asarray(m.compute(inp))
+            if sums is None:
+                sums = np.zeros((out.shape[0], k))
+                cnts = np.zeros(k)
+            spec = getattr(m, "spec", None)
+            ci = (getattr(spec, "extra", None) or {}).get("class_index") \
+                if spec is not None else None
+            if out.shape[1] == k:
+                sums += out
+                cnts += 1.0
+            elif ci is not None:
+                sums[:, int(ci)] += out[:, 0]
+                cnts[int(ci)] += 1.0
+            else:
+                raise ValueError(
+                    f"{type(m).__name__} is neither K-output NATIVE nor "
+                    "class-indexed OVA — cannot assemble class scores")
+        return sums / np.maximum(cnts, 1.0)[None, :]
+
 
 class ModelRunner:
     """raw chunk -> normalize -> score (reference ``ModelRunner.compute``,
@@ -108,3 +152,11 @@ class ModelRunner:
         res = self.scorer.score(tc.x, bins=tc.bins)
         return {"result": res, "target": tc.target, "weight": tc.weight,
                 "n": tc.n}
+
+    def compute_classes(self, chunk) -> Dict[str, np.ndarray]:
+        """Multi-class scoring: [n, K] class scores instead of per-model
+        scalar scores."""
+        tc = self.transformer.transform(chunk)
+        cs = self.scorer.score_classes(tc.x, bins=tc.bins)
+        return {"class_scores": cs, "target": tc.target,
+                "weight": tc.weight, "n": tc.n}

@@ -38,6 +38,7 @@ ACTIVATIONS: Dict[str, Callable] = {
     "linear": lambda x: x,
     "log": lambda x: jnp.where(x >= 0, jnp.log1p(x), -jnp.log1p(-x)),
     "sin": jnp.sin,
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
 }
 
 
@@ -135,6 +136,19 @@ LOSSES = {
 }
 
 
+def per_row_loss(pred, y, spec: NNModelSpec):
+    """Per-row loss for any head.  Multi-class (output_dim > 1): y holds the
+    class index, loss is softmax cross-entropy — the NATIVE multi-class mode
+    (reference ``ModelTrainConf.MultipleClassification.NATIVE``).  Binary /
+    regression: the configured elementwise loss."""
+    if spec.output_dim > 1:
+        oh = jax.nn.one_hot(jnp.asarray(y).reshape(-1).astype(jnp.int32),
+                            spec.output_dim, dtype=pred.dtype)
+        return -(oh * jnp.log(jnp.clip(pred, 1e-7, 1.0))).sum(axis=-1)
+    lfn = LOSSES.get(spec.loss, LOSSES["squared"])
+    return lfn(pred, y).sum(axis=-1)
+
+
 def weighted_loss(params, spec: NNModelSpec, x, y, w, *,
                   l2: float = 0.0, l1: float = 0.0,
                   dropout_rate: float = 0.0, rng=None):
@@ -142,8 +156,7 @@ def weighted_loss(params, spec: NNModelSpec, x, y, w, *,
     applies reg in the update; applying it in the loss is equivalent under
     gradient descent and lets XLA fuse it)."""
     pred = forward(params, spec, x, dropout_rate=dropout_rate, rng=rng)
-    lfn = LOSSES.get(spec.loss, LOSSES["squared"])
-    per_row = lfn(pred, y).sum(axis=-1)
+    per_row = per_row_loss(pred, y, spec)
     denom = jnp.maximum(w.sum(), 1e-9)
     loss = (per_row * w).sum() / denom
     if l2:

@@ -112,5 +112,9 @@ class IndependentTreeModel:
             else:
                 out = np.clip(f, 0.0, 1.0)
             return out[:, None].astype(np.float32)
-        # RF: mean leaf pos-rate across trees
-        return preds.mean(axis=0)[:, None].astype(np.float32)
+        # RF: mean leaf across trees — pos-rate [N] binary, class
+        # distribution [N, K] multiclass NATIVE
+        out = preds.mean(axis=0)
+        if out.ndim == 1:
+            out = out[:, None]
+        return out.astype(np.float32)

@@ -93,19 +93,48 @@ def _impurity_score(w, wy, wy2, kind: str):
     raise ValueError(f"unknown impurity {kind!r}")
 
 
-@partial(jax.jit, static_argnames=("impurity",))
+def _class_score(cnt, kind: str):
+    """Multi-class purity score from per-class weight counts ``cnt``
+    [..., K]; gain = score_L + score_R - score_P (reference multiclass
+    Entropy/Gini, ``dt/Impurity.java:368,553``)."""
+    tot = jnp.maximum(cnt.sum(-1), EPS)
+    p = jnp.clip(cnt, 0.0, None) / tot[..., None]
+    if kind == "entropy":
+        h = -(jnp.where(p > 0, p * jnp.log2(jnp.maximum(p, EPS)), 0.0)).sum(-1)
+        return -tot * h
+    if kind == "gini":
+        return -tot * (1.0 - (p * p).sum(-1))
+    raise ValueError(f"multi-class impurity must be entropy/gini, "
+                     f"got {kind!r}")
+
+
+@partial(jax.jit, static_argnames=("impurity", "n_classes"))
 def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
-                min_instances: float = 1.0, min_gain: float = 0.0):
+                min_instances: float = 1.0, min_gain: float = 0.0,
+                n_classes: int = 0):
     """Best split per node from the level histogram.
 
-    hist: [nodes, C, B, 3] (w, wy, wy2); cat_mask: [C] bool (categorical →
-    bins sorted by response before the prefix scan); feat_active: [C] bool
-    (feature sub-sampling, reference featureSubsetStrategy).
+    hist: [nodes, C, B, 3] (w, wy, wy2) — or, when ``n_classes > 2``,
+    [nodes, C, B, K] per-class weight counts (multiclass NATIVE mode).
+    cat_mask: [C] bool (categorical → bins sorted by response before the
+    prefix scan); feat_active: [C] bool (feature sub-sampling, reference
+    featureSubsetStrategy).
 
     Returns (gain [nodes], feat [nodes], left_mask [nodes, B],
-             leaf_value [nodes], node_w [nodes]).
+             leaf_value [nodes] — or [nodes, K] class distributions when
+             multiclass — and node_w [nodes]).
     """
-    w, wy, wy2 = hist[..., 0], hist[..., 1], hist[..., 2]
+    multiclass = n_classes > 2
+    if multiclass:
+        cls = hist                                         # [nodes, C, B, K]
+        w = cls.sum(-1)
+        # scalar "response" for categorical ordering: mean class index
+        # (equals pos rate for K=2)
+        kidx = jnp.arange(n_classes, dtype=hist.dtype)
+        wy = (cls * kidx).sum(-1)
+        wy2 = jnp.zeros_like(w)
+    else:
+        w, wy, wy2 = hist[..., 0], hist[..., 1], hist[..., 2]
     n_nodes, c, b = w.shape
 
     # ---- per-(node,feat) bin order: natural for numeric, response-sorted
@@ -125,7 +154,15 @@ def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
     cwy2 = jnp.cumsum(wy2_o, axis=-1)
     tw, twy, twy2 = cw[..., -1:], cwy[..., -1:], cwy2[..., -1:]
 
-    if impurity == "friedmanmse":
+    if multiclass:
+        cls_o = jnp.take_along_axis(cls, order[..., None], axis=2)
+        ccls = jnp.cumsum(cls_o, axis=2)                  # [nodes, C, B, K]
+        tcls = ccls[:, :, -1:, :]
+        score_l = _class_score(ccls, impurity)
+        score_r = _class_score(tcls - ccls, impurity)
+        score_p = _class_score(tcls, impurity)
+        gain = score_l + score_r - score_p                 # [nodes, C, B]
+    elif impurity == "friedmanmse":
         # Friedman's improvement (reference ``dt/Impurity.java:313-315``):
         # (w_r*s_l - w_l*s_r)^2 / (w_l*w_r*(w_l+w_r))
         wl, wr = cw, tw - cw
@@ -156,7 +193,11 @@ def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
     left_mask = ranks <= k_sel
 
     node_w = tw[..., 0, 0]
-    leaf_value = twy[..., 0, 0] / jnp.maximum(node_w, EPS)
+    if multiclass:
+        node_cls = tcls[:, 0, 0, :]                       # [nodes, K]
+        leaf_value = node_cls / jnp.maximum(node_w, EPS)[:, None]
+    else:
+        leaf_value = twy[..., 0, 0] / jnp.maximum(node_w, EPS)
     ok = jnp.isfinite(node_gain) & (node_gain > min_gain)
     feat = jnp.where(ok, best_feat, -1)
     return node_gain, feat.astype(jnp.int32), left_mask & ok[:, None], \
@@ -175,9 +216,10 @@ def _descend(bins, node_idx, feat, lmask):
     return jnp.where(active, 2 * node_idx + jnp.where(goes_left, 0, 1), -1)
 
 
-@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity"))
+@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "n_classes"))
 def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
-                  impurity: str, min_instances: float, min_gain: float):
+                  impurity: str, min_instances: float, min_gain: float,
+                  n_classes: int = 0):
     """Whole-tree level-wise growth as ONE jitted program — zero host syncs
     per level (reference ``DTMaster.java:543-600`` level mode; the round-1
     build synced feat/lmask/leaf to host every level).
@@ -196,7 +238,7 @@ def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
         n_nodes = 1 << level
         hist = build_histograms(bins, node_idx, stats, n_nodes, n_bins)
         gain, feat, lmask, leaf, node_w = best_splits(
-            hist, cat, fa, impurity, min_instances, min_gain)
+            hist, cat, fa, impurity, min_instances, min_gain, n_classes)
         if level == depth:                   # bottom level never splits
             feat = jnp.full(n_nodes, -1, jnp.int32)
             lmask = jnp.zeros((n_nodes, n_bins), bool)

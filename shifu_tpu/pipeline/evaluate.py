@@ -96,6 +96,8 @@ class EvalProcessor(BasicProcessor):
 
     def _run_one(self, idx: int, action: str, scorer: Scorer) -> int:
         mc = self.model_config
+        if mc.is_multi_class() and len(mc.dataSet.posTags) > 2:
+            return self._run_one_multiclass(idx, action, scorer)
         ev = mc.evals[idx]
         runner = ModelRunner(mc, self.column_configs, scorer.models,
                              for_eval_set=idx)
@@ -155,6 +157,71 @@ class EvalProcessor(BasicProcessor):
         log.info("eval %s: AUC %.6f weighted AUC %.6f PR-AUC %.6f",
                  ev.name, result.areaUnderRoc, result.weightedAuc,
                  result.areaUnderPr)
+        return 0
+
+    def _run_one_multiclass(self, idx: int, action: str,
+                            scorer: Scorer) -> int:
+        """Multi-class eval: [n, K] class scores, argmax predicted tag,
+        accuracy + per-class OvR AUC + K x K confusion (reference
+        ``MultiClsTagPredictor`` + ``EvalScoreUDF`` multi-class columns)."""
+        from ..eval.metrics import evaluate_multiclass
+        mc = self.model_config
+        ev = mc.evals[idx]
+        runner = ModelRunner(mc, self.column_configs, scorer.models,
+                             for_eval_set=idx)
+        ds = ev.dataSet
+        source = DataSource(self._abs(ds.dataPath), ds.dataDelimiter,
+                            header_path=self._abs(ds.headerPath),
+                            header_delimiter=ds.headerDelimiter)
+        eval_dir = self.paths.eval_dir(ev.name)
+        os.makedirs(eval_dir, exist_ok=True)
+        # the SAME tag resolution ChunkExtractor uses: eval-set tags first —
+        # class indices in targets are positions in THIS list
+        tags = list(ds.posTags or mc.dataSet.posTags)
+        k_models = scorer.n_classes()
+        if k_models and len(tags) != k_models:
+            raise ValueError(
+                f"eval set {ev.name} lists {len(tags)} tags but the models "
+                f"were trained over {k_models} classes — tag lists must "
+                "match in length and order")
+        all_cs, all_t, all_w = [], [], []
+        with open(self.paths.eval_score_path(ev.name), "w") as sf:
+            w = csv.writer(sf, delimiter="|")
+            w.writerow(["tag", "weight", "predictedTag"]
+                       + [f"score_{t}" for t in tags])
+            for chunk in source.iter_chunks():
+                out = runner.compute_classes(chunk)
+                if out["n"] == 0:
+                    continue
+                cs = out["class_scores"]
+                pred = cs.argmax(axis=1)
+                tag_arr = np.asarray(tags, dtype=object)
+                block = np.column_stack(
+                    [out["target"].astype(int).astype(str),
+                     out["weight"].astype(str),
+                     tag_arr[pred].astype(str)]
+                    + [np.char.mod("%.6f", cs[:, k])
+                       for k in range(cs.shape[1])])
+                w.writerows(block.tolist())
+                all_cs.append(cs)
+                all_t.append(out["target"])
+                all_w.append(out["weight"])
+        if not all_cs:
+            log.error("eval %s: no records scored", ev.name)
+            return 1
+        cs = np.concatenate(all_cs)
+        t = np.concatenate(all_t)
+        wgt = np.concatenate(all_w)
+        log.info("eval %s: scored %d records over %d classes with %d "
+                 "model(s)", ev.name, len(t), len(tags), len(scorer.models))
+        if action == "score":
+            return 0
+        rep = evaluate_multiclass(cs, t, wgt)
+        rep["tags"] = tags
+        with open(self.paths.eval_performance_path(ev.name), "w") as f:
+            json.dump(rep, f, indent=2)
+        log.info("eval %s: accuracy %.6f macro OvR AUC %.6f", ev.name,
+                 rep["accuracy"], rep["macroAuc"])
         return 0
 
     def _write_confusion(self, name: str, result) -> None:

@@ -70,9 +70,13 @@ class StatsProcessor(BasicProcessor):
             ex = extractor.extract(chunk, keep_raw=psi_col is not None)
             if ex.n == 0:
                 continue
+            # multi-class: bin pos/neg stats binarize as class 0 vs rest so
+            # KS/IV/WOE stay defined (class ids are ordinal positions only)
+            tgt = (ex.target > 0).astype(ex.target.dtype) \
+                if extractor.multiclass else ex.target
             if num_cols:
                 num_acc.update_histogram(ex.numeric, ex.numeric_valid,
-                                         ex.target, ex.weight)
+                                         tgt, ex.weight)
                 if corr_acc is not None:
                     corr_acc.update(ex.numeric, ex.numeric_valid)
             for cc in cat_cols:
@@ -81,7 +85,7 @@ class StatsProcessor(BasicProcessor):
                 s = pd.Series(vals, dtype=str).str.strip()
                 valid = (~s.str.lower().isin(
                     {m.strip().lower() for m in extractor.missing_values})).to_numpy()
-                cat_acc.update(cc.columnName, vals, valid, ex.target, ex.weight)
+                cat_acc.update(cc.columnName, vals, valid, tgt, ex.weight)
 
         # ---------------- finalize numeric columns
         if num_cols:

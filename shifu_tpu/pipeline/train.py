@@ -112,10 +112,18 @@ class TrainProcessor(BasicProcessor):
 
     # ------------------------------------------------------------ NN / LR
     def _train_nn_family(self, alg: Algorithm) -> int:
+        from ..config.model_config import MultipleClassification
         mc = self.model_config
+        K = len(mc.dataSet.posTags) if mc.is_multi_class() else 0
+        ova = K > 2 and mc.train.multiClassifyMethod == \
+            MultipleClassification.ONEVSALL
         shards = Shards.open(self.paths.norm_dir)
         if self._use_streaming(shards, shards.schema):
-            return self._train_nn_streamed(alg, shards)
+            if ova:
+                log.warning("ONEVSALL has no streamed mode yet; "
+                            "training in-RAM")
+            else:
+                return self._train_nn_streamed(alg, shards, n_classes=K)
         data = shards.load_all()
         x, y, w = data["x"], data["y"], data["w"]
         if self.params.get("shuffle"):
@@ -150,6 +158,11 @@ class TrainProcessor(BasicProcessor):
                 run_params = trials[run[0]] if is_gs else dict(params)
                 spec = self._make_spec(alg, d, run_params, column_nums,
                                        feature_names)
+                if K > 2 and not ova:
+                    # NATIVE multiclass: one softmax head over K classes
+                    spec.output_dim = K
+                    spec.output_activation = "softmax"
+                    spec.extra["n_classes"] = K
                 settings = settings_from_params(run_params, mc.train)
                 if not is_gs:
                     # trainer-state fail-over checkpoints (grid trials are
@@ -157,6 +170,13 @@ class TrainProcessor(BasicProcessor):
                     settings.checkpoint_dir = self.paths.checkpoint_dir
                     settings.resume = bool(self.params.get("resume"))
                 run_kfold = kfold if not is_gs else -1
+                up_w = mc.train.upSampleWeight
+                if K > 2 and up_w != 1.0:
+                    # up-sampling is a binary notion (reference restricts it
+                    # to regression/binary); class indices would skew
+                    # arbitrary classes
+                    log.warning("upSampleWeight ignored for multi-class")
+                    up_w = 1.0
                 train_w, valid_w = member_masks(
                     n, len(run) if is_gs else bags,
                     valid_rate=mc.train.validSetRate,
@@ -164,8 +184,22 @@ class TrainProcessor(BasicProcessor):
                     sample_rate=mc.train.baggingSampleRate,
                     replacement=mc.train.baggingWithReplacement,
                     stratified=mc.train.stratifiedSample,
-                    up_sample_weight=mc.train.upSampleWeight,
+                    up_sample_weight=up_w,
                     targets=y, seed=settings.seed)
+                y_members = None
+                if ova:
+                    if is_gs:
+                        raise ValueError("grid search is not supported with "
+                                         "ONEVSALL multi-class")
+                    # fan each bagging member out per class: member b*K+k
+                    # trains class k's binary task on bag b's mask
+                    b0 = train_w.shape[0]
+                    train_w = np.repeat(train_w, K, axis=0)
+                    valid_w = np.repeat(valid_w, K, axis=0)
+                    y_members = np.tile(
+                        np.stack([(y == k).astype(np.float32)
+                                  for k in range(K)]), (b0, 1))
+                    spec.extra.update({"ova_classes": K, "n_classes": K})
                 n_members = train_w.shape[0]  # kfold mode yields numKFold
                 train_w = train_w * w[None, :]
                 valid_w = valid_w * w[None, :]
@@ -174,7 +208,8 @@ class TrainProcessor(BasicProcessor):
                 res = train_ensemble(x, y, train_w, valid_w, spec, settings,
                                      init_params_list=init_list,
                                      progress=self._progress_fn(pf, run),
-                                     checkpoint=self._checkpoint_fn(spec, alg))
+                                     checkpoint=self._checkpoint_fn(spec, alg),
+                                     y_members=y_members)
                 results.append((run, spec, res, run_params))
 
         self._write_models(results, alg, is_gs)
@@ -198,7 +233,8 @@ class TrainProcessor(BasicProcessor):
         n_rows = schema.get("numRows") or shards.num_rows
         return n_rows * 4 * (width + 2) > budget
 
-    def _train_nn_streamed(self, alg: Algorithm, shards: Shards) -> int:
+    def _train_nn_streamed(self, alg: Algorithm, shards: Shards,
+                           n_classes: int = 0) -> int:
         """Streamed counterpart of the in-RAM branch: windows flow through
         ``train_ensemble_streamed``; sampling masks are stateless hashes of
         the global row index (``data.streaming``)."""
@@ -249,6 +285,10 @@ class TrainProcessor(BasicProcessor):
                 run_params = trials[run[0]] if is_gs else dict(params)
                 spec = self._make_spec(alg, d, run_params, column_nums,
                                        feature_names)
+                if n_classes > 2:
+                    spec.output_dim = n_classes
+                    spec.output_activation = "softmax"
+                    spec.extra["n_classes"] = n_classes
                 settings = settings_from_params(run_params, mc.train)
                 if not is_gs:
                     settings.checkpoint_dir = self.paths.checkpoint_dir
@@ -256,12 +296,16 @@ class TrainProcessor(BasicProcessor):
                 run_kfold = kfold if not is_gs else -1
                 n_members = run_kfold if (run_kfold and run_kfold > 1) \
                     else (len(run) if is_gs else bags)
+                up_w = mc.train.upSampleWeight
+                if n_classes > 2 and up_w != 1.0:
+                    log.warning("upSampleWeight ignored for multi-class")
+                    up_w = 1.0
                 mask_fn = mask_fn_from_settings(
                     n_members, valid_rate=mc.train.validSetRate,
                     kfold=run_kfold,
                     sample_rate=mc.train.baggingSampleRate,
                     replacement=mc.train.baggingWithReplacement,
-                    up_sample_weight=mc.train.upSampleWeight,
+                    up_sample_weight=up_w,
                     seed=settings.seed)
                 stream = ShardStream(shards, ("x", "y", "w"), window_rows)
                 init_list = self._continuous_init(spec, n_members, alg)
@@ -346,7 +390,14 @@ class TrainProcessor(BasicProcessor):
                 json.dump(report, f, indent=2, default=str)
             return
         run, spec, res, _ = results[0]
+        ova_k = (spec.extra or {}).get("ova_classes")
         for i, p in enumerate(res.params):
-            nn_model.save_model(self.paths.model_path(i, ext), spec, p)
+            sp = spec
+            if ova_k:
+                # member b*K+k scores class k — stamp the class identity
+                import dataclasses
+                sp = dataclasses.replace(
+                    spec, extra={**spec.extra, "class_index": i % ova_k})
+            nn_model.save_model(self.paths.model_path(i, ext), sp, p)
         log.info("saved %d model(s); valid errors %s", len(res.params),
                  np.round(res.valid_errors, 6).tolist())

@@ -70,6 +70,7 @@ class DTSettings:
     checkpoint_dir: str = ""             # "" disables mid-forest checkpoints
     checkpoint_every: int = 25           # trees between checkpoints
     resume: bool = False
+    n_classes: int = 0                   # >2: RF multiclass NATIVE mode
 
 
 def settings_from_params(params: Dict[str, Any], train_conf,
@@ -176,22 +177,44 @@ def _gbt_round(bins, y, tw, vw, f, fa, cat, lr, min_instances, min_gain,
 
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
-                                   "poisson"))
+                                   "poisson", "n_classes"))
 def _rf_round(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
               min_instances, min_gain, n_bins: int, depth: int,
-              impurity: str, loss: str, poisson: bool):
+              impurity: str, loss: str, poisson: bool, n_classes: int = 0):
     """One RF tree on device: Poisson bag → grow → oob accumulate →
     loss-consistent oob validation error (reference oob-as-validation,
-    ``DTWorker.java:582-616``; round 1 hardcoded squared error)."""
+    ``DTWorker.java:582-616``; round 1 hardcoded squared error).
+
+    Multiclass NATIVE (``n_classes > 2``): per-class stat channels, leaf
+    class distributions, misclassification-rate errors (reference
+    ``dt/Impurity.java:368,553`` multiclass Entropy/Gini)."""
     n = bins.shape[0]
+    multiclass = n_classes > 2
     bag = jax.random.poisson(key, bag_rate, (n,)).astype(jnp.float32) \
         if poisson else jnp.ones(n, jnp.float32)
     bw = w * bag
-    stats = jnp.stack([bw, bw * y, bw * y * y], axis=1).astype(jnp.float32)
+    if multiclass:
+        yi = y.astype(jnp.int32)
+        stats = bw[:, None] * jax.nn.one_hot(yi, n_classes,
+                                             dtype=jnp.float32)
+    else:
+        stats = jnp.stack([bw, bw * y, bw * y * y], axis=1) \
+            .astype(jnp.float32)
     sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
-                                    impurity, min_instances, min_gain)
-    pred = predict_tree(sf, lm, lv, bins, depth)
+                                    impurity, min_instances, min_gain,
+                                    n_classes)
+    pred = predict_tree(sf, lm, lv, bins, depth)   # [n, K] mc, [n] binary
     oob = (bag == 0) & (w > 0)
+    if multiclass:
+        oob_sum = oob_sum + jnp.where(oob[:, None], pred, 0.0)
+        oob_cnt = oob_cnt + oob.astype(oob_cnt.dtype)
+        seen = oob_cnt > 0
+        per_v = (jnp.argmax(oob_sum, axis=-1) != yi).astype(jnp.float32)
+        per_t = (jnp.argmax(pred, axis=-1) != yi).astype(jnp.float32)
+        wv = w * seen
+        va = (per_v * wv).sum() / jnp.maximum(wv.sum(), 1e-9)
+        tr = (per_t * w).sum() / jnp.maximum(w.sum(), 1e-9)
+        return sf, lm, lv, gfi, oob_sum, oob_cnt, tr, va
     oob_sum = oob_sum + jnp.where(oob, pred, 0.0)
     oob_cnt = oob_cnt + oob.astype(oob_cnt.dtype)
     seen = oob_cnt > 0
@@ -314,7 +337,10 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
         mesh, np.asarray(bins, np.int32), np.asarray(y, np.float32),
         np.asarray(w, np.float32))
     cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
-    oob_sum = jnp.zeros(bins_d.shape[0], jnp.float32)
+    mc = settings.n_classes > 2
+    oob_shape = (bins_d.shape[0], settings.n_classes) if mc \
+        else (bins_d.shape[0],)
+    oob_sum = jnp.zeros(oob_shape, jnp.float32)
     oob_cnt = jnp.zeros(bins_d.shape[0], jnp.float32)
     trees: List[TreeArrays] = list(init_trees or [])
     history: List[Tuple[float, float]] = list(start_history or [])
@@ -332,7 +358,8 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
                                 jnp.asarray(t_old.leaf_value), bins_d,
                                 t_old.depth)
             oob = (bag == 0) & (w_d > 0)
-            oob_sum = oob_sum + jnp.where(oob, pred, 0.0)
+            oob_sum = oob_sum + jnp.where(oob[:, None] if mc else oob,
+                                          pred, 0.0)
             oob_cnt = oob_cnt + oob.astype(jnp.float32)
     for ti in range(start, settings.n_trees):
         fa = jnp.asarray(_feat_subset(settings, c, ti))
@@ -341,7 +368,7 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
             bins_d, y_d, w_d, key, settings.bagging_rate,
             oob_sum, oob_cnt, fa, cat, settings.min_instances,
             settings.min_gain, n_bins, settings.depth, settings.impurity,
-            settings.loss, settings.poisson_bagging)
+            settings.loss, settings.poisson_bagging, settings.n_classes)
         trees.append(TreeArrays(split_feat=np.asarray(sf),
                                 left_mask=np.asarray(lm),
                                 leaf_value=np.asarray(lv),
@@ -354,8 +381,11 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
         if checkpoint_fn and settings.checkpoint_every and \
                 (ti + 1) % settings.checkpoint_every == 0:
             checkpoint_fn(trees, history, None)
+    spec_kwargs: Dict[str, Any] = {"algorithm": "RF"}
+    if mc:
+        spec_kwargs["extra"] = {"n_classes": settings.n_classes}
     return ForestResult(
-        trees=trees, spec_kwargs={"algorithm": "RF"},
+        trees=trees, spec_kwargs=spec_kwargs,
         train_error=history[-1][0] if history else float("nan"),
         valid_error=history[-1][1] if history else float("nan"),
         feature_importance=fi,
@@ -766,6 +796,59 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
 
 
 # -------------------------------------------------------- pipeline driver
+def _run_tree_ova(proc, shards, col_nums, cat_mask, n_bins,
+                  settings: DTSettings, alg, K: int) -> int:
+    """One-vs-all tree multiclass: K binary forests, ``model{k}`` scores
+    class k (reference ``TrainModelProcessor.java:684-714`` runs one bagging
+    job per class; here each class is a sequential forest on the full
+    mesh)."""
+    data = shards.load_all()
+    bins, y, w = data["bins"].astype(np.int32), data["y"], data["w"]
+    from ..parallel.mesh import device_mesh
+    mesh = device_mesh(n_ensemble=1)
+    os.makedirs(proc.paths.models_dir, exist_ok=True)
+    for f in os.listdir(proc.paths.models_dir):
+        if f.startswith("model"):
+            os.remove(os.path.join(proc.paths.models_dir, f))
+    fi_total = np.zeros(len(col_nums))
+    with open(proc.paths.progress_path, "w") as pf:
+        for k in range(K):
+            yk = (np.asarray(y) == k).astype(np.float32)
+
+            def progress(ti, tr, va, k=k):
+                pf.write(f"Class {k} Tree #{ti + 1} Train Error: {tr:.6f} "
+                         f"Validation Error: {va:.6f}\n")
+                pf.flush()
+
+            if alg == Algorithm.GBT:
+                res = train_gbt(bins, yk, w, n_bins, cat_mask, settings,
+                                progress, mesh=mesh)
+            else:
+                res = train_rf(bins, yk, w, n_bins, cat_mask, settings,
+                               progress, mesh=mesh)
+                res.spec_kwargs["algorithm"] = \
+                    "RF" if alg != Algorithm.DT else "DT"
+            res.spec_kwargs.setdefault("extra", {}).update(
+                {"class_index": k, "n_classes": K})
+            spec = tree_model.TreeModelSpec(
+                n_trees=len(res.trees), depth=settings.depth, n_bins=n_bins,
+                column_nums=list(col_nums),
+                feature_names=shards.schema.get("columnNames"),
+                **res.spec_kwargs)
+            tree_model.save_model(proc.paths.model_path(k, alg.name.lower()),
+                                  spec, res.trees)
+            fi_total += res.feature_importance
+            log.info("train %s OVA class %d/%d: %d trees, valid err %.6f",
+                     alg.name, k + 1, K, res.trees_built, res.valid_error)
+    names = shards.schema.get("columnNames", [str(cn) for cn in col_nums])
+    fi_named = sorted(((names[j], float(v)) for j, v in enumerate(fi_total)),
+                      key=lambda kv: -kv[1])
+    with open(os.path.join(proc.paths.tmp_dir, "feature_importance.json"),
+              "w") as fjson:
+        json.dump({k2: v for k2, v in fi_named}, fjson, indent=2)
+    return 0
+
+
 def run_tree_training(proc) -> int:
     """Entry called by TrainProcessor for GBT/RF/DT."""
     mc = proc.model_config
@@ -784,8 +867,33 @@ def run_tree_training(proc) -> int:
     settings.resume = bool(proc.params.get("resume"))
     settings.checkpoint_dir = proc.paths.checkpoint_dir
 
+    K = len(mc.dataSet.posTags) if mc.is_multi_class() else 0
+    if K > 2:
+        from ..config.model_config import MultipleClassification
+        # GBT has no NATIVE multiclass mode (reference restricts NATIVE to
+        # NN/RF, ``TrainModelProcessor.java:347-349``)
+        if mc.train.multiClassifyMethod == MultipleClassification.ONEVSALL \
+                or alg == Algorithm.GBT:
+            if hasattr(proc, "_use_streaming") and \
+                    proc._use_streaming(shards, shards.schema):
+                log.warning("tree ONEVSALL has no streamed mode yet; "
+                            "training in-RAM")
+            if proc.params.get("resume"):
+                log.warning("tree ONEVSALL does not support -resume; "
+                            "retraining all %d class forests", K)
+            return _run_tree_ova(proc, shards, col_nums, cat_mask, n_bins,
+                                 settings, alg, K)
+        settings.n_classes = K
+        settings.loss = "squared"          # errors are misclassification
+        if settings.impurity not in ("entropy", "gini"):
+            settings.impurity = "entropy"
+
     streaming = proc._use_streaming(shards, shards.schema) \
         if hasattr(proc, "_use_streaming") else False
+    if settings.n_classes > 2 and streaming:
+        log.warning("multiclass NATIVE RF has no streamed mode yet; "
+                    "training in-RAM")
+        streaming = False
     ckpt_fn = _forest_checkpoint_fn(proc, settings, alg, n_bins, col_nums,
                                     shards)
 

@@ -83,15 +83,27 @@ def train_ensemble(x: np.ndarray, y: np.ndarray,
                    init_params_list: Optional[List[Any]] = None,
                    progress: Optional[ProgressFn] = None,
                    checkpoint: Optional[Callable[[int, List[Any]], None]] = None,
-                   mesh=None) -> EnsembleResult:
+                   mesh=None,
+                   y_members: Optional[np.ndarray] = None) -> EnsembleResult:
     """Train ``B`` members; ``train_w``/``valid_w`` are ``[B, N]`` per-row
-    weight matrices (bagging/fold masks × data weights)."""
+    weight matrices (bagging/fold masks × data weights).
+
+    ``y_members`` ([B, N]) gives each member its OWN target — the one-vs-all
+    fan-out (reference ``TrainModelProcessor.java:684-714`` runs one bagging
+    job per class; here classes are members on the ensemble axis, trained
+    simultaneously as one vmapped program)."""
     bags = train_w.shape[0]
     n = x.shape[0]
     if mesh is None:
         mesh = meshlib.device_mesh(n_ensemble=bags)
     data_size = mesh.shape["data"]
-    x, y, train_w, valid_w = _pad_all(x, y, train_w, valid_w, data_size)
+    if y_members is not None:
+        # fold the per-member targets through the same row padding as the
+        # weights, then restore the shared-y variable for the common path
+        x, y, train_w, valid_w, y_members = _pad_all(
+            x, y, train_w, valid_w, data_size, y_members)
+    else:
+        x, y, train_w, valid_w = _pad_all(x, y, train_w, valid_w, data_size)
 
     key = jax.random.PRNGKey(settings.seed)
     if init_params_list is None:
@@ -111,6 +123,8 @@ def train_ensemble(x: np.ndarray, y: np.ndarray,
     yd = jax.device_put(y, NamedSharding(mesh, P("data")))
     twd = jax.device_put(train_w, NamedSharding(mesh, P("ensemble", "data")))
     vwd = jax.device_put(valid_w, NamedSharding(mesh, P("ensemble", "data")))
+    ymd = None if y_members is None else jax.device_put(
+        y_members, NamedSharding(mesh, P("ensemble", "data")))
 
     dropout = settings.dropout_rate
 
@@ -124,27 +138,39 @@ def train_ensemble(x: np.ndarray, y: np.ndarray,
                                         params, delta)
         return params, opt_state, loss
 
+    y_axis = None if ymd is None else 0    # per-member targets vmap over B
+
     @jax.jit
     def step(stacked, opt_state, xb, yb, tw, rngs, lr_scale):
-        return jax.vmap(member_update, in_axes=(0, 0, None, None, 0, 0, None))(
+        return jax.vmap(member_update,
+                        in_axes=(0, 0, None, y_axis, 0, 0, None))(
             stacked, opt_state, xb, yb, tw, rngs, lr_scale)
 
     @jax.jit
     def eval_errors(stacked, tw, vw):
-        def one(params, mw):
+        def one(params, mw, ym):
             pred = nn_model.forward(params, spec, xd)
-            lfn = nn_model.LOSSES.get(spec.loss, nn_model.LOSSES["squared"])
-            per_row = lfn(pred, yd[:, None]).sum(axis=-1)
+            per_row = nn_model.per_row_loss(pred, ym[:, None], spec)
             return (per_row * mw).sum() / jnp.maximum(mw.sum(), 1e-9)
-        return jax.vmap(one)(stacked, tw), jax.vmap(one)(stacked, vw)
+        ys = yd if ymd is None else ymd
+        ev = jax.vmap(one, in_axes=(0, 0, y_axis))
+        return ev(stacked, tw, ys), ev(stacked, vw, ys)
 
     bs = settings.batch_size
     if bs:
         bs = max(bs - bs % data_size, data_size)
         # pad rows to a batch multiple so the tail is never dropped;
         # padded rows carry zero weight
-        x, y, train_w, valid_w = _pad_all(
-            np.asarray(xd), np.asarray(yd), np.asarray(twd), np.asarray(vwd), bs)
+        if ymd is None:
+            x, y, train_w, valid_w = _pad_all(
+                np.asarray(xd), np.asarray(yd), np.asarray(twd),
+                np.asarray(vwd), bs)
+        else:
+            x, y, train_w, valid_w, y_members = _pad_all(
+                np.asarray(xd), np.asarray(yd), np.asarray(twd),
+                np.asarray(vwd), bs, np.asarray(ymd))
+            ymd = jax.device_put(y_members,
+                                 NamedSharding(mesh, P("ensemble", "data")))
         xd = jax.device_put(x, NamedSharding(mesh, P("data", None)))
         yd = jax.device_put(y, NamedSharding(mesh, P("data")))
         twd = jax.device_put(train_w, NamedSharding(mesh, P("ensemble", "data")))
@@ -180,14 +206,17 @@ def train_ensemble(x: np.ndarray, y: np.ndarray,
         if bs and bs < n_padded:
             for bi, start in enumerate(range(0, n_padded - bs + 1, bs)):
                 xb = jax.lax.slice_in_dim(xd, start, start + bs, axis=0)
-                yb = jax.lax.slice_in_dim(yd, start, start + bs, axis=0)
+                yb = jax.lax.slice_in_dim(yd, start, start + bs, axis=0) \
+                    if ymd is None else \
+                    jax.lax.slice_in_dim(ymd, start, start + bs, axis=1)
                 twb = jax.lax.slice_in_dim(twd, start, start + bs, axis=1)
                 rngs_b = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
                     rngs, bi) if dropout > 0 else rngs
                 stacked, opt_state, _ = step(stacked, opt_state, xb, yb, twb,
                                              rngs_b, lr_scale)
         else:
-            stacked, opt_state, _ = step(stacked, opt_state, xd, yd, twd,
+            stacked, opt_state, _ = step(stacked, opt_state, xd,
+                                         yd if ymd is None else ymd, twd,
                                          rngs, lr_scale)
         tr, va = eval_errors(stacked, twd, vwd)
         tr, va = np.asarray(tr), np.asarray(va)
@@ -233,7 +262,7 @@ def train_ensemble(x: np.ndarray, y: np.ndarray,
                           history=history)
 
 
-def _pad_all(x, y, train_w, valid_w, multiple):
+def _pad_all(x, y, train_w, valid_w, multiple, y_members=None):
     extra = meshlib.pad_rows(x.shape[0], multiple)
     if extra:
         x = np.concatenate([x, np.zeros((extra, x.shape[1]), x.dtype)])
@@ -241,6 +270,12 @@ def _pad_all(x, y, train_w, valid_w, multiple):
         zpad = np.zeros((train_w.shape[0], extra), train_w.dtype)
         train_w = np.concatenate([train_w, zpad], axis=1)
         valid_w = np.concatenate([valid_w, zpad], axis=1)
+        if y_members is not None:
+            y_members = np.concatenate(
+                [y_members, np.zeros((y_members.shape[0], extra),
+                                     y_members.dtype)], axis=1)
+    if y_members is not None:
+        return x, y, train_w, valid_w, y_members
     return x, y, train_w, valid_w
 
 
@@ -299,17 +334,16 @@ def train_ensemble_streamed(stream, spec: nn_model.NNModelSpec,
 
     dropout = settings.dropout_rate
     l1, l2 = settings.l1, settings.l2
-    lfn = nn_model.LOSSES.get(spec.loss, nn_model.LOSSES["squared"])
 
     def _loss_sum(params, xb, yb, mw, rng):
         pred = nn_model.forward(params, spec, xb,
                                 dropout_rate=dropout,
                                 rng=rng if dropout > 0 else None)
-        return (lfn(pred, yb[:, None]).sum(axis=-1) * mw).sum()
+        return (nn_model.per_row_loss(pred, yb[:, None], spec) * mw).sum()
 
     def _eval_sums(params, xb, yb, mw, vw):
         pred = nn_model.forward(params, spec, xb)
-        per_row = lfn(pred, yb[:, None]).sum(axis=-1)
+        per_row = nn_model.per_row_loss(pred, yb[:, None], spec)
         return jnp.stack([(per_row * mw).sum(), mw.sum(),
                           (per_row * vw).sum(), vw.sum()])
 

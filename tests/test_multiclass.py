@@ -1,0 +1,255 @@
+"""Multi-class classification: K-class tags, NATIVE softmax NN, NATIVE
+multiclass RF (per-class histogram channels), one-vs-all fan-out, and the
+multi-class eval report (reference ``TrainModelProcessor.java:684-714``,
+``dt/Impurity.java:368,553``, ``MultiClsTagPredictor``)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _three_class(n=900, d=5, seed=0):
+    """Linearly separable-ish 3-class data."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 3, n)
+    centers = np.array([[2.0, 0, 0, 0, 0], [0, 2.0, 0, 0, 0],
+                        [0, 0, 2.0, 0, 0]])
+    x = rng.normal(size=(n, d)) * 0.5 + centers[y][:, :d]
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def test_tag_to_class():
+    from shifu_tpu.data.reader import tag_to_class
+    vals = np.array(["a", "b", "c", "a", "zz", " b "])
+    out = tag_to_class(vals, ["a", "b", "c"])
+    np.testing.assert_array_equal(out[:4], [0, 1, 2, 0])
+    assert np.isnan(out[4])
+    assert out[5] == 1.0  # whitespace-stripped
+
+
+def test_multiclass_tree_kernel_pure_split():
+    from shifu_tpu.ops.tree import grow_tree_jit, predict_tree
+    rng = np.random.default_rng(0)
+    n = 900
+    y = np.repeat(np.arange(3), 300)
+    bins = rng.integers(0, 4, size=(n, 3)).astype(np.int32)
+    bins[:, 0] = y * 2
+    stats = np.ones(n, np.float32)[:, None] * \
+        np.asarray(jax.nn.one_hot(y, 3), np.float32)
+    sf, lm, lv, _ = grow_tree_jit(
+        jnp.asarray(bins), jnp.asarray(stats), jnp.zeros(3, bool),
+        jnp.ones(3, bool), 8, 2, "entropy", 1.0, 0.0, 3)
+    assert lv.shape == (7, 3)           # leaf class distributions
+    pred = np.asarray(predict_tree(sf, lm, lv, jnp.asarray(bins), 2))
+    assert (pred.argmax(1) == y).mean() == 1.0
+
+
+def test_rf_native_multiclass_trains():
+    from shifu_tpu.train.dt_trainer import DTSettings, train_rf
+    rng = np.random.default_rng(1)
+    n = 1200
+    y = rng.integers(0, 3, n).astype(np.float32)
+    bins = rng.integers(0, 6, size=(n, 4)).astype(np.int32)
+    bins[:, 0] = (y * 2).astype(np.int32)  # informative feature
+    w = np.ones(n, np.float32)
+    s = DTSettings(n_trees=5, depth=3, impurity="entropy", n_classes=3,
+                   bagging_rate=1.0, seed=0)
+    res = train_rf(bins, y, w, 8, None, s)
+    assert res.trees_built == 5
+    assert res.trees[0].leaf_value.ndim == 2       # [nodes, K]
+    assert res.spec_kwargs["extra"]["n_classes"] == 3
+    # misclassification errors, not losses: must be low on separable data
+    assert res.train_error < 0.05
+    assert res.valid_error < 0.10
+
+
+def test_nn_native_multiclass_softmax():
+    from shifu_tpu.models import nn as nn_model
+    from shifu_tpu.train.nn_trainer import TrainSettings, train_ensemble
+    from shifu_tpu.train.sampling import member_masks
+
+    x, y = _three_class()
+    spec = nn_model.NNModelSpec(input_dim=x.shape[1], hidden_nodes=[16],
+                                activations=["tanh"], output_dim=3,
+                                output_activation="softmax")
+    tw, vw = member_masks(len(y), 1, valid_rate=0.2, sample_rate=1.0,
+                          replacement=False, targets=y, seed=0)
+    res = train_ensemble(x, y, tw, vw, spec,
+                         TrainSettings(optimizer="ADAM", learning_rate=0.02,
+                                       epochs=60, seed=0))
+    probs = np.asarray(nn_model.forward(res.params[0], spec, jnp.asarray(x)))
+    assert probs.shape == (len(y), 3)
+    np.testing.assert_allclose(probs.sum(1), 1.0, atol=1e-4)
+    assert (probs.argmax(1) == y).mean() > 0.9
+
+
+def test_nn_ova_members_learn_their_class():
+    from shifu_tpu.models import nn as nn_model
+    from shifu_tpu.train.nn_trainer import TrainSettings, train_ensemble
+    from shifu_tpu.train.sampling import member_masks
+
+    x, y = _three_class()
+    spec = nn_model.NNModelSpec(input_dim=x.shape[1], hidden_nodes=[8],
+                                activations=["tanh"], loss="log")
+    tw, vw = member_masks(len(y), 1, valid_rate=0.2, sample_rate=1.0,
+                          replacement=False, targets=y, seed=0)
+    tw, vw = np.repeat(tw, 3, axis=0), np.repeat(vw, 3, axis=0)
+    y_members = np.stack([(y == k).astype(np.float32) for k in range(3)])
+    res = train_ensemble(x, y, tw, vw, spec,
+                         TrainSettings(optimizer="ADAM", learning_rate=0.02,
+                                       epochs=60, seed=0),
+                         y_members=y_members)
+    # assembled OVA argmax must recover the class
+    scores = np.stack([np.asarray(nn_model.forward(
+        res.params[k], spec, jnp.asarray(x)))[:, 0] for k in range(3)], 1)
+    assert (scores.argmax(1) == y).mean() > 0.9
+
+
+@pytest.fixture
+def mc_model_set(tmp_path):
+    """A 3-class model set (csv + scaffold) ready for init."""
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.pipeline.create import create_new_model
+
+    rng = np.random.default_rng(5)
+    n = 1500
+    y = rng.integers(0, 3, n)
+    f1 = rng.normal(size=n) + (y == 0) * 2.2
+    f2 = rng.normal(size=n) + (y == 1) * 2.2
+    f3 = rng.normal(size=n) + (y == 2) * 2.2
+    kind = np.asarray(["low", "mid", "high"])[y]
+    # 10% label noise on the categorical hint
+    flip = rng.random(n) < 0.1
+    kind[flip] = rng.choice(["low", "mid", "high"], flip.sum())
+    tag = np.asarray(["alpha", "beta", "gamma"])[y]
+    rows = ["id|f1|f2|f3|kind|tag"]
+    for i in range(n):
+        rows.append(f"r{i}|{f1[i]:.5f}|{f2[i]:.5f}|{f3[i]:.5f}|"
+                    f"{kind[i]}|{tag[i]}")
+    csv_path = tmp_path / "mc.csv"
+    csv_path.write_text("\n".join(rows) + "\n")
+    meta = tmp_path / "meta.names"
+    meta.write_text("id\n")
+
+    mdir = create_new_model("mctest", base_dir=str(tmp_path))
+    mcp = os.path.join(mdir, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.dataSet.dataPath = str(csv_path)
+    mc.dataSet.dataDelimiter = "|"
+    mc.dataSet.targetColumnName = "tag"
+    mc.dataSet.posTags = ["alpha", "beta", "gamma"]
+    mc.dataSet.negTags = []
+    mc.dataSet.metaColumnNameFile = str(meta)
+    mc.train.baggingNum = 1
+    mc.train.numTrainEpochs = 40
+    mc.evals[0].dataSet.dataPath = str(csv_path)
+    mc.evals[0].dataSet.dataDelimiter = "|"
+    mc.save(mcp)
+    return mdir
+
+
+def _run_steps(mdir):
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+    from shifu_tpu.pipeline.evaluate import EvalProcessor
+
+    assert InitProcessor(mdir).run() == 0
+    assert StatsProcessor(mdir, params={}).run() == 0
+    assert NormalizeProcessor(mdir, params={}).run() == 0
+    assert TrainProcessor(mdir, params={}).run() == 0
+    assert EvalProcessor(mdir, params={"run_eval": "Eval1"}).run() == 0
+    perf = os.path.join(mdir, "evals", "Eval1", "EvalPerformance.json")
+    # path via PathFinder may differ; search for it
+    hits = []
+    for root, _, files in os.walk(mdir):
+        if "EvalPerformance.json" in files:
+            hits.append(os.path.join(root, "EvalPerformance.json"))
+    assert hits, "no EvalPerformance.json written"
+    with open(hits[0]) as f:
+        return json.load(f)
+
+
+def test_e2e_nn_native_multiclass(mc_model_set):
+    from shifu_tpu.config import ModelConfig
+    mcp = os.path.join(mc_model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.train.algorithm = "NN"
+    mc.train.params = {"NumHiddenNodes": [12], "Propagation": "ADAM",
+                       "LearningRate": 0.02}
+    mc.save(mcp)
+    rep = _run_steps(mc_model_set)
+    assert rep["nClasses"] == 3
+    assert rep["accuracy"] > 0.85
+    assert rep["macroAuc"] > 0.9
+    assert len(rep["confusionMatrix"]) == 3
+
+
+def test_e2e_rf_native_multiclass(mc_model_set):
+    from shifu_tpu.config import ModelConfig
+    mcp = os.path.join(mc_model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.train.algorithm = "RF"
+    mc.train.params = {"TreeNum": 8, "MaxDepth": 4, "Impurity": "entropy"}
+    mc.save(mcp)
+    rep = _run_steps(mc_model_set)
+    assert rep["accuracy"] > 0.8
+    assert rep["macroAuc"] > 0.85
+
+
+def test_e2e_gbt_ova_multiclass(mc_model_set):
+    """GBT has no NATIVE multiclass: must auto-route one-vs-all and save
+    one model per class."""
+    from shifu_tpu.config import ModelConfig
+    mcp = os.path.join(mc_model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.train.algorithm = "GBT"
+    mc.train.params = {"TreeNum": 8, "MaxDepth": 3, "Loss": "log",
+                       "LearningRate": 0.2}
+    mc.save(mcp)
+    rep = _run_steps(mc_model_set)
+    models = [f for f in os.listdir(os.path.join(mc_model_set, "models"))
+              if f.startswith("model")]
+    assert len(models) == 3                       # one forest per class
+    assert rep["accuracy"] > 0.8
+
+
+def test_e2e_nn_ova_multiclass(mc_model_set):
+    from shifu_tpu.config import ModelConfig
+    mcp = os.path.join(mc_model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.train.algorithm = "NN"
+    mc.train.multiClassifyMethod = "ONEVSALL"
+    mc.train.params = {"NumHiddenNodes": [12], "Propagation": "ADAM",
+                       "LearningRate": 0.02, "Loss": "log"}
+    mc.save(mcp)
+    rep = _run_steps(mc_model_set)
+    models = [f for f in os.listdir(os.path.join(mc_model_set, "models"))
+              if f.startswith("model")]
+    assert len(models) == 3
+    assert rep["accuracy"] > 0.85
+
+
+def test_e2e_nn_native_multiclass_streamed(mc_model_set):
+    """Streamed NATIVE multiclass must use softmax CE, not the binary
+    elementwise loss (regression guard for the streamed per_row_loss path)."""
+    from shifu_tpu.config import ModelConfig, environment
+    mcp = os.path.join(mc_model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.train.algorithm = "NN"
+    mc.train.params = {"NumHiddenNodes": [12], "Propagation": "ADAM",
+                       "LearningRate": 0.02}
+    mc.save(mcp)
+    environment.set_property("shifu.train.streaming", "on")
+    try:
+        rep = _run_steps(mc_model_set)
+    finally:
+        environment.set_property("shifu.train.streaming", "")
+    assert rep["accuracy"] > 0.85
+    assert rep["macroAuc"] > 0.9
