@@ -99,6 +99,19 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    try:
+        return _dispatch(argv)
+    except Exception as e:
+        from .config.validator import ValidationError
+        if isinstance(e, ValidationError):
+            # config errors are user errors: message, not traceback
+            # (reference ShifuCLI prints ShifuException messages plainly)
+            print(str(e), file=sys.stderr)
+            return 1
+        raise
+
+
+def _dispatch(argv: Optional[List[str]] = None) -> int:
     argv = _split_props(list(argv if argv is not None else sys.argv[1:]))
     args = build_parser().parse_args(argv)
     logging.basicConfig(

@@ -38,6 +38,15 @@ def probe(mc: ModelConfig, step: ModelStep, model_set_dir: str = ".") -> None:
     if not mc.basic.name:
         problems.append("basic.name must not be empty")
 
+    # meta-driven field schema (reference MetaFactory/ModelConfigMeta.json):
+    # declarative type/range/enum checks over the whole config tree
+    from .meta import validate_config_fields, validate_train_conf
+    problems.extend(validate_config_fields(mc))
+    if step == ModelStep.TRAIN:
+        # every train#params key checked; unknown keys (typos) are hard
+        # errors; grid-search candidate lists expand per trial
+        problems.extend(validate_train_conf(mc.train))
+
     if step in (ModelStep.INIT, ModelStep.STATS, ModelStep.NORMALIZE,
                 ModelStep.VARSELECT, ModelStep.TRAIN, ModelStep.POSTTRAIN):
         ds = mc.dataSet
@@ -51,40 +60,12 @@ def probe(mc: ModelConfig, step: ModelStep, model_set_dir: str = ".") -> None:
         if overlap:
             problems.append(f"posTags and negTags overlap: {sorted(overlap)}")
 
-    if step == ModelStep.STATS:
-        if mc.stats.maxNumBin < 2:
-            problems.append("stats.maxNumBin must be >= 2")
-        if not (0.0 < mc.stats.sampleRate <= 1.0):
-            problems.append("stats.sampleRate must be in (0, 1]")
-
-    if step == ModelStep.NORMALIZE:
-        if mc.normalize.stdDevCutOff <= 0:
-            problems.append("normalize.stdDevCutOff must be > 0")
-
     if step == ModelStep.TRAIN:
+        # cross-field rules the per-key schema can't express (NN shape
+        # consistency lives in meta.validate_train_params, per trial)
         tr = mc.train
-        if tr.baggingNum < 1:
-            problems.append("train.baggingNum must be >= 1")
-        if tr.numTrainEpochs < 1:
-            problems.append("train.numTrainEpochs must be >= 1")
-        if not (0.0 <= tr.validSetRate < 1.0):
-            problems.append("train.validSetRate must be in [0, 1)")
         if tr.isCrossValidation and tr.numKFold < 2:
             problems.append("train.numKFold must be >= 2 when isCrossValidation")
-        if not (0.0 < tr.baggingSampleRate <= 1.0):
-            problems.append("train.baggingSampleRate must be in (0, 1]")
-        if tr.algorithm in (Algorithm.GBT, Algorithm.RF, Algorithm.DT):
-            depth = tr.params.get("MaxDepth", 10)
-            if not (1 <= int(depth) <= 20):
-                problems.append("train.params.MaxDepth must be in [1, 20]")
-        if tr.algorithm == Algorithm.NN:
-            layers = tr.params.get("NumHiddenLayers")
-            nodes = tr.params.get("NumHiddenNodes")
-            acts = tr.params.get("ActivationFunc")
-            if layers is not None and nodes is not None and int(layers) != len(nodes):
-                problems.append("NumHiddenLayers must equal len(NumHiddenNodes)")
-            if layers is not None and acts is not None and int(layers) != len(acts):
-                problems.append("NumHiddenLayers must equal len(ActivationFunc)")
 
     if step == ModelStep.EVAL:
         if not mc.evals:

@@ -59,6 +59,15 @@ def _init_members(d: str, members: List[str]) -> int:
     """Each member = a sub model-set dir sharing the parent's configs/stats
     but with its own train.algorithm (reference sub-model dirs)."""
     from ..config import ModelConfig
+    from ..config.meta import unknown_param_problems
+    from ..config.validator import ValidationError
+    parent = ModelConfig.load(os.path.join(d, "ModelConfig.json"))
+    # typos must fail HERE — the per-member applicability filter below would
+    # otherwise silently drop them (the parent dict legitimately mixes keys
+    # of several algorithm families, so only unknown keys are errors)
+    bad = unknown_param_problems(parent.train.params)
+    if bad:
+        raise ValidationError(bad)
     for i, alg in enumerate(members):
         md = _member_dir(d, alg, i)
         os.makedirs(md, exist_ok=True)
@@ -66,11 +75,13 @@ def _init_members(d: str, members: List[str]) -> int:
         from ..config.model_config import Algorithm
         mc.train.algorithm = Algorithm[alg]
         mc.basic.name = f"{mc.basic.name}_{alg}{i}"
-        # member-specific defaults: trees for DT family, nets for NN
-        if alg in ("GBT", "RF", "DT"):
-            mc.train.params = {k: v for k, v in (mc.train.params or {}).items()
-                               if k in ("TreeNum", "MaxDepth", "LearningRate",
-                                        "Loss", "Impurity")}
+        # keep only the params applicable to this member's algorithm —
+        # driven by the meta schema so combo and probe() can't disagree
+        from ..config.meta import TRAIN_PARAM_RULES
+        mc.train.params = {
+            k: v for k, v in (mc.train.params or {}).items()
+            if (r := TRAIN_PARAM_RULES.get(k)) is not None
+            and (r.algs is None or alg in r.algs)}
         mc.save(os.path.join(md, "ModelConfig.json"))
         shutil.copy(os.path.join(d, "ColumnConfig.json"),
                     os.path.join(md, "ColumnConfig.json"))

@@ -1,0 +1,157 @@
+"""Meta-driven config validation — reference ``MetaFactory.java`` +
+``store/ModelConfigMeta.json``: declarative type/range/enum/applicability
+schema over ModelConfig and train#params; unknown keys are hard errors."""
+
+import pytest
+
+from shifu_tpu.config.meta import (validate_config_fields,
+                                   validate_train_conf,
+                                   validate_train_params)
+from shifu_tpu.config.model_config import Algorithm, ModelConfig, ModelTrainConf
+
+
+def _problems(params, alg=Algorithm.NN):
+    return validate_train_params(params, alg)
+
+
+def test_unknown_key_is_hard_error_with_suggestion():
+    out = _problems({"LearningRat": 0.1})
+    assert len(out) == 1
+    assert "unknown" in out[0] and "LearningRate" in out[0]
+
+
+def test_unknown_key_without_close_match():
+    out = _problems({"Zorp": 1})
+    assert "unknown train#params key 'Zorp'" in out[0]
+
+
+def test_known_keys_pass():
+    assert _problems({"LearningRate": 0.1, "Propagation": "ADAM",
+                      "NumHiddenNodes": [30, 10],
+                      "ActivationFunc": ["tanh", "relu"],
+                      "DropoutRate": 0.2, "MiniBatchs": 128,
+                      "Loss": "log", "Seed": 7}) == []
+
+
+def test_range_violations():
+    assert "must be >" in _problems({"LearningRate": 0.0})[0]
+    assert "must be <" in _problems({"DropoutRate": 1.0})[0]
+    assert _problems({"MaxDepth": 25}, Algorithm.GBT)[0].startswith(
+        "train#params.MaxDepth must be <= 20")
+    assert _problems({"TreeNum": 0}, Algorithm.RF)
+
+
+def test_type_violations():
+    assert "must be a int" in _problems({"MiniBatchs": 12.5})[0]
+    assert "must be a list" in _problems({"NumHiddenNodes": 30})[0]
+    assert "elements must be ints" in _problems({"NumHiddenNodes": ["x"]})[0]
+
+
+def test_enum_violations():
+    assert "one of" in _problems({"Propagation": "WARP"})[0]
+    assert "one of" in _problems({"Loss": "hinge"})[0]
+    assert "not one of" in _problems({"ActivationFunc": ["tanh", "zap"]})[0]
+    assert "one of" in _problems({"Impurity": "mse"}, Algorithm.RF)[0]
+
+
+def test_enum_checks_are_case_insensitive():
+    assert _problems({"Propagation": "adam"}) == []
+    assert _problems({"Impurity": "ENTROPY"}, Algorithm.RF) == []
+
+
+def test_per_algorithm_applicability():
+    out = _problems({"TreeNum": 100})            # NN with a tree key
+    assert "does not apply to algorithm NN" in out[0]
+    out = _problems({"DropoutRate": 0.1}, Algorithm.GBT)
+    assert "does not apply to algorithm GBT" in out[0]
+    assert _problems({"WideEnable": True}, Algorithm.WDL) == []
+    assert "does not apply" in _problems({"WideEnable": True},
+                                         Algorithm.NN)[0]
+
+
+def test_grid_trials_validated_individually():
+    tc = ModelTrainConf(algorithm=Algorithm.NN,
+                        params={"LearningRate": [0.1, 0.2, -1.0],
+                                "Propagation": ["ADAM", "WARP"]})
+    out = validate_train_conf(tc)
+    joined = "\n".join(out)
+    assert "LearningRate" in joined        # the -1.0 candidate
+    assert "WARP" in joined                # the bad optimizer candidate
+
+
+def test_grid_list_keys_not_mistaken_for_axes():
+    tc = ModelTrainConf(algorithm=Algorithm.NN,
+                        params={"NumHiddenNodes": [30, 10]})
+    assert validate_train_conf(tc) == []
+
+
+def test_numeric_strings_accepted():
+    assert _problems({"LearningRate": "0.1"}) == []
+    assert _problems({"MiniBatchs": "128"}) == []
+
+
+def test_config_field_rules():
+    mc = ModelConfig()
+    mc.train.baggingNum = 0
+    mc.train.validSetRate = 1.0
+    mc.stats.maxNumBin = 1
+    out = validate_config_fields(mc)
+    joined = "\n".join(out)
+    assert "train.baggingNum" in joined
+    assert "train.validSetRate" in joined
+    assert "stats.maxNumBin" in joined
+
+
+def test_probe_rejects_typo_end_to_end(tmp_path):
+    from shifu_tpu.config.validator import ModelStep, ValidationError, probe
+    from shifu_tpu.pipeline.create import create_new_model
+    import os
+    mdir = create_new_model("metatest", base_dir=str(tmp_path))
+    mc = ModelConfig.load(os.path.join(mdir, "ModelConfig.json"))
+    mc.dataSet.dataPath = "/tmp/d.csv"
+    mc.dataSet.targetColumnName = "tag"
+    mc.dataSet.posTags, mc.dataSet.negTags = ["1"], ["0"]
+    mc.train.params = {"LearningRat": 0.1}
+    with pytest.raises(ValidationError, match="LearningRate"):
+        probe(mc, ModelStep.TRAIN)
+
+
+def test_nan_inf_strings_are_problems_not_crashes():
+    assert _problems({"MiniBatchs": "nan"})
+    assert _problems({"MiniBatchs": "inf"})
+    assert _problems({"LearningRate": "nan"})
+
+
+def test_grid_validates_without_cartesian_blowup():
+    # 4 axes x 50 candidates = 6.25M cartesian trials; per-axis validation
+    # must finish instantly and still catch the one bad candidate
+    import time
+    tc = ModelTrainConf(algorithm=Algorithm.NN,
+                        params={"LearningRate": [0.1] * 49 + [-1.0],
+                                "DropoutRate": [0.1] * 50,
+                                "MiniBatchs": list(range(1, 51)),
+                                "Seed": list(range(50))})
+    t0 = time.perf_counter()
+    out = validate_train_conf(tc)
+    assert time.perf_counter() - t0 < 1.0
+    assert any("LearningRate" in p for p in out)
+
+
+def test_grid_shape_mismatch_caught_per_combo():
+    tc = ModelTrainConf(algorithm=Algorithm.NN,
+                        params={"NumHiddenLayers": [1, 3],
+                                "NumHiddenNodes": [[10], [10, 5]]})
+    assert any("NumHiddenLayers" in p for p in validate_train_conf(tc))
+
+
+def test_combo_rejects_typo_params(model_set):
+    from shifu_tpu.config.validator import ValidationError
+    from shifu_tpu.pipeline.combo import run_combo
+    import os
+    mcp = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.train.params = {"LearningRat": 0.1}
+    mc.save(mcp)
+    assert run_combo(model_set, "new", "LR:GBT") == 0
+    with pytest.raises(ValidationError, match="LearningRate"):
+        run_combo(model_set, "init", None)
