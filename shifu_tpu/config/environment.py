@@ -55,6 +55,10 @@ def set_property(key: str, value: Any) -> None:
 def get_property(key: str, default: Optional[str] = None) -> Optional[str]:
     _ensure_loaded()
     v = _props.get(key)
+    if v is None:
+        # env vars lowercase on import (SHIFU_TRAIN_WINDOWROWS ->
+        # shifu.train.windowrows) — camelCase property names still match
+        v = _props.get(key.lower())
     # empty string = unset (clearing a property restores the default)
     return default if v is None or v == "" else v
 

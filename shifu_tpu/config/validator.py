@@ -11,7 +11,7 @@ import enum
 import os
 from typing import List
 
-from .model_config import Algorithm, ModelConfig
+from .model_config import ModelConfig
 
 
 class ModelStep(enum.Enum):

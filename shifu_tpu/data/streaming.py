@@ -223,13 +223,17 @@ class ShardStream:
                              src=consume(buffered))
         finally:
             # unblock + retire the reader even when the generator is
-            # abandoned mid-iteration (jit error, early stop, interrupt)
+            # abandoned mid-iteration (jit error, early stop, interrupt);
+            # JOIN it so no daemon thread survives into interpreter
+            # shutdown (a live thread racing stdio finalization is a
+            # "Fatal Python error: _enter_buffered_busy" waiting to happen)
             stop.set()
             try:
                 while True:
                     q.get_nowait()
             except queue.Empty:
                 pass
+            t.join(timeout=5.0)
 
     @property
     def num_rows(self) -> int:

@@ -119,17 +119,38 @@ def forward(params: Dict, spec: WDLModelSpec, x_num, x_cat):
     return jax.nn.sigmoid(forward_logits(params, spec, x_num, x_cat))
 
 
+def per_row_bce(p, y):
+    """Clipped binary cross-entropy per row: p, y are [N, 1] -> [N].
+    The ONE definition of the WDL loss — trainers (in-RAM, streamed, eval
+    sums) all call this so the objective cannot drift between paths."""
+    return -(y * jnp.log(jnp.clip(p, 1e-7, 1.0))
+             + (1 - y) * jnp.log(jnp.clip(1 - p, 1e-7, 1.0))).sum(axis=-1)
+
+
 def weighted_loss(params, spec: WDLModelSpec, x_num, x_cat, y, w,
                   l2: float = 0.0):
     p = forward(params, spec, x_num, x_cat)
-    per = -(y * jnp.log(jnp.clip(p, 1e-7, 1.0))
-            + (1 - y) * jnp.log(jnp.clip(1 - p, 1e-7, 1.0))).sum(axis=-1)
+    per = per_row_bce(p, y)
     loss = (per * w).sum() / jnp.maximum(w.sum(), 1e-9)
     if l2:
         reg = sum((layer["w"] ** 2).sum() for layer in params.get("deep", []))
         reg = reg + sum((t ** 2).sum() for t in params.get("embed", []))
         loss = loss + l2 * reg
     return loss
+
+
+def l2_grads(params: Dict, l2: float) -> Dict:
+    """Gradient of weighted_loss's L2 term — deep weights and embedding
+    tables ONLY (bias/wide stay unpenalized), so the streamed trainer's
+    accumulated-gradient update regularizes exactly what the in-RAM loss
+    does."""
+    import jax
+    g = jax.tree_util.tree_map(jnp.zeros_like, params)
+    for i, layer in enumerate(params.get("deep", [])):
+        g["deep"][i]["w"] = 2.0 * l2 * layer["w"]
+    for i, t in enumerate(params.get("embed", [])):
+        g["embed"][i] = 2.0 * l2 * t
+    return g
 
 
 # ------------------------------------------------------------- save/load

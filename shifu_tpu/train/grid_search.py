@@ -20,7 +20,8 @@ def is_grid_search(params: Dict[str, Any]) -> bool:
 def _is_axis(key: str, v: list) -> bool:
     """A list value is a grid axis unless the key naturally takes a list
     (hidden node counts / activations), where only list-of-list is an axis."""
-    if key in ("NumHiddenNodes", "ActivationFunc", "FixedLayers"):
+    if key in ("NumHiddenNodes", "ActivationFunc", "FixedLayers",
+               "NumEmbedColumnIds"):
         return bool(v) and isinstance(v[0], list)
     return True
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -200,20 +201,32 @@ def train_ensemble(x: np.ndarray, y: np.ndarray,
             log.info("resumed trainer state at epoch %d", start_epoch)
 
     n_padded = xd.shape[0]
+
+    # batch slicing happens INSIDE jit (dynamic_slice of sharded arrays
+    # compiles into the SPMD program); an EAGER lax.slice on sharded inputs
+    # does ad-hoc device-to-device copies the XLA:CPU runtime has been seen
+    # to SIGABRT on
+    @partial(jax.jit, static_argnames=("blen",))
+    def step_batch(stacked, opt_state, start, rngs, lr_scale, blen: int):
+        xb = jax.lax.dynamic_slice_in_dim(xd, start, blen, axis=0)
+        yb = jax.lax.dynamic_slice_in_dim(yd, start, blen, axis=0) \
+            if ymd is None else \
+            jax.lax.dynamic_slice_in_dim(ymd, start, blen, axis=1)
+        twb = jax.lax.dynamic_slice_in_dim(twd, start, blen, axis=1)
+        return jax.vmap(member_update,
+                        in_axes=(0, 0, None, y_axis, 0, 0, None))(
+            stacked, opt_state, xb, yb, twb, rngs, lr_scale)
+
     for epoch in range(start_epoch, settings.epochs):
         key, sub = jax.random.split(key)
         rngs = jax.random.split(sub, bags)
         if bs and bs < n_padded:
             for bi, start in enumerate(range(0, n_padded - bs + 1, bs)):
-                xb = jax.lax.slice_in_dim(xd, start, start + bs, axis=0)
-                yb = jax.lax.slice_in_dim(yd, start, start + bs, axis=0) \
-                    if ymd is None else \
-                    jax.lax.slice_in_dim(ymd, start, start + bs, axis=1)
-                twb = jax.lax.slice_in_dim(twd, start, start + bs, axis=1)
                 rngs_b = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
                     rngs, bi) if dropout > 0 else rngs
-                stacked, opt_state, _ = step(stacked, opt_state, xb, yb, twb,
-                                             rngs_b, lr_scale)
+                stacked, opt_state, _ = step_batch(
+                    stacked, opt_state, jnp.int32(start), rngs_b, lr_scale,
+                    bs)
         else:
             stacked, opt_state, _ = step(stacked, opt_state, xd,
                                          yd if ymd is None else ymd, twd,
@@ -376,8 +389,16 @@ def train_ensemble_streamed(stream, spec: nn_model.NNModelSpec,
             return params, ostate
         return jax.vmap(one)(stacked, opt_state, grad_acc, train_wsum)
 
-    @jax.jit
-    def minibatch_window(stacked, opt_state, xb, yb, tw, rngs, lr_scale):
+    @partial(jax.jit, static_argnames=("blen",))
+    def minibatch_window(stacked, opt_state, xw, yw, tww, rngs, lr_scale,
+                         start, blen: int):
+        # slice INSIDE jit: dynamic_slice of the sharded window compiles
+        # into the SPMD program (an eager lax.slice would trigger ad-hoc
+        # device copies the XLA:CPU runtime can SIGABRT on)
+        xb = jax.lax.dynamic_slice_in_dim(xw, start, blen, axis=0)
+        yb = jax.lax.dynamic_slice_in_dim(yw, start, blen, axis=0)
+        tw = jax.lax.dynamic_slice_in_dim(tww, start, blen, axis=1)
+
         def one(params, ostate, mw, rng):
             def norm_loss(p):
                 return _loss_sum(p, xb, yb, mw, rng) / jnp.maximum(mw.sum(), 1e-9) \
@@ -477,13 +498,11 @@ def train_ensemble_streamed(stream, spec: nn_model.NNModelSpec,
             else:
                 stats_acc = eval_window(stacked, stats_acc, xb, yb, tw, vw)
                 for si, (s, e) in enumerate(slices):
-                    xs = jax.lax.slice_in_dim(xb, s, e, axis=0)
-                    ys = jax.lax.slice_in_dim(yb, s, e, axis=0)
-                    ts = jax.lax.slice_in_dim(tw, s, e, axis=1)
                     rngs_s = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
                         rngs_w, si) if dropout > 0 else rngs_w
                     stacked, opt_state = minibatch_window(
-                        stacked, opt_state, xs, ys, ts, rngs_s, lr_scale)
+                        stacked, opt_state, xb, yb, tw, rngs_s, lr_scale,
+                        jnp.int32(s), e - s)
             n_win += 1
         if n_win == 0:
             raise RuntimeError("streamed training: empty shard stream")

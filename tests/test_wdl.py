@@ -8,7 +8,13 @@ import pytest
 import jax
 
 from shifu_tpu.models import wdl as wdl_model
-from shifu_tpu.train.wdl_trainer import train_wdl
+from shifu_tpu.train.nn_trainer import TrainSettings
+from shifu_tpu.train.wdl_trainer import train_wdl_ensemble
+
+
+def _settings(lr=0.05, l2=0.0, epochs=8, batch=256):
+    return TrainSettings(optimizer="ADAM", learning_rate=lr, l2=l2,
+                         epochs=epochs, batch_size=batch, seed=0)
 
 
 def make_data(n=2000, seed=0):
@@ -42,21 +48,19 @@ def test_wdl_wide_only_and_deep_only():
                                       embed_dim=4, hidden_nodes=[8],
                                       activations=["relu"],
                                       wide_enable=wide, deep_enable=deep)
-        res = train_wdl(x_num, x_cat, y, np.ones(len(y)), spec,
-                        {"lr": 0.05, "l2": 0.0, "epochs": 8, "batch": 256,
-                         "optimizer": "ADAM", "window": 0})
-        assert res["valid_error"] < 0.68, (wide, deep, res["valid_error"])
+        res = train_wdl_ensemble(x_num, x_cat, y, np.ones(len(y)), spec,
+                                 _settings(epochs=8))
+        assert res.valid_errors[0] < 0.68, (wide, deep, res.valid_errors)
 
 
 def test_wdl_training_learns():
     x_num, x_cat, y = make_data()
-    res = train_wdl(x_num, x_cat, y, np.ones(len(y)), SPEC,
-                    {"lr": 0.05, "l2": 1e-5, "epochs": 25, "batch": 256,
-                     "optimizer": "ADAM", "window": 0})
+    res = train_wdl_ensemble(x_num, x_cat, y, np.ones(len(y)), SPEC,
+                             _settings(l2=1e-5, epochs=25))
     # best validation error (what gets saved) beats the first epoch and
     # approaches the Bayes limit of this noisy data (~0.55; chance = 0.69)
-    assert res["valid_error"] < res["history"][0][1]
-    assert res["valid_error"] < 0.60
+    assert res.valid_errors[0] < res.history[0][1]
+    assert res.valid_errors[0] < 0.60
 
 
 def test_wdl_save_load_roundtrip(tmp_path):
@@ -95,3 +99,58 @@ def test_wdl_pipeline_end_to_end(model_set):
     perf = json.load(open(os.path.join(model_set, "evals", "Eval1",
                                        "EvalPerformance.json")))
     assert perf["areaUnderRoc"] > 0.7
+
+
+def test_wdl_mesh_ensemble_equivalence():
+    """1-device vs 8-device mesh must train the same 2-member ensemble
+    (gradient psum over the data axis is exact)."""
+    from shifu_tpu.parallel.mesh import device_mesh
+    x_num, x_cat, y = make_data(1024)
+    devs = jax.devices("cpu")
+    r1 = train_wdl_ensemble(x_num, x_cat, y, np.ones(len(y)), SPEC,
+                            _settings(epochs=4, batch=0), bags=2,
+                            mesh=device_mesh(2, devices=devs[:1]))
+    r8 = train_wdl_ensemble(x_num, x_cat, y, np.ones(len(y)), SPEC,
+                            _settings(epochs=4, batch=0), bags=2,
+                            mesh=device_mesh(2, devices=devs[:8]))
+    np.testing.assert_allclose(r1.valid_errors, r8.valid_errors,
+                               rtol=1e-4, atol=1e-5)
+    for p1, p8 in zip(r1.params, r8.params):
+        a1 = jax.tree_util.tree_leaves(p1)
+        a8 = jax.tree_util.tree_leaves(p8)
+        for l1, l8 in zip(a1, a8):
+            np.testing.assert_allclose(l1, l8, rtol=1e-3, atol=1e-4)
+
+
+def test_wdl_pipeline_streamed(model_set):
+    """WDL trains streamed (forced) through the pipeline and still scores."""
+    from shifu_tpu.config import ModelConfig, environment
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+    from shifu_tpu.pipeline.evaluate import EvalProcessor
+
+    mcp = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.train.algorithm = "WDL"
+    mc.train.baggingNum = 2
+    mc.train.numTrainEpochs = 15
+    mc.train.params = {"LearningRate": 0.05, "MiniBatchs": 256,
+                       "EmbedDim": 4, "NumHiddenNodes": [8],
+                       "ActivationFunc": ["relu"]}
+    mc.save(mcp)
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    environment.set_property("shifu.train.streaming", "on")
+    environment.set_property("shifu.train.windowRows", 512)
+    try:
+        assert TrainProcessor(model_set, params={}).run() == 0
+    finally:
+        environment.set_property("shifu.train.streaming", "")
+        environment.set_property("shifu.train.windowRows", "")
+    models = [f for f in os.listdir(os.path.join(model_set, "models"))
+              if f.endswith(".wdl")]
+    assert len(models) == 2                    # both bagging members saved
+    assert EvalProcessor(model_set, params={"run_eval": "Eval1"}).run() == 0
