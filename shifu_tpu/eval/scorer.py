@@ -54,6 +54,7 @@ class Scorer:
             raise ValueError("no models to score with")
         self.models = list(models)
         self.scale = scale
+        self._groups = None          # lazy same-shape NN stacks
 
     @classmethod
     def from_dir(cls, models_dir: str, scale: float = SCORE_SCALE) -> "Scorer":
@@ -70,22 +71,66 @@ class Scorer:
             raise FileNotFoundError(f"no model files in {models_dir}")
         return cls(models, scale)
 
+    def _stacked_nn_groups(self):
+        """Same-shape NN/LR models stacked for ONE vmapped forward — the
+        bagged ensemble was trained stacked (``train_ensemble``); scoring it
+        unstacked is pure overhead (reference scores each model on its own
+        thread, ``Scorer.java:163-200``)."""
+        if self._groups is not None:
+            return self._groups
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.nn import forward
+        by_shape = {}
+        for i, m in enumerate(self.models):
+            sp = getattr(m, "spec", None)
+            if type(m).__name__ != "IndependentNNModel" or sp is None:
+                continue
+            key = (sp.input_dim, tuple(sp.hidden_nodes),
+                   tuple(sp.activations), sp.output_dim,
+                   sp.output_activation)
+            by_shape.setdefault(key, []).append(i)
+        self._groups = []
+        for idxs in by_shape.values():
+            if len(idxs) < 2:
+                continue
+            spec = self.models[idxs[0]].spec
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs),
+                *[self.models[i].params for i in idxs])
+            fwd = jax.jit(lambda ps, xv, spec=spec: jax.vmap(
+                lambda p: forward(p, spec, xv))(ps))
+            self._groups.append((idxs, stacked, fwd))
+        return self._groups
+
     def score(self, x: np.ndarray,
               bins: Optional[np.ndarray] = None) -> CaseScoreResult:
         """Tree models consume the binned matrix (``input_kind == 'bins'``),
-        NN/LR the normalized floats — both come from one transform pass."""
-        cols = []
-        for m in self.models:
+        NN/LR the normalized floats — both come from one transform pass.
+        Same-shape NN models score as one stacked jit call."""
+        import jax.numpy as jnp
+        cols: List[Optional[np.ndarray]] = [None] * len(self.models)
+        groups = self._stacked_nn_groups()
+        if groups:
+            xj = jnp.asarray(x, jnp.float32)
+            for idxs, stacked, fwd in groups:
+                outs = np.asarray(fwd(stacked, xj))    # [M, n, out]
+                for pos, i in enumerate(idxs):
+                    cols[i] = outs[pos][:, 0]
+        for i, m in enumerate(self.models):
+            if cols[i] is not None:
+                continue
             kind = getattr(m, "input_kind", "norm")
             if kind in ("bins", "both") and bins is None:
                 raise ValueError(f"{type(m).__name__} requires binned input "
                                  "— pass bins= to Scorer.score")
             if kind == "bins":
-                cols.append(np.asarray(m.compute(bins))[:, 0])
+                cols[i] = np.asarray(m.compute(bins))[:, 0]
             elif kind == "both":
-                cols.append(np.asarray(m.compute_full(x, bins))[:, 0])
+                cols[i] = np.asarray(m.compute_full(x, bins))[:, 0]
             else:
-                cols.append(np.asarray(m.compute(x))[:, 0])
+                cols[i] = np.asarray(m.compute(x))[:, 0]
         raw = np.stack(cols, axis=1) * self.scale
         return CaseScoreResult(scores=raw, mean=raw.mean(axis=1),
                                max=raw.max(axis=1), min=raw.min(axis=1),

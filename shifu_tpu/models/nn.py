@@ -120,12 +120,21 @@ def forward(params: List[Dict], spec: NNModelSpec, x, *,
     n_hidden = len(params) - 1
     for i, layer in enumerate(params[:-1]):
         h = acts[i % max(1, len(acts))](h @ layer["w"] + layer["b"])
-        if dropout_rate > 0.0 and rng is not None:
+        # rng gates dropout statically; the RATE may be a tracer (stacked
+        # grid trials carry a per-member dropout array)
+        if rng is not None and _nonzero(dropout_rate):
             rng, sub = jax.random.split(rng)
-            keep = jax.random.bernoulli(sub, 1.0 - dropout_rate, h.shape)
-            h = jnp.where(keep, h / (1.0 - dropout_rate), 0.0)
+            keep_p = 1.0 - dropout_rate
+            keep = jax.random.bernoulli(sub, keep_p, h.shape)
+            h = jnp.where(keep, h / keep_p, 0.0)
     out = h @ params[-1]["w"] + params[-1]["b"]
     return activation(spec.output_activation)(out)
+
+
+def _nonzero(v) -> bool:
+    """Static gate for optional terms: a concrete 0.0 skips the op entirely;
+    a tracer (per-member hyper array under vmap) always includes it."""
+    return not (isinstance(v, (int, float)) and float(v) == 0.0)
 
 
 LOSSES = {
@@ -159,9 +168,9 @@ def weighted_loss(params, spec: NNModelSpec, x, y, w, *,
     per_row = per_row_loss(pred, y, spec)
     denom = jnp.maximum(w.sum(), 1e-9)
     loss = (per_row * w).sum() / denom
-    if l2:
+    if _nonzero(l2):
         loss = loss + l2 * sum((layer["w"] ** 2).sum() for layer in params)
-    if l1:
+    if _nonzero(l1):
         loss = loss + l1 * sum(jnp.abs(layer["w"]).sum() for layer in params)
     return loss
 

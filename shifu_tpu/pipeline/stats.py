@@ -230,7 +230,16 @@ class StatsProcessor(BasicProcessor):
             else:
                 binners[cc.columnName] = (cc, ColumnBinner(
                     boundaries=np.asarray(cc.bin_boundary)))
-        unit_hists: Dict[str, Dict[str, np.ndarray]] = {}
+        # ONE flat count over (unit, column, bin) per chunk — columns pack
+        # into a global offset bin space so wall-clock is flat in column
+        # count (the round-2 per-(unit, column) bincount loop was O(U*C)
+        # passes; reference runs $column_parallel Pig mappers, PSI.pig)
+        col_list = list(binners.items())
+        nb_list = [binner.num_bins + 1 for _, (_, binner) in col_list]
+        offsets = np.concatenate([[0], np.cumsum(nb_list)]).astype(np.int64)
+        total_bins = int(offsets[-1])
+        unit_ids: Dict[str, int] = {}
+        acc = np.zeros((0, total_bins), np.float64)   # [units, packed bins]
         for chunk in source.iter_chunks():
             df = chunk.data
             if psi_col not in df.columns:
@@ -239,29 +248,45 @@ class StatsProcessor(BasicProcessor):
             ex = extractor.extract(chunk, keep_raw=True)
             if ex.n == 0:
                 continue
-            units = ex.raw.data[psi_col].to_numpy()
+            units = ex.raw.data[psi_col].to_numpy()  # raw values: numeric
+            # unit columns keep numeric sort order in unitStats
             num_index = {c.columnName: i for i, c in enumerate(ex.numeric_cols)}
-            for name, (cc, binner) in binners.items():
+            idx_mat = np.empty((ex.n, len(col_list)), np.int64)
+            for ci, (name, (cc, binner)) in enumerate(col_list):
                 if cc.is_categorical():
                     idx = binner.bin_categorical(ex.categorical[name])
                 else:
                     j = num_index[name]
-                    idx = binner.bin_numeric(ex.numeric[:, j], ex.numeric_valid[:, j])
-                nb = binner.num_bins + 1
-                for u in np.unique(units):
-                    h = np.bincount(idx[units == u], minlength=nb).astype(np.float64)
-                    unit_hists.setdefault(name, {})
-                    prev = unit_hists[name].get(u)
-                    unit_hists[name][u] = h if prev is None else prev + h
+                    idx = binner.bin_numeric(ex.numeric[:, j],
+                                             ex.numeric_valid[:, j])
+                idx_mat[:, ci] = np.asarray(idx, np.int64) + offsets[ci]
+            for u in np.unique(units):
+                unit_ids.setdefault(u, len(unit_ids))
+            if len(unit_ids) > acc.shape[0]:
+                acc = np.vstack([acc, np.zeros(
+                    (len(unit_ids) - acc.shape[0], total_bins), np.float64)])
+            uvec = np.fromiter((unit_ids[u] for u in units), np.int64,
+                               count=len(units))
+            flat = uvec[:, None] * total_bins + idx_mat
+            counts = np.bincount(flat.ravel(),
+                                 minlength=len(unit_ids) * total_bins)
+            acc += counts.reshape(len(unit_ids), total_bins)
+        if not unit_ids:
+            return
+        units_sorted = sorted(unit_ids.items(), key=lambda kv: kv[0])
+        by_name = {name: ci for ci, (name, _) in enumerate(col_list)}
         for cc in self.column_configs:
-            hists = unit_hists.get(cc.columnName)
-            if not hists:
+            ci = by_name.get(cc.columnName)
+            if ci is None:
                 continue
-            overall = np.sum(list(hists.values()), axis=0)
-            vals = [psi(overall, h) for h in hists.values()]
+            s, e = offsets[ci], offsets[ci + 1]
+            overall = acc[:, s:e].sum(axis=0)
+            vals = [psi(overall, acc[unit_ids[u], s:e])
+                    for u, _ in units_sorted]
             cc.columnStats.psi = _f(np.nanmax(vals)) if vals else None
-            cc.columnStats.unitStats = [f"{u}:{psi(overall, h):.6f}"
-                                        for u, h in sorted(hists.items())]
+            cc.columnStats.unitStats = [
+                f"{u}:{psi(overall, acc[uid, s:e]):.6f}"
+                for u, uid in units_sorted]
 
 
 def _f(x) -> Optional[float]:

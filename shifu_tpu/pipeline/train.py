@@ -150,9 +150,10 @@ class TrainProcessor(BasicProcessor):
 
         results = []
         with open(progress_path, "w") as pf:
-            # one run per grid trial; non-grid = one run with all bagging
-            # members vmapped together
-            runs = [[t] for t in range(len(trials))] if is_gs \
+            # grid trials group by structural shape: same-shape trials train
+            # as ONE vmapped run with per-member hyper arrays; non-grid =
+            # one run with all bagging members vmapped together
+            runs = grid_search.stackable_groups(trials) if is_gs \
                 else [list(range(bags))]
             for run in runs:
                 run_params = trials[run[0]] if is_gs else dict(params)
@@ -178,7 +179,7 @@ class TrainProcessor(BasicProcessor):
                     log.warning("upSampleWeight ignored for multi-class")
                     up_w = 1.0
                 train_w, valid_w = member_masks(
-                    n, len(run) if is_gs else bags,
+                    n, 1 if is_gs else bags,
                     valid_rate=mc.train.validSetRate,
                     kfold=run_kfold,
                     sample_rate=mc.train.baggingSampleRate,
@@ -186,6 +187,11 @@ class TrainProcessor(BasicProcessor):
                     stratified=mc.train.stratifiedSample,
                     up_sample_weight=up_w,
                     targets=y, seed=settings.seed)
+                if is_gs:
+                    # every trial in the group sees the SAME split — they
+                    # must differ only in hypers, never in data draw
+                    train_w = np.tile(train_w, (len(run), 1))
+                    valid_w = np.tile(valid_w, (len(run), 1))
                 y_members = None
                 if ova:
                     if is_gs:
@@ -205,12 +211,40 @@ class TrainProcessor(BasicProcessor):
                 valid_w = valid_w * w[None, :]
                 init_list = self._continuous_init(spec, n_members, alg)
 
+                member_hypers = None
+                if is_gs and len(run) > 1:
+                    # the group's trials differ only in stackable scalars —
+                    # feed them as per-member arrays, one compiled run;
+                    # identical init so the comparison isolates the hypers
+                    if init_list is None:
+                        import jax
+                        p0 = nn_model.init_params(
+                            jax.random.PRNGKey(settings.seed), spec,
+                            settings.weight_initializer)
+                        init_list = [p0] * len(run)
+                    else:
+                        # continuous warm-start: every trial resumes from
+                        # the SAME saved model, not one bagged model each
+                        init_list = [init_list[0]] * len(run)
+                    tsl = [settings_from_params(trials[t], mc.train)
+                           for t in run]
+                    base_lr = settings.learning_rate
+                    member_hypers = {
+                        "lr_scale": np.array([s.learning_rate / base_lr
+                                              for s in tsl]),
+                        "l2": np.array([s.l2 for s in tsl]),
+                        "l1": np.array([s.l1 for s in tsl]),
+                        "dropout": np.array([s.dropout_rate for s in tsl]),
+                    }
                 res = train_ensemble(x, y, train_w, valid_w, spec, settings,
                                      init_params_list=init_list,
                                      progress=self._progress_fn(pf, run),
                                      checkpoint=self._checkpoint_fn(spec, alg),
-                                     y_members=y_members)
-                results.append((run, spec, res, run_params))
+                                     y_members=y_members,
+                                     member_hypers=member_hypers)
+                results.append((run, spec, res,
+                                [trials[t] for t in run] if is_gs
+                                else run_params))
 
         self._write_models(results, alg, is_gs)
         log.info("train done in %.1fs", time.time() - t0)
@@ -377,8 +411,10 @@ class TrainProcessor(BasicProcessor):
             flat = []
             for run, spec, res, run_params in results:
                 for j, trial_idx in enumerate(run):
+                    tp = run_params[j] if isinstance(run_params, list) \
+                        else run_params
                     flat.append((res.valid_errors[j], trial_idx, spec,
-                                 res.params[j], run_params))
+                                 res.params[j], tp))
             flat.sort(key=lambda t: t[0])
             best = flat[0]
             log.info("grid search: best trial #%d valid error %.6f params %s",

@@ -43,3 +43,37 @@ def expand(params: Dict[str, Any]) -> List[Dict[str, Any]]:
         t.update({k: c for (k, _), c in zip(axes, combo)})
         trials.append(t)
     return trials
+
+
+# hypers a vmapped ensemble can vary per member (scalar multipliers in the
+# update rule / loss); everything else changes program structure
+STACKABLE_KEYS = ("LearningRate", "RegularizedConstant", "L2Const",
+                  "L1Const", "DropoutRate")
+
+# optimizers whose update delta is LINEAR in learning_rate — only for these
+# can a LearningRate axis stack as a per-member delta multiplier.  RPROP
+# ('R', the default) ignores lr entirely and quickprop is nonlinear in it.
+LR_LINEAR_OPTS = frozenset({"ADAM", "SGD", "MOMENTUM", "NESTEROV",
+                            "RMSPROP", "ADAGRAD", "B", "M"})
+
+
+def _trial_stackable(trial: Dict[str, Any]) -> frozenset:
+    opt = str(trial.get("Propagation", trial.get("Optimizer", "R"))).upper()
+    if opt in LR_LINEAR_OPTS:
+        return frozenset(STACKABLE_KEYS)
+    return frozenset(k for k in STACKABLE_KEYS if k != "LearningRate")
+
+
+def stackable_groups(trials: List[Dict[str, Any]]) -> List[List[int]]:
+    """Group trial indices whose params differ ONLY in stackable scalar
+    hypers — each group trains as ONE vmapped ensemble run (scalar hypers
+    become per-member arrays), instead of the reference's queue of 5
+    concurrent YARN jobs (``TrainModelProcessor.java:768-781``)."""
+    import json
+    groups: Dict[str, List[int]] = {}
+    for i, t in enumerate(trials):
+        stackable = _trial_stackable(t)
+        key = json.dumps({k: v for k, v in sorted(t.items())
+                          if k not in stackable}, default=str)
+        groups.setdefault(key, []).append(i)
+    return list(groups.values())

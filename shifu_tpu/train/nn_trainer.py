@@ -85,14 +85,21 @@ def train_ensemble(x: np.ndarray, y: np.ndarray,
                    progress: Optional[ProgressFn] = None,
                    checkpoint: Optional[Callable[[int, List[Any]], None]] = None,
                    mesh=None,
-                   y_members: Optional[np.ndarray] = None) -> EnsembleResult:
+                   y_members: Optional[np.ndarray] = None,
+                   member_hypers: Optional[Dict[str, np.ndarray]] = None
+                   ) -> EnsembleResult:
     """Train ``B`` members; ``train_w``/``valid_w`` are ``[B, N]`` per-row
     weight matrices (bagging/fold masks × data weights).
 
     ``y_members`` ([B, N]) gives each member its OWN target — the one-vs-all
     fan-out (reference ``TrainModelProcessor.java:684-714`` runs one bagging
     job per class; here classes are members on the ensemble axis, trained
-    simultaneously as one vmapped program)."""
+    simultaneously as one vmapped program).
+
+    ``member_hypers`` gives each member its OWN scalar hypers ([B] arrays
+    under keys ``lr_scale``/``l2``/``l1``/``dropout``) — how same-shape
+    grid-search trials train as ONE compiled run instead of the reference's
+    queue of jobs (``gs/GridSearch.java:62``)."""
     bags = train_w.shape[0]
     n = x.shape[0]
     if mesh is None:
@@ -127,16 +134,37 @@ def train_ensemble(x: np.ndarray, y: np.ndarray,
     ymd = None if y_members is None else jax.device_put(
         y_members, NamedSharding(mesh, P("ensemble", "data")))
 
-    dropout = settings.dropout_rate
+    # per-member hyper rows [B, 4]: lr_scale, l2, l1, dropout — uniform from
+    # settings unless stacked grid trials supplied their own
+    if member_hypers is None:
+        hyp = np.tile(np.asarray(
+            [[1.0, settings.l2, settings.l1, settings.dropout_rate]],
+            np.float32), (bags, 1))
+    else:
+        hyp = np.stack([
+            np.asarray(member_hypers.get("lr_scale", np.ones(bags)),
+                       np.float32),
+            np.asarray(member_hypers.get("l2", np.full(bags, settings.l2)),
+                       np.float32),
+            np.asarray(member_hypers.get("l1", np.full(bags, settings.l1)),
+                       np.float32),
+            np.asarray(member_hypers.get(
+                "dropout", np.full(bags, settings.dropout_rate)),
+                np.float32)], axis=1)
+    dropout = float(hyp[:, 3].max())       # static gate: any member drops?
+    uniform = member_hypers is None
+    hd = jax.device_put(hyp, sh_ens)
 
-    def member_update(params, opt_state, xb, yb, mw, rng, lr_scale):
+    def member_update(params, opt_state, xb, yb, mw, rng, h, lr_scale):
         loss, grads = jax.value_and_grad(nn_model.weighted_loss)(
             params, spec, xb, yb[:, None], mw,
-            l2=settings.l2, l1=settings.l1,
-            dropout_rate=dropout, rng=rng if dropout > 0 else None)
+            l2=settings.l2 if uniform else h[1],
+            l1=settings.l1 if uniform else h[2],
+            dropout_rate=settings.dropout_rate if uniform else h[3],
+            rng=rng if dropout > 0 else None)
         delta, opt_state = opt.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(lambda p, d: p + d * lr_scale,
-                                        params, delta)
+        params = jax.tree_util.tree_map(
+            lambda p, d: p + d * (lr_scale * h[0]), params, delta)
         return params, opt_state, loss
 
     y_axis = None if ymd is None else 0    # per-member targets vmap over B
@@ -144,8 +172,8 @@ def train_ensemble(x: np.ndarray, y: np.ndarray,
     @jax.jit
     def step(stacked, opt_state, xb, yb, tw, rngs, lr_scale):
         return jax.vmap(member_update,
-                        in_axes=(0, 0, None, y_axis, 0, 0, None))(
-            stacked, opt_state, xb, yb, tw, rngs, lr_scale)
+                        in_axes=(0, 0, None, y_axis, 0, 0, 0, None))(
+            stacked, opt_state, xb, yb, tw, rngs, hd, lr_scale)
 
     @jax.jit
     def eval_errors(stacked, tw, vw):
@@ -214,8 +242,8 @@ def train_ensemble(x: np.ndarray, y: np.ndarray,
             jax.lax.dynamic_slice_in_dim(ymd, start, blen, axis=1)
         twb = jax.lax.dynamic_slice_in_dim(twd, start, blen, axis=1)
         return jax.vmap(member_update,
-                        in_axes=(0, 0, None, y_axis, 0, 0, None))(
-            stacked, opt_state, xb, yb, twb, rngs, lr_scale)
+                        in_axes=(0, 0, None, y_axis, 0, 0, 0, None))(
+            stacked, opt_state, xb, yb, twb, rngs, hd, lr_scale)
 
     for epoch in range(start_epoch, settings.epochs):
         key, sub = jax.random.split(key)

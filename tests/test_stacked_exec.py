@@ -1,0 +1,204 @@
+"""Stacked execution paths (VERDICT round-2 item 7): grid trials as one
+vmapped run, bagged scorer as one jit call, PSI flat in column count."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+
+# --------------------------------------------------------- grid stacking
+def test_stackable_groups_partition():
+    from shifu_tpu.train.grid_search import expand, stackable_groups
+    trials = expand({"Propagation": "ADAM", "LearningRate": [0.1, 0.2],
+                     "NumHiddenNodes": [[8], [8, 4]],
+                     "ActivationFunc": ["tanh"]})
+    assert len(trials) == 4
+    groups = stackable_groups(trials)
+    # two shapes x two LRs -> 2 groups of 2 stacked trials
+    assert sorted(len(g) for g in groups) == [2, 2]
+    for g in groups:
+        shapes = {json.dumps(trials[t]["NumHiddenNodes"]) for t in g}
+        assert len(shapes) == 1
+
+
+def test_member_hypers_match_serial_runs():
+    """One vmapped run with per-member (lr, l2) arrays must reproduce each
+    serially-trained trial bit-for-bit (same init, same split)."""
+    import jax
+    from shifu_tpu.models import nn as nn_model
+    from shifu_tpu.train.nn_trainer import TrainSettings, train_ensemble
+    from shifu_tpu.train.sampling import member_masks
+
+    rng = np.random.default_rng(0)
+    n, d = 512, 6
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.random(n) < 1 / (1 + np.exp(-x[:, 0]))).astype(np.float32)
+    spec = nn_model.NNModelSpec(input_dim=d, hidden_nodes=[8],
+                                activations=["tanh"], loss="log")
+    tw1, vw1 = member_masks(n, 1, valid_rate=0.25, sample_rate=1.0,
+                            replacement=False, targets=y, seed=0)
+    p0 = nn_model.init_params(jax.random.PRNGKey(0), spec)
+
+    lrs = [0.05, 0.2]
+    l2s = [0.0, 1e-3]
+    serial = []
+    for lr, l2 in zip(lrs, l2s):
+        s = TrainSettings(optimizer="ADAM", learning_rate=lr, l2=l2,
+                          epochs=8, seed=0)
+        r = train_ensemble(x, y, tw1, vw1, spec, s, init_params_list=[p0])
+        serial.append(r)
+
+    base = TrainSettings(optimizer="ADAM", learning_rate=lrs[0], l2=l2s[0],
+                         epochs=8, seed=0)
+    stacked = train_ensemble(
+        x, y, np.tile(tw1, (2, 1)), np.tile(vw1, (2, 1)), spec, base,
+        init_params_list=[p0, p0],
+        member_hypers={"lr_scale": np.array([1.0, lrs[1] / lrs[0]]),
+                       "l2": np.array(l2s),
+                       "l1": np.zeros(2), "dropout": np.zeros(2)})
+    for k in range(2):
+        np.testing.assert_allclose(stacked.valid_errors[k],
+                                   serial[k].valid_errors[0],
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_grid_stacked_trains_concurrently(model_set):
+    """A 4-trial same-shape grid = ONE run (progress shows one trial group);
+    report still ranks all 4."""
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+
+    mcp = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.train.numTrainEpochs = 8
+    mc.train.params = {"Propagation": "ADAM",
+                       "LearningRate": [0.02, 0.05, 0.1, 0.2],
+                       "NumHiddenNodes": [8], "ActivationFunc": ["tanh"]}
+    mc.save(mcp)
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    assert TrainProcessor(model_set, params={}).run() == 0
+    report = json.load(open(os.path.join(model_set, "tmp",
+                                         "grid_search.json")))
+    assert len(report) == 4
+    assert report[0]["validError"] <= report[-1]["validError"]
+    # all 4 trials trained as one vmapped group -> progress file has ONE
+    # trial tag listing all four indices
+    progress = open(os.path.join(model_set, "tmp",
+                                 "train.progress")).read()
+    assert "Trial [0, 1, 2, 3]" in progress
+
+
+# --------------------------------------------------------- scorer stacking
+def test_scorer_stacks_same_shape_nn(tmp_path):
+    import jax
+    from shifu_tpu.eval.scorer import Scorer
+    from shifu_tpu.models import nn as nn_model
+
+    spec = nn_model.NNModelSpec(input_dim=4, hidden_nodes=[6],
+                                activations=["tanh"])
+    models = []
+    for i in range(5):
+        p = nn_model.init_params(jax.random.PRNGKey(i), spec)
+        path = os.path.join(tmp_path, f"model{i}.nn")
+        nn_model.save_model(path, spec, p)
+        models.append(nn_model.IndependentNNModel.load(path))
+    sc = Scorer(models)
+    groups = sc._stacked_nn_groups()
+    assert len(groups) == 1 and len(groups[0][0]) == 5   # one stack of 5
+    x = np.random.default_rng(0).normal(size=(64, 4)).astype(np.float32)
+    res = sc.score(x)
+    # stacked result must equal per-model compute
+    for i, m in enumerate(models):
+        np.testing.assert_allclose(res.scores[:, i],
+                                   m.compute(x)[:, 0] * 1000.0,
+                                   rtol=1e-5, atol=1e-3)
+
+
+def test_scorer_mixed_shapes_fall_back(tmp_path):
+    import jax
+    from shifu_tpu.eval.scorer import Scorer
+    from shifu_tpu.models import nn as nn_model
+
+    specs = [nn_model.NNModelSpec(input_dim=4, hidden_nodes=[6],
+                                  activations=["tanh"]),
+             nn_model.NNModelSpec(input_dim=4, hidden_nodes=[3],
+                                  activations=["tanh"])]
+    models = []
+    for i, sp in enumerate(specs):
+        p = nn_model.init_params(jax.random.PRNGKey(i), sp)
+        path = os.path.join(tmp_path, f"model{i}.nn")
+        nn_model.save_model(path, sp, p)
+        models.append(nn_model.IndependentNNModel.load(path))
+    sc = Scorer(models)
+    assert sc._stacked_nn_groups() == []     # nothing to stack
+    x = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
+    res = sc.score(x)
+    assert res.scores.shape == (16, 2)
+
+
+# ----------------------------------------------------------------- PSI
+def _psi_model_set(model_set, psi_col="channel"):
+    from shifu_tpu.config import ModelConfig
+    mcp = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.stats.psiColumnName = psi_col
+    mc.save(mcp)
+    return model_set
+
+
+def test_psi_vectorized_matches_reference_math(model_set):
+    """Vectorized PSI equals a direct per-unit histogram computation."""
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.config.column_config import load_column_configs
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.ops.stats_math import psi as psi_fn
+
+    _psi_model_set(model_set)
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={"psi": True}).run() == 0
+    ccs = load_column_configs(os.path.join(model_set, "ColumnConfig.json"))
+    amount = next(c for c in ccs if c.columnName == "amount")
+    assert amount.columnStats.psi is not None
+    assert amount.columnStats.psi >= 0
+    assert len(amount.columnStats.unitStats) == 3      # web/app/pos
+
+    # recompute directly from raw csv for one column
+    import pandas as pd
+    mc = ModelConfig.load(os.path.join(model_set, "ModelConfig.json"))
+    df = pd.read_csv(mc.dataSet.dataPath, sep="|")
+    bounds = np.asarray(amount.bin_boundary)
+    vals = pd.to_numeric(df["amount"], errors="coerce").to_numpy()
+    idx = np.searchsorted(bounds[1:], vals, side="right")
+    idx = np.where(np.isnan(vals), len(bounds), idx)   # missing bin
+    nb = len(bounds) + 1
+    hists = {u: np.bincount(idx[(df["channel"] == u).to_numpy()],
+                            minlength=nb)
+             for u in sorted(df["channel"].unique())}
+    overall = np.sum(list(hists.values()), axis=0)
+    for stat in amount.columnStats.unitStats:
+        u, v = stat.rsplit(":", 1)
+        np.testing.assert_allclose(float(v), psi_fn(overall, hists[u]),
+                                   atol=1e-6)
+
+
+def test_rprop_lr_axis_not_stacked():
+    """RPROP ignores LearningRate, so an LR axis must NOT group (stacking
+    would scale rprop's adaptive steps by a meaningless multiplier)."""
+    from shifu_tpu.train.grid_search import expand, stackable_groups
+    trials = expand({"Propagation": "R", "LearningRate": [0.05, 0.1, 0.2],
+                     "NumHiddenNodes": [8]})
+    groups = stackable_groups(trials)
+    assert sorted(len(g) for g in groups) == [1, 1, 1]
+    # ...while ADAM's LR axis stacks into one group
+    trials = expand({"Propagation": "ADAM", "LearningRate": [0.05, 0.1, 0.2],
+                     "NumHiddenNodes": [8]})
+    assert [len(g) for g in stackable_groups(trials)] == [3]
