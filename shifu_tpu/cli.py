@@ -70,6 +70,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-score", dest="score", metavar="EVALSET", nargs="?", const="")
     sp.add_argument("-perf", dest="perf", metavar="EVALSET", nargs="?", const="")
     sp.add_argument("-confmat", dest="confmat", metavar="EVALSET", nargs="?", const="")
+    sp.add_argument("-norm", dest="norm_eval", metavar="EVALSET", nargs="?",
+                    const="")
     sp.add_argument("-new", dest="new_eval", metavar="EVALSET")
     sp.add_argument("-delete", dest="delete_eval", metavar="EVALSET")
     sp.add_argument("-list", dest="list", action="store_true")

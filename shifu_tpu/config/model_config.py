@@ -121,6 +121,7 @@ class FilterBy(enum.Enum):
     SE = "SE"
     ST = "ST"
     FI = "FI"
+    GENETIC = "GENETIC"      # dvarsel wrapper search (core/dvarsel/)
 
 
 class MultipleClassification(enum.Enum):

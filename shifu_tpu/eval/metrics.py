@@ -122,7 +122,12 @@ def evaluate_scores(scores: np.ndarray, targets: np.ndarray,
     """Full eval report: AUC (unit + weighted), PR AUC, per-bucket confusion
     rows at ``buckets`` equal-population thresholds (reference
     ``performanceBucketNum``, default 10)."""
-    c = sweep(scores, targets, weights)
+    return evaluate_curves(sweep(scores, targets, weights), buckets)
+
+
+def evaluate_curves(c: SweepCurves, buckets: int = 10) -> PerformanceResult:
+    """Report from precomputed curves — callers that also render charts
+    (``eval/report.py``) sweep ONCE and share."""
     n = len(c.thresholds)           # distinct thresholds (ties collapsed)
     total = int(c.pos_total + c.neg_total)
     if n == 0 or c.pos_total == 0 or c.neg_total == 0:

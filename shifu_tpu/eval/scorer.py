@@ -21,6 +21,20 @@ from ..models import load_any
 SCORE_SCALE = 1000.0  # reference scales [0,1] raw scores by 1000
 
 
+def discover_model_paths(models_dir: str) -> List[str]:
+    """model* files in NUMERIC member order (model2 before model10) — the
+    one discovery rule for the scorer, exports, and anything else that
+    walks the models dir."""
+    def index_key(p: str) -> tuple:
+        stem = os.path.splitext(os.path.basename(p))[0]
+        digits = "".join(ch for ch in stem if ch.isdigit())
+        return (int(digits) if digits else 0, p)
+
+    return sorted((p for p in glob.glob(os.path.join(models_dir, "model*.*"))
+                   if not p.endswith(".json")),  # convert sidecars
+                  key=index_key)
+
+
 @dataclass
 class CaseScoreResult:
     """Batch analogue of reference ``container/CaseScoreResult``: per-row
@@ -58,14 +72,7 @@ class Scorer:
 
     @classmethod
     def from_dir(cls, models_dir: str, scale: float = SCORE_SCALE) -> "Scorer":
-        def index_key(p: str) -> tuple:
-            stem = os.path.splitext(os.path.basename(p))[0]
-            digits = "".join(ch for ch in stem if ch.isdigit())
-            return (int(digits) if digits else 0, p)
-
-        paths = sorted((p for p in glob.glob(os.path.join(models_dir, "model*.*"))
-                        if not p.endswith(".json")),  # convert sidecars
-                       key=index_key)
+        paths = discover_model_paths(models_dir)
         models = [load_any(p) for p in paths]
         if not models:
             raise FileNotFoundError(f"no model files in {models_dir}")

@@ -42,11 +42,50 @@ class EvalProcessor(BasicProcessor):
             return self._new_eval(p["new_eval"])
         if p.get("delete_eval"):
             return self._delete_eval(p["delete_eval"])
+        if p.get("norm_eval") is not None:
+            return self._norm_export(p["norm_eval"] or None)
         for key in ("run_eval", "score", "perf", "confmat"):
             if p.get(key) is not None:
                 return self._run(p[key] or None, action=key)
         # bare `eval` = run all sets (reference default)
         return self._run(None, action="run_eval")
+
+    def _norm_export(self, name: Optional[str]) -> int:
+        """`eval -norm`: write the eval set's NORMALIZED feature matrix
+        (reference ``EvalModelProcessor`` runNormalize path — feeds external
+        scoring/debug tooling the exact model inputs)."""
+        from ..data.transform import DatasetTransformer
+        for i in self._eval_sets(name):
+            ev = self.model_config.evals[i]
+            tf = DatasetTransformer(self.model_config, self.column_configs,
+                                    for_eval_set=i)
+            ds = ev.dataSet
+            source = DataSource(self._abs(ds.dataPath), ds.dataDelimiter,
+                                header_path=self._abs(ds.headerPath),
+                                header_delimiter=ds.headerDelimiter)
+            out = self.paths.eval_norm_path(ev.name)
+            os.makedirs(os.path.dirname(out), exist_ok=True)
+            n_rows = 0
+            with open(out, "w") as f:
+                w = csv.writer(f, delimiter="|")
+                header_written = False
+                for chunk in source.iter_chunks():
+                    tc = tf.transform(chunk)
+                    if tc.n == 0:
+                        continue
+                    if not header_written:
+                        w.writerow(["tag", "weight"] + list(tf.output_names))
+                        header_written = True
+                    block = np.column_stack(
+                        [tc.target.astype(int).astype(str),
+                         tc.weight.astype(str)]
+                        + [np.char.mod("%.6f", tc.x[:, j])
+                           for j in range(tc.x.shape[1])])
+                    w.writerows(block.tolist())
+                    n_rows += tc.n
+            log.info("eval %s: normalized %d rows -> %s", ev.name, n_rows,
+                     out)
+        return 0
 
     # -------------------------------------------------------------- CRUD
     def _new_eval(self, name: str) -> int:
@@ -147,13 +186,17 @@ class EvalProcessor(BasicProcessor):
         if action == "score":
             return 0
 
-        result = evaluate_scores(scores, targets, weights,
-                                 buckets=ev.performanceBucketNum)
+        from ..eval.metrics import evaluate_curves, sweep
+        curves = sweep(scores, targets, weights)   # ONE sort; two consumers
+        result = evaluate_curves(curves, buckets=ev.performanceBucketNum)
         result.modelCount = n_models
         with open(self.paths.eval_performance_path(ev.name), "w") as f:
             json.dump(result.to_dict(), f, indent=2)
         self._write_confusion(ev.name, result)
         self._write_gains(eval_dir, result)
+        from ..eval.report import html_report
+        with open(os.path.join(eval_dir, "report.html"), "w") as f:
+            f.write(html_report(ev.name, curves, result))
         log.info("eval %s: AUC %.6f weighted AUC %.6f PR-AUC %.6f",
                  ev.name, result.areaUnderRoc, result.weightedAuc,
                  result.areaUnderPr)

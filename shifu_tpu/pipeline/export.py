@@ -22,8 +22,13 @@ class ExportProcessor(BasicProcessor):
     def process(self) -> int:
         t = (self.params.get("type") or "pmml").lower()
         os.makedirs(self.paths.export_dir, exist_ok=True)
-        if t == "pmml":
+        if t in ("pmml", "baggingpmml"):
+            # pmml already walks EVERY bagged member (model0..B) — the
+            # reference's separate baggingpmml path collapses into it
+            # (ExportModelProcessor.java:76-84)
             return self._export_pmml()
+        if t == "bagging":
+            return self._export_bagging()
         if t == "columnstats":
             return self._export_columnstats()
         if t in ("woemapping", "woe"):
@@ -32,6 +37,33 @@ class ExportProcessor(BasicProcessor):
             return self._export_corr()
         log.error("unknown export type %s", t)
         return 1
+
+    def _export_bagging(self) -> int:
+        """Bundle all bagged members + an ensemble manifest into export/
+        (reference EXPORT_BAGGING: one spec that scores the whole
+        ensemble)."""
+        import json as _json
+        import shutil
+
+        from ..eval.scorer import discover_model_paths
+        paths = discover_model_paths(self.paths.models_dir)
+        if not paths:
+            log.error("no models to export — run `train` first")
+            return 1
+        out_dir = os.path.join(self.paths.export_dir, "bagging")
+        os.makedirs(out_dir, exist_ok=True)
+        members = []
+        for p in paths:
+            shutil.copy(p, os.path.join(out_dir, os.path.basename(p)))
+            members.append(os.path.basename(p))
+        sel = self.model_config.evals[0].performanceScoreSelector \
+            if self.model_config.evals else "mean"
+        with open(os.path.join(out_dir, "ensemble.json"), "w") as f:
+            _json.dump({"modelSet": self.model_config.basic.name,
+                        "members": members,
+                        "scoreSelector": sel or "mean"}, f, indent=2)
+        log.info("bagging export: %d member(s) -> %s", len(members), out_dir)
+        return 0
 
     def _export_pmml(self) -> int:
         from ..export import pmml as pmml_mod

@@ -136,6 +136,8 @@ class VarSelectProcessor(BasicProcessor):
         fb = vs.filterBy
         if fb in (FilterBy.SE, FilterBy.ST):
             scores = self._sensitivity_scores(candidates, fb)
+        elif fb == FilterBy.GENETIC:
+            scores = self._genetic_scores(candidates, vs)
         elif fb == FilterBy.FI:
             scores = self._fi_scores(candidates)
         elif fb == FilterBy.IV:
@@ -293,6 +295,41 @@ class VarSelectProcessor(BasicProcessor):
             json.dump({str(k): v for k, v in
                        sorted(scores.items(), key=lambda kv: -kv[1])}, f,
                       indent=2)
+        return scores
+
+    def _genetic_scores(self, candidates: List[ColumnConfig],
+                        vs) -> Dict[int, float]:
+        """dvarsel wrapper search: a population of column subsets evolves by
+        inherit/crossover/mutation, fitness = masked-NN validation loss, all
+        candidates trained as one vmapped run (reference ``core/dvarsel/``;
+        see ``train/dvarsel.py``).  Needs `norm` to have run."""
+        from ..data.shards import Shards
+        from ..train.dvarsel import WrapperSettings, genetic_varselect
+
+        shards = Shards.open(self.paths.norm_dir)
+        data = shards.load_all()
+        names = shards.schema["outputNames"]
+        col_nums = shards.schema["columnNums"]
+        blocks = _column_blocks(names, col_nums, candidates)
+        blocks = {cn: idx for cn, idx in blocks.items() if idx}
+        if not blocks:
+            raise RuntimeError("genetic varselect: no candidate feature "
+                               "blocks in the normalized plane — run `norm`")
+        settings = WrapperSettings.from_params(
+            vs.params, n_select=min(vs.filterNum, len(blocks)),
+            valid_rate=self.model_config.train.validSetRate)
+        scores, history = genetic_varselect(
+            data["x"], data["y"], data["w"], blocks, settings)
+        os.makedirs(self.paths.varsel_dir, exist_ok=True)
+        with open(os.path.join(self.paths.varsel_dir, "genetic.json"),
+                  "w") as f:
+            json.dump({"history": history,
+                       "credit": {str(k): v for k, v in sorted(
+                           scores.items(), key=lambda kv: -kv[1])}},
+                      f, indent=2)
+        # columns with no feature block rank last
+        for c in candidates:
+            scores.setdefault(c.columnNum, -1.0)
         return scores
 
     def _fi_scores(self, candidates: List[ColumnConfig]) -> Dict[int, float]:
