@@ -123,6 +123,13 @@ class NumericAccumulator:
     missing_agg: Optional[np.ndarray] = None   # [C, 4] pos/neg/wpos/wneg of missing
     lo: Optional[np.ndarray] = None
     hi: Optional[np.ndarray] = None
+    # exact mode (MunroPat): keep per-column (valid values, pos flag,
+    # weight) so boundaries land on TRUE quantiles instead of sketch-bucket
+    # edges (reference ``core/binning/MunroPatBinning.java:29`` materializes
+    # the column sample the same way).  Memory is O(valid values) — the
+    # exact path is for LOCAL-scale runs; the sketch remains the default.
+    exact: bool = False
+    _exact_cols: Optional[list] = None     # [C] lists of (vals, pos, w)
 
     # ---- pass 1
     def update_moments(self, x: np.ndarray, valid: np.ndarray) -> None:
@@ -160,6 +167,15 @@ class NumericAccumulator:
             (inval * (weight * is_pos)[:, None]).sum(0),
             (inval * (weight * ~is_pos)[:, None]).sum(0)], axis=1).astype(np.float64)
         self.missing_agg = magg if self.missing_agg is None else self.missing_agg + magg
+        if self.exact:
+            if self._exact_cols is None:
+                self._exact_cols = [[] for _ in range(self.n_cols)]
+            pos_r = np.asarray(target, np.float64) >= 0.5
+            w64 = np.asarray(weight, np.float64)
+            for c in range(self.n_cols):
+                v = valid[:, c]
+                self._exact_cols[c].append(
+                    (np.asarray(x[v, c], np.float64), pos_r[v], w64[v]))
 
     # ---- boundary derivation
     def bucket_edges(self, col: int) -> np.ndarray:
@@ -198,6 +214,85 @@ class NumericAccumulator:
             bnds = np.concatenate([[NEG_INF], edges[pos + 1]])
             out.append(_dedupe(bnds))
         return out
+
+    def _exact_col(self, col: int):
+        chunks = self._exact_cols[col]
+        return (np.concatenate([c[0] for c in chunks]) if chunks
+                else np.empty(0),
+                np.concatenate([c[1] for c in chunks]) if chunks
+                else np.empty(0, bool),
+                np.concatenate([c[2] for c in chunks]) if chunks
+                else np.empty(0))
+
+    @staticmethod
+    def _measure(method: BinningMethod):
+        """Weight measure of one (pos, w) row set for a binning method —
+        selected ONCE, not rebuilt per column."""
+        return {
+            BinningMethod.EqualTotal: lambda p, w: np.ones(len(p)),
+            BinningMethod.EqualPositive: lambda p, w: p.astype(np.float64),
+            BinningMethod.EqualNegtive: lambda p, w: (~p).astype(np.float64),
+            BinningMethod.WeightEqualTotal: lambda p, w: w,
+            BinningMethod.WeightEqualPositive: lambda p, w: w * p,
+            BinningMethod.WeightEqualNegative: lambda p, w: w * ~p,
+        }.get(method, lambda p, w: np.ones(len(p)))
+
+    def compute_boundaries_exact(self, method: BinningMethod,
+                                 max_bins: int) -> List[np.ndarray]:
+        """Exact equal-frequency boundaries from the materialized values —
+        the MunroPat path (reference ``MunroPatBinning.java:29`` exact
+        quantiles): boundaries are TRUE data quantiles of the method's
+        weight measure, not sketch-bucket edges.  Pair with
+        :meth:`bin_counts_exact` — the sketch-based :meth:`bin_counts`
+        assumes boundaries on bucket edges and would misassign rows tied
+        at a mid-bucket boundary."""
+        assert self._exact_cols is not None, \
+            "exact boundaries need exact=True collection during pass 2"
+        measure = self._measure(method)
+        out = []
+        for c in range(self.n_cols):
+            vals, pos, ws = self._exact_col(c)
+            if vals.size == 0:
+                out.append(np.array([NEG_INF]))
+                continue
+            if method == BinningMethod.EqualInterval:
+                inner = np.linspace(vals.min(), vals.max(), max_bins + 1)[:-1]
+                out.append(_dedupe(np.concatenate([[NEG_INF], inner[1:]])))
+                continue
+            wrow = measure(pos, ws)
+            order = np.argsort(vals, kind="stable")
+            sv, sw = vals[order], wrow[order]
+            cum = np.cumsum(sw)
+            total = cum[-1]
+            if total <= 0:
+                out.append(np.array([NEG_INF]))
+                continue
+            targets = total * np.arange(1, max_bins) / max_bins
+            pos_idx = np.searchsorted(cum, targets, side="left")
+            pos_idx = np.minimum(pos_idx, len(sv) - 1)
+            bnds = np.concatenate([[NEG_INF], sv[pos_idx]])
+            out.append(_dedupe(bnds))
+        return out
+
+    def bin_counts_exact(self, col: int, boundaries: np.ndarray) -> np.ndarray:
+        """Per-bin (pos, neg, wpos, wneg) from the EXACT materialized rows,
+        with the same assignment rule scoring uses (``ColumnBinner
+        .bin_numeric``: b[i] <= v < b[i+1]); trailing missing bin from the
+        missing aggregation.  The sketch-based :meth:`bin_counts` is only
+        exact when boundaries sit on fine-bucket edges — exact-quantile
+        boundaries don't."""
+        vals, pos, ws = self._exact_col(col)
+        nb = len(boundaries)
+        idx = np.clip(np.searchsorted(boundaries, vals, side="right") - 1,
+                      0, nb - 1)
+        agg = np.zeros((nb + 1, 4))
+        np.add.at(agg, (idx, 0), pos.astype(np.float64))
+        np.add.at(agg, (idx, 1), (~pos).astype(np.float64))
+        np.add.at(agg, (idx, 2), ws * pos)
+        np.add.at(agg, (idx, 3), ws * ~pos)
+        if self.missing_agg is not None:
+            agg[nb] = self.missing_agg[col]
+        return agg
 
     def bin_counts(self, col: int, boundaries: np.ndarray) -> np.ndarray:
         """Exact per-bin (pos, neg, wpos, wneg) counts incl. trailing missing

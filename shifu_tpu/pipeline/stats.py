@@ -42,15 +42,19 @@ class StatsProcessor(BasicProcessor):
                             header_path=self._abs(mc.dataSet.headerPath),
                             header_delimiter=mc.dataSet.headerDelimiter)
 
-        num_acc = NumericAccumulator(n_cols=len(num_cols))
+        from ..config.model_config import BinningAlgorithm
+        exact_alg = mc.stats.binningAlgorithm in (BinningAlgorithm.MunroPat,
+                                                  BinningAlgorithm.MunroPatI)
+        num_acc = NumericAccumulator(n_cols=len(num_cols), exact=exact_alg)
         cat_acc = CategoricalAccumulator()
         psi_col = mc.stats.psiColumnName if self.params.get("psi") or \
             mc.stats.psiColumnName else None
+        rate = float(mc.stats.sampleRate)
 
         # ---------------- pass 1: moments/min/max (numeric)
         total_rows = 0
-        for chunk in source.iter_chunks():
-            ex = extractor.extract(chunk)
+        for ci, chunk in enumerate(source.iter_chunks()):
+            ex = extractor.extract(_sample_raw(chunk, rate, ci))
             if ex.n == 0:
                 continue
             total_rows += ex.n
@@ -66,8 +70,9 @@ class StatsProcessor(BasicProcessor):
         corr_acc = CorrelationAccumulator(mean=num_acc.moments["mean"]) \
             if (want_corr and num_cols) else None
         psi_units: Dict[str, Dict[str, np.ndarray]] = {}
-        for chunk in source.iter_chunks():
-            ex = extractor.extract(chunk, keep_raw=psi_col is not None)
+        for ci, chunk in enumerate(source.iter_chunks()):
+            ex = extractor.extract(_sample_raw(chunk, rate, ci),
+                                   keep_raw=psi_col is not None)
             if ex.n == 0:
                 continue
             # multi-class: bin pos/neg stats binarize as class 0 vs rest so
@@ -109,7 +114,14 @@ class StatsProcessor(BasicProcessor):
     def _finalize_numeric(self, num_cols: List[ColumnConfig],
                           acc: NumericAccumulator, total_rows: int) -> None:
         mc = self.model_config
-        boundaries = acc.compute_boundaries(mc.stats.binningMethod, mc.stats.maxNumBin)
+        # MunroPat/MunroPatI: exact data quantiles; everything else: the
+        # streaming fine-histogram sketch (SPDT-family stand-in)
+        if acc.exact:
+            boundaries = acc.compute_boundaries_exact(mc.stats.binningMethod,
+                                                      mc.stats.maxNumBin)
+        else:
+            boundaries = acc.compute_boundaries(mc.stats.binningMethod,
+                                                mc.stats.maxNumBin)
         # skew/kurt directly from central moments (more stable than power sums)
         cnt = np.maximum(acc.moments["count"], 1.0)
         m2 = acc.moments["M2"] / cnt
@@ -122,7 +134,10 @@ class StatsProcessor(BasicProcessor):
 
         for i, cc in enumerate(num_cols):
             bnds = boundaries[i]
-            agg = acc.bin_counts(i, bnds)  # [bins+1, 4]
+            # exact mode counts from the materialized rows (mid-bucket
+            # boundaries would misassign ties through the sketch)
+            agg = acc.bin_counts_exact(i, bnds) if acc.exact \
+                else acc.bin_counts(i, bnds)   # [bins+1, 4]
             cpos, cneg, wpos, wneg = agg[:, 0], agg[:, 1], agg[:, 2], agg[:, 3]
             cm = column_metrics(cneg[None, :], cpos[None, :])
             wm = column_metrics(wneg[None, :], wpos[None, :])
@@ -150,6 +165,7 @@ class StatsProcessor(BasicProcessor):
             bn.length = len(bnds) + 1
             bn.binBoundary = [float(b) for b in bnds]
             bn.binCategory = None
+            bn.extra["binningAlgorithm"] = mc.stats.binningAlgorithm.value
             bn.binCountNeg = [int(x) for x in cneg]
             bn.binCountPos = [int(x) for x in cpos]
             bn.binWeightedNeg = [float(x) for x in wneg]
@@ -240,26 +256,28 @@ class StatsProcessor(BasicProcessor):
         total_bins = int(offsets[-1])
         unit_ids: Dict[str, int] = {}
         acc = np.zeros((0, total_bins), np.float64)   # [units, packed bins]
-        for chunk in source.iter_chunks():
+        rate = float(self.model_config.stats.sampleRate)
+        for ci, chunk in enumerate(source.iter_chunks()):
             df = chunk.data
             if psi_col not in df.columns:
                 log.warning("psi column %s not found; skipping PSI", psi_col)
                 return
-            ex = extractor.extract(chunk, keep_raw=True)
+            ex = extractor.extract(_sample_raw(chunk, rate, ci),
+                                   keep_raw=True)
             if ex.n == 0:
                 continue
             units = ex.raw.data[psi_col].to_numpy()  # raw values: numeric
             # unit columns keep numeric sort order in unitStats
             num_index = {c.columnName: i for i, c in enumerate(ex.numeric_cols)}
             idx_mat = np.empty((ex.n, len(col_list)), np.int64)
-            for ci, (name, (cc, binner)) in enumerate(col_list):
+            for col_i, (name, (cc, binner)) in enumerate(col_list):
                 if cc.is_categorical():
                     idx = binner.bin_categorical(ex.categorical[name])
                 else:
                     j = num_index[name]
                     idx = binner.bin_numeric(ex.numeric[:, j],
                                              ex.numeric_valid[:, j])
-                idx_mat[:, ci] = np.asarray(idx, np.int64) + offsets[ci]
+                idx_mat[:, col_i] = np.asarray(idx, np.int64) + offsets[col_i]
             for u in np.unique(units):
                 unit_ids.setdefault(u, len(unit_ids))
             if len(unit_ids) > acc.shape[0]:
@@ -287,6 +305,21 @@ class StatsProcessor(BasicProcessor):
             cc.columnStats.unitStats = [
                 f"{u}:{psi(overall, acc[uid, s:e]):.6f}"
                 for u, uid in units_sorted]
+
+
+def _sample_raw(chunk, rate: float, chunk_idx: int):
+    """Apply ``stats.sampleRate`` BEFORE parsing: deterministic Bernoulli
+    sample of the raw rows, IDENTICAL across all stats passes (per-chunk
+    substream seed over the raw row count) — the reference samples in its
+    stats mappers (``ModelStatsConf`` sampleRate,
+    ``MapReducerStatsWorker``); sampling pre-extract also skips the parse
+    cost of the dropped rows."""
+    if rate >= 1.0 or len(chunk.data) == 0:
+        return chunk
+    from ..data.reader import RawChunk
+    keep = np.random.default_rng([977, chunk_idx]) \
+        .random(len(chunk.data)) < rate
+    return RawChunk(chunk.columns, chunk.data[keep])
 
 
 def _f(x) -> Optional[float]:

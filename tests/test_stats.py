@@ -147,3 +147,70 @@ def test_stats_step_end_to_end(model_set):
     assert os.path.isfile(os.path.join(model_set, "correlation.csv"))
     # noise column should carry ~no signal
     assert by_name["noise"].columnStats.iv < amt.columnStats.iv
+
+
+def test_stats_sample_rate_applied(model_set):
+    """stats.sampleRate must actually subsample (round-2 gap: validated but
+    ignored); sampled stats stay statistically close to the full pass."""
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.config.column_config import load_column_configs
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    ccp = os.path.join(model_set, "ColumnConfig.json")
+    full = {c.columnName: c.columnStats.validNumCount
+            for c in load_column_configs(ccp) if not c.is_categorical()}
+    full_mean = {c.columnName: c.columnStats.mean
+                 for c in load_column_configs(ccp) if c.columnStats.mean}
+
+    mcp = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.stats.sampleRate = 0.5
+    mc.save(mcp)
+    assert StatsProcessor(model_set, params={}).run() == 0
+    half = {c.columnName: c.columnStats.validNumCount
+            for c in load_column_configs(ccp) if not c.is_categorical()}
+    for name, n_full in full.items():
+        if not n_full:
+            continue
+        frac = half[name] / n_full
+        assert 0.4 < frac < 0.6, (name, frac)     # ~50% of rows seen
+    for c in load_column_configs(ccp):
+        m = full_mean.get(c.columnName)
+        if m and c.columnStats.mean and abs(m) > 0.5:
+            assert abs(c.columnStats.mean - m) / abs(m) < 0.2
+
+
+def test_munropat_exact_boundaries(model_set):
+    """MunroPat dispatch: boundaries are EXACT data quantiles (not quantized
+    to sketch-bucket edges) and the selection is recorded in ColumnConfig."""
+    import pandas as pd
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.config.column_config import load_column_configs
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+
+    mcp = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.stats.binningAlgorithm = "MunroPat"
+    mc.stats.binningMethod = "EqualTotal"
+    mc.stats.maxNumBin = 8
+    mc.save(mcp)
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    ccs = load_column_configs(os.path.join(model_set, "ColumnConfig.json"))
+    amount = next(c for c in ccs if c.columnName == "amount")
+    assert amount.columnBinning.extra["binningAlgorithm"] == "MunroPat"
+    bnds = amount.bin_boundary
+    # every inner boundary must be an ACTUAL data value (exact quantile)
+    df = pd.read_csv(mc.dataSet.dataPath, sep="|")
+    vals = set(np.round(pd.to_numeric(df["amount"], errors="coerce")
+                        .dropna().to_numpy(), 9))
+    for b in bnds[1:]:
+        assert np.round(b, 9) in vals, b
+    # equal-total: inner bins hold roughly equal counts
+    counts = np.asarray(amount.columnBinning.binCountPos[:-1]) + \
+        np.asarray(amount.columnBinning.binCountNeg[:-1])
+    assert counts.min() > 0.5 * counts.max() - 1
