@@ -76,8 +76,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-delete", dest="delete_eval", metavar="EVALSET")
     sp.add_argument("-list", dest="list", action="store_true")
 
-    sp = sub.add_parser("export", help="export model (pmml|columnstats|woemapping|corr)")
+    sp = sub.add_parser("export", help="export model "
+                        "(pmml|baggingpmml|bagging|columnstats|woemapping|corr)")
     sp.add_argument("-t", "--type", default="pmml")
+
+    sp = sub.add_parser("analysis", help="model spec analysis "
+                        "(-fi MODEL: tree feature importance)")
+    sp.add_argument("-fi", dest="fi_model", metavar="MODELPATH")
 
     sp = sub.add_parser("test", help="pipeline smoke test on a data sample")
     sp = sub.add_parser("encode", help="encode dataset by tree-leaf index")
@@ -149,6 +154,9 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
     if cmd == "export":
         from .pipeline.export import ExportProcessor
         return ExportProcessor(args.dir, params=vars(args)).run()
+    if cmd == "analysis":
+        from .pipeline.analysis import analyze_model_fi
+        return analyze_model_fi(args.fi_model)
     if cmd == "test":
         from .pipeline.smoke import SmokeTestProcessor
         return SmokeTestProcessor(args.dir, params=vars(args)).run()

@@ -242,3 +242,38 @@ class IndependentNNModel:
 
     def compute(self, x: np.ndarray) -> np.ndarray:
         return np.asarray(self._fwd(self.params, jnp.asarray(x, jnp.float32)))
+
+
+def fit_params_into(old_spec: NNModelSpec, old_params, new_spec: NNModelSpec,
+                    key, initializer: str = "xavier"):
+    """Continuous-training structure fit-in (reference ``NNMaster.java:
+    331-362,605-645``): grow a smaller saved net into a larger configured
+    one — fresh-init the new shape, then copy each old weight block into
+    the top-left corner of the matching layer.  New rows/cols/layers keep
+    their fresh init.  Returns None when the old net does not embed (any
+    old dim exceeds the new one, or fewer layers configured than saved)."""
+    old_dims = old_spec.layer_dims()
+    new_dims = new_spec.layer_dims()
+    if len(old_dims) > len(new_dims):
+        return None
+    for (oi, oo), (ni, no) in zip(old_dims, new_dims):
+        if oi > ni or oo > no:
+            return None
+    # the OUTPUT layer must stay last: when layers are added, the old
+    # output layer cannot be copied mid-stack meaningfully — only grow
+    # same-depth nets or append hidden layers before a fresh output
+    params = init_params(key, new_spec, initializer)
+    out = []
+    for li, layer in enumerate(params):
+        if li < len(old_params) and not (
+                len(old_dims) < len(new_dims) and li == len(old_params) - 1):
+            w = np.asarray(layer["w"]).copy()
+            b = np.asarray(layer["b"]).copy()
+            ow = np.asarray(old_params[li]["w"])
+            ob = np.asarray(old_params[li]["b"])
+            w[:ow.shape[0], :ow.shape[1]] = ow
+            b[:ob.shape[0]] = ob
+            out.append({"w": jnp.asarray(w), "b": jnp.asarray(b)})
+        else:
+            out.append(layer)
+    return out

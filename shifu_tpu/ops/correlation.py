@@ -1,15 +1,22 @@
-"""Pearson correlation across candidate columns, on device.
+"""Pearson correlation across ALL candidate columns, on device, with
+pairwise-complete semantics.
 
 Replaces the reference's Correlation MR job (``core/correlation/``,
-``CorrelationWritable.java:36-52`` running sums): each chunk contributes
-``X^T X`` cross-products via one MXU matmul; missing values are imputed with
-the column mean (pass-1 stats) so they contribute zero deviation — the dense,
-TPU-friendly version of the reference's pairwise ``adjustCount`` bookkeeping.
+``CorrelationWritable.java:36-52``): the reference keeps per-pair running
+sums (sumX, sumY, sumXX, sumYY, sumXY, adjustCount) so each pair uses
+exactly the rows where BOTH columns are valid.  Here those per-pair sums
+are four MXU matmuls per chunk over the validity-masked matrix — the dense
+TPU formulation of adjustCount bookkeeping (the round-2 version mean-imputed
+missing values, which biases pairs with disjoint missingness).
+
+Categorical columns participate via their bin pos-rate encoding
+(``CorrelationMapper.java:309-318``), so the matrix covers every candidate,
+not just numerics.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 import jax
@@ -18,33 +25,59 @@ import numpy as np
 
 
 @jax.jit
-def _corr_kernel(x: jnp.ndarray, valid: jnp.ndarray, mean: jnp.ndarray):
-    xc = jnp.where(valid, x - mean, 0.0)
-    return xc.T @ xc, valid.astype(x.dtype).T @ valid.astype(x.dtype)
+def _pair_sums(x: jnp.ndarray, v: jnp.ndarray, offset: jnp.ndarray):
+    """Per-pair running sums for one chunk: x [R, C] (invalid entries may
+    hold anything), v [R, C] validity, offset [C] per-column shift.
+    Returns (n, sx, sxy, sxx) each [C, C], where cell (i, j) sums over rows
+    valid in BOTH i and j: n = count, sx = sum x_i, sxy = sum x_i x_j,
+    sxx = sum x_i^2 — all over the SHIFTED values.  Pearson is per-column
+    shift-invariant, and shifting by ~the column mean keeps the f32
+    uncentered power sums from cancelling catastrophically (unix-timestamp
+    scale columns would otherwise lose all variance signal)."""
+    vf = v.astype(x.dtype)
+    xv = jnp.where(v, x - offset, 0.0)
+    x2v = xv * xv
+    return (vf.T @ vf, xv.T @ vf, xv.T @ xv, x2v.T @ vf)
 
 
 @dataclass
 class CorrelationAccumulator:
-    mean: np.ndarray                      # [C] per-column mean from pass 1
-    xtx: Optional[np.ndarray] = None      # [C, C] sum of centered cross-products
-    nn: Optional[np.ndarray] = None       # [C, C] pairwise valid counts
+    """Streaming pairwise-complete Pearson (sy/syy come free as sx^T/sxx^T).
+    ``offset`` [C] shifts each column before the sums (pass-1 means keep
+    f32 stable); None = no shift."""
+    n_cols: int
+    offset: Optional[np.ndarray] = None
+    n: Optional[np.ndarray] = None
+    sx: Optional[np.ndarray] = None
+    sxy: Optional[np.ndarray] = None
+    sxx: Optional[np.ndarray] = None
 
     def update(self, x: np.ndarray, valid: np.ndarray) -> None:
-        a, b = _corr_kernel(jnp.asarray(x, jnp.float32), jnp.asarray(valid),
-                            jnp.asarray(self.mean, jnp.float32))
-        a = np.asarray(a, np.float64)
-        b = np.asarray(b, np.float64)
-        self.xtx = a if self.xtx is None else self.xtx + a
-        self.nn = b if self.nn is None else self.nn + b
+        off = np.zeros(self.n_cols) if self.offset is None else self.offset
+        out = _pair_sums(jnp.asarray(x, jnp.float32), jnp.asarray(valid),
+                         jnp.asarray(off, jnp.float32))
+        n, sx, sxy, sxx = (np.asarray(a, np.float64) for a in out)
+        if self.n is None:
+            self.n, self.sx, self.sxy, self.sxx = n, sx, sxy, sxx
+        else:
+            self.n += n
+            self.sx += sx
+            self.sxy += sxy
+            self.sxx += sxx
 
     def finalize(self) -> np.ndarray:
-        """[C, C] Pearson matrix; columns with ~zero variance give NaN."""
-        if self.xtx is None:
-            return np.zeros((len(self.mean), len(self.mean)))
-        var = np.diag(self.xtx).copy()
-        denom = np.sqrt(np.outer(var, var))
+        """[C, C] Pearson over each pair's both-valid rows; degenerate pairs
+        (no overlap / zero variance) give NaN."""
+        if self.n is None:
+            return np.full((self.n_cols, self.n_cols), np.nan)
+        n, sx, sxy, sxx = self.n, self.sx, self.sxy, self.sxx
+        sy, syy = sx.T, sxx.T
         with np.errstate(invalid="ignore", divide="ignore"):
-            corr = np.where(denom > 1e-12, self.xtx / np.where(denom == 0, 1, denom),
-                            np.nan)
+            cov = n * sxy - sx * sy
+            varx = n * sxx - sx * sx
+            vary = n * syy - sy * sy
+            denom = np.sqrt(np.where(varx > 0, varx, np.nan)
+                            * np.where(vary > 0, vary, np.nan))
+            corr = cov / denom
         np.fill_diagonal(corr, 1.0)
         return corr

@@ -65,10 +65,14 @@ class StatsProcessor(BasicProcessor):
         if num_cols:
             num_acc.finalize_range()
 
-        # ---------------- pass 2: fine histograms + categorical + correlation
+        # ---------------- pass 2: fine histograms + categorical
+        # correlation piggybacks pass 2 when only numerics participate;
+        # categorical pos-rate encodings need finished bin stats (3rd pass)
         want_corr = bool(self.params.get("correlation"))
-        corr_acc = CorrelationAccumulator(mean=num_acc.moments["mean"]) \
-            if (want_corr and num_cols) else None
+        corr_acc = None
+        if want_corr and num_cols and not cat_cols:
+            corr_acc = CorrelationAccumulator(
+                n_cols=len(num_cols), offset=num_acc.moments["mean"])
         psi_units: Dict[str, Dict[str, np.ndarray]] = {}
         for ci, chunk in enumerate(source.iter_chunks()):
             ex = extractor.extract(_sample_raw(chunk, rate, ci),
@@ -83,7 +87,8 @@ class StatsProcessor(BasicProcessor):
                 num_acc.update_histogram(ex.numeric, ex.numeric_valid,
                                          tgt, ex.weight)
                 if corr_acc is not None:
-                    corr_acc.update(ex.numeric, ex.numeric_valid)
+                    corr_acc.update(np.nan_to_num(ex.numeric),
+                                    ex.numeric_valid)
             for cc in cat_cols:
                 vals = ex.categorical[cc.columnName]
                 import pandas as pd
@@ -97,8 +102,12 @@ class StatsProcessor(BasicProcessor):
             self._finalize_numeric(num_cols, num_acc, total_rows)
         self._finalize_categorical(cat_cols, cat_acc, total_rows)
 
-        if corr_acc is not None:
-            self._write_correlation(corr_acc, num_cols)
+        if want_corr:
+            if corr_acc is not None:      # numeric-only: done in pass 2
+                self._write_corr_matrix(corr_acc.finalize(),
+                                        [c.columnName for c in num_cols], 0)
+            else:
+                self._compute_correlation(source, extractor, rate)
         if psi_col:
             self._compute_psi(source, extractor, psi_col)
         if self.params.get("rebin"):
@@ -221,17 +230,62 @@ class StatsProcessor(BasicProcessor):
             bn.binWeightedWoe = _fl(wm.bin_woe[0])
 
     # -------------------------------------------------------------- extras
-    def _write_correlation(self, corr_acc: CorrelationAccumulator,
-                           num_cols: List[ColumnConfig]) -> None:
-        corr = corr_acc.finalize()
+    def _compute_correlation(self, source: DataSource,
+                             extractor: ChunkExtractor,
+                             rate: float) -> None:
+        """Pairwise-complete Pearson over ALL candidates: numerics use raw
+        values, categoricals their bin pos-rate encoding (reference
+        ``CorrelationMapper.java:309-318``); each pair's sums count only
+        rows valid in BOTH columns (``CorrelationWritable`` adjustCount)."""
+        import pandas as pd
+        num_cols = extractor.numeric_cols
+        cat_cols = extractor.categorical_cols
+        cols = num_cols + cat_cols
+        # categorical value -> pos-rate lookup from the finished bin stats
+        rate_maps = {}
+        for cc in cat_cols:
+            cats = cc.bin_category or []
+            pr = cc.columnBinning.binPosRate or []
+            rate_maps[cc.columnName] = {str(c): float(pr[i])
+                                        for i, c in enumerate(cats)
+                                        if i < len(pr) and pr[i] is not None}
+        # offsets: pass-1 means for numerics, 0.5 for pos-rate encodings
+        num_means = [c.columnStats.mean or 0.0 for c in num_cols]
+        acc = CorrelationAccumulator(
+            n_cols=len(cols),
+            offset=np.asarray(num_means + [0.5] * len(cat_cols)))
+        miss = {m.strip().lower() for m in extractor.missing_values}
+        for ci, chunk in enumerate(source.iter_chunks()):
+            ex = extractor.extract(_sample_raw(chunk, rate, ci))
+            if ex.n == 0:
+                continue
+            x = np.zeros((ex.n, len(cols)))
+            v = np.zeros((ex.n, len(cols)), bool)
+            if num_cols:
+                x[:, :len(num_cols)] = np.nan_to_num(ex.numeric)
+                v[:, :len(num_cols)] = ex.numeric_valid
+            for j, cc in enumerate(cat_cols):
+                s = pd.Series(ex.categorical[cc.columnName],
+                              dtype=str).str.strip()
+                enc = s.map(rate_maps[cc.columnName])
+                ok = enc.notna().to_numpy() & \
+                    ~s.str.lower().isin(miss).to_numpy()
+                x[:, len(num_cols) + j] = enc.fillna(0.0).to_numpy()
+                v[:, len(num_cols) + j] = ok
+            acc.update(x, v)
+        self._write_corr_matrix(acc.finalize(),
+                                [c.columnName for c in cols], len(cat_cols))
+
+    def _write_corr_matrix(self, corr: np.ndarray, names: List[str],
+                           n_cat: int) -> None:
         path = self.paths.correlation_path
-        names = [c.columnName for c in num_cols]
         with open(path, "w") as f:
             f.write("," + ",".join(names) + "\n")
             for i, n in enumerate(names):
-                f.write(n + "," + ",".join(f"{corr[i, j]:.6f}" for j in range(len(names)))
-                        + "\n")
-        log.info("correlation matrix -> %s", path)
+                f.write(n + "," + ",".join(
+                    f"{corr[i, j]:.6f}" for j in range(len(names))) + "\n")
+        log.info("correlation matrix (%d columns incl. %d categorical) -> %s",
+                 len(names), n_cat, path)
 
     def _compute_psi(self, source: DataSource, extractor: ChunkExtractor,
                      psi_col: str) -> None:

@@ -52,6 +52,8 @@ def settings_from_params(params: Dict[str, Any], train_conf,
         seed=int(p.get("Seed", 0)),
         tmp_model_every=int(p.get("TmpModelEpochs", 0) or 0),
         checkpoint_every=int(p.get("CheckpointInterval", 25)),
+        fixed_layers=tuple(int(v) for v in p.get("FixedLayers", []) or []),
+        fixed_bias=bool(p.get("FixedBias", False)),
     )
 
 
@@ -209,7 +211,8 @@ class TrainProcessor(BasicProcessor):
                 n_members = train_w.shape[0]  # kfold mode yields numKFold
                 train_w = train_w * w[None, :]
                 valid_w = valid_w * w[None, :]
-                init_list = self._continuous_init(spec, n_members, alg)
+                init_list = self._continuous_init(spec, n_members, alg,
+                                                  settings)
 
                 member_hypers = None
                 if is_gs and len(run) > 1:
@@ -342,7 +345,8 @@ class TrainProcessor(BasicProcessor):
                     up_sample_weight=up_w,
                     seed=settings.seed)
                 stream = ShardStream(shards, ("x", "y", "w"), window_rows)
-                init_list = self._continuous_init(spec, n_members, alg)
+                init_list = self._continuous_init(spec, n_members, alg,
+                                                  settings)
                 res = train_ensemble_streamed(
                     stream, spec, settings, n_members, mask_fn,
                     init_params_list=init_list,
@@ -378,23 +382,37 @@ class TrainProcessor(BasicProcessor):
                 nn_model.save_model(path, spec, p)
         return checkpoint
 
-    def _continuous_init(self, spec, n_members: int, alg: Algorithm):
-        """Continuous training: warm-start members from existing final models
-        (reference ``NNMaster.java:331-362``; structure fit-in not yet)."""
+    def _continuous_init(self, spec, n_members: int, alg: Algorithm,
+                         settings=None):
+        """Continuous training: warm-start members from existing final
+        models; a GROWN configuration fits the saved net into the larger
+        structure (reference ``NNMaster.java:331-362,605-645``)."""
         if not self.model_config.train.isContinuous:
             return None
+        import jax
+        seed = settings.seed if settings else 0
+        initializer = settings.weight_initializer if settings else "xavier"
         ext = alg.name.lower() if alg != Algorithm.SVM else "lr"
         init = []
+        grown = 0
         for i in range(n_members):
             path = self.paths.model_path(i, ext)
             if not os.path.isfile(path):
                 return None
             old_spec, params = nn_model.load_model(path)
             if old_spec.layer_dims() != spec.layer_dims():
-                log.warning("continuous: model%d shape changed, fresh init", i)
-                return None
+                params = nn_model.fit_params_into(
+                    old_spec, params, spec,
+                    jax.random.fold_in(jax.random.PRNGKey(seed), i),
+                    initializer)
+                if params is None:
+                    log.warning("continuous: model%d does not embed in the "
+                                "new structure, fresh init", i)
+                    return None
+                grown += 1
             init.append(params)
-        log.info("continuous training: warm-started %d members", n_members)
+        log.info("continuous training: warm-started %d members%s", n_members,
+                 f" ({grown} grown via structure fit-in)" if grown else "")
         return init
 
     def _write_models(self, results, alg: Algorithm, is_gs: bool) -> None:

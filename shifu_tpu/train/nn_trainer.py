@@ -53,7 +53,9 @@ class TrainSettings:
     checkpoint_dir: str = ""           # "" disables trainer-state checkpoints
     checkpoint_every: int = 25
     resume: bool = False               # restore latest trainer state
-    opt_kwargs: Dict[str, Any] = field(default_factory=dict)
+    fixed_layers: Tuple[int, ...] = () # 1-based layer ids frozen during
+    fixed_bias: bool = False           # continuous training (NNMaster
+    opt_kwargs: Dict[str, Any] = field(default_factory=dict)  # FIXED_LAYERS)
 
 
 @dataclass
@@ -155,6 +157,20 @@ def train_ensemble(x: np.ndarray, y: np.ndarray,
     uniform = member_hypers is None
     hd = jax.device_put(hyp, sh_ens)
 
+    fixed = set(settings.fixed_layers)
+
+    def _freeze(delta):
+        """Zero deltas of fixed layers (reference FIXED_LAYERS /
+        FIXED_BIAS: frozen weights during continuous training; 1-based
+        layer ids)."""
+        if not fixed:
+            return delta
+        return [dl if (li + 1) not in fixed else
+                {"w": jnp.zeros_like(dl["w"]),
+                 "b": jnp.zeros_like(dl["b"]) if settings.fixed_bias
+                 else dl["b"]}
+                for li, dl in enumerate(delta)]
+
     def member_update(params, opt_state, xb, yb, mw, rng, h, lr_scale):
         loss, grads = jax.value_and_grad(nn_model.weighted_loss)(
             params, spec, xb, yb[:, None], mw,
@@ -164,7 +180,7 @@ def train_ensemble(x: np.ndarray, y: np.ndarray,
             rng=rng if dropout > 0 else None)
         delta, opt_state = opt.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(
-            lambda p, d: p + d * (lr_scale * h[0]), params, delta)
+            lambda p, d: p + d * (lr_scale * h[0]), params, _freeze(delta))
         return params, opt_state, loss
 
     y_axis = None if ymd is None else 0    # per-member targets vmap over B

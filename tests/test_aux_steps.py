@@ -164,3 +164,37 @@ def test_combo_ensemble(model_set):
     doc = json.load(open(os.path.join(model_set, "ComboEval.Eval1.json")))
     assert doc["areaUnderRoc"] > 0.7
     assert len(doc["memberAuc"]) == 2
+
+
+def test_analysis_fi_command(model_set):
+    """`analysis -fi model.gbt` writes a ranked .fi file (reference
+    ShifuCLI.analysisModelFi)."""
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+    from shifu_tpu.cli import main as cli_main
+
+    mcp = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.train.algorithm = "GBT"
+    mc.train.params = {"TreeNum": 5, "MaxDepth": 3, "Loss": "log"}
+    mc.save(mcp)
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    assert TrainProcessor(model_set, params={}).run() == 0
+    mp = os.path.join(model_set, "models", "model0.gbt")
+    assert cli_main(["--dir", model_set, "analysis", "-fi", mp]) == 0
+    lines = open(mp + ".fi").read().strip().split("\n")
+    assert len(lines) >= 4
+    name, v = lines[0].split("\t")
+    assert float(v) > 0
+    # names come from the model spec's feature list (txn_id is a candidate
+    # in this fixture — no meta file — and its unique-id pos-rate leak
+    # makes it the top splitter, as conftest documents)
+    from shifu_tpu.models import tree as tree_model
+    spec, _ = tree_model.load_model(mp)
+    assert name in spec.feature_names
+    assert len(lines) == len(spec.feature_names)

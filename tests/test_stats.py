@@ -214,3 +214,31 @@ def test_munropat_exact_boundaries(model_set):
     counts = np.asarray(amount.columnBinning.binCountPos[:-1]) + \
         np.asarray(amount.columnBinning.binCountNeg[:-1])
     assert counts.min() > 0.5 * counts.max() - 1
+
+
+def test_correlation_pairwise_complete_and_categorical(model_set):
+    """Correlation covers categoricals (pos-rate encoding) and each pair
+    uses only both-valid rows (adjustCount semantics, not mean imputation)."""
+    import pandas as pd
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set,
+                          params={"correlation": True}).run() == 0
+    path = os.path.join(model_set, "correlation.csv")
+    df = pd.read_csv(path, index_col=0)
+    assert "country" in df.columns and "channel" in df.columns  # categorical
+    assert "amount" in df.columns
+    # symmetric with unit diagonal
+    m = df.to_numpy()
+    np.testing.assert_allclose(np.diag(m), 1.0)
+    np.testing.assert_allclose(m, m.T, atol=1e-5)
+    # pairwise-complete against pandas on the raw csv (amount has missing)
+    mc = ModelConfig.load(os.path.join(model_set, "ModelConfig.json"))
+    raw = pd.read_csv(mc.dataSet.dataPath, sep="|")
+    expect = pd.to_numeric(raw["amount"], errors="coerce").corr(
+        pd.to_numeric(raw["age_days"], errors="coerce"))
+    np.testing.assert_allclose(df.loc["amount", "age_days"], expect,
+                               atol=1e-4)

@@ -141,3 +141,86 @@ def test_minibatch_mode():
                          TrainSettings(optimizer="ADAM", learning_rate=0.05,
                                        epochs=10, batch_size=256))
     assert res.train_errors[0] < res.history[0][0]
+
+
+def test_structure_fit_in_grows_net():
+    """Continuous-training structure fit-in: old weights embed in the
+    top-left block of the grown layer; predictions from the embedded part
+    survive (reference NNMaster.java:331-362,605-645)."""
+    import jax
+    from shifu_tpu.models import nn as nn_model
+    small = nn_model.NNModelSpec(input_dim=4, hidden_nodes=[5],
+                                 activations=["tanh"])
+    big = nn_model.NNModelSpec(input_dim=4, hidden_nodes=[9],
+                               activations=["tanh"])
+    sp = nn_model.init_params(jax.random.PRNGKey(0), small)
+    grown = nn_model.fit_params_into(small, sp, big, jax.random.PRNGKey(1))
+    assert grown is not None
+    np.testing.assert_array_equal(np.asarray(grown[0]["w"])[:, :5],
+                                  np.asarray(sp[0]["w"]))
+    np.testing.assert_array_equal(np.asarray(grown[1]["w"])[:5, :],
+                                  np.asarray(sp[1]["w"]))
+    # shrinking must refuse
+    assert nn_model.fit_params_into(big, grown, small,
+                                    jax.random.PRNGKey(2)) is None
+    # deeper target: old hidden layers copy, output layer fresh-positioned
+    deep = nn_model.NNModelSpec(input_dim=4, hidden_nodes=[5, 6],
+                                activations=["tanh", "tanh"])
+    grown2 = nn_model.fit_params_into(small, sp, deep, jax.random.PRNGKey(3))
+    assert grown2 is not None
+    np.testing.assert_array_equal(np.asarray(grown2[0]["w"]),
+                                  np.asarray(sp[0]["w"]))
+
+
+def test_fixed_layers_freeze_weights():
+    """FixedLayers: the frozen layer's weights must not move during
+    training; unfrozen layers must."""
+    import jax
+    from shifu_tpu.models import nn as nn_model
+    from shifu_tpu.train.nn_trainer import TrainSettings, train_ensemble
+    from shifu_tpu.train.sampling import member_masks
+
+    rng = np.random.default_rng(0)
+    n, d = 256, 4
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    spec = nn_model.NNModelSpec(input_dim=d, hidden_nodes=[6],
+                                activations=["tanh"], loss="log")
+    p0 = nn_model.init_params(jax.random.PRNGKey(0), spec)
+    tw, vw = member_masks(n, 1, valid_rate=0.2, sample_rate=1.0,
+                          replacement=False, targets=y, seed=0)
+    res = train_ensemble(x, y, tw, vw, spec,
+                         TrainSettings(optimizer="ADAM", learning_rate=0.05,
+                                       epochs=5, seed=0,
+                                       fixed_layers=(1,)),
+                         init_params_list=[p0])
+    trained = res.params[0]
+    np.testing.assert_array_equal(np.asarray(trained[0]["w"]),
+                                  np.asarray(p0[0]["w"]))   # frozen
+    assert not np.allclose(np.asarray(trained[0]["b"]),
+                           np.asarray(p0[0]["b"]))          # bias free
+    assert not np.allclose(np.asarray(trained[1]["w"]),
+                           np.asarray(p0[1]["w"]))          # layer 2 moves
+
+
+def test_pipeline_continuous_growth(model_set):
+    """isContinuous + larger NumHiddenNodes: train must warm-start via
+    fit-in (no 'fresh init' fallback) and still converge."""
+    from shifu_tpu.config import ModelConfig
+    from tests.test_pipeline_train import run_steps
+    run_steps(model_set, upto_train_params={
+        "NumHiddenNodes": [6], "ActivationFunc": ["tanh"],
+        "Propagation": "ADAM", "LearningRate": 0.05})
+    mcp = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.train.isContinuous = True
+    mc.train.numTrainEpochs = 10
+    mc.train.params = {"NumHiddenNodes": [12], "ActivationFunc": ["tanh"],
+                       "Propagation": "ADAM", "LearningRate": 0.05}
+    mc.save(mcp)
+    from shifu_tpu.pipeline.train import TrainProcessor
+    assert TrainProcessor(model_set, params={}).run() == 0
+    from shifu_tpu.models import nn as nn_model
+    spec, _ = nn_model.load_model(
+        os.path.join(model_set, "models", "model0.nn"))
+    assert spec.hidden_nodes == [12]
