@@ -83,6 +83,11 @@ TRAIN_PARAM_RULES: Dict[str, Rule] = {
     "ValidationTolerance": Rule("float", lo=0.0, algs=NN_FAMILY),
     "OutputActivationFunc": Rule("str", allowed=_ACTIVATIONS,
                                  algs=NN_FAMILY),
+    # TPU matmul precision: bfloat16 inputs + f32 accumulation feed the MXU
+    # at full rate (no reference analogue; Encog is f64 CPU)
+    "Precision": Rule("str", allowed=("highest", "float32", "default",
+                                      "bfloat16", "tensorfloat32"),
+                      algs=NN_FAMILY),
     "Loss": Rule("str", allowed=_LOSSES),
     "Seed": Rule("int"),
     "CheckpointInterval": Rule("int", lo=0),

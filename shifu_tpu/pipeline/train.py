@@ -54,6 +54,7 @@ def settings_from_params(params: Dict[str, Any], train_conf,
         checkpoint_every=int(p.get("CheckpointInterval", 25)),
         fixed_layers=tuple(int(v) for v in p.get("FixedLayers", []) or []),
         fixed_bias=bool(p.get("FixedBias", False)),
+        matmul_precision=str(p.get("Precision", "") or ""),
     )
 
 

@@ -55,7 +55,8 @@ class TrainSettings:
     resume: bool = False               # restore latest trainer state
     fixed_layers: Tuple[int, ...] = () # 1-based layer ids frozen during
     fixed_bias: bool = False           # continuous training (NNMaster
-    opt_kwargs: Dict[str, Any] = field(default_factory=dict)  # FIXED_LAYERS)
+    matmul_precision: str = ""         # FIXED_LAYERS); ""=backend default,
+    opt_kwargs: Dict[str, Any] = field(default_factory=dict)  # bfloat16=MXU
 
 
 @dataclass
@@ -80,6 +81,31 @@ def _unstack(tree, n: int) -> List[Any]:
 
 
 def train_ensemble(x: np.ndarray, y: np.ndarray,
+                   train_w: np.ndarray, valid_w: np.ndarray,
+                   spec: nn_model.NNModelSpec,
+                   settings: TrainSettings,
+                   init_params_list: Optional[List[Any]] = None,
+                   progress: Optional[ProgressFn] = None,
+                   checkpoint: Optional[Callable[[int, List[Any]],
+                                                 None]] = None,
+                   mesh=None,
+                   y_members: Optional[np.ndarray] = None,
+                   member_hypers: Optional[Dict[str, np.ndarray]] = None
+                   ) -> EnsembleResult:
+    """See :func:`_train_ensemble_impl`; wraps it in the configured matmul
+    precision (bfloat16 inputs with f32 accumulation feed the MXU at full
+    rate — the training math stays f32 elsewhere)."""
+    if settings.matmul_precision:
+        with jax.default_matmul_precision(settings.matmul_precision):
+            return _train_ensemble_impl(
+                x, y, train_w, valid_w, spec, settings, init_params_list,
+                progress, checkpoint, mesh, y_members, member_hypers)
+    return _train_ensemble_impl(
+        x, y, train_w, valid_w, spec, settings, init_params_list,
+        progress, checkpoint, mesh, y_members, member_hypers)
+
+
+def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
                    train_w: np.ndarray, valid_w: np.ndarray,
                    spec: nn_model.NNModelSpec,
                    settings: TrainSettings,
@@ -338,6 +364,25 @@ def _pad_all(x, y, train_w, valid_w, multiple, y_members=None):
 
 # ------------------------------------------------------------- streaming
 def train_ensemble_streamed(stream, spec: nn_model.NNModelSpec,
+                            settings: TrainSettings, bags: int, mask_fn,
+                            init_params_list: Optional[List[Any]] = None,
+                            progress: Optional[ProgressFn] = None,
+                            checkpoint: Optional[Callable[[int, List[Any]],
+                                                          None]] = None,
+                            mesh=None) -> EnsembleResult:
+    """See :func:`_train_ensemble_streamed_impl`; precision wrapper as in
+    :func:`train_ensemble`."""
+    if settings.matmul_precision:
+        with jax.default_matmul_precision(settings.matmul_precision):
+            return _train_ensemble_streamed_impl(
+                stream, spec, settings, bags, mask_fn, init_params_list,
+                progress, checkpoint, mesh)
+    return _train_ensemble_streamed_impl(
+        stream, spec, settings, bags, mask_fn, init_params_list,
+        progress, checkpoint, mesh)
+
+
+def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
                             settings: TrainSettings, bags: int, mask_fn,
                             init_params_list: Optional[List[Any]] = None,
                             progress: Optional[ProgressFn] = None,

@@ -224,3 +224,13 @@ def test_pipeline_continuous_growth(model_set):
     spec, _ = nn_model.load_model(
         os.path.join(model_set, "models", "model0.nn"))
     assert spec.hidden_nodes == [12]
+
+
+def test_precision_param_accepted(model_set):
+    """Precision=bfloat16 trains through the pipeline (MXU-rate matmuls)."""
+    from tests.test_pipeline_train import run_steps
+    run_steps(model_set, upto_train_params={
+        "NumHiddenNodes": [6], "ActivationFunc": ["tanh"],
+        "Propagation": "ADAM", "LearningRate": 0.05,
+        "Precision": "bfloat16"})
+    assert os.path.isfile(os.path.join(model_set, "models", "model0.nn"))
