@@ -109,10 +109,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         return _dispatch(argv)
     except Exception as e:
-        from .config.validator import ValidationError
-        if isinstance(e, ValidationError):
-            # config errors are user errors: message, not traceback
-            # (reference ShifuCLI prints ShifuException messages plainly)
+        from .config.errors import ShifuError
+        if isinstance(e, ShifuError):
+            # coded user errors: message, not traceback (reference ShifuCLI
+            # prints ShifuException messages plainly)
             print(str(e), file=sys.stderr)
             return 1
         raise

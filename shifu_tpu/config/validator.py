@@ -26,10 +26,17 @@ class ModelStep(enum.Enum):
     EXPORT = "EXPORT"
 
 
-class ValidationError(ValueError):
+from .errors import ErrorCode, ShifuError
+
+
+class ValidationError(ShifuError, ValueError):
+    """Coded config failure (1051) in the ShifuError hierarchy; ValueError
+    base keeps existing ``except ValueError`` callers working."""
+
     def __init__(self, problems: List[str]):
         self.problems = problems
-        super().__init__("ModelConfig validation failed:\n  - " + "\n  - ".join(problems))
+        super().__init__(ErrorCode.ERROR_MODELCONFIG_NOT_VALIDATION,
+                         "\n  - " + "\n  - ".join(problems))
 
 
 def probe(mc: ModelConfig, step: ModelStep, model_set_dir: str = ".") -> None:

@@ -21,22 +21,42 @@ import numpy as np
 import pandas as pd
 
 
+_REMOTE_SCHEMES = ("hdfs://", "s3://", "s3a://", "s3n://", "gs://",
+                   "viewfs://")
+
+
 def resolve_data_files(data_path: str) -> List[str]:
     """Expand a file / directory / glob into an ordered list of data files.
 
     Skips hidden files (``.pig_header``, ``_SUCCESS``), like the reference's
-    part-file scanners.
+    part-file scanners.  Remote schemes (the reference's HDFS/S3 source
+    types) are recognized and rejected with instructions — this runtime has
+    no cluster filesystem client; stage the data locally (gsutil/aws-cli/
+    distcp) and point dataPath at the local copy.
     """
+    from ..config.errors import ErrorCode, ShifuError
+    for scheme in _REMOTE_SCHEMES:
+        if data_path.startswith(scheme):
+            raise ShifuError(
+                ErrorCode.ERROR_REMOTE_SOURCE,
+                f"{data_path!r}: no {scheme[:-3]} client in this runtime — "
+                "stage the files locally (gsutil -m cp -r / aws s3 sync / "
+                "hdfs dfs -get) and set dataPath to the local copy")
     if os.path.isdir(data_path):
-        files = sorted(
+        files = [f for f in sorted(
             os.path.join(data_path, f) for f in os.listdir(data_path)
             if not f.startswith(".") and not f.startswith("_"))
-        return [f for f in files if os.path.isfile(f)]
+            if os.path.isfile(f)]
+        if not files:
+            raise ShifuError(ErrorCode.ERROR_INPUT_NOT_FOUND,
+                             f"{data_path} holds no data files (markers "
+                             "like _SUCCESS are skipped)")
+        return files
     if os.path.isfile(data_path):
         return [data_path]
     files = sorted(glob.glob(data_path))
     if not files:
-        raise FileNotFoundError(f"no data files at {data_path}")
+        raise ShifuError(ErrorCode.ERROR_INPUT_NOT_FOUND, data_path)
     return files
 
 
@@ -45,6 +65,11 @@ def read_header(header_path: Optional[str], header_delimiter: str,
                 data_delimiter: str = "|") -> List[str]:
     """Read column names from a header file, or fall back to the first data
     line (named or synthesized), reference ``InitModelProcessor`` behavior."""
+    if header_path and "://" in header_path:
+        from ..config.errors import ErrorCode, ShifuError
+        raise ShifuError(ErrorCode.ERROR_REMOTE_SOURCE,
+                         f"headerPath {header_path!r} — stage it locally "
+                         "alongside the data")
     if header_path and os.path.isfile(header_path):
         with _open_text(header_path) as f:
             line = f.readline().rstrip("\r\n")
@@ -94,13 +119,22 @@ class DataSource:
                  header_delimiter: str = "|"):
         self.files = resolve_data_files(data_path)
         self.delimiter = data_delimiter or "|"
+        self.parquet = all(_is_parquet(f) for f in self.files) \
+            and bool(self.files)
         if header is None:
-            header = read_header(header_path, header_delimiter or self.delimiter,
-                                 self.files, self.delimiter)
+            if self.parquet:
+                header = _parquet_schema_names(self.files[0])
+            else:
+                header = read_header(header_path,
+                                     header_delimiter or self.delimiter,
+                                     self.files, self.delimiter)
         self.header = header
 
     def iter_chunks(self, chunk_rows: int = 262144) -> Iterator[RawChunk]:
         """Yield RawChunks of up to ``chunk_rows`` rows across all files."""
+        if self.parquet:
+            yield from self._iter_parquet(chunk_rows)
+            return
         for path in self.files:
             reader = pd.read_csv(
                 path, sep=self.delimiter, engine="c", header=None,
@@ -118,8 +152,35 @@ class DataSource:
                         if df.empty:
                             continue
                 if len(df.columns) != len(self.header):
-                    raise ValueError(
-                        f"{path}: {len(df.columns)} fields vs {len(self.header)} header cols")
+                    from ..config.errors import ErrorCode, ShifuError
+                    code = ErrorCode.ERROR_EXCEED_COL \
+                        if len(df.columns) > len(self.header) \
+                        else ErrorCode.ERROR_LESS_COL
+                    raise ShifuError(code,
+                                     f"{path}: {len(df.columns)} fields vs "
+                                     f"{len(self.header)} header cols")
+                yield RawChunk(columns=self.header, data=df)
+
+    def _iter_parquet(self, chunk_rows: int) -> Iterator[RawChunk]:
+        """Columnar parquet ingest (reference ``NNParquetWorker`` /
+        ``GuaguaParquetMapReduceClient`` role): record batches stream
+        straight out of the column chunks; values render to the pipeline's
+        string plane with nulls as '' (the missing marker)."""
+        import pyarrow.parquet as pq
+        for path in self.files:
+            pf = pq.ParquetFile(path)
+            for batch in pf.iter_batches(batch_size=chunk_rows,
+                                         columns=list(self.header)):
+                # cast to string IN ARROW: int64 renders '1' regardless of
+                # nulls in the batch (to_pandas would upcast nullable ints
+                # to float64 and stringify '1.0' in some chunks only)
+                import pyarrow as pa
+                import pyarrow.compute as pc
+                cols = {}
+                for name, col in zip(batch.schema.names, batch.columns):
+                    sc = pc.cast(col, pa.string())
+                    cols[name] = pc.fill_null(sc, "").to_pandas()
+                df = pd.DataFrame(cols, columns=self.header)
                 yield RawChunk(columns=self.header, data=df)
 
     def read_all(self) -> RawChunk:
@@ -188,3 +249,12 @@ def parse_weight(values: Optional[np.ndarray], n: int) -> np.ndarray:
     w, valid = parse_numeric(values)
     w = np.where(valid & (w > 0), w, 1.0)
     return w
+
+
+def _is_parquet(path: str) -> bool:
+    return path.endswith((".parquet", ".pq"))
+
+
+def _parquet_schema_names(path: str) -> List[str]:
+    import pyarrow.parquet as pq
+    return list(pq.ParquetFile(path).schema_arrow.names)

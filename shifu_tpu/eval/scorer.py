@@ -75,7 +75,9 @@ class Scorer:
         paths = discover_model_paths(models_dir)
         models = [load_any(p) for p in paths]
         if not models:
-            raise FileNotFoundError(f"no model files in {models_dir}")
+            from ..config.errors import ErrorCode, ShifuError
+            raise ShifuError(ErrorCode.ERROR_MODEL_FILE_NOT_FOUND,
+                             f"no model files in {models_dir} — run `train`")
         return cls(models, scale)
 
     def _stacked_nn_groups(self):

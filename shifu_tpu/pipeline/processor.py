@@ -51,9 +51,13 @@ class BasicProcessor:
         self.paths.ensure_dirs()
 
     def _abs(self, p: Optional[str]) -> Optional[str]:
-        """Resolve a config-relative path against the model-set dir."""
+        """Resolve a config-relative path against the model-set dir.
+        Scheme'd URIs (hdfs://, s3://, ...) pass through untouched so the
+        data layer can reject them with the proper error code."""
         if p is None:
             return None
+        if "://" in p:
+            return p
         return p if os.path.isabs(p) else os.path.normpath(
             os.path.join(self.dir, p))
 

@@ -198,3 +198,67 @@ def test_analysis_fi_command(model_set):
     spec, _ = tree_model.load_model(mp)
     assert name in spec.feature_names
     assert len(lines) == len(spec.feature_names)
+
+
+def test_error_codes_surface():
+    """Coded errors (reference ShifuErrorCode taxonomy): remote sources,
+    missing inputs, missing models."""
+    import pytest
+    from shifu_tpu.config.errors import ErrorCode, ShifuError
+    from shifu_tpu.data.reader import resolve_data_files
+    from shifu_tpu.eval.scorer import Scorer
+
+    with pytest.raises(ShifuError) as ei:
+        resolve_data_files("hdfs://nn/data/train")
+    assert ei.value.error_code is ErrorCode.ERROR_REMOTE_SOURCE
+    assert "1007" in str(ei.value)
+    with pytest.raises(ShifuError) as ei:
+        resolve_data_files("/nonexistent/glob*")
+    assert ei.value.error_code is ErrorCode.ERROR_INPUT_NOT_FOUND
+    with pytest.raises(ShifuError) as ei:
+        Scorer.from_dir("/nonexistent/models")
+    assert ei.value.error_code is ErrorCode.ERROR_MODEL_FILE_NOT_FOUND
+
+
+def test_parquet_source_end_to_end(model_set, tmp_path):
+    """A parquet dataPath flows through the same pipeline (reference
+    NNParquetWorker/GuaguaParquetMapReduceClient role)."""
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.evaluate import EvalProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+
+    mcp = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    df = pd.read_csv(mc.dataSet.dataPath, sep="|", dtype=str,
+                     keep_default_na=False)
+    pdir = tmp_path / "pq"
+    pdir.mkdir()
+    # typed columns: parquet carries real floats + nulls
+    out = pd.DataFrame({
+        "amount": pd.to_numeric(df["amount"], errors="coerce"),
+        "velocity": pd.to_numeric(df["velocity"], errors="coerce"),
+        "age_days": pd.to_numeric(df["age_days"], errors="coerce"),
+        "country": df["country"], "channel": df["channel"],
+        "tag": df["tag"]})
+    pq.write_table(pa.Table.from_pandas(out), str(pdir / "part-0.parquet"))
+    mc.dataSet.dataPath = str(pdir)
+    mc.dataSet.weightColumnName = None
+    mc.train.numTrainEpochs = 15
+    mc.train.params = {"NumHiddenNodes": [8], "ActivationFunc": ["tanh"],
+                       "Propagation": "ADAM", "LearningRate": 0.05}
+    mc.evals[0].dataSet.dataPath = str(pdir)
+    mc.save(mcp)
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    assert TrainProcessor(model_set, params={}).run() == 0
+    assert EvalProcessor(model_set, params={"run_eval": "Eval1"}).run() == 0
+    perf = json.load(open(os.path.join(model_set, "evals", "Eval1",
+                                       "EvalPerformance.json")))
+    assert perf["areaUnderRoc"] > 0.7
