@@ -185,19 +185,21 @@ def build_initial_column_configs(header: List[str], target: Optional[str],
                                  weight_col: Optional[str] = None) -> List[ColumnConfig]:
     """``shifu init``: one ColumnConfig per header column with flags assigned
     (reference ``InitModelProcessor.java:74,89``)."""
+    # NSColumn matching throughout: a bare name in a column file matches
+    # its namespaced variants in the header and vice versa
     meta = set(meta_cols or [])
     cate = set(categorical_cols or [])
     configs = []
     for i, name in enumerate(header):
         cc = ColumnConfig(columnNum=i, columnName=name)
-        if target is not None and name == target:
+        if target is not None and ns_match(name, target):
             cc.columnFlag = ColumnFlag.Target
             cc.columnType = ColumnType.C
-        elif weight_col is not None and name == weight_col:
+        elif weight_col is not None and ns_match(name, weight_col):
             cc.columnFlag = ColumnFlag.Weight
-        elif name in meta:
+        elif ns_in(name, meta):
             cc.columnFlag = ColumnFlag.Meta
-        if name in cate:
+        if ns_in(name, cate):
             cc.columnType = ColumnType.C
         configs.append(cc)
     return configs
@@ -219,3 +221,32 @@ def target_column(configs: List[ColumnConfig]) -> Optional[ColumnConfig]:
         if c.is_target():
             return c
     return None
+
+
+# -------------------------------------------------------- namespaced names
+NS_DELIMITER = "::"          # reference Constants.NAMESPACE_DELIMITER
+
+
+def ns_simple(name: str) -> str:
+    """The simple (last) identifier of a possibly-namespaced column name —
+    reference ``column/NSColumn.java``: 'raw::a::amount' -> 'amount'."""
+    return name.rsplit(NS_DELIMITER, 1)[-1] if NS_DELIMITER in name else name
+
+
+def ns_match(a: str, b: str) -> bool:
+    """NSColumn equality: exact full-name match, or a BARE name matching a
+    namespaced variant of it (``NSColumn.equals``).  Two different
+    namespaces never match — 'a::score' names a different column than
+    'b::score'."""
+    if a == b:
+        return True
+    if (NS_DELIMITER in a) != (NS_DELIMITER in b):
+        return ns_simple(a) == ns_simple(b)
+    return False
+
+
+def ns_in(name: str, names) -> bool:
+    """``name`` matches any entry of ``names`` under NSColumn equality."""
+    if name in names:          # fast path: exact
+        return True
+    return any(ns_match(name, other) for other in names)

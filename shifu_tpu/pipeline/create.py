@@ -75,9 +75,17 @@ class InitProcessor(BasicProcessor):
                             header_path=self._abs(ds.headerPath),
                             header_delimiter=ds.headerDelimiter)
         header = source.header
-        if ds.targetColumnName and ds.targetColumnName not in header:
-            raise ValueError(f"target column {ds.targetColumnName!r} not in header "
-                             f"({len(header)} columns)")
+        if ds.targetColumnName:
+            from ..config.column_config import ns_match
+            hits = [h for h in header if ns_match(h, ds.targetColumnName)]
+            if not hits:
+                raise ValueError(
+                    f"target column {ds.targetColumnName!r} not in header "
+                    f"({len(header)} columns)")
+            if len(hits) > 1:
+                raise ValueError(
+                    f"target column {ds.targetColumnName!r} is ambiguous: "
+                    f"matches {hits} — use the full namespaced name")
         meta = _read_column_file(ds.metaColumnNameFile, self.dir)
         cate = _read_column_file(ds.categoricalColumnNameFile, self.dir)
         configs = build_initial_column_configs(
@@ -95,37 +103,58 @@ class InitProcessor(BasicProcessor):
 
     def _auto_type(self, source: DataSource, configs: List[ColumnConfig],
                    sample_rows: int = 200_000) -> None:
-        """Numeric/categorical inference from a data sample (analogue of the
-        reference's distinct-count MR auto-type job)."""
+        """Numeric/categorical inference via streaming sketches — the
+        reference's distinct-count MR job (``core/autotype/``): per-column
+        HyperLogLog distinct estimate + bounded frequent items, then the
+        ``InitModelProcessor.java:185-250`` rules: a 0/1 binary variable is
+        numeric, a column whose frequent items all parse as double is
+        numeric, everything else flips to categorical."""
+        from ..ops.sketches import FrequentItems, HyperLogLog
         seen = 0
-        parse_ok = None
-        non_empty = None
-        samples = [set() for _ in configs]
+        parse_ok = np.zeros(len(configs), np.int64)
+        non_empty = np.zeros(len(configs), np.int64)
+        hlls = [HyperLogLog() for _ in configs]
+        freqs = [FrequentItems() for _ in configs]
         for chunk in source.iter_chunks(chunk_rows=min(sample_rows, 262144)):
             df = chunk.data
-            if parse_ok is None:
-                parse_ok = np.zeros(len(configs), dtype=np.int64)
-                non_empty = np.zeros(len(configs), dtype=np.int64)
             for i, cc in enumerate(configs):
                 vals = df[cc.columnName].to_numpy()
-                floats, valid = parse_numeric(vals)
+                _, valid = parse_numeric(vals)
                 s = pd.Series(vals, dtype=str).str.strip()
                 ne = (s != "").to_numpy()
                 parse_ok[i] += int(valid.sum())
                 non_empty[i] += int(ne.sum())
-                if len(samples[i]) < 1000:
-                    samples[i].update(s[ne][:200].tolist())
+                live = s[ne].to_numpy()
+                hlls[i].update(live)
+                freqs[i].update(live)
             seen += len(df)
             if seen >= sample_rows:
                 break
-        if parse_ok is None:
+        if seen == 0:
             return
+
+        def _all_double(items: List[str]) -> bool:
+            # covers the reference's isBinaryVariable special case too: a
+            # 0/1 column's frequent items all parse, so it stays numeric
+            for v in items:
+                try:
+                    float(v)
+                except ValueError:
+                    return False
+            return bool(items)
+
         for i, cc in enumerate(configs):
+            distinct = hlls[i].estimate()
+            cc.columnStats.distinctCount = distinct
             if cc.is_target() or cc.is_meta():
                 continue
             if cc.columnType != ColumnType.N or non_empty[i] == 0:
                 continue
+            items = freqs[i].top()
             rate = parse_ok[i] / max(1, non_empty[i])
-            if rate < self.CATE_FREQ_THRESHOLD:
+            if rate >= self.CATE_FREQ_THRESHOLD and _all_double(items):
+                cc.columnType = ColumnType.N
+            else:
                 cc.columnType = ColumnType.C
-            cc.sampleValues = sorted(samples[i])[:20] if rate < 1.0 else None
+            if cc.columnType == ColumnType.C or rate < 1.0:
+                cc.sampleValues = sorted(items)[:20]

@@ -173,14 +173,16 @@ class VarSelectProcessor(BasicProcessor):
         return 0
 
     def _apply_force_files(self, vs) -> None:
-        from ..config.column_config import ColumnFlag
+        from ..config.column_config import ColumnFlag, ns_in
         force_sel = _read_names(self._abs(vs.forceSelectColumnNameFile))
         force_rem = _read_names(self._abs(vs.forceRemoveColumnNameFile))
         for c in self.column_configs:
-            if c.columnName in force_rem:
+            # NSColumn matching: bare names in force files match namespaced
+            # header columns (reference column/NSColumn.java equality)
+            if ns_in(c.columnName, force_rem):
                 c.columnFlag = ColumnFlag.ForceRemove
                 c.finalSelect = False
-            elif c.columnName in force_sel and c.is_candidate():
+            elif ns_in(c.columnName, force_sel) and c.is_candidate():
                 c.columnFlag = ColumnFlag.ForceSelect
                 c.finalSelect = True
 
@@ -354,8 +356,9 @@ def _column_blocks(names: List[str], col_nums: List[int],
     by_name = {c.columnName: c.columnNum for c in candidates}
     blocks: Dict[int, List[int]] = {}
     for i, n in enumerate(names):
-        base = n.split("::")[0] if "::" in n else n
-        # strip onehot suffix "name_k"
+        # output names are the FULL column name (namespaced names included)
+        # plus an optional onehot suffix "_k"
+        base = n
         if base not in by_name and "_" in base:
             stem = base.rsplit("_", 1)[0]
             if stem in by_name and base.rsplit("_", 1)[1].isdigit():

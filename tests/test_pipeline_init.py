@@ -2,6 +2,7 @@
 
 import os
 
+import numpy as np
 import pytest
 
 from shifu_tpu.config import ColumnFlag, ColumnType, ModelConfig, load_column_configs
@@ -42,3 +43,111 @@ def test_reader_and_target_parse(fraud_csv):
     amt, valid = parse_numeric(chunk.col("amount"), missing_values=["", "?"])
     assert valid.sum() < len(valid)  # some missing
     assert amt[valid].min() >= 0
+
+
+def test_hll_estimate_accuracy():
+    """HLL distinct estimate within ~5% at p=12 (reference HyperLogLogPlus
+    role in AutoTypeDistinctCountMapper)."""
+    from shifu_tpu.ops.sketches import HyperLogLog
+    rng = np.random.default_rng(0)
+    for true_n in (10, 1000, 50_000):
+        h = HyperLogLog()
+        vals = np.array([f"v{i}" for i in range(true_n)])
+        # feed in shuffled chunks with repeats
+        for _ in range(3):
+            h.update(rng.permutation(vals))
+        est = h.estimate()
+        assert abs(est - true_n) / true_n < 0.05, (true_n, est)
+
+
+def test_auto_type_rules(tmp_path):
+    """InitModelProcessor.java:185-250 rules: 0/1 binary stays numeric,
+    low-cardinality strings go categorical, distinctCount recorded."""
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.config.column_config import load_column_configs
+    from shifu_tpu.pipeline.create import InitProcessor, create_new_model
+
+    rng = np.random.default_rng(1)
+    n = 3000
+    rows = ["flag|code|amount|tag"]
+    for i in range(n):
+        rows.append(f"{rng.integers(0, 2)}|"
+                    f"{rng.choice(['A1', 'B2', 'C3'])}|"
+                    f"{rng.normal():.4f}|"
+                    f"{'bad' if rng.random() < 0.2 else 'good'}")
+    csv = tmp_path / "d.csv"
+    csv.write_text("\n".join(rows) + "\n")
+    mdir = create_new_model("att", base_dir=str(tmp_path))
+    mcp = os.path.join(mdir, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.dataSet.dataPath = str(csv)
+    mc.dataSet.dataDelimiter = "|"
+    mc.dataSet.targetColumnName = "tag"
+    mc.dataSet.posTags, mc.dataSet.negTags = ["bad"], ["good"]
+    mc.save(mcp)
+    assert InitProcessor(mdir).run() == 0
+    by_name = {c.columnName: c
+               for c in load_column_configs(os.path.join(
+                   mdir, "ColumnConfig.json"))}
+    assert not by_name["flag"].is_categorical()     # 0/1 binary -> numeric
+    assert not by_name["amount"].is_categorical()   # doubles -> numeric
+    assert by_name["code"].is_categorical()         # strings -> categorical
+    assert by_name["flag"].columnStats.distinctCount == 2
+    assert by_name["code"].columnStats.distinctCount == 3
+    assert by_name["amount"].columnStats.distinctCount > 2000
+
+
+def test_nscolumn_matching(tmp_path):
+    """Namespaced headers (raw::amount) match bare names in meta files and
+    target config (reference column/NSColumn.java equality)."""
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.pipeline.create import InitProcessor, create_new_model
+
+    rows = ["id|raw::amount|raw::kind|tag",
+            "r1|10.5|A|bad", "r2|3.2|B|good", "r3|7.7|A|good"]
+    csv = tmp_path / "ns.csv"
+    csv.write_text("\n".join(rows) + "\n")
+    meta = tmp_path / "meta.names"
+    meta.write_text("id\n")
+    cate = tmp_path / "cate.names"
+    cate.write_text("kind\n")                     # bare name, ns'd header
+    mdir = create_new_model("nst", base_dir=str(tmp_path))
+    mcp = os.path.join(mdir, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.dataSet.dataPath = str(csv)
+    mc.dataSet.dataDelimiter = "|"
+    mc.dataSet.targetColumnName = "tag"
+    mc.dataSet.posTags, mc.dataSet.negTags = ["bad"], ["good"]
+    mc.dataSet.metaColumnNameFile = str(meta)
+    mc.dataSet.categoricalColumnNameFile = str(cate)
+    mc.save(mcp)
+    assert InitProcessor(mdir).run() == 0
+    by_name = {c.columnName: c for c in load_column_configs(
+        os.path.join(mdir, "ColumnConfig.json"))}
+    assert by_name["raw::kind"].is_categorical()
+    assert by_name["id"].is_meta()
+    assert by_name["tag"].is_target()
+
+
+def test_ns_match_semantics():
+    from shifu_tpu.config.column_config import ns_match
+    assert ns_match("amount", "raw::amount")       # bare vs namespaced
+    assert ns_match("raw::amount", "amount")
+    assert ns_match("a::b::amount", "amount")
+    assert not ns_match("a::score", "b::score")    # different namespaces
+    assert not ns_match("amount", "velocity")
+
+
+def test_frequent_items_order_independent():
+    """MG merge: a globally-frequent sentinel survives regardless of chunk
+    order, even through high-cardinality churn."""
+    from shifu_tpu.ops.sketches import FrequentItems
+    rng = np.random.default_rng(0)
+    noise = [f"u{i}" for i in range(40_000)]
+    sentinel = ["MISSING"] * 4000
+    for order in (noise + sentinel, sentinel + noise):
+        f = FrequentItems(cap=1024)
+        arr = np.array(order)
+        for s in range(0, len(arr), 8192):
+            f.update(arr[s:s + 8192])
+        assert "MISSING" in f.top(), order[:2]
