@@ -30,6 +30,10 @@ class ErrorCode(enum.Enum):
     ERROR_UNSUPPORT_ALG = (1052, "Unsupported algorithm")
     ERROR_GRIDCONFIG_NOT_VALIDATION = (
         1055, "The grid search config did not pass the validation")
+    # rebuild-specific: ordered-pipeline precondition (the reference's
+    # cluster steps fail inside Pig/Hadoop instead)
+    ERROR_STEP_PRECONDITION = (
+        1061, "A prerequisite pipeline step has not run")
     # --- data shape (1150s)
     ERROR_EXCEED_COL = (1151, "Input data has more fields than the header")
     ERROR_LESS_COL = (1152, "Input data has fewer fields than the header")

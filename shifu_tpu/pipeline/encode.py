@@ -45,6 +45,10 @@ def leaf_indices(trees, bins: np.ndarray) -> np.ndarray:
 class EncodeProcessor(BasicProcessor):
     step = ModelStep.EVAL
 
+    @property
+    def profile_name(self) -> str:
+        return "ENCODE"
+
     def process(self) -> int:
         mc = self.model_config
         model_path = self.paths.model_path(0, None)

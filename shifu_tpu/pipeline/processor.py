@@ -8,6 +8,7 @@ ColumnConfig save) in ``BasicProcessor`` (reference
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import shutil
@@ -25,6 +26,12 @@ class BasicProcessor:
     """Shared step setup/teardown (reference ``BasicModelProcessor.java``)."""
 
     step: ModelStep = ModelStep.NEW
+
+    @property
+    def profile_name(self) -> str:
+        """profile.json key — override when several processors share a
+        ModelStep (encode runs under EVAL validation rules)."""
+        return self.step.name
 
     def __init__(self, model_set_dir: str = ".", params: Optional[dict] = None):
         self.dir = os.path.abspath(model_set_dir)
@@ -49,6 +56,32 @@ class BasicProcessor:
             raise FileNotFoundError(
                 f"{cc_path} not found — run `shifu-tpu init` first")
         self.paths.ensure_dirs()
+        self._check_step_preconditions()
+
+    def _check_step_preconditions(self) -> None:
+        """Ordered-pipeline guard: running a step before its inputs exist
+        fails with a coded hint instead of a raw traceback deep in the
+        step (stats -> norm -> train dependency chain)."""
+        from ..config.errors import ErrorCode, ShifuError
+        s = self.step
+        if s in (ModelStep.NORMALIZE, ModelStep.VARSELECT, ModelStep.TRAIN):
+            cand = [c for c in self.column_configs or [] if c.is_candidate()]
+            if cand and not any((c.num_bins() or 0) > 0
+                                or c.columnStats.mean is not None
+                                for c in cand):
+                raise ShifuError(
+                    ErrorCode.ERROR_STEP_PRECONDITION,
+                    f"`{s.value.lower()}` needs column statistics — run "
+                    "`stats` first")
+        if s == ModelStep.TRAIN:
+            if not (os.path.isfile(os.path.join(self.paths.norm_dir,
+                                                "schema.json"))
+                    or os.path.isfile(os.path.join(self.paths.clean_dir,
+                                                   "schema.json"))):
+                raise ShifuError(
+                    ErrorCode.ERROR_STEP_PRECONDITION,
+                    "`train` needs the materialized data plane — run "
+                    "`norm` first")
 
     def _abs(self, p: Optional[str]) -> Optional[str]:
         """Resolve a config-relative path against the model-set dir.
@@ -72,8 +105,42 @@ class BasicProcessor:
         log.info("step %s start", self.step.name)
         self.setup()
         code = self.process()
-        log.info("step %s done in %.2fs", self.step.name, time.time() - t0)
+        total = time.time() - t0
+        log.info("step %s done in %.2fs", self.step.name, total)
+        self._write_profile(total)
         return code
+
+    # ------------------------------------------------------------ profiling
+    def phase(self, name: str):
+        """Time a named phase inside the step (reference aux tracing role,
+        SURVEY §5): accumulates into ``tmp/profile.json`` per step."""
+        return _PhaseSpan(self._phases, name)
+
+    @property
+    def _phases(self) -> dict:
+        if not hasattr(self, "_phase_spans"):
+            self._phase_spans = {}
+        return self._phase_spans
+
+    def _write_profile(self, total_s: float) -> None:
+        try:
+            path = os.path.join(self.paths.tmp_dir, "profile.json")
+            doc = {}
+            if os.path.isfile(path):
+                try:
+                    with open(path) as f:
+                        doc = json.load(f)
+                except (json.JSONDecodeError, OSError):
+                    doc = {}            # self-heal a truncated file
+            doc[self.profile_name] = {
+                "total_s": round(total_s, 3),
+                "phases_s": {k: round(v, 3)
+                             for k, v in self._phases.items()}}
+            os.makedirs(self.paths.tmp_dir, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(doc, f, indent=2)
+        except Exception:                       # profiling must never fail
+            log.debug("profile write failed", exc_info=True)
 
     def process(self) -> int:  # pragma: no cover - abstract
         raise NotImplementedError
@@ -85,3 +152,18 @@ class BasicProcessor:
             bdir = self.paths.backup_dir
             os.makedirs(bdir, exist_ok=True)
             shutil.copy2(path, os.path.join(bdir, os.path.basename(path)))
+
+
+class _PhaseSpan:
+    def __init__(self, store: dict, name: str):
+        self.store = store
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.store[self.name] = self.store.get(self.name, 0.0) \
+            + (time.perf_counter() - self.t0)
+        return False

@@ -53,13 +53,14 @@ class StatsProcessor(BasicProcessor):
 
         # ---------------- pass 1: moments/min/max (numeric)
         total_rows = 0
-        for ci, chunk in enumerate(source.iter_chunks()):
-            ex = extractor.extract(_sample_raw(chunk, rate, ci))
-            if ex.n == 0:
-                continue
-            total_rows += ex.n
-            if num_cols:
-                num_acc.update_moments(ex.numeric, ex.numeric_valid)
+        with self.phase("pass1_moments"):
+            for ci, chunk in enumerate(source.iter_chunks()):
+                ex = extractor.extract(_sample_raw(chunk, rate, ci))
+                if ex.n == 0:
+                    continue
+                total_rows += ex.n
+                if num_cols:
+                    num_acc.update_moments(ex.numeric, ex.numeric_valid)
         if total_rows == 0:
             raise RuntimeError("stats: dataset is empty after filtering")
         if num_cols:
@@ -74,42 +75,48 @@ class StatsProcessor(BasicProcessor):
             corr_acc = CorrelationAccumulator(
                 n_cols=len(num_cols), offset=num_acc.moments["mean"])
         psi_units: Dict[str, Dict[str, np.ndarray]] = {}
-        for ci, chunk in enumerate(source.iter_chunks()):
-            ex = extractor.extract(_sample_raw(chunk, rate, ci),
-                                   keep_raw=psi_col is not None)
-            if ex.n == 0:
-                continue
-            # multi-class: bin pos/neg stats binarize as class 0 vs rest so
-            # KS/IV/WOE stay defined (class ids are ordinal positions only)
-            tgt = (ex.target > 0).astype(ex.target.dtype) \
-                if extractor.multiclass else ex.target
-            if num_cols:
-                num_acc.update_histogram(ex.numeric, ex.numeric_valid,
-                                         tgt, ex.weight)
-                if corr_acc is not None:
-                    corr_acc.update(np.nan_to_num(ex.numeric),
-                                    ex.numeric_valid)
-            for cc in cat_cols:
-                vals = ex.categorical[cc.columnName]
-                import pandas as pd
-                s = pd.Series(vals, dtype=str).str.strip()
-                valid = (~s.str.lower().isin(
-                    {m.strip().lower() for m in extractor.missing_values})).to_numpy()
-                cat_acc.update(cc.columnName, vals, valid, tgt, ex.weight)
-
+        with self.phase("pass2_histograms"):
+            for ci, chunk in enumerate(source.iter_chunks()):
+                ex = extractor.extract(_sample_raw(chunk, rate, ci),
+                                       keep_raw=psi_col is not None)
+                if ex.n == 0:
+                    continue
+                # multi-class: bin pos/neg stats binarize as class 0 vs rest
+                # so KS/IV/WOE stay defined (class ids are ordinal only)
+                tgt = (ex.target > 0).astype(ex.target.dtype) \
+                    if extractor.multiclass else ex.target
+                if num_cols:
+                    num_acc.update_histogram(ex.numeric, ex.numeric_valid,
+                                             tgt, ex.weight)
+                    if corr_acc is not None:
+                        corr_acc.update(np.nan_to_num(ex.numeric),
+                                        ex.numeric_valid)
+                for cc in cat_cols:
+                    vals = ex.categorical[cc.columnName]
+                    import pandas as pd
+                    s = pd.Series(vals, dtype=str).str.strip()
+                    valid = (~s.str.lower().isin(
+                        {m.strip().lower()
+                         for m in extractor.missing_values})).to_numpy()
+                    cat_acc.update(cc.columnName, vals, valid, tgt,
+                                   ex.weight)
         # ---------------- finalize numeric columns
-        if num_cols:
-            self._finalize_numeric(num_cols, num_acc, total_rows)
-        self._finalize_categorical(cat_cols, cat_acc, total_rows)
+        with self.phase("finalize"):
+            if num_cols:
+                self._finalize_numeric(num_cols, num_acc, total_rows)
+            self._finalize_categorical(cat_cols, cat_acc, total_rows)
 
         if want_corr:
-            if corr_acc is not None:      # numeric-only: done in pass 2
-                self._write_corr_matrix(corr_acc.finalize(),
-                                        [c.columnName for c in num_cols], 0)
-            else:
-                self._compute_correlation(source, extractor, rate)
+            with self.phase("correlation"):
+                if corr_acc is not None:  # numeric-only: done in pass 2
+                    self._write_corr_matrix(
+                        corr_acc.finalize(),
+                        [c.columnName for c in num_cols], 0)
+                else:
+                    self._compute_correlation(source, extractor, rate)
         if psi_col:
-            self._compute_psi(source, extractor, psi_col)
+            with self.phase("psi"):
+                self._compute_psi(source, extractor, psi_col)
         if self.params.get("rebin"):
             self._dynamic_rebin()
 

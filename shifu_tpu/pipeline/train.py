@@ -127,7 +127,8 @@ class TrainProcessor(BasicProcessor):
                             "training in-RAM")
             else:
                 return self._train_nn_streamed(alg, shards, n_classes=K)
-        data = shards.load_all()
+        with self.phase("load_data"):
+            data = shards.load_all()
         x, y, w = data["x"], data["y"], data["w"]
         if self.params.get("shuffle"):
             # reference `train -shuffle` re-randomizes row order before
@@ -240,17 +241,20 @@ class TrainProcessor(BasicProcessor):
                         "l1": np.array([s.l1 for s in tsl]),
                         "dropout": np.array([s.dropout_rate for s in tsl]),
                     }
-                res = train_ensemble(x, y, train_w, valid_w, spec, settings,
-                                     init_params_list=init_list,
-                                     progress=self._progress_fn(pf, run),
-                                     checkpoint=self._checkpoint_fn(spec, alg),
-                                     y_members=y_members,
-                                     member_hypers=member_hypers)
+                with self.phase("train"):
+                    res = train_ensemble(
+                        x, y, train_w, valid_w, spec, settings,
+                        init_params_list=init_list,
+                        progress=self._progress_fn(pf, run),
+                        checkpoint=self._checkpoint_fn(spec, alg),
+                        y_members=y_members,
+                        member_hypers=member_hypers)
                 results.append((run, spec, res,
                                 [trials[t] for t in run] if is_gs
                                 else run_params))
 
-        self._write_models(results, alg, is_gs)
+        with self.phase("save_models"):
+            self._write_models(results, alg, is_gs)
         log.info("train done in %.1fs", time.time() - t0)
         return 0
 

@@ -110,3 +110,46 @@ def test_nn_param_consistency_validated():
     mc.train.params["NumHiddenLayers"] = 3  # mismatch with 2 nodes/act lists
     with pytest.raises(ValidationError):
         probe(mc, ModelStep.TRAIN)
+
+
+def test_out_of_order_steps_fail_with_coded_hint(model_set):
+    """norm/train before stats/norm fail with ERROR_STEP_PRECONDITION and a
+    'run X first' hint, not a deep traceback (verify-skill gotcha)."""
+    import pytest
+    from shifu_tpu.config.errors import ErrorCode, ShifuError
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+
+    assert InitProcessor(model_set).run() == 0
+    with pytest.raises(ShifuError) as ei:
+        NormalizeProcessor(model_set, params={}).run()
+    assert ei.value.error_code is ErrorCode.ERROR_STEP_PRECONDITION
+    assert "stats" in str(ei.value)
+    assert StatsProcessor(model_set, params={}).run() == 0
+    with pytest.raises(ShifuError) as ei:
+        TrainProcessor(model_set, params={}).run()
+    assert "norm" in str(ei.value)
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    assert TrainProcessor(model_set, params={}).run() == 0
+
+
+def test_profile_json_written(model_set):
+    """Per-step wall-clock + per-phase timers land in tmp/profile.json
+    (SURVEY §5 tracing/profiling)."""
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    assert TrainProcessor(model_set, params={}).run() == 0
+    prof = json.load(open(os.path.join(model_set, "tmp", "profile.json")))
+    assert prof["STATS"]["total_s"] > 0
+    assert "pass1_moments" in prof["STATS"]["phases_s"]
+    assert "pass2_histograms" in prof["STATS"]["phases_s"]
+    assert "train" in prof["TRAIN"]["phases_s"]
+    assert "load_data" in prof["TRAIN"]["phases_s"]
