@@ -125,6 +125,11 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
 
+    # multi-host bootstrap: no-op unless the launcher set SHIFU_COORDINATOR
+    # (one process per host; jax.devices() then spans the fleet)
+    from .parallel.mesh import initialize_distributed
+    initialize_distributed()
+
     cmd = args.command
     if cmd == "new":
         from .pipeline.create import create_new_model

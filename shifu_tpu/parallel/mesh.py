@@ -11,6 +11,10 @@ whole stack collapses into SPMD under ``jax.jit`` over a ``Mesh``:
 - the ``ensemble`` axis shards bagging/grid-search members (the reference's
   N parallel YARN jobs, ``TrainModelProcessor.java:684-945``) — members train
   simultaneously as one vmapped program, sharded across devices.
+- multi-host: after :func:`initialize_distributed`, ``device_mesh()`` spans
+  the fleet (jax.devices() is global, host-major), so the data axis keeps a
+  host's rows on its own ICI domain and only psum combines cross DCN; with
+  n_ensemble = n_hosts each member pins to one host.
 
 Quorum/straggler logic (97% + 2s timeout) has no analogue: the mesh is
 synchronous.  Fail-over maps to checkpoint/restore instead.
@@ -46,3 +50,44 @@ def pad_rows(n: int, multiple: int) -> int:
     """Rows to add so n divides the data-axis extent."""
     r = n % multiple
     return 0 if r == 0 else multiple - r
+
+
+# ------------------------------------------------------------- multi-host
+def initialize_distributed(coordinator: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap — the reference's Guagua/ZooKeeper coordination
+    role (``GuaguaConstants`` zk wiring, ``TrainModelProcessor.java``
+    cluster submit): after this, ``jax.devices()`` is the GLOBAL device set
+    across hosts, a ``device_mesh`` spans them, and XLA routes collectives
+    over ICI within a host and DCN across hosts.
+
+    Args default from SHIFU_COORDINATOR / SHIFU_NUM_PROCESSES /
+    SHIFU_PROCESS_ID (set by the launcher, one process per host).
+    """
+    import os
+
+    coordinator = coordinator or os.environ.get("SHIFU_COORDINATOR")
+    if coordinator is None:
+        return      # single-host run: stays a true no-op (no jax import)
+    import jax
+    if num_processes is None:
+        num_processes = int(os.environ["SHIFU_NUM_PROCESSES"])
+    if process_id is None:
+        process_id = int(os.environ["SHIFU_PROCESS_ID"])
+    jax.distributed.initialize(coordinator, num_processes=num_processes,
+                               process_id=process_id)
+
+
+def shard_rows_from_local(mesh, local_rows: "np.ndarray"):
+    """Build the GLOBAL row-sharded array from THIS host's row block — the
+    multi-host data feed (each host reads its own shard files, reference
+    worker-split role of ``ShifuInputFormat``).  Rows concatenate in
+    process order; the per-host block must divide the host's share of the
+    data axis."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = P("data") if local_rows.ndim == 1 else P("data", None)
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), local_rows)

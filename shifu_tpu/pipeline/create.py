@@ -58,15 +58,12 @@ def _read_column_file(path: Optional[str], base_dir: str) -> List[str]:
 
 class InitProcessor(BasicProcessor):
     step = ModelStep.INIT
+    require_columns = False
 
     # Columns whose distinct count / numeric-parse rate crosses these are
     # auto-typed categorical, standing in for the reference's
     # CountAndFrequentItemsWritable + 0.1*count heuristics (core/autotype).
     CATE_FREQ_THRESHOLD = 0.95
-
-    def run(self) -> int:
-        self.setup(require_columns=False)
-        return self.process()
 
     def process(self) -> int:
         mc = self.model_config

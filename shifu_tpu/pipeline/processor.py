@@ -26,6 +26,7 @@ class BasicProcessor:
     """Shared step setup/teardown (reference ``BasicModelProcessor.java``)."""
 
     step: ModelStep = ModelStep.NEW
+    require_columns: bool = True       # INIT creates ColumnConfig itself
 
     @property
     def profile_name(self) -> str:
@@ -41,7 +42,9 @@ class BasicProcessor:
         self.paths: Optional[PathFinder] = None
 
     # ------------------------------------------------------------ lifecycle
-    def setup(self, require_columns: bool = True) -> None:
+    def setup(self, require_columns: Optional[bool] = None) -> None:
+        if require_columns is None:
+            require_columns = self.require_columns
         mc_path = os.path.join(self.dir, "ModelConfig.json")
         if not os.path.isfile(mc_path):
             raise FileNotFoundError(

@@ -1,0 +1,64 @@
+"""Worker for the 2-process multi-host test: each process plays one host
+(4 virtual CPU devices), the mesh spans both, and a jitted global reduction
+crosses the simulated DCN."""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# force EXACTLY 4 local devices, replacing any inherited count (pytest's
+# conftest exports 8)
+flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+         if "xla_force_host_platform_device_count" not in f]
+flags.append("--xla_force_host_platform_device_count=4")
+os.environ["XLA_FLAGS"] = " ".join(flags)
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+for _name in [n for n in list(getattr(_xb, "_backend_factories", {}))
+              if n != "cpu"]:
+    _xb._backend_factories.pop(_name, None)
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from shifu_tpu.parallel.mesh import (device_mesh,  # noqa: E402
+                                     initialize_distributed,
+                                     shard_rows_from_local)
+
+os.environ["SHIFU_COORDINATOR"] = f"localhost:{port}"
+os.environ["SHIFU_NUM_PROCESSES"] = str(nproc)
+os.environ["SHIFU_PROCESS_ID"] = str(pid)
+initialize_distributed()
+
+assert jax.process_count() == nproc
+assert len(jax.devices()) == 4 * nproc          # global device set
+
+mesh = device_mesh(n_ensemble=1)
+assert mesh.shape == {"ensemble": 1, "data": 4 * nproc}, mesh.shape
+
+# each "host" contributes its own row block (its shard files)
+local = (np.arange(16, dtype=np.float32).reshape(4, 4) + 100 * pid)
+garr = shard_rows_from_local(mesh, local)
+assert garr.shape == (4 * nproc, 4), garr.shape
+
+# a global weighted reduction: the cross-host part of a gradient psum
+total = float(jax.jit(lambda a: (a * 2.0).sum())(garr))
+expected = 2.0 * sum(float((np.arange(16) + 100 * p).sum())
+                     for p in range(nproc))
+assert total == expected, (total, expected)
+
+# ensemble axis across hosts: members pin to one host each, data stays on
+# the host's own ICI domain
+mesh2 = device_mesh(n_ensemble=nproc)
+assert mesh2.shape == {"ensemble": nproc, "data": 4}
+row = [d.process_index for d in mesh2.devices[pid]]
+assert row == [pid] * 4, row                     # one host per member row
+
+print(f"proc {pid}: MULTIHOST-OK total={total}", flush=True)
