@@ -90,6 +90,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("combo", help="multi-algorithm ensemble")
     sp.add_argument("action", choices=["new", "init", "run", "eval"])
+    sp.add_argument("-resume", dest="resume", action="store_true",
+                    help="skip members already trained")
     sp.add_argument("-alg", dest="algs", default=None,
                     help="colon-separated list, e.g. NN:GBT:LR")
 
@@ -170,7 +172,8 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
         return EncodeProcessor(args.dir, params=vars(args)).run()
     if cmd == "combo":
         from .pipeline.combo import run_combo
-        return run_combo(args.dir, args.action, args.algs)
+        return run_combo(args.dir, args.action, args.algs,
+                         resume=getattr(args, "resume", False))
     if cmd == "convert":
         from .pipeline.convert import run_convert
         return run_convert(args.dir, vars(args))

@@ -321,23 +321,3 @@ class ModelConfig:
                                dataSet=RawSourceData(dataPath=os.path.join(".", name, "evaldata")))]
         return mc
 
-
-def load_grid_config_params(train: ModelTrainConf, base_dir: str = ".") -> Dict[str, Any]:
-    """Load ``gridConfigFile`` (one ``key:json-value`` per line) into a params dict."""
-    params: Dict[str, Any] = {}
-    if not train.gridConfigFile:
-        return params
-    path = train.gridConfigFile
-    if not os.path.isabs(path):
-        path = os.path.join(base_dir, path)
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            key, _, val = line.partition(":")
-            try:
-                params[key.strip()] = json.loads(val.strip())
-            except json.JSONDecodeError:
-                params[key.strip()] = val.strip()
-    return params

@@ -20,7 +20,8 @@ log = logging.getLogger(__name__)
 COMBO_FILE = "combo.json"
 
 
-def run_combo(model_set_dir: str, action: str, algs: Optional[str]) -> int:
+def run_combo(model_set_dir: str, action: str, algs: Optional[str],
+              resume: bool = False) -> int:
     d = os.path.abspath(model_set_dir)
     if action == "new":
         if not algs:
@@ -44,7 +45,7 @@ def run_combo(model_set_dir: str, action: str, algs: Optional[str]) -> int:
         rc = _init_members(d, members)
         if rc:
             return rc
-        return _train_members(d, members)
+        return _train_members(d, members, resume=resume)
     if action == "eval":
         return _eval_members(d, members)
     log.error("unknown combo action %s", action)
@@ -82,6 +83,12 @@ def _init_members(d: str, members: List[str]) -> int:
             k: v for k, v in (mc.train.params or {}).items()
             if (r := TRAIN_PARAM_RULES.get(k)) is not None
             and (r.algs is None or alg in r.algs)}
+        if mc.train.gridConfigFile and \
+                not os.path.isabs(mc.train.gridConfigFile):
+            # member configs resolve paths against THEIR dir — pin the
+            # parent-relative grid file to the parent
+            mc.train.gridConfigFile = os.path.join(
+                d, mc.train.gridConfigFile)
         mc.save(os.path.join(md, "ModelConfig.json"))
         shutil.copy(os.path.join(d, "ColumnConfig.json"),
                     os.path.join(md, "ColumnConfig.json"))
@@ -89,11 +96,18 @@ def _init_members(d: str, members: List[str]) -> int:
     return 0
 
 
-def _train_members(d: str, members: List[str]) -> int:
+def _train_members(d: str, members: List[str], resume: bool = False) -> int:
+    """``combo run [-resume]``: -resume skips members whose model file is
+    already on disk (reference ComboModelProcessor -resume)."""
+    from ..eval.scorer import discover_model_paths
     from .norm import NormalizeProcessor
     from .train import TrainProcessor
     for i, alg in enumerate(members):
         md = _member_dir(d, alg, i)
+        if resume and discover_model_paths(os.path.join(md, "models")):
+            log.info("combo: member %d (%s) already trained, skipping "
+                     "(-resume)", i, alg)
+            continue
         log.info("combo: training member %d (%s)", i, alg)
         rc = NormalizeProcessor(md, params={}).run()
         if rc == 0:

@@ -113,6 +113,30 @@ class TrainProcessor(BasicProcessor):
             return run_wdl_training(self)
         raise ValueError(f"unsupported algorithm {alg}")
 
+
+    def _trials(self, params: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Grid trials: explicit per-line file (train.gridConfigFile,
+        validated per trial via the meta schema) or cartesian expansion of
+        list-valued params; file trials inherit unlisted keys from
+        train#params."""
+        gcf = self.model_config.train.gridConfigFile
+        if gcf:
+            file_trials = grid_search.load_grid_config(self._abs(gcf))
+            trials = [{**params, **t} for t in file_trials]
+            from ..config.meta import validate_train_params
+            problems = []
+            for i, t in enumerate(trials):
+                for p in validate_train_params(
+                        t, self.model_config.train.algorithm):
+                    problems.append(f"gridConfigFile trial {i + 1}: {p}")
+            if problems:
+                from ..config.validator import ValidationError
+                raise ValidationError(problems)
+            return trials
+        if grid_search.is_grid_search(params):
+            return grid_search.expand(params)
+        return [params]
+
     # ------------------------------------------------------------ NN / LR
     def _train_nn_family(self, alg: Algorithm) -> int:
         from ..config.model_config import MultipleClassification
@@ -142,8 +166,7 @@ class TrainProcessor(BasicProcessor):
         log.info("train %s: %d rows x %d features", alg.name, n, d)
 
         params = dict(mc.train.params or {})
-        trials = grid_search.expand(params) if grid_search.is_grid_search(params) \
-            else [params]
+        trials = self._trials(params)
         is_gs = len(trials) > 1
         kfold = mc.train.numKFold if mc.train.isCrossValidation else -1
         bags = 1 if is_gs else max(1, mc.train.baggingNum)
@@ -294,8 +317,7 @@ class TrainProcessor(BasicProcessor):
         n_rows = schema.get("numRows") or shards.num_rows
 
         params = dict(mc.train.params or {})
-        trials = grid_search.expand(params) if grid_search.is_grid_search(params) \
-            else [params]
+        trials = self._trials(params)
         is_gs = len(trials) > 1
         kfold = mc.train.numKFold if mc.train.isCrossValidation else -1
         bags = 1 if is_gs else max(1, mc.train.baggingNum)

@@ -863,6 +863,14 @@ def run_tree_training(proc) -> int:
     # would make eval-time indices overflow the left_mask
     n_bins = max((by_num[cn].num_bins() + 1 for cn in col_nums if cn in by_num),
                  default=2)
+    from ..train import grid_search
+    if mc.train.gridConfigFile or grid_search.is_grid_search(
+            mc.train.params or {}):
+        from ..config.validator import ValidationError
+        raise ValidationError(
+            ["grid search (list-valued train#params / gridConfigFile) is "
+             "not supported for tree algorithms yet — train trials "
+             "individually or use the NN family"])
     settings = settings_from_params(mc.train.params, mc.train, alg)
     settings.resume = bool(proc.params.get("resume"))
     settings.checkpoint_dir = proc.paths.checkpoint_dir

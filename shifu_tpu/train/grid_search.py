@@ -1,10 +1,11 @@
 """Grid search / hyper-param fan-out (reference ``core/dtrain/gs/GridSearch.java:62``).
 
 List-valued entries in ``train#params`` expand cartesian-product style into
-flattened trial param dicts; a ``gridConfigFile`` contributes extra axes.  In
-the reference each combo becomes its own Guagua YARN job; here each trial is
-one ensemble-trainer run (a future optimization could vmap same-shape trials
-together, but per-trial settings feed the optimizer closure today).
+flattened trial param dicts; alternatively ``train.gridConfigFile`` lists one
+EXPLICIT trial per line (``key:value;key:value``, :func:`load_grid_config`).
+In the reference each combo becomes its own Guagua YARN job; here same-shape
+trials stack as members of ONE vmapped ensemble run
+(:func:`stackable_groups` + per-member hyper arrays in ``train_ensemble``).
 """
 
 from __future__ import annotations
@@ -77,3 +78,33 @@ def stackable_groups(trials: List[Dict[str, Any]]) -> List[List[int]]:
                           if k not in stackable}, default=str)
         groups.setdefault(key, []).append(i)
     return list(groups.values())
+
+
+def load_grid_config(path: str) -> List[Dict[str, Any]]:
+    """Explicit trial list from ``train.gridConfigFile`` — one trial per
+    line, ``key:value;key:value`` (reference ``GridSearch.java:119-153``);
+    values parse as JSON when possible (lists/numbers), else strings."""
+    import json
+    trials: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            t: Dict[str, Any] = {}
+            for ele in line.split(";"):
+                if not ele.strip():
+                    continue
+                key, sep, val = ele.partition(":")
+                if not sep:
+                    raise ValueError(
+                        f"{path}:{lineno}: expected <name>:<value> "
+                        f"elements joined by ';', got {ele!r}")
+                val = val.strip()
+                try:
+                    t[key.strip()] = json.loads(val)
+                except json.JSONDecodeError:
+                    t[key.strip()] = val
+            if t:
+                trials.append(t)
+    return trials

@@ -448,6 +448,13 @@ def _wdl_settings(mc, p: Dict[str, Any]) -> TrainSettings:
 
 def run_wdl_training(proc) -> int:
     mc = proc.model_config
+    from ..train import grid_search
+    if mc.train.gridConfigFile or grid_search.is_grid_search(
+            mc.train.params or {}):
+        from ..config.validator import ValidationError
+        raise ValidationError(
+            ["grid search (list-valued train#params / gridConfigFile) is "
+             "not supported for WDL yet"])
     norm = Shards.open(proc.paths.norm_dir)
     clean = Shards.open(proc.paths.clean_dir)
     schema = norm.schema

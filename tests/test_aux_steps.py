@@ -262,3 +262,59 @@ def test_parquet_source_end_to_end(model_set, tmp_path):
     perf = json.load(open(os.path.join(model_set, "evals", "Eval1",
                                        "EvalPerformance.json")))
     assert perf["areaUnderRoc"] > 0.7
+
+
+def test_grid_config_file(model_set):
+    """train.gridConfigFile: one explicit trial per line, key:value;...
+    (GridSearch.java:119-153); trials validate against the meta schema."""
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.config.validator import ValidationError
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+
+    gcf = os.path.join(model_set, "grid.conf")
+    open(gcf, "w").write(
+        "Propagation:ADAM;LearningRate:0.05\n"
+        "Propagation:ADAM;LearningRate:0.2\n"
+        "Propagation:ADAM;LearningRate:0.1;RegularizedConstant:0.001\n")
+    mcp = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.train.numTrainEpochs = 8
+    mc.train.params = {"NumHiddenNodes": [8], "ActivationFunc": ["tanh"]}
+    mc.train.gridConfigFile = "grid.conf"
+    mc.save(mcp)
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    assert TrainProcessor(model_set, params={}).run() == 0
+    report = json.load(open(os.path.join(model_set, "tmp",
+                                         "grid_search.json")))
+    assert len(report) == 3
+    # a typo in the file must fail probe-style, before training
+    open(gcf, "w").write("Propagation:ADAM;LearningRat:0.05\n"
+                         "Propagation:ADAM;LearningRate:0.2\n")
+    import pytest
+    with pytest.raises(ValidationError, match="LearningRate"):
+        TrainProcessor(model_set, params={}).run()
+
+
+def test_combo_resume_skips_trained(model_set):
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.combo import run_combo
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.train.numTrainEpochs = 5
+    mc.train.params = {"NumHiddenNodes": [6], "ActivationFunc": ["tanh"],
+                       "LearningRate": 0.1}
+    mc.save(mc_path)
+    assert run_combo(model_set, "new", "LR:NN") == 0
+    assert run_combo(model_set, "run", None) == 0
+    m0 = os.path.join(model_set, "combo_0_LR", "models", "model0.lr")
+    t0 = os.path.getmtime(m0)
+    assert run_combo(model_set, "run", None, resume=True) == 0
+    assert os.path.getmtime(m0) == t0          # untouched: skipped
