@@ -12,7 +12,6 @@ and this framework.  Enum families: algorithms ``ModelTrainConf.java:43``
 from __future__ import annotations
 
 import enum
-import json
 import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional

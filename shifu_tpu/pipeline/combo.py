@@ -83,7 +83,11 @@ def _init_members(d: str, members: List[str]) -> int:
             k: v for k, v in (mc.train.params or {}).items()
             if (r := TRAIN_PARAM_RULES.get(k)) is not None
             and (r.algs is None or alg in r.algs)}
-        if mc.train.gridConfigFile and \
+        if alg not in ("NN", "LR", "SVM", "TENSORFLOW"):
+            # tree/WDL members can't grid-search — inheriting the parent's
+            # file would hard-fail their training step
+            mc.train.gridConfigFile = None
+        elif mc.train.gridConfigFile and \
                 not os.path.isabs(mc.train.gridConfigFile):
             # member configs resolve paths against THEIR dir — pin the
             # parent-relative grid file to the parent

@@ -122,7 +122,12 @@ class TrainProcessor(BasicProcessor):
         gcf = self.model_config.train.gridConfigFile
         if gcf:
             file_trials = grid_search.load_grid_config(self._abs(gcf))
-            trials = [{**params, **t} for t in file_trials]
+            # list-valued params are grid axes in their own right — expand
+            # them first so a file trial that doesn't mention the key
+            # doesn't inherit a raw list (cartesian product of both)
+            base_trials = grid_search.expand(params) \
+                if grid_search.is_grid_search(params) else [params]
+            trials = [{**b, **t} for b in base_trials for t in file_trials]
             from ..config.meta import validate_train_params
             problems = []
             for i, t in enumerate(trials):

@@ -157,9 +157,9 @@ def _per_row_loss(y, f, loss: str):
     return (y - f) ** 2
 
 
-@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss"))
-def _gbt_round(bins, y, tw, vw, f, fa, cat, lr, min_instances, min_gain,
-               n_bins: int, depth: int, impurity: str, loss: str):
+def _gbt_round_impl(bins, y, tw, vw, f, fa, cat, lr, min_instances,
+                    min_gain, n_bins: int, depth: int, impurity: str,
+                    loss: str, use_pallas: bool = False):
     """One GBT tree end-to-end on device: residual grad → grow → predict →
     score update → train/valid error sums.  Only the tree arrays and two
     scalars cross to the host."""
@@ -167,7 +167,8 @@ def _gbt_round(bins, y, tw, vw, f, fa, cat, lr, min_instances, min_gain,
     stats = jnp.stack([tw, tw * grad, tw * grad * grad], axis=1) \
         .astype(jnp.float32)
     sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
-                                    impurity, min_instances, min_gain)
+                                    impurity, min_instances, min_gain,
+                                    use_pallas=use_pallas)
     pred = predict_tree(sf, lm, lv, bins, depth)
     f2 = f + lr * pred
     per = _per_row_loss(y, f2, loss)
@@ -176,11 +177,38 @@ def _gbt_round(bins, y, tw, vw, f, fa, cat, lr, min_instances, min_gain,
     return sf, lm, lv, gfi, f2, tr, va
 
 
+_gbt_round = partial(jax.jit, static_argnames=(
+    "n_bins", "depth", "impurity", "loss", "use_pallas"))(_gbt_round_impl)
+
+
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
-                                   "poisson", "n_classes"))
-def _rf_round(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
-              min_instances, min_gain, n_bins: int, depth: int,
-              impurity: str, loss: str, poisson: bool, n_classes: int = 0):
+                                   "n_trees", "use_pallas"))
+def _gbt_forest(bins, y, tw, vw, f, fa_all, cat, lr, min_instances,
+                min_gain, n_bins: int, depth: int, impurity: str,
+                loss: str, n_trees: int, use_pallas: bool = False):
+    """A whole chunk of the GBT forest as ONE executable (``lax.scan`` over
+    trees).  The per-tree loop costs one program execution per tree; over a
+    remote-device link each execution carries latency that dwarfs the
+    sub-ms tree compute (measured ~0.8 s/exec cold vs ~0.3 ms compute), so
+    the forest scans on device and crosses to the host once.  This is the
+    natural end point of the reference's master/worker iteration collapse
+    (``DTMaster.java:274-533`` per-iteration sync → zero syncs)."""
+    del n_trees    # shape comes from fa_all; static arg keys the cache
+
+    def body(f, fa):
+        sf, lm, lv, gfi, f2, tr, va = _gbt_round_impl(
+            bins, y, tw, vw, f, fa, cat, lr, min_instances, min_gain,
+            n_bins, depth, impurity, loss, use_pallas)
+        return f2, _pack_tree_impl(sf, lm, lv, gfi, tr, va)
+
+    f_out, packed = jax.lax.scan(body, f, fa_all)
+    return f_out, packed
+
+
+def _rf_round_impl(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
+                   min_instances, min_gain, n_bins: int, depth: int,
+                   impurity: str, loss: str, poisson: bool,
+                   n_classes: int = 0, use_pallas: bool = False):
     """One RF tree on device: Poisson bag → grow → oob accumulate →
     loss-consistent oob validation error (reference oob-as-validation,
     ``DTWorker.java:582-616``; round 1 hardcoded squared error).
@@ -202,7 +230,7 @@ def _rf_round(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
             .astype(jnp.float32)
     sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
                                     impurity, min_instances, min_gain,
-                                    n_classes)
+                                    n_classes, use_pallas)
     pred = predict_tree(sf, lm, lv, bins, depth)   # [n, K] mc, [n] binary
     oob = (bag == 0) & (w > 0)
     if multiclass:
@@ -232,6 +260,80 @@ def _rf_round(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
           + (1 - y) * jnp.log(jnp.clip(1 - pred, 1e-9, 1 - 1e-9)))
     tr = (per_t * w).sum() / jnp.maximum(w.sum(), 1e-9)
     return sf, lm, lv, gfi, oob_sum, oob_cnt, tr, va
+
+
+def _pack_tree_impl(sf, lm, lv, gfi, tr, va):
+    """Flatten one round's outputs into a single f32 vector so the host
+    fetches the whole tree in ONE transfer.  The tunnel to the chip costs
+    ~100-250 ms per transfer regardless of size (measured on this rig);
+    unbatched per-array fetches dominated round-2 GBT wall-clock ~15:1
+    over compute."""
+    return jnp.concatenate([
+        sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
+        lv.reshape(-1).astype(jnp.float32), gfi.astype(jnp.float32),
+        jnp.stack([tr, va]).astype(jnp.float32)])
+
+
+_pack_tree = jax.jit(_pack_tree_impl)
+
+_rf_round = partial(jax.jit, static_argnames=(
+    "n_bins", "depth", "impurity", "loss", "poisson",
+    "n_classes", "use_pallas"))(_rf_round_impl)
+
+
+@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
+                                   "poisson", "n_classes", "n_trees",
+                                   "use_pallas"))
+def _rf_forest(bins, y, w, base_key, tree_ids, bag_rate, oob_sum, oob_cnt,
+               fa_all, cat, min_instances, min_gain, n_bins: int,
+               depth: int, impurity: str, loss: str, poisson: bool,
+               n_classes: int, n_trees: int, use_pallas: bool = False):
+    """A chunk of the RF forest as ONE executable (see :func:`_gbt_forest`).
+    Per-tree keys fold the tree id into the base key on device — identical
+    draws to the per-tree path, so resumed and scanned runs agree."""
+    del n_trees
+
+    def body(carry, inp):
+        oob_sum, oob_cnt = carry
+        fa, ti = inp
+        key = jax.random.fold_in(base_key, ti)
+        sf, lm, lv, gfi, oob_sum2, oob_cnt2, tr, va = _rf_round_impl(
+            bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
+            min_instances, min_gain, n_bins, depth, impurity, loss,
+            poisson, n_classes, use_pallas)
+        return (oob_sum2, oob_cnt2), _pack_tree_impl(sf, lm, lv, gfi, tr, va)
+
+    (oob_sum, oob_cnt), packed = jax.lax.scan(
+        body, (oob_sum, oob_cnt), (fa_all, tree_ids))
+    return oob_sum, oob_cnt, packed
+
+
+def _unpack_tree(vec: np.ndarray, total: int, n_bins: int, c: int,
+                 depth: int, n_classes: int = 0):
+    """Host-side inverse of :func:`_pack_tree`."""
+    k = n_classes if n_classes > 2 else 1
+    sizes = [total, total * n_bins, total * k, c, 2]
+    parts = np.split(vec, np.cumsum(sizes)[:-1])
+    lv = parts[2].astype(np.float32)
+    if k > 1:
+        lv = lv.reshape(total, k)
+    tree = TreeArrays(split_feat=parts[0].astype(np.int32),
+                      left_mask=parts[1].reshape(total, n_bins) > 0.5,
+                      leaf_value=lv, depth=depth)
+    return tree, parts[3].astype(np.float64), float(parts[4][0]), \
+        float(parts[4][1])
+
+
+def _use_pallas(mesh) -> bool:
+    """MXU histogram kernel dispatch: TPU backend, and at most one device
+    in the mesh — under a multi-device mesh the scatter path stays, where
+    GSPMD partitions the segment-sum over the data axis (a pallas_call is
+    opaque to the partitioner).  A 1-device mesh (the pipeline default on
+    a single chip) has nothing to partition and takes the kernel."""
+    from ..ops.hist_pallas import pallas_available
+    if mesh is not None and mesh.size > 1:
+        return False
+    return pallas_available()
 
 
 def _device_put_rows(mesh, *arrays):
@@ -291,29 +393,81 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
     for tr_prev, va_prev in history:
         stopper.add(va_prev)
     fi = np.zeros(c)
-    for ti in range(len(trees), settings.n_trees):
-        fa = jnp.asarray(_feat_subset(settings, c, ti))
-        sf, lm, lv, gfi, f, tr, va = _gbt_round(
-            bins_d, y_d, tw_d, vw_d, f, fa, cat,
-            settings.learning_rate, settings.min_instances,
-            settings.min_gain, n_bins, settings.depth,
-            "friedmanmse" if settings.impurity == "friedmanmse" else "variance",
-            settings.loss)
-        trees.append(TreeArrays(split_feat=np.asarray(sf),
-                                left_mask=np.asarray(lm),
-                                leaf_value=np.asarray(lv),
-                                depth=settings.depth))
-        fi += np.asarray(gfi)
-        tr_err, va_err = float(tr), float(va)
-        history.append((tr_err, va_err))
-        if progress:
-            progress(ti, tr_err, va_err)
-        if checkpoint_fn and settings.checkpoint_every and \
-                (ti + 1) % settings.checkpoint_every == 0:
-            checkpoint_fn(trees, history, init_score)
-        if settings.early_stop and stopper.add(va_err):
-            log.info("GBT early stop after %d trees", ti + 1)
-            break
+    total = n_tree_nodes(settings.depth)
+    imp = "friedmanmse" if settings.impurity == "friedmanmse" else "variance"
+    up = _use_pallas(mesh)
+    ckpt = settings.checkpoint_every if (checkpoint_fn and
+                                         settings.checkpoint_every) else 0
+
+    def absorb(flat: np.ndarray, with_history: bool):
+        nonlocal fi
+        for vec in flat:
+            tree, gfi, tr_err, va_err = _unpack_tree(
+                vec, total, n_bins, c, settings.depth)
+            trees.append(tree)
+            fi += gfi
+            if with_history:
+                history.append((tr_err, va_err))
+
+    if not settings.early_stop:
+        # whole-forest scan: one executable + one fetch per chunk — zero
+        # per-tree host round-trips.  A progress consumer gets its lines
+        # in bursts of 8 trees (the progress file is a tail surface, and
+        # per-tree fetches cost ~0.8 s each over a remote-device link)
+        ti = len(trees)
+        while ti < settings.n_trees:
+            chunk = settings.n_trees - ti
+            if ckpt:
+                chunk = min(chunk, ((ti // ckpt) + 1) * ckpt - ti)
+            if progress:
+                chunk = min(chunk, 8)
+            fa_all = jnp.asarray(np.stack(
+                [_feat_subset(settings, c, t)
+                 for t in range(ti, ti + chunk)]))
+            f, packed = _gbt_forest(
+                bins_d, y_d, tw_d, vw_d, f, fa_all, cat,
+                settings.learning_rate, settings.min_instances,
+                settings.min_gain, n_bins, settings.depth, imp,
+                settings.loss, chunk, up)
+            before = len(history)
+            absorb(np.asarray(packed), with_history=True)
+            if progress:
+                for j, (tr_err, va_err) in enumerate(history[before:],
+                                                     start=ti):
+                    progress(j, tr_err, va_err)
+            ti += chunk
+            if ckpt and ti % ckpt == 0:
+                checkpoint_fn(trees, history, init_score)
+    else:
+        # per-tree loop: early stop decides after every tree; packed
+        # outputs still drain in batched fetches
+        pending: List[Any] = []
+
+        def drain():
+            if pending:
+                absorb(np.asarray(jnp.stack(pending)), with_history=False)
+                pending.clear()
+
+        for ti in range(len(trees), settings.n_trees):
+            fa = jnp.asarray(_feat_subset(settings, c, ti))
+            sf, lm, lv, gfi, f, tr, va = _gbt_round(
+                bins_d, y_d, tw_d, vw_d, f, fa, cat,
+                settings.learning_rate, settings.min_instances,
+                settings.min_gain, n_bins, settings.depth, imp,
+                settings.loss, up)
+            pending.append(_pack_tree(sf, lm, lv, gfi, tr, va))
+            tr_err, va_err = (float(x) for x in
+                              np.asarray(jnp.stack([tr, va])))
+            history.append((tr_err, va_err))
+            if progress:
+                progress(ti, tr_err, va_err)
+            if ckpt and (ti + 1) % ckpt == 0:
+                drain()
+                checkpoint_fn(trees, history, init_score)
+            if settings.early_stop and stopper.add(va_err):
+                log.info("GBT early stop after %d trees", ti + 1)
+                break
+        drain()
     return ForestResult(
         trees=trees,
         spec_kwargs={"algorithm": "GBT", "loss": settings.loss,
@@ -361,25 +515,48 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
             oob_sum = oob_sum + jnp.where(oob[:, None] if mc else oob,
                                           pred, 0.0)
             oob_cnt = oob_cnt + oob.astype(jnp.float32)
-    for ti in range(start, settings.n_trees):
-        fa = jnp.asarray(_feat_subset(settings, c, ti))
-        key = jax.random.fold_in(base_key, ti)
-        sf, lm, lv, gfi, oob_sum, oob_cnt, tr, va = _rf_round(
-            bins_d, y_d, w_d, key, settings.bagging_rate,
-            oob_sum, oob_cnt, fa, cat, settings.min_instances,
-            settings.min_gain, n_bins, settings.depth, settings.impurity,
-            settings.loss, settings.poisson_bagging, settings.n_classes)
-        trees.append(TreeArrays(split_feat=np.asarray(sf),
-                                left_mask=np.asarray(lm),
-                                leaf_value=np.asarray(lv),
-                                depth=settings.depth))
-        fi += np.asarray(gfi)
-        tr_err, va_err = float(tr), float(va)
-        history.append((tr_err, va_err))
+    total = n_tree_nodes(settings.depth)
+    up = _use_pallas(mesh)
+    ckpt = settings.checkpoint_every if (checkpoint_fn and
+                                         settings.checkpoint_every) else 0
+
+    def absorb(flat: np.ndarray, with_history: bool):
+        nonlocal fi
+        for vec in flat:
+            tree, gfi, tr_err, va_err = _unpack_tree(
+                vec, total, n_bins, c, settings.depth, settings.n_classes)
+            trees.append(tree)
+            fi += gfi
+            if with_history:
+                history.append((tr_err, va_err))
+
+    # whole-forest scan (see _gbt_forest): one executable + one fetch per
+    # chunk; progress consumers get their lines in bursts of 8 trees
+    ti = start
+    while ti < settings.n_trees:
+        chunk = settings.n_trees - ti
+        if ckpt:
+            chunk = min(chunk, ((ti // ckpt) + 1) * ckpt - ti)
         if progress:
-            progress(ti, tr_err, va_err)
-        if checkpoint_fn and settings.checkpoint_every and \
-                (ti + 1) % settings.checkpoint_every == 0:
+            chunk = min(chunk, 8)
+        fa_all = jnp.asarray(np.stack(
+            [_feat_subset(settings, c, t)
+             for t in range(ti, ti + chunk)]))
+        tree_ids = jnp.arange(ti, ti + chunk, dtype=jnp.uint32)
+        oob_sum, oob_cnt, packed = _rf_forest(
+            bins_d, y_d, w_d, base_key, tree_ids,
+            settings.bagging_rate, oob_sum, oob_cnt, fa_all, cat,
+            settings.min_instances, settings.min_gain, n_bins,
+            settings.depth, settings.impurity, settings.loss,
+            settings.poisson_bagging, settings.n_classes, chunk, up)
+        before = len(history)
+        absorb(np.asarray(packed), with_history=True)
+        if progress:
+            for j, (tr_err, va_err) in enumerate(history[before:],
+                                                 start=ti):
+                progress(j, tr_err, va_err)
+        ti += chunk
+        if ckpt and ti % ckpt == 0:
             checkpoint_fn(trees, history, None)
     spec_kwargs: Dict[str, Any] = {"algorithm": "RF"}
     if mc:
@@ -393,9 +570,11 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
 
 
 # ------------------------------------------------------------- streaming
-@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level", "loss"))
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level", "loss",
+                                   "use_pallas"))
 def _gbt_window_hist(bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
-                     n_bins: int, level: int, loss: str):
+                     n_bins: int, level: int, loss: str,
+                     use_pallas: bool = False):
     """Streamed level step: window rows find their level-local node by
     walking the partial tree, then scatter residual-gradient stats.  With
     mesh-sharded window rows the [nodes, C, B, S] sum is XLA's psum over
@@ -404,17 +583,20 @@ def _gbt_window_hist(bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
     grad = _loss_grad(y_w, f_w, loss)
     stats = jnp.stack([tw_w, tw_w * grad, tw_w * grad * grad], axis=1) \
         .astype(jnp.float32)
-    return build_histograms(bins_w, node_idx, stats, n_nodes, n_bins)
+    return build_histograms(bins_w, node_idx, stats, n_nodes, n_bins,
+                            use_pallas)
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level"))
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level",
+                                   "use_pallas"))
 def _rf_window_hist(bins_w, y_w, w_w, bag_w, sf, lm, n_nodes: int,
-                    n_bins: int, level: int):
+                    n_bins: int, level: int, use_pallas: bool = False):
     bw_w = w_w * bag_w
     node_idx = node_index_at_level(sf, lm, bins_w, level)
     stats = jnp.stack([bw_w, bw_w * y_w, bw_w * y_w * y_w], axis=1) \
         .astype(jnp.float32)
-    return build_histograms(bins_w, node_idx, stats, n_nodes, n_bins)
+    return build_histograms(bins_w, node_idx, stats, n_nodes, n_bins,
+                            use_pallas)
 
 
 @partial(jax.jit, static_argnames=("depth", "loss"))
@@ -527,6 +709,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
     from ..data.streaming import ResidentCache
 
     _require_divisible(stream, mesh)
+    up = _use_pallas(mesh)
     n_rows = stream.num_rows
     total = n_tree_nodes(settings.depth)
     trees: List[TreeArrays] = list(init_trees or [])
@@ -583,7 +766,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                 hist = hist + _gbt_window_hist(
                     it.arrays["bins"], it.arrays["y"], it.arrays["tw"],
                     _window_f(f, it, mesh), sf, lm,
-                    n_nodes, n_bins, level, settings.loss)
+                    n_nodes, n_bins, level, settings.loss, up)
             gain, feat, lmask, leaf, _ = best_splits(
                 hist, cat, fa,
                 "friedmanmse" if settings.impurity == "friedmanmse"
@@ -691,6 +874,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
     from ..data.streaming import ResidentCache, _hash_poisson, row_uniform
 
     _require_divisible(stream, mesh)
+    up = _use_pallas(mesh)
     n_rows = stream.num_rows
     total = n_tree_nodes(settings.depth)
     trees: List[TreeArrays] = list(init_trees or [])
@@ -760,7 +944,8 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
             for it in cache.items():
                 hist = hist + _rf_window_hist(
                     it.arrays["bins"], it.arrays["y"], it.arrays["w"],
-                    window_bag(ti, it), sf, lm, n_nodes, n_bins, level)
+                    window_bag(ti, it), sf, lm, n_nodes, n_bins, level,
+                    up)
             gain, feat, lmask, leaf, _ = best_splits(
                 hist, cat, fa, settings.impurity,
                 settings.min_instances, settings.min_gain)

@@ -21,8 +21,10 @@ try:
     import jax
     import jax._src.xla_bridge as _xb
 
+    # keep "tpu" registered: pallas/mosaic registers tpu MLIR lowerings at
+    # import time and needs the platform known, even under JAX_PLATFORMS=cpu
     for _name in [n for n in list(getattr(_xb, "_backend_factories", {}))
-                  if n not in ("cpu",)]:
+                  if n not in ("cpu", "tpu")]:
         _xb._backend_factories.pop(_name, None)
     jax.config.update("jax_platforms", "cpu")  # sitecustomize may have set "axon"
 except Exception:
