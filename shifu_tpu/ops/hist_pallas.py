@@ -32,8 +32,6 @@ from __future__ import annotations
 import os
 from functools import partial
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl

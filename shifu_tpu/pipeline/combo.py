@@ -85,8 +85,11 @@ def _init_members(d: str, members: List[str]) -> int:
             and (r.algs is None or alg in r.algs)}
         if alg not in ("NN", "LR", "SVM", "TENSORFLOW"):
             # tree/WDL members can't grid-search — inheriting the parent's
-            # file would hard-fail their training step
+            # grid file or list-valued axes would hard-fail their training
+            # step; those members fall back to per-key defaults
             mc.train.gridConfigFile = None
+            mc.train.params = {k: v for k, v in mc.train.params.items()
+                               if not isinstance(v, list)}
         elif mc.train.gridConfigFile and \
                 not os.path.isabs(mc.train.gridConfigFile):
             # member configs resolve paths against THEIR dir — pin the

@@ -127,7 +127,15 @@ class TrainProcessor(BasicProcessor):
             # doesn't inherit a raw list (cartesian product of both)
             base_trials = grid_search.expand(params) \
                 if grid_search.is_grid_search(params) else [params]
-            trials = [{**b, **t} for b in base_trials for t in file_trials]
+            merged = [{**b, **t} for b in base_trials for t in file_trials]
+            # a file trial that sets an expanded key collapses that axis —
+            # drop the resulting exact duplicates (keep first occurrence)
+            seen, trials = set(), []
+            for t in merged:
+                key = tuple(sorted((k, repr(v)) for k, v in t.items()))
+                if key not in seen:
+                    seen.add(key)
+                    trials.append(t)
             from ..config.meta import validate_train_params
             problems = []
             for i, t in enumerate(trials):

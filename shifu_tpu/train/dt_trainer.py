@@ -743,7 +743,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
         else:
             init_score = prior
     cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
-    fi = np.zeros(c)
+    fi_dev = jnp.zeros(c, jnp.float32)     # device-accumulated split gains
 
     f = np.full(n_rows, init_score, np.float32)
     for t in trees:  # resumed/continuous: replay stored trees over the cache
@@ -753,6 +753,17 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
             pred = predict_tree(sf, lm, lv, it.arrays["bins"], t.depth)
             s, e = it.start, it.start + it.n_valid
             f[s:e] += settings.learning_rate * np.asarray(pred)[:it.n_valid]
+
+    def window_f(it):
+        """Resident windows keep their score slice ON DEVICE across trees
+        and levels (zero fetches); only tail windows round-trip host f."""
+        if it.resident:
+            fw = it.arrays.get("f")
+            if fw is None:
+                fw = _window_f(f, it, mesh)
+                it.arrays["f"] = fw
+            return fw
+        return _window_f(f, it, mesh)
 
     for ti in range(len(trees), settings.n_trees):
         fa = jnp.asarray(_feat_subset(settings, c, ti))
@@ -765,7 +776,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
             for it in cache.items():
                 hist = hist + _gbt_window_hist(
                     it.arrays["bins"], it.arrays["y"], it.arrays["tw"],
-                    _window_f(f, it, mesh), sf, lm,
+                    window_f(it), sf, lm,
                     n_nodes, n_bins, level, settings.loss, up)
             gain, feat, lmask, leaf, _ = best_splits(
                 hist, cat, fa,
@@ -779,27 +790,37 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
             sf = sf.at[base:base + n_nodes].set(feat)
             lm = lm.at[base:base + n_nodes].set(lmask)
             lv = lv.at[base:base + n_nodes].set(leaf)
-            fi_add = jax.ops.segment_sum(
-                np.asarray(jnp.where(feat >= 0, jnp.maximum(gain, 0.0), 0.0)),
-                np.maximum(np.asarray(feat), 0), num_segments=c)
-            fi += np.asarray(fi_add)
-        # update pass: f cache + errors
-        sums = np.zeros(4)
+            fi_dev = fi_dev + jax.ops.segment_sum(
+                jnp.where(feat >= 0, jnp.maximum(gain, 0.0),
+                          0.0).astype(jnp.float32),
+                jnp.maximum(feat, 0), num_segments=c)
+        # update pass: f caches + error sums, all device-side; ONE packed
+        # fetch per tree (tree arrays + sums) — tail windows additionally
+        # round-trip their f slice (they are disk-bound anyway)
+        sums_dev = jnp.zeros(4, jnp.float32)
         for it in cache.items():
             f2, s4 = _gbt_window_update(
                 it.arrays["bins"], it.arrays["y"], it.arrays["tw"],
-                it.arrays["vw"], _window_f(f, it, mesh),
+                it.arrays["vw"], window_f(it),
                 sf, lm, lv, settings.learning_rate, settings.depth,
                 settings.loss)
-            s, e = it.start, it.start + it.n_valid
-            f[s:e] = np.asarray(f2)[:it.n_valid]
-            sums += np.asarray(s4)
-        trees.append(TreeArrays(split_feat=np.asarray(sf),
-                                left_mask=np.asarray(lm),
-                                leaf_value=np.asarray(lv),
+            if it.resident:
+                it.arrays["f"] = f2
+            else:
+                s, e = it.start, it.start + it.n_valid
+                f[s:e] = np.asarray(f2)[:it.n_valid]
+            sums_dev = sums_dev + s4
+        packed = np.asarray(jnp.concatenate([
+            sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
+            lv, sums_dev]))
+        sf_h, lm_h, lv_h, sums = np.split(
+            packed, np.cumsum([total, total * n_bins, total]))
+        trees.append(TreeArrays(split_feat=sf_h.astype(np.int32),
+                                left_mask=lm_h.reshape(total, n_bins) > 0.5,
+                                leaf_value=lv_h.astype(np.float32),
                                 depth=settings.depth))
-        tr_err = sums[0] / max(sums[1], 1e-9)
-        va_err = sums[2] / max(sums[3], 1e-9)
+        tr_err = float(sums[0]) / max(float(sums[1]), 1e-9)
+        va_err = float(sums[2]) / max(float(sums[3]), 1e-9)
         history.append((tr_err, va_err))
         if progress:
             progress(ti, tr_err, va_err)
@@ -816,7 +837,8 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                      "init_score": init_score},
         train_error=history[-1][0] if history else float("nan"),
         valid_error=history[-1][1] if history else float("nan"),
-        feature_importance=fi, trees_built=len(trees), history=history,
+        feature_importance=np.asarray(fi_dev, np.float64),
+        trees_built=len(trees), history=history,
         disk_passes=cache.disk_passes)
 
 
@@ -892,7 +914,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
     cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
     oob_sum = np.zeros(n_rows, np.float32)
     oob_cnt = np.zeros(n_rows, np.float32)
-    fi = np.zeros(c)
+    fi_dev = jnp.zeros(c, jnp.float32)     # device-accumulated split gains
 
     # per-(tree, window) bags are deterministic; memoized so the depth+2
     # sweeps of a tree hash/upload each window's bag once
@@ -911,19 +933,35 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                 bag_cache[key] = dev
         return dev
 
-    def accumulate_oob(ti: int, sf, lm, lv, depth: int) -> np.ndarray:
-        sums = np.zeros(4)
+    def window_oob(it):
+        """Resident windows keep oob vote state ON DEVICE across trees;
+        tail windows round-trip the host arrays."""
+        if it.resident:
+            pair = it.arrays.get("oob")
+            if pair is None:
+                pair = (_window_f(oob_sum, it, mesh),
+                        _window_f(oob_cnt, it, mesh))
+                it.arrays["oob"] = pair
+            return pair
+        return (_window_f(oob_sum, it, mesh), _window_f(oob_cnt, it, mesh))
+
+    def accumulate_oob(ti: int, sf, lm, lv, depth: int):
+        """Device-side error sums; only tail windows fetch oob state."""
+        sums_dev = jnp.zeros(4, jnp.float32)
         for it in cache.items():
+            osw, ocw = window_oob(it)
             os2, oc2, s4 = _rf_window_update(
                 it.arrays["bins"], it.arrays["y"], it.arrays["w"],
-                window_bag(ti, it), _window_f(oob_sum, it, mesh),
-                _window_f(oob_cnt, it, mesh), sf, lm, lv, depth,
+                window_bag(ti, it), osw, ocw, sf, lm, lv, depth,
                 settings.loss)
-            s, e = it.start, it.start + it.n_valid
-            oob_sum[s:e] = np.asarray(os2)[:it.n_valid]
-            oob_cnt[s:e] = np.asarray(oc2)[:it.n_valid]
-            sums += np.asarray(s4)
-        return sums
+            if it.resident:
+                it.arrays["oob"] = (os2, oc2)
+            else:
+                s, e = it.start, it.start + it.n_valid
+                oob_sum[s:e] = np.asarray(os2)[:it.n_valid]
+                oob_cnt[s:e] = np.asarray(oc2)[:it.n_valid]
+            sums_dev = sums_dev + s4
+        return sums_dev
 
     # resumed/continuous: replay oob accumulation for stored trees
     for ti, t_old in enumerate(trees):
@@ -956,16 +994,23 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
             sf = sf.at[base:base + n_nodes].set(feat)
             lm = lm.at[base:base + n_nodes].set(lmask)
             lv = lv.at[base:base + n_nodes].set(leaf)
-            fi += np.asarray(jax.ops.segment_sum(
-                np.asarray(jnp.where(feat >= 0, jnp.maximum(gain, 0.0), 0.0)),
-                np.maximum(np.asarray(feat), 0), num_segments=c))
-        sums = accumulate_oob(ti, sf, lm, lv, settings.depth)
-        trees.append(TreeArrays(split_feat=np.asarray(sf),
-                                left_mask=np.asarray(lm),
-                                leaf_value=np.asarray(lv),
+            fi_dev = fi_dev + jax.ops.segment_sum(
+                jnp.where(feat >= 0, jnp.maximum(gain, 0.0),
+                          0.0).astype(jnp.float32),
+                jnp.maximum(feat, 0), num_segments=c)
+        sums_dev = accumulate_oob(ti, sf, lm, lv, settings.depth)
+        packed = np.asarray(jnp.concatenate([
+            sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
+            lv, sums_dev]))
+        sf_h, lm_h, lv_h, sums = np.split(
+            packed, np.cumsum([total, total * n_bins, total]))
+        trees.append(TreeArrays(split_feat=sf_h.astype(np.int32),
+                                left_mask=lm_h.reshape(total, n_bins) > 0.5,
+                                leaf_value=lv_h.astype(np.float32),
                                 depth=settings.depth))
-        va_err = sums[0] / max(sums[1], 1e-9) if sums[1] > 0 else float("nan")
-        tr_err = sums[2] / max(sums[3], 1e-9)
+        va_err = float(sums[0]) / max(float(sums[1]), 1e-9) \
+            if sums[1] > 0 else float("nan")
+        tr_err = float(sums[2]) / max(float(sums[3]), 1e-9)
         history.append((tr_err, va_err))
         if progress:
             progress(ti, tr_err, va_err)
@@ -976,7 +1021,8 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
         trees=trees, spec_kwargs={"algorithm": "RF"},
         train_error=history[-1][0] if history else float("nan"),
         valid_error=history[-1][1] if history else float("nan"),
-        feature_importance=fi, trees_built=len(trees), history=history,
+        feature_importance=np.asarray(fi_dev, np.float64),
+        trees_built=len(trees), history=history,
         disk_passes=cache.disk_passes)
 
 
