@@ -56,6 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-list", dest="list", action="store_true")
     sp.add_argument("-reset", dest="reset", action="store_true")
     sp.add_argument("-recover", dest="recover", action="store_true")
+    sp.add_argument("-recursive", dest="recursive", type=int, default=1,
+                    metavar="N", help="SE/ST wrapper rounds: each round "
+                    "re-norms + retrains on the current selection, then "
+                    "re-scores sensitivity")
 
     sp = sub.add_parser("train", help="train model(s)")
     sp.add_argument("-dry", dest="dry", action="store_true")

@@ -233,6 +233,25 @@ def ns_simple(name: str) -> str:
     return name.rsplit(NS_DELIMITER, 1)[-1] if NS_DELIMITER in name else name
 
 
+def read_column_name_file(path, base_dir: str = ".") -> set:
+    """One-name-per-line column file (force/meta/candidate lists):
+    blank lines and '#' comments skipped.  The single reader shared by
+    validation (``validator.probe``) and selection
+    (``varselect._apply_force_files``) so both interpret the same file
+    identically."""
+    if not path:
+        return set()
+    p = path if os.path.isabs(path) else os.path.join(base_dir, path)
+    if not os.path.isfile(p):
+        return set()
+    out = set()
+    for line in open(p):
+        line = line.strip()
+        if line and not line.startswith("#"):
+            out.add(line)
+    return out
+
+
 def ns_match(a: str, b: str) -> bool:
     """NSColumn equality: exact full-name match, or a BARE name matching a
     namespaced variant of it (``NSColumn.equals``).  Two different

@@ -94,6 +94,9 @@ TRAIN_PARAM_RULES: Dict[str, Rule] = {
     # tree family
     "TreeNum": Rule("int", lo=1, hi=100000, algs=TREE_FAMILY),
     "MaxDepth": Rule("int", lo=1, hi=20, algs=TREE_FAMILY),
+    # -1 (default) = level-wise; >0 enables the leaf-wise node budget
+    # (reference DTMaster.java:129-137 MaxLeaves / isLeafWise)
+    "MaxLeaves": Rule("int", lo=-1, hi=1 << 20, algs=TREE_FAMILY),
     "Impurity": Rule("str", allowed=_IMPURITIES, algs=TREE_FAMILY),
     "FeatureSubsetStrategy": Rule("str", allowed=_SUBSETS,
                                   algs=TREE_FAMILY),
@@ -120,7 +123,7 @@ CONFIG_RULES: Dict[str, Rule] = {
     "train.convergenceThreshold": Rule("float", lo=0.0),
     "train.epochsPerIteration": Rule("int", lo=1),
     "train.workerThreadCount": Rule("int", lo=1, hi=1024),
-    "stats.maxNumBin": Rule("int", lo=2, hi=100000),
+    "stats.maxNumBin": Rule("int", lo=2, hi=32767),
     "stats.sampleRate": Rule("float", lo=0.0, lo_open=True, hi=1.0),
     "stats.binningMethod": Rule("str"),
     "normalize.stdDevCutOff": Rule("float", lo=0.0, lo_open=True),

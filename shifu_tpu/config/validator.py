@@ -39,6 +39,49 @@ class ValidationError(ShifuError, ValueError):
                          "\n  - " + "\n  - ".join(problems))
 
 
+def _check_name_file(path: str, model_set_dir: str, what: str,
+                     problems: List[str]) -> None:
+    """Reference ``ModelInspector.checkFile`` via ``checkVarSelect``: a
+    configured column-name file must exist."""
+    if not path:
+        return
+    p = path if os.path.isabs(path) else os.path.join(model_set_dir, path)
+    if not os.path.isfile(p):
+        problems.append(f"{what} file does not exist: {path}")
+
+
+def _check_column_conf(mc: ModelConfig, model_set_dir: str,
+                       problems: List[str]) -> None:
+    """Cross-list column checks (reference
+    ``ModelInspector.checkColumnConf``, ``:213-262``): the target must not
+    appear in meta / forceRemove / forceSelect, and with forceEnable the
+    three lists must not overlap each other."""
+    from .column_config import ns_in, read_column_name_file
+    ds, vs = mc.dataSet, mc.varSelect
+    target = ds.targetColumnName
+    meta = read_column_name_file(ds.metaColumnNameFile, model_set_dir)
+    frm = read_column_name_file(vs.forceRemoveColumnNameFile, model_set_dir)
+    fsel = read_column_name_file(vs.forceSelectColumnNameFile,
+                                 model_set_dir)
+    # NSColumn equality throughout — a bare name matches its namespaced
+    # variant, the same matching the runtime force/meta application uses
+    if target and ns_in(target, meta):
+        problems.append("the target column must not be a meta column")
+    if vs.forceEnable and target and ns_in(target, frm):
+        problems.append("the target column must not be force-removed")
+    if vs.forceEnable and target and ns_in(target, fsel):
+        problems.append("the target column must not be force-selected")
+    if vs.forceEnable:
+        for a, b, an, bn in ((meta, frm, "meta", "forceRemove"),
+                             (meta, fsel, "meta", "forceSelect"),
+                             (fsel, frm, "forceSelect", "forceRemove")):
+            both = sorted(x for x in a if ns_in(x, b))
+            if both:
+                problems.append(
+                    f"column(s) {both[:5]} appear in both {an} "
+                    f"and {bn} lists")
+
+
 def probe(mc: ModelConfig, step: ModelStep, model_set_dir: str = ".") -> None:
     problems: List[str] = []
 
@@ -59,6 +102,12 @@ def probe(mc: ModelConfig, step: ModelStep, model_set_dir: str = ".") -> None:
         ds = mc.dataSet
         if not ds.dataPath:
             problems.append("dataSet.dataPath must be set")
+        elif step == ModelStep.INIT and "://" not in ds.dataPath and \
+                not os.path.exists(ds.dataPath if os.path.isabs(ds.dataPath)
+                                   else os.path.join(model_set_dir,
+                                                     ds.dataPath)):
+            # reference checkRawData → checkFile (:359-372, :939)
+            problems.append(f"dataSet.dataPath does not exist: {ds.dataPath}")
         if not ds.targetColumnName:
             problems.append("dataSet.targetColumnName must be set")
         if not ds.posTags and not ds.negTags:
@@ -66,13 +115,48 @@ def probe(mc: ModelConfig, step: ModelStep, model_set_dir: str = ".") -> None:
         overlap = set(map(str, ds.posTags)) & set(map(str, ds.negTags))
         if overlap:
             problems.append(f"posTags and negTags overlap: {sorted(overlap)}")
+        _check_column_conf(mc, model_set_dir, problems)
+
+    if step == ModelStep.STATS:
+        # reference checkStatsConf (:263-305)
+        from .model_config import BinningAlgorithm, BinningMethod
+        st = mc.stats
+        multiclass = mc.is_multi_class()
+        per_class = (BinningMethod.EqualPositive, BinningMethod.EqualNegtive,
+                     BinningMethod.WeightEqualPositive,
+                     BinningMethod.WeightEqualNegative)
+        if multiclass and st.binningMethod in per_class:
+            problems.append("multi-class classification cannot use "
+                            "EqualPositive/EqualNegtive binning methods")
+        if multiclass and st.binningAlgorithm != BinningAlgorithm.SPDTI:
+            problems.append("only the SPDTI binning algorithm supports "
+                            "multi-class classification")
+        # maxNumBin range lives in the meta schema (single source of truth)
+
+    if step in (ModelStep.VARSELECT, ModelStep.TRAIN):
+        # reference checkVarSelect (:316-357): configured force/candidate
+        # files must exist
+        vs = mc.varSelect
+        if vs.forceEnable:
+            _check_name_file(vs.candidateColumnNameFile, model_set_dir,
+                             "varSelect.candidateColumnNameFile", problems)
+            _check_name_file(vs.forceRemoveColumnNameFile, model_set_dir,
+                             "varSelect.forceRemoveColumnNameFile", problems)
+            _check_name_file(vs.forceSelectColumnNameFile, model_set_dir,
+                             "varSelect.forceSelectColumnNameFile", problems)
 
     if step == ModelStep.TRAIN:
         # cross-field rules the per-key schema can't express (NN shape
-        # consistency lives in meta.validate_train_params, per trial)
+        # consistency lives in meta.validate_train_params, per trial;
+        # reference checkTrainSetting :451-560)
         tr = mc.train
         if tr.isCrossValidation and tr.numKFold < 2:
             problems.append("train.numKFold must be >= 2 when isCrossValidation")
+        if tr.numKFold is not None and tr.numKFold > 20:
+            # reference checkTrainSetting: k-fold capped at 20
+            problems.append("train.numKFold must be <= 20")
+        # baggingNum / rates / epochs / convergenceThreshold ranges live in
+        # the meta schema (meta.py CONFIG_FIELD_RULES), checked above
 
     if step == ModelStep.EVAL:
         if not mc.evals:

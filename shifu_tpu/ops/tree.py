@@ -222,6 +222,25 @@ def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
         leaf_value, node_w
 
 
+def cap_splits_by_leaves(gain, feat, lmask, nodes_cnt, max_leaves: int):
+    """Leaf-wise node budget (reference ``DTMaster.java:543-560``
+    ``splitNodeForLeafWisedTree``: a split is refused once the tree's node
+    count would exceed MaxLeaves; each split adds two nodes).  TPU-shaped
+    as best-first-within-level: candidate splits rank by gain and consume
+    the remaining budget in that order, the rest freeze to leaves — same
+    budget arithmetic, static shapes, no host queue.
+
+    Returns (feat, lmask, new nodes_cnt); ``nodes_cnt`` is a traced int32
+    scalar starting at 1 (the root)."""
+    cand = feat >= 0
+    key = jnp.where(cand, -gain, jnp.inf)
+    rank = jnp.argsort(jnp.argsort(key))
+    budget = jnp.maximum((max_leaves - nodes_cnt) // 2, 0)
+    allow = cand & (rank < budget)
+    return (jnp.where(allow, feat, -1), lmask & allow[:, None],
+            nodes_cnt + 2 * allow.sum().astype(nodes_cnt.dtype))
+
+
 # ------------------------------------------------------------------ grow
 def _descend(bins, node_idx, feat, lmask):
     """One level of worker tree traversal: rows whose node split move to a
@@ -235,10 +254,11 @@ def _descend(bins, node_idx, feat, lmask):
 
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity",
-                                   "n_classes", "use_pallas"))
+                                   "n_classes", "use_pallas", "max_leaves"))
 def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
                   impurity: str, min_instances: float, min_gain: float,
-                  n_classes: int = 0, use_pallas: bool = False):
+                  n_classes: int = 0, use_pallas: bool = False,
+                  max_leaves: int = 0):
     """Whole-tree level-wise growth as ONE jitted program — zero host syncs
     per level (reference ``DTMaster.java:543-600`` level mode; the round-1
     build synced feat/lmask/leaf to host every level).
@@ -253,6 +273,7 @@ def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
     feats, lmasks, leaves = [], [], []
     gain_fi = jnp.zeros(c, jnp.float32)
     node_idx = jnp.zeros(n, jnp.int32)       # level-local position, -1 done
+    nodes_cnt = jnp.int32(1)                 # leaf-wise budget state
     for level in range(depth + 1):
         n_nodes = 1 << level
         hist = build_histograms(bins, node_idx, stats, n_nodes, n_bins,
@@ -262,6 +283,9 @@ def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
         if level == depth:                   # bottom level never splits
             feat = jnp.full(n_nodes, -1, jnp.int32)
             lmask = jnp.zeros((n_nodes, n_bins), bool)
+        elif max_leaves > 0:
+            feat, lmask, nodes_cnt = cap_splits_by_leaves(
+                gain, feat, lmask, nodes_cnt, max_leaves)
         feats.append(feat)
         lmasks.append(lmask)
         leaves.append(leaf)

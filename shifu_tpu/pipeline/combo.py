@@ -88,8 +88,10 @@ def _init_members(d: str, members: List[str]) -> int:
             # grid file or list-valued axes would hard-fail their training
             # step; those members fall back to per-key defaults
             mc.train.gridConfigFile = None
+            from ..train.grid_search import _is_axis
             mc.train.params = {k: v for k, v in mc.train.params.items()
-                               if not isinstance(v, list)}
+                               if not (isinstance(v, list)
+                                       and _is_axis(k, v))}
         elif mc.train.gridConfigFile and \
                 not os.path.isabs(mc.train.gridConfigFile):
             # member configs resolve paths against THEIR dir — pin the

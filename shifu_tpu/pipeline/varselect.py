@@ -20,6 +20,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import shutil
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -113,6 +114,54 @@ class VarSelectProcessor(BasicProcessor):
 
     # ------------------------------------------------------------- selection
     def _select(self) -> int:
+        vs = self.model_config.varSelect
+        rounds = int(self.params.get("recursive") or 1)
+        if rounds > 1:
+            if vs.filterBy not in (FilterBy.SE, FilterBy.ST):
+                log.error("varselect -recursive needs filterBy SE/ST "
+                          "(wrapper re-scoring); got %s", vs.filterBy.name)
+                return 1
+            return self._recursive_select(rounds)
+        return self._select_once()
+
+    def _recursive_select(self, rounds: int) -> int:
+        """SE/ST wrapper recursion (reference
+        ``VarSelectModelProcessor.java:201-227``): each round re-norms and
+        retrains on the CURRENT selection, re-scores sensitivity against
+        the fresh model, re-selects, and snapshots ``ColumnConfig.json.{i}``
+        + ``se.{i}.json`` into varsels/ for audit (reference varsel dir
+        history + ``se.x`` copies)."""
+        from .norm import NormalizeProcessor
+        from .train import TrainProcessor
+        os.makedirs(self.paths.varsel_dir, exist_ok=True)
+        self._snapshot_round(0)
+        for i in range(rounds):
+            self.save_column_configs()   # current selection feeds norm/train
+            for proc_cls in (NormalizeProcessor, TrainProcessor):
+                rc = proc_cls(self.dir, {}).run()
+                if rc != 0:
+                    log.error("recursive varselect round %d: %s failed "
+                              "(rc=%d)", i + 1, proc_cls.__name__, rc)
+                    return rc
+            rc = self._select_once()
+            if rc != 0:
+                return rc
+            self._snapshot_round(i + 1)
+            se_src = os.path.join(self.paths.varsel_dir, "se.json")
+            if os.path.isfile(se_src):
+                shutil.copy(se_src, os.path.join(self.paths.varsel_dir,
+                                                 f"se.{i}.json"))
+            log.info("recursive varselect round %d/%d: %d selected",
+                     i + 1, rounds, len(self._selected()))
+        return 0
+
+    def _snapshot_round(self, i: int) -> None:
+        src = self.paths.column_config_path
+        if os.path.isfile(src):
+            shutil.copy(src, os.path.join(self.paths.varsel_dir,
+                                          f"ColumnConfig.json.{i}"))
+
+    def _select_once(self) -> int:
         vs = self.model_config.varSelect
         self._push_history()
         self._apply_force_files(vs)
@@ -375,11 +424,5 @@ def _rank_of(scores: Dict[int, float]) -> Dict[int, int]:
 
 
 def _read_names(path: Optional[str]) -> set:
-    if not path or not os.path.isfile(path):
-        return set()
-    out = set()
-    for line in open(path):
-        line = line.strip()
-        if line and not line.startswith("#"):
-            out.add(line)
-    return out
+    from ..config.column_config import read_column_name_file
+    return read_column_name_file(path)

@@ -44,8 +44,8 @@ from ..config.model_config import Algorithm
 from ..data.shards import Shards
 from ..models import tree as tree_model
 from ..ops.tree import (TreeArrays, best_splits, build_histograms,
-                        grow_tree_jit, n_tree_nodes, node_index_at_level,
-                        predict_tree)
+                        cap_splits_by_leaves, grow_tree_jit, n_tree_nodes,
+                        node_index_at_level, predict_tree)
 from .early_stop import GBTEarlyStopDecider
 from .sampling import validation_split
 
@@ -71,6 +71,7 @@ class DTSettings:
     checkpoint_every: int = 25           # trees between checkpoints
     resume: bool = False
     n_classes: int = 0                   # >2: RF multiclass NATIVE mode
+    max_leaves: int = 0                  # >0: leaf-wise node budget
 
 
 def settings_from_params(params: Dict[str, Any], train_conf,
@@ -89,6 +90,7 @@ def settings_from_params(params: Dict[str, Any], train_conf,
         min_instances=float(p.get("MinInstancesPerNode", 1)),
         min_gain=float(p.get("MinInfoGain", 0.0)),
         feature_subset=str(p.get("FeatureSubsetStrategy", "ALL")).upper(),
+        max_leaves=max(0, int(p.get("MaxLeaves", -1))),
         valid_rate=float(train_conf.validSetRate),
         bagging_rate=float(train_conf.baggingSampleRate),
         poisson_bagging=alg != Algorithm.DT,  # plain DT = one tree, full data
@@ -159,7 +161,8 @@ def _per_row_loss(y, f, loss: str):
 
 def _gbt_round_impl(bins, y, tw, vw, f, fa, cat, lr, min_instances,
                     min_gain, n_bins: int, depth: int, impurity: str,
-                    loss: str, use_pallas: bool = False):
+                    loss: str, use_pallas: bool = False,
+                    max_leaves: int = 0):
     """One GBT tree end-to-end on device: residual grad → grow → predict →
     score update → train/valid error sums.  Only the tree arrays and two
     scalars cross to the host."""
@@ -168,7 +171,8 @@ def _gbt_round_impl(bins, y, tw, vw, f, fa, cat, lr, min_instances,
         .astype(jnp.float32)
     sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
                                     impurity, min_instances, min_gain,
-                                    use_pallas=use_pallas)
+                                    use_pallas=use_pallas,
+                                    max_leaves=max_leaves)
     pred = predict_tree(sf, lm, lv, bins, depth)
     f2 = f + lr * pred
     per = _per_row_loss(y, f2, loss)
@@ -178,14 +182,16 @@ def _gbt_round_impl(bins, y, tw, vw, f, fa, cat, lr, min_instances,
 
 
 _gbt_round = partial(jax.jit, static_argnames=(
-    "n_bins", "depth", "impurity", "loss", "use_pallas"))(_gbt_round_impl)
+    "n_bins", "depth", "impurity", "loss", "use_pallas",
+    "max_leaves"))(_gbt_round_impl)
 
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
-                                   "n_trees", "use_pallas"))
+                                   "n_trees", "use_pallas", "max_leaves"))
 def _gbt_forest(bins, y, tw, vw, f, fa_all, cat, lr, min_instances,
                 min_gain, n_bins: int, depth: int, impurity: str,
-                loss: str, n_trees: int, use_pallas: bool = False):
+                loss: str, n_trees: int, use_pallas: bool = False,
+                max_leaves: int = 0):
     """A whole chunk of the GBT forest as ONE executable (``lax.scan`` over
     trees).  The per-tree loop costs one program execution per tree; over a
     remote-device link each execution carries latency that dwarfs the
@@ -198,7 +204,7 @@ def _gbt_forest(bins, y, tw, vw, f, fa_all, cat, lr, min_instances,
     def body(f, fa):
         sf, lm, lv, gfi, f2, tr, va = _gbt_round_impl(
             bins, y, tw, vw, f, fa, cat, lr, min_instances, min_gain,
-            n_bins, depth, impurity, loss, use_pallas)
+            n_bins, depth, impurity, loss, use_pallas, max_leaves)
         return f2, _pack_tree_impl(sf, lm, lv, gfi, tr, va)
 
     f_out, packed = jax.lax.scan(body, f, fa_all)
@@ -208,7 +214,8 @@ def _gbt_forest(bins, y, tw, vw, f, fa_all, cat, lr, min_instances,
 def _rf_round_impl(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
                    min_instances, min_gain, n_bins: int, depth: int,
                    impurity: str, loss: str, poisson: bool,
-                   n_classes: int = 0, use_pallas: bool = False):
+                   n_classes: int = 0, use_pallas: bool = False,
+                   max_leaves: int = 0):
     """One RF tree on device: Poisson bag → grow → oob accumulate →
     loss-consistent oob validation error (reference oob-as-validation,
     ``DTWorker.java:582-616``; round 1 hardcoded squared error).
@@ -230,7 +237,7 @@ def _rf_round_impl(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
             .astype(jnp.float32)
     sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
                                     impurity, min_instances, min_gain,
-                                    n_classes, use_pallas)
+                                    n_classes, use_pallas, max_leaves)
     pred = predict_tree(sf, lm, lv, bins, depth)   # [n, K] mc, [n] binary
     oob = (bag == 0) & (w > 0)
     if multiclass:
@@ -278,16 +285,17 @@ _pack_tree = jax.jit(_pack_tree_impl)
 
 _rf_round = partial(jax.jit, static_argnames=(
     "n_bins", "depth", "impurity", "loss", "poisson",
-    "n_classes", "use_pallas"))(_rf_round_impl)
+    "n_classes", "use_pallas", "max_leaves"))(_rf_round_impl)
 
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
                                    "poisson", "n_classes", "n_trees",
-                                   "use_pallas"))
+                                   "use_pallas", "max_leaves"))
 def _rf_forest(bins, y, w, base_key, tree_ids, bag_rate, oob_sum, oob_cnt,
                fa_all, cat, min_instances, min_gain, n_bins: int,
                depth: int, impurity: str, loss: str, poisson: bool,
-               n_classes: int, n_trees: int, use_pallas: bool = False):
+               n_classes: int, n_trees: int, use_pallas: bool = False,
+               max_leaves: int = 0):
     """A chunk of the RF forest as ONE executable (see :func:`_gbt_forest`).
     Per-tree keys fold the tree id into the base key on device — identical
     draws to the per-tree path, so resumed and scanned runs agree."""
@@ -300,7 +308,7 @@ def _rf_forest(bins, y, w, base_key, tree_ids, bag_rate, oob_sum, oob_cnt,
         sf, lm, lv, gfi, oob_sum2, oob_cnt2, tr, va = _rf_round_impl(
             bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
             min_instances, min_gain, n_bins, depth, impurity, loss,
-            poisson, n_classes, use_pallas)
+            poisson, n_classes, use_pallas, max_leaves)
         return (oob_sum2, oob_cnt2), _pack_tree_impl(sf, lm, lv, gfi, tr, va)
 
     (oob_sum, oob_cnt), packed = jax.lax.scan(
@@ -428,7 +436,7 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
                 bins_d, y_d, tw_d, vw_d, f, fa_all, cat,
                 settings.learning_rate, settings.min_instances,
                 settings.min_gain, n_bins, settings.depth, imp,
-                settings.loss, chunk, up)
+                settings.loss, chunk, up, settings.max_leaves)
             before = len(history)
             absorb(np.asarray(packed), with_history=True)
             if progress:
@@ -454,7 +462,7 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
                 bins_d, y_d, tw_d, vw_d, f, fa, cat,
                 settings.learning_rate, settings.min_instances,
                 settings.min_gain, n_bins, settings.depth, imp,
-                settings.loss, up)
+                settings.loss, up, settings.max_leaves)
             pending.append(_pack_tree(sf, lm, lv, gfi, tr, va))
             tr_err, va_err = (float(x) for x in
                               np.asarray(jnp.stack([tr, va])))
@@ -548,7 +556,8 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
             settings.bagging_rate, oob_sum, oob_cnt, fa_all, cat,
             settings.min_instances, settings.min_gain, n_bins,
             settings.depth, settings.impurity, settings.loss,
-            settings.poisson_bagging, settings.n_classes, chunk, up)
+            settings.poisson_bagging, settings.n_classes, chunk, up,
+            settings.max_leaves)
         before = len(history)
         absorb(np.asarray(packed), with_history=True)
         if progress:
@@ -770,6 +779,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
         sf = jnp.full(total, -1, jnp.int32)
         lm = jnp.zeros((total, n_bins), bool)
         lv = jnp.zeros(total, jnp.float32)
+        nodes_cnt = jnp.int32(1)
         for level in range(settings.depth + 1):
             n_nodes = 1 << level
             hist = jnp.zeros((n_nodes, c, n_bins, 3), jnp.float32)
@@ -787,6 +797,9 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
             if level == settings.depth:
                 feat = jnp.full(n_nodes, -1, jnp.int32)
                 lmask = jnp.zeros((n_nodes, n_bins), bool)
+            elif settings.max_leaves > 0:
+                feat, lmask, nodes_cnt = cap_splits_by_leaves(
+                    gain, feat, lmask, nodes_cnt, settings.max_leaves)
             sf = sf.at[base:base + n_nodes].set(feat)
             lm = lm.at[base:base + n_nodes].set(lmask)
             lv = lv.at[base:base + n_nodes].set(leaf)
@@ -976,6 +989,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
         sf = jnp.full(total, -1, jnp.int32)
         lm = jnp.zeros((total, n_bins), bool)
         lv = jnp.zeros(total, jnp.float32)
+        nodes_cnt = jnp.int32(1)
         for level in range(settings.depth + 1):
             n_nodes = 1 << level
             hist = jnp.zeros((n_nodes, c, n_bins, 3), jnp.float32)
@@ -991,6 +1005,9 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
             if level == settings.depth:
                 feat = jnp.full(n_nodes, -1, jnp.int32)
                 lmask = jnp.zeros((n_nodes, n_bins), bool)
+            elif settings.max_leaves > 0:
+                feat, lmask, nodes_cnt = cap_splits_by_leaves(
+                    gain, feat, lmask, nodes_cnt, settings.max_leaves)
             sf = sf.at[base:base + n_nodes].set(feat)
             lm = lm.at[base:base + n_nodes].set(lmask)
             lv = lv.at[base:base + n_nodes].set(leaf)

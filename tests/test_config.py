@@ -153,3 +153,55 @@ def test_profile_json_written(model_set):
     assert "pass2_histograms" in prof["STATS"]["phases_s"]
     assert "train" in prof["TRAIN"]["phases_s"]
     assert "load_data" in prof["TRAIN"]["phases_s"]
+
+
+def test_probe_cross_list_column_conflicts(tmp_path):
+    """Reference ModelInspector.checkColumnConf (:213-262): target vs
+    meta/force lists, and pairwise list overlaps under forceEnable."""
+    mc = ModelConfig.from_dict(REFERENCE_STYLE_MODEL_CONFIG)
+    meta_f = tmp_path / "meta.names"
+    meta_f.write_text("diagnosis\ntxid\n")       # target in meta!
+    frm = tmp_path / "rm.names"
+    frm.write_text("txid\namount\n")             # txid also in meta
+    mc.dataSet.metaColumnNameFile = str(meta_f)
+    mc.varSelect.forceRemoveColumnNameFile = str(frm)
+    mc.varSelect.forceEnable = True
+    with pytest.raises(ValidationError) as e:
+        probe(mc, ModelStep.STATS, str(tmp_path))
+    text = "\n".join(e.value.problems)
+    assert "target column must not be a meta column" in text
+    assert "meta" in text and "forceRemove" in text
+
+
+def test_probe_force_file_must_exist(tmp_path):
+    """Reference ModelInspector.checkVarSelect (:316-357)."""
+    mc = ModelConfig.from_dict(REFERENCE_STYLE_MODEL_CONFIG)
+    mc.varSelect.forceEnable = True
+    mc.varSelect.forceSelectColumnNameFile = "no/such/file.names"
+    with pytest.raises(ValidationError) as e:
+        probe(mc, ModelStep.VARSELECT, str(tmp_path))
+    assert any("does not exist" in p for p in e.value.problems)
+
+
+def test_probe_stats_multiclass_binning_rules():
+    """Reference ModelInspector.checkStatsConf (:263-305)."""
+    from shifu_tpu.config.model_config import (BinningAlgorithm,
+                                               BinningMethod)
+    mc = ModelConfig.from_dict(REFERENCE_STYLE_MODEL_CONFIG)
+    mc.dataSet.posTags = ["a", "b", "c"]          # multi-class
+    mc.dataSet.negTags = []
+    mc.stats.binningMethod = BinningMethod.EqualPositive
+    mc.stats.binningAlgorithm = BinningAlgorithm.MunroPat
+    with pytest.raises(ValidationError) as e:
+        probe(mc, ModelStep.STATS)
+    text = "\n".join(e.value.problems)
+    assert "EqualPositive" in text
+    assert "SPDTI" in text
+
+
+def test_probe_init_missing_datapath_flagged(tmp_path):
+    mc = ModelConfig.from_dict(REFERENCE_STYLE_MODEL_CONFIG)
+    mc.dataSet.dataPath = "/no/such/data.csv"
+    with pytest.raises(ValidationError) as e:
+        probe(mc, ModelStep.INIT, str(tmp_path))
+    assert any("does not exist" in p for p in e.value.problems)

@@ -181,3 +181,41 @@ def test_rf_pipeline_end_to_end(model_set):
     assert NormalizeProcessor(model_set, params={}).run() == 0
     assert TrainProcessor(model_set, params={}).run() == 0
     assert os.path.isfile(os.path.join(model_set, "models", "model0.rf"))
+
+
+def test_leafwise_node_budget():
+    """MaxLeaves (reference DTMaster.java:543-560): the node budget caps
+    growth best-first by gain — node count never exceeds the budget and
+    the strongest split survives."""
+    import jax.numpy as jnp
+    from shifu_tpu.ops.tree import grow_tree_jit, n_tree_nodes
+
+    rng = np.random.default_rng(3)
+    n, c, b, depth = 4000, 6, 8, 4
+    bins = rng.integers(0, b, (n, c)).astype(np.int32)
+    # col 0 carries a strong signal, others weak
+    y = (bins[:, 0] >= 4).astype(np.float32)
+    y = np.where(rng.random(n) < 0.05, 1 - y, y)
+    w = np.ones(n, np.float32)
+    stats = jnp.stack([jnp.asarray(w), jnp.asarray(w * y),
+                       jnp.asarray(w * y * y)], axis=1)
+    cat = jnp.zeros(c, bool)
+    fa = jnp.ones(c, bool)
+
+    def node_count(max_leaves):
+        sf, _, _, _ = grow_tree_jit(
+            jnp.asarray(bins), stats, cat, fa, b, depth, "variance",
+            1.0, 0.0, 0, False, max_leaves)
+        return int((np.asarray(sf) >= 0).sum()) * 2 + 1
+
+    full = node_count(0)                       # level-wise, no cap
+    assert full > 7
+    capped = node_count(7)                     # budget of 7 nodes
+    assert capped <= 7
+    # the root split (strongest gain) must survive the cap
+    sf, _, _, _ = grow_tree_jit(
+        jnp.asarray(bins), stats, cat, fa, b, depth, "variance",
+        1.0, 0.0, 0, False, 3)
+    sf = np.asarray(sf)
+    assert sf[0] == 0                          # root split on the signal col
+    assert (sf >= 0).sum() == 1                # budget 3 = exactly one split

@@ -133,3 +133,38 @@ def test_varselect_auto_filter_missing_rate(model_set):
     assert VarSelectProcessor(model_set, params={}).run() == 0
     by_name = {c.columnName: c for c in _ccs(model_set)}
     assert not by_name["noise"].finalSelect
+
+
+def test_varselect_recursive_se(model_set):
+    """-recursive N (reference VarSelectModelProcessor.java:201-227): each
+    round re-norms + retrains on the current selection, then re-scores;
+    per-round ColumnConfig/se snapshots land in varsels/."""
+    from shifu_tpu.pipeline.varselect import VarSelectProcessor
+    from shifu_tpu.config.model_config import FilterBy
+    _prep(model_set, train_first=True)
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.varSelect.filterNum = 2
+    mc.varSelect.filterBy = FilterBy.SE
+    mc.save(mc_path)
+    assert VarSelectProcessor(model_set,
+                              params={"recursive": 2}).run() == 0
+    sel = [c for c in _ccs(model_set) if c.finalSelect]
+    assert len(sel) == 2
+    vdir = os.path.join(model_set, "varsels")
+    # snapshots: initial + one per round
+    for i in range(3):
+        assert os.path.isfile(os.path.join(vdir, f"ColumnConfig.json.{i}"))
+    for i in range(2):
+        assert os.path.isfile(os.path.join(vdir, f"se.{i}.json"))
+    # round-2 model was retrained on round-1's selection: its se scores
+    # only cover surviving candidates
+    se1 = json.load(open(os.path.join(vdir, "se.1.json")))
+    assert len(se1) >= 2
+
+
+def test_varselect_recursive_rejects_filter_modes(model_set):
+    from shifu_tpu.pipeline.varselect import VarSelectProcessor
+    _prep(model_set)
+    assert VarSelectProcessor(model_set,
+                              params={"recursive": 3}).run() == 1
