@@ -28,8 +28,8 @@ BASELINE_ROWS_PER_SEC = MEASURED_CPU_ROWS_PER_SEC * BASELINE_CLUSTER_WORKERS
 
 
 def bench_nn(n_rows: int = 1 << 17, n_features: int = 256,
-             hidden: tuple = (512, 256), batch: int = 1 << 14,
-             steps: int = 50) -> float:
+             hidden: tuple = (512, 256), batch: int = 1 << 12,
+             steps: int = 8000) -> float:
     import jax
     import jax.numpy as jnp
 
@@ -42,6 +42,8 @@ def bench_nn(n_rows: int = 1 << 17, n_features: int = 256,
     y = jnp.asarray(rng.random(n_rows) < jax.nn.sigmoid(logits), jnp.float32)[:, None]
     wgt = jnp.ones((n_rows, 1), jnp.float32)
 
+    from functools import partial
+
     spec = NNModelSpec(input_dim=n_features, hidden_nodes=list(hidden),
                        activations=["relu"] * len(hidden), output_dim=1)
     params = init_params(jax.random.PRNGKey(0), spec)
@@ -51,26 +53,34 @@ def bench_nn(n_rows: int = 1 << 17, n_features: int = 256,
     with jax.default_matmul_precision("bfloat16"):
         step_fn, opt_state = make_train_step(spec, params, optimizer="adam",
                                              learning_rate=1e-3)
-
         n_batches = n_rows // batch
-        params, opt_state, loss = step_fn(params, opt_state, x[:batch],
-                                          y[:batch], wgt[:batch])
-        jax.block_until_ready(loss)
-        # best of 3 timing windows: the tunnel to the chip adds run-to-run
-        # noise approaching 30%; steady-state throughput is the max
+
+        # the whole timing window is ONE executable (lax.scan over steps):
+        # per-step dispatch latency over the device link would otherwise
+        # dominate the sub-ms step compute
+        @partial(jax.jit, static_argnames=("n_steps",), donate_argnums=(0, 1))
+        def run_steps(params, opt_state, n_steps: int):
+            def body(carry, i):
+                p, o = carry
+                b = (i % n_batches) * batch
+                p, o, loss = step_fn(
+                    p, o, jax.lax.dynamic_slice_in_dim(x, b, batch),
+                    jax.lax.dynamic_slice_in_dim(y, b, batch),
+                    jax.lax.dynamic_slice_in_dim(wgt, b, batch))
+                return (p, o), loss
+            (p, o), losses = jax.lax.scan(
+                body, (params, opt_state),
+                jnp.arange(n_steps, dtype=jnp.int32))
+            return p, o, losses[-1]
+
+        params, opt_state, loss = run_steps(params, opt_state, steps)
+        float(loss)                                  # full warmup sync
         best = 0.0
         for _ in range(3):
             t0 = time.perf_counter()
-            done = 0
-            for i in range(steps):
-                b = (i % n_batches) * batch
-                params, opt_state, loss = step_fn(params, opt_state,
-                                                  x[b:b + batch],
-                                                  y[b:b + batch],
-                                                  wgt[b:b + batch])
-                done += batch
-            jax.block_until_ready(loss)
-            best = max(best, done / (time.perf_counter() - t0))
+            params, opt_state, loss = run_steps(params, opt_state, steps)
+            float(loss)                              # value-forcing sync
+            best = max(best, steps * batch / (time.perf_counter() - t0))
         return best
 
 
@@ -162,10 +172,14 @@ def run_benchmark() -> Dict[str, Any]:
         "baseline_provenance": "measured 28850.5 rows/s/worker f64 backprop "
                                "on this rig x 100 north-star workers "
                                "(BASELINE.md, tools/measure_baseline.py)",
-        # harness changed in round 3: bf16 matmuls + best-of-3 windows —
-        # BENCH_r01/r02 values (default precision, single window) are not
-        # directly comparable to this and later rounds
+        # harness re-based mid-round-3: the r01/r02 timing loop synced via
+        # block_until_ready, which this device link answers EARLY (phantom
+        # readiness) — those numbers were inflated ~4x.  Timing is now a
+        # value-forcing fetch around ONE scanned executable per window
+        # (steps fused via lax.scan), best of 3 windows; r01/r02 values
+        # are not comparable.
         "harness": {"matmul_precision": "bfloat16",
-                    "timing": "best-of-3 windows", "since_round": 3},
+                    "timing": "value-forced, scanned steps, best-of-3",
+                    "since_round": 3},
         "extra": extras,
     }

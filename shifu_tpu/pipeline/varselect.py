@@ -332,7 +332,11 @@ class VarSelectProcessor(BasicProcessor):
         for c in candidates:
             fidx = blocks.get(c.columnNum)
             if fidx is None:
-                scores[c.columnNum] = 0.0
+                # not in the trained model's feature plane (e.g. dropped in
+                # an earlier recursive round): rank LAST — a 0.0 here would
+                # outrank in-model columns with negative sensitivity and
+                # re-select a column the scoring model never saw
+                scores[c.columnNum] = float("-inf")
                 continue
             mask = np.zeros(x.shape[1], bool)
             mask[fidx] = True
@@ -344,8 +348,8 @@ class VarSelectProcessor(BasicProcessor):
         os.makedirs(self.paths.varsel_dir, exist_ok=True)
         with open(sens_path, "w") as f:
             json.dump({str(k): v for k, v in
-                       sorted(scores.items(), key=lambda kv: -kv[1])}, f,
-                      indent=2)
+                       sorted(scores.items(), key=lambda kv: -kv[1])
+                       if v != float("-inf")}, f, indent=2)
         return scores
 
     def _genetic_scores(self, candidates: List[ColumnConfig],

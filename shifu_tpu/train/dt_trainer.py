@@ -283,11 +283,6 @@ def _pack_tree_impl(sf, lm, lv, gfi, tr, va):
 
 _pack_tree = jax.jit(_pack_tree_impl)
 
-_rf_round = partial(jax.jit, static_argnames=(
-    "n_bins", "depth", "impurity", "loss", "poisson",
-    "n_classes", "use_pallas", "max_leaves"))(_rf_round_impl)
-
-
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
                                    "poisson", "n_classes", "n_trees",
                                    "use_pallas", "max_leaves"))

@@ -276,7 +276,6 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
     # compiles into the SPMD program); an EAGER lax.slice on sharded inputs
     # does ad-hoc device-to-device copies the XLA:CPU runtime has been seen
     # to SIGABRT on
-    @partial(jax.jit, static_argnames=("blen",))
     def step_batch(stacked, opt_state, start, rngs, lr_scale, blen: int):
         xb = jax.lax.dynamic_slice_in_dim(xd, start, blen, axis=0)
         yb = jax.lax.dynamic_slice_in_dim(yd, start, blen, axis=0) \
@@ -287,22 +286,36 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
                         in_axes=(0, 0, None, y_axis, 0, 0, 0, None))(
             stacked, opt_state, xb, yb, twb, rngs, hd, lr_scale)
 
+    @partial(jax.jit, static_argnames=("blen", "n_b"))
+    def epoch_steps(stacked, opt_state, rngs, lr_scale, blen: int,
+                    n_b: int):
+        """A whole epoch's minibatch sweep as ONE executable (lax.scan over
+        batches) — the per-batch dispatch loop costs one program execution
+        per batch, which dominates wall-clock on a remote-device link."""
+        def body(carry, bi):
+            st, os_ = carry
+            rngs_b = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+                rngs, bi) if dropout > 0 else rngs
+            st, os_, _ = step_batch(st, os_, bi * blen, rngs_b, lr_scale,
+                                    blen)
+            return (st, os_), None
+        (st, os_), _ = jax.lax.scan(body, (stacked, opt_state),
+                                    jnp.arange(n_b, dtype=jnp.int32))
+        return st, os_
+
     for epoch in range(start_epoch, settings.epochs):
         key, sub = jax.random.split(key)
         rngs = jax.random.split(sub, bags)
         if bs and bs < n_padded:
-            for bi, start in enumerate(range(0, n_padded - bs + 1, bs)):
-                rngs_b = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
-                    rngs, bi) if dropout > 0 else rngs
-                stacked, opt_state, _ = step_batch(
-                    stacked, opt_state, jnp.int32(start), rngs_b, lr_scale,
-                    bs)
+            stacked, opt_state = epoch_steps(
+                stacked, opt_state, rngs, lr_scale, bs,
+                (n_padded - bs) // bs + 1)
         else:
             stacked, opt_state, _ = step(stacked, opt_state, xd,
                                          yd if ymd is None else ymd, twd,
                                          rngs, lr_scale)
         tr, va = eval_errors(stacked, twd, vwd)
-        tr, va = np.asarray(tr), np.asarray(va)
+        tr, va = np.asarray(jnp.stack([tr, va]))       # one fetch
         history.append((float(tr.mean()), float(va.mean())))
         epochs_run = epoch + 1
 
