@@ -211,6 +211,10 @@ class VarSelectProcessor(BasicProcessor):
         if vs.filterOutRatio is not None:
             n_keep = min(n_keep,
                          int(len(candidates) * (1 - vs.filterOutRatio)))
+        # -inf marks columns the scoring model never saw (dropped in an
+        # earlier recursive round): never selectable, not merely last
+        candidates = [c for c in candidates
+                      if scores[c.columnNum] != float("-inf")]
         ranked = sorted(candidates, key=lambda c: -scores[c.columnNum])
         keep = set(c.columnNum for c in ranked[:n_keep])
         for c in candidates:

@@ -196,7 +196,6 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
     # compiles into the SPMD program — an EAGER lax.slice on sharded inputs
     # does ad-hoc device-to-device copies on the host backend, which the
     # XLA:CPU runtime intermittently aborts on (observed SIGABRT)
-    @partial(jax.jit, static_argnames=("bs",))
     def step_batch(stacked, opt_state, start, bs: int):
         xnb = jax.lax.dynamic_slice_in_dim(xnd, start, bs, axis=0)
         xcb = jax.lax.dynamic_slice_in_dim(xcd, start, bs, axis=0)
@@ -204,6 +203,17 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
         twb = jax.lax.dynamic_slice_in_dim(twd, start, bs, axis=1)
         return jax.vmap(member_update, in_axes=(0, 0, None, None, None, 0))(
             stacked, opt_state, xnb, xcb, yb, twb)
+
+    @partial(jax.jit, static_argnames=("blen",))
+    def epoch_steps(stacked, opt_state, starts, blen: int):
+        """One epoch's minibatch sweep as ONE executable (lax.scan over the
+        permuted batch starts) — see nn_trainer.epoch_steps."""
+        def body(carry, start):
+            st, os_ = carry
+            st, os_, _ = step_batch(st, os_, start, blen)
+            return (st, os_), None
+        (st, os_), _ = jax.lax.scan(body, (stacked, opt_state), starts)
+        return st, os_
 
     stops = [WindowEarlyStop(settings.early_stop_window) for _ in range(bags)]
     best_valid = np.full(bags, np.inf)
@@ -218,15 +228,14 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
             # rows were shuffled once; re-randomize the BATCH ORDER each
             # epoch (cheap host-side; no gather, no recompile)
             starts = order_rng.permutation(
-                np.arange(0, n_padded - bs + 1, bs))
-            for start in starts:
-                stacked, opt_state, _ = step_batch(
-                    stacked, opt_state, jnp.int32(start), bs)
+                np.arange(0, n_padded - bs + 1, bs).astype(np.int32))
+            stacked, opt_state = epoch_steps(stacked, opt_state,
+                                             jnp.asarray(starts), bs)
         else:
             stacked, opt_state, _ = step(stacked, opt_state, xnd, xcd, yd,
                                          twd)
         tr, va = eval_errors(stacked, twd, vwd)
-        tr, va = np.asarray(tr), np.asarray(va)
+        tr, va = np.asarray(jnp.stack([tr, va]))       # one fetch
         history.append((float(tr.mean()), float(va.mean())))
         epochs_run = epoch + 1
         improved = np.flatnonzero(va < best_valid)

@@ -145,6 +145,10 @@ def mc_model_set(tmp_path):
     mc.dataSet.posTags = ["alpha", "beta", "gamma"]
     mc.dataSet.negTags = []
     mc.dataSet.metaColumnNameFile = str(meta)
+    # per-class binning methods are rejected for multi-class targets
+    # (reference ModelInspector.checkStatsConf)
+    from shifu_tpu.config.model_config import BinningMethod
+    mc.stats.binningMethod = BinningMethod.EqualTotal
     mc.train.baggingNum = 1
     mc.train.numTrainEpochs = 40
     mc.evals[0].dataSet.dataPath = str(csv_path)
