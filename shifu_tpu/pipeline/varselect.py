@@ -207,14 +207,16 @@ class VarSelectProcessor(BasicProcessor):
         else:  # KS default
             scores = {c.columnNum: c.columnStats.ks or 0 for c in candidates}
 
+        # -inf marks columns the scoring model never saw (dropped in an
+        # earlier recursive round): never selectable, not merely last —
+        # and excluded BEFORE the filterOutRatio math so the ratio applies
+        # to the selectable set
+        candidates = [c for c in candidates
+                      if scores[c.columnNum] != float("-inf")]
         n_keep = vs.filterNum
         if vs.filterOutRatio is not None:
             n_keep = min(n_keep,
                          int(len(candidates) * (1 - vs.filterOutRatio)))
-        # -inf marks columns the scoring model never saw (dropped in an
-        # earlier recursive round): never selectable, not merely last
-        candidates = [c for c in candidates
-                      if scores[c.columnNum] != float("-inf")]
         ranked = sorted(candidates, key=lambda c: -scores[c.columnNum])
         keep = set(c.columnNum for c in ranked[:n_keep])
         for c in candidates:

@@ -219,3 +219,29 @@ def test_leafwise_node_budget():
     sf = np.asarray(sf)
     assert sf[0] == 0                          # root split on the signal col
     assert (sf >= 0).sum() == 1                # budget 3 = exactly one split
+
+
+def test_gbt_scan_and_loop_paths_identical():
+    """The scan fast-path (no early stop) and the per-tree loop (early
+    stop enabled, window large enough never to fire) must build identical
+    forests — two lowerings of the same math."""
+    from shifu_tpu.train.dt_trainer import DTSettings, train_gbt
+
+    rng = np.random.default_rng(11)
+    n, c, b = 3000, 8, 16
+    bins = rng.integers(0, b, (n, c)).astype(np.int32)
+    y = (rng.random(n) < 0.4).astype(np.float32)
+    w = np.ones(n, np.float32)
+    cat = np.zeros(c, bool)
+    base = dict(n_trees=5, depth=3, loss="log", learning_rate=0.1,
+                seed=7, feature_subset="HALF")
+    scan = train_gbt(bins, y, w, b, cat, DTSettings(**base))
+    loop = train_gbt(bins, y, w, b, cat,
+                     DTSettings(**base, early_stop=True))
+    assert scan.trees_built == loop.trees_built == 5
+    for ts, tl in zip(scan.trees, loop.trees):
+        np.testing.assert_array_equal(ts.split_feat, tl.split_feat)
+        np.testing.assert_array_equal(ts.left_mask, tl.left_mask)
+        np.testing.assert_allclose(ts.leaf_value, tl.leaf_value, atol=1e-6)
+    for (a, b_), (c_, d) in zip(scan.history, loop.history):
+        assert abs(a - c_) < 1e-6 and abs(b_ - d) < 1e-6
