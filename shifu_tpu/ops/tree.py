@@ -118,10 +118,10 @@ def _class_score(cnt, kind: str):
                      f"got {kind!r}")
 
 
-@partial(jax.jit, static_argnames=("impurity", "n_classes"))
+@partial(jax.jit, static_argnames=("impurity", "n_classes", "has_cat"))
 def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
                 min_instances: float = 1.0, min_gain: float = 0.0,
-                n_classes: int = 0):
+                n_classes: int = 0, has_cat: bool = True):
     """Best split per node from the level histogram.
 
     hist: [nodes, C, B, 3] (w, wy, wy2) — or, when ``n_classes > 2``,
@@ -149,23 +149,26 @@ def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
 
     # ---- per-(node,feat) bin order: natural for numeric, response-sorted
     # for categorical (empty bins pushed last so prefixes skip them).
-    # The argsort only matters for categorical features — all-numeric
-    # configs skip it at runtime (lax.cond), a measurable win since sorts
-    # don't vectorize well on the TPU
-    nat_order = jnp.broadcast_to(jnp.arange(b), (n_nodes, c, b))
+    # The argsort/gather machinery only matters for categorical features:
+    # ``has_cat=False`` (static, trainers know their cat_mask host-side)
+    # compiles it out entirely; otherwise a runtime lax.cond still skips
+    # the sort when the mask is dynamically empty
+    if has_cat:
+        nat_order = jnp.broadcast_to(jnp.arange(b), (n_nodes, c, b))
 
-    def _mixed_order():
-        rate = wy / jnp.maximum(w, EPS)
-        sort_key = jnp.where(w > 0, -rate, jnp.inf)
-        cat_order = jnp.argsort(sort_key, axis=-1)        # [nodes, C, B]
-        return jnp.where(cat_mask[None, :, None], cat_order, nat_order)
+        def _mixed_order():
+            rate = wy / jnp.maximum(w, EPS)
+            sort_key = jnp.where(w > 0, -rate, jnp.inf)
+            cat_order = jnp.argsort(sort_key, axis=-1)    # [nodes, C, B]
+            return jnp.where(cat_mask[None, :, None], cat_order, nat_order)
 
-    order = jax.lax.cond(jnp.any(cat_mask), _mixed_order,
-                         lambda: nat_order)
-
-    w_o = jnp.take_along_axis(w, order, axis=-1)
-    wy_o = jnp.take_along_axis(wy, order, axis=-1)
-    wy2_o = jnp.take_along_axis(wy2, order, axis=-1)
+        order = jax.lax.cond(jnp.any(cat_mask), _mixed_order,
+                             lambda: nat_order)
+        w_o = jnp.take_along_axis(w, order, axis=-1)
+        wy_o = jnp.take_along_axis(wy, order, axis=-1)
+        wy2_o = jnp.take_along_axis(wy2, order, axis=-1)
+    else:
+        w_o, wy_o, wy2_o = w, wy, wy2
 
     cw = jnp.cumsum(w_o, axis=-1)
     cwy = jnp.cumsum(wy_o, axis=-1)
@@ -173,7 +176,8 @@ def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
     tw, twy, twy2 = cw[..., -1:], cwy[..., -1:], cwy2[..., -1:]
 
     if multiclass:
-        cls_o = jnp.take_along_axis(cls, order[..., None], axis=2)
+        cls_o = jnp.take_along_axis(cls, order[..., None], axis=2) \
+            if has_cat else cls
         ccls = jnp.cumsum(cls_o, axis=2)                  # [nodes, C, B, K]
         tcls = ccls[:, :, -1:, :]
         score_l = _class_score(ccls, impurity)
@@ -205,10 +209,13 @@ def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
 
     # ---- build left_mask for the winning (feat, k): order[:k+1] goes left
     k_sel = jnp.take_along_axis(best_k, best_feat[:, None], axis=-1)  # [nodes,1]
-    order_sel = jnp.take_along_axis(
-        order, best_feat[:, None, None], axis=1)[:, 0]     # [nodes, B]
-    ranks = jnp.argsort(order_sel, axis=-1)                # bin -> position
-    left_mask = ranks <= k_sel
+    if has_cat:
+        order_sel = jnp.take_along_axis(
+            order, best_feat[:, None, None], axis=1)[:, 0]  # [nodes, B]
+        ranks = jnp.argsort(order_sel, axis=-1)             # bin -> position
+        left_mask = ranks <= k_sel
+    else:   # natural order: position == bin index
+        left_mask = jnp.arange(b)[None, :] <= k_sel
 
     node_w = tw[..., 0, 0]
     if multiclass:
@@ -254,11 +261,12 @@ def _descend(bins, node_idx, feat, lmask):
 
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity",
-                                   "n_classes", "use_pallas", "max_leaves"))
+                                   "n_classes", "use_pallas", "max_leaves",
+                                   "has_cat"))
 def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
                   impurity: str, min_instances: float, min_gain: float,
                   n_classes: int = 0, use_pallas: bool = False,
-                  max_leaves: int = 0):
+                  max_leaves: int = 0, has_cat: bool = True):
     """Whole-tree level-wise growth as ONE jitted program — zero host syncs
     per level (reference ``DTMaster.java:543-600`` level mode; the round-1
     build synced feat/lmask/leaf to host every level).
@@ -279,7 +287,8 @@ def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
         hist = build_histograms(bins, node_idx, stats, n_nodes, n_bins,
                                 use_pallas)
         gain, feat, lmask, leaf, node_w = best_splits(
-            hist, cat, fa, impurity, min_instances, min_gain, n_classes)
+            hist, cat, fa, impurity, min_instances, min_gain, n_classes,
+            has_cat)
         if level == depth:                   # bottom level never splits
             feat = jnp.full(n_nodes, -1, jnp.int32)
             lmask = jnp.zeros((n_nodes, n_bins), bool)

@@ -162,7 +162,7 @@ def _per_row_loss(y, f, loss: str):
 def _gbt_round_impl(bins, y, tw, vw, f, fa, cat, lr, min_instances,
                     min_gain, n_bins: int, depth: int, impurity: str,
                     loss: str, use_pallas: bool = False,
-                    max_leaves: int = 0):
+                    max_leaves: int = 0, has_cat: bool = True):
     """One GBT tree end-to-end on device: residual grad → grow → predict →
     score update → train/valid error sums.  Only the tree arrays and two
     scalars cross to the host."""
@@ -172,7 +172,7 @@ def _gbt_round_impl(bins, y, tw, vw, f, fa, cat, lr, min_instances,
     sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
                                     impurity, min_instances, min_gain,
                                     use_pallas=use_pallas,
-                                    max_leaves=max_leaves)
+                                    max_leaves=max_leaves, has_cat=has_cat)
     pred = predict_tree(sf, lm, lv, bins, depth)
     f2 = f + lr * pred
     per = _per_row_loss(y, f2, loss)
@@ -183,15 +183,16 @@ def _gbt_round_impl(bins, y, tw, vw, f, fa, cat, lr, min_instances,
 
 _gbt_round = partial(jax.jit, static_argnames=(
     "n_bins", "depth", "impurity", "loss", "use_pallas",
-    "max_leaves"))(_gbt_round_impl)
+    "max_leaves", "has_cat"))(_gbt_round_impl)
 
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
-                                   "n_trees", "use_pallas", "max_leaves"))
+                                   "n_trees", "use_pallas", "max_leaves",
+                                   "has_cat"))
 def _gbt_forest(bins, y, tw, vw, f, fa_all, cat, lr, min_instances,
                 min_gain, n_bins: int, depth: int, impurity: str,
                 loss: str, n_trees: int, use_pallas: bool = False,
-                max_leaves: int = 0):
+                max_leaves: int = 0, has_cat: bool = True):
     """A whole chunk of the GBT forest as ONE executable (``lax.scan`` over
     trees).  The per-tree loop costs one program execution per tree; over a
     remote-device link each execution carries latency that dwarfs the
@@ -204,7 +205,8 @@ def _gbt_forest(bins, y, tw, vw, f, fa_all, cat, lr, min_instances,
     def body(f, fa):
         sf, lm, lv, gfi, f2, tr, va = _gbt_round_impl(
             bins, y, tw, vw, f, fa, cat, lr, min_instances, min_gain,
-            n_bins, depth, impurity, loss, use_pallas, max_leaves)
+            n_bins, depth, impurity, loss, use_pallas, max_leaves,
+            has_cat)
         return f2, _pack_tree_impl(sf, lm, lv, gfi, tr, va)
 
     f_out, packed = jax.lax.scan(body, f, fa_all)
@@ -215,7 +217,7 @@ def _rf_round_impl(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
                    min_instances, min_gain, n_bins: int, depth: int,
                    impurity: str, loss: str, poisson: bool,
                    n_classes: int = 0, use_pallas: bool = False,
-                   max_leaves: int = 0):
+                   max_leaves: int = 0, has_cat: bool = True):
     """One RF tree on device: Poisson bag → grow → oob accumulate →
     loss-consistent oob validation error (reference oob-as-validation,
     ``DTWorker.java:582-616``; round 1 hardcoded squared error).
@@ -237,7 +239,8 @@ def _rf_round_impl(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
             .astype(jnp.float32)
     sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
                                     impurity, min_instances, min_gain,
-                                    n_classes, use_pallas, max_leaves)
+                                    n_classes, use_pallas, max_leaves,
+                                    has_cat)
     pred = predict_tree(sf, lm, lv, bins, depth)   # [n, K] mc, [n] binary
     oob = (bag == 0) & (w > 0)
     if multiclass:
@@ -285,12 +288,12 @@ _pack_tree = jax.jit(_pack_tree_impl)
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
                                    "poisson", "n_classes", "n_trees",
-                                   "use_pallas", "max_leaves"))
+                                   "use_pallas", "max_leaves", "has_cat"))
 def _rf_forest(bins, y, w, base_key, tree_ids, bag_rate, oob_sum, oob_cnt,
                fa_all, cat, min_instances, min_gain, n_bins: int,
                depth: int, impurity: str, loss: str, poisson: bool,
                n_classes: int, n_trees: int, use_pallas: bool = False,
-               max_leaves: int = 0):
+               max_leaves: int = 0, has_cat: bool = True):
     """A chunk of the RF forest as ONE executable (see :func:`_gbt_forest`).
     Per-tree keys fold the tree id into the base key on device — identical
     draws to the per-tree path, so resumed and scanned runs agree."""
@@ -303,7 +306,7 @@ def _rf_forest(bins, y, w, base_key, tree_ids, bag_rate, oob_sum, oob_cnt,
         sf, lm, lv, gfi, oob_sum2, oob_cnt2, tr, va = _rf_round_impl(
             bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
             min_instances, min_gain, n_bins, depth, impurity, loss,
-            poisson, n_classes, use_pallas, max_leaves)
+            poisson, n_classes, use_pallas, max_leaves, has_cat)
         return (oob_sum2, oob_cnt2), _pack_tree_impl(sf, lm, lv, gfi, tr, va)
 
     (oob_sum, oob_cnt), packed = jax.lax.scan(
@@ -384,6 +387,7 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
         wt.astype(np.float32), wv.astype(np.float32))
     f = jnp.full(bins_d.shape[0], init_score, jnp.float32)
     cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
+    hc = bool(np.asarray(cat).any())
 
     trees: List[TreeArrays] = list(init_trees or [])
     for t in trees:  # continuous/resumed training: replay existing trees
@@ -431,7 +435,7 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
                 bins_d, y_d, tw_d, vw_d, f, fa_all, cat,
                 settings.learning_rate, settings.min_instances,
                 settings.min_gain, n_bins, settings.depth, imp,
-                settings.loss, chunk, up, settings.max_leaves)
+                settings.loss, chunk, up, settings.max_leaves, hc)
             before = len(history)
             absorb(np.asarray(packed), with_history=True)
             if progress:
@@ -457,7 +461,7 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
                 bins_d, y_d, tw_d, vw_d, f, fa, cat,
                 settings.learning_rate, settings.min_instances,
                 settings.min_gain, n_bins, settings.depth, imp,
-                settings.loss, up, settings.max_leaves)
+                settings.loss, up, settings.max_leaves, hc)
             pending.append(_pack_tree(sf, lm, lv, gfi, tr, va))
             tr_err, va_err = (float(x) for x in
                               np.asarray(jnp.stack([tr, va])))
@@ -494,6 +498,7 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
         mesh, np.asarray(bins, np.int32), np.asarray(y, np.float32),
         np.asarray(w, np.float32))
     cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
+    hc = bool(np.asarray(cat).any())
     mc = settings.n_classes > 2
     oob_shape = (bins_d.shape[0], settings.n_classes) if mc \
         else (bins_d.shape[0],)
@@ -552,7 +557,7 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
             settings.min_instances, settings.min_gain, n_bins,
             settings.depth, settings.impurity, settings.loss,
             settings.poisson_bagging, settings.n_classes, chunk, up,
-            settings.max_leaves)
+            settings.max_leaves, hc)
         before = len(history)
         absorb(np.asarray(packed), with_history=True)
         if progress:
@@ -747,6 +752,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
         else:
             init_score = prior
     cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
+    hc = bool(np.asarray(cat).any())
     fi_dev = jnp.zeros(c, jnp.float32)     # device-accumulated split gains
 
     f = np.full(n_rows, init_score, np.float32)
@@ -787,7 +793,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                 hist, cat, fa,
                 "friedmanmse" if settings.impurity == "friedmanmse"
                 else "variance",
-                settings.min_instances, settings.min_gain)
+                settings.min_instances, settings.min_gain, has_cat=hc)
             base = n_nodes - 1
             if level == settings.depth:
                 feat = jnp.full(n_nodes, -1, jnp.int32)
@@ -920,6 +926,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
     if c is None:
         raise RuntimeError("streamed RF: empty shard stream")
     cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
+    hc = bool(np.asarray(cat).any())
     oob_sum = np.zeros(n_rows, np.float32)
     oob_cnt = np.zeros(n_rows, np.float32)
     fi_dev = jnp.zeros(c, jnp.float32)     # device-accumulated split gains
@@ -995,7 +1002,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                     up)
             gain, feat, lmask, leaf, _ = best_splits(
                 hist, cat, fa, settings.impurity,
-                settings.min_instances, settings.min_gain)
+                settings.min_instances, settings.min_gain, has_cat=hc)
             base = n_nodes - 1
             if level == settings.depth:
                 feat = jnp.full(n_nodes, -1, jnp.int32)

@@ -245,3 +245,40 @@ def test_gbt_scan_and_loop_paths_identical():
         np.testing.assert_allclose(ts.leaf_value, tl.leaf_value, atol=1e-6)
     for (a, b_), (c_, d) in zip(scan.history, loop.history):
         assert abs(a - c_) < 1e-6 and abs(b_ - d) < 1e-6
+
+
+def test_best_splits_has_cat_fast_path_equivalent():
+    """has_cat=False compiles out the order/gather machinery; it must give
+    bit-identical splits to the general path on all-numeric histograms."""
+    import jax.numpy as jnp
+    from shifu_tpu.ops.tree import best_splits, build_histograms
+
+    rng = np.random.default_rng(5)
+    n, c, b, k = 2000, 6, 8, 4
+    bins = jnp.asarray(rng.integers(0, b, (n, c)), jnp.int32)
+    node = jnp.asarray(rng.integers(0, k, n), jnp.int32)
+    t = jnp.asarray(rng.normal(size=n), jnp.float32)
+    w = jnp.ones(n, jnp.float32)
+    stats = jnp.stack([w, w * t, w * t * t], axis=1)
+    hist = build_histograms(bins, node, stats, k, b)
+    cat = jnp.zeros(c, bool)
+    fa = jnp.ones(c, bool)
+    for imp in ("variance", "friedmanmse", "entropy"):
+        slow = best_splits(hist, cat, fa, imp, 1.0, 0.0, 0, True)
+        fast = best_splits(hist, cat, fa, imp, 1.0, 0.0, 0, False)
+        for a_, b_ in zip(slow, fast):
+            np.testing.assert_allclose(np.asarray(a_), np.asarray(b_),
+                                       atol=1e-6)
+    # multiclass fast branch (cls_o = cls) must agree too
+    kcls = 3
+    yi = rng.integers(0, kcls, n)
+    mc_stats = jnp.asarray(np.eye(kcls, dtype=np.float32)[yi])
+    mhist = build_histograms(bins, node, mc_stats, k, b)
+    for imp in ("entropy", "gini"):
+        slow = best_splits(hist=mhist, cat_mask=cat, feat_active=fa,
+                           impurity=imp, n_classes=kcls, has_cat=True)
+        fast = best_splits(hist=mhist, cat_mask=cat, feat_active=fa,
+                           impurity=imp, n_classes=kcls, has_cat=False)
+        for a_, b_ in zip(slow, fast):
+            np.testing.assert_allclose(np.asarray(a_), np.asarray(b_),
+                                       atol=1e-6)
