@@ -47,6 +47,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-correlation", "-c", dest="correlation", action="store_true")
     sp.add_argument("-psi", dest="psi", action="store_true")
     sp.add_argument("-rebin", dest="rebin", action="store_true")
+    sp.add_argument("-vars", dest="rebin_vars", metavar="A,B",
+                    help="rebin only these columns (reference -vars)")
+    sp.add_argument("-ivr", dest="rebin_ivr", type=float, default=None,
+                    help="rebin IV keep ratio (reference -ivr)")
+    sp.add_argument("-bic", dest="rebin_bic", type=int, default=None,
+                    help="rebin minimum bin instance count (reference -bic)")
 
     sp = sub.add_parser("norm", aliases=["normalize", "transform"],
                         help="normalize training data")
@@ -60,6 +66,11 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="N", help="SE/ST wrapper rounds: each round "
                     "re-norms + retrains on the current selection, then "
                     "re-scores sensitivity")
+    sp.add_argument("-autofilter", dest="autofilter", action="store_true",
+                    help="apply only the missing-rate/KS/IV/correlation "
+                    "auto filter to the current selection")
+    sp.add_argument("-recoverauto", dest="recoverauto", action="store_true",
+                    help="restore variables removed by the last -autofilter")
 
     sp = sub.add_parser("train", help="train model(s)")
     sp.add_argument("-dry", dest="dry", action="store_true")

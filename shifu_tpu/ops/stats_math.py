@@ -90,8 +90,8 @@ def _iv_terms(neg: np.ndarray, pos: np.ndarray,
 
 
 def merge_adjacent_by_iv(neg: np.ndarray, pos: np.ndarray,
-                         target_bins: int, iv_keep: float = 0.95
-                         ) -> list:
+                         target_bins: int, iv_keep: float = 0.95,
+                         min_inst: int = 0) -> list:
     """IV-driven adjacent bin merge (reference ``DynamicBinning`` /
     ``AutoDynamicBinning``: merge bins while information value survives).
 
@@ -117,6 +117,14 @@ def merge_adjacent_by_iv(neg: np.ndarray, pos: np.ndarray,
         cand = float(t.sum()) - t[:-1] - t[1:] + tm  # IV after each merge
         i = int(np.argmax(cand))
         need_shrink = len(groups) > target_bins
+        # reference -bic: bins under the minimum instance count must merge
+        # regardless of IV (DynamicBinningUDF minimumBinInstCnt)
+        tiny = (neg + pos) < min_inst if min_inst > 0 else None
+        if tiny is not None and tiny.any() and not need_shrink:
+            j = int(np.argmin(neg + pos))
+            i = j if j < len(cand) and (j == 0 or cand[j] >= cand[j - 1]) \
+                else max(j - 1, 0)
+            need_shrink = True
         if not need_shrink and (iv0 <= 0 or cand[i] < iv_keep * iv0):
             break
         neg[i] += neg[i + 1]

@@ -410,9 +410,19 @@ def _dynamic_rebin(self) -> None:
 
     target = int(environment.get_property("shifu.rebin.maxNumBin",
                                           self.model_config.stats.maxNumBin))
-    iv_keep = float(environment.get_property("shifu.rebin.ivKeepRatio", 0.95))
+    _ivr = self.params.get("rebin_ivr")
+    iv_keep = float(_ivr) if _ivr is not None else \
+        float(environment.get_property("shifu.rebin.ivKeepRatio", 0.95))
+    _bic = self.params.get("rebin_bic")
+    min_inst = int(_bic) if _bic is not None else \
+        int(environment.get_property("shifu.rebin.minBinInstCnt", 0))
+    only = {v.strip() for v in (self.params.get("rebin_vars") or "").split(",")
+            if v.strip()}
+    from ..config.column_config import ns_in
     merged_cols = 0
     for cc in self.column_configs:
+        if only and not ns_in(cc.columnName, only):
+            continue
         bn = cc.columnBinning
         if not bn.binCountNeg or len(bn.binCountNeg) < 4:
             continue
@@ -426,7 +436,7 @@ def _dynamic_rebin(self) -> None:
         groups = merge_adjacent_by_iv(
             np.asarray([neg[i] for i in order], np.float64),
             np.asarray([pos[i] for i in order], np.float64),
-            target, iv_keep)
+            target, iv_keep, min_inst)
         if len(groups) >= len(neg):
             continue
         merged_cols += 1

@@ -64,6 +64,10 @@ class VarSelectProcessor(BasicProcessor):
             return self._reset()
         if self.params.get("recover"):
             return self._recover()
+        if self.params.get("autofilter"):
+            return self._autofilter_only()
+        if self.params.get("recoverauto"):
+            return self._recover_auto()
         return self._select()
 
     # ---------------------------------------------------------- bookkeeping
@@ -111,6 +115,63 @@ class VarSelectProcessor(BasicProcessor):
                  "selected": [c.columnNum for c in self._selected()]}
         with open(self.paths.varsel_history_path, "a") as f:
             f.write(json.dumps(entry) + "\n")
+
+    # ------------------------------------------------- standalone autofilter
+    def _autofilter_only(self) -> int:
+        """``varselect -autofilter`` (reference ``ShifuCLI.java:836``):
+        apply ONLY the missing-rate/KS/IV/correlation auto filter to the
+        currently selected columns, recording what it turned off so
+        ``-recoverauto`` can undo it."""
+        vs = self.model_config.varSelect
+        selected = [c for c in self.column_configs
+                    if c.finalSelect and not c.is_force_select()]
+        if not selected:
+            log.error("no selected columns to auto-filter — run a "
+                      "selection first")
+            return 1
+        kept = {c.columnNum for c in self._auto_filter(selected, vs)}
+        removed = [c.columnNum for c in selected if c.columnNum not in kept]
+        if not removed:
+            log.info("autofilter: nothing to remove (%d columns pass)",
+                     len(kept))
+            return 0
+        for c in selected:
+            c.finalSelect = c.columnNum in kept
+        os.makedirs(self.paths.varsel_dir, exist_ok=True)
+        with open(self._autofilter_history_path(), "a") as f:
+            f.write(json.dumps({"ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+                                "removed": removed}) + "\n")
+        self.save_column_configs()
+        log.info("autofilter: %d kept, %d removed", len(kept), len(removed))
+        return 0
+
+    def _recover_auto(self) -> int:
+        """``varselect -recoverauto``: restore the variables the last
+        ``-autofilter`` run turned off (reference ``ShifuCLI.java:837``)."""
+        path = self._autofilter_history_path()
+        if not os.path.isfile(path):
+            log.error("no autofilter history to recover from")
+            return 1
+        lines = open(path).read().strip().splitlines()
+        if not lines:
+            log.error("autofilter history empty")
+            return 1
+        last = json.loads(lines[-1])
+        removed = set(last["removed"])
+        n = 0
+        for c in self.column_configs:
+            if c.columnNum in removed:
+                c.finalSelect = True
+                n += 1
+        self.save_column_configs()
+        with open(path, "w") as f:
+            f.write("\n".join(lines[:-1]) + ("\n" if lines[:-1] else ""))
+        log.info("recovered %d auto-filtered columns (ts %s)", n,
+                 last.get("ts"))
+        return 0
+
+    def _autofilter_history_path(self) -> str:
+        return os.path.join(self.paths.varsel_dir, "autofilter.history")
 
     # ------------------------------------------------------------- selection
     def _select(self) -> int:
@@ -183,11 +244,26 @@ class VarSelectProcessor(BasicProcessor):
             return 0
 
         fb = vs.filterBy
+        alg = self.model_config.train.algorithm.name
         if fb in (FilterBy.SE, FilterBy.ST):
+            # reference VarSelectModelProcessor.java:196-200: SE/ST score a
+            # trained NN/LR; a tree model cannot be column-frozen this way
+            if alg not in ("NN", "LR", "SVM", "TENSORFLOW"):
+                from ..config.validator import ValidationError
+                raise ValidationError(
+                    [f"varSelect.filterBy {fb.name} needs an NN/LR model "
+                     f"(train.algorithm is {alg}) — use filterBy FI for "
+                     "tree models"])
             scores = self._sensitivity_scores(candidates, fb)
         elif fb == FilterBy.GENETIC:
             scores = self._genetic_scores(candidates, vs)
         elif fb == FilterBy.FI:
+            # reference :188-193: FI comes from tree forests only
+            if alg not in ("GBT", "RF", "DT"):
+                from ..config.validator import ValidationError
+                raise ValidationError(
+                    [f"varSelect.filterBy FI needs a tree model "
+                     f"(train.algorithm is {alg}) — use SE/ST for NN/LR"])
             scores = self._fi_scores(candidates)
         elif fb == FilterBy.IV:
             scores = {c.columnNum: c.columnStats.iv or 0 for c in candidates}

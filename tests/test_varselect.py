@@ -168,3 +168,49 @@ def test_varselect_recursive_rejects_filter_modes(model_set):
     _prep(model_set)
     assert VarSelectProcessor(model_set,
                               params={"recursive": 3}).run() == 1
+
+
+def test_varselect_autofilter_and_recoverauto(model_set):
+    """`varselect -autofilter` prunes the current selection by
+    missing-rate/KS/IV thresholds and `-recoverauto` undoes it
+    (reference ShifuCLI.java:836-837)."""
+    from shifu_tpu.pipeline.varselect import VarSelectProcessor
+    _prep(model_set)
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.varSelect.filterNum = 100           # select everything first
+    mc.save(mc_path)
+    assert VarSelectProcessor(model_set, params={}).run() == 0
+    before = {c.columnNum for c in _ccs(model_set) if c.finalSelect}
+    assert before
+    # raise the KS bar so the filter has something to remove
+    mc.varSelect.minKsThreshold = \
+        sorted((c.columnStats.ks or 0) for c in _ccs(model_set)
+               if c.finalSelect)[-1] * 0.99
+    mc.save(mc_path)
+    assert VarSelectProcessor(model_set,
+                              params={"autofilter": True}).run() == 0
+    after = {c.columnNum for c in _ccs(model_set) if c.finalSelect}
+    assert after < before                  # strictly pruned
+    hist = os.path.join(model_set, "varsels", "autofilter.history")
+    assert os.path.isfile(hist)
+    assert VarSelectProcessor(model_set,
+                              params={"recoverauto": True}).run() == 0
+    recovered = {c.columnNum for c in _ccs(model_set) if c.finalSelect}
+    assert recovered == before
+
+
+def test_varselect_se_rejects_tree_algorithm(model_set):
+    """Reference VarSelectModelProcessor.java:196-200: SE/ST needs NN/LR."""
+    from shifu_tpu.config.model_config import Algorithm, FilterBy
+    from shifu_tpu.config.validator import ValidationError
+    from shifu_tpu.pipeline.varselect import VarSelectProcessor
+    _prep(model_set)
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.varSelect.filterBy = FilterBy.SE
+    mc.train.algorithm = Algorithm.RF
+    mc.save(mc_path)
+    with pytest.raises(ValidationError) as e:
+        VarSelectProcessor(model_set, params={}).run()
+    assert "needs an NN/LR model" in str(e.value)
