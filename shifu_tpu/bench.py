@@ -139,17 +139,19 @@ def bench_gbt_streamed(n_rows: int = 1 << 16, n_features: int = 64,
                        "numShards": n_shards, "numRows": n_rows}, f)
         stream = ShardStream(Shards.open(td), ("bins", "y", "w"),
                              window_rows=16384)
-        # compile warmup (same shapes/levels as the timed run)
-        train_gbt_streamed(stream, n_bins, cat,
-                           DTSettings(n_trees=1, depth=depth, loss="log",
-                                      learning_rate=0.1))
         settings = DTSettings(n_trees=n_trees, depth=depth, loss="log",
                               learning_rate=0.1)
-        t0 = time.perf_counter()
-        res = train_gbt_streamed(stream, n_bins, cat, settings)
-        dt = time.perf_counter() - t0
-        assert res.trees_built == n_trees
-    return n_rows * n_trees / dt
+        # compile warmup: identical settings so every executable (fused
+        # tree, batched drain) is cached before timing
+        train_gbt_streamed(stream, n_bins, cat, settings)
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            res = train_gbt_streamed(stream, n_bins, cat, settings)
+            dt = time.perf_counter() - t0
+            assert res.trees_built == n_trees
+            best = max(best, n_rows * n_trees / dt)
+    return best
 
 
 def bench_eval(n_rows: int = 1 << 18, n_features: int = 256,

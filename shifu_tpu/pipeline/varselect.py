@@ -89,22 +89,30 @@ class VarSelectProcessor(BasicProcessor):
         log.info("selection reset")
         return 0
 
-    def _recover(self) -> int:
-        hist = self.paths.varsel_history_path
-        if not os.path.isfile(hist):
-            log.error("no varsel history to recover from")
-            return 1
-        lines = open(hist).read().strip().splitlines()
+    @staticmethod
+    def _pop_last_history(path: str, what: str):
+        """Pop and return the last JSONL entry of a history file; None
+        (with a logged error) when there is nothing to pop."""
+        if not os.path.isfile(path):
+            log.error("no %s history to recover from", what)
+            return None
+        lines = open(path).read().strip().splitlines()
         if not lines:
-            log.error("varsel history empty")
+            log.error("%s history empty", what)
+            return None
+        with open(path, "w") as f:
+            f.write("\n".join(lines[:-1]) + ("\n" if lines[:-1] else ""))
+        return json.loads(lines[-1])
+
+    def _recover(self) -> int:
+        last = self._pop_last_history(self.paths.varsel_history_path,
+                                      "varsel")
+        if last is None:
             return 1
-        last = json.loads(lines[-1])
         sel = set(last["selected"])
         for c in self.column_configs:
             c.finalSelect = c.columnNum in sel
         self.save_column_configs()
-        with open(hist, "w") as f:
-            f.write("\n".join(lines[:-1]) + ("\n" if lines[:-1] else ""))
         log.info("recovered selection of %d columns (ts %s)", len(sel),
                  last.get("ts"))
         return 0
@@ -148,15 +156,10 @@ class VarSelectProcessor(BasicProcessor):
     def _recover_auto(self) -> int:
         """``varselect -recoverauto``: restore the variables the last
         ``-autofilter`` run turned off (reference ``ShifuCLI.java:837``)."""
-        path = self._autofilter_history_path()
-        if not os.path.isfile(path):
-            log.error("no autofilter history to recover from")
+        last = self._pop_last_history(self._autofilter_history_path(),
+                                      "autofilter")
+        if last is None:
             return 1
-        lines = open(path).read().strip().splitlines()
-        if not lines:
-            log.error("autofilter history empty")
-            return 1
-        last = json.loads(lines[-1])
         removed = set(last["removed"])
         n = 0
         for c in self.column_configs:
@@ -164,8 +167,6 @@ class VarSelectProcessor(BasicProcessor):
                 c.finalSelect = True
                 n += 1
         self.save_column_configs()
-        with open(path, "w") as f:
-            f.write("\n".join(lines[:-1]) + ("\n" if lines[:-1] else ""))
         log.info("recovered %d auto-filtered columns (ts %s)", n,
                  last.get("ts"))
         return 0
@@ -174,8 +175,29 @@ class VarSelectProcessor(BasicProcessor):
         return os.path.join(self.paths.varsel_dir, "autofilter.history")
 
     # ------------------------------------------------------------- selection
+    def _check_filterby_algorithm(self) -> None:
+        """filterBy vs train.algorithm compatibility (reference
+        ``VarSelectModelProcessor.java:188-200``) — checked BEFORE any side
+        effect (history push, recursive retrain rounds)."""
+        vs = self.model_config.varSelect
+        if not vs.filterEnable:
+            return
+        fb, alg = vs.filterBy, self.model_config.train.algorithm.name
+        from ..config.validator import ValidationError
+        if fb in (FilterBy.SE, FilterBy.ST) and \
+                alg not in ("NN", "LR", "SVM", "TENSORFLOW"):
+            raise ValidationError(
+                [f"varSelect.filterBy {fb.name} needs an NN/LR model "
+                 f"(train.algorithm is {alg}) — use filterBy FI for "
+                 "tree models"])
+        if fb == FilterBy.FI and alg not in ("GBT", "RF", "DT"):
+            raise ValidationError(
+                [f"varSelect.filterBy FI needs a tree model "
+                 f"(train.algorithm is {alg}) — use SE/ST for NN/LR"])
+
     def _select(self) -> int:
         vs = self.model_config.varSelect
+        self._check_filterby_algorithm()
         rounds = int(self.params.get("recursive") or 1)
         if rounds > 1:
             if vs.filterBy not in (FilterBy.SE, FilterBy.ST):
@@ -244,26 +266,11 @@ class VarSelectProcessor(BasicProcessor):
             return 0
 
         fb = vs.filterBy
-        alg = self.model_config.train.algorithm.name
         if fb in (FilterBy.SE, FilterBy.ST):
-            # reference VarSelectModelProcessor.java:196-200: SE/ST score a
-            # trained NN/LR; a tree model cannot be column-frozen this way
-            if alg not in ("NN", "LR", "SVM", "TENSORFLOW"):
-                from ..config.validator import ValidationError
-                raise ValidationError(
-                    [f"varSelect.filterBy {fb.name} needs an NN/LR model "
-                     f"(train.algorithm is {alg}) — use filterBy FI for "
-                     "tree models"])
             scores = self._sensitivity_scores(candidates, fb)
         elif fb == FilterBy.GENETIC:
             scores = self._genetic_scores(candidates, vs)
         elif fb == FilterBy.FI:
-            # reference :188-193: FI comes from tree forests only
-            if alg not in ("GBT", "RF", "DT"):
-                from ..config.validator import ValidationError
-                raise ValidationError(
-                    [f"varSelect.filterBy FI needs a tree model "
-                     f"(train.algorithm is {alg}) — use SE/ST for NN/LR"])
             scores = self._fi_scores(candidates)
         elif fb == FilterBy.IV:
             scores = {c.columnNum: c.columnStats.iv or 0 for c in candidates}

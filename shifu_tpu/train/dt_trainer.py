@@ -644,6 +644,82 @@ def _rf_window_update(bins_w, y_w, w_w, bag_w, oob_sum_w, oob_cnt_w,
     return oob_sum2, oob_cnt2, sums
 
 
+
+
+def _tree_level_step(hist, cat, fa, impurity: str, min_instances,
+                     min_gain, has_cat: bool, level: int, depth: int,
+                     max_leaves: int, sf, lm, lv, nodes_cnt, fi_add):
+    """One level of streamed tree growth from an aggregated histogram —
+    the single implementation behind both the fused-resident executable
+    and the disk-tail window loop (they must never drift)."""
+    n_nodes = 1 << level
+    gain, feat, lmask, leaf, _ = best_splits(
+        hist, cat, fa, impurity, min_instances, min_gain, has_cat=has_cat)
+    base = n_nodes - 1
+    if level == depth:
+        feat = jnp.full(n_nodes, -1, jnp.int32)
+        lmask = jnp.zeros((n_nodes, hist.shape[2]), bool)
+    elif max_leaves > 0:
+        feat, lmask, nodes_cnt = cap_splits_by_leaves(
+            gain, feat, lmask, nodes_cnt, max_leaves)
+    sf = sf.at[base:base + n_nodes].set(feat)
+    lm = lm.at[base:base + n_nodes].set(lmask)
+    lv = lv.at[base:base + n_nodes].set(leaf)
+    fi_add = fi_add + jax.ops.segment_sum(
+        jnp.where(feat >= 0, jnp.maximum(gain, 0.0),
+                  0.0).astype(jnp.float32),
+        jnp.maximum(feat, 0), num_segments=hist.shape[1])
+    return sf, lm, lv, nodes_cnt, fi_add
+
+
+@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
+                                   "use_pallas", "max_leaves", "has_cat"))
+def _gbt_tree_fused(wins, fa, cat, lr, min_instances, min_gain,
+                    n_bins: int, depth: int, impurity: str, loss: str,
+                    use_pallas: bool, max_leaves: int, has_cat: bool):
+    """One streamed GBT tree over a FULLY-RESIDENT window cache as a single
+    executable: all (depth+1) level sweeps + the update pass fuse, so a
+    tree costs one program execution + one packed fetch — the per-level
+    per-window dispatch pattern only remains for disk-tail windows.
+
+    ``wins``: tuple of (bins, y, tw, vw, f) per resident window (static
+    count/shapes).  Returns (packed [tree + fi + sums], new f per window).
+    """
+    total = n_tree_nodes(depth)
+    c = wins[0][0].shape[1]
+    sf = jnp.full(total, -1, jnp.int32)
+    lm = jnp.zeros((total, n_bins), bool)
+    lv = jnp.zeros(total, jnp.float32)
+    nodes_cnt = jnp.int32(1)
+    fi_add = jnp.zeros(c, jnp.float32)
+    for level in range(depth + 1):
+        n_nodes = 1 << level
+        hist = jnp.zeros((n_nodes, c, n_bins, 3), jnp.float32)
+        for bins_w, y_w, tw_w, _, f_w in wins:
+            node_idx = node_index_at_level(sf, lm, bins_w, level)
+            grad = _loss_grad(y_w, f_w, loss)
+            stats = jnp.stack([tw_w, tw_w * grad, tw_w * grad * grad],
+                              axis=1).astype(jnp.float32)
+            hist = hist + build_histograms(bins_w, node_idx, stats,
+                                           n_nodes, n_bins, use_pallas)
+        sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
+            hist, cat, fa, impurity, min_instances, min_gain, has_cat,
+            level, depth, max_leaves, sf, lm, lv, nodes_cnt, fi_add)
+    sums = jnp.zeros(4, jnp.float32)
+    new_f = []
+    for bins_w, y_w, tw_w, vw_w, f_w in wins:
+        pred = predict_tree(sf, lm, lv, bins_w, depth)
+        f2 = f_w + lr * pred
+        per = _per_row_loss(y_w, f2, loss)
+        sums = sums + jnp.stack([(per * tw_w).sum(), tw_w.sum(),
+                                 (per * vw_w).sum(), vw_w.sum()])
+        new_f.append(f2)
+    packed = jnp.concatenate([
+        sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
+        lv, fi_add, sums])
+    return packed, tuple(new_f)
+
+
 def _device_put_window(mesh, arrays: Dict[str, np.ndarray]) -> Dict[str, Any]:
     """Place a window's arrays: mesh-sharded over the data axis when a mesh
     is given (rows must divide the axis), plain device arrays otherwise."""
@@ -775,12 +851,68 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
             return fw
         return _window_f(f, it, mesh)
 
-    for ti in range(len(trees), settings.n_trees):
+    imp = "friedmanmse" if settings.impurity == "friedmanmse" else "variance"
+    pending_fused: List[Any] = []
+
+    def absorb_fused(flat_list) -> None:
+        nonlocal fi_dev
+        for packed in flat_list:
+            sf_h, lm_h, lv_h, fi_h, sums = np.split(
+                packed, np.cumsum([total, total * n_bins, total, c]))
+            fi_dev = fi_dev + jnp.asarray(fi_h.astype(np.float32))
+            trees.append(TreeArrays(
+                split_feat=sf_h.astype(np.int32),
+                left_mask=lm_h.reshape(total, n_bins) > 0.5,
+                leaf_value=lv_h.astype(np.float32),
+                depth=settings.depth))
+            history.append((float(sums[0]) / max(float(sums[1]), 1e-9),
+                            float(sums[2]) / max(float(sums[3]), 1e-9)))
+
+    def drain_fused() -> None:
+        if pending_fused:
+            absorb_fused(np.asarray(jnp.stack(pending_fused)))
+            pending_fused.clear()
+
+    sync_each = bool(progress) or settings.early_stop
+    for ti in range(len(trees) + len(pending_fused), settings.n_trees):
         fa = jnp.asarray(_feat_subset(settings, c, ti))
+        all_resident = cache.tail is None
+        if all_resident:
+            # everything fits the device budget: the whole tree (levels +
+            # update) is ONE executable (see _gbt_tree_fused); with no
+            # live consumer the packed trees drain in one batched fetch
+            items = list(cache.items())
+            wins = tuple((it.arrays["bins"], it.arrays["y"],
+                          it.arrays["tw"], it.arrays["vw"], window_f(it))
+                         for it in items)
+            packed_d, new_f = _gbt_tree_fused(
+                wins, fa, cat, settings.learning_rate,
+                settings.min_instances, settings.min_gain, n_bins,
+                settings.depth, imp, settings.loss, up,
+                settings.max_leaves, hc)
+            for it, f2 in zip(items, new_f):
+                it.arrays["f"] = f2
+            if sync_each:
+                absorb_fused([np.asarray(packed_d)])
+                tr_err, va_err = history[-1]
+                if progress:
+                    progress(ti, tr_err, va_err)
+                if settings.early_stop and stopper.add(va_err):
+                    log.info("GBT early stop after %d trees (streamed)",
+                             ti + 1)
+                    break
+            else:
+                pending_fused.append(packed_d)
+            if checkpoint_fn and settings.checkpoint_every and \
+                    (ti + 1) % settings.checkpoint_every == 0:
+                drain_fused()
+                checkpoint_fn(trees, history, init_score)
+            continue
         sf = jnp.full(total, -1, jnp.int32)
         lm = jnp.zeros((total, n_bins), bool)
         lv = jnp.zeros(total, jnp.float32)
         nodes_cnt = jnp.int32(1)
+        fi_add = jnp.zeros(c, jnp.float32)
         for level in range(settings.depth + 1):
             n_nodes = 1 << level
             hist = jnp.zeros((n_nodes, c, n_bins, 3), jnp.float32)
@@ -789,28 +921,14 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                     it.arrays["bins"], it.arrays["y"], it.arrays["tw"],
                     window_f(it), sf, lm,
                     n_nodes, n_bins, level, settings.loss, up)
-            gain, feat, lmask, leaf, _ = best_splits(
-                hist, cat, fa,
-                "friedmanmse" if settings.impurity == "friedmanmse"
-                else "variance",
-                settings.min_instances, settings.min_gain, has_cat=hc)
-            base = n_nodes - 1
-            if level == settings.depth:
-                feat = jnp.full(n_nodes, -1, jnp.int32)
-                lmask = jnp.zeros((n_nodes, n_bins), bool)
-            elif settings.max_leaves > 0:
-                feat, lmask, nodes_cnt = cap_splits_by_leaves(
-                    gain, feat, lmask, nodes_cnt, settings.max_leaves)
-            sf = sf.at[base:base + n_nodes].set(feat)
-            lm = lm.at[base:base + n_nodes].set(lmask)
-            lv = lv.at[base:base + n_nodes].set(leaf)
-            fi_dev = fi_dev + jax.ops.segment_sum(
-                jnp.where(feat >= 0, jnp.maximum(gain, 0.0),
-                          0.0).astype(jnp.float32),
-                jnp.maximum(feat, 0), num_segments=c)
+            sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
+                hist, cat, fa, imp, settings.min_instances,
+                settings.min_gain, hc, level, settings.depth,
+                settings.max_leaves, sf, lm, lv, nodes_cnt, fi_add)
         # update pass: f caches + error sums, all device-side; ONE packed
-        # fetch per tree (tree arrays + sums) — tail windows additionally
-        # round-trip their f slice (they are disk-bound anyway)
+        # fetch per tree (same layout as the fused path, absorbed by
+        # absorb_fused) — tail windows additionally round-trip their f
+        # slice (they are disk-bound anyway)
         sums_dev = jnp.zeros(4, jnp.float32)
         for it in cache.items():
             f2, s4 = _gbt_window_update(
@@ -824,18 +942,10 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                 s, e = it.start, it.start + it.n_valid
                 f[s:e] = np.asarray(f2)[:it.n_valid]
             sums_dev = sums_dev + s4
-        packed = np.asarray(jnp.concatenate([
+        absorb_fused([np.asarray(jnp.concatenate([
             sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
-            lv, sums_dev]))
-        sf_h, lm_h, lv_h, sums = np.split(
-            packed, np.cumsum([total, total * n_bins, total]))
-        trees.append(TreeArrays(split_feat=sf_h.astype(np.int32),
-                                left_mask=lm_h.reshape(total, n_bins) > 0.5,
-                                leaf_value=lv_h.astype(np.float32),
-                                depth=settings.depth))
-        tr_err = float(sums[0]) / max(float(sums[1]), 1e-9)
-        va_err = float(sums[2]) / max(float(sums[3]), 1e-9)
-        history.append((tr_err, va_err))
+            lv, fi_add, sums_dev]))])
+        tr_err, va_err = history[-1]
         if progress:
             progress(ti, tr_err, va_err)
         if checkpoint_fn and settings.checkpoint_every and \
@@ -844,6 +954,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
         if settings.early_stop and stopper.add(va_err):
             log.info("GBT early stop after %d trees (streamed)", ti + 1)
             break
+    drain_fused()
     return ForestResult(
         trees=trees,
         spec_kwargs={"algorithm": "GBT", "loss": settings.loss,
