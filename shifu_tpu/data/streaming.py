@@ -330,6 +330,12 @@ class ResidentCache:
     def resident_rows(self) -> int:
         return sum(it.n_valid for it in self.cached)
 
+    @property
+    def warmed(self) -> bool:
+        """True once the first full sweep has classified every window as
+        resident or tail — ``tail`` is only meaningful after this."""
+        return self._warm
+
 
 def auto_window_rows(row_bytes: int, budget_bytes: int,
                      multiple: int = 8, lo: int = 1024,

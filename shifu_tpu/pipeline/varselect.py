@@ -90,32 +90,32 @@ class VarSelectProcessor(BasicProcessor):
         return 0
 
     @staticmethod
-    def _pop_last_history(path: str, what: str):
-        """Pop and return the last JSONL entry of a history file; None
-        (with a logged error) when there is nothing to pop."""
+    def _pop_last_history(path: str, what: str, apply_fn) -> bool:
+        """Parse the last JSONL entry of a history file, run ``apply_fn``
+        on it, and only THEN truncate the file — a failure while parsing
+        or applying leaves the undo entry intact for a retry."""
         if not os.path.isfile(path):
             log.error("no %s history to recover from", what)
-            return None
+            return False
         lines = open(path).read().strip().splitlines()
         if not lines:
             log.error("%s history empty", what)
-            return None
+            return False
+        apply_fn(json.loads(lines[-1]))
         with open(path, "w") as f:
             f.write("\n".join(lines[:-1]) + ("\n" if lines[:-1] else ""))
-        return json.loads(lines[-1])
+        return True
 
     def _recover(self) -> int:
-        last = self._pop_last_history(self.paths.varsel_history_path,
-                                      "varsel")
-        if last is None:
-            return 1
-        sel = set(last["selected"])
-        for c in self.column_configs:
-            c.finalSelect = c.columnNum in sel
-        self.save_column_configs()
-        log.info("recovered selection of %d columns (ts %s)", len(sel),
-                 last.get("ts"))
-        return 0
+        def apply(last):
+            sel = set(last["selected"])
+            for c in self.column_configs:
+                c.finalSelect = c.columnNum in sel
+            self.save_column_configs()
+            log.info("recovered selection of %d columns (ts %s)",
+                     len(sel), last.get("ts"))
+        return 0 if self._pop_last_history(
+            self.paths.varsel_history_path, "varsel", apply) else 1
 
     def _push_history(self) -> None:
         os.makedirs(self.paths.varsel_dir, exist_ok=True)
@@ -156,20 +156,18 @@ class VarSelectProcessor(BasicProcessor):
     def _recover_auto(self) -> int:
         """``varselect -recoverauto``: restore the variables the last
         ``-autofilter`` run turned off (reference ``ShifuCLI.java:837``)."""
-        last = self._pop_last_history(self._autofilter_history_path(),
-                                      "autofilter")
-        if last is None:
-            return 1
-        removed = set(last["removed"])
-        n = 0
-        for c in self.column_configs:
-            if c.columnNum in removed:
-                c.finalSelect = True
-                n += 1
-        self.save_column_configs()
-        log.info("recovered %d auto-filtered columns (ts %s)", n,
-                 last.get("ts"))
-        return 0
+        def apply(last):
+            removed = set(last["removed"])
+            n = 0
+            for c in self.column_configs:
+                if c.columnNum in removed:
+                    c.finalSelect = True
+                    n += 1
+            self.save_column_configs()
+            log.info("recovered %d auto-filtered columns (ts %s)", n,
+                     last.get("ts"))
+        return 0 if self._pop_last_history(
+            self._autofilter_history_path(), "autofilter", apply) else 1
 
     def _autofilter_history_path(self) -> str:
         return os.path.join(self.paths.varsel_dir, "autofilter.history")

@@ -646,6 +646,18 @@ def _rf_window_update(bins_w, y_w, w_w, bag_w, oob_sum_w, oob_cnt_w,
 
 
 
+def _unpack_streamed(packed: np.ndarray, total: int, n_bins: int, c: int,
+                     depth: int):
+    """Host-side inverse of the fused/streamed packed layout
+    [sf, lm, lv, fi, sums] — the ONE place that knows it."""
+    sf_h, lm_h, lv_h, fi_h, sums = np.split(
+        packed, np.cumsum([total, total * n_bins, total, c]))
+    tree = TreeArrays(split_feat=sf_h.astype(np.int32),
+                      left_mask=lm_h.reshape(total, n_bins) > 0.5,
+                      leaf_value=lv_h.astype(np.float32), depth=depth)
+    return tree, fi_h.astype(np.float32), sums
+
+
 def _tree_level_step(hist, cat, fa, impurity: str, min_instances,
                      min_gain, has_cat: bool, level: int, depth: int,
                      max_leaves: int, sf, lm, lv, nodes_cnt, fi_add):
@@ -718,6 +730,49 @@ def _gbt_tree_fused(wins, fa, cat, lr, min_instances, min_gain,
         sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
         lv, fi_add, sums])
     return packed, tuple(new_f)
+
+
+
+@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
+                                   "use_pallas", "max_leaves", "has_cat"))
+def _rf_tree_fused(wins, fa, cat, min_instances, min_gain, n_bins: int,
+                   depth: int, impurity: str, loss: str,
+                   use_pallas: bool, max_leaves: int, has_cat: bool):
+    """One streamed RF tree over a FULLY-RESIDENT window cache as a single
+    executable (see :func:`_gbt_tree_fused`).  ``wins``: tuple of
+    (bins, y, w, bag, oob_sum, oob_cnt) per window.  Returns
+    (packed [tree + fi + sums], new (oob_sum, oob_cnt) per window)."""
+    total = n_tree_nodes(depth)
+    c = wins[0][0].shape[1]
+    sf = jnp.full(total, -1, jnp.int32)
+    lm = jnp.zeros((total, n_bins), bool)
+    lv = jnp.zeros(total, jnp.float32)
+    nodes_cnt = jnp.int32(1)
+    fi_add = jnp.zeros(c, jnp.float32)
+    for level in range(depth + 1):
+        n_nodes = 1 << level
+        hist = jnp.zeros((n_nodes, c, n_bins, 3), jnp.float32)
+        for bins_w, y_w, w_w, bag_w, _, _ in wins:
+            bw = w_w * bag_w
+            node_idx = node_index_at_level(sf, lm, bins_w, level)
+            stats = jnp.stack([bw, bw * y_w, bw * y_w * y_w],
+                              axis=1).astype(jnp.float32)
+            hist = hist + build_histograms(bins_w, node_idx, stats,
+                                           n_nodes, n_bins, use_pallas)
+        sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
+            hist, cat, fa, impurity, min_instances, min_gain, has_cat,
+            level, depth, max_leaves, sf, lm, lv, nodes_cnt, fi_add)
+    sums = jnp.zeros(4, jnp.float32)
+    new_oob = []
+    for bins_w, y_w, w_w, bag_w, os_w, oc_w in wins:
+        os2, oc2, s4 = _rf_window_update(
+            bins_w, y_w, w_w, bag_w, os_w, oc_w, sf, lm, lv, depth, loss)
+        sums = sums + s4
+        new_oob.append((os2, oc2))
+    packed = jnp.concatenate([
+        sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
+        lv, fi_add, sums])
+    return packed, tuple(new_oob)
 
 
 def _device_put_window(mesh, arrays: Dict[str, np.ndarray]) -> Dict[str, Any]:
@@ -857,14 +912,10 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
     def absorb_fused(flat_list) -> None:
         nonlocal fi_dev
         for packed in flat_list:
-            sf_h, lm_h, lv_h, fi_h, sums = np.split(
-                packed, np.cumsum([total, total * n_bins, total, c]))
-            fi_dev = fi_dev + jnp.asarray(fi_h.astype(np.float32))
-            trees.append(TreeArrays(
-                split_feat=sf_h.astype(np.int32),
-                left_mask=lm_h.reshape(total, n_bins) > 0.5,
-                leaf_value=lv_h.astype(np.float32),
-                depth=settings.depth))
+            tree, fi_h, sums = _unpack_streamed(packed, total, n_bins, c,
+                                                settings.depth)
+            fi_dev = fi_dev + jnp.asarray(fi_h)
+            trees.append(tree)
             history.append((float(sums[0]) / max(float(sums[1]), 1e-9),
                             float(sums[2]) / max(float(sums[3]), 1e-9)))
 
@@ -876,8 +927,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
     sync_each = bool(progress) or settings.early_stop
     for ti in range(len(trees) + len(pending_fused), settings.n_trees):
         fa = jnp.asarray(_feat_subset(settings, c, ti))
-        all_resident = cache.tail is None
-        if all_resident:
+        if cache.warmed and cache.tail is None:
             # everything fits the device budget: the whole tree (levels +
             # update) is ONE executable (see _gbt_tree_fused); with no
             # live consumer the packed trees drain in one batched fetch
@@ -897,16 +947,17 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                 tr_err, va_err = history[-1]
                 if progress:
                     progress(ti, tr_err, va_err)
-                if settings.early_stop and stopper.add(va_err):
-                    log.info("GBT early stop after %d trees (streamed)",
-                             ti + 1)
-                    break
             else:
                 pending_fused.append(packed_d)
             if checkpoint_fn and settings.checkpoint_every and \
                     (ti + 1) % settings.checkpoint_every == 0:
                 drain_fused()
                 checkpoint_fn(trees, history, init_score)
+            if sync_each and settings.early_stop and \
+                    stopper.add(history[-1][1]):
+                log.info("GBT early stop after %d trees (streamed)",
+                         ti + 1)
+                break
             continue
         sf = jnp.full(total, -1, jnp.int32)
         lm = jnp.zeros((total, n_bins), bool)
@@ -1096,13 +1147,59 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                        jnp.asarray(t_old.left_mask),
                        jnp.asarray(t_old.leaf_value), t_old.depth)
 
-    for ti in range(len(trees), settings.n_trees):
+    def absorb_rf(flat_list) -> None:
+        nonlocal fi_dev
+        for packed in flat_list:
+            tree, fi_h, sums = _unpack_streamed(packed, total, n_bins, c,
+                                                settings.depth)
+            fi_dev = fi_dev + jnp.asarray(fi_h)
+            trees.append(tree)
+            va_err = float(sums[0]) / max(float(sums[1]), 1e-9) \
+                if sums[1] > 0 else float("nan")
+            history.append((float(sums[2]) / max(float(sums[3]), 1e-9),
+                            va_err))
+
+    pending_rf: List[Any] = []
+
+    def drain_rf() -> None:
+        if pending_rf:
+            absorb_rf(np.asarray(jnp.stack(pending_rf)))
+            pending_rf.clear()
+
+    sync_each = bool(progress)
+    for ti in range(len(trees) + len(pending_rf), settings.n_trees):
         bag_cache.clear()
         fa = jnp.asarray(_feat_subset(settings, c, ti))
+        if cache.warmed and cache.tail is None:
+            # fully resident: whole tree is ONE executable (see
+            # _rf_tree_fused); packed trees drain in batched fetches
+            items = list(cache.items())
+            wins = tuple(
+                (it.arrays["bins"], it.arrays["y"], it.arrays["w"],
+                 window_bag(ti, it)) + window_oob(it)
+                for it in items)
+            packed_d, new_oob = _rf_tree_fused(
+                wins, fa, cat, settings.min_instances, settings.min_gain,
+                n_bins, settings.depth, settings.impurity, settings.loss,
+                up, settings.max_leaves, hc)
+            for it, pair in zip(items, new_oob):
+                it.arrays["oob"] = pair
+            if sync_each:
+                absorb_rf([np.asarray(packed_d)])
+                tr_err, va_err = history[-1]
+                progress(ti, tr_err, va_err)
+            else:
+                pending_rf.append(packed_d)
+            if checkpoint_fn and settings.checkpoint_every and \
+                    (ti + 1) % settings.checkpoint_every == 0:
+                drain_rf()
+                checkpoint_fn(trees, history, None)
+            continue
         sf = jnp.full(total, -1, jnp.int32)
         lm = jnp.zeros((total, n_bins), bool)
         lv = jnp.zeros(total, jnp.float32)
         nodes_cnt = jnp.int32(1)
+        fi_add = jnp.zeros(c, jnp.float32)
         for level in range(settings.depth + 1):
             n_nodes = 1 << level
             hist = jnp.zeros((n_nodes, c, n_bins, 3), jnp.float32)
@@ -1111,42 +1208,21 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                     it.arrays["bins"], it.arrays["y"], it.arrays["w"],
                     window_bag(ti, it), sf, lm, n_nodes, n_bins, level,
                     up)
-            gain, feat, lmask, leaf, _ = best_splits(
-                hist, cat, fa, settings.impurity,
-                settings.min_instances, settings.min_gain, has_cat=hc)
-            base = n_nodes - 1
-            if level == settings.depth:
-                feat = jnp.full(n_nodes, -1, jnp.int32)
-                lmask = jnp.zeros((n_nodes, n_bins), bool)
-            elif settings.max_leaves > 0:
-                feat, lmask, nodes_cnt = cap_splits_by_leaves(
-                    gain, feat, lmask, nodes_cnt, settings.max_leaves)
-            sf = sf.at[base:base + n_nodes].set(feat)
-            lm = lm.at[base:base + n_nodes].set(lmask)
-            lv = lv.at[base:base + n_nodes].set(leaf)
-            fi_dev = fi_dev + jax.ops.segment_sum(
-                jnp.where(feat >= 0, jnp.maximum(gain, 0.0),
-                          0.0).astype(jnp.float32),
-                jnp.maximum(feat, 0), num_segments=c)
+            sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
+                hist, cat, fa, settings.impurity, settings.min_instances,
+                settings.min_gain, hc, level, settings.depth,
+                settings.max_leaves, sf, lm, lv, nodes_cnt, fi_add)
         sums_dev = accumulate_oob(ti, sf, lm, lv, settings.depth)
-        packed = np.asarray(jnp.concatenate([
+        absorb_rf([np.asarray(jnp.concatenate([
             sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
-            lv, sums_dev]))
-        sf_h, lm_h, lv_h, sums = np.split(
-            packed, np.cumsum([total, total * n_bins, total]))
-        trees.append(TreeArrays(split_feat=sf_h.astype(np.int32),
-                                left_mask=lm_h.reshape(total, n_bins) > 0.5,
-                                leaf_value=lv_h.astype(np.float32),
-                                depth=settings.depth))
-        va_err = float(sums[0]) / max(float(sums[1]), 1e-9) \
-            if sums[1] > 0 else float("nan")
-        tr_err = float(sums[2]) / max(float(sums[3]), 1e-9)
-        history.append((tr_err, va_err))
+            lv, fi_add, sums_dev]))])
+        tr_err, va_err = history[-1]
         if progress:
             progress(ti, tr_err, va_err)
         if checkpoint_fn and settings.checkpoint_every and \
                 (ti + 1) % settings.checkpoint_every == 0:
             checkpoint_fn(trees, history, None)
+    drain_rf()
     return ForestResult(
         trees=trees, spec_kwargs={"algorithm": "RF"},
         train_error=history[-1][0] if history else float("nan"),
