@@ -289,3 +289,30 @@ def test_resident_cache_tail_restream_matches_full_residency(tmp_path):
         np.testing.assert_array_equal(tf.split_feat, tt.split_feat)
         np.testing.assert_allclose(tf.leaf_value, tt.leaf_value,
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_rf_fused_matches_tail_restream(tmp_path):
+    """RF's fully-resident fused executable and the disk-tail window loop
+    must build the same forest (bags/oob state included)."""
+    from shifu_tpu.data.streaming import ShardStream
+    from shifu_tpu.train.dt_trainer import DTSettings, train_rf_streamed
+
+    bins, y, w = _tree_data(n=1024)
+    settings = DTSettings(n_trees=3, depth=3, impurity="entropy",
+                          loss="squared", seed=2)
+    shards = _write_tree_shards(str(tmp_path / "s"), bins, y, w)
+    full = train_rf_streamed(
+        ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+        8, None, settings, cache_budget=1 << 30)
+    win_bytes = 256 * (6 * 4 + 4 * 4)
+    tail = train_rf_streamed(
+        ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+        8, None, settings, cache_budget=2 * win_bytes + 64)
+    assert tail.disk_passes > full.disk_passes
+    assert full.trees_built == tail.trees_built == 3
+    for tf, tt in zip(full.trees, tail.trees):
+        np.testing.assert_array_equal(tf.split_feat, tt.split_feat)
+        np.testing.assert_allclose(tf.leaf_value, tt.leaf_value,
+                                   rtol=1e-4, atol=1e-5)
+    for (a, b), (c_, d) in zip(full.history, tail.history):
+        assert abs(a - c_) < 1e-5 and abs(b - d) < 1e-5
