@@ -100,6 +100,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-fi", dest="fi_model", metavar="MODELPATH")
 
     sp = sub.add_parser("test", help="pipeline smoke test on a data sample")
+    sp.add_argument("-filter", dest="filter_target", nargs="?", const="",
+                    default=None, metavar="EVALSET",
+                    help="test only the filter expressions: no value = "
+                    "training set, '*' = all sets, a name = that eval set")
     sp = sub.add_parser("encode", help="encode dataset by tree-leaf index")
     sp.add_argument("-evalset", dest="evalset", default=None)
 

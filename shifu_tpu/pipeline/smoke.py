@@ -24,12 +24,27 @@ class SmokeTestProcessor(BasicProcessor):
 
     def process(self) -> int:
         mc = self.model_config
+        # reference ShifuTestProcessor.java:54-60 `-filter [target]`:
+        # blank = training set only, "*" = train + every eval set,
+        # a name = that eval set only; default (no -filter) tests all
+        target = self.params.get("filter_target")
         rc = 0
-        rc |= self._test_source("training", mc.dataSet, for_eval=None)
+        if target in (None, "", "*"):
+            rc |= self._test_source("training", mc.dataSet, for_eval=None)
+        if target == "":
+            return rc
+        matched = False
         for i, ev in enumerate(mc.evals):
+            if target not in (None, "*") and ev.name != target:
+                continue
             if ev.dataSet.dataPath:
+                matched = True
                 rc |= self._test_source(f"eval:{ev.name}", ev.dataSet,
                                         for_eval=i)
+        if target not in (None, "", "*") and not matched:
+            log.error("test -filter %s: no such eval set (or it has no "
+                      "dataPath) — nothing was tested", target)
+            return 1
         return rc
 
     def _test_source(self, label, ds, for_eval) -> int:
