@@ -41,7 +41,8 @@ LANE = 128
 
 
 def _hist_kernel(bins_ref, node_ref, stats_ref, out_ref, *, n_stats: int,
-                 n_nodes: int, b_pad: int, nblk: int, cblk: int):
+                 n_nodes: int, b_pad: int, nblk: int, cblk: int,
+                 pair: bool = False):
     r = pl.program_id(1)
 
     @pl.when(r == 0)
@@ -58,12 +59,42 @@ def _hist_kernel(bins_ref, node_ref, stats_ref, out_ref, *, n_stats: int,
     # the reference accumulates in double (``DTWorker.java:850-852``) —
     # plain bf16 rounding shifted chosen thresholds measurably (2.5%
     # cell error at bench shapes), the hi/lo split does not.
+    # the split must NOT be written as a convert round-trip
+    # (a - f32(bf16(a))): XLA's allow-excess-precision simplification —
+    # explicitly enabled on this TPU toolchain — folds that to zero,
+    # silently degrading the kernel to plain bf16.  Masking the low
+    # mantissa bits out via bitcast is opaque to the simplifier.
     a_hi, a_lo = [], []
     for s in range(n_stats):
         a = node1h * stats_ref[s:s + 1, :]                # [K, nblk] f32
-        hi = a.astype(jnp.bfloat16)
-        a_hi.append(hi)
-        a_lo.append((a - hi.astype(jnp.float32)).astype(jnp.bfloat16))
+        hi_f = jax.lax.bitcast_convert_type(
+            jax.lax.bitcast_convert_type(a, jnp.uint32)
+            & jnp.uint32(0xFFFF0000), jnp.float32)        # bf16-exact
+        a_hi.append(hi_f.astype(jnp.bfloat16))
+        a_lo.append((a - hi_f).astype(jnp.bfloat16))
+    dims = (((1,), (1,)), ((), ()))
+    half = LANE // 2
+    if pair:
+        # n_bins <= 64: pack TWO features per 128-lane tile (lanes 0-63 =
+        # feature cf's bins, 64-127 = feature cf+1's) — halves the dots
+        b_iota = jax.lax.broadcasted_iota(jnp.int32, (LANE, nblk), 0)
+        lo_half = b_iota < half
+        lane_val = jnp.where(lo_half, b_iota, b_iota - half)
+        for cf in range(0, cblk, 2):
+            bview_a = bins_ref[cf:cf + 1, :]              # [1, nblk]
+            bview_b = bins_ref[cf + 1:cf + 2, :]
+            oneh = (lane_val == jnp.where(lo_half, bview_a, bview_b)) \
+                .astype(jnp.bfloat16)                     # [LANE, nblk]
+            for s in range(n_stats):
+                acc = jax.lax.dot_general(
+                    a_hi[s], oneh, dims,
+                    preferred_element_type=jnp.float32)   # [K, LANE]
+                acc += jax.lax.dot_general(
+                    a_lo[s], oneh, dims,
+                    preferred_element_type=jnp.float32)
+                out_ref[cf, s, :, :] += acc[:, :half]
+                out_ref[cf + 1, s, :, :] += acc[:, half:]
+        return
     for cf in range(cblk):
         bview = bins_ref[cf:cf + 1, :]                    # [1, nblk]
         for bt in range(b_pad // LANE):
@@ -71,7 +102,6 @@ def _hist_kernel(bins_ref, node_ref, stats_ref, out_ref, *, n_stats: int,
                 jnp.int32, (LANE, nblk), 0) + bt * LANE
             oneh = (b_iota == bview).astype(jnp.bfloat16)  # [LANE, nblk]
             for s in range(n_stats):
-                dims = (((1,), (1,)), ((), ()))
                 acc = jax.lax.dot_general(
                     a_hi[s], oneh, dims,
                     preferred_element_type=jnp.float32)   # [K, LANE]
@@ -106,7 +136,8 @@ def build_histograms_pallas(bins, node_idx, stats, n_nodes: int,
         return jnp.concatenate(parts, axis=0)
     n, c = bins.shape
     s = stats.shape[1]
-    b_pad = max(LANE, ((n_bins + LANE - 1) // LANE) * LANE)
+    pair = n_bins <= LANE // 2       # two features share one 128-lane tile
+    b_pad = LANE // 2 if pair else ((n_bins + LANE - 1) // LANE) * LANE
     cblk = 8                 # Mosaic wants >=8 sublanes per bins block
     c_pad = ((c + cblk - 1) // cblk) * cblk
     # row-block: large enough to keep the MXU busy, small enough that the
@@ -124,7 +155,7 @@ def build_histograms_pallas(bins, node_idx, stats, n_nodes: int,
     grid = (c_pad // cblk, n_pad // nblk)
     out = pl.pallas_call(
         partial(_hist_kernel, n_stats=s, n_nodes=n_nodes, b_pad=b_pad,
-                nblk=nblk, cblk=cblk),
+                nblk=nblk, cblk=cblk, pair=pair),
         grid=grid,
         in_specs=[
             pl.BlockSpec((cblk, nblk), lambda ci, r: (ci, r)),
