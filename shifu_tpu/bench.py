@@ -84,28 +84,37 @@ def bench_nn(n_rows: int = 1 << 17, n_features: int = 256,
         return best
 
 
-def bench_gbt(n_rows: int = 1 << 17, n_features: int = 64, n_bins: int = 64,
-              n_trees: int = 32, depth: int = 6) -> float:
-    """GBT training throughput, device-resident rows: rows*trees processed
-    per wall-clock second (each tree is a full pass over the rows)."""
-    from shifu_tpu.train.dt_trainer import DTSettings, train_gbt
-
+def _bench_forest(train_fn, settings, n_rows: int, n_features: int,
+                  n_bins: int) -> float:
+    """Shared forest-trainer harness: synthetic rows, compile warmup with
+    identical settings, best-of-3 value-synced windows (train_* fetches
+    packed trees to host internally, so the window measures real work)."""
     rng = np.random.default_rng(0)
     bins = rng.integers(0, n_bins, size=(n_rows, n_features)).astype(np.int32)
     y = (rng.random(n_rows) < 0.3).astype(np.float32)
     w = np.ones(n_rows, np.float32)
     cat = np.zeros(n_features, bool)
-    settings = DTSettings(n_trees=n_trees, depth=depth, loss="log",
-                          learning_rate=0.1)
-    train_gbt(bins, y, w, n_bins, cat, settings)        # compile warmup
+    train_fn(bins, y, w, n_bins, cat, settings)         # compile warmup
     best = 0.0
     for _ in range(3):
         t0 = time.perf_counter()
-        res = train_gbt(bins, y, w, n_bins, cat, settings)
+        res = train_fn(bins, y, w, n_bins, cat, settings)
         dt = time.perf_counter() - t0
-        assert res.trees_built == n_trees
-        best = max(best, n_rows * n_trees / dt)
+        assert res.trees_built == settings.n_trees
+        best = max(best, n_rows * settings.n_trees / dt)
     return best
+
+
+def bench_gbt(n_rows: int = 1 << 17, n_features: int = 64, n_bins: int = 64,
+              n_trees: int = 32, depth: int = 6) -> float:
+    """GBT training throughput, device-resident rows: rows*trees processed
+    per wall-clock second (each tree is a full pass over the rows)."""
+    from shifu_tpu.train.dt_trainer import DTSettings, train_gbt
+    return _bench_forest(
+        train_gbt,
+        DTSettings(n_trees=n_trees, depth=depth, loss="log",
+                   learning_rate=0.1),
+        n_rows, n_features, n_bins)
 
 
 def bench_gbt_streamed(n_rows: int = 1 << 16, n_features: int = 64,
@@ -152,6 +161,18 @@ def bench_gbt_streamed(n_rows: int = 1 << 16, n_features: int = 64,
             assert res.trees_built == n_trees
             best = max(best, n_rows * n_trees / dt)
     return best
+
+
+def bench_rf(n_rows: int = 1 << 17, n_features: int = 64, n_bins: int = 64,
+             n_trees: int = 32, depth: int = 6) -> float:
+    """RF training throughput (Poisson bagging + oob validation),
+    rows*trees per second — same harness as bench_gbt."""
+    from shifu_tpu.train.dt_trainer import DTSettings, train_rf
+    return _bench_forest(
+        train_rf,
+        DTSettings(n_trees=n_trees, depth=depth, impurity="entropy",
+                   loss="log", feature_subset="SQRT"),
+        n_rows, n_features, n_bins)
 
 
 def bench_eval(n_rows: int = 1 << 18, n_features: int = 256,
@@ -205,6 +226,10 @@ def run_benchmark() -> Dict[str, Any]:
         extras["gbt_train_throughput_streamed"] = round(bench_gbt_streamed(), 1)
     except Exception as e:                      # pragma: no cover
         extras["gbt_train_throughput_streamed_error"] = str(e)[:200]
+    try:
+        extras["rf_train_throughput"] = round(bench_rf(), 1)
+    except Exception as e:                      # pragma: no cover
+        extras["rf_train_throughput_error"] = str(e)[:200]
     try:
         extras["eval_throughput"] = round(bench_eval(), 1)
     except Exception as e:                      # pragma: no cover
