@@ -174,11 +174,53 @@ def build_histograms_pallas(bins, node_idx, stats, n_nodes: int,
     return out[:c, :, :, :n_bins].transpose(2, 0, 3, 1)
 
 
-def pallas_available() -> bool:
-    """Histogram kernel dispatch gate: real TPU backend and not disabled."""
-    if os.environ.get("SHIFU_HIST_PALLAS", "1") == "0":
-        return False
+def build_histograms_sharded(bins, node_idx, stats, n_nodes: int,
+                             n_bins: int, mesh, interpret: bool = False):
+    """Mesh lowering of the kernel: ``shard_map`` over the ``data`` axis.
+
+    A ``pallas_call`` is opaque to the GSPMD partitioner, so under a
+    multi-device mesh the kernel must be placed per-shard explicitly: each
+    device builds the histogram of its local rows (the ``DTWorker`` side),
+    then a ``psum`` over the data axis merges them on ICI (the
+    ``DTMaster.java:274-533`` aggregation).  Inputs must already be sharded
+    row-wise over ``data`` (the trainers' `_device_put_rows` layout); axes
+    the specs don't mention (``ensemble``) stay replicated.
+
+    ``check_vma=False``: the replication checker can't see through the
+    kernel, but the output IS replicated — inputs are replicated over
+    every non-data axis and the psum makes it data-invariant.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def local(b, ni, st):
+        h = build_histograms_pallas(b, ni, st, n_nodes, n_bins, interpret)
+        return jax.lax.psum(h, "data")
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P("data", None), P("data"), P("data", None)),
+        out_specs=P(), check_vma=False)(bins, node_idx, stats)
+
+
+def target_platform(mesh=None) -> str:
+    """The platform the histogram will actually run on: the mesh's devices
+    when one is given (a CPU mesh on a TPU-backed host must NOT get the
+    Mosaic lowering), the default backend otherwise."""
+    if mesh is not None:
+        return mesh.devices.flat[0].platform
     try:
-        return jax.default_backend() == "tpu"
+        return jax.default_backend()
     except Exception:                                      # pragma: no cover
+        return "cpu"
+
+
+def pallas_available(mesh=None) -> bool:
+    """Histogram kernel dispatch gate: runs on a real TPU and not disabled.
+    ``SHIFU_HIST_PALLAS=force`` enables it on any platform (tests exercise
+    the kernel + shard_map wiring in interpret mode on the CPU mesh)."""
+    env = os.environ.get("SHIFU_HIST_PALLAS", "1")
+    if env == "0":
         return False
+    if env == "force":
+        return True
+    return target_platform(mesh) == "tpu"

@@ -51,9 +51,10 @@ def n_tree_nodes(depth: int) -> int:
 
 
 # ------------------------------------------------------------- histograms
-@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "use_pallas"))
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "use_pallas",
+                                   "mesh"))
 def build_histograms(bins, node_idx, stats, n_nodes: int, n_bins: int,
-                     use_pallas: bool = False):
+                     use_pallas: bool = False, mesh=None):
     """Per-row stats into (node, feature, bin) cells.
 
     bins: [N, C] int32; node_idx: [N] int32 level-local (-1 = inactive);
@@ -61,14 +62,22 @@ def build_histograms(bins, node_idx, stats, n_nodes: int, n_bins: int,
     Returns [n_nodes, C, n_bins, S].
 
     Two lowerings: ``use_pallas=True`` → MXU one-hot-matmul kernel
-    (:mod:`shifu_tpu.ops.hist_pallas`, ~50x on a TPU chip); default →
-    ``segment_sum`` scatter-add (CPU tests, sharded-mesh paths where GSPMD
-    partitions the scatter over the data axis).
+    (:mod:`shifu_tpu.ops.hist_pallas`, ~50x on a TPU chip), shard_mapped
+    over the mesh's data axis + psum when ``mesh`` spans devices; default
+    → ``segment_sum`` scatter-add (CPU tests, or kernel disabled), which
+    GSPMD partitions over the data axis on its own.
     """
     if use_pallas:
-        from .hist_pallas import build_histograms_pallas
+        from .hist_pallas import (build_histograms_pallas,
+                                  build_histograms_sharded, target_platform)
+        # forced-on CPU meshes/tests take interpret mode; dispatch follows
+        # where the op runs, not the host's default backend
+        interpret = target_platform(mesh) != "tpu"
+        if mesh is not None and mesh.size > 1:
+            return build_histograms_sharded(bins, node_idx, stats, n_nodes,
+                                            n_bins, mesh, interpret)
         return build_histograms_pallas(bins, node_idx, stats, n_nodes,
-                                       n_bins)
+                                       n_bins, interpret)
     active = node_idx >= 0
     seg_base = jnp.where(active, node_idx, 0) * n_bins
     masked = stats * active[:, None].astype(stats.dtype)
@@ -242,7 +251,11 @@ def cap_splits_by_leaves(gain, feat, lmask, nodes_cnt, max_leaves: int):
     cand = feat >= 0
     key = jnp.where(cand, -gain, jnp.inf)
     rank = jnp.argsort(jnp.argsort(key))
-    budget = jnp.maximum((max_leaves - nodes_cnt) // 2, 0)
+    # reference arithmetic: a split is allowed while nodeNum + 1 <=
+    # maxLeaves BEFORE its two children land, so for even MaxLeaves the
+    # final count may reach maxLeaves + 1 (one more split than a strict
+    # <= maxLeaves cap); rank r's split sees nodes_cnt + 2r nodes
+    budget = jnp.maximum((max_leaves - nodes_cnt + 1) // 2, 0)
     allow = cand & (rank < budget)
     return (jnp.where(allow, feat, -1), lmask & allow[:, None],
             nodes_cnt + 2 * allow.sum().astype(nodes_cnt.dtype))
@@ -262,11 +275,11 @@ def _descend(bins, node_idx, feat, lmask):
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity",
                                    "n_classes", "use_pallas", "max_leaves",
-                                   "has_cat"))
+                                   "has_cat", "mesh"))
 def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
                   impurity: str, min_instances: float, min_gain: float,
                   n_classes: int = 0, use_pallas: bool = False,
-                  max_leaves: int = 0, has_cat: bool = True):
+                  max_leaves: int = 0, has_cat: bool = True, mesh=None):
     """Whole-tree level-wise growth as ONE jitted program — zero host syncs
     per level (reference ``DTMaster.java:543-600`` level mode; the round-1
     build synced feat/lmask/leaf to host every level).
@@ -285,7 +298,7 @@ def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
     for level in range(depth + 1):
         n_nodes = 1 << level
         hist = build_histograms(bins, node_idx, stats, n_nodes, n_bins,
-                                use_pallas)
+                                use_pallas, mesh)
         gain, feat, lmask, leaf, node_w = best_splits(
             hist, cat, fa, impurity, min_instances, min_gain, n_classes,
             has_cat)

@@ -162,7 +162,7 @@ def _per_row_loss(y, f, loss: str):
 def _gbt_round_impl(bins, y, tw, vw, f, fa, cat, lr, min_instances,
                     min_gain, n_bins: int, depth: int, impurity: str,
                     loss: str, use_pallas: bool = False,
-                    max_leaves: int = 0, has_cat: bool = True):
+                    max_leaves: int = 0, has_cat: bool = True, mesh=None):
     """One GBT tree end-to-end on device: residual grad → grow → predict →
     score update → train/valid error sums.  Only the tree arrays and two
     scalars cross to the host."""
@@ -172,7 +172,8 @@ def _gbt_round_impl(bins, y, tw, vw, f, fa, cat, lr, min_instances,
     sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
                                     impurity, min_instances, min_gain,
                                     use_pallas=use_pallas,
-                                    max_leaves=max_leaves, has_cat=has_cat)
+                                    max_leaves=max_leaves, has_cat=has_cat,
+                                    mesh=mesh)
     pred = predict_tree(sf, lm, lv, bins, depth)
     f2 = f + lr * pred
     per = _per_row_loss(y, f2, loss)
@@ -183,16 +184,16 @@ def _gbt_round_impl(bins, y, tw, vw, f, fa, cat, lr, min_instances,
 
 _gbt_round = partial(jax.jit, static_argnames=(
     "n_bins", "depth", "impurity", "loss", "use_pallas",
-    "max_leaves", "has_cat"))(_gbt_round_impl)
+    "max_leaves", "has_cat", "mesh"))(_gbt_round_impl)
 
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
                                    "n_trees", "use_pallas", "max_leaves",
-                                   "has_cat"))
+                                   "has_cat", "mesh"))
 def _gbt_forest(bins, y, tw, vw, f, fa_all, cat, lr, min_instances,
                 min_gain, n_bins: int, depth: int, impurity: str,
                 loss: str, n_trees: int, use_pallas: bool = False,
-                max_leaves: int = 0, has_cat: bool = True):
+                max_leaves: int = 0, has_cat: bool = True, mesh=None):
     """A whole chunk of the GBT forest as ONE executable (``lax.scan`` over
     trees).  The per-tree loop costs one program execution per tree; over a
     remote-device link each execution carries latency that dwarfs the
@@ -206,7 +207,7 @@ def _gbt_forest(bins, y, tw, vw, f, fa_all, cat, lr, min_instances,
         sf, lm, lv, gfi, f2, tr, va = _gbt_round_impl(
             bins, y, tw, vw, f, fa, cat, lr, min_instances, min_gain,
             n_bins, depth, impurity, loss, use_pallas, max_leaves,
-            has_cat)
+            has_cat, mesh)
         return f2, _pack_tree_impl(sf, lm, lv, gfi, tr, va)
 
     f_out, packed = jax.lax.scan(body, f, fa_all)
@@ -217,7 +218,7 @@ def _rf_round_impl(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
                    min_instances, min_gain, n_bins: int, depth: int,
                    impurity: str, loss: str, poisson: bool,
                    n_classes: int = 0, use_pallas: bool = False,
-                   max_leaves: int = 0, has_cat: bool = True):
+                   max_leaves: int = 0, has_cat: bool = True, mesh=None):
     """One RF tree on device: Poisson bag → grow → oob accumulate →
     loss-consistent oob validation error (reference oob-as-validation,
     ``DTWorker.java:582-616``; round 1 hardcoded squared error).
@@ -240,7 +241,7 @@ def _rf_round_impl(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
     sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
                                     impurity, min_instances, min_gain,
                                     n_classes, use_pallas, max_leaves,
-                                    has_cat)
+                                    has_cat, mesh)
     pred = predict_tree(sf, lm, lv, bins, depth)   # [n, K] mc, [n] binary
     oob = (bag == 0) & (w > 0)
     if multiclass:
@@ -288,12 +289,13 @@ _pack_tree = jax.jit(_pack_tree_impl)
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
                                    "poisson", "n_classes", "n_trees",
-                                   "use_pallas", "max_leaves", "has_cat"))
+                                   "use_pallas", "max_leaves", "has_cat",
+                                   "mesh"))
 def _rf_forest(bins, y, w, base_key, tree_ids, bag_rate, oob_sum, oob_cnt,
                fa_all, cat, min_instances, min_gain, n_bins: int,
                depth: int, impurity: str, loss: str, poisson: bool,
                n_classes: int, n_trees: int, use_pallas: bool = False,
-               max_leaves: int = 0, has_cat: bool = True):
+               max_leaves: int = 0, has_cat: bool = True, mesh=None):
     """A chunk of the RF forest as ONE executable (see :func:`_gbt_forest`).
     Per-tree keys fold the tree id into the base key on device — identical
     draws to the per-tree path, so resumed and scanned runs agree."""
@@ -306,7 +308,7 @@ def _rf_forest(bins, y, w, base_key, tree_ids, bag_rate, oob_sum, oob_cnt,
         sf, lm, lv, gfi, oob_sum2, oob_cnt2, tr, va = _rf_round_impl(
             bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
             min_instances, min_gain, n_bins, depth, impurity, loss,
-            poisson, n_classes, use_pallas, max_leaves, has_cat)
+            poisson, n_classes, use_pallas, max_leaves, has_cat, mesh)
         return (oob_sum2, oob_cnt2), _pack_tree_impl(sf, lm, lv, gfi, tr, va)
 
     (oob_sum, oob_cnt), packed = jax.lax.scan(
@@ -331,15 +333,20 @@ def _unpack_tree(vec: np.ndarray, total: int, n_bins: int, c: int,
 
 
 def _use_pallas(mesh) -> bool:
-    """MXU histogram kernel dispatch: TPU backend, and at most one device
-    in the mesh — under a multi-device mesh the scatter path stays, where
-    GSPMD partitions the segment-sum over the data axis (a pallas_call is
-    opaque to the partitioner).  A 1-device mesh (the pipeline default on
-    a single chip) has nothing to partition and takes the kernel."""
+    """MXU histogram kernel dispatch.  On a multi-device mesh the kernel
+    runs per-shard under ``shard_map`` with a psum merge over the data
+    axis (``ops.hist_pallas.build_histograms_sharded``) — the trainers
+    thread their mesh down so ``build_histograms`` can place it; a single
+    device takes the plain kernel.  Gated on the MESH devices' platform
+    (a CPU mesh on a TPU-backed host must not take the Mosaic path)."""
     from ..ops.hist_pallas import pallas_available
-    if mesh is not None and mesh.size > 1:
-        return False
-    return pallas_available()
+    return pallas_available(mesh)
+
+
+def _hist_mesh(mesh):
+    """The mesh build_histograms should shard_map over: only a real
+    multi-device mesh matters (None keeps jit caches unified)."""
+    return mesh if (mesh is not None and mesh.size > 1) else None
 
 
 def _device_put_rows(mesh, *arrays):
@@ -435,7 +442,8 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
                 bins_d, y_d, tw_d, vw_d, f, fa_all, cat,
                 settings.learning_rate, settings.min_instances,
                 settings.min_gain, n_bins, settings.depth, imp,
-                settings.loss, chunk, up, settings.max_leaves, hc)
+                settings.loss, chunk, up, settings.max_leaves, hc,
+                _hist_mesh(mesh))
             before = len(history)
             absorb(np.asarray(packed), with_history=True)
             if progress:
@@ -461,7 +469,8 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
                 bins_d, y_d, tw_d, vw_d, f, fa, cat,
                 settings.learning_rate, settings.min_instances,
                 settings.min_gain, n_bins, settings.depth, imp,
-                settings.loss, up, settings.max_leaves, hc)
+                settings.loss, up, settings.max_leaves, hc,
+                _hist_mesh(mesh))
             pending.append(_pack_tree(sf, lm, lv, gfi, tr, va))
             tr_err, va_err = (float(x) for x in
                               np.asarray(jnp.stack([tr, va])))
@@ -557,7 +566,7 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
             settings.min_instances, settings.min_gain, n_bins,
             settings.depth, settings.impurity, settings.loss,
             settings.poisson_bagging, settings.n_classes, chunk, up,
-            settings.max_leaves, hc)
+            settings.max_leaves, hc, _hist_mesh(mesh))
         before = len(history)
         absorb(np.asarray(packed), with_history=True)
         if progress:
@@ -580,10 +589,10 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
 
 # ------------------------------------------------------------- streaming
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level", "loss",
-                                   "use_pallas"))
+                                   "use_pallas", "mesh"))
 def _gbt_window_hist(bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
                      n_bins: int, level: int, loss: str,
-                     use_pallas: bool = False):
+                     use_pallas: bool = False, mesh=None):
     """Streamed level step: window rows find their level-local node by
     walking the partial tree, then scatter residual-gradient stats.  With
     mesh-sharded window rows the [nodes, C, B, S] sum is XLA's psum over
@@ -593,19 +602,20 @@ def _gbt_window_hist(bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
     stats = jnp.stack([tw_w, tw_w * grad, tw_w * grad * grad], axis=1) \
         .astype(jnp.float32)
     return build_histograms(bins_w, node_idx, stats, n_nodes, n_bins,
-                            use_pallas)
+                            use_pallas, mesh)
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level",
-                                   "use_pallas"))
+                                   "use_pallas", "mesh"))
 def _rf_window_hist(bins_w, y_w, w_w, bag_w, sf, lm, n_nodes: int,
-                    n_bins: int, level: int, use_pallas: bool = False):
+                    n_bins: int, level: int, use_pallas: bool = False,
+                    mesh=None):
     bw_w = w_w * bag_w
     node_idx = node_index_at_level(sf, lm, bins_w, level)
     stats = jnp.stack([bw_w, bw_w * y_w, bw_w * y_w * y_w], axis=1) \
         .astype(jnp.float32)
     return build_histograms(bins_w, node_idx, stats, n_nodes, n_bins,
-                            use_pallas)
+                            use_pallas, mesh)
 
 
 @partial(jax.jit, static_argnames=("depth", "loss"))
@@ -685,10 +695,12 @@ def _tree_level_step(hist, cat, fa, impurity: str, min_instances,
 
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
-                                   "use_pallas", "max_leaves", "has_cat"))
+                                   "use_pallas", "max_leaves", "has_cat",
+                                   "mesh"))
 def _gbt_tree_fused(wins, fa, cat, lr, min_instances, min_gain,
                     n_bins: int, depth: int, impurity: str, loss: str,
-                    use_pallas: bool, max_leaves: int, has_cat: bool):
+                    use_pallas: bool, max_leaves: int, has_cat: bool,
+                    mesh=None):
     """One streamed GBT tree over a FULLY-RESIDENT window cache as a single
     executable: all (depth+1) level sweeps + the update pass fuse, so a
     tree costs one program execution + one packed fetch — the per-level
@@ -713,7 +725,8 @@ def _gbt_tree_fused(wins, fa, cat, lr, min_instances, min_gain,
             stats = jnp.stack([tw_w, tw_w * grad, tw_w * grad * grad],
                               axis=1).astype(jnp.float32)
             hist = hist + build_histograms(bins_w, node_idx, stats,
-                                           n_nodes, n_bins, use_pallas)
+                                           n_nodes, n_bins, use_pallas,
+                                           mesh)
         sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
             hist, cat, fa, impurity, min_instances, min_gain, has_cat,
             level, depth, max_leaves, sf, lm, lv, nodes_cnt, fi_add)
@@ -734,10 +747,12 @@ def _gbt_tree_fused(wins, fa, cat, lr, min_instances, min_gain,
 
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
-                                   "use_pallas", "max_leaves", "has_cat"))
+                                   "use_pallas", "max_leaves", "has_cat",
+                                   "mesh"))
 def _rf_tree_fused(wins, fa, cat, min_instances, min_gain, n_bins: int,
                    depth: int, impurity: str, loss: str,
-                   use_pallas: bool, max_leaves: int, has_cat: bool):
+                   use_pallas: bool, max_leaves: int, has_cat: bool,
+                   mesh=None):
     """One streamed RF tree over a FULLY-RESIDENT window cache as a single
     executable (see :func:`_gbt_tree_fused`).  ``wins``: tuple of
     (bins, y, w, bag, oob_sum, oob_cnt) per window.  Returns
@@ -758,7 +773,8 @@ def _rf_tree_fused(wins, fa, cat, min_instances, min_gain, n_bins: int,
             stats = jnp.stack([bw, bw * y_w, bw * y_w * y_w],
                               axis=1).astype(jnp.float32)
             hist = hist + build_histograms(bins_w, node_idx, stats,
-                                           n_nodes, n_bins, use_pallas)
+                                           n_nodes, n_bins, use_pallas,
+                                           mesh)
         sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
             hist, cat, fa, impurity, min_instances, min_gain, has_cat,
             level, depth, max_leaves, sf, lm, lv, nodes_cnt, fi_add)
@@ -939,7 +955,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                 wins, fa, cat, settings.learning_rate,
                 settings.min_instances, settings.min_gain, n_bins,
                 settings.depth, imp, settings.loss, up,
-                settings.max_leaves, hc)
+                settings.max_leaves, hc, _hist_mesh(mesh))
             for it, f2 in zip(items, new_f):
                 it.arrays["f"] = f2
             if sync_each:
@@ -971,7 +987,8 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                 hist = hist + _gbt_window_hist(
                     it.arrays["bins"], it.arrays["y"], it.arrays["tw"],
                     window_f(it), sf, lm,
-                    n_nodes, n_bins, level, settings.loss, up)
+                    n_nodes, n_bins, level, settings.loss, up,
+                    _hist_mesh(mesh))
             sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
                 hist, cat, fa, imp, settings.min_instances,
                 settings.min_gain, hc, level, settings.depth,
@@ -1181,7 +1198,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
             packed_d, new_oob = _rf_tree_fused(
                 wins, fa, cat, settings.min_instances, settings.min_gain,
                 n_bins, settings.depth, settings.impurity, settings.loss,
-                up, settings.max_leaves, hc)
+                up, settings.max_leaves, hc, _hist_mesh(mesh))
             for it, pair in zip(items, new_oob):
                 it.arrays["oob"] = pair
             if sync_each:
@@ -1207,7 +1224,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                 hist = hist + _rf_window_hist(
                     it.arrays["bins"], it.arrays["y"], it.arrays["w"],
                     window_bag(ti, it), sf, lm, n_nodes, n_bins, level,
-                    up)
+                    up, _hist_mesh(mesh))
             sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
                 hist, cat, fa, settings.impurity, settings.min_instances,
                 settings.min_gain, hc, level, settings.depth,

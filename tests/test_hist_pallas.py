@@ -37,6 +37,53 @@ def test_pallas_matches_segment_sum(n, c, b, k, s):
     np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-5)
 
 
+def test_sharded_kernel_matches_segment_sum():
+    """shard_map'd kernel over the mesh data axis + psum == scatter path
+    (the DTWorker→DTMaster merge on ICI, VERDICT r3 item 1)."""
+    import jax
+    from shifu_tpu.ops.hist_pallas import build_histograms_sharded
+    from shifu_tpu.parallel.mesh import device_mesh
+
+    n, c, b, k = 1024, 6, 16, 8
+    rng = np.random.default_rng(7)
+    bins = jnp.asarray(rng.integers(0, b, (n, c)), jnp.int32)
+    node = jnp.asarray(rng.integers(-1, k, n), jnp.int32)
+    stats = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
+    mesh = device_mesh(2, devices=jax.devices("cpu")[:8])  # ensemble axis too
+    ref = np.asarray(build_histograms(bins, node, stats, k, b))
+    out = np.asarray(build_histograms_sharded(bins, node, stats, k, b,
+                                              mesh, interpret=True))
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-5)
+
+
+def test_gbt_mesh_equivalence_with_kernel(monkeypatch):
+    """Forced kernel (interpret on CPU): an 8-device mesh GBT with the
+    shard_map'd kernel builds the same trees as the scatter path — the
+    north-star config (GBT on a multi-chip mesh) keeps the MXU path."""
+    import jax
+    from shifu_tpu.parallel.mesh import device_mesh
+    from shifu_tpu.train.dt_trainer import DTSettings, train_gbt
+
+    rng = np.random.default_rng(3)
+    n, c, n_bins = 640, 6, 8
+    bins = rng.integers(0, n_bins - 1, size=(n, c)).astype(np.int32)
+    logit = (bins[:, 0] - 3) * 0.8 + (bins[:, 1] == 2) * 1.5 - 0.5
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    w = np.ones(n, np.float32)
+    settings = DTSettings(n_trees=3, depth=3, loss="log", seed=0)
+    mesh8 = device_mesh(1, devices=jax.devices("cpu")[:8])
+    r_scatter = train_gbt(bins, y, w, n_bins, None, settings, mesh=mesh8)
+    monkeypatch.setenv("SHIFU_HIST_PALLAS", "force")
+    r_kernel = train_gbt(bins, y, w, n_bins, None, settings, mesh=mesh8)
+    for t1, t8 in zip(r_scatter.trees, r_kernel.trees):
+        np.testing.assert_array_equal(t1.split_feat, t8.split_feat)
+        np.testing.assert_array_equal(t1.left_mask, t8.left_mask)
+        np.testing.assert_allclose(t1.leaf_value, t8.leaf_value,
+                                   rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(r_scatter.valid_error, r_kernel.valid_error,
+                               rtol=1e-4)
+
+
 def test_pallas_weighted_counts_exact():
     """Integer weights accumulate exactly (counting semantics)."""
     rng = np.random.default_rng(0)

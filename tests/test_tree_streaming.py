@@ -228,6 +228,31 @@ def test_streamed_gbt_mesh_equivalence(tmp_path):
     np.testing.assert_allclose(r1.valid_error, r8.valid_error, rtol=1e-4)
 
 
+def test_streamed_gbt_mesh_kernel_equivalence(tmp_path, monkeypatch):
+    """Streamed GBT on the 8-device mesh with the shard_map'd MXU kernel
+    forced on (interpret mode on CPU) == the scatter path: the out-of-core
+    multi-chip config keeps the kernel (VERDICT r3 item 1)."""
+    from shifu_tpu.data.streaming import ShardStream
+    from shifu_tpu.parallel.mesh import device_mesh
+    from shifu_tpu.train.dt_trainer import DTSettings, train_gbt_streamed
+
+    bins, y, w = _tree_data(n=1024)
+    shards = _write_tree_shards(str(tmp_path / "s"), bins, y, w)
+    settings = DTSettings(n_trees=2, depth=3, loss="log", seed=0)
+    mesh8 = device_mesh(1, devices=jax.devices("cpu")[:8])
+    r_scatter = train_gbt_streamed(
+        ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+        8, None, settings, mesh=mesh8)
+    monkeypatch.setenv("SHIFU_HIST_PALLAS", "force")
+    r_kernel = train_gbt_streamed(
+        ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+        8, None, settings, mesh=mesh8)
+    for t1, t8 in zip(r_scatter.trees, r_kernel.trees):
+        np.testing.assert_array_equal(t1.split_feat, t8.split_feat)
+        np.testing.assert_allclose(t1.leaf_value, t8.leaf_value,
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_streamed_rf_mesh_equivalence(tmp_path):
     from shifu_tpu.data.streaming import ShardStream
     from shifu_tpu.parallel.mesh import device_mesh
