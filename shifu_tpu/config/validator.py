@@ -102,12 +102,16 @@ def probe(mc: ModelConfig, step: ModelStep, model_set_dir: str = ".") -> None:
         ds = mc.dataSet
         if not ds.dataPath:
             problems.append("dataSet.dataPath must be set")
-        elif step == ModelStep.INIT and "://" not in ds.dataPath and \
-                not os.path.exists(ds.dataPath if os.path.isabs(ds.dataPath)
-                                   else os.path.join(model_set_dir,
-                                                     ds.dataPath)):
-            # reference checkRawData → checkFile (:359-372, :939)
-            problems.append(f"dataSet.dataPath does not exist: {ds.dataPath}")
+        elif step == ModelStep.INIT and "://" not in ds.dataPath:
+            # reference checkRawData → checkFile (:359-372, :939);
+            # dataPath may be a glob ('data/part-*') — resolve it the way
+            # the reader does rather than os.path.exists
+            p = ds.dataPath if os.path.isabs(ds.dataPath) \
+                else os.path.join(model_set_dir, ds.dataPath)
+            import glob as _glob
+            if not (os.path.exists(p) or _glob.glob(p)):
+                problems.append(
+                    f"dataSet.dataPath does not exist: {ds.dataPath}")
         if not ds.targetColumnName:
             problems.append("dataSet.targetColumnName must be set")
         if not ds.posTags and not ds.negTags:

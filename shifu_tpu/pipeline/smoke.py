@@ -28,22 +28,32 @@ class SmokeTestProcessor(BasicProcessor):
         # blank = training set only, "*" = train + every eval set,
         # a name = that eval set only; default (no -filter) tests all
         target = self.params.get("filter_target")
+        # four cases: None / "*" = training + all evals; "" = training
+        # only; "a,b" = the named eval sets (comma-split, like the
+        # reference's per-name loop)
+        if target in (None, "*"):
+            names = None
+        elif str(target).strip() == "":
+            return self._test_source("training", mc.dataSet, for_eval=None)
+        else:
+            names = [t.strip() for t in str(target).split(",") if t.strip()]
+            if not names:                       # e.g. "," — a typo, not blank
+                log.error("test -filter %r: no eval set names given", target)
+                return 1
         rc = 0
-        if target in (None, "", "*"):
+        if names is None:
             rc |= self._test_source("training", mc.dataSet, for_eval=None)
-        if target == "":
-            return rc
-        matched = False
+        unmatched = set(names or [])
         for i, ev in enumerate(mc.evals):
-            if target not in (None, "*") and ev.name != target:
+            if names is not None and ev.name not in names:
                 continue
             if ev.dataSet.dataPath:
-                matched = True
+                unmatched.discard(ev.name)
                 rc |= self._test_source(f"eval:{ev.name}", ev.dataSet,
                                         for_eval=i)
-        if target not in (None, "", "*") and not matched:
+        if unmatched:
             log.error("test -filter %s: no such eval set (or it has no "
-                      "dataPath) — nothing was tested", target)
+                      "dataPath): %s", target, sorted(unmatched))
             return 1
         return rc
 
