@@ -116,6 +116,145 @@ def sweep(scores: np.ndarray, targets: np.ndarray,
                        wneg_total=float(wfp[-1]) if len(wfp) else 0.0)
 
 
+CURVE_POINTS = 1024     # device-sweep downsample resolution (charts/buckets)
+
+
+def _sweep_device_impl(s, t, w, points: int):
+    """Whole confusion sweep ON DEVICE; one packed fetch.
+
+    The host sweep (above) argsorts fetched scores — on this rig a
+    full-set fetch costs 100-250 ms before sorting starts, putting eval
+    ~2 orders below the train plane (BENCH_r03).  Here sort, cumsums and
+    the tie-group reductions all run on device and only
+    ``5*points + 7`` floats cross the link.
+
+    Deliberately scatter-free (TPU serializes scatters): tie groups are
+    resolved with cummax/cummin scans + gathers —
+      start_idx[i] = index of row i's tie-group start (forward cummax)
+      end_idx[i]   = index of its group end (reverse cummin)
+    AUC/wAUC use the tie-corrected Mann-Whitney sum, which equals the
+    trapezoid over the tie-collapsed curve exactly; PR-AUC accumulates
+    per-group trapezoid contributions at group-end rows.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = s.shape[0]
+    # f64 when x64 is live (checked, not assumed: .astype(f64) under
+    # disabled x64 truncates with a warning per call)
+    f = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    tiny = 1e-12
+    neg_s, t, w = jax.lax.sort(
+        (-s.astype(f), t.astype(f), w.astype(f)), num_keys=1,
+        is_stable=True)
+    s = -neg_s
+    idx = jnp.arange(n)
+    tp = jnp.cumsum(t)
+    fp = jnp.cumsum(1.0 - t)
+    wtp = jnp.cumsum(t * w)
+    wfp = jnp.cumsum((1.0 - t) * w)
+    pos, neg, wpos, wneg = tp[-1], fp[-1], wtp[-1], wfp[-1]
+
+    newg = jnp.concatenate([jnp.ones(1, bool), s[1:] != s[:-1]])
+    is_end = jnp.concatenate([s[1:] != s[:-1], jnp.ones(1, bool)])
+    start_idx = jax.lax.cummax(jnp.where(newg, idx, -1))
+    end_idx = jnp.flip(jax.lax.cummin(
+        jnp.flip(jnp.where(is_end, idx, n - 1))))
+    j_prev = start_idx - 1                      # end of the previous group
+    jp = jnp.maximum(j_prev, 0)
+    has_prev = j_prev >= 0
+
+    fp_end, wfp_end = fp[end_idx], wfp[end_idx]
+    fp_before = jnp.where(has_prev, fp[jp], 0.0)
+    wfp_before = jnp.where(has_prev, wfp[jp], 0.0)
+    # exact tie-corrected AUC: per positive row, negatives strictly below
+    # + half the negatives tied with it
+    auc = jnp.sum(t * ((neg - fp_end) + 0.5 * (fp_end - fp_before))) \
+        / jnp.maximum(pos * neg, tiny)
+    wauc = jnp.sum((t * w) * ((wneg - wfp_end)
+                              + 0.5 * (wfp_end - wfp_before))) \
+        / jnp.maximum(wpos * wneg, tiny)
+
+    # PR-AUC trapezoid over group ends (r_{-1}=0, p_{-1}=p_0, matching
+    # the host evaluate_curves integration)
+    tp_end = tp[end_idx]
+    prec_end = tp_end / jnp.maximum(tp_end + fp_end, tiny)
+    rec_end = tp_end / jnp.maximum(pos, tiny)
+    prev_tp = jnp.where(has_prev, tp[jp], 0.0)
+    prev_fp = jnp.where(has_prev, fp[jp], 0.0)
+    prev_prec = jnp.where(
+        has_prev, prev_tp / jnp.maximum(prev_tp + prev_fp, tiny), prec_end)
+    prev_rec = prev_tp / jnp.maximum(pos, tiny)
+    pr_auc = jnp.sum(jnp.where(
+        is_end, (rec_end - prev_rec) * (prec_end + prev_prec) * 0.5, 0.0))
+
+    # downsampled curve: 'points' equal-population rows snapped to their
+    # tie-group end (cumulative population at row i is exactly i+1)
+    rows = jnp.clip((jnp.arange(1, points + 1) * n) // points - 1, 0, n - 1)
+    e = end_idx[rows]
+    packed = jnp.concatenate([
+        s[e], tp[e], fp[e], wtp[e], wfp[e],
+        jnp.stack([auc, wauc, pr_auc, pos, neg, wpos, wneg])])
+    return packed
+
+
+_sweep_device_jit = None      # lazily jitted (keeps jax import lazy here)
+
+
+def sweep_device(scores, targets, weights=None,
+                 points: int = CURVE_POINTS):
+    """Device-side :func:`sweep`: returns ``(SweepCurves, exact_aucs)``.
+
+    ``scores``/``targets``/``weights`` may live on device already (the
+    scorer's resident plane) — nothing but the packed curve crosses the
+    link.  ``exact_aucs`` is ``(auc, wauc, pr_auc)`` at full resolution;
+    the curves are downsampled to ``points`` for charts/buckets.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = int(scores.shape[0])
+    if n == 0:
+        return sweep(np.zeros(0), np.zeros(0)), (float("nan"),) * 3
+    if weights is None:
+        weights = jnp.ones(n, jnp.float32)
+    global _sweep_device_jit
+    if _sweep_device_jit is None:
+        _sweep_device_jit = jax.jit(_sweep_device_impl,
+                                    static_argnames=("points",))
+    packed = np.asarray(_sweep_device_jit(
+        jnp.asarray(scores), jnp.asarray(targets), jnp.asarray(weights),
+        min(points, n)))
+    p = min(points, n)
+    thr, tp, fp, wtp, wfp = (packed[i * p:(i + 1) * p] for i in range(5))
+    auc, wauc, pr_auc, pos, neg, wpos, wneg = packed[5 * p:]
+    if p > 1:     # collapse duplicate group snaps (ties / n < points)
+        keep = np.concatenate([np.flatnonzero(np.diff(thr) != 0),
+                               [p - 1]])
+        thr, tp, fp, wtp, wfp = (a[keep] for a in (thr, tp, fp, wtp, wfp))
+    curves = SweepCurves(thresholds=thr, tp=tp, fp=fp, wtp=wtp, wfp=wfp,
+                         pos_total=float(pos), neg_total=float(neg),
+                         wpos_total=float(wpos), wneg_total=float(wneg))
+    return curves, (float(auc), float(wauc), float(pr_auc))
+
+
+def evaluate_scores_device(scores, targets, weights=None,
+                           buckets: int = 10,
+                           points: int = CURVE_POINTS):
+    """Device-plane :func:`evaluate_scores`: returns ``(curves, result)``
+    with AUC/wAUC/PR-AUC computed exactly on device (the bucket rows come
+    from the downsampled curve — boundary error ≤ 1/points of the
+    population, the reference's own bucket granularity is 1/10)."""
+    curves, (auc, wauc, pr_auc) = sweep_device(scores, targets, weights,
+                                               points)
+    result = evaluate_curves(curves, buckets)
+    if not np.isnan(result.areaUnderRoc):
+        result.areaUnderRoc = auc
+        result.weightedAuc = wauc
+        result.areaUnderPr = pr_auc
+    return curves, result
+
+
 def evaluate_scores(scores: np.ndarray, targets: np.ndarray,
                     weights: Optional[np.ndarray] = None,
                     buckets: int = 10) -> PerformanceResult:

@@ -117,33 +117,44 @@ class Scorer:
               bins: Optional[np.ndarray] = None) -> CaseScoreResult:
         """Tree models consume the binned matrix (``input_kind == 'bins'``),
         NN/LR the normalized floats — both come from one transform pass.
-        Same-shape NN models score as one stacked jit call."""
+        Same-shape NN models score as one stacked jit call.  Thin host
+        wrapper over :meth:`score_device` — ONE [n, M] fetch, aggregates
+        on host (the dispatch rules live in one place)."""
         import jax.numpy as jnp
-        cols: List[Optional[np.ndarray]] = [None] * len(self.models)
-        groups = self._stacked_nn_groups()
-        if groups:
-            xj = jnp.asarray(x, jnp.float32)
-            for idxs, stacked, fwd in groups:
-                outs = np.asarray(fwd(stacked, xj))    # [M, n, out]
-                for pos, i in enumerate(idxs):
-                    cols[i] = outs[pos][:, 0]
+        raw_d, _ = self.score_device(
+            jnp.asarray(x, jnp.float32),
+            None if bins is None else jnp.asarray(bins))
+        raw = np.asarray(raw_d)
+        return CaseScoreResult(scores=raw, mean=raw.mean(axis=1),
+                               max=raw.max(axis=1), min=raw.min(axis=1),
+                               median=np.median(raw, axis=1))
+
+    def score_device(self, x_dev, bins_dev=None):
+        """Device-plane scoring: per-model columns stay in HBM; returns
+        ``(scores [n, M], mean [n])`` device arrays (feed them straight to
+        :func:`shifu_tpu.eval.metrics.sweep_device` — nothing crosses the
+        link).  Same dispatch rules as :meth:`score`."""
+        import jax.numpy as jnp
+        cols = [None] * len(self.models)
+        for idxs, stacked, fwd in self._stacked_nn_groups():
+            outs = fwd(stacked, x_dev)                 # [M, n, out] device
+            for pos, i in enumerate(idxs):
+                cols[i] = outs[pos][:, 0]
         for i, m in enumerate(self.models):
             if cols[i] is not None:
                 continue
             kind = getattr(m, "input_kind", "norm")
-            if kind in ("bins", "both") and bins is None:
+            if kind in ("bins", "both") and bins_dev is None:
                 raise ValueError(f"{type(m).__name__} requires binned input "
-                                 "— pass bins= to Scorer.score")
+                                 "— pass bins= to the scorer")
             if kind == "bins":
-                cols[i] = np.asarray(m.compute(bins))[:, 0]
+                cols[i] = jnp.asarray(m.compute(bins_dev))[:, 0]
             elif kind == "both":
-                cols[i] = np.asarray(m.compute_full(x, bins))[:, 0]
+                cols[i] = jnp.asarray(m.compute_full(x_dev, bins_dev))[:, 0]
             else:
-                cols[i] = np.asarray(m.compute(x))[:, 0]
-        raw = np.stack(cols, axis=1) * self.scale
-        return CaseScoreResult(scores=raw, mean=raw.mean(axis=1),
-                               max=raw.max(axis=1), min=raw.min(axis=1),
-                               median=np.median(raw, axis=1))
+                cols[i] = jnp.asarray(m.compute(x_dev))[:, 0]
+        raw = jnp.stack(cols, axis=1) * self.scale
+        return raw, raw.mean(axis=1)
 
     # ------------------------------------------------------- multi-class
     def n_classes(self) -> int:

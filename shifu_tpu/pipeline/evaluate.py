@@ -186,6 +186,11 @@ class EvalProcessor(BasicProcessor):
         if action == "score":
             return 0
 
+        # host sweep by choice: the per-row score CSV above already forced
+        # the scores to the host, and re-uploading them to sweep on device
+        # costs more than the host argsort on this link (~5 MB/s up).  The
+        # device plane (metrics.sweep_device / Scorer.score_device) serves
+        # callers whose scores are HBM-resident.
         from ..eval.metrics import evaluate_curves, sweep
         curves = sweep(scores, targets, weights)   # ONE sort; two consumers
         result = evaluate_curves(curves, buckets=ev.performanceBucketNum)

@@ -41,6 +41,76 @@ def test_auc_matches_rank_statistic():
     assert res.areaUnderRoc == pytest.approx(mw, abs=1e-6)
 
 
+def test_device_sweep_matches_host_exactly():
+    """Device sweep (sort/cumsum/tie-scans in HBM, one packed fetch) must
+    reproduce the host sweep's AUC/wAUC/PR-AUC exactly — ties included —
+    and its downsampled curve points must lie ON the host curve."""
+    from shifu_tpu.eval.metrics import evaluate_scores_device, sweep
+
+    rng = np.random.default_rng(5)
+    n = 5000
+    # heavy ties: quantized scores
+    scores = np.round(rng.normal(size=n), 2)
+    targets = (rng.random(n) < 0.3).astype(float)
+    scores[targets == 1] += 0.5
+    weights = rng.random(n) + 0.5
+    host = evaluate_scores(scores, targets, weights)
+    import jax
+    with jax.enable_x64():        # exactness check at f64 (TPU runs f32)
+        curves, dev = evaluate_scores_device(scores, targets, weights)
+    assert dev.areaUnderRoc == pytest.approx(host.areaUnderRoc, abs=1e-12)
+    assert dev.weightedAuc == pytest.approx(host.weightedAuc, abs=1e-12)
+    assert dev.areaUnderPr == pytest.approx(host.areaUnderPr, abs=1e-12)
+    # default (f32, the TPU precision) stays within float tolerance
+    _, dev32 = evaluate_scores_device(scores, targets, weights)
+    assert dev32.areaUnderRoc == pytest.approx(host.areaUnderRoc, abs=2e-4)
+    assert dev.recordCount == host.recordCount
+    assert dev.posCount == pytest.approx(host.posCount)
+    # every downsampled point must be an exact host tie-group end
+    hc = sweep(scores, targets, weights)
+    host_pts = {(round(t, 9), tp, fp)
+                for t, tp, fp in zip(hc.thresholds, hc.tp, hc.fp)}
+    for t, tp, fp in zip(curves.thresholds, curves.tp, curves.fp):
+        assert (round(t, 9), tp, fp) in host_pts
+
+
+def test_device_sweep_small_and_degenerate():
+    from shifu_tpu.eval.metrics import evaluate_scores_device
+
+    # n < points path + all-one-class degenerate
+    scores = np.array([0.9, 0.8, 0.8, 0.1])
+    targets = np.array([1.0, 1.0, 0.0, 0.0])
+    _, res = evaluate_scores_device(scores, targets)
+    host = evaluate_scores(scores, targets)
+    assert res.areaUnderRoc == pytest.approx(host.areaUnderRoc, abs=1e-12)
+    _, degen = evaluate_scores_device(scores, np.ones(4))
+    assert np.isnan(degen.areaUnderRoc)
+
+
+def test_score_device_matches_host_scorer():
+    """score_device must agree with score() and feed sweep_device without
+    leaving HBM (the bench/eval resident plane)."""
+    import jax
+    import jax.numpy as jnp
+    from shifu_tpu.models.nn import (IndependentNNModel, NNModelSpec,
+                                     init_params)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 16)).astype(np.float32)
+    spec = NNModelSpec(input_dim=16, hidden_nodes=[8, 4],
+                       activations=["relu", "relu"], output_dim=1)
+    models = [IndependentNNModel(spec, init_params(jax.random.PRNGKey(i),
+                                                   spec))
+              for i in range(3)]
+    sc = Scorer(models)
+    host = sc.score(x)
+    raw_d, mean_d = sc.score_device(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(raw_d), host.scores,
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(mean_d), host.mean,
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_weighted_auc_reweights():
     scores = np.array([0.9, 0.8, 0.3, 0.2])
     targets = np.array([1.0, 0.0, 1.0, 0.0])
