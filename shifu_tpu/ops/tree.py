@@ -393,9 +393,13 @@ def predict_forest_stacked(split_feats, left_masks, leaf_values, bins,
 def predict_forest(trees, bins, weights=None) -> np.ndarray:
     """Weighted-average forest prediction (RF mean vote / GBT partial sums
     are built by the caller).  Trees stack per depth group (continuous runs
-    may append trees of a different depth)."""
+    may append trees of a different depth).  Multiclass forests (2D
+    ``leaf_value`` class distributions) average to [n, K]."""
     bins = jnp.asarray(bins, jnp.int32)
-    preds = np.empty((len(trees), bins.shape[0]), np.float32)
+    k = trees[0].leaf_value.shape[1] if trees[0].leaf_value.ndim == 2 else 0
+    shape = (len(trees), bins.shape[0], k) if k \
+        else (len(trees), bins.shape[0])
+    preds = np.empty(shape, np.float32)
     by_depth: dict = {}
     for i, t in enumerate(trees):
         by_depth.setdefault(t.depth, []).append(i)
@@ -405,5 +409,5 @@ def predict_forest(trees, bins, weights=None) -> np.ndarray:
             predict_forest_stacked(sf, lm, lv, bins, depth))
     if weights is None:
         return preds.mean(axis=0)
-    w = np.asarray(weights)[:, None]
+    w = np.asarray(weights).reshape((-1,) + (1,) * (preds.ndim - 1))
     return (preds * w).sum(axis=0)

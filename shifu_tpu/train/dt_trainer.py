@@ -31,8 +31,8 @@ from __future__ import annotations
 import json
 import logging
 import os
-from dataclasses import dataclass, field
-from functools import partial
+from dataclasses import dataclass, field, replace
+from functools import lru_cache, partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -187,13 +187,10 @@ _gbt_round = partial(jax.jit, static_argnames=(
     "max_leaves", "has_cat", "mesh"))(_gbt_round_impl)
 
 
-@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
-                                   "n_trees", "use_pallas", "max_leaves",
-                                   "has_cat", "mesh"))
-def _gbt_forest(bins, y, tw, vw, f, fa_all, cat, lr, min_instances,
-                min_gain, n_bins: int, depth: int, impurity: str,
-                loss: str, n_trees: int, use_pallas: bool = False,
-                max_leaves: int = 0, has_cat: bool = True, mesh=None):
+def _gbt_forest_impl(bins, y, tw, vw, f, fa_all, cat, lr, min_instances,
+                     min_gain, n_bins: int, depth: int, impurity: str,
+                     loss: str, n_trees: int, use_pallas: bool = False,
+                     max_leaves: int = 0, has_cat: bool = True, mesh=None):
     """A whole chunk of the GBT forest as ONE executable (``lax.scan`` over
     trees).  The per-tree loop costs one program execution per tree; over a
     remote-device link each execution carries latency that dwarfs the
@@ -212,6 +209,30 @@ def _gbt_forest(bins, y, tw, vw, f, fa_all, cat, lr, min_instances,
 
     f_out, packed = jax.lax.scan(body, f, fa_all)
     return f_out, packed
+
+
+_gbt_forest = partial(jax.jit, static_argnames=(
+    "n_bins", "depth", "impurity", "loss", "n_trees", "use_pallas",
+    "max_leaves", "has_cat", "mesh"))(_gbt_forest_impl)
+
+
+@lru_cache(maxsize=None)
+def _gbt_forest_multi(n_bins: int, depth: int, impurity: str, loss: str,
+                      n_trees: int, use_pallas: bool, max_leaves: int,
+                      has_cat: bool, mesh=None):
+    """vmapped :func:`_gbt_forest_impl` over a leading member axis —
+    bagging members / same-structure grid trials train as ONE executable
+    (reference queues one Guagua job per bag/combo,
+    ``TrainModelProcessor.java:768-945``).  Members vary in weights,
+    scores, feature subsets and the traced scalar hypers (lr /
+    min_instances / min_gain); ``bins``/``y``/``cat`` broadcast."""
+    def one(bins, y, tw, vw, f, fa_all, cat, lr, mi, mg):
+        return _gbt_forest_impl(bins, y, tw, vw, f, fa_all, cat, lr, mi,
+                                mg, n_bins, depth, impurity, loss, n_trees,
+                                use_pallas, max_leaves, has_cat, mesh)
+    return jax.jit(jax.vmap(one,
+                            in_axes=(None, None, 0, 0, 0, 0, None, 0, 0,
+                                     0)))
 
 
 def _rf_round_impl(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
@@ -287,15 +308,12 @@ def _pack_tree_impl(sf, lm, lv, gfi, tr, va):
 
 _pack_tree = jax.jit(_pack_tree_impl)
 
-@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
-                                   "poisson", "n_classes", "n_trees",
-                                   "use_pallas", "max_leaves", "has_cat",
-                                   "mesh"))
-def _rf_forest(bins, y, w, base_key, tree_ids, bag_rate, oob_sum, oob_cnt,
-               fa_all, cat, min_instances, min_gain, n_bins: int,
-               depth: int, impurity: str, loss: str, poisson: bool,
-               n_classes: int, n_trees: int, use_pallas: bool = False,
-               max_leaves: int = 0, has_cat: bool = True, mesh=None):
+def _rf_forest_impl(bins, y, w, base_key, tree_ids, bag_rate, oob_sum,
+                    oob_cnt, fa_all, cat, min_instances, min_gain,
+                    n_bins: int, depth: int, impurity: str, loss: str,
+                    poisson: bool, n_classes: int, n_trees: int,
+                    use_pallas: bool = False, max_leaves: int = 0,
+                    has_cat: bool = True, mesh=None):
     """A chunk of the RF forest as ONE executable (see :func:`_gbt_forest`).
     Per-tree keys fold the tree id into the base key on device — identical
     draws to the per-tree path, so resumed and scanned runs agree."""
@@ -314,6 +332,32 @@ def _rf_forest(bins, y, w, base_key, tree_ids, bag_rate, oob_sum, oob_cnt,
     (oob_sum, oob_cnt), packed = jax.lax.scan(
         body, (oob_sum, oob_cnt), (fa_all, tree_ids))
     return oob_sum, oob_cnt, packed
+
+
+_rf_forest = partial(jax.jit, static_argnames=(
+    "n_bins", "depth", "impurity", "loss", "poisson", "n_classes",
+    "n_trees", "use_pallas", "max_leaves", "has_cat",
+    "mesh"))(_rf_forest_impl)
+
+
+@lru_cache(maxsize=None)
+def _rf_forest_multi(n_bins: int, depth: int, impurity: str, loss: str,
+                     poisson: bool, n_classes: int, n_trees: int,
+                     use_pallas: bool, max_leaves: int, has_cat: bool,
+                     mesh=None):
+    """vmapped :func:`_rf_forest_impl` over a leading member axis (see
+    :func:`_gbt_forest_multi`); members vary in weights, keys, oob state,
+    feature subsets, bag rate and the traced scalar hypers."""
+    def one(bins, y, w, base_key, tree_ids, bag_rate, oob_sum, oob_cnt,
+            fa_all, cat, mi, mg):
+        return _rf_forest_impl(bins, y, w, base_key, tree_ids, bag_rate,
+                               oob_sum, oob_cnt, fa_all, cat, mi, mg,
+                               n_bins, depth, impurity, loss, poisson,
+                               n_classes, n_trees, use_pallas, max_leaves,
+                               has_cat, mesh)
+    return jax.jit(jax.vmap(one,
+                            in_axes=(None, None, 0, 0, None, 0, 0, 0, 0,
+                                     None, 0, 0)))
 
 
 def _unpack_tree(vec: np.ndarray, total: int, n_bins: int, c: int,
@@ -587,6 +631,167 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
         trees_built=len(trees), history=history)
 
 
+# ------------------------------------------------- bagged / grid members
+def _device_put_members(mesh, *arrays):
+    """Shard [B, rows] member matrices over the mesh's data axis (rows =
+    axis 1; members replicate)."""
+    if mesh is None:
+        return [jnp.asarray(a) for a in arrays]
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    data_size = mesh.shape["data"]
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        extra = (-a.shape[1]) % data_size
+        if extra:
+            pad = np.zeros((a.shape[0], extra) + a.shape[2:], a.dtype)
+            a = np.concatenate([a, pad], axis=1)
+        out.append(jax.device_put(
+            a, NamedSharding(mesh, P(None, "data"))))
+    return out
+
+
+def _check_member_structure(settings_list: List[DTSettings]) -> DTSettings:
+    s0 = settings_list[0]
+    for s in settings_list[1:]:
+        same = (s.n_trees == s0.n_trees and s.depth == s0.depth
+                and s.impurity == s0.impurity and s.loss == s0.loss
+                and s.feature_subset == s0.feature_subset
+                and s.max_leaves == s0.max_leaves
+                and s.n_classes == s0.n_classes
+                and s.poisson_bagging == s0.poisson_bagging)
+        if not same:
+            raise ValueError("bagged tree members must share structural "
+                             "params (TreeNum/MaxDepth/Impurity/Loss/...)")
+    return s0
+
+
+def _member_results(packed_bt, settings_list, total, n_bins, c, alg,
+                    n_classes=0) -> List[ForestResult]:
+    """Unpack a [B, T, L] stacked-forest fetch into per-member results."""
+    out = []
+    for b, s in enumerate(settings_list):
+        trees, fi = [], np.zeros(c)
+        history = []
+        for vec in packed_bt[b]:
+            tree, gfi, tr_err, va_err = _unpack_tree(
+                vec, total, n_bins, c, s.depth, n_classes)
+            trees.append(tree)
+            fi += gfi
+            history.append((tr_err, va_err))
+        kw: Dict[str, Any] = {"algorithm": alg}
+        if alg == "GBT":
+            kw.update({"loss": s.loss, "learning_rate": s.learning_rate})
+        if n_classes > 2:
+            kw["extra"] = {"n_classes": n_classes}
+        out.append(ForestResult(
+            trees=trees, spec_kwargs=kw,
+            train_error=history[-1][0] if history else float("nan"),
+            valid_error=history[-1][1] if history else float("nan"),
+            feature_importance=fi, trees_built=len(trees),
+            history=history))
+    return out
+
+
+def train_gbt_bagged(bins, y, tw_m, vw_m, n_bins: int, cat_mask,
+                     settings_list: List[DTSettings], mesh=None,
+                     progress=None) -> List[ForestResult]:
+    """B independent GBT forests as ONE vmapped executable (reference
+    bagging/grid fan-out, ``TrainModelProcessor.java:768-945``, one Guagua
+    job per member).  Members share structure (TreeNum/MaxDepth/...) and
+    vary in row weights ``tw_m``/``vw_m`` [B, n], seeds (feature subsets)
+    and the traced scalars LearningRate / MinInstancesPerNode /
+    MinInfoGain.  Early stop / checkpointing are per-run features of
+    :func:`train_gbt`; callers fall back to sequential runs for those."""
+    s0 = _check_member_structure(settings_list)
+    n, c = bins.shape
+    tw_m = np.asarray(tw_m, np.float32)
+    vw_m = np.asarray(vw_m, np.float32)
+    y64 = np.asarray(y, np.float64)
+
+    init_scores = []
+    for b, s in enumerate(settings_list):
+        prior = float((y64 * tw_m[b]).sum() / max(tw_m[b].sum(), 1e-9))
+        if s.loss == "log":
+            prior = float(np.clip(prior, 1e-6, 1 - 1e-6))
+            init_scores.append(float(np.log(prior / (1 - prior))))
+        else:
+            init_scores.append(prior)
+
+    bins_d, y_d = _device_put_rows(mesh, np.asarray(bins, np.int32),
+                                   y64.astype(np.float32))
+    tw_d, vw_d = _device_put_members(mesh, tw_m, vw_m)
+    n_pad = bins_d.shape[0]
+    f = jnp.asarray(np.repeat(np.asarray(init_scores, np.float32)[:, None],
+                              n_pad, axis=1))
+    cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
+    hc = bool(np.asarray(cat).any())
+    fa_all = jnp.asarray(np.stack(
+        [[_feat_subset(s, c, t) for t in range(s0.n_trees)]
+         for s in settings_list]))                       # [B, T, C]
+    lr = jnp.asarray([s.learning_rate for s in settings_list])
+    mi = jnp.asarray([s.min_instances for s in settings_list])
+    mg = jnp.asarray([s.min_gain for s in settings_list])
+    imp = "friedmanmse" if s0.impurity == "friedmanmse" else "variance"
+    fn = _gbt_forest_multi(n_bins, s0.depth, imp, s0.loss, s0.n_trees,
+                           _use_pallas(mesh), s0.max_leaves, hc,
+                           _hist_mesh(mesh))
+    _, packed = fn(bins_d, y_d, tw_d, vw_d, f, fa_all, cat, lr, mi, mg)
+    total = n_tree_nodes(s0.depth)
+    results = _member_results(np.asarray(packed), settings_list, total,
+                              n_bins, c, "GBT")
+    for b, (res, s) in enumerate(zip(results, settings_list)):
+        res.spec_kwargs["init_score"] = init_scores[b]
+        if progress:
+            for ti, (tr, va) in enumerate(res.history):
+                progress(b, ti, tr, va)
+    return results
+
+
+def train_rf_bagged(bins, y, w_m, n_bins: int, cat_mask,
+                    settings_list: List[DTSettings], mesh=None,
+                    progress=None) -> List[ForestResult]:
+    """B independent RF/DT forests as ONE vmapped executable (see
+    :func:`train_gbt_bagged`).  ``w_m`` [B, n]: per-member row weights
+    (the bagging sample); validation is per-member out-of-bag."""
+    s0 = _check_member_structure(settings_list)
+    n, c = bins.shape
+    B = len(settings_list)
+    mc = s0.n_classes if s0.n_classes > 2 else 0
+    bins_d, y_d = _device_put_rows(mesh, np.asarray(bins, np.int32),
+                                   np.asarray(y, np.float32))
+    w_d, = _device_put_members(mesh, np.asarray(w_m, np.float32))
+    n_pad = bins_d.shape[0]
+    cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
+    hc = bool(np.asarray(cat).any())
+    oob_shape = (B, n_pad, s0.n_classes) if mc else (B, n_pad)
+    oob_sum = jnp.zeros(oob_shape, jnp.float32)
+    oob_cnt = jnp.zeros((B, n_pad), jnp.float32)
+    base_key = jnp.stack([jax.random.PRNGKey(s.seed)
+                          for s in settings_list])
+    tree_ids = jnp.arange(s0.n_trees, dtype=jnp.uint32)
+    bag_rate = jnp.asarray([s.bagging_rate for s in settings_list])
+    fa_all = jnp.asarray(np.stack(
+        [[_feat_subset(s, c, t) for t in range(s0.n_trees)]
+         for s in settings_list]))
+    mi = jnp.asarray([s.min_instances for s in settings_list])
+    mg = jnp.asarray([s.min_gain for s in settings_list])
+    fn = _rf_forest_multi(n_bins, s0.depth, s0.impurity, s0.loss,
+                          s0.poisson_bagging, s0.n_classes, s0.n_trees,
+                          _use_pallas(mesh), s0.max_leaves, hc,
+                          _hist_mesh(mesh))
+    _, _, packed = fn(bins_d, y_d, w_d, base_key, tree_ids, bag_rate,
+                      oob_sum, oob_cnt, fa_all, cat, mi, mg)
+    total = n_tree_nodes(s0.depth)
+    results = _member_results(np.asarray(packed), settings_list, total,
+                              n_bins, c, "RF", s0.n_classes)
+    if progress:
+        for b, res in enumerate(results):
+            for ti, (tr, va) in enumerate(res.history):
+                progress(b, ti, tr, va)
+    return results
+
+
 # ------------------------------------------------------------- streaming
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level", "loss",
                                    "use_pallas", "mesh"))
@@ -606,14 +811,18 @@ def _gbt_window_hist(bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level",
-                                   "use_pallas", "mesh"))
+                                   "use_pallas", "mesh", "n_classes"))
 def _rf_window_hist(bins_w, y_w, w_w, bag_w, sf, lm, n_nodes: int,
                     n_bins: int, level: int, use_pallas: bool = False,
-                    mesh=None):
+                    mesh=None, n_classes: int = 0):
     bw_w = w_w * bag_w
     node_idx = node_index_at_level(sf, lm, bins_w, level)
-    stats = jnp.stack([bw_w, bw_w * y_w, bw_w * y_w * y_w], axis=1) \
-        .astype(jnp.float32)
+    if n_classes > 2:      # NATIVE multiclass: per-class weight channels
+        stats = bw_w[:, None] * jax.nn.one_hot(
+            y_w.astype(jnp.int32), n_classes, dtype=jnp.float32)
+    else:
+        stats = jnp.stack([bw_w, bw_w * y_w, bw_w * y_w * y_w], axis=1) \
+            .astype(jnp.float32)
     return build_histograms(bins_w, node_idx, stats, n_nodes, n_bins,
                             use_pallas, mesh)
 
@@ -629,13 +838,27 @@ def _gbt_window_update(bins_w, y_w, tw_w, vw_w, f_w, sf, lm, lv, lr,
     return f2, sums
 
 
-@partial(jax.jit, static_argnames=("depth", "loss"))
+@partial(jax.jit, static_argnames=("depth", "loss", "n_classes"))
 def _rf_window_update(bins_w, y_w, w_w, bag_w, oob_sum_w, oob_cnt_w,
-                      sf, lm, lv, depth: int, loss: str):
+                      sf, lm, lv, depth: int, loss: str,
+                      n_classes: int = 0):
     """RF per-window oob accumulate + loss-consistent error sums on device
-    (the round-2 host-numpy loop, jitted)."""
+    (the round-2 host-numpy loop, jitted).  Multiclass (``n_classes > 2``):
+    class-distribution votes + misclassification-rate errors, matching
+    :func:`_rf_round_impl`."""
     pred = predict_tree(sf, lm, lv, bins_w, depth)
     oob = (bag_w == 0) & (w_w > 0)
+    if n_classes > 2:
+        oob_sum2 = oob_sum_w + jnp.where(oob[:, None], pred, 0.0)
+        oob_cnt2 = oob_cnt_w + oob.astype(oob_cnt_w.dtype)
+        seen = oob_cnt2 > 0
+        yi = y_w.astype(jnp.int32)
+        per_v = (jnp.argmax(oob_sum2, axis=-1) != yi).astype(jnp.float32)
+        per_t = (jnp.argmax(pred, axis=-1) != yi).astype(jnp.float32)
+        wv = w_w * seen
+        sums = jnp.stack([(per_v * wv).sum(), wv.sum(),
+                          (per_t * w_w).sum(), w_w.sum()])
+        return oob_sum2, oob_cnt2, sums
     oob_sum2 = oob_sum_w + jnp.where(oob, pred, 0.0)
     oob_cnt2 = oob_cnt_w + oob.astype(oob_cnt_w.dtype)
     seen = oob_cnt2 > 0
@@ -657,26 +880,32 @@ def _rf_window_update(bins_w, y_w, w_w, bag_w, oob_sum_w, oob_cnt_w,
 
 
 def _unpack_streamed(packed: np.ndarray, total: int, n_bins: int, c: int,
-                     depth: int):
+                     depth: int, n_classes: int = 0):
     """Host-side inverse of the fused/streamed packed layout
     [sf, lm, lv, fi, sums] — the ONE place that knows it."""
+    k = n_classes if n_classes > 2 else 1
     sf_h, lm_h, lv_h, fi_h, sums = np.split(
-        packed, np.cumsum([total, total * n_bins, total, c]))
+        packed, np.cumsum([total, total * n_bins, total * k, c]))
+    lv = lv_h.astype(np.float32)
+    if k > 1:
+        lv = lv.reshape(total, k)
     tree = TreeArrays(split_feat=sf_h.astype(np.int32),
                       left_mask=lm_h.reshape(total, n_bins) > 0.5,
-                      leaf_value=lv_h.astype(np.float32), depth=depth)
+                      leaf_value=lv, depth=depth)
     return tree, fi_h.astype(np.float32), sums
 
 
 def _tree_level_step(hist, cat, fa, impurity: str, min_instances,
                      min_gain, has_cat: bool, level: int, depth: int,
-                     max_leaves: int, sf, lm, lv, nodes_cnt, fi_add):
+                     max_leaves: int, sf, lm, lv, nodes_cnt, fi_add,
+                     n_classes: int = 0):
     """One level of streamed tree growth from an aggregated histogram —
     the single implementation behind both the fused-resident executable
     and the disk-tail window loop (they must never drift)."""
     n_nodes = 1 << level
     gain, feat, lmask, leaf, _ = best_splits(
-        hist, cat, fa, impurity, min_instances, min_gain, has_cat=has_cat)
+        hist, cat, fa, impurity, min_instances, min_gain,
+        n_classes=n_classes, has_cat=has_cat)
     base = n_nodes - 1
     if level == depth:
         feat = jnp.full(n_nodes, -1, jnp.int32)
@@ -748,46 +977,55 @@ def _gbt_tree_fused(wins, fa, cat, lr, min_instances, min_gain,
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
                                    "use_pallas", "max_leaves", "has_cat",
-                                   "mesh"))
+                                   "mesh", "n_classes"))
 def _rf_tree_fused(wins, fa, cat, min_instances, min_gain, n_bins: int,
                    depth: int, impurity: str, loss: str,
                    use_pallas: bool, max_leaves: int, has_cat: bool,
-                   mesh=None):
+                   mesh=None, n_classes: int = 0):
     """One streamed RF tree over a FULLY-RESIDENT window cache as a single
     executable (see :func:`_gbt_tree_fused`).  ``wins``: tuple of
     (bins, y, w, bag, oob_sum, oob_cnt) per window.  Returns
-    (packed [tree + fi + sums], new (oob_sum, oob_cnt) per window)."""
+    (packed [tree + fi + sums], new (oob_sum, oob_cnt) per window).
+    Multiclass NATIVE: per-class stat channels + leaf distributions."""
     total = n_tree_nodes(depth)
     c = wins[0][0].shape[1]
+    multiclass = n_classes > 2
+    n_stats = n_classes if multiclass else 3
     sf = jnp.full(total, -1, jnp.int32)
     lm = jnp.zeros((total, n_bins), bool)
-    lv = jnp.zeros(total, jnp.float32)
+    lv = jnp.zeros((total, n_classes) if multiclass else total, jnp.float32)
     nodes_cnt = jnp.int32(1)
     fi_add = jnp.zeros(c, jnp.float32)
     for level in range(depth + 1):
         n_nodes = 1 << level
-        hist = jnp.zeros((n_nodes, c, n_bins, 3), jnp.float32)
+        hist = jnp.zeros((n_nodes, c, n_bins, n_stats), jnp.float32)
         for bins_w, y_w, w_w, bag_w, _, _ in wins:
             bw = w_w * bag_w
             node_idx = node_index_at_level(sf, lm, bins_w, level)
-            stats = jnp.stack([bw, bw * y_w, bw * y_w * y_w],
-                              axis=1).astype(jnp.float32)
+            if multiclass:
+                stats = bw[:, None] * jax.nn.one_hot(
+                    y_w.astype(jnp.int32), n_classes, dtype=jnp.float32)
+            else:
+                stats = jnp.stack([bw, bw * y_w, bw * y_w * y_w],
+                                  axis=1).astype(jnp.float32)
             hist = hist + build_histograms(bins_w, node_idx, stats,
                                            n_nodes, n_bins, use_pallas,
                                            mesh)
         sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
             hist, cat, fa, impurity, min_instances, min_gain, has_cat,
-            level, depth, max_leaves, sf, lm, lv, nodes_cnt, fi_add)
+            level, depth, max_leaves, sf, lm, lv, nodes_cnt, fi_add,
+            n_classes)
     sums = jnp.zeros(4, jnp.float32)
     new_oob = []
     for bins_w, y_w, w_w, bag_w, os_w, oc_w in wins:
         os2, oc2, s4 = _rf_window_update(
-            bins_w, y_w, w_w, bag_w, os_w, oc_w, sf, lm, lv, depth, loss)
+            bins_w, y_w, w_w, bag_w, os_w, oc_w, sf, lm, lv, depth, loss,
+            n_classes)
         sums = sums + s4
         new_oob.append((os2, oc2))
     packed = jnp.concatenate([
         sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
-        lv, fi_add, sums])
+        lv.reshape(-1), fi_add, sums])
     return packed, tuple(new_oob)
 
 
@@ -828,18 +1066,22 @@ def _stream_masks(idx: np.ndarray, n_valid: int, w_w: np.ndarray,
     return (w * ~vmask).astype(np.float32), (w * vmask).astype(np.float32)
 
 
-def _gbt_prepare(mesh, valid_rate: float, seed: int):
+def _gbt_prepare(mesh, valid_rate: float, seed: int, y_transform=None):
     """Window prepare hook for streamed GBT: hash train/valid masks once,
-    arrays onto the device (mesh-sharded over the data axis)."""
+    arrays onto the device (mesh-sharded over the data axis).
+    ``y_transform`` maps the raw window targets (one-vs-all binarization,
+    reference per-class jobs ``TrainModelProcessor.java:684-714``)."""
     from ..data.streaming import PreparedWindow
 
     def prep(win):
         tw, vw = _stream_masks(win.index, win.n_valid, win.arrays["w"],
                                valid_rate, seed)
+        y = np.asarray(win.arrays["y"], np.float32)
+        if y_transform is not None:
+            y = np.asarray(y_transform(y), np.float32)
         dev = _device_put_window(mesh, {
             "bins": np.asarray(win.arrays["bins"], np.int32),
-            "y": np.asarray(win.arrays["y"], np.float32),
-            "tw": tw, "vw": vw})
+            "y": y, "tw": tw, "vw": vw})
         return PreparedWindow(win.start, win.n_valid, win.rows,
                               win.index, dev)
     return prep
@@ -852,7 +1094,8 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                        checkpoint_fn: Optional[Callable] = None,
                        start_history: Optional[List] = None,
                        mesh=None,
-                       cache_budget: Optional[int] = None) -> ForestResult:
+                       cache_budget: Optional[int] = None,
+                       y_transform=None) -> ForestResult:
     """Out-of-core GBT over a ResidentCache: windows that fit the device
     budget are mesh-sharded HBM residents (re-sweeping them costs no IO);
     only the tail past the budget re-streams from disk per level.  The
@@ -878,7 +1121,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                           _default_cache_budget() if cache_budget is None
                           else cache_budget,
                           _gbt_prepare(mesh, settings.valid_rate,
-                                       settings.seed))
+                                       settings.seed, y_transform))
 
     # warm pass: width probe + init-score sums in one sweep
     c = None
@@ -1036,16 +1279,17 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
 
 
 def _window_f(f: np.ndarray, win, mesh=None):
-    """Slice the row-score cache for a window, padding past the end; shard
-    over the mesh data axis so it joins the window's arrays' layout."""
+    """Slice a per-row cache (1D scores or 2D per-class votes) for a
+    window, padding past the end; shard over the mesh data axis so it
+    joins the window's arrays' layout."""
     s = win.start
     e = min(s + win.rows, len(f))
-    out = np.zeros(win.rows, np.float32)
+    out = np.zeros((win.rows,) + f.shape[1:], np.float32)
     out[:e - s] = f[s:e]
     return _shard_rows(out, mesh)
 
 
-def _rf_prepare(mesh):
+def _rf_prepare(mesh, y_transform=None):
     """Window prepare hook for streamed RF: zero weights past n_valid once,
     arrays onto the device (mesh-sharded over the data axis)."""
     from ..data.streaming import PreparedWindow
@@ -1053,10 +1297,12 @@ def _rf_prepare(mesh):
     def prep(win):
         w = np.asarray(win.arrays["w"], np.float32).copy()
         w[win.n_valid:] = 0.0
+        y = np.asarray(win.arrays["y"], np.float32)
+        if y_transform is not None:
+            y = np.asarray(y_transform(y), np.float32)
         dev = _device_put_window(mesh, {
             "bins": np.asarray(win.arrays["bins"], np.int32),
-            "y": np.asarray(win.arrays["y"], np.float32),
-            "w": w})
+            "y": y, "w": w})
         return PreparedWindow(win.start, win.n_valid, win.rows,
                               win.index, dev)
     return prep
@@ -1069,7 +1315,8 @@ def _shard_rows(a: np.ndarray, mesh=None):
         return jnp.asarray(a)
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
-    return jax.device_put(a, NamedSharding(mesh, P("data")))
+    spec = P("data") if a.ndim == 1 else P("data", None)
+    return jax.device_put(a, NamedSharding(mesh, spec))
 
 
 def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
@@ -1078,7 +1325,8 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                       init_trees: Optional[List[TreeArrays]] = None,
                       start_history: Optional[List] = None,
                       mesh=None,
-                      cache_budget: Optional[int] = None) -> ForestResult:
+                      cache_budget: Optional[int] = None,
+                      y_transform=None) -> ForestResult:
     """Out-of-core RF over a ResidentCache: hash-based Poisson bags per
     (tree, row) keep bagging stateless across sweeps; oob vote caches
     (2 host arrays, rows x 4B) carry validation across trees.  Windows
@@ -1097,7 +1345,8 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
 
     cache = ResidentCache(stream,
                           _default_cache_budget() if cache_budget is None
-                          else cache_budget, _rf_prepare(mesh))
+                          else cache_budget,
+                          _rf_prepare(mesh, y_transform))
     c = None
     for win in stream.windows():      # peek the first window for the width;
         c = int(win.arrays["bins"].shape[1])   # cache warms during useful
@@ -1106,7 +1355,9 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
         raise RuntimeError("streamed RF: empty shard stream")
     cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
     hc = bool(np.asarray(cat).any())
-    oob_sum = np.zeros(n_rows, np.float32)
+    K = settings.n_classes
+    mc = K > 2          # NATIVE multiclass: per-class vote caches
+    oob_sum = np.zeros((n_rows, K) if mc else n_rows, np.float32)
     oob_cnt = np.zeros(n_rows, np.float32)
     fi_dev = jnp.zeros(c, jnp.float32)     # device-accumulated split gains
 
@@ -1147,7 +1398,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
             os2, oc2, s4 = _rf_window_update(
                 it.arrays["bins"], it.arrays["y"], it.arrays["w"],
                 window_bag(ti, it), osw, ocw, sf, lm, lv, depth,
-                settings.loss)
+                settings.loss, settings.n_classes)
             if it.resident:
                 it.arrays["oob"] = (os2, oc2)
             else:
@@ -1168,7 +1419,8 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
         nonlocal fi_dev
         for packed in flat_list:
             tree, fi_h, sums = _unpack_streamed(packed, total, n_bins, c,
-                                                settings.depth)
+                                                settings.depth,
+                                                settings.n_classes)
             fi_dev = fi_dev + jnp.asarray(fi_h)
             trees.append(tree)
             va_err = float(sums[0]) / max(float(sums[1]), 1e-9) \
@@ -1198,7 +1450,8 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
             packed_d, new_oob = _rf_tree_fused(
                 wins, fa, cat, settings.min_instances, settings.min_gain,
                 n_bins, settings.depth, settings.impurity, settings.loss,
-                up, settings.max_leaves, hc, _hist_mesh(mesh))
+                up, settings.max_leaves, hc, _hist_mesh(mesh),
+                settings.n_classes)
             for it, pair in zip(items, new_oob):
                 it.arrays["oob"] = pair
             if sync_each:
@@ -1214,25 +1467,27 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
             continue
         sf = jnp.full(total, -1, jnp.int32)
         lm = jnp.zeros((total, n_bins), bool)
-        lv = jnp.zeros(total, jnp.float32)
+        lv = jnp.zeros((total, K) if mc else total, jnp.float32)
         nodes_cnt = jnp.int32(1)
         fi_add = jnp.zeros(c, jnp.float32)
+        n_stats = K if mc else 3
         for level in range(settings.depth + 1):
             n_nodes = 1 << level
-            hist = jnp.zeros((n_nodes, c, n_bins, 3), jnp.float32)
+            hist = jnp.zeros((n_nodes, c, n_bins, n_stats), jnp.float32)
             for it in cache.items():
                 hist = hist + _rf_window_hist(
                     it.arrays["bins"], it.arrays["y"], it.arrays["w"],
                     window_bag(ti, it), sf, lm, n_nodes, n_bins, level,
-                    up, _hist_mesh(mesh))
+                    up, _hist_mesh(mesh), settings.n_classes)
             sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
                 hist, cat, fa, settings.impurity, settings.min_instances,
                 settings.min_gain, hc, level, settings.depth,
-                settings.max_leaves, sf, lm, lv, nodes_cnt, fi_add)
+                settings.max_leaves, sf, lm, lv, nodes_cnt, fi_add,
+                settings.n_classes)
         sums_dev = accumulate_oob(ti, sf, lm, lv, settings.depth)
         absorb_rf([np.asarray(jnp.concatenate([
             sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
-            lv, fi_add, sums_dev]))])
+            lv.reshape(-1), fi_add, sums_dev]))])
         tr_err, va_err = history[-1]
         if progress:
             progress(ti, tr_err, va_err)
@@ -1240,8 +1495,11 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                 (ti + 1) % settings.checkpoint_every == 0:
             checkpoint_fn(trees, history, None)
     drain_rf()
+    spec_kwargs: Dict[str, Any] = {"algorithm": "RF"}
+    if mc:
+        spec_kwargs["extra"] = {"n_classes": K}
     return ForestResult(
-        trees=trees, spec_kwargs={"algorithm": "RF"},
+        trees=trees, spec_kwargs=spec_kwargs,
         train_error=history[-1][0] if history else float("nan"),
         valid_error=history[-1][1] if history else float("nan"),
         feature_importance=np.asarray(fi_dev, np.float64),
@@ -1250,36 +1508,114 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
 
 
 # -------------------------------------------------------- pipeline driver
+def _tree_stream(shards, mesh):
+    """A ShardStream with the tree trainers' window geometry (env knobs +
+    data-axis rounding) — the ONE place that computes it (main streamed
+    path and per-class OVA sweeps must agree)."""
+    from ..config import environment
+    from ..data.streaming import ShardStream, auto_window_rows
+    budget = environment.get_int("shifu.train.memoryBudgetBytes", 1 << 31)
+    data_size = mesh.shape["data"]
+    ncols = len(shards.schema.get("columnNums", [])) or 1
+    window_rows = environment.get_int("shifu.train.windowRows", 0) or \
+        auto_window_rows(2 * ncols + 8, budget, multiple=data_size)
+    window_rows += (-window_rows) % data_size
+    return ShardStream(shards, ("bins", "y", "w"), window_rows)
+
+
 def _run_tree_ova(proc, shards, col_nums, cat_mask, n_bins,
-                  settings: DTSettings, alg, K: int) -> int:
+                  settings: DTSettings, alg, K: int,
+                  streaming: bool = False) -> int:
     """One-vs-all tree multiclass: K binary forests, ``model{k}`` scores
-    class k (reference ``TrainModelProcessor.java:684-714`` runs one bagging
-    job per class; here each class is a sequential forest on the full
-    mesh)."""
-    data = shards.load_all()
-    bins, y, w = data["bins"].astype(np.int32), data["y"], data["w"]
+    class k (reference ``TrainModelProcessor.java:684-714`` runs one
+    bagging job per class; here each class is a sequential forest on the
+    full mesh).  Streamed data trains each class out-of-core over its own
+    ResidentCache sweep.  ``train -resume`` restarts at the first
+    unfinished class, restoring a mid-forest checkpoint for the class
+    that was interrupted (reference combo ``-resume`` semantics)."""
     from ..parallel.mesh import device_mesh
     mesh = device_mesh(n_ensemble=1)
+    ext = alg.name.lower()
     os.makedirs(proc.paths.models_dir, exist_ok=True)
-    for f in os.listdir(proc.paths.models_dir):
-        if f.startswith("model"):
-            os.remove(os.path.join(proc.paths.models_dir, f))
-    fi_total = np.zeros(len(col_nums))
-    with open(proc.paths.progress_path, "w") as pf:
+    if not settings.resume:
+        for f in os.listdir(proc.paths.models_dir):
+            if f.startswith("model"):
+                os.remove(os.path.join(proc.paths.models_dir, f))
+    bins = y = w = None
+    if not streaming:
+        data = shards.load_all()
+        bins, y, w = data["bins"].astype(np.int32), data["y"], data["w"]
+
+    # per-class FI sidecars: a resumed run skips finished classes but must
+    # still report ALL classes' gains in feature_importance.json
+    def fi_path(k: int) -> str:
+        return os.path.join(proc.paths.tmp_dir, f"fi_class{k}.npy")
+
+    with open(proc.paths.progress_path,
+              "a" if settings.resume else "w") as pf:
         for k in range(K):
-            yk = (np.asarray(y) == k).astype(np.float32)
+            model_path = proc.paths.model_path(k, ext)
+            if settings.resume and os.path.isfile(model_path):
+                spec_k, trees_k = tree_model.load_model(model_path)
+                if spec_k.n_trees >= settings.n_trees:
+                    log.info("train %s OVA class %d/%d: already complete "
+                             "(%d trees), skipping", alg.name, k + 1, K,
+                             spec_k.n_trees)
+                    continue
+            init_trees, init_score, start_history = (None, None, None)
+            if settings.resume:
+                ck = _forest_checkpoint_path(proc, f"_c{k}")
+                if os.path.isfile(ck):
+                    spec_c, init_trees = tree_model.load_model(ck)
+                    init_score = spec_c.init_score
+                    meta = {}
+                    if os.path.isfile(ck + ".meta.json"):
+                        with open(ck + ".meta.json") as f:
+                            meta = json.load(f)
+                    start_history = [tuple(h)
+                                     for h in meta.get("history", [])]
+                    log.info("OVA resume: class %d restarts from %d "
+                             "checkpointed trees", k, len(init_trees))
+            ckpt_fn = _forest_checkpoint_fn(proc, settings, alg, n_bins,
+                                            col_nums, shards,
+                                            suffix=f"_c{k}")
 
             def progress(ti, tr, va, k=k):
                 pf.write(f"Class {k} Tree #{ti + 1} Train Error: {tr:.6f} "
                          f"Validation Error: {va:.6f}\n")
                 pf.flush()
 
-            if alg == Algorithm.GBT:
-                res = train_gbt(bins, yk, w, n_bins, cat_mask, settings,
-                                progress, mesh=mesh)
+            if streaming:
+                def yk_transform(yv, k=k):
+                    return (np.asarray(yv) == k).astype(np.float32)
+                if alg == Algorithm.GBT:
+                    res = train_gbt_streamed(
+                        _tree_stream(shards, mesh), n_bins, cat_mask,
+                        settings, progress, init_trees=init_trees,
+                        init_score=init_score, checkpoint_fn=ckpt_fn,
+                        start_history=start_history, mesh=mesh,
+                        y_transform=yk_transform)
+                else:
+                    res = train_rf_streamed(
+                        _tree_stream(shards, mesh), n_bins, cat_mask,
+                        settings, progress, checkpoint_fn=ckpt_fn,
+                        init_trees=init_trees,
+                        start_history=start_history, mesh=mesh,
+                        y_transform=yk_transform)
             else:
-                res = train_rf(bins, yk, w, n_bins, cat_mask, settings,
-                               progress, mesh=mesh)
+                yk = (np.asarray(y) == k).astype(np.float32)
+                if alg == Algorithm.GBT:
+                    res = train_gbt(bins, yk, w, n_bins, cat_mask, settings,
+                                    progress, init_trees=init_trees,
+                                    init_score=init_score,
+                                    checkpoint_fn=ckpt_fn,
+                                    start_history=start_history, mesh=mesh)
+                else:
+                    res = train_rf(bins, yk, w, n_bins, cat_mask, settings,
+                                   progress, checkpoint_fn=ckpt_fn,
+                                   init_trees=init_trees,
+                                   start_history=start_history, mesh=mesh)
+            if alg != Algorithm.GBT:
                 res.spec_kwargs["algorithm"] = \
                     "RF" if alg != Algorithm.DT else "DT"
             res.spec_kwargs.setdefault("extra", {}).update(
@@ -1289,17 +1625,176 @@ def _run_tree_ova(proc, shards, col_nums, cat_mask, n_bins,
                 column_nums=list(col_nums),
                 feature_names=shards.schema.get("columnNames"),
                 **res.spec_kwargs)
-            tree_model.save_model(proc.paths.model_path(k, alg.name.lower()),
-                                  spec, res.trees)
-            fi_total += res.feature_importance
+            tree_model.save_model(model_path, spec, res.trees)
+            np.save(fi_path(k), np.asarray(res.feature_importance))
             log.info("train %s OVA class %d/%d: %d trees, valid err %.6f",
                      alg.name, k + 1, K, res.trees_built, res.valid_error)
+    fi_total = np.zeros(len(col_nums))
+    for k in range(K):
+        if os.path.isfile(fi_path(k)):
+            fi_total += np.load(fi_path(k))
+        else:                                         # pragma: no cover
+            log.warning("OVA class %d has no stored feature importance "
+                        "(pre-resume run?); totals omit it", k)
     names = shards.schema.get("columnNames", [str(cn) for cn in col_nums])
     fi_named = sorted(((names[j], float(v)) for j, v in enumerate(fi_total)),
                       key=lambda kv: -kv[1])
     with open(os.path.join(proc.paths.tmp_dir, "feature_importance.json"),
               "w") as fjson:
         json.dump({k2: v for k2, v in fi_named}, fjson, indent=2)
+    return 0
+
+
+def _run_tree_multi(proc, shards, col_nums, cat_mask, n_bins, alg,
+                    trials, is_gs: bool, kfold: int, bags: int) -> int:
+    """Tree grid search / bagging / k-fold (reference
+    ``TrainModelProcessor.java:768-945`` runs one Guagua job per
+    bag/combo/fold; ``gs/GridSearch.java:62`` is algorithm-agnostic).
+
+    Same-structure members train as ONE vmapped multi-forest executable
+    (:func:`train_gbt_bagged` / :func:`train_rf_bagged`); structurally
+    different grid trials run group by group.  Streamed data or early
+    stop falls back to sequential full runs per member — the reference's
+    own job-queue shape."""
+    from ..parallel.mesh import device_mesh
+    from ..train.grid_search import tree_stackable_groups
+    from .sampling import member_masks
+
+    mc = proc.model_config
+    data = shards.load_all()
+    bins, y, w = data["bins"].astype(np.int32), data["y"], data["w"]
+    n = len(y)
+    mesh = device_mesh(n_ensemble=1)
+    streaming = proc._use_streaming(shards, shards.schema) \
+        if hasattr(proc, "_use_streaming") else False
+    if streaming:
+        log.warning("tree grid/bagging ignores streaming mode; members "
+                    "train in-RAM sequentially when data exceeds the "
+                    "budget, use fewer trials or more memory")
+
+    base = settings_from_params(mc.train.params if not is_gs else trials[0],
+                                mc.train, alg)
+    if is_gs:
+        settings_list = [settings_from_params(t, mc.train, alg)
+                         for t in trials]
+        member_trials = list(range(len(trials)))
+    else:
+        B = kfold if (kfold and kfold > 1) else bags
+        settings_list = [replace(base, seed=base.seed + b)
+                         for b in range(B)]
+        member_trials = [None] * B
+
+    ext = alg.name.lower()
+    os.makedirs(proc.paths.models_dir, exist_ok=True)
+    for f in os.listdir(proc.paths.models_dir):
+        if f.startswith("model"):
+            os.remove(os.path.join(proc.paths.models_dir, f))
+    os.makedirs(proc.paths.tmp_dir, exist_ok=True)
+
+    def run_members(idxs: List[int]) -> List[ForestResult]:
+        sl = [settings_list[i] for i in idxs]
+        if base.early_stop and alg == Algorithm.GBT:
+            # early stop is a per-run decision loop; honor it sequentially
+            return [train_gbt(bins, y, w * (tw_m[i] + vw_m[i] > 0), n_bins,
+                              cat_mask, sl[j], mesh=mesh)
+                    for j, i in enumerate(idxs)]
+        if alg == Algorithm.GBT:
+            return train_gbt_bagged(bins, y, tw_m[idxs] * w[None, :],
+                                    vw_m[idxs] * w[None, :], n_bins,
+                                    cat_mask, sl, mesh=mesh)
+        return train_rf_bagged(bins, y, tw_m[idxs] * w[None, :], n_bins,
+                               cat_mask, sl, mesh=mesh)
+
+    # sampling masks: grid trials share ONE split (isolate the hypers);
+    # bagging/k-fold members each get their bag/fold (reference bagging
+    # sample rate / CV folds).  RF validates on out-of-bag rows, so its
+    # members take the full bag as train weight (valid_rate=0).
+    rf_like = alg != Algorithm.GBT
+    if is_gs:
+        tw1, vw1 = member_masks(
+            n, 1, valid_rate=0.0 if rf_like else mc.train.validSetRate,
+            kfold=-1, sample_rate=mc.train.baggingSampleRate,
+            replacement=mc.train.baggingWithReplacement,
+            stratified=mc.train.stratifiedSample, targets=y,
+            seed=base.seed)
+        tw_m = np.repeat(tw1, len(trials), axis=0)
+        vw_m = np.repeat(vw1, len(trials), axis=0)
+        if rf_like:
+            tw_m = tw_m + vw_m          # oob validates; no held-out split
+    else:
+        tw_m, vw_m = member_masks(
+            n, bags, valid_rate=0.0 if rf_like else mc.train.validSetRate,
+            kfold=kfold, sample_rate=mc.train.baggingSampleRate,
+            replacement=mc.train.baggingWithReplacement,
+            stratified=mc.train.stratifiedSample, targets=y,
+            seed=base.seed)
+        if rf_like and not (kfold and kfold > 1):
+            tw_m = tw_m + vw_m
+
+    results: List[Optional[ForestResult]] = [None] * len(settings_list)
+    with open(proc.paths.progress_path, "w") as pf:
+        groups = tree_stackable_groups(trials) if is_gs \
+            else [list(range(len(settings_list)))]
+        for group in groups:
+            for j, res in zip(group, run_members(group)):
+                results[j] = res
+                label = f"Trial [{j}]" if is_gs else f"Bag [{j}]"
+                for ti, (tr, va) in enumerate(res.history):
+                    pf.write(f"{label} Tree #{ti + 1} Train Error: "
+                             f"{tr:.6f} Validation Error: {va:.6f}\n")
+                pf.flush()
+
+    if rf_like and kfold and kfold > 1 and not is_gs:
+        # RF k-fold: oob error is in-fold; the CV figure of merit is the
+        # mean-vote error on the HELD-OUT fold (reference CV semantics)
+        from ..ops.tree import predict_forest
+        for i, res in enumerate(results):
+            fold = vw_m[i] > 0
+            vote = predict_forest(res.trees, bins[fold])
+            yf, wf = y[fold], (w * vw_m[i])[fold]
+            if base.loss == "log":
+                p = np.clip(vote, 1e-9, 1 - 1e-9)
+                per = -(yf * np.log(p) + (1 - yf) * np.log(1 - p))
+            else:
+                per = (yf - vote) ** 2
+            res.valid_error = float((per * wf).sum() / max(wf.sum(), 1e-9))
+
+    feature_names = shards.schema.get("columnNames")
+
+    def save(res: ForestResult, member: int, s: DTSettings) -> None:
+        kw = dict(res.spec_kwargs)
+        if alg != Algorithm.GBT:
+            kw["algorithm"] = "RF" if alg != Algorithm.DT else "DT"
+        spec = tree_model.TreeModelSpec(
+            n_trees=len(res.trees), depth=s.depth, n_bins=n_bins,
+            column_nums=list(col_nums), feature_names=feature_names, **kw)
+        tree_model.save_model(proc.paths.model_path(member, ext), spec,
+                              res.trees)
+
+    if is_gs:
+        order = sorted(range(len(results)),
+                       key=lambda i: results[i].valid_error)
+        best = order[0]
+        log.info("grid search: best trial #%d valid error %.6f params %s",
+                 best, results[best].valid_error, trials[best])
+        save(results[best], 0, settings_list[best])
+        report = [{"trial": i, "validError": float(results[i].valid_error),
+                   "params": trials[i]} for i in order]
+        with open(os.path.join(proc.paths.tmp_dir, "grid_search.json"),
+                  "w") as f:
+            json.dump(report, f, indent=2, default=str)
+    else:
+        for i, res in enumerate(results):
+            save(res, i, settings_list[i])
+        log.info("saved %d bagged %s model(s); valid errors %s", len(results),
+                 alg.name, [round(r.valid_error, 6) for r in results])
+    fi_total = np.sum([r.feature_importance for r in results], axis=0)
+    names = feature_names or [str(cn) for cn in col_nums]
+    fi_named = sorted(((names[j], float(v)) for j, v in enumerate(fi_total)),
+                      key=lambda kv: -kv[1])
+    with open(os.path.join(proc.paths.tmp_dir, "feature_importance.json"),
+              "w") as fjson:
+        json.dump({k: v for k, v in fi_named}, fjson, indent=2)
     return 0
 
 
@@ -1317,45 +1812,40 @@ def run_tree_training(proc) -> int:
     # would make eval-time indices overflow the left_mask
     n_bins = max((by_num[cn].num_bins() + 1 for cn in col_nums if cn in by_num),
                  default=2)
-    from ..train import grid_search
-    if mc.train.gridConfigFile or grid_search.is_grid_search(
-            mc.train.params or {}):
-        from ..config.validator import ValidationError
-        raise ValidationError(
-            ["grid search (list-valued train#params / gridConfigFile) is "
-             "not supported for tree algorithms yet — train trials "
-             "individually or use the NN family"])
-    settings = settings_from_params(mc.train.params, mc.train, alg)
+    trials = proc._trials(dict(mc.train.params or {}))
+    is_gs = len(trials) > 1
+    kfold = mc.train.numKFold if mc.train.isCrossValidation else -1
+    bags = 1 if is_gs else max(1, mc.train.baggingNum)
+    multi = is_gs or bags > 1 or (kfold and kfold > 1)
+    # trials[0] == params when no grid axes; raw params may hold lists
+    settings = settings_from_params(trials[0], mc.train, alg)
     settings.resume = bool(proc.params.get("resume"))
     settings.checkpoint_dir = proc.paths.checkpoint_dir
 
     K = len(mc.dataSet.posTags) if mc.is_multi_class() else 0
+    if K > 2 and multi:
+        from ..config.validator import ValidationError
+        raise ValidationError(
+            ["grid search / bagging / k-fold are not supported with "
+             "multi-class tree training — train classes individually"])
+    if multi:
+        return _run_tree_multi(proc, shards, col_nums, cat_mask, n_bins,
+                               alg, trials, is_gs, kfold, bags)
+    streaming = proc._use_streaming(shards, shards.schema) \
+        if hasattr(proc, "_use_streaming") else False
     if K > 2:
         from ..config.model_config import MultipleClassification
         # GBT has no NATIVE multiclass mode (reference restricts NATIVE to
         # NN/RF, ``TrainModelProcessor.java:347-349``)
         if mc.train.multiClassifyMethod == MultipleClassification.ONEVSALL \
                 or alg == Algorithm.GBT:
-            if hasattr(proc, "_use_streaming") and \
-                    proc._use_streaming(shards, shards.schema):
-                log.warning("tree ONEVSALL has no streamed mode yet; "
-                            "training in-RAM")
-            if proc.params.get("resume"):
-                log.warning("tree ONEVSALL does not support -resume; "
-                            "retraining all %d class forests", K)
             return _run_tree_ova(proc, shards, col_nums, cat_mask, n_bins,
-                                 settings, alg, K)
+                                 settings, alg, K, streaming=streaming)
         settings.n_classes = K
         settings.loss = "squared"          # errors are misclassification
         if settings.impurity not in ("entropy", "gini"):
             settings.impurity = "entropy"
 
-    streaming = proc._use_streaming(shards, shards.schema) \
-        if hasattr(proc, "_use_streaming") else False
-    if settings.n_classes > 2 and streaming:
-        log.warning("multiclass NATIVE RF has no streamed mode yet; "
-                    "training in-RAM")
-        streaming = False
     ckpt_fn = _forest_checkpoint_fn(proc, settings, alg, n_bins, col_nums,
                                     shards)
 
@@ -1374,18 +1864,9 @@ def run_tree_training(proc) -> int:
         from ..parallel.mesh import device_mesh
         mesh = device_mesh(n_ensemble=1)   # trees are sequential: all devices
         if streaming:                      # on the data axis
-            from ..config import environment
-            from ..data.streaming import ShardStream, auto_window_rows
-            budget = environment.get_int("shifu.train.memoryBudgetBytes",
-                                         1 << 31)
-            data_size = mesh.shape["data"]
-            window_rows = environment.get_int("shifu.train.windowRows", 0) or \
-                auto_window_rows(2 * len(col_nums) + 8, budget,
-                                 multiple=data_size)
-            window_rows += (-window_rows) % data_size
-            stream = ShardStream(shards, ("bins", "y", "w"), window_rows)
+            stream = _tree_stream(shards, mesh)
             log.info("train %s STREAMED: %d rows, window %d rows, mesh %s",
-                     alg.name, stream.num_rows, window_rows,
+                     alg.name, stream.num_rows, stream.window_rows,
                      dict(mesh.shape))
             if alg == Algorithm.GBT:
                 res = train_gbt_streamed(stream, n_bins, cat_mask, settings,
@@ -1444,15 +1925,17 @@ def run_tree_training(proc) -> int:
     return 0
 
 
-def _forest_checkpoint_path(proc) -> str:
-    return os.path.join(proc.paths.checkpoint_dir, "forest_ckpt.npz")
+def _forest_checkpoint_path(proc, suffix: str = "") -> str:
+    return os.path.join(proc.paths.checkpoint_dir,
+                        f"forest_ckpt{suffix}.npz")
 
 
 def _forest_checkpoint_fn(proc, settings: DTSettings, alg, n_bins, col_nums,
-                          shards):
+                          shards, suffix: str = ""):
     """Mid-forest checkpoint (reference ``DTMaster.doCheckPoint`` every
     checkpointInterval iterations): partial forest + history persist; a
-    killed run resumes from the last saved tree."""
+    killed run resumes from the last saved tree.  ``suffix`` separates
+    per-class OVA checkpoints (``forest_ckpt_c{k}.npz``)."""
     def save(trees, history, init_score):
         os.makedirs(proc.paths.checkpoint_dir, exist_ok=True)
         spec = tree_model.TreeModelSpec(
@@ -1462,7 +1945,7 @@ def _forest_checkpoint_fn(proc, settings: DTSettings, alg, n_bins, col_nums,
             algorithm=alg.name, loss=settings.loss,
             learning_rate=settings.learning_rate,
             init_score=init_score if init_score is not None else 0.0)
-        path = _forest_checkpoint_path(proc)
+        path = _forest_checkpoint_path(proc, suffix)
         tmp = path + ".tmp"
         tree_model.save_model(tmp, spec, trees)
         os.replace(tmp, path)

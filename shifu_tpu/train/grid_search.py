@@ -80,6 +80,28 @@ def stackable_groups(trials: List[Dict[str, Any]]) -> List[List[int]]:
     return list(groups.values())
 
 
+# tree-trainer hypers that are traced scalars in the forest executables —
+# trials differing only in these vmap as members of ONE bagged run (an
+# extra leading axis on weights/keys/feature-subsets); everything else
+# (TreeNum/MaxDepth/Impurity/Loss/...) changes program structure
+TREE_STACKABLE_KEYS = ("LearningRate", "MinInstancesPerNode", "MinInfoGain",
+                       "Seed")
+
+
+def tree_stackable_groups(trials: List[Dict[str, Any]]) -> List[List[int]]:
+    """Group tree-trial indices whose params differ only in traced scalar
+    hypers (see :data:`TREE_STACKABLE_KEYS`) — each group trains as one
+    vmapped multi-forest run (reference queues one Guagua job per combo,
+    ``TrainModelProcessor.java:768-781``)."""
+    import json
+    groups: Dict[str, List[int]] = {}
+    for i, t in enumerate(trials):
+        key = json.dumps({k: v for k, v in sorted(t.items())
+                          if k not in TREE_STACKABLE_KEYS}, default=str)
+        groups.setdefault(key, []).append(i)
+    return list(groups.values())
+
+
 def load_grid_config(path: str) -> List[Dict[str, Any]]:
     """Explicit trial list from ``train.gridConfigFile`` — one trial per
     line, ``key:value;key:value`` (reference ``GridSearch.java:119-153``);
