@@ -45,7 +45,7 @@ _OPTIMIZERS = ("B", "Q", "R", "M", "ADAM", "SGD", "MOMENTUM", "NESTEROV",
                "RMSPROP", "ADAGRAD")
 _ACTIVATIONS = ("sigmoid", "tanh", "relu", "leakyrelu", "ptanh", "swish",
                 "linear", "log", "sin", "softmax")
-_LOSSES = ("squared", "absolute", "log")
+_LOSSES = ("squared", "absolute", "log", "hinge")
 _IMPURITIES = ("variance", "friedmanmse", "entropy", "gini")
 _SUBSETS = ("ALL", "HALF", "SQRT", "LOG2", "ONETHIRD", "TWOTHIRDS")
 _INITIALIZERS = ("xavier", "he", "lecun", "zero", "default",
@@ -89,6 +89,11 @@ TRAIN_PARAM_RULES: Dict[str, Rule] = {
                                       "bfloat16", "tensorfloat32"),
                       algs=NN_FAMILY),
     "Loss": Rule("str", allowed=_LOSSES),
+    # SVM (reference core/alg/SVMTrainer.java param keys)
+    "Kernel": Rule("str", allowed=("linear", "rbf", "radialbasisfunction",
+                                   "poly", "sigmoid"), algs=("SVM",)),
+    "Gamma": Rule("float", lo=0.0, lo_open=True, algs=("SVM",)),
+    "Const": Rule("float", lo=0.0, lo_open=True, algs=("SVM",)),
     "Seed": Rule("int"),
     "CheckpointInterval": Rule("int", lo=0),
     # tree family

@@ -85,9 +85,9 @@ class PathFinder:
     def model_path(self, index: int, alg: Optional[str] = None) -> str:
         if alg is None:
             alg = self.model_config.train.algorithm.name
-            # algorithms that train through another family share its
-            # extension (TENSORFLOW bridges to the NN path, SVM to LR)
-            alg = {"TENSORFLOW": "nn", "SVM": "lr"}.get(alg, alg)
+            # TENSORFLOW trains through the NN path and shares its
+            # extension; SVM is its own hinge-loss model (model0.svm)
+            alg = {"TENSORFLOW": "nn"}.get(alg, alg)
         return os.path.join(self.models_dir, f"model{index}.{alg.lower()}")
 
     def tmp_model_path(self, index: int, epoch: int, alg: Optional[str] = None) -> str:

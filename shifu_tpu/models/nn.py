@@ -142,6 +142,10 @@ LOSSES = {
     "absolute": lambda p, y: jnp.abs(p - y),
     "log": lambda p, y: -(y * jnp.log(jnp.clip(p, 1e-7, 1.0))
                           + (1 - y) * jnp.log(jnp.clip(1 - p, 1e-7, 1.0))),
+    # hinge on a linear head: y in {0,1} maps to targets {-1,+1}; the SVM
+    # path (reference ``core/alg/SVMTrainer.java``) is this loss on the
+    # 0-hidden-layer net
+    "hinge": lambda p, y: jnp.maximum(0.0, 1.0 - (2.0 * y - 1.0) * p),
 }
 
 

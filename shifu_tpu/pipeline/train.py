@@ -88,6 +88,38 @@ def lr_spec(input_dim: int, params: Dict[str, Any], column_nums: List[int],
         extra={"algorithm": "LR"})
 
 
+def svm_spec(input_dim: int, params: Dict[str, Any], column_nums: List[int],
+             feature_names: List[str]) -> nn_model.NNModelSpec:
+    """Linear SVM: hinge loss on a linear head (reference
+    ``core/alg/SVMTrainer.java`` Kernel/Gamma/Const params).  Only the
+    linear kernel is implemented — the reference's libsvm RBF/poly/sigmoid
+    kernels have no TPU-shaped analogue here; asking for one is a coded
+    error (an NN with hidden layers is the nonlinear option), NOT a silent
+    fallback.  ``Const`` (the C penalty) maps to L2 ``1/(2C)`` on the
+    weights — the textbook soft-margin objective scaled by C."""
+    kernel = str(params.get("Kernel", "linear")).lower()
+    if kernel != "linear":
+        from ..config.errors import ErrorCode, ShifuError
+        raise ShifuError(ErrorCode.ERROR_MODELCONFIG_NOT_VALIDATION,
+                         f"SVM Kernel={kernel!r} is not supported (linear "
+                         "only); for a nonlinear decision surface use "
+                         "algorithm NN with hidden layers")
+    c_penalty = float(params.get("Const", 1.0))
+    return nn_model.NNModelSpec(
+        input_dim=input_dim, hidden_nodes=[], activations=[],
+        output_dim=1, output_activation="linear", loss="hinge",
+        column_nums=column_nums, feature_names=feature_names,
+        extra={"algorithm": "SVM", "svm_const": c_penalty})
+
+
+def _apply_svm_objective(settings, alg: Algorithm,
+                         run_params: Dict[str, Any]) -> None:
+    """Soft-margin C -> L2 1/(2C), default C=1.0 (svm_spec docstring) —
+    the ONE place the SVM objective maps onto TrainSettings."""
+    if alg == Algorithm.SVM:
+        settings.l2 = 1.0 / (2.0 * float(run_params.get("Const", 1.0)))
+
+
 class TrainProcessor(BasicProcessor):
     step = ModelStep.TRAIN
 
@@ -205,6 +237,7 @@ class TrainProcessor(BasicProcessor):
                     spec.output_activation = "softmax"
                     spec.extra["n_classes"] = K
                 settings = settings_from_params(run_params, mc.train)
+                _apply_svm_objective(settings, alg, run_params)
                 if not is_gs:
                     # trainer-state fail-over checkpoints (grid trials are
                     # cheap; only full runs checkpoint/resume)
@@ -367,6 +400,7 @@ class TrainProcessor(BasicProcessor):
                     spec.output_activation = "softmax"
                     spec.extra["n_classes"] = n_classes
                 settings = settings_from_params(run_params, mc.train)
+                _apply_svm_objective(settings, alg, run_params)
                 if not is_gs:
                     settings.checkpoint_dir = self.paths.checkpoint_dir
                     settings.resume = bool(self.params.get("resume"))
@@ -401,7 +435,9 @@ class TrainProcessor(BasicProcessor):
     # ---------------------------------------------------- shared run setup
     def _make_spec(self, alg: Algorithm, d: int, run_params: Dict[str, Any],
                    column_nums, feature_names):
-        if alg in (Algorithm.LR, Algorithm.SVM):
+        if alg == Algorithm.SVM:
+            return svm_spec(d, run_params, column_nums, feature_names)
+        if alg == Algorithm.LR:
             return lr_spec(d, run_params, column_nums, feature_names)
         return nn_spec_from_params(d, run_params, column_nums, feature_names)
 
@@ -432,7 +468,7 @@ class TrainProcessor(BasicProcessor):
         import jax
         seed = settings.seed if settings else 0
         initializer = settings.weight_initializer if settings else "xavier"
-        ext = alg.name.lower() if alg != Algorithm.SVM else "lr"
+        ext = alg.name.lower()
         init = []
         grown = 0
         for i in range(n_members):
@@ -455,8 +491,21 @@ class TrainProcessor(BasicProcessor):
                  f" ({grown} grown via structure fit-in)" if grown else "")
         return init
 
+    @staticmethod
+    def _scoring_spec(spec):
+        """The SPEC a model file ships with: SVM trains on a linear head
+        (hinge needs raw margins) but scores through sigmoid so eval stays
+        in the documented [0, 1]*1000 range — monotone, rank metrics
+        unchanged."""
+        if (spec.extra or {}).get("algorithm") == "SVM":
+            import dataclasses
+            return dataclasses.replace(
+                spec, output_activation="sigmoid",
+                extra={**spec.extra, "margin_sigmoid": True})
+        return spec
+
     def _write_models(self, results, alg: Algorithm, is_gs: bool) -> None:
-        ext = alg.name.lower() if alg != Algorithm.SVM else "lr"
+        ext = alg.name.lower()
         os.makedirs(self.paths.models_dir, exist_ok=True)
         # clear stale models from previous runs (fewer bags / other algs) so
         # eval's glob never mixes ensembles
@@ -477,7 +526,8 @@ class TrainProcessor(BasicProcessor):
             best = flat[0]
             log.info("grid search: best trial #%d valid error %.6f params %s",
                      best[1], best[0], best[4])
-            nn_model.save_model(self.paths.model_path(0, ext), best[2], best[3])
+            nn_model.save_model(self.paths.model_path(0, ext),
+                                self._scoring_spec(best[2]), best[3])
             report = [{"trial": t[1], "validError": float(t[0]),
                        "params": {k: v for k, v in t[4].items()}} for t in flat]
             with open(os.path.join(self.paths.tmp_dir, "grid_search.json"), "w") as f:
@@ -492,6 +542,7 @@ class TrainProcessor(BasicProcessor):
                 import dataclasses
                 sp = dataclasses.replace(
                     spec, extra={**spec.extra, "class_index": i % ova_k})
-            nn_model.save_model(self.paths.model_path(i, ext), sp, p)
+            nn_model.save_model(self.paths.model_path(i, ext),
+                                self._scoring_spec(sp), p)
         log.info("saved %d model(s); valid errors %s", len(res.params),
                  np.round(res.valid_errors, 6).tolist())

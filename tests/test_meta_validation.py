@@ -49,7 +49,8 @@ def test_type_violations():
 
 def test_enum_violations():
     assert "one of" in _problems({"Propagation": "WARP"})[0]
-    assert "one of" in _problems({"Loss": "hinge"})[0]
+    assert "one of" in _problems({"Loss": "huber"})[0]
+    assert not _problems({"Loss": "hinge"})      # the SVM loss is valid
     assert "not one of" in _problems({"ActivationFunc": ["tanh", "zap"]})[0]
     assert "one of" in _problems({"Impurity": "mse"}, Algorithm.RF)[0]
 
