@@ -81,6 +81,8 @@ def main() -> None:
     # same computation — float64 NumPy minibatch backprop on the bench
     # shapes — on this rig.  bench.py divides its TPU rows/s by this.
     out.update(measure_cpu_backprop())
+    out.update(measure_cpu_tree_trainer())
+    out.update(measure_cpu_scalar_scorer())
     print(json.dumps(out, indent=1))
 
 
@@ -117,6 +119,113 @@ def measure_cpu_backprop(n_features: int = 256, hidden=(512, 256),
     dt = time.time() - t0
     return {"cpu_backprop_rows_per_sec": round(steps * batch / dt, 1),
             "cpu_backprop_shapes": f"{n_features}->{hidden}->1 b{batch} f64"}
+
+
+def measure_cpu_tree_trainer(n_rows: int = 1 << 15, n_features: int = 64,
+                             n_bins: int = 64, depth: int = 6,
+                             trees: int = 3) -> dict:
+    """Single-worker reference-style GBT trainer throughput.
+
+    The reference's DTWorker accumulates per-(node, feature, bin) stats
+    with a scalar hot loop (``DTWorker.java:763-884``) and DTMaster scans
+    splits per level (``DTMaster.java:274-533``); in this JVM-less image
+    the same per-level histogram+split computation runs as float64 NumPy
+    (scatter-add via np.add.at per feature — the same memory-bound access
+    pattern, vectorized where Java would loop, i.e. generous to the
+    reference).  Measured at the bench feature/bin/depth shapes; bench.py
+    divides its device rows*trees/s by this x the north-star worker count.
+    """
+    rng = np.random.default_rng(0)
+    bins = rng.integers(0, n_bins, size=(n_rows, n_features)).astype(np.int32)
+    y = (rng.random(n_rows) < 0.3).astype(np.float64)
+    f = np.zeros(n_rows)
+    lr = 0.1
+
+    def train_one_tree():
+        grad = y - 1.0 / (1.0 + np.exp(-f))
+        stats = np.stack([np.ones(n_rows), grad, grad * grad], axis=1)
+        node_idx = np.zeros(n_rows, np.int64)
+        feat = {}
+        thr = {}
+        leaf = np.zeros(2 ** (depth + 1) - 1)
+        for level in range(depth):
+            n_nodes = 1 << level
+            hist = np.zeros((n_nodes, n_features, n_bins, 3))
+            for c in range(n_features):          # DTWorker per-feature loop
+                np.add.at(hist[:, c], (node_idx, bins[:, c]), stats)
+            # DTMaster variance split scan per (node, feature)
+            w = hist[..., 0]
+            wy = hist[..., 1]
+            cw = np.cumsum(w, axis=-1)
+            cwy = np.cumsum(wy, axis=-1)
+            tw, twy = cw[..., -1:], cwy[..., -1:]
+            score = (cwy ** 2 / np.maximum(cw, 1e-12)
+                     + (twy - cwy) ** 2 / np.maximum(tw - cw, 1e-12))
+            score[..., -1] = -np.inf
+            k = score.reshape(n_nodes, -1).argmax(axis=1)
+            base = n_nodes - 1
+            for node in range(n_nodes):
+                feat[base + node] = k[node] // n_bins
+                thr[base + node] = k[node] % n_bins
+            nf = np.array([feat[base + v] for v in range(n_nodes)])
+            nt = np.array([thr[base + v] for v in range(n_nodes)])
+            row_bin = bins[np.arange(n_rows), nf[node_idx]]
+            node_idx = 2 * node_idx + (row_bin > nt[node_idx])
+        # leaves at the bottom level
+        n_nodes = 1 << depth
+        sw = np.zeros(n_nodes)
+        swy = np.zeros(n_nodes)
+        np.add.at(sw, node_idx, stats[:, 0])
+        np.add.at(swy, node_idx, stats[:, 1])
+        leaf_vals = swy / np.maximum(sw, 1e-12)
+        return f + lr * leaf_vals[node_idx], leaf
+
+    train_one_tree()                               # warm caches
+    t0 = time.time()
+    for _ in range(trees):
+        f, _ = train_one_tree()
+    dt = time.time() - t0
+    return {"cpu_tree_rows_trees_per_sec": round(trees * n_rows / dt, 1),
+            "cpu_tree_shapes": (f"{n_rows}x{n_features} b{n_bins} "
+                                f"d{depth} f64 np.add.at")}
+
+
+def measure_cpu_scalar_scorer(n_rows: int = 2000, n_features: int = 256,
+                              hidden=(512, 256), n_models: int = 5) -> dict:
+    """Reference-style eval throughput: ``core/Scorer.java:163-200`` scores
+    ONE normalized row at a time across the bagged models; the confusion
+    sweep then sorts on the host (``ConfusionMatrix.java:62``).  Stand-in:
+    per-row float64 NumPy forwards (vectorized matvecs where Encog loops —
+    generous) + a host argsort sweep, single thread."""
+    rng = np.random.default_rng(0)
+    dims = [n_features, *hidden, 1]
+    models = []
+    for _ in range(n_models):
+        models.append(([rng.normal(size=(a, b)) / np.sqrt(a)
+                        for a, b in zip(dims[:-1], dims[1:])],
+                       [np.zeros(b) for b in dims[1:]]))
+    x = rng.normal(size=(n_rows, n_features))
+    y = (rng.random(n_rows) < 0.3).astype(np.float64)
+
+    def score_row(row):
+        s = 0.0
+        for ws, bs in models:
+            h = row
+            for w, b in zip(ws[:-1], bs[:-1]):
+                h = np.maximum(h @ w + b, 0.0)
+            s += 1.0 / (1.0 + np.exp(-(h @ ws[-1] + bs[-1])[0]))
+        return s / n_models
+
+    score_row(x[0])                                # warm caches
+    t0 = time.time()
+    scores = np.fromiter((score_row(x[i]) for i in range(n_rows)),
+                         np.float64, count=n_rows)
+    order = np.argsort(-scores, kind="stable")
+    np.cumsum(y[order])
+    dt = time.time() - t0
+    return {"cpu_scalar_score_rows_per_sec": round(n_rows / dt, 1),
+            "cpu_scalar_score_shapes":
+                f"{n_features}->{hidden}->1 x{n_models} models f64 per-row"}
 
 
 if __name__ == "__main__":
