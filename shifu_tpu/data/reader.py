@@ -21,27 +21,95 @@ import numpy as np
 import pandas as pd
 
 
-_REMOTE_SCHEMES = ("hdfs://", "s3://", "s3a://", "s3n://", "gs://",
-                   "viewfs://")
+# Hadoop-cluster filesystems stay gated (no libhdfs in this runtime);
+# object stores and any other fsspec scheme stream directly
+_GATED_SCHEMES = ("hdfs://", "viewfs://", "arrow_hdfs://")
+
+
+def _is_remote(path: str) -> bool:
+    return "://" in path and not path.startswith("file://")
+
+
+def _fsspec_paths(path: str):
+    """(fs, expanded paths) for a remote URL — the ONE place that gates
+    Hadoop schemes and converts fsspec's failures into coded errors."""
+    from ..config.errors import ErrorCode, ShifuError
+    for scheme in _GATED_SCHEMES:
+        if path.startswith(scheme):
+            raise ShifuError(
+                ErrorCode.ERROR_REMOTE_SOURCE,
+                f"{path!r}: no {scheme[:-3]} client in this runtime — "
+                "stage the files locally (hdfs dfs -get) or serve them "
+                "from object storage (gs://, s3://)")
+    import fsspec
+    try:
+        fs, _, paths = fsspec.get_fs_token_paths(path)
+    except ImportError as e:                   # backend package missing
+        raise ShifuError(
+            ErrorCode.ERROR_REMOTE_SOURCE,
+            f"{path!r}: the fsspec backend for this scheme is not "
+            f"installed ({e}) — stage the files locally (gsutil -m cp -r "
+            "/ aws s3 sync) and set the path to the local copy") from e
+    except ValueError as e:                    # unknown protocol / bad URL
+        raise ShifuError(
+            ErrorCode.ERROR_REMOTE_SOURCE,
+            f"{path!r}: {e} — use a known scheme (gs://, s3://, file://) "
+            "or stage the files locally") from e
+    return fs, paths
+
+
+def _resolve_remote(data_path: str) -> List[str]:
+    """Expand a remote (fsspec) path / directory / glob into full URLs.
+
+    The reference's ``RawSourceData.SourceType`` HDFS duality
+    (``fs/ShifuFileUtils.java``) becomes fsspec here: ``gs://`` / ``s3://``
+    (object storage — where the 1TB-scenario data actually lives) and
+    ``memory://`` (tests) stream straight into the columnar reader;
+    pandas/pyarrow consume the URLs natively.  Hadoop filesystems remain a
+    coded error — no libhdfs client in this runtime.
+    """
+    from ..config.errors import ErrorCode, ShifuError
+    fs, paths = _fsspec_paths(data_path)
+    proto = fs.protocol if isinstance(fs.protocol, str) else fs.protocol[0]
+
+    def url(p: str) -> str:
+        if "://" in p:
+            return p
+        if proto == "memory":                  # ls yields "/bucket/file"
+            return f"memory://{p.lstrip('/')}"
+        return f"{proto}://{p}"                # s3/gs ls yields bucket/key
+
+    out: List[str] = []
+    for p in paths:
+        if fs.isdir(p):
+            # ONE detail listing per directory: a per-entry isfile() would
+            # cost an object-store round-trip per part file
+            entries = fs.ls(p, detail=True)
+            out.extend(
+                url(e["name"]) for e in sorted(entries,
+                                               key=lambda e: e["name"])
+                if e.get("type") == "file"
+                and not os.path.basename(e["name"]).startswith((".", "_")))
+        elif fs.isfile(p):
+            out.append(url(p))
+    if not out:
+        raise ShifuError(ErrorCode.ERROR_INPUT_NOT_FOUND, data_path)
+    return out
 
 
 def resolve_data_files(data_path: str) -> List[str]:
     """Expand a file / directory / glob into an ordered list of data files.
 
     Skips hidden files (``.pig_header``, ``_SUCCESS``), like the reference's
-    part-file scanners.  Remote schemes (the reference's HDFS/S3 source
-    types) are recognized and rejected with instructions — this runtime has
-    no cluster filesystem client; stage the data locally (gsutil/aws-cli/
-    distcp) and point dataPath at the local copy.
+    part-file scanners.  Remote fsspec schemes (``gs://``, ``s3://``,
+    ``memory://``, ...) resolve through :func:`_resolve_remote`; Hadoop
+    filesystems are a coded error (stage locally or use object storage).
     """
     from ..config.errors import ErrorCode, ShifuError
-    for scheme in _REMOTE_SCHEMES:
-        if data_path.startswith(scheme):
-            raise ShifuError(
-                ErrorCode.ERROR_REMOTE_SOURCE,
-                f"{data_path!r}: no {scheme[:-3]} client in this runtime — "
-                "stage the files locally (gsutil -m cp -r / aws s3 sync / "
-                "hdfs dfs -get) and set dataPath to the local copy")
+    if _is_remote(data_path):
+        return _resolve_remote(data_path)
+    if data_path.startswith("file://"):
+        data_path = data_path[len("file://"):]
     if os.path.isdir(data_path):
         files = [f for f in sorted(
             os.path.join(data_path, f) for f in os.listdir(data_path)
@@ -60,17 +128,21 @@ def resolve_data_files(data_path: str) -> List[str]:
     return files
 
 
+def _path_exists(path: str) -> bool:
+    if _is_remote(path):
+        fs, paths = _fsspec_paths(path)
+        return bool(paths) and fs.exists(paths[0])
+    if path.startswith("file://"):
+        path = path[len("file://"):]
+    return os.path.isfile(path)
+
+
 def read_header(header_path: Optional[str], header_delimiter: str,
                 data_files: Optional[Sequence[str]] = None,
                 data_delimiter: str = "|") -> List[str]:
     """Read column names from a header file, or fall back to the first data
     line (named or synthesized), reference ``InitModelProcessor`` behavior."""
-    if header_path and "://" in header_path:
-        from ..config.errors import ErrorCode, ShifuError
-        raise ShifuError(ErrorCode.ERROR_REMOTE_SOURCE,
-                         f"headerPath {header_path!r} — stage it locally "
-                         "alongside the data")
-    if header_path and os.path.isfile(header_path):
+    if header_path and _path_exists(header_path):
         with _open_text(header_path) as f:
             line = f.readline().rstrip("\r\n")
         return [c.strip() for c in line.split(header_delimiter)]
@@ -92,6 +164,13 @@ def read_header(header_path: Optional[str], header_delimiter: str,
 
 
 def _open_text(path: str):
+    if _is_remote(path):
+        import fsspec
+        _fsspec_paths(path)            # gate + coded errors first
+        return fsspec.open(path, "rt", compression="infer",
+                           encoding="utf-8", errors="replace").open()
+    if path.startswith("file://"):
+        path = path[len("file://"):]
     if path.endswith(".gz"):
         return io.TextIOWrapper(gzip.open(path, "rb"), encoding="utf-8", errors="replace")
     return open(path, encoding="utf-8", errors="replace")
@@ -166,9 +245,8 @@ class DataSource:
         ``GuaguaParquetMapReduceClient`` role): record batches stream
         straight out of the column chunks; values render to the pipeline's
         string plane with nulls as '' (the missing marker)."""
-        import pyarrow.parquet as pq
         for path in self.files:
-            pf = pq.ParquetFile(path)
+            pf = _open_parquet(path)
             for batch in pf.iter_batches(batch_size=chunk_rows,
                                          columns=list(self.header)):
                 # cast to string IN ARROW: int64 renders '1' regardless of
@@ -255,6 +333,15 @@ def _is_parquet(path: str) -> bool:
     return path.endswith((".parquet", ".pq"))
 
 
-def _parquet_schema_names(path: str) -> List[str]:
+def _open_parquet(path: str):
+    """A ParquetFile over local or fsspec-remote storage."""
     import pyarrow.parquet as pq
-    return list(pq.ParquetFile(path).schema_arrow.names)
+    if _is_remote(path):
+        import fsspec
+        _fsspec_paths(path)            # gate + coded errors first
+        return pq.ParquetFile(fsspec.open(path, "rb").open())
+    return pq.ParquetFile(path)
+
+
+def _parquet_schema_names(path: str) -> List[str]:
+    return list(_open_parquet(path).schema_arrow.names)

@@ -1,0 +1,119 @@
+"""Remote-source data plane (VERDICT r3 item 3): fsspec-backed reads for
+object-storage schemes — the reference's ``RawSourceData.SourceType`` HDFS
+duality (``fs/ShifuFileUtils.java``) becomes gs://s3://memory:// streaming;
+only Hadoop filesystems remain a coded error."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def _write_memory_dataset(n=2500, seed=7):
+    import fsspec
+    fs = fsspec.filesystem("memory")
+    rng = np.random.default_rng(seed)
+    amount = rng.lognormal(3.0, 1.2, n)
+    velocity = rng.poisson(3, n).astype(float)
+    country = rng.choice(["US", "GB", "BR"], n, p=[.6, .2, .2])
+    logit = 0.8 * np.log1p(amount) + 0.35 * velocity + \
+        (country == "BR") * 1.2 - 4.0
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(int)
+    tag = np.where(y == 1, "bad", "good")
+    rows = ["txn_id|amount|velocity|country|tag"]
+    for i in range(n):
+        rows.append(f"t{i}|{amount[i]:.4f}|{velocity[i]:.0f}|"
+                    f"{country[i]}|{tag[i]}")
+    half = len(rows) // 2
+    with fs.open("/fraud/part-000.csv", "w") as f:
+        f.write("\n".join(rows[:half]) + "\n")
+    with fs.open("/fraud/part-001.csv", "w") as f:
+        f.write(rows[0] + "\n" + "\n".join(rows[half:]) + "\n")
+    with fs.open("/fraud/_SUCCESS", "w") as f:
+        f.write("")
+    return "memory://fraud"
+
+
+def test_resolve_remote_dir_lists_parts_skips_markers():
+    from shifu_tpu.data.reader import resolve_data_files
+
+    path = _write_memory_dataset()
+    files = resolve_data_files(path)
+    assert [os.path.basename(f) for f in files] == ["part-000.csv",
+                                                    "part-001.csv"]
+    assert all(f.startswith("memory://") for f in files)
+
+
+def test_hdfs_still_coded_error():
+    from shifu_tpu.config.errors import ShifuError
+    from shifu_tpu.data.reader import resolve_data_files
+
+    with pytest.raises(ShifuError, match="hdfs"):
+        resolve_data_files("hdfs://nn:8020/data/part-*")
+
+
+def test_unknown_scheme_coded_error():
+    """Typo'd/unknown schemes must stay a coded ShifuError, not a raw
+    fsspec ValueError (round-4 review finding)."""
+    from shifu_tpu.config.errors import ShifuError
+    from shifu_tpu.data.reader import resolve_data_files
+
+    with pytest.raises(ShifuError, match="known scheme"):
+        resolve_data_files("s3n://bucket/part-*")
+
+
+def test_file_scheme_header_resolves(tmp_path):
+    from shifu_tpu.data.reader import read_header
+
+    hp = tmp_path / "header"
+    hp.write_text("a|b|c\n")
+    assert read_header(f"file://{hp}", "|") == ["a", "b", "c"]
+
+
+def test_datasource_streams_remote_chunks():
+    from shifu_tpu.data.reader import DataSource
+
+    path = _write_memory_dataset()
+    src = DataSource(path, "|")
+    assert src.header[:2] == ["txn_id", "amount"]
+    total = sum(len(c) for c in src.iter_chunks(chunk_rows=512))
+    assert total == 2500
+
+
+def test_full_pipeline_over_memory_source(tmp_path):
+    """init -> stats -> norm -> train -> eval with dataPath in object
+    storage (memory://): the whole pipeline streams remotely, no staging."""
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.pipeline.create import InitProcessor, create_new_model
+    from shifu_tpu.pipeline.evaluate import EvalProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+
+    path = _write_memory_dataset()
+    meta = tmp_path / "meta.names"
+    meta.write_text("txn_id\n")
+    mdir = create_new_model("remotetest", base_dir=str(tmp_path))
+    mcp = os.path.join(mdir, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.dataSet.dataPath = path
+    mc.dataSet.dataDelimiter = "|"
+    mc.dataSet.targetColumnName = "tag"
+    mc.dataSet.posTags = ["bad"]
+    mc.dataSet.negTags = ["good"]
+    mc.dataSet.metaColumnNameFile = str(meta)
+    mc.train.baggingNum = 1
+    mc.train.numTrainEpochs = 60
+    mc.evals[0].dataSet.dataPath = path
+    mc.evals[0].dataSet.dataDelimiter = "|"
+    mc.save(mcp)
+    assert InitProcessor(mdir).run() == 0
+    assert StatsProcessor(mdir, params={}).run() == 0
+    assert NormalizeProcessor(mdir, params={}).run() == 0
+    assert TrainProcessor(mdir, params={}).run() == 0
+    assert EvalProcessor(mdir, params={"run_eval": "Eval1"}).run() == 0
+    import json
+    perf = json.load(open(os.path.join(mdir, "evals", "Eval1",
+                                       "EvalPerformance.json")))
+    # plumbing test: the signal in this 3-feature synthetic caps AUC ~0.78
+    assert perf["areaUnderRoc"] > 0.7
