@@ -75,8 +75,24 @@ def _stack(trees: List[Any]):
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
 
 
+def _gather_np(a) -> np.ndarray:
+    """Host copy of a (possibly multi-host) array.  Under multiple
+    controllers ``np.asarray`` can only read fully-addressable arrays;
+    ``process_allgather`` assembles the global value over the DCN (the
+    reference's master-side model collect, ``NNMaster.java:240-286``)."""
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(a, tiled=True))
+    return np.asarray(a)
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(_gather_np, tree)
+
+
 def _unstack(tree, n: int) -> List[Any]:
-    host = jax.tree_util.tree_map(np.asarray, tree)
+    host = _to_host(tree)
     return [jax.tree_util.tree_map(lambda a: a[i], host) for i in range(n)]
 
 
@@ -218,12 +234,13 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
             stacked, opt_state, xb, yb, tw, rngs, hd, lr_scale)
 
     @jax.jit
-    def eval_errors(stacked, tw, vw):
+    def eval_errors(stacked, tw, vw, xe, ys):
+        # data arrays enter as ARGUMENTS: closing over a multi-host-sharded
+        # array is an error under multiple controllers
         def one(params, mw, ym):
-            pred = nn_model.forward(params, spec, xd)
+            pred = nn_model.forward(params, spec, xe)
             per_row = nn_model.per_row_loss(pred, ym[:, None], spec)
             return (per_row * mw).sum() / jnp.maximum(mw.sum(), 1e-9)
-        ys = yd if ymd is None else ymd
         ev = jax.vmap(one, in_axes=(0, 0, y_axis))
         return ev(stacked, tw, ys), ev(stacked, vw, ys)
 
@@ -231,15 +248,16 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
     if bs:
         bs = max(bs - bs % data_size, data_size)
         # pad rows to a batch multiple so the tail is never dropped;
-        # padded rows carry zero weight
+        # padded rows carry zero weight (_gather_np: a plain np.asarray
+        # cannot read cross-host-sharded arrays under multiple controllers)
         if ymd is None:
             x, y, train_w, valid_w = _pad_all(
-                np.asarray(xd), np.asarray(yd), np.asarray(twd),
-                np.asarray(vwd), bs)
+                _gather_np(xd), _gather_np(yd), _gather_np(twd),
+                _gather_np(vwd), bs)
         else:
             x, y, train_w, valid_w, y_members = _pad_all(
-                np.asarray(xd), np.asarray(yd), np.asarray(twd),
-                np.asarray(vwd), bs, np.asarray(ymd))
+                _gather_np(xd), _gather_np(yd), _gather_np(twd),
+                _gather_np(vwd), bs, _gather_np(ymd))
             ymd = jax.device_put(y_members,
                                  NamedSharding(mesh, P("ensemble", "data")))
         xd = jax.device_put(x, NamedSharding(mesh, P("data", None)))
@@ -276,19 +294,20 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
     # compiles into the SPMD program); an EAGER lax.slice on sharded inputs
     # does ad-hoc device-to-device copies the XLA:CPU runtime has been seen
     # to SIGABRT on
-    def step_batch(stacked, opt_state, start, rngs, lr_scale, blen: int):
-        xb = jax.lax.dynamic_slice_in_dim(xd, start, blen, axis=0)
-        yb = jax.lax.dynamic_slice_in_dim(yd, start, blen, axis=0) \
+    def step_batch(stacked, opt_state, start, rngs, lr_scale, blen: int,
+                   xe, ye, twe):
+        xb = jax.lax.dynamic_slice_in_dim(xe, start, blen, axis=0)
+        yb = jax.lax.dynamic_slice_in_dim(ye, start, blen, axis=0) \
             if ymd is None else \
-            jax.lax.dynamic_slice_in_dim(ymd, start, blen, axis=1)
-        twb = jax.lax.dynamic_slice_in_dim(twd, start, blen, axis=1)
+            jax.lax.dynamic_slice_in_dim(ye, start, blen, axis=1)
+        twb = jax.lax.dynamic_slice_in_dim(twe, start, blen, axis=1)
         return jax.vmap(member_update,
                         in_axes=(0, 0, None, y_axis, 0, 0, 0, None))(
             stacked, opt_state, xb, yb, twb, rngs, hd, lr_scale)
 
     @partial(jax.jit, static_argnames=("blen", "n_b"))
-    def epoch_steps(stacked, opt_state, rngs, lr_scale, blen: int,
-                    n_b: int):
+    def epoch_steps(stacked, opt_state, rngs, lr_scale, xe, ye, twe,
+                    blen: int, n_b: int):
         """A whole epoch's minibatch sweep as ONE executable (lax.scan over
         batches) — the per-batch dispatch loop costs one program execution
         per batch, which dominates wall-clock on a remote-device link."""
@@ -297,7 +316,7 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
             rngs_b = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
                 rngs, bi) if dropout > 0 else rngs
             st, os_, _ = step_batch(st, os_, bi * blen, rngs_b, lr_scale,
-                                    blen)
+                                    blen, xe, ye, twe)
             return (st, os_), None
         (st, os_), _ = jax.lax.scan(body, (stacked, opt_state),
                                     jnp.arange(n_b, dtype=jnp.int32))
@@ -308,20 +327,22 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
         rngs = jax.random.split(sub, bags)
         if bs and bs < n_padded:
             stacked, opt_state = epoch_steps(
-                stacked, opt_state, rngs, lr_scale, bs,
+                stacked, opt_state, rngs, lr_scale, xd,
+                yd if ymd is None else ymd, twd, bs,
                 (n_padded - bs) // bs + 1)
         else:
             stacked, opt_state, _ = step(stacked, opt_state, xd,
                                          yd if ymd is None else ymd, twd,
                                          rngs, lr_scale)
-        tr, va = eval_errors(stacked, twd, vwd)
-        tr, va = np.asarray(jnp.stack([tr, va]))       # one fetch
+        tr, va = eval_errors(stacked, twd, vwd, xd,
+                             yd if ymd is None else ymd)
+        tr, va = _gather_np(jnp.stack([tr, va]))       # one fetch
         history.append((float(tr.mean()), float(va.mean())))
         epochs_run = epoch + 1
 
         improved = np.flatnonzero(va < best_valid)
         if improved.size:
-            host = jax.tree_util.tree_map(np.asarray, stacked)
+            host = _to_host(stacked)
             for i in improved:
                 best_valid[i], best_train[i] = va[i], tr[i]
                 best_params[i] = jax.tree_util.tree_map(lambda a: a[i].copy(), host)
@@ -334,8 +355,7 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
                 (epoch + 1) % settings.checkpoint_every == 0:
             from . import checkpoint as ckpt
             ckpt.save_state(settings.checkpoint_dir, epoch + 1,
-                            (jax.tree_util.tree_map(np.asarray, stacked),
-                             jax.tree_util.tree_map(np.asarray, opt_state),
+                            (_to_host(stacked), _to_host(opt_state),
                              np.asarray(key)))
         if settings.learning_decay > 0:
             lr_scale *= (1.0 - settings.learning_decay)
@@ -348,7 +368,7 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
                          settings.early_stop_window)
                 break
 
-    final = jax.tree_util.tree_map(np.asarray, stacked)
+    final = _to_host(stacked)
     for i in range(bags):
         if best_params[i] is None:
             best_params[i] = jax.tree_util.tree_map(lambda a: a[i], final)
@@ -626,8 +646,7 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
                 (epoch + 1) % settings.checkpoint_every == 0:
             from . import checkpoint as ckpt
             ckpt.save_state(settings.checkpoint_dir, epoch + 1,
-                            (jax.tree_util.tree_map(np.asarray, stacked),
-                             jax.tree_util.tree_map(np.asarray, opt_state),
+                            (_to_host(stacked), _to_host(opt_state),
                              np.asarray(key)))
         if settings.learning_decay > 0:
             lr_scale *= (1.0 - settings.learning_decay)
@@ -643,7 +662,7 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
         stats_acc = eval_window(stacked, stats_acc, xb, yb, tw, vw)
     bookkeep(epochs_run - 1, np.asarray(stats_acc), stacked)
 
-    final = jax.tree_util.tree_map(np.asarray, stacked)
+    final = _to_host(stacked)
     for i in range(bags):
         if best_params[i] is None:
             best_params[i] = jax.tree_util.tree_map(lambda a: a[i], final)

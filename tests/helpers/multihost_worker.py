@@ -61,4 +61,45 @@ assert mesh2.shape == {"ensemble": nproc, "data": 4}
 row = [d.process_index for d in mesh2.devices[pid]]
 assert row == [pid] * 4, row                     # one host per member row
 
+# ---- a REAL trainer across the process boundary (VERDICT r3 item 7):
+# each host feeds its own row block; the gradient psum crosses the DCN
+# every step; both controllers must converge to the SAME weights.
+from shifu_tpu.models.nn import NNModelSpec  # noqa: E402
+from shifu_tpu.train.nn_trainer import (TrainSettings,  # noqa: E402
+                                        train_ensemble)
+
+N, D = 256, 8
+rng = np.random.default_rng(0)                  # same draw on both hosts
+x_all = rng.normal(size=(N, D)).astype(np.float32)
+wvec = rng.normal(size=D).astype(np.float32) / np.sqrt(D)
+y_all = (1 / (1 + np.exp(-(x_all @ wvec) * 3))
+         > rng.random(N)).astype(np.float32)
+half = N // nproc
+x_global = shard_rows_from_local(mesh, x_all[pid * half:(pid + 1) * half])
+assert x_global.shape == (N, D)
+tw = np.full((1, N), 0.8, np.float32)
+vw = np.full((1, N), 0.2, np.float32)
+res = train_ensemble(x_global, y_all, tw, vw,
+                     NNModelSpec(input_dim=D, hidden_nodes=[8],
+                                 activations=["tanh"], loss="log"),
+                     TrainSettings(optimizer="ADAM", learning_rate=0.05,
+                                   epochs=12),
+                     mesh=mesh)
+assert res.history[-1][0] < res.history[0][0], res.history
+checksum = float(sum(np.abs(layer[k]).sum()
+                     for layer in res.params[0] for k in ("w", "b")))
+print(f"proc {pid}: MULTIHOST-TRAIN weights={checksum:.8f} "
+      f"err={res.train_errors[0]:.6f}", flush=True)
+
+# minibatch path too: its re-pad block must gather (not np.asarray) the
+# cross-host-sharded arrays
+res_mb = train_ensemble(x_global, y_all, tw, vw,
+                        NNModelSpec(input_dim=D, hidden_nodes=[8],
+                                    activations=["tanh"], loss="log"),
+                        TrainSettings(optimizer="ADAM", learning_rate=0.05,
+                                      epochs=3, batch_size=64),
+                        mesh=mesh)
+assert np.isfinite(res_mb.train_errors[0])
+print(f"proc {pid}: MULTIHOST-MINIBATCH ok", flush=True)
+
 print(f"proc {pid}: MULTIHOST-OK total={total}", flush=True)

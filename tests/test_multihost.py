@@ -42,3 +42,9 @@ def test_two_process_mesh_and_global_reduction():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
         assert "MULTIHOST-OK" in out
+    # the trainer ran across the process boundary and both controllers
+    # converged to the SAME weights (the psum crossed the DCN every step)
+    import re
+    sums = [re.search(r"MULTIHOST-TRAIN weights=([0-9.]+)", out).group(1)
+            for out in outs]
+    assert sums[0] == sums[1], sums
