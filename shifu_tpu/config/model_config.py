@@ -194,6 +194,7 @@ class ModelVarSelectConf:
     filterNum: int = 200
     filterOutRatio: Optional[float] = None
     filterBy: FilterBy = FilterBy.KS
+    postCorrelationMetric: Optional[str] = None   # IV | KS | SE (ref enum)
     autoFilterEnable: bool = False
     missingRateThreshold: float = 0.98
     correlationThreshold: float = 1.0

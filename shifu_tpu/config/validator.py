@@ -39,6 +39,18 @@ class ValidationError(ShifuError, ValueError):
                          "\n  - " + "\n  - ".join(problems))
 
 
+def _check_data_path(path: str, model_set_dir: str, what: str,
+                     problems: List[str]) -> None:
+    """Local data-path existence, resolved the way the reader does (glob
+    patterns included); remote schemes are checked at read time."""
+    if "://" in path:
+        return
+    p = path if os.path.isabs(path) else os.path.join(model_set_dir, path)
+    import glob as _glob
+    if not (os.path.exists(p) or _glob.glob(p)):
+        problems.append(f"{what} does not exist: {path}")
+
+
 def _check_name_file(path: str, model_set_dir: str, what: str,
                      problems: List[str]) -> None:
     """Reference ``ModelInspector.checkFile`` via ``checkVarSelect``: a
@@ -102,16 +114,18 @@ def probe(mc: ModelConfig, step: ModelStep, model_set_dir: str = ".") -> None:
         ds = mc.dataSet
         if not ds.dataPath:
             problems.append("dataSet.dataPath must be set")
-        elif step == ModelStep.INIT and "://" not in ds.dataPath:
-            # reference checkRawData → checkFile (:359-372, :939);
-            # dataPath may be a glob ('data/part-*') — resolve it the way
-            # the reader does rather than os.path.exists
-            p = ds.dataPath if os.path.isabs(ds.dataPath) \
-                else os.path.join(model_set_dir, ds.dataPath)
-            import glob as _glob
-            if not (os.path.exists(p) or _glob.glob(p)):
+        elif step == ModelStep.INIT:
+            # reference checkRawData → checkFile (:359-372, :939)
+            _check_data_path(ds.dataPath, model_set_dir,
+                             "dataSet.dataPath", problems)
+        if step == ModelStep.INIT and ds.headerPath and \
+                "://" not in ds.headerPath:
+            # reference checkRawData also probes the header file (:366-369)
+            hp = ds.headerPath if os.path.isabs(ds.headerPath) \
+                else os.path.join(model_set_dir, ds.headerPath)
+            if not os.path.isfile(hp):
                 problems.append(
-                    f"dataSet.dataPath does not exist: {ds.dataPath}")
+                    f"dataSet.headerPath does not exist: {ds.headerPath}")
         if not ds.targetColumnName:
             problems.append("dataSet.targetColumnName must be set")
         if not ds.posTags and not ds.negTags:
@@ -122,6 +136,13 @@ def probe(mc: ModelConfig, step: ModelStep, model_set_dir: str = ".") -> None:
         _check_column_conf(mc, model_set_dir, problems)
 
     if step == ModelStep.STATS:
+        # reference probe() at STATS verifies the configured column-name
+        # files exist (:121-131) before checkStatsConf
+        _check_name_file(mc.dataSet.metaColumnNameFile, model_set_dir,
+                         "dataSet.metaColumnNameFile", problems)
+        _check_name_file(mc.dataSet.categoricalColumnNameFile,
+                         model_set_dir,
+                         "dataSet.categoricalColumnNameFile", problems)
         # reference checkStatsConf (:263-305)
         from .model_config import BinningAlgorithm, BinningMethod
         st = mc.stats
@@ -148,17 +169,49 @@ def probe(mc: ModelConfig, step: ModelStep, model_set_dir: str = ".") -> None:
                              "varSelect.forceRemoveColumnNameFile", problems)
             _check_name_file(vs.forceSelectColumnNameFile, model_set_dir,
                              "varSelect.forceSelectColumnNameFile", problems)
+        # reference checkVarSelect :335-343: postCorrelationMetric SE only
+        # composes with filterBy SE (the SE stats exist only then); the
+        # value itself is an enum (reference PostCorrelationMetric)
+        pcm = (vs.postCorrelationMetric or "").upper()
+        if pcm and pcm not in ("IV", "KS", "SE"):
+            problems.append("varSelect.postCorrelationMetric must be one "
+                            f"of IV/KS/SE, got {vs.postCorrelationMetric!r}")
+        if pcm == "SE" and vs.filterBy.name != "SE":
+            problems.append("varSelect.filterBy and "
+                            "varSelect.postCorrelationMetric must both be "
+                            "SE (reference ModelInspector.checkVarSelect)")
 
     if step == ModelStep.TRAIN:
         # cross-field rules the per-key schema can't express (NN shape
         # consistency lives in meta.validate_train_params, per trial;
         # reference checkTrainSetting :451-560)
+        from .model_config import (Algorithm, MultipleClassification)
         tr = mc.train
         if tr.isCrossValidation and tr.numKFold < 2:
             problems.append("train.numKFold must be >= 2 when isCrossValidation")
         if tr.numKFold is not None and tr.numKFold > 20:
             # reference checkTrainSetting: k-fold capped at 20
             problems.append("train.numKFold must be <= 20")
+        multiclass = mc.is_multi_class() and len(mc.dataSet.posTags) > 2
+        ova_algs = (Algorithm.NN, Algorithm.RF, Algorithm.GBT, Algorithm.DT)
+        if multiclass and \
+                tr.multiClassifyMethod == MultipleClassification.ONEVSALL \
+                and tr.algorithm not in ova_algs:
+            # reference checkTrainSetting :513-520
+            problems.append("'one vs all' multi-class works with "
+                            "RF/GBT/DT/NN only")
+        if multiclass and \
+                tr.multiClassifyMethod == MultipleClassification.NATIVE \
+                and tr.algorithm == Algorithm.RF:
+            # reference checkTrainSetting :522-534
+            imp = str((tr.params or {}).get("Impurity", "entropy")).lower()
+            if imp not in ("entropy", "gini"):
+                problems.append("Impurity must be entropy/gini for NATIVE "
+                                "multi-class RF")
+        if str((tr.params or {}).get("Loss", "")).lower() == "hinge" and \
+                tr.algorithm != Algorithm.SVM:
+            problems.append("Loss 'hinge' is the SVM objective — use "
+                            "algorithm SVM (or log/squared/absolute)")
         # baggingNum / rates / epochs / convergenceThreshold ranges live in
         # the meta schema (meta.py CONFIG_FIELD_RULES), checked above
 
@@ -170,6 +223,17 @@ def probe(mc: ModelConfig, step: ModelStep, model_set_dir: str = ".") -> None:
                 problems.append("eval set without a name")
             if not e.dataSet.dataPath:
                 problems.append(f"eval {e.name}: dataSet.dataPath must be set")
+            else:
+                # reference probe() EVAL loop: checkRawData per eval set
+                _check_data_path(e.dataSet.dataPath, model_set_dir,
+                                 f"eval {e.name}: dataPath", problems)
+            _check_name_file(e.scoreMetaColumnNameFile, model_set_dir,
+                             f"eval {e.name}: scoreMetaColumnNameFile",
+                             problems)
+            if e.performanceBucketNum is not None and \
+                    not (0 < e.performanceBucketNum <= 1000):
+                problems.append(f"eval {e.name}: performanceBucketNum must "
+                                "be in (0, 1000]")
 
     if problems:
         raise ValidationError(problems)

@@ -205,3 +205,89 @@ def test_probe_init_missing_datapath_flagged(tmp_path):
     with pytest.raises(ValidationError) as e:
         probe(mc, ModelStep.INIT, str(tmp_path))
     assert any("does not exist" in p for p in e.value.problems)
+
+
+def test_probe_init_missing_header_flagged(tmp_path):
+    """Reference checkRawData probes headerPath too (:366-369)."""
+    data = tmp_path / "d.csv"
+    data.write_text("a|b\n1|2\n")
+    mc = ModelConfig.from_dict(REFERENCE_STYLE_MODEL_CONFIG)
+    mc.dataSet.dataPath = str(data)
+    mc.dataSet.headerPath = "/no/such/header"
+    with pytest.raises(ValidationError) as e:
+        probe(mc, ModelStep.INIT, str(tmp_path))
+    assert any("headerPath" in p for p in e.value.problems)
+
+
+def test_probe_stats_name_files_must_exist(tmp_path):
+    """Reference probe() at STATS verifies meta/categorical name files
+    (:121-131)."""
+    mc = ModelConfig.from_dict(REFERENCE_STYLE_MODEL_CONFIG)
+    mc.dataSet.metaColumnNameFile = "no/meta.names"
+    mc.dataSet.categoricalColumnNameFile = "no/cat.names"
+    with pytest.raises(ValidationError) as e:
+        probe(mc, ModelStep.STATS, str(tmp_path))
+    text = "\n".join(e.value.problems)
+    assert "metaColumnNameFile" in text
+    assert "categoricalColumnNameFile" in text
+
+
+def test_probe_post_correlation_metric_se_pairing():
+    """Reference checkVarSelect :335-343."""
+    from shifu_tpu.config.model_config import FilterBy
+    mc = ModelConfig.from_dict(REFERENCE_STYLE_MODEL_CONFIG)
+    mc.varSelect.filterBy = FilterBy.KS
+    mc.varSelect.postCorrelationMetric = "SE"
+    with pytest.raises(ValidationError) as e:
+        probe(mc, ModelStep.VARSELECT)
+    assert any("postCorrelationMetric" in p for p in e.value.problems)
+    mc.varSelect.filterBy = FilterBy.SE
+    probe(mc, ModelStep.VARSELECT)               # both SE: valid
+
+
+def test_probe_train_multiclass_cross_checks():
+    """Reference checkTrainSetting :513-534: OVA algorithm restriction and
+    NATIVE-RF impurity restriction."""
+    from shifu_tpu.config.model_config import (Algorithm,
+                                               MultipleClassification)
+    mc = ModelConfig.from_dict(REFERENCE_STYLE_MODEL_CONFIG)
+    mc.dataSet.posTags = ["a", "b", "c"]
+    mc.dataSet.negTags = []
+    mc.train.algorithm = Algorithm.WDL
+    mc.train.multiClassifyMethod = MultipleClassification.ONEVSALL
+    mc.train.params = {}
+    with pytest.raises(ValidationError) as e:
+        probe(mc, ModelStep.TRAIN)
+    assert any("one vs all" in p for p in e.value.problems)
+    mc.train.algorithm = Algorithm.RF
+    mc.train.multiClassifyMethod = MultipleClassification.NATIVE
+    mc.train.params = {"Impurity": "variance"}
+    with pytest.raises(ValidationError) as e:
+        probe(mc, ModelStep.TRAIN)
+    assert any("entropy/gini" in p for p in e.value.problems)
+
+
+def test_probe_hinge_requires_svm():
+    from shifu_tpu.config.model_config import Algorithm
+    mc = ModelConfig.from_dict(REFERENCE_STYLE_MODEL_CONFIG)
+    mc.train.algorithm = Algorithm.NN
+    mc.train.params = {"Loss": "hinge"}
+    with pytest.raises(ValidationError) as e:
+        probe(mc, ModelStep.TRAIN)
+    assert any("SVM" in p for p in e.value.problems)
+
+
+def test_probe_eval_semantic_checks(tmp_path):
+    """Reference probe() EVAL loop: per-set data existence +
+    scoreMetaColumnNameFile + bucket sanity."""
+    mc = ModelConfig.from_dict(REFERENCE_STYLE_MODEL_CONFIG)
+    ev = mc.evals[0]
+    ev.dataSet.dataPath = "/no/such/eval.csv"
+    ev.scoreMetaColumnNameFile = "no/score.meta"
+    ev.performanceBucketNum = 0
+    with pytest.raises(ValidationError) as e:
+        probe(mc, ModelStep.EVAL, str(tmp_path))
+    text = "\n".join(e.value.problems)
+    assert "dataPath does not exist" in text
+    assert "scoreMetaColumnNameFile" in text
+    assert "performanceBucketNum" in text
