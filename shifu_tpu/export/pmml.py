@@ -16,8 +16,10 @@ Normalizer tables used in training —
 - GBT trees: leaf values pre-scaled by shrinkage, an init-score constant
   segment, and a logistic-link OutputField for log loss.
 
-One-hot expanding norms are rejected with a clear error (mapping a widened
-net back to per-column fields is not yet supported).
+- one-hot-expanding norms (ONEHOT / ZSCALE_ONEHOT categorical) → one
+  indicator ``MapValues`` DerivedField per bin (the last = missing/unseen
+  indicator); net inputs / regression predictors bind to the flat expanded
+  feature list in norm order.
 """
 
 from __future__ import annotations
@@ -94,17 +96,61 @@ def _numeric_bin_values(cc: ColumnConfig, nc: NormalizedColumn) -> np.ndarray:
 
 
 def _local_transformations(parent: ET.Element, columns: List[ColumnConfig],
-                           model_config: ModelConfig) -> None:
+                           model_config: ModelConfig) -> List[str]:
+    """Emit one DerivedField per normalized FEATURE and return the flat
+    ordered name list — one-hot-expanding norms contribute one indicator
+    field per bin (reference ``WoeZscorePmmlElementCreator`` +
+    ``ZscoreLocalTransformCreator`` family, ``core/pmml/builder/impl/``),
+    so net input i == flat feature i for every norm type."""
     norm_type = model_config.normalize.normType
     cutoff = model_config.normalize.stdDevCutOff
     lt = ET.SubElement(parent, "LocalTransformations")
+    names: List[str] = []
     for cc in columns:
         nc = NormalizedColumn(cc, norm_type, cutoff)
         if nc.width != 1:
-            raise PmmlUnsupportedError(
-                f"column {cc.columnName}: norm type {norm_type.name} expands "
-                "to multiple features (onehot) — PMML export not supported "
-                "for onehot norms yet")
+            # one-hot expansion: feature j = [bin(col) == j]; the last
+            # feature is the missing-bin indicator (unseen/missing -> 1).
+            # Categorical bins one-hot via MapValues; numeric bins (plain
+            # NormType.ONEHOT) via per-interval Discretize indicators.
+            nb = nc.width - 1
+            cats = list(cc.bin_category or [])
+            bounds = list(cc.bin_boundary or [])
+            for j in range(nc.width):
+                name = f"{_derived_name(cc)}_{j}"
+                df = ET.SubElement(lt, "DerivedField",
+                                   {"name": name, "optype": "continuous",
+                                    "dataType": "double"})
+                missing_feat = j == nb
+                if cc.is_categorical():
+                    mv = ET.SubElement(df, "MapValues", {
+                        "outputColumn": "out", "dataType": "double",
+                        "defaultValue": "1" if missing_feat else "0",
+                        "mapMissingTo": "1" if missing_feat else "0"})
+                    ET.SubElement(mv, "FieldColumnPair",
+                                  {"field": cc.columnName, "column": "in"})
+                    table = ET.SubElement(mv, "InlineTable")
+                    for bi, cat in enumerate(cats):
+                        row = ET.SubElement(table, "row")
+                        ET.SubElement(row, "in").text = str(cat)
+                        ET.SubElement(row, "out").text = \
+                            "1" if (bi == j and not missing_feat) else "0"
+                else:
+                    disc = ET.SubElement(df, "Discretize", {
+                        "field": cc.columnName, "dataType": "double",
+                        "defaultValue": "0",
+                        "mapMissingTo": "1" if missing_feat else "0"})
+                    if not missing_feat and j < len(bounds):
+                        b = ET.SubElement(disc, "DiscretizeBin",
+                                          {"binValue": "1"})
+                        iv = {"closure": "closedOpen"}
+                        if np.isfinite(bounds[j]):
+                            iv["leftMargin"] = f"{bounds[j]:.6g}"
+                        if j + 1 < len(bounds) and np.isfinite(bounds[j + 1]):
+                            iv["rightMargin"] = f"{bounds[j + 1]:.6g}"
+                        ET.SubElement(b, "Interval", iv)
+                names.append(name)
+            continue
         df = ET.SubElement(lt, "DerivedField",
                            {"name": _derived_name(cc), "optype": "continuous",
                             "dataType": "double"})
@@ -117,6 +163,8 @@ def _local_transformations(parent: ET.Element, columns: List[ColumnConfig],
             # per-bin table norms (WOE / WOE_ZSCALE / DISCRETE_* / ...)
             vals = _numeric_bin_values(cc, nc)
             _discretize(df, cc, vals)
+        names.append(_derived_name(cc))
+    return names
 
 
 def _map_values(df: ET.Element, cc: ColumnConfig, vals: np.ndarray) -> None:
@@ -170,12 +218,8 @@ def _zscore_transform(df: ET.Element, cc: ColumnConfig, cutoff: float) -> None:
 def nn_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
                spec, params) -> ET.ElementTree:
     """NeuralNetwork PMML (reference NNPmmlModelCreator +
-    NeuralNetworkModelIntegrator).  Requires width-1 norms so net input i ==
-    column i's derived field."""
-    if spec.input_dim != len(columns):
-        raise PmmlUnsupportedError(
-            f"net input dim {spec.input_dim} != {len(columns)} columns — "
-            "onehot-expanded nets cannot be exported to PMML yet")
+    NeuralNetworkModelIntegrator).  One-hot-expanding norms contribute one
+    indicator field per bin; net input i == flat feature i."""
     target = model_config.dataSet.targetColumnName or "target"
     root = _pmml_root()
     _data_dictionary(root, columns, target)
@@ -184,17 +228,22 @@ def nn_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
         "activationFunction": _pmml_act(spec.activations[0]
                                         if spec.activations else "tanh")})
     _mining_schema(nn, columns, target)
-    _local_transformations(nn, columns, model_config)
+    feature_names = _local_transformations(nn, columns, model_config)
+    if spec.input_dim != len(feature_names):
+        raise PmmlUnsupportedError(
+            f"net input dim {spec.input_dim} != {len(feature_names)} "
+            "normalized features — the model was trained on a different "
+            "column/norm configuration")
 
     inputs = ET.SubElement(nn, "NeuralInputs",
                            {"numberOfInputs": str(spec.input_dim)})
     in_ids = []
-    for i, cc in enumerate(columns):
+    for i, fname in enumerate(feature_names):
         nid = f"0,{i}"
         ni = ET.SubElement(inputs, "NeuralInput", {"id": nid})
         df = ET.SubElement(ni, "DerivedField", {"optype": "continuous",
                                                 "dataType": "double"})
-        ET.SubElement(df, "FieldRef", {"field": _derived_name(cc)})
+        ET.SubElement(df, "FieldRef", {"field": fname})
         in_ids.append(nid)
 
     prev_ids = in_ids
@@ -229,24 +278,26 @@ def nn_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
 def lr_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
                spec, params) -> ET.ElementTree:
     """RegressionModel PMML with logit normalization (reference
-    RegressionPmmlModelCreator)."""
-    if spec.input_dim != len(columns):
-        raise PmmlUnsupportedError(
-            f"LR input dim {spec.input_dim} != {len(columns)} columns — "
-            "onehot-expanded models cannot be exported to PMML yet")
+    RegressionPmmlModelCreator).  One-hot norms yield one predictor per
+    expanded indicator feature."""
     target = model_config.dataSet.targetColumnName or "target"
     root = _pmml_root()
     _data_dictionary(root, columns, target)
     rm = ET.SubElement(root, "RegressionModel", {
         "functionName": "regression", "normalizationMethod": "logit"})
     _mining_schema(rm, columns, target)
-    _local_transformations(rm, columns, model_config)
+    feature_names = _local_transformations(rm, columns, model_config)
+    if spec.input_dim != len(feature_names):
+        raise PmmlUnsupportedError(
+            f"LR input dim {spec.input_dim} != {len(feature_names)} "
+            "normalized features — the model was trained on a different "
+            "column/norm configuration")
     w = np.asarray(params[0]["w"])[:, 0]
     b = float(np.asarray(params[0]["b"])[0])
     table = ET.SubElement(rm, "RegressionTable", {"intercept": f"{b:.6f}"})
-    for i, cc in enumerate(columns):
+    for i, fname in enumerate(feature_names):
         ET.SubElement(table, "NumericPredictor",
-                      {"name": _derived_name(cc), "exponent": "1",
+                      {"name": fname, "exponent": "1",
                        "coefficient": f"{w[i]:.6f}"})
     return ET.ElementTree(root)
 
