@@ -91,15 +91,15 @@ class StatsProcessor(BasicProcessor):
                     if corr_acc is not None:
                         corr_acc.update(np.nan_to_num(ex.numeric),
                                         ex.numeric_valid)
+                missing_set = {m.strip().lower()
+                               for m in extractor.missing_values}
                 for cc in cat_cols:
                     vals = ex.categorical[cc.columnName]
                     import pandas as pd
                     s = pd.Series(vals, dtype=str).str.strip()
-                    valid = (~s.str.lower().isin(
-                        {m.strip().lower()
-                         for m in extractor.missing_values})).to_numpy()
-                    cat_acc.update(cc.columnName, vals, valid, tgt,
-                                   ex.weight)
+                    valid = (~s.str.lower().isin(missing_set)).to_numpy()
+                    cat_acc.update(cc.columnName, s.to_numpy(), valid, tgt,
+                                   ex.weight, stripped=True)
         # ---------------- finalize numeric columns
         with self.phase("finalize"):
             if num_cols:
