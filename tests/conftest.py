@@ -69,13 +69,11 @@ def fraud_csv(tmp_path_factory):
     return str(path)
 
 
-@pytest.fixture
-def model_set(tmp_path, fraud_csv):
-    """A scaffolded model set over the synthetic fraud data, ready for init."""
+def _scaffold_model_set(base_dir: str, fraud_csv: str) -> str:
     from shifu_tpu.config import ModelConfig
     from shifu_tpu.pipeline.create import create_new_model
 
-    mdir = create_new_model("fraudtest", base_dir=str(tmp_path))
+    mdir = create_new_model("fraudtest", base_dir=base_dir)
     mc = ModelConfig.load(os.path.join(mdir, "ModelConfig.json"))
     mc.dataSet.dataPath = fraud_csv
     mc.dataSet.dataDelimiter = "|"
@@ -90,3 +88,38 @@ def model_set(tmp_path, fraud_csv):
     mc.evals[0].dataSet.dataDelimiter = "|"
     mc.save(os.path.join(mdir, "ModelConfig.json"))
     return mdir
+
+
+@pytest.fixture
+def model_set(tmp_path, fraud_csv):
+    """A scaffolded model set over the synthetic fraud data, ready for init."""
+    return _scaffold_model_set(str(tmp_path), fraud_csv)
+
+
+@pytest.fixture(scope="session")
+def _prepared_template(tmp_path_factory, fraud_csv):
+    """init+stats+norm run ONCE on the default config (norm materializes
+    both the norm and clean/binned planes, so any algorithm can train from
+    a copy) — the suite's pipeline-mechanics tests were each re-running
+    these three identical steps."""
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+
+    mdir = _scaffold_model_set(
+        str(tmp_path_factory.mktemp("prepared")), fraud_csv)
+    assert InitProcessor(mdir).run() == 0
+    assert StatsProcessor(mdir, params={}).run() == 0
+    assert NormalizeProcessor(mdir, params={}).run() == 0
+    return mdir
+
+
+@pytest.fixture
+def prepared_set(_prepared_template, tmp_path):
+    """A fresh per-test copy of the prepared (post-norm) model set.  Use
+    when the test does not change dataSet/stats/normalize config; set
+    train config + run TrainProcessor directly."""
+    import shutil
+    dst = str(tmp_path / "fraudtest")
+    shutil.copytree(_prepared_template, dst)
+    return dst

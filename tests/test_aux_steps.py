@@ -11,6 +11,27 @@ import pytest
 from shifu_tpu.config import ModelConfig
 
 
+def _set_train_alg(mdir, alg=None, tree_params=None):
+    if not alg:
+        return
+    from shifu_tpu.config.model_config import Algorithm
+    mc_path = os.path.join(mdir, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.train.algorithm = Algorithm[alg]
+    if tree_params:
+        mc.train.params = tree_params
+    mc.save(mc_path)
+
+
+def _train_prepared(prepared_set, alg=None, tree_params=None):
+    """Train on a prepared (post-norm) copy — init/stats/norm already ran
+    in the session template; norm materializes both planes so any
+    algorithm can train from it."""
+    from shifu_tpu.pipeline.train import TrainProcessor
+    _set_train_alg(prepared_set, alg, tree_params)
+    assert TrainProcessor(prepared_set, params={}).run() == 0
+
+
 def _run_pipeline(model_set, alg=None, tree_params=None):
     from shifu_tpu.pipeline.create import InitProcessor
     from shifu_tpu.pipeline.stats import StatsProcessor
@@ -18,25 +39,18 @@ def _run_pipeline(model_set, alg=None, tree_params=None):
     from shifu_tpu.pipeline.train import TrainProcessor
     assert InitProcessor(model_set).run() == 0
     assert StatsProcessor(model_set, params={}).run() == 0
-    if alg:
-        from shifu_tpu.config.model_config import Algorithm
-        mc_path = os.path.join(model_set, "ModelConfig.json")
-        mc = ModelConfig.load(mc_path)
-        mc.train.algorithm = Algorithm[alg]
-        if tree_params:
-            mc.train.params = tree_params
-        mc.save(mc_path)
-    from shifu_tpu.pipeline.norm import NormalizeProcessor as NP
-    assert NP(model_set, params={}).run() == 0
+    _set_train_alg(model_set, alg, tree_params)
+    assert NormalizeProcessor(model_set, params={}).run() == 0
     assert TrainProcessor(model_set, params={}).run() == 0
 
 
 NS = {"p": "http://www.dmg.org/PMML-4_2"}
 
 
-def test_export_pmml_nn(model_set):
+def test_export_pmml_nn(prepared_set):
+    model_set = prepared_set
     from shifu_tpu.pipeline.export import ExportProcessor
-    _run_pipeline(model_set)
+    _train_prepared(model_set)
     assert ExportProcessor(model_set, params={"type": "pmml"}).run() == 0
     pmml_files = [f for f in os.listdir(os.path.join(model_set, "export"))
                   if f.endswith(".pmml")]
@@ -148,10 +162,11 @@ def test_categorical_accumulator_nan_rows_fold_into_missing():
     assert counts[-1][0] + counts[-1][1] == 2   # both NaN rows -> missing
 
 
-def test_export_pmml_tree(model_set):
+def test_export_pmml_tree(prepared_set):
+    model_set = prepared_set
     from shifu_tpu.pipeline.export import ExportProcessor
-    _run_pipeline(model_set, alg="GBT",
-                  tree_params={"TreeNum": 3, "MaxDepth": 3, "Loss": "log"})
+    _train_prepared(model_set, alg="GBT",
+                    tree_params={"TreeNum": 3, "MaxDepth": 3, "Loss": "log"})
     assert ExportProcessor(model_set, params={"type": "pmml"}).run() == 0
     pmml_files = [f for f in os.listdir(os.path.join(model_set, "export"))
                   if f.endswith(".pmml")]
@@ -173,12 +188,9 @@ def test_export_pmml_tree(model_set):
     assert out is not None and len(out.findall("p:OutputField", NS)) == 2
 
 
-def test_export_columnstats_and_woe(model_set):
-    from shifu_tpu.pipeline.create import InitProcessor
-    from shifu_tpu.pipeline.stats import StatsProcessor
+def test_export_columnstats_and_woe(prepared_set):
+    model_set = prepared_set          # init/stats ran in the template
     from shifu_tpu.pipeline.export import ExportProcessor
-    assert InitProcessor(model_set).run() == 0
-    assert StatsProcessor(model_set, params={}).run() == 0
     assert ExportProcessor(model_set, params={"type": "columnstats"}).run() == 0
     stats_csv = os.path.join(model_set, "export", "columnstats.csv")
     lines = open(stats_csv).read().splitlines()
@@ -201,10 +213,11 @@ def test_smoke_test_ok_and_one_sided(model_set, tmp_path):
     assert SmokeTestProcessor(model_set, params={}).run() == 1
 
 
-def test_encode_leaf_indices(model_set):
+def test_encode_leaf_indices(prepared_set):
+    model_set = prepared_set
     from shifu_tpu.pipeline.encode import EncodeProcessor
-    _run_pipeline(model_set, alg="RF",
-                  tree_params={"TreeNum": 4, "MaxDepth": 3})
+    _train_prepared(model_set, alg="RF",
+                    tree_params={"TreeNum": 4, "MaxDepth": 3})
     assert EncodeProcessor(model_set, params={}).run() == 0
     enc = os.path.join(model_set, "tmp", "EncodedData")
     lines = open(enc).read().splitlines()
@@ -215,11 +228,12 @@ def test_encode_leaf_indices(model_set):
     assert vals.max() < 15
 
 
-def test_convert_roundtrip(model_set):
+def test_convert_roundtrip(prepared_set):
+    model_set = prepared_set
     from shifu_tpu.pipeline.convert import run_convert
     from shifu_tpu.models import load_any
     from shifu_tpu.data.shards import Shards
-    _run_pipeline(model_set)
+    _train_prepared(model_set)
     models_dir = os.path.join(model_set, "models")
     orig = load_any(os.path.join(models_dir, "model0.nn"))
     data = Shards.open(os.path.join(model_set, "tmp", "NormalizedData")).load_all()
@@ -259,25 +273,15 @@ def test_combo_ensemble(model_set):
     assert len(doc["memberAuc"]) == 2
 
 
-def test_analysis_fi_command(model_set):
+def test_analysis_fi_command(prepared_set):
+    model_set = prepared_set
     """`analysis -fi model.gbt` writes a ranked .fi file (reference
     ShifuCLI.analysisModelFi)."""
-    from shifu_tpu.config import ModelConfig
-    from shifu_tpu.pipeline.create import InitProcessor
-    from shifu_tpu.pipeline.norm import NormalizeProcessor
-    from shifu_tpu.pipeline.stats import StatsProcessor
-    from shifu_tpu.pipeline.train import TrainProcessor
     from shifu_tpu.cli import main as cli_main
 
-    mcp = os.path.join(model_set, "ModelConfig.json")
-    mc = ModelConfig.load(mcp)
-    mc.train.algorithm = "GBT"
-    mc.train.params = {"TreeNum": 5, "MaxDepth": 3, "Loss": "log"}
-    mc.save(mcp)
-    assert InitProcessor(model_set).run() == 0
-    assert StatsProcessor(model_set, params={}).run() == 0
-    assert NormalizeProcessor(model_set, params={}).run() == 0
-    assert TrainProcessor(model_set, params={}).run() == 0
+    _train_prepared(model_set, alg="GBT",
+                    tree_params={"TreeNum": 5, "MaxDepth": 3,
+                                 "Loss": "log"})
     mp = os.path.join(model_set, "models", "model0.gbt")
     assert cli_main(["--dir", model_set, "analysis", "-fi", mp]) == 0
     lines = open(mp + ".fi").read().strip().split("\n")

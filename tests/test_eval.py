@@ -160,16 +160,11 @@ def test_scorer_aggregates_and_scale():
     assert res.select("model1")[0] == 600.0
 
 
-def test_eval_pipeline_end_to_end(model_set):
-    from shifu_tpu.pipeline.create import InitProcessor
-    from shifu_tpu.pipeline.stats import StatsProcessor
-    from shifu_tpu.pipeline.norm import NormalizeProcessor
+def test_eval_pipeline_end_to_end(prepared_set):
+    model_set = prepared_set          # init/stats/norm ran in the template
     from shifu_tpu.pipeline.train import TrainProcessor
     from shifu_tpu.pipeline.evaluate import EvalProcessor
 
-    assert InitProcessor(model_set).run() == 0
-    assert StatsProcessor(model_set, params={}).run() == 0
-    assert NormalizeProcessor(model_set, params={}).run() == 0
     assert TrainProcessor(model_set, params={}).run() == 0
     assert EvalProcessor(model_set, params={"run_eval": ""}).run() == 0
 
@@ -189,10 +184,9 @@ def test_eval_pipeline_end_to_end(model_set):
     assert os.path.isfile(os.path.join(eval_dir, "gainchart.csv"))
 
 
-def test_eval_crud(model_set):
-    from shifu_tpu.pipeline.create import InitProcessor
+def test_eval_crud(prepared_set):
+    model_set = prepared_set          # init ran in the template
     from shifu_tpu.pipeline.evaluate import EvalProcessor
-    assert InitProcessor(model_set).run() == 0
     assert EvalProcessor(model_set, params={"new_eval": "EvalX"}).run() == 0
     from shifu_tpu.config import ModelConfig
     mc = ModelConfig.load(os.path.join(model_set, "ModelConfig.json"))
@@ -203,17 +197,13 @@ def test_eval_crud(model_set):
     assert EvalProcessor(model_set, params={"delete_eval": "nope"}).run() == 1
 
 
-def test_posttrain_bin_avg_scores(model_set):
-    from shifu_tpu.pipeline.create import InitProcessor
-    from shifu_tpu.pipeline.stats import StatsProcessor
-    from shifu_tpu.pipeline.norm import NormalizeProcessor
+def test_posttrain_bin_avg_scores(prepared_set):
+    model_set = prepared_set          # init/stats/norm ran in the template
     from shifu_tpu.pipeline.train import TrainProcessor
     from shifu_tpu.pipeline.posttrain import PostTrainProcessor
     from shifu_tpu.config import load_column_configs
 
-    assert InitProcessor(model_set).run() == 0
-    for P in (StatsProcessor, NormalizeProcessor, TrainProcessor):
-        assert P(model_set, params={}).run() == 0
+    assert TrainProcessor(model_set, params={}).run() == 0
     assert PostTrainProcessor(model_set, params={}).run() == 0
     ccs = load_column_configs(os.path.join(model_set, "ColumnConfig.json"))
     scored = [c for c in ccs if c.columnBinning.binAvgScore]

@@ -135,25 +135,20 @@ def test_tree_model_save_load_roundtrip(tmp_path):
     assert pred[y == 1].mean() > pred[y == 0].mean() + 0.3
 
 
-def test_gbt_pipeline_end_to_end(model_set):
+def test_gbt_pipeline_end_to_end(prepared_set):
+    model_set = prepared_set          # init/stats/norm ran in the template
     from shifu_tpu.config import ModelConfig
     from shifu_tpu.config.model_config import Algorithm
-    from shifu_tpu.pipeline.create import InitProcessor
-    from shifu_tpu.pipeline.stats import StatsProcessor
-    from shifu_tpu.pipeline.norm import NormalizeProcessor
     from shifu_tpu.pipeline.train import TrainProcessor
     from shifu_tpu.pipeline.evaluate import EvalProcessor
     import json
 
-    assert InitProcessor(model_set).run() == 0
-    assert StatsProcessor(model_set, params={}).run() == 0
     mc_path = os.path.join(model_set, "ModelConfig.json")
     mc = ModelConfig.load(mc_path)
     mc.train.algorithm = Algorithm.GBT
     mc.train.params = {"TreeNum": 15, "MaxDepth": 4, "Loss": "log",
                        "LearningRate": 0.3}
     mc.save(mc_path)
-    assert NormalizeProcessor(model_set, params={}).run() == 0
     assert TrainProcessor(model_set, params={}).run() == 0
     assert os.path.isfile(os.path.join(model_set, "models", "model0.gbt"))
     assert EvalProcessor(model_set, params={"run_eval": ""}).run() == 0
@@ -162,23 +157,18 @@ def test_gbt_pipeline_end_to_end(model_set):
     assert perf["areaUnderRoc"] > 0.75
 
 
-def test_rf_pipeline_end_to_end(model_set):
+def test_rf_pipeline_end_to_end(prepared_set):
+    model_set = prepared_set          # init/stats/norm ran in the template
     from shifu_tpu.config import ModelConfig
     from shifu_tpu.config.model_config import Algorithm
-    from shifu_tpu.pipeline.create import InitProcessor
-    from shifu_tpu.pipeline.stats import StatsProcessor
-    from shifu_tpu.pipeline.norm import NormalizeProcessor
     from shifu_tpu.pipeline.train import TrainProcessor
 
-    assert InitProcessor(model_set).run() == 0
-    assert StatsProcessor(model_set, params={}).run() == 0
     mc_path = os.path.join(model_set, "ModelConfig.json")
     mc = ModelConfig.load(mc_path)
     mc.train.algorithm = Algorithm.RF
     mc.train.params = {"TreeNum": 8, "MaxDepth": 5,
                        "FeatureSubsetStrategy": "TWOTHIRDS"}
     mc.save(mc_path)
-    assert NormalizeProcessor(model_set, params={}).run() == 0
     assert TrainProcessor(model_set, params={}).run() == 0
     assert os.path.isfile(os.path.join(model_set, "models", "model0.rf"))
 

@@ -67,25 +67,20 @@ def test_gbt_stacked_lr_trials_differ():
     assert res[0].valid_error != res[1].valid_error
 
 
-def test_pipeline_tree_grid_search(model_set):
+def test_pipeline_tree_grid_search(prepared_set):
     """List-valued tree params train, grid report lands, best trial saved
     as model0 (the round-3 ValidationError is gone)."""
+    model_set = prepared_set
     from shifu_tpu.config import ModelConfig
     from shifu_tpu.config.model_config import Algorithm
-    from shifu_tpu.pipeline.create import InitProcessor
-    from shifu_tpu.pipeline.norm import NormalizeProcessor
-    from shifu_tpu.pipeline.stats import StatsProcessor
     from shifu_tpu.pipeline.train import TrainProcessor
 
-    assert InitProcessor(model_set).run() == 0
-    assert StatsProcessor(model_set, params={}).run() == 0
     mc_path = os.path.join(model_set, "ModelConfig.json")
     mc = ModelConfig.load(mc_path)
     mc.train.algorithm = Algorithm.GBT
     mc.train.params = {"TreeNum": 6, "MaxDepth": [3, 4], "Loss": "log",
                        "LearningRate": [0.1, 0.3]}
     mc.save(mc_path)
-    assert NormalizeProcessor(model_set, params={}).run() == 0
     assert TrainProcessor(model_set, params={}).run() == 0
     assert os.path.isfile(os.path.join(model_set, "models", "model0.gbt"))
     report = json.load(open(os.path.join(model_set, "tmp",
@@ -100,18 +95,14 @@ def test_pipeline_tree_grid_search(model_set):
     assert "Trial [3]" in progress
 
 
-def test_pipeline_rf_bagging(model_set):
+def test_pipeline_rf_bagging(prepared_set):
     """baggingNum > 1 trains independent forests model0..modelB-1."""
+    model_set = prepared_set
     from shifu_tpu.config import ModelConfig
     from shifu_tpu.config.model_config import Algorithm
     from shifu_tpu.models import tree as tree_model
-    from shifu_tpu.pipeline.create import InitProcessor
-    from shifu_tpu.pipeline.norm import NormalizeProcessor
-    from shifu_tpu.pipeline.stats import StatsProcessor
     from shifu_tpu.pipeline.train import TrainProcessor
 
-    assert InitProcessor(model_set).run() == 0
-    assert StatsProcessor(model_set, params={}).run() == 0
     mc_path = os.path.join(model_set, "ModelConfig.json")
     mc = ModelConfig.load(mc_path)
     mc.train.algorithm = Algorithm.RF
@@ -119,7 +110,6 @@ def test_pipeline_rf_bagging(model_set):
     mc.train.params = {"TreeNum": 5, "MaxDepth": 3,
                        "FeatureSubsetStrategy": "HALF"}
     mc.save(mc_path)
-    assert NormalizeProcessor(model_set, params={}).run() == 0
     assert TrainProcessor(model_set, params={}).run() == 0
     mdir = os.path.join(model_set, "models")
     paths = sorted(p for p in os.listdir(mdir) if p.startswith("model"))
@@ -131,19 +121,15 @@ def test_pipeline_rf_bagging(model_set):
                for a, b in zip(trees0, trees1))
 
 
-def test_pipeline_rf_kfold_cv_error(model_set):
+def test_pipeline_rf_kfold_cv_error(prepared_set):
     """RF k-fold: each fold's model lands and the progress trail shows
     per-fold runs; the saved valid figure is held-out-fold error (the
     oob-only error was the round-4 review finding)."""
+    model_set = prepared_set
     from shifu_tpu.config import ModelConfig
     from shifu_tpu.config.model_config import Algorithm
-    from shifu_tpu.pipeline.create import InitProcessor
-    from shifu_tpu.pipeline.norm import NormalizeProcessor
-    from shifu_tpu.pipeline.stats import StatsProcessor
     from shifu_tpu.pipeline.train import TrainProcessor
 
-    assert InitProcessor(model_set).run() == 0
-    assert StatsProcessor(model_set, params={}).run() == 0
     mc_path = os.path.join(model_set, "ModelConfig.json")
     mc = ModelConfig.load(mc_path)
     mc.train.algorithm = Algorithm.RF
@@ -151,24 +137,19 @@ def test_pipeline_rf_kfold_cv_error(model_set):
     mc.train.numKFold = 3
     mc.train.params = {"TreeNum": 4, "MaxDepth": 3, "Loss": "log"}
     mc.save(mc_path)
-    assert NormalizeProcessor(model_set, params={}).run() == 0
     assert TrainProcessor(model_set, params={}).run() == 0
     mdir = os.path.join(model_set, "models")
     paths = sorted(p for p in os.listdir(mdir) if p.startswith("model"))
     assert paths == ["model0.rf", "model1.rf", "model2.rf"]
 
 
-def test_pipeline_gbt_kfold(model_set):
+def test_pipeline_gbt_kfold(prepared_set):
     """isCrossValidation trains one forest per fold."""
+    model_set = prepared_set
     from shifu_tpu.config import ModelConfig
     from shifu_tpu.config.model_config import Algorithm
-    from shifu_tpu.pipeline.create import InitProcessor
-    from shifu_tpu.pipeline.norm import NormalizeProcessor
-    from shifu_tpu.pipeline.stats import StatsProcessor
     from shifu_tpu.pipeline.train import TrainProcessor
 
-    assert InitProcessor(model_set).run() == 0
-    assert StatsProcessor(model_set, params={}).run() == 0
     mc_path = os.path.join(model_set, "ModelConfig.json")
     mc = ModelConfig.load(mc_path)
     mc.train.algorithm = Algorithm.GBT
@@ -176,7 +157,6 @@ def test_pipeline_gbt_kfold(model_set):
     mc.train.numKFold = 3
     mc.train.params = {"TreeNum": 4, "MaxDepth": 3, "Loss": "log"}
     mc.save(mc_path)
-    assert NormalizeProcessor(model_set, params={}).run() == 0
     assert TrainProcessor(model_set, params={}).run() == 0
     mdir = os.path.join(model_set, "models")
     paths = sorted(p for p in os.listdir(mdir) if p.startswith("model"))
