@@ -1508,6 +1508,46 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
 
 
 # -------------------------------------------------------- pipeline driver
+def _tree_member_masks(mc, n: int, bags: int, kfold: int, rf_like: bool,
+                       targets, seed: int, distinct: bool = False):
+    """(tw_m, vw_m) member weight matrices for bagged/fold tree members —
+    RF-family members take the full bag as train weight (out-of-bag
+    validates), GBT members keep the held-out split.
+
+    ``distinct``: each bagging member draws its OWN validation split from
+    its own seed (the reference's per-Guagua-job randomness) — without it,
+    default-config GBT bags (sampleRate 1, no replacement, subset ALL)
+    would be byte-identical forests.  Grid trials must NOT use it: trials
+    share one split so the comparison isolates the hypers."""
+    from .sampling import member_masks
+
+    def one(b: int, nb: int, sd: int):
+        return member_masks(
+            n, nb, valid_rate=0.0 if rf_like else mc.train.validSetRate,
+            kfold=kfold, sample_rate=mc.train.baggingSampleRate,
+            replacement=mc.train.baggingWithReplacement,
+            stratified=mc.train.stratifiedSample, targets=targets, seed=sd)
+
+    if distinct and bags > 1 and not rf_like and not (kfold and kfold > 1):
+        pairs = [one(b, 1, seed + b) for b in range(bags)]
+        tw_m = np.concatenate([p[0] for p in pairs])
+        vw_m = np.concatenate([p[1] for p in pairs])
+    else:
+        tw_m, vw_m = one(0, bags, seed)
+    if rf_like and not (kfold and kfold > 1):
+        tw_m = tw_m + vw_m
+    return tw_m, vw_m
+
+
+def _write_feature_importance(proc, col_nums, feature_names, fi_total):
+    names = feature_names or [str(cn) for cn in col_nums]
+    fi_named = sorted(((names[j], float(v)) for j, v in enumerate(fi_total)),
+                      key=lambda kv: -kv[1])
+    with open(os.path.join(proc.paths.tmp_dir, "feature_importance.json"),
+              "w") as fjson:
+        json.dump({k: v for k, v in fi_named}, fjson, indent=2)
+
+
 def _tree_stream(shards, mesh):
     """A ShardStream with the tree trainers' window geometry (env knobs +
     data-axis rounding) — the ONE place that computes it (main streamed
@@ -1521,6 +1561,103 @@ def _tree_stream(shards, mesh):
         auto_window_rows(2 * ncols + 8, budget, multiple=data_size)
     window_rows += (-window_rows) % data_size
     return ShardStream(shards, ("bins", "y", "w"), window_rows)
+
+
+def _run_tree_ova_bagged(proc, shards, col_nums, cat_mask, n_bins,
+                         settings: DTSettings, alg, K: int,
+                         bags: int) -> int:
+    """OVA x bagging: B independent forests per class (reference runs one
+    FULL bagging job per class, ``TrainModelProcessor.java:684-714``).
+    Each class's B bags train as ONE vmapped multi-forest run; model files
+    follow the NN OVA convention (member ``b*K + k`` scores class k via
+    its ``class_index`` extra — the scorer averages contributors per
+    class, so file numbering is immaterial).  ``train -resume`` skips
+    classes whose B models are all complete (per-class granularity; the
+    un-bagged OVA path additionally restores mid-forest checkpoints)."""
+    from ..parallel.mesh import device_mesh
+
+    mc = proc.model_config
+    mesh = device_mesh(n_ensemble=1)
+    ext = alg.name.lower()
+    os.makedirs(proc.paths.models_dir, exist_ok=True)
+    if not settings.resume:
+        for f in os.listdir(proc.paths.models_dir):
+            if f.startswith("model"):
+                os.remove(os.path.join(proc.paths.models_dir, f))
+    data = shards.load_all()
+    bins, y, w = data["bins"].astype(np.int32), data["y"], data["w"]
+    n = len(y)
+    rf_like = alg != Algorithm.GBT
+    settings_list = [replace(settings, seed=settings.seed + b)
+                     for b in range(bags)]
+    fi_total = np.zeros(len(col_nums))
+    feature_names = shards.schema.get("columnNames")
+
+    def fi_path(k: int) -> str:
+        return os.path.join(proc.paths.tmp_dir, f"fi_class{k}.npy")
+
+    def class_complete(k: int) -> bool:
+        for b in range(bags):
+            p = proc.paths.model_path(b * K + k, ext)
+            if not os.path.isfile(p):
+                return False
+            spec_k, _ = tree_model.load_model(p)
+            if spec_k.n_trees < settings.n_trees:
+                return False
+        return True
+
+    with open(proc.paths.progress_path,
+              "a" if settings.resume else "w") as pf:
+        for k in range(K):
+            if settings.resume and class_complete(k):
+                log.info("train %s OVA class %d/%d: all %d bags complete, "
+                         "skipping", alg.name, k + 1, K, bags)
+                continue
+            yk = (np.asarray(y) == k).astype(np.float32)
+            tw_m, vw_m = _tree_member_masks(mc, n, bags, -1, rf_like, yk,
+                                            settings.seed, distinct=True)
+            if settings.early_stop and alg == Algorithm.GBT:
+                # early stop is a per-run decision loop; honor it
+                # sequentially (train_gbt_bagged trains full forests)
+                results = [train_gbt(bins, yk,
+                                     w * (tw_m[b] + vw_m[b] > 0), n_bins,
+                                     cat_mask, settings_list[b], mesh=mesh)
+                           for b in range(bags)]
+            elif alg == Algorithm.GBT:
+                results = train_gbt_bagged(
+                    bins, yk, tw_m * w[None, :], vw_m * w[None, :], n_bins,
+                    cat_mask, settings_list, mesh=mesh)
+            else:
+                results = train_rf_bagged(
+                    bins, yk, tw_m * w[None, :], n_bins, cat_mask,
+                    settings_list, mesh=mesh)
+            np.save(fi_path(k), np.sum([r.feature_importance
+                                        for r in results], axis=0))
+            for b, res in enumerate(results):
+                if alg != Algorithm.GBT:
+                    res.spec_kwargs["algorithm"] = \
+                        "RF" if alg != Algorithm.DT else "DT"
+                res.spec_kwargs.setdefault("extra", {}).update(
+                    {"class_index": k, "n_classes": K})
+                spec = tree_model.TreeModelSpec(
+                    n_trees=len(res.trees), depth=settings.depth,
+                    n_bins=n_bins, column_nums=list(col_nums),
+                    feature_names=feature_names, **res.spec_kwargs)
+                tree_model.save_model(
+                    proc.paths.model_path(b * K + k, ext), spec, res.trees)
+                for ti, (tr, va) in enumerate(res.history):
+                    pf.write(f"Class {k} Bag {b} Tree #{ti + 1} Train "
+                             f"Error: {tr:.6f} Validation Error: "
+                             f"{va:.6f}\n")
+            pf.flush()
+            log.info("train %s OVA class %d/%d: %d bagged forests, valid "
+                     "errs %s", alg.name, k + 1, K, bags,
+                     [round(r.valid_error, 6) for r in results])
+    for k in range(K):      # FI sidecars survive resume-skipped classes
+        if os.path.isfile(fi_path(k)):
+            fi_total += np.load(fi_path(k))
+    _write_feature_importance(proc, col_nums, feature_names, fi_total)
+    return 0
 
 
 def _run_tree_ova(proc, shards, col_nums, cat_mask, n_bins,
@@ -1636,12 +1773,8 @@ def _run_tree_ova(proc, shards, col_nums, cat_mask, n_bins,
         else:                                         # pragma: no cover
             log.warning("OVA class %d has no stored feature importance "
                         "(pre-resume run?); totals omit it", k)
-    names = shards.schema.get("columnNames", [str(cn) for cn in col_nums])
-    fi_named = sorted(((names[j], float(v)) for j, v in enumerate(fi_total)),
-                      key=lambda kv: -kv[1])
-    with open(os.path.join(proc.paths.tmp_dir, "feature_importance.json"),
-              "w") as fjson:
-        json.dump({k2: v for k2, v in fi_named}, fjson, indent=2)
+    _write_feature_importance(proc, col_nums,
+                              shards.schema.get("columnNames"), fi_total)
     return 0
 
 
@@ -1658,7 +1791,6 @@ def _run_tree_multi(proc, shards, col_nums, cat_mask, n_bins, alg,
     own job-queue shape."""
     from ..parallel.mesh import device_mesh
     from ..train.grid_search import tree_stackable_groups
-    from .sampling import member_masks
 
     mc = proc.model_config
     data = shards.load_all()
@@ -1707,29 +1839,15 @@ def _run_tree_multi(proc, shards, col_nums, cat_mask, n_bins, alg,
 
     # sampling masks: grid trials share ONE split (isolate the hypers);
     # bagging/k-fold members each get their bag/fold (reference bagging
-    # sample rate / CV folds).  RF validates on out-of-bag rows, so its
-    # members take the full bag as train weight (valid_rate=0).
+    # sample rate / CV folds)
     rf_like = alg != Algorithm.GBT
     if is_gs:
-        tw1, vw1 = member_masks(
-            n, 1, valid_rate=0.0 if rf_like else mc.train.validSetRate,
-            kfold=-1, sample_rate=mc.train.baggingSampleRate,
-            replacement=mc.train.baggingWithReplacement,
-            stratified=mc.train.stratifiedSample, targets=y,
-            seed=base.seed)
+        tw1, vw1 = _tree_member_masks(mc, n, 1, -1, rf_like, y, base.seed)
         tw_m = np.repeat(tw1, len(trials), axis=0)
         vw_m = np.repeat(vw1, len(trials), axis=0)
-        if rf_like:
-            tw_m = tw_m + vw_m          # oob validates; no held-out split
     else:
-        tw_m, vw_m = member_masks(
-            n, bags, valid_rate=0.0 if rf_like else mc.train.validSetRate,
-            kfold=kfold, sample_rate=mc.train.baggingSampleRate,
-            replacement=mc.train.baggingWithReplacement,
-            stratified=mc.train.stratifiedSample, targets=y,
-            seed=base.seed)
-        if rf_like and not (kfold and kfold > 1):
-            tw_m = tw_m + vw_m
+        tw_m, vw_m = _tree_member_masks(mc, n, bags, kfold, rf_like, y,
+                                        base.seed, distinct=True)
 
     results: List[Optional[ForestResult]] = [None] * len(settings_list)
     with open(proc.paths.progress_path, "w") as pf:
@@ -1788,13 +1906,9 @@ def _run_tree_multi(proc, shards, col_nums, cat_mask, n_bins, alg,
             save(res, i, settings_list[i])
         log.info("saved %d bagged %s model(s); valid errors %s", len(results),
                  alg.name, [round(r.valid_error, 6) for r in results])
-    fi_total = np.sum([r.feature_importance for r in results], axis=0)
-    names = feature_names or [str(cn) for cn in col_nums]
-    fi_named = sorted(((names[j], float(v)) for j, v in enumerate(fi_total)),
-                      key=lambda kv: -kv[1])
-    with open(os.path.join(proc.paths.tmp_dir, "feature_importance.json"),
-              "w") as fjson:
-        json.dump({k: v for k, v in fi_named}, fjson, indent=2)
+    _write_feature_importance(
+        proc, col_nums, feature_names,
+        np.sum([r.feature_importance for r in results], axis=0))
     return 0
 
 
@@ -1824,10 +1938,25 @@ def run_tree_training(proc) -> int:
 
     K = len(mc.dataSet.posTags) if mc.is_multi_class() else 0
     if K > 2 and multi:
+        from ..config.model_config import MultipleClassification
+        ova = mc.train.multiClassifyMethod == \
+            MultipleClassification.ONEVSALL or alg == Algorithm.GBT
+        if ova and bags > 1 and not is_gs and not (kfold and kfold > 1):
+            streaming = proc._use_streaming(shards, shards.schema) \
+                if hasattr(proc, "_use_streaming") else False
+            if streaming:
+                log.warning("OVA bagging trains in-RAM (no streamed "
+                            "bagged mode); reduce baggingNum or memory "
+                            "budget pressure if this OOMs")
+            return _run_tree_ova_bagged(proc, shards, col_nums, cat_mask,
+                                        n_bins, settings, alg, K, bags)
         from ..config.validator import ValidationError
+        what = "grid search / k-fold" if (is_gs or (kfold and kfold > 1)) \
+            else "bagging with NATIVE multi-class"
         raise ValidationError(
-            ["grid search / bagging / k-fold are not supported with "
-             "multi-class tree training — train classes individually"])
+            [f"{what} is not supported with multi-class tree training — "
+             "train trials/folds individually, or use ONEVSALL (OVA "
+             "bagging is supported)"])
     if multi:
         return _run_tree_multi(proc, shards, col_nums, cat_mask, n_bins,
                                alg, trials, is_gs, kfold, bags)

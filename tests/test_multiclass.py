@@ -275,6 +275,50 @@ def test_ova_resume_restarts_at_unfinished_class(mc_model_set):
     assert os.path.isfile(os.path.join(mdir, "model2.gbt"))
 
 
+def test_e2e_gbt_ova_bagged(mc_model_set):
+    """OVA x bagging: one full bagging job per class (reference
+    TrainModelProcessor.java:684-714) — B*K models, each stamped with its
+    class_index; the scorer averages contributors per class."""
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.models import tree as tree_model
+    mcp = os.path.join(mc_model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.train.algorithm = "GBT"
+    mc.train.baggingNum = 2
+    mc.train.params = {"TreeNum": 6, "MaxDepth": 3, "Loss": "log",
+                       "LearningRate": 0.2}
+    mc.save(mcp)
+    rep = _run_steps(mc_model_set)
+    mdir = os.path.join(mc_model_set, "models")
+    models = sorted(f for f in os.listdir(mdir) if f.startswith("model"))
+    assert len(models) == 6                       # 2 bags x 3 classes
+    by_class = {}
+    for f in models:
+        spec, _ = tree_model.load_model(os.path.join(mdir, f))
+        by_class.setdefault(spec.extra["class_index"], []).append(f)
+    assert {len(v) for v in by_class.values()} == {2}
+    assert rep["accuracy"] > 0.8
+    # bags are genuinely different forests (per-member validation splits —
+    # default sampling would otherwise duplicate GBT bags byte-for-byte)
+    f0, f1 = by_class[0]
+    _, t0 = tree_model.load_model(os.path.join(mdir, f0))
+    _, t1 = tree_model.load_model(os.path.join(mdir, f1))
+    assert any((a.split_feat != b.split_feat).any() or
+               (a.leaf_value != b.leaf_value).any()
+               for a, b in zip(t0, t1))
+    # resume skips complete classes: drop class 2's bags, keep the rest
+    from shifu_tpu.pipeline.train import TrainProcessor
+    for f in by_class[2]:
+        os.remove(os.path.join(mdir, f))
+    kept = {f: os.path.getmtime(os.path.join(mdir, f))
+            for c in (0, 1) for f in by_class[c]}
+    assert TrainProcessor(mc_model_set, params={"resume": True}).run() == 0
+    for f, mtime in kept.items():
+        assert os.path.getmtime(os.path.join(mdir, f)) == mtime
+    for f in by_class[2]:
+        assert os.path.isfile(os.path.join(mdir, f))
+
+
 def test_e2e_nn_ova_multiclass(mc_model_set):
     from shifu_tpu.config import ModelConfig
     mcp = os.path.join(mc_model_set, "ModelConfig.json")
