@@ -88,15 +88,60 @@ def init_params(key, spec: WDLModelSpec) -> Dict:
     return params
 
 
+# one-hot-matmul lowering cap on TOTAL one-hot elements (N * C * max_card
+# — a single high-cardinality column inflates the tensor even at small
+# batch): worth materializing for training minibatches (embedding grads
+# become matmuls instead of TPU-serialized scatters, measured ~26x on the
+# bench step), but a full-dataset scoring pass or a 50k-card column would
+# blow HBM — those keep the gather.  33.5M elements = 134 MB f32.
+_ONEHOT_MAX_ELEMS = 1 << 25
+
+
+def _cat_onehot(params: Dict, x_cat):
+    """[N, C, K] one-hot over per-column-clipped indices (K = max
+    cardinality; a column's padding lanes never activate because its
+    indices clip below its own cardinality)."""
+    tabs = params.get("embed") or params.get("wide_cat")
+    cards = jnp.asarray([t.shape[0] for t in tabs])
+    idx = jnp.clip(x_cat, 0, cards[None, :] - 1)
+    return jax.nn.one_hot(idx, int(max(t.shape[0] for t in tabs)),
+                          dtype=jnp.float32)
+
+
 def forward_logits(params: Dict, spec: WDLModelSpec, x_num, x_cat):
-    """x_num [N, numeric_dim] float; x_cat [N, n_cat] int bin indices."""
+    """x_num [N, numeric_dim] float; x_cat [N, n_cat] int bin indices.
+
+    Embedding/wide lookups lower two ways: small (training) batches build
+    the categorical one-hot ONCE and feed MXU einsums — the backward pass
+    is then matmuls, not one scatter-add per column (the per-column
+    ``table[idx]`` loop's gathers backprop as scatters the TPU
+    serializes); large (scoring) batches keep the per-column gather."""
     n = x_num.shape[0] if spec.numeric_dim else x_cat.shape[0]
+    tabs = params.get("embed") or params.get("wide_cat")
+    use_onehot = bool(tabs) and (
+        x_cat.shape[0] * x_cat.shape[1]
+        * max(t.shape[0] for t in tabs) <= _ONEHOT_MAX_ELEMS)
+    oh = _cat_onehot(params, x_cat) if use_onehot else None
     logit = jnp.zeros((n, 1)) + params["bias"]
     if spec.deep_enable:
         parts = [x_num] if spec.numeric_dim else []
-        for i, table in enumerate(params["embed"]):
-            idx = jnp.clip(x_cat[:, i], 0, table.shape[0] - 1)
-            parts.append(table[idx])
+        if use_onehot:
+            k = oh.shape[-1]
+            stacked = jnp.stack([
+                jnp.pad(t, ((0, k - t.shape[0]), (0, 0)))
+                if t.shape[0] != k else t
+                for t in params["embed"]])                # [C, K, E]
+            # HIGHEST precision: this einsum is a LOOKUP — default/bf16
+            # matmul precision would silently round every table value to
+            # bf16 per step (the gather it replaces was exact; same trap
+            # as the histogram kernel's convert-round-trip fold)
+            emb = jnp.einsum("nck,cke->nce", oh, stacked,
+                             precision=jax.lax.Precision.HIGHEST)
+            parts.append(emb.reshape(n, -1))             # == concat order
+        else:
+            for i, table in enumerate(params["embed"]):
+                idx = jnp.clip(x_cat[:, i], 0, table.shape[0] - 1)
+                parts.append(table[idx])
         h = jnp.concatenate(parts, axis=1)
         from .nn import ACTIVATIONS
         acts = [ACTIVATIONS[a.lower()] for a in spec.activations]
@@ -106,9 +151,19 @@ def forward_logits(params: Dict, spec: WDLModelSpec, x_num, x_cat):
         logit = logit + h @ last["w"] + last["b"]
     if spec.wide_enable:
         wide = jnp.zeros((n, 1))
-        for i, wvec in enumerate(params["wide_cat"]):
-            idx = jnp.clip(x_cat[:, i], 0, wvec.shape[0] - 1)
-            wide = wide + wvec[idx][:, None]
+        if use_onehot:
+            k = oh.shape[-1]
+            wstack = jnp.stack([
+                jnp.pad(v, (0, k - v.shape[0]))
+                if v.shape[0] != k else v
+                for v in params["wide_cat"]])             # [C, K]
+            wide = wide + jnp.einsum(
+                "nck,ck->n", oh, wstack,
+                precision=jax.lax.Precision.HIGHEST)[:, None]
+        else:
+            for i, wvec in enumerate(params["wide_cat"]):
+                idx = jnp.clip(x_cat[:, i], 0, wvec.shape[0] - 1)
+                wide = wide + wvec[idx][:, None]
         if spec.numeric_dim:
             wide = wide + x_num @ params["wide_num"]
         logit = logit + wide

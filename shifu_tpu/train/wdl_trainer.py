@@ -27,7 +27,7 @@ from ..data.shards import Shards
 from ..models import wdl as wdl_model
 from ..parallel import mesh as meshlib
 from .early_stop import WindowEarlyStop
-from .nn_trainer import TrainSettings, _stack
+from .nn_trainer import TrainSettings, _stack, _to_host
 from .optimizers import make_optimizer
 from .sampling import member_masks
 
@@ -240,7 +240,7 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
         epochs_run = epoch + 1
         improved = np.flatnonzero(va < best_valid)
         if improved.size:
-            host = jax.tree_util.tree_map(np.asarray, stacked)
+            host = _to_host(stacked)
             for i in improved:
                 best_valid[i], best_train[i] = va[i], tr[i]
                 best_params[i] = jax.tree_util.tree_map(
@@ -252,7 +252,7 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
             if all(flags):
                 log.info("WDL early stop at epoch %d", epoch)
                 break
-    final = jax.tree_util.tree_map(np.asarray, stacked)
+    final = _to_host(stacked)
     for i in range(bags):
         if best_params[i] is None:
             best_params[i] = jax.tree_util.tree_map(lambda a: a[i], final)
@@ -390,7 +390,7 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
         history.append((float(tr.mean()), float(va.mean())))
         improved = np.flatnonzero(va < best_valid)
         if improved.size:
-            host = jax.tree_util.tree_map(np.asarray, params_snapshot)
+            host = _to_host(params_snapshot)
             for i in improved:
                 best_valid[i], best_train[i] = va[i], tr[i]
                 best_params[i] = jax.tree_util.tree_map(
@@ -433,7 +433,7 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
             xnb, xcb, yb, tw, vw = put_window(win)
             stats_acc = eval_window(stacked, stats_acc, xnb, xcb, yb, tw, vw)
         bookkeep(epochs_run, np.asarray(stats_acc), stacked)
-    final = jax.tree_util.tree_map(np.asarray, stacked)
+    final = _to_host(stacked)
     for i in range(bags):
         if best_params[i] is None:
             best_params[i] = jax.tree_util.tree_map(lambda a: a[i], final)

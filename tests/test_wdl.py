@@ -41,6 +41,44 @@ def test_wdl_forward_shapes():
     assert np.all((out > 0) & (out < 1))
 
 
+def test_wdl_onehot_and_gather_lowerings_agree():
+    """The one-hot-matmul embedding path (training batches — grads become
+    MXU matmuls, not per-column scatters) must produce EXACTLY the gather
+    path's logits (a one-hot matmul sums a single nonzero term)."""
+    import jax.numpy as jnp
+
+    import shifu_tpu.models.wdl as W
+
+    x_num, x_cat, _ = make_data(400)
+    spec = wdl_model.WDLModelSpec(numeric_dim=3, cat_cardinalities=[6, 4],
+                                  embed_dim=5)
+    params = wdl_model.init_params(jax.random.PRNGKey(3), spec)
+    small = W.forward_logits(params, spec, jnp.asarray(x_num),
+                             jnp.asarray(x_cat))
+    cap = W._ONEHOT_MAX_ELEMS
+    try:
+        W._ONEHOT_MAX_ELEMS = 0           # force the gather lowering
+        gathered = W.forward_logits(params, spec, jnp.asarray(x_num),
+                                    jnp.asarray(x_cat))
+    finally:
+        W._ONEHOT_MAX_ELEMS = cap
+    np.testing.assert_allclose(np.asarray(small), np.asarray(gathered),
+                               rtol=1e-6, atol=1e-6)
+    # out-of-range / missing-bin indices clip identically per column
+    x_bad = x_cat.copy()
+    x_bad[:7, 0] = 99
+    a = W.forward_logits(params, spec, jnp.asarray(x_num),
+                         jnp.asarray(x_bad))
+    try:
+        W._ONEHOT_MAX_ELEMS = 0
+        b = W.forward_logits(params, spec, jnp.asarray(x_num),
+                             jnp.asarray(x_bad))
+    finally:
+        W._ONEHOT_MAX_ELEMS = cap
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-6, atol=1e-6)
+
+
 def test_wdl_wide_only_and_deep_only():
     x_num, x_cat, y = make_data()
     for wide, deep in ((True, False), (False, True)):
