@@ -190,6 +190,73 @@ def bench_rf(n_rows: int = 1 << 17, n_features: int = 64, n_bins: int = 64,
         n_rows, n_features, n_bins)
 
 
+def bench_wdl(n_rows: int = 1 << 17, n_num: int = 64, n_cat: int = 32,
+              card: int = 64, batch: int = 1 << 12,
+              steps: int = 2000) -> float:
+    """Wide&deep training-step throughput, same harness shape as
+    :func:`bench_nn`: the timing window is ONE scanned executable of
+    dual-plane minibatch updates (embedding gathers + wide sparse path +
+    deep MLP backprop), value-force synced.  (Reference
+    ``core/dtrain/wdl/`` worker backprop; the measured NN-backprop
+    baseline is the same reference-class computation and serves as the
+    denominator.)"""
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.models.wdl import WDLModelSpec, init_params, weighted_loss
+    from shifu_tpu.train.optimizers import make_optimizer
+
+    rng = np.random.default_rng(0)
+    x_num = jnp.asarray(rng.normal(size=(n_rows, n_num)), jnp.float32)
+    x_cat = jnp.asarray(rng.integers(0, card, (n_rows, n_cat)), jnp.int32)
+    logit = np.asarray(x_num)[:, 0] * 0.8 \
+        + (np.asarray(x_cat)[:, 0] < card // 2) * 0.7 - 0.3
+    y = jnp.asarray(rng.random(n_rows) < 1 / (1 + np.exp(-logit)),
+                    jnp.float32)
+    w = jnp.ones(n_rows, jnp.float32)
+    spec = WDLModelSpec(numeric_dim=n_num,
+                        cat_cardinalities=[card] * n_cat, embed_dim=16,
+                        hidden_nodes=[128, 64],
+                        activations=["relu", "relu"])
+    params = init_params(jax.random.PRNGKey(0), spec)
+    opt = make_optimizer("ADAM", 1e-3)
+    opt_state = opt.init(params)
+    n_batches = n_rows // batch
+
+    from functools import partial
+
+    with jax.default_matmul_precision("bfloat16"):
+        @partial(jax.jit, static_argnames=("n_steps",),
+                 donate_argnums=(0, 1))
+        def run_steps(params, opt_state, n_steps: int):
+            def body(carry, i):
+                p, o = carry
+                b = (i % n_batches) * batch
+                xnb = jax.lax.dynamic_slice_in_dim(x_num, b, batch)
+                xcb = jax.lax.dynamic_slice_in_dim(x_cat, b, batch)
+                yb = jax.lax.dynamic_slice_in_dim(y, b, batch)
+                wb = jax.lax.dynamic_slice_in_dim(w, b, batch)
+                loss, grads = jax.value_and_grad(weighted_loss)(
+                    p, spec, xnb, xcb, yb[:, None], wb, 0.0)
+                delta, o = opt.update(grads, o, p)
+                p = jax.tree_util.tree_map(lambda a, d: a + d, p, delta)
+                return (p, o), loss
+            (p, o), losses = jax.lax.scan(
+                body, (params, opt_state),
+                jnp.arange(n_steps, dtype=jnp.int32))
+            return p, o, losses[-1]
+
+        params, opt_state, loss = run_steps(params, opt_state, steps)
+        float(loss)                                  # full warmup sync
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            params, opt_state, loss = run_steps(params, opt_state, steps)
+            float(loss)                              # value-forcing sync
+            best = max(best, steps * batch / (time.perf_counter() - t0))
+        return best
+
+
 def bench_eval(n_rows: int = 1 << 20, n_features: int = 256,
                n_models: int = 5) -> float:
     """Eval-stack throughput: a bagged NN scored + confusion-swept (the
@@ -256,6 +323,7 @@ def run_benchmark() -> Dict[str, Any]:
            lambda: bench_gbt_streamed(cache_budget=tail_budget),
            BASELINE_TREE_RATE)
     record("rf_train_throughput", bench_rf, BASELINE_TREE_RATE)
+    record("wdl_train_throughput", bench_wdl, BASELINE_ROWS_PER_SEC)
     record("eval_throughput", bench_eval, BASELINE_SCORE_RATE)
     extras["baselines"] = {
         "tree_rows_trees_per_sec_per_worker":
