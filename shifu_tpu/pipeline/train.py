@@ -522,16 +522,17 @@ class TrainProcessor(BasicProcessor):
                         else run_params
                     flat.append((res.valid_errors[j], trial_idx, spec,
                                  res.params[j], tp))
-            flat.sort(key=lambda t: t[0])
-            best = flat[0]
+            from ..train.grid_search import rank_and_report
+            by_idx = {t[1]: t for t in flat}
+            idxs = sorted(by_idx)
+            order = rank_and_report(
+                self.paths.tmp_dir, [by_idx[i][0] for i in idxs],
+                [by_idx[i][4] for i in idxs])
+            best = by_idx[idxs[order[0]]]
             log.info("grid search: best trial #%d valid error %.6f params %s",
                      best[1], best[0], best[4])
             nn_model.save_model(self.paths.model_path(0, ext),
                                 self._scoring_spec(best[2]), best[3])
-            report = [{"trial": t[1], "validError": float(t[0]),
-                       "params": {k: v for k, v in t[4].items()}} for t in flat]
-            with open(os.path.join(self.paths.tmp_dir, "grid_search.json"), "w") as f:
-                json.dump(report, f, indent=2, default=str)
             return
         run, spec, res, _ = results[0]
         ova_k = (spec.extra or {}).get("ova_classes")

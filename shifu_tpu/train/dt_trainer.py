@@ -1890,17 +1890,13 @@ def _run_tree_multi(proc, shards, col_nums, cat_mask, n_bins, alg,
                               res.trees)
 
     if is_gs:
-        order = sorted(range(len(results)),
-                       key=lambda i: results[i].valid_error)
+        from ..train.grid_search import rank_and_report
+        order = rank_and_report(proc.paths.tmp_dir,
+                                [r.valid_error for r in results], trials)
         best = order[0]
         log.info("grid search: best trial #%d valid error %.6f params %s",
                  best, results[best].valid_error, trials[best])
         save(results[best], 0, settings_list[best])
-        report = [{"trial": i, "validError": float(results[i].valid_error),
-                   "params": trials[i]} for i in order]
-        with open(os.path.join(proc.paths.tmp_dir, "grid_search.json"),
-                  "w") as f:
-            json.dump(report, f, indent=2, default=str)
     else:
         for i, res in enumerate(results):
             save(res, i, settings_list[i])

@@ -102,6 +102,23 @@ def tree_stackable_groups(trials: List[Dict[str, Any]]) -> List[List[int]]:
     return list(groups.values())
 
 
+def rank_and_report(tmp_dir: str, valid_errors: List[float],
+                    trial_params: List[Dict[str, Any]]) -> List[int]:
+    """THE grid-report contract (one place): rank trials by validation
+    error, write the ordered ``[{trial, validError, params}]`` list to
+    ``tmp_dir/grid_search.json``, return the ranked trial indices (best
+    first).  Consumers: NN/tree/WDL grid drivers + their tests."""
+    import json
+    import os
+    order = sorted(range(len(valid_errors)), key=lambda i: valid_errors[i])
+    report = [{"trial": i, "validError": float(valid_errors[i]),
+               "params": trial_params[i]} for i in order]
+    os.makedirs(tmp_dir, exist_ok=True)
+    with open(os.path.join(tmp_dir, "grid_search.json"), "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    return order
+
+
 def load_grid_config(path: str) -> List[Dict[str, Any]]:
     """Explicit trial list from ``train.gridConfigFile`` — one trial per
     line, ``key:value;key:value`` (reference ``GridSearch.java:119-153``);

@@ -457,13 +457,12 @@ def _wdl_settings(mc, p: Dict[str, Any]) -> TrainSettings:
 
 def run_wdl_training(proc) -> int:
     mc = proc.model_config
-    from ..train import grid_search
-    if mc.train.gridConfigFile or grid_search.is_grid_search(
-            mc.train.params or {}):
-        from ..config.validator import ValidationError
-        raise ValidationError(
-            ["grid search (list-valued train#params / gridConfigFile) is "
-             "not supported for WDL yet"])
+    trials = proc._trials(dict(mc.train.params or {}))
+    if len(trials) > 1:
+        return _run_wdl_grid(proc, trials)
+    # trials[0] == params when no grid axes; a 1-trial gridConfigFile or
+    # single-element list axis must still apply its expanded values
+    mc.train.params = trials[0]
     norm = Shards.open(proc.paths.norm_dir)
     clean = Shards.open(proc.paths.clean_dir)
     schema = norm.schema
@@ -546,6 +545,71 @@ def run_wdl_training(proc) -> int:
     log.info("train WDL done: %d model(s), valid errors %s (%d epochs)",
              len(res.params), np.round(res.valid_errors, 6).tolist(),
              res.epochs_run)
+    return 0
+
+
+def _run_wdl_grid(proc, trials) -> int:
+    """WDL grid search: trials MAY differ structurally (embed dim /
+    hidden shape change the program), so they run sequentially — the
+    reference's job-queue shape (``gs/GridSearch.java:62`` is
+    algorithm-agnostic).  Scalar-only grids could stack as vmapped
+    members the way the NN path does, but the WDL trainer has no
+    per-member hyper plumbing yet.
+    The ranked report lands in tmp/grid_search.json and the best trial's
+    model saves as model0 (the NN grid contract)."""
+    mc = proc.model_config
+    norm = Shards.open(proc.paths.norm_dir)
+    clean = Shards.open(proc.paths.clean_dir)
+    schema = norm.schema
+    by_num = {c.columnNum: c for c in proc.column_configs}
+    if hasattr(proc, "_use_streaming") and \
+            proc._use_streaming(norm, schema):
+        log.warning("WDL grid trials train in-RAM (structural trials "
+                    "can't stream-share); reduce trials or raise the "
+                    "memory budget if this OOMs")
+    ndata = norm.load_all()
+    cdata = clean.load_all()
+    x, y, w = ndata["x"], ndata["y"], ndata["w"]
+    bins = cdata["bins"].astype(np.int32)
+    x_num, x_cat, num_feat_idx, cat_col_idx, num_nums, cat_nums = \
+        split_planes(x, bins, schema, proc.column_configs)
+    results = []
+    with open(proc.paths.progress_path, "w") as pf:
+        for ti, p in enumerate(trials):
+            spec = _make_spec(x_num.shape[1], by_num, cat_nums, num_nums,
+                              num_feat_idx, cat_col_idx, p)
+            settings = _wdl_settings(mc, p)
+
+            def progress(epoch, tr, va, ti=ti):
+                pf.write(f"Trial [{ti}] Epoch #{epoch + 1} Train Error: "
+                         f"{tr:.6f} Validation Error: {va:.6f}\n")
+                pf.flush()
+
+            res = train_wdl_ensemble(
+                x_num, x_cat, y, w, spec, settings, bags=1,
+                valid_rate=mc.train.validSetRate,
+                sample_rate=mc.train.baggingSampleRate,
+                replacement=mc.train.baggingWithReplacement,
+                stratified=mc.train.stratifiedSample,
+                up_sample_weight=mc.train.upSampleWeight,
+                progress=progress)
+            results.append((float(res.valid_errors[0]), spec,
+                            res.params[0], p))
+            log.info("WDL grid trial %d/%d: valid err %.6f", ti + 1,
+                     len(trials), res.valid_errors[0])
+    from ..train.grid_search import rank_and_report
+    order = rank_and_report(proc.paths.tmp_dir,
+                            [r[0] for r in results],
+                            [r[3] for r in results])
+    best = order[0]
+    os.makedirs(proc.paths.models_dir, exist_ok=True)
+    for f in os.listdir(proc.paths.models_dir):
+        if f.startswith("model"):
+            os.remove(os.path.join(proc.paths.models_dir, f))
+    wdl_model.save_model(proc.paths.model_path(0, "wdl"),
+                         results[best][1], results[best][2])
+    log.info("WDL grid search: best trial #%d valid error %.6f params %s",
+             best, results[best][0], results[best][3])
     return 0
 
 

@@ -160,6 +160,35 @@ def test_wdl_mesh_ensemble_equivalence():
             np.testing.assert_allclose(l1, l8, rtol=1e-3, atol=1e-4)
 
 
+def test_wdl_pipeline_grid_search(prepared_set):
+    """List-valued WDL params train sequential trials, a ranked report
+    lands, and the best trial saves as model0.wdl."""
+    import json
+
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.pipeline.train import TrainProcessor
+
+    model_set = prepared_set
+    mcp = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.train.algorithm = "WDL"
+    mc.train.numTrainEpochs = 6
+    mc.train.params = {"NumHiddenNodes": [[8], [16]],
+                       "ActivationFunc": ["relu"],
+                       "EmbedDim": 4, "MiniBatchs": 512,
+                       "LearningRate": 0.02}
+    mc.save(mcp)
+    assert TrainProcessor(model_set, params={}).run() == 0
+    assert os.path.isfile(os.path.join(model_set, "models", "model0.wdl"))
+    report = json.load(open(os.path.join(model_set, "tmp",
+                                         "grid_search.json")))
+    assert len(report) == 2
+    errs = [r["validError"] for r in report]
+    assert errs == sorted(errs)
+    progress = open(os.path.join(model_set, "tmp", "train.progress")).read()
+    assert "Trial [1]" in progress
+
+
 def test_wdl_pipeline_streamed(model_set):
     """WDL trains streamed (forced) through the pipeline and still scores."""
     from shifu_tpu.config import ModelConfig, environment
