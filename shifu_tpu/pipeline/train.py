@@ -189,13 +189,15 @@ class TrainProcessor(BasicProcessor):
         K = len(mc.dataSet.posTags) if mc.is_multi_class() else 0
         ova = K > 2 and mc.train.multiClassifyMethod == \
             MultipleClassification.ONEVSALL
+        if ova and (mc.train.gridConfigFile or
+                    grid_search.is_grid_search(mc.train.params or {})):
+            # ONE guard for both the in-RAM and streamed paths
+            raise ValueError("grid search is not supported with "
+                             "ONEVSALL multi-class")
         shards = Shards.open(self.paths.norm_dir)
         if self._use_streaming(shards, shards.schema):
-            if ova:
-                log.warning("ONEVSALL has no streamed mode yet; "
-                            "training in-RAM")
-            else:
-                return self._train_nn_streamed(alg, shards, n_classes=K)
+            return self._train_nn_streamed(alg, shards, n_classes=K,
+                                           ova=ova)
         with self.phase("load_data"):
             data = shards.load_all()
         x, y, w = data["x"], data["y"], data["w"]
@@ -267,9 +269,6 @@ class TrainProcessor(BasicProcessor):
                     valid_w = np.tile(valid_w, (len(run), 1))
                 y_members = None
                 if ova:
-                    if is_gs:
-                        raise ValueError("grid search is not supported with "
-                                         "ONEVSALL multi-class")
                     # fan each bagging member out per class: member b*K+k
                     # trains class k's binary task on bag b's mask
                     b0 = train_w.shape[0]
@@ -345,7 +344,7 @@ class TrainProcessor(BasicProcessor):
         return n_rows * 4 * (width + 2) > budget
 
     def _train_nn_streamed(self, alg: Algorithm, shards: Shards,
-                           n_classes: int = 0) -> int:
+                           n_classes: int = 0, ova: bool = False) -> int:
         """Streamed counterpart of the in-RAM branch: windows flow through
         ``train_ensemble_streamed``; sampling masks are stateless hashes of
         the global row index (``data.streaming``)."""
@@ -374,8 +373,13 @@ class TrainProcessor(BasicProcessor):
             log.warning("streaming: `train -shuffle` ignored; use "
                         "`norm -shuffle` to reshuffle the materialized shards")
 
-        # members on the ensemble axis: k-fold overrides bagging count
+        K = n_classes if ova else 0
+        # members on the ensemble axis: k-fold overrides bagging count;
+        # OVA fans each bag out per class (member b*K + k trains class k,
+        # the in-RAM y_members convention)
         mesh_members = kfold if (not is_gs and kfold and kfold > 1) else bags
+        if ova:
+            mesh_members = mesh_members * K
         mesh = device_mesh(n_ensemble=mesh_members)
         data_size = mesh.shape["data"]
         budget = environment.get_int("shifu.train.memoryBudgetBytes", 1 << 31)
@@ -395,10 +399,12 @@ class TrainProcessor(BasicProcessor):
                 run_params = trials[run[0]] if is_gs else dict(params)
                 spec = self._make_spec(alg, d, run_params, column_nums,
                                        feature_names)
-                if n_classes > 2:
+                if n_classes > 2 and not ova:
                     spec.output_dim = n_classes
                     spec.output_activation = "softmax"
                     spec.extra["n_classes"] = n_classes
+                if ova:
+                    spec.extra.update({"ova_classes": K, "n_classes": K})
                 settings = settings_from_params(run_params, mc.train)
                 _apply_svm_objective(settings, alg, run_params)
                 if not is_gs:
@@ -418,6 +424,22 @@ class TrainProcessor(BasicProcessor):
                     replacement=mc.train.baggingWithReplacement,
                     up_sample_weight=up_w,
                     seed=settings.seed)
+                member_classes = None
+                if ova:
+                    # repeat each bag's masks per class; member b*K + k
+                    # binarizes class k ON DEVICE in the streamed trainer.
+                    # The K host copies of each bag mask cost K*4B/row vs
+                    # the window's d*4B/row feature transfer — a few
+                    # percent for typical K; indexing base masks on
+                    # device (m // K) would remove it if K grows
+                    base_fn, b0 = mask_fn, n_members
+                    def mask_fn(idx, targets, base_fn=base_fn):
+                        tm, vm = base_fn(idx, targets)
+                        return (np.repeat(tm, K, axis=0),
+                                np.repeat(vm, K, axis=0))
+                    member_classes = [k for _ in range(b0)
+                                      for k in range(K)]
+                    n_members = b0 * K
                 stream = ShardStream(shards, ("x", "y", "w"), window_rows)
                 init_list = self._continuous_init(spec, n_members, alg,
                                                   settings)
@@ -425,7 +447,8 @@ class TrainProcessor(BasicProcessor):
                     stream, spec, settings, n_members, mask_fn,
                     init_params_list=init_list,
                     progress=self._progress_fn(pf, run),
-                    checkpoint=self._checkpoint_fn(spec, alg), mesh=mesh)
+                    checkpoint=self._checkpoint_fn(spec, alg), mesh=mesh,
+                    member_classes=member_classes)
                 results.append((run, spec, res, run_params))
 
         self._write_models(results, alg, is_gs)

@@ -429,17 +429,19 @@ def train_ensemble_streamed(stream, spec: nn_model.NNModelSpec,
                             progress: Optional[ProgressFn] = None,
                             checkpoint: Optional[Callable[[int, List[Any]],
                                                           None]] = None,
-                            mesh=None) -> EnsembleResult:
+                            mesh=None,
+                            member_classes: Optional[List[int]] = None
+                            ) -> EnsembleResult:
     """See :func:`_train_ensemble_streamed_impl`; precision wrapper as in
     :func:`train_ensemble`."""
     if settings.matmul_precision:
         with jax.default_matmul_precision(settings.matmul_precision):
             return _train_ensemble_streamed_impl(
                 stream, spec, settings, bags, mask_fn, init_params_list,
-                progress, checkpoint, mesh)
+                progress, checkpoint, mesh, member_classes)
     return _train_ensemble_streamed_impl(
         stream, spec, settings, bags, mask_fn, init_params_list,
-        progress, checkpoint, mesh)
+        progress, checkpoint, mesh, member_classes)
 
 
 def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
@@ -447,7 +449,9 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
                             init_params_list: Optional[List[Any]] = None,
                             progress: Optional[ProgressFn] = None,
                             checkpoint: Optional[Callable[[int, List[Any]], None]] = None,
-                            mesh=None) -> EnsembleResult:
+                            mesh=None,
+                            member_classes: Optional[List[int]] = None
+                            ) -> EnsembleResult:
     """Out-of-core ensemble training: one pass over ``stream.windows()`` per
     epoch, dataset never resident anywhere (the
     ``MemoryDiskFloatMLDataSet.java`` role, done the streaming-SPMD way).
@@ -509,19 +513,31 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
         return jnp.stack([(per_row * mw).sum(), mw.sum(),
                           (per_row * vw).sum(), vw.sum()])
 
+    # OVA fan-out (``member_classes``): member m binarizes the shared
+    # class-id window against its OWN class on device — the streamed
+    # analogue of the in-RAM path's y_members (reference per-class jobs,
+    # ``TrainModelProcessor.java:684-714``)
+    cls_arr = None if member_classes is None else \
+        jnp.asarray(member_classes, jnp.float32)
+
     @jax.jit
     def grad_eval_window(stacked, grad_acc, stats_acc, xb, yb, tw, vw, rngs):
-        def one(params, mw, vwm, rng):
-            _, grads = jax.value_and_grad(_loss_sum)(params, xb, yb, mw, rng)
-            return grads, _eval_sums(params, xb, yb, mw, vwm)
-        grads, stats = jax.vmap(one)(stacked, tw, vw, rngs)
+        def one(params, mw, vwm, rng, ci):
+            ym = yb if cls_arr is None else (yb == ci).astype(yb.dtype)
+            _, grads = jax.value_and_grad(_loss_sum)(params, xb, ym, mw, rng)
+            return grads, _eval_sums(params, xb, ym, mw, vwm)
+        cis = jnp.zeros(tw.shape[0]) if cls_arr is None else cls_arr
+        grads, stats = jax.vmap(one)(stacked, tw, vw, rngs, cis)
         grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
         return grad_acc, stats_acc + stats
 
     @jax.jit
     def eval_window(stacked, stats_acc, xb, yb, tw, vw):
-        stats = jax.vmap(_eval_sums, in_axes=(0, None, None, 0, 0))(
-            stacked, xb, yb, tw, vw)
+        def one(params, mw, vwm, ci):
+            ym = yb if cls_arr is None else (yb == ci).astype(yb.dtype)
+            return _eval_sums(params, xb, ym, mw, vwm)
+        cis = jnp.zeros(tw.shape[0]) if cls_arr is None else cls_arr
+        stats = jax.vmap(one)(stacked, tw, vw, cis)
         return stats_acc + stats
 
     @jax.jit
@@ -548,9 +564,10 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
         yb = jax.lax.dynamic_slice_in_dim(yw, start, blen, axis=0)
         tw = jax.lax.dynamic_slice_in_dim(tww, start, blen, axis=1)
 
-        def one(params, ostate, mw, rng):
+        def one(params, ostate, mw, rng, ci):
+            ym = yb if cls_arr is None else (yb == ci).astype(yb.dtype)
             def norm_loss(p):
-                return _loss_sum(p, xb, yb, mw, rng) / jnp.maximum(mw.sum(), 1e-9) \
+                return _loss_sum(p, xb, ym, mw, rng) / jnp.maximum(mw.sum(), 1e-9) \
                     + l2 * sum((layer["w"] ** 2).sum() for layer in p) \
                     + l1 * sum(jnp.abs(layer["w"]).sum() for layer in p)
             grads = jax.grad(norm_loss)(params)
@@ -558,7 +575,9 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
             params = jax.tree_util.tree_map(lambda p, d: p + d * lr_scale,
                                             params, delta)
             return params, ostate
-        return jax.vmap(one, in_axes=(0, 0, 0, 0))(stacked, opt_state, tw, rngs)
+        cis = jnp.zeros(tw.shape[0]) if cls_arr is None else cls_arr
+        return jax.vmap(one, in_axes=(0, 0, 0, 0, 0))(stacked, opt_state,
+                                                      tw, rngs, cis)
 
     zero_grads = jax.device_put(
         jax.tree_util.tree_map(jnp.zeros_like, stacked), sh_ens)

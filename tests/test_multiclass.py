@@ -319,6 +319,35 @@ def test_e2e_gbt_ova_bagged(mc_model_set):
         assert os.path.isfile(os.path.join(mdir, f))
 
 
+def test_e2e_nn_ova_streamed(mc_model_set):
+    """NN ONEVSALL over streamed data: member b*K+k binarizes its class
+    on device inside the streamed trainer (closes the last 'no streamed
+    mode yet' fallback)."""
+    from shifu_tpu.config import ModelConfig, environment
+    from shifu_tpu.config.model_config import MultipleClassification
+    mcp = os.path.join(mc_model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.train.algorithm = "NN"
+    mc.train.multiClassifyMethod = MultipleClassification.ONEVSALL
+    mc.train.baggingNum = 2       # bags x classes: the b*K+k ordering and
+    mc.train.params = {"NumHiddenNodes": [12], "Propagation": "ADAM",   # the
+                       "LearningRate": 0.02}  # class_index stamp must agree
+    mc.save(mcp)
+    environment.set_property("shifu.train.streaming", "on")
+    try:
+        rep = _run_steps(mc_model_set)
+    finally:
+        environment.set_property("shifu.train.streaming", "auto")
+    from shifu_tpu.models import nn as nn_model
+    mdir = os.path.join(mc_model_set, "models")
+    models = sorted(f for f in os.listdir(mdir) if f.startswith("model"))
+    assert len(models) == 6                    # 2 bags x 3 classes
+    for i, f in enumerate(models):
+        spec, _ = nn_model.load_model(os.path.join(mdir, f))
+        assert spec.extra["class_index"] == i % 3   # b-major, class-minor
+    assert rep["accuracy"] > 0.8
+
+
 def test_e2e_nn_ova_multiclass(mc_model_set):
     from shifu_tpu.config import ModelConfig
     mcp = os.path.join(mc_model_set, "ModelConfig.json")
