@@ -21,8 +21,9 @@ always "bin ∈ left set" — scoring never touches raw floats.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional, Tuple
 
 import numpy as np
@@ -31,6 +32,87 @@ import jax
 import jax.numpy as jnp
 
 EPS = 1e-12
+
+
+# ------------------------------------------------- per-row select lowering
+# cap on the [N, n_nodes] one-hot operand width: past this the select
+# form's memory (O(N * nodes) f32, materialized for the matmul) outgrows
+# its speed win and the gather form takes over (deep trees: MaxDepth can
+# go to 20 per config meta — 2^20-wide one-hots would OOM any HBM)
+ONEHOT_MAX_NODES = 512
+
+
+@lru_cache(maxsize=None)
+def _onehot_traversal() -> bool:
+    """Row-level tree traversal lowering.  XLA serializes per-row gathers
+    (``x[idx]`` with a [N]-shaped ``idx``) on TPU — measured ~21 ns/row,
+    which put 64% of resident-GBT tree time into ``take_along_axis`` — so
+    on TPU the traversal selects through one-hot matmuls/reductions instead
+    (MXU/VPU, ~7x at bench shapes).  CPU keeps native gathers (they are
+    fast there and the tests run on the virtual CPU mesh).
+    ``SHIFU_TREE_ONEHOT=1/0`` overrides; tests pin both paths.  Resolved
+    ONCE per process (cached): traced programs bake the lowering in, so a
+    mid-process env flip could not reach already-jitted shapes anyway —
+    set it before the first traversal."""
+    env = os.environ.get("SHIFU_TREE_ONEHOT", "auto")
+    if env in ("0", "off"):
+        return False
+    if env in ("1", "force"):
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:                                  # pragma: no cover
+        return False
+
+
+def _use_onehot(n_nodes: int) -> bool:
+    return _onehot_traversal() and n_nodes <= ONEHOT_MAX_NODES
+
+
+def _sel_exact(oh, table):
+    """``table[idx]`` as a one-hot matmul (``oh`` = one_hot(idx)).  Exact:
+    the one-hot operand is 0/1 and every output element sums exactly one
+    term; HIGHEST precision keeps selected f32 values bit-identical to a
+    gather."""
+    return jnp.matmul(oh, table.astype(jnp.float32),
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+def _row_bin_of(bins, feat):
+    """``bins[i, feat[i]]`` without a gather: one-hot mask + reduce over
+    the (small) feature axis — fused elementwise on the VPU, exact for
+    integer bin ids."""
+    featoh = jax.nn.one_hot(jnp.maximum(feat, 0), bins.shape[1],
+                            dtype=jnp.float32)
+    return jnp.round((featoh * bins.astype(jnp.float32)).sum(1)) \
+        .astype(jnp.int32)
+
+
+def _goes_left(lmask, oh, row_bin):
+    """``lmask[node[i], row_bin[i]]`` without a gather: select the node's
+    bin-mask row by matmul (0/1 operands, exact at any precision), then
+    mask-reduce over bins."""
+    lrow = jnp.matmul(oh, lmask.astype(jnp.float32))      # [N, B]
+    binoh = jax.nn.one_hot(row_bin, lmask.shape[1], dtype=jnp.float32)
+    return (lrow * binoh).sum(1) > 0.5
+
+
+def _level_select(bins, node, feat, lmask):
+    """One traversal level's selects for already-clamped node ids [N]
+    (callers mask frozen rows themselves): returns (node_feat [N],
+    goes_left [N]).  The single place both lowerings live — `_descend`
+    (training descent) and `traverse_nodes` (predict/encode) must never
+    drift."""
+    if _use_onehot(feat.shape[0]):
+        # ONE [N, K] one-hot shared by the feature-id and mask-row selects
+        oh = jax.nn.one_hot(node, feat.shape[0], dtype=jnp.float32)
+        node_feat = jnp.round(_sel_exact(oh, feat)).astype(jnp.int32)
+        row_bin = _row_bin_of(bins, node_feat)
+        return node_feat, _goes_left(lmask, oh, row_bin)
+    node_feat = feat[node]
+    row_bin = jnp.take_along_axis(
+        bins, jnp.maximum(node_feat, 0)[:, None], axis=1)[:, 0]
+    return node_feat, lmask[node, row_bin]
 
 
 @dataclass
@@ -264,12 +346,11 @@ def cap_splits_by_leaves(gain, feat, lmask, nodes_cnt, max_leaves: int):
 # ------------------------------------------------------------------ grow
 def _descend(bins, node_idx, feat, lmask):
     """One level of worker tree traversal: rows whose node split move to a
-    child's level-local index; rows at leaves freeze at -1."""
-    node_feat = feat[jnp.maximum(node_idx, 0)]
+    child's level-local index; rows at leaves freeze at -1 (frozen rows
+    select node 0's values through the clamp, masked by ``active``)."""
+    node_feat, goes_left = _level_select(
+        bins, jnp.maximum(node_idx, 0), feat, lmask)
     active = (node_idx >= 0) & (node_feat >= 0)
-    row_bin = jnp.take_along_axis(
-        bins, jnp.maximum(node_feat, 0)[:, None], axis=1)[:, 0]
-    goes_left = lmask[jnp.maximum(node_idx, 0), row_bin]
     return jnp.where(active, 2 * node_idx + jnp.where(goes_left, 0, 1), -1)
 
 
@@ -357,19 +438,25 @@ def node_index_at_level(split_feat, left_mask, bins, level: int):
 
 
 # ---------------------------------------------------------------- predict
+def traverse_nodes(split_feat, left_mask, bins, depth: int):
+    """Terminal global node id per row after ``depth`` descents (shared by
+    predict and the `encode` step's leaf indexing)."""
+    n = bins.shape[0]
+    node = jnp.zeros(n, jnp.int32)           # global node ids, never -1
+    for _ in range(depth):
+        feat, goes_left = _level_select(bins, node, split_feat, left_mask)
+        child = jnp.where(goes_left, 2 * node + 1, 2 * node + 2)
+        node = jnp.where(feat >= 0, child, node)
+    return node
+
+
 @partial(jax.jit, static_argnames=("depth",))
 def predict_tree(split_feat, left_mask, leaf_value, bins, depth: int):
-    """Batched traversal: one gather per level over all rows."""
-    n = bins.shape[0]
-    node = jnp.zeros(n, jnp.int32)           # global node ids
-    for _ in range(depth):
-        feat = split_feat[node]
-        is_split = feat >= 0
-        row_bin = jnp.take_along_axis(
-            bins, jnp.maximum(feat, 0)[:, None], axis=1)[:, 0]
-        goes_left = left_mask[node, row_bin]
-        child = jnp.where(goes_left, 2 * node + 1, 2 * node + 2)
-        node = jnp.where(is_split, child, node)
+    """Batched traversal: one descent per level over all rows."""
+    node = traverse_nodes(split_feat, left_mask, bins, depth)
+    if _use_onehot(split_feat.shape[0]):
+        oh = jax.nn.one_hot(node, split_feat.shape[0], dtype=jnp.float32)
+        return _sel_exact(oh, leaf_value)    # [N] or [N, K] (multiclass)
     return leaf_value[node]
 
 

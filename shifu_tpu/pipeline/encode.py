@@ -16,7 +16,7 @@ from ..config.validator import ModelStep
 from ..data import DataSource
 from ..data.transform import DatasetTransformer
 from ..models import load_any
-from ..ops.tree import predict_tree
+from ..ops.tree import traverse_nodes
 from .processor import BasicProcessor
 
 log = logging.getLogger(__name__)
@@ -26,19 +26,9 @@ def leaf_indices(trees, bins: np.ndarray) -> np.ndarray:
     """[n, n_trees] terminal-node id per tree (same traversal as predict,
     returning the node instead of its value)."""
     b = jnp.asarray(bins, jnp.int32)
-    cols = []
-    for t in trees:
-        sf = jnp.asarray(t.split_feat)
-        lm = jnp.asarray(t.left_mask)
-        node = jnp.zeros(bins.shape[0], jnp.int32)
-        for _ in range(t.depth):
-            feat = sf[node]
-            is_split = feat >= 0
-            row_bin = jnp.take_along_axis(
-                b, jnp.maximum(feat, 0)[:, None], axis=1)[:, 0]
-            child = jnp.where(lm[node, row_bin], 2 * node + 1, 2 * node + 2)
-            node = jnp.where(is_split, child, node)
-        cols.append(np.asarray(node))
+    cols = [np.asarray(traverse_nodes(jnp.asarray(t.split_feat),
+                                      jnp.asarray(t.left_mask), b, t.depth))
+            for t in trees]
     return np.stack(cols, axis=1)
 
 

@@ -393,6 +393,43 @@ def _hist_mesh(mesh):
     return mesh if (mesh is not None and mesh.size > 1) else None
 
 
+def _wire_bins_dtype(n_bins: int):
+    """Narrowest host→device wire dtype that holds bin ids 0..n_bins-1.
+    The transfer is a real cost (the bench tunnel moves ~20 MB/s; real rigs
+    pay PCIe), and the reference itself stores worker rows as short[] bin
+    ids (``DTWorker.java:100``) — int32 on the wire is pure waste."""
+    if n_bins <= 127:
+        return np.int8
+    if n_bins <= 32767:
+        return np.int16
+    return np.int32
+
+
+@lru_cache(maxsize=None)
+def _widen_i32():
+    """Device-side widen after a narrow-wire transfer: HBM keeps int32 so
+    every executable (Pallas kernel included) sees the one layout; jit
+    propagates the input's mesh sharding."""
+    return jax.jit(lambda b: b.astype(jnp.int32))
+
+
+def _put_bins(mesh, bins, n_bins: int):
+    """bins → device: narrow dtype over the wire, int32 in HBM."""
+    bins = np.asarray(bins)
+    wire = _wire_bins_dtype(n_bins)
+    if wire != bins.dtype and bins.size:
+        # a stale clean dir / re-binned ColumnConfig mismatch must fail
+        # loudly, not wrap ids into negatives via the narrowing cast
+        lo, hi = int(bins.min()), int(bins.max())
+        if lo < 0 or hi >= n_bins:
+            raise ValueError(
+                f"bin ids [{lo}, {hi}] out of range for n_bins={n_bins} — "
+                "the materialized clean data does not match the current "
+                "ColumnConfig binning; re-run `norm`")
+    [b] = _device_put_rows(mesh, bins.astype(wire, copy=False))
+    return _widen_i32()(b)
+
+
 def _device_put_rows(mesh, *arrays):
     """Shard row-indexed arrays over the mesh's data axis (padding rows with
     zeros so the extent divides; padded rows carry zero weight by
@@ -433,8 +470,9 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
         else:
             init_score = prior
 
-    bins_d, y_d, tw_d, vw_d = _device_put_rows(
-        mesh, np.asarray(bins, np.int32), y64.astype(np.float32),
+    bins_d = _put_bins(mesh, bins, n_bins)
+    y_d, tw_d, vw_d = _device_put_rows(
+        mesh, y64.astype(np.float32),
         wt.astype(np.float32), wv.astype(np.float32))
     f = jnp.full(bins_d.shape[0], init_score, jnp.float32)
     cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
@@ -547,9 +585,9 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
     """Independent Poisson-bagged trees; out-of-bag rows score validation
     with the configured loss."""
     n, c = bins.shape
-    bins_d, y_d, w_d = _device_put_rows(
-        mesh, np.asarray(bins, np.int32), np.asarray(y, np.float32),
-        np.asarray(w, np.float32))
+    bins_d = _put_bins(mesh, bins, n_bins)
+    y_d, w_d = _device_put_rows(
+        mesh, np.asarray(y, np.float32), np.asarray(w, np.float32))
     cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
     hc = bool(np.asarray(cat).any())
     mc = settings.n_classes > 2
@@ -718,8 +756,8 @@ def train_gbt_bagged(bins, y, tw_m, vw_m, n_bins: int, cat_mask,
         else:
             init_scores.append(prior)
 
-    bins_d, y_d = _device_put_rows(mesh, np.asarray(bins, np.int32),
-                                   y64.astype(np.float32))
+    bins_d = _put_bins(mesh, bins, n_bins)
+    y_d, = _device_put_rows(mesh, y64.astype(np.float32))
     tw_d, vw_d = _device_put_members(mesh, tw_m, vw_m)
     n_pad = bins_d.shape[0]
     f = jnp.asarray(np.repeat(np.asarray(init_scores, np.float32)[:, None],
@@ -758,8 +796,8 @@ def train_rf_bagged(bins, y, w_m, n_bins: int, cat_mask,
     n, c = bins.shape
     B = len(settings_list)
     mc = s0.n_classes if s0.n_classes > 2 else 0
-    bins_d, y_d = _device_put_rows(mesh, np.asarray(bins, np.int32),
-                                   np.asarray(y, np.float32))
+    bins_d = _put_bins(mesh, bins, n_bins)
+    y_d, = _device_put_rows(mesh, np.asarray(y, np.float32))
     w_d, = _device_put_members(mesh, np.asarray(w_m, np.float32))
     n_pad = bins_d.shape[0]
     cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
@@ -1066,7 +1104,8 @@ def _stream_masks(idx: np.ndarray, n_valid: int, w_w: np.ndarray,
     return (w * ~vmask).astype(np.float32), (w * vmask).astype(np.float32)
 
 
-def _gbt_prepare(mesh, valid_rate: float, seed: int, y_transform=None):
+def _gbt_prepare(mesh, valid_rate: float, seed: int, n_bins: int,
+                 y_transform=None):
     """Window prepare hook for streamed GBT: hash train/valid masks once,
     arrays onto the device (mesh-sharded over the data axis).
     ``y_transform`` maps the raw window targets (one-vs-all binarization,
@@ -1079,9 +1118,8 @@ def _gbt_prepare(mesh, valid_rate: float, seed: int, y_transform=None):
         y = np.asarray(win.arrays["y"], np.float32)
         if y_transform is not None:
             y = np.asarray(y_transform(y), np.float32)
-        dev = _device_put_window(mesh, {
-            "bins": np.asarray(win.arrays["bins"], np.int32),
-            "y": y, "tw": tw, "vw": vw})
+        dev = _device_put_window(mesh, {"y": y, "tw": tw, "vw": vw})
+        dev["bins"] = _put_bins(mesh, win.arrays["bins"], n_bins)
         return PreparedWindow(win.start, win.n_valid, win.rows,
                               win.index, dev)
     return prep
@@ -1121,7 +1159,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                           _default_cache_budget() if cache_budget is None
                           else cache_budget,
                           _gbt_prepare(mesh, settings.valid_rate,
-                                       settings.seed, y_transform))
+                                       settings.seed, n_bins, y_transform))
 
     # warm pass: width probe + init-score sums in one sweep
     c = None
@@ -1289,7 +1327,7 @@ def _window_f(f: np.ndarray, win, mesh=None):
     return _shard_rows(out, mesh)
 
 
-def _rf_prepare(mesh, y_transform=None):
+def _rf_prepare(mesh, n_bins: int, y_transform=None):
     """Window prepare hook for streamed RF: zero weights past n_valid once,
     arrays onto the device (mesh-sharded over the data axis)."""
     from ..data.streaming import PreparedWindow
@@ -1300,9 +1338,8 @@ def _rf_prepare(mesh, y_transform=None):
         y = np.asarray(win.arrays["y"], np.float32)
         if y_transform is not None:
             y = np.asarray(y_transform(y), np.float32)
-        dev = _device_put_window(mesh, {
-            "bins": np.asarray(win.arrays["bins"], np.int32),
-            "y": y, "w": w})
+        dev = _device_put_window(mesh, {"y": y, "w": w})
+        dev["bins"] = _put_bins(mesh, win.arrays["bins"], n_bins)
         return PreparedWindow(win.start, win.n_valid, win.rows,
                               win.index, dev)
     return prep
@@ -1346,7 +1383,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
     cache = ResidentCache(stream,
                           _default_cache_budget() if cache_budget is None
                           else cache_budget,
-                          _rf_prepare(mesh, y_transform))
+                          _rf_prepare(mesh, n_bins, y_transform))
     c = None
     for win in stream.windows():      # peek the first window for the width;
         c = int(win.arrays["bins"].shape[1])   # cache warms during useful

@@ -272,3 +272,44 @@ def test_best_splits_has_cat_fast_path_equivalent():
         for a_, b_ in zip(slow, fast):
             np.testing.assert_allclose(np.asarray(a_), np.asarray(b_),
                                        atol=1e-6)
+
+
+def test_onehot_traversal_matches_gather(monkeypatch):
+    """The TPU one-hot (matmul-select) traversal must be bit-identical to
+    the gather form: every select sums exactly one term at HIGHEST
+    precision (``ops/tree.py:_onehot_traversal``)."""
+    from shifu_tpu.ops import tree as ot
+
+    rng = np.random.default_rng(7)
+    n, c, b, depth = 3000, 9, 8, 4
+    total = n_tree_nodes(depth)
+    bins = jnp.asarray(rng.integers(0, b, (n, c)), jnp.int32)
+    sf = rng.integers(0, c, total).astype(np.int32)
+    sf[total // 2:] = -1                       # bottom half leaves
+    sf[3] = -1                                 # an interior leaf too
+    lm = rng.random((total, b)) < 0.5
+    lv = rng.normal(size=total).astype(np.float32)
+    lv_mc = rng.normal(size=(total, 3)).astype(np.float32)  # multiclass
+
+    outs = {}
+    for mode in ("0", "1"):
+        monkeypatch.setenv("SHIFU_TREE_ONEHOT", mode)
+        ot._onehot_traversal.cache_clear()   # resolved once per process
+        assert ot._use_onehot(total) == (mode == "1")
+        # jit caches would otherwise reuse the other mode's lowering
+        pred = ot.predict_tree.__wrapped__(jnp.asarray(sf), jnp.asarray(lm),
+                                           jnp.asarray(lv), bins, depth)
+        pred_mc = ot.predict_tree.__wrapped__(
+            jnp.asarray(sf), jnp.asarray(lm), jnp.asarray(lv_mc), bins,
+            depth)
+        nodes = ot.traverse_nodes(jnp.asarray(sf), jnp.asarray(lm), bins,
+                                  depth)
+        nidx = ot.node_index_at_level.__wrapped__(
+            jnp.asarray(sf), jnp.asarray(lm), bins, depth)
+        outs[mode] = [np.asarray(x) for x in (pred, pred_mc, nodes, nidx)]
+    for a, o in zip(outs["0"], outs["1"]):
+        np.testing.assert_array_equal(a, o)
+    # leave the process-wide lowering choice as the default for the rest
+    # of the suite (the cache outlives monkeypatch's env restore)
+    monkeypatch.setenv("SHIFU_TREE_ONEHOT", "auto")
+    ot._onehot_traversal.cache_clear()
