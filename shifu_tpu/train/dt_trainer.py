@@ -833,26 +833,35 @@ def train_rf_bagged(bins, y, w_m, n_bins: int, cat_mask,
 # ------------------------------------------------------------- streaming
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level", "loss",
                                    "use_pallas", "mesh"))
-def _gbt_window_hist(bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
+def _gbt_window_hist(hist, bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
                      n_bins: int, level: int, loss: str,
                      use_pallas: bool = False, mesh=None):
     """Streamed level step: window rows find their level-local node by
     walking the partial tree, then scatter residual-gradient stats.  With
     mesh-sharded window rows the [nodes, C, B, S] sum is XLA's psum over
-    the data axis — the DTWorker→DTMaster merge on ICI."""
+    the data axis — the DTWorker→DTMaster merge on ICI.
+
+    ``hist`` (the running accumulator) is an INPUT so consecutive window
+    programs chain by data dependency: XLA's CPU in-process collectives
+    deadlock when two independent mesh programs overlap on a thread pool
+    smaller than 2x the device count (each program's ranks block in the
+    rendezvous holding pool threads the other program needs) — chained
+    programs can never overlap, on CPU or over a real tunnel."""
     node_idx = node_index_at_level(sf, lm, bins_w, level)
     grad = _loss_grad(y_w, f_w, loss)
     stats = jnp.stack([tw_w, tw_w * grad, tw_w * grad * grad], axis=1) \
         .astype(jnp.float32)
-    return build_histograms(bins_w, node_idx, stats, n_nodes, n_bins,
-                            use_pallas, mesh)
+    return hist + build_histograms(bins_w, node_idx, stats, n_nodes,
+                                   n_bins, use_pallas, mesh)
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level",
                                    "use_pallas", "mesh", "n_classes"))
-def _rf_window_hist(bins_w, y_w, w_w, bag_w, sf, lm, n_nodes: int,
+def _rf_window_hist(hist, bins_w, y_w, w_w, bag_w, sf, lm, n_nodes: int,
                     n_bins: int, level: int, use_pallas: bool = False,
                     mesh=None, n_classes: int = 0):
+    """``hist`` accumulator as input — see :func:`_gbt_window_hist` on why
+    window programs must chain."""
     bw_w = w_w * bag_w
     node_idx = node_index_at_level(sf, lm, bins_w, level)
     if n_classes > 2:      # NATIVE multiclass: per-class weight channels
@@ -861,24 +870,26 @@ def _rf_window_hist(bins_w, y_w, w_w, bag_w, sf, lm, n_nodes: int,
     else:
         stats = jnp.stack([bw_w, bw_w * y_w, bw_w * y_w * y_w], axis=1) \
             .astype(jnp.float32)
-    return build_histograms(bins_w, node_idx, stats, n_nodes, n_bins,
-                            use_pallas, mesh)
+    return hist + build_histograms(bins_w, node_idx, stats, n_nodes,
+                                   n_bins, use_pallas, mesh)
 
 
 @partial(jax.jit, static_argnames=("depth", "loss"))
-def _gbt_window_update(bins_w, y_w, tw_w, vw_w, f_w, sf, lm, lv, lr,
-                       depth: int, loss: str):
+def _gbt_window_update(sums_in, bins_w, y_w, tw_w, vw_w, f_w, sf, lm, lv,
+                       lr, depth: int, loss: str):
+    """``sums_in`` accumulator as input — see :func:`_gbt_window_hist` on
+    why window programs must chain."""
     pred = predict_tree(sf, lm, lv, bins_w, depth)
     f2 = f_w + lr * pred
     per = _per_row_loss(y_w, f2, loss)
     sums = jnp.stack([(per * tw_w).sum(), tw_w.sum(),
                       (per * vw_w).sum(), vw_w.sum()])
-    return f2, sums
+    return f2, sums_in + sums
 
 
 @partial(jax.jit, static_argnames=("depth", "loss", "n_classes"))
-def _rf_window_update(bins_w, y_w, w_w, bag_w, oob_sum_w, oob_cnt_w,
-                      sf, lm, lv, depth: int, loss: str,
+def _rf_window_update(sums_in, bins_w, y_w, w_w, bag_w, oob_sum_w,
+                      oob_cnt_w, sf, lm, lv, depth: int, loss: str,
                       n_classes: int = 0):
     """RF per-window oob accumulate + loss-consistent error sums on device
     (the round-2 host-numpy loop, jitted).  Multiclass (``n_classes > 2``):
@@ -896,7 +907,7 @@ def _rf_window_update(bins_w, y_w, w_w, bag_w, oob_sum_w, oob_cnt_w,
         wv = w_w * seen
         sums = jnp.stack([(per_v * wv).sum(), wv.sum(),
                           (per_t * w_w).sum(), w_w.sum()])
-        return oob_sum2, oob_cnt2, sums
+        return oob_sum2, oob_cnt2, sums_in + sums
     oob_sum2 = oob_sum_w + jnp.where(oob, pred, 0.0)
     oob_cnt2 = oob_cnt_w + oob.astype(oob_cnt_w.dtype)
     seen = oob_cnt2 > 0
@@ -912,7 +923,7 @@ def _rf_window_update(bins_w, y_w, w_w, bag_w, oob_sum_w, oob_cnt_w,
     wv = w_w * seen
     sums = jnp.stack([(per_v * wv).sum(), wv.sum(),
                       (per_t * w_w).sum(), w_w.sum()])
-    return oob_sum2, oob_cnt2, sums
+    return oob_sum2, oob_cnt2, sums_in + sums
 
 
 
@@ -1056,10 +1067,9 @@ def _rf_tree_fused(wins, fa, cat, min_instances, min_gain, n_bins: int,
     sums = jnp.zeros(4, jnp.float32)
     new_oob = []
     for bins_w, y_w, w_w, bag_w, os_w, oc_w in wins:
-        os2, oc2, s4 = _rf_window_update(
-            bins_w, y_w, w_w, bag_w, os_w, oc_w, sf, lm, lv, depth, loss,
-            n_classes)
-        sums = sums + s4
+        os2, oc2, sums = _rf_window_update(
+            sums, bins_w, y_w, w_w, bag_w, os_w, oc_w, sf, lm, lv, depth,
+            loss, n_classes)
         new_oob.append((os2, oc2))
     packed = jnp.concatenate([
         sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
@@ -1105,17 +1115,28 @@ def _stream_masks(idx: np.ndarray, n_valid: int, w_w: np.ndarray,
 
 
 def _gbt_prepare(mesh, valid_rate: float, seed: int, n_bins: int,
-                 y_transform=None):
+                 y_transform=None, mask_fn=None):
     """Window prepare hook for streamed GBT: hash train/valid masks once,
     arrays onto the device (mesh-sharded over the data axis).
     ``y_transform`` maps the raw window targets (one-vs-all binarization,
-    reference per-class jobs ``TrainModelProcessor.java:684-714``)."""
+    reference per-class jobs ``TrainModelProcessor.java:684-714``);
+    ``mask_fn(index, targets) -> (train_w, valid_w)`` overrides the plain
+    valid-rate split (grid/bagging members supply their member's
+    stateless bag/split, ``data.streaming.window_member_masks``)."""
     from ..data.streaming import PreparedWindow
 
     def prep(win):
-        tw, vw = _stream_masks(win.index, win.n_valid, win.arrays["w"],
-                               valid_rate, seed)
-        y = np.asarray(win.arrays["y"], np.float32)
+        y_raw = np.asarray(win.arrays["y"], np.float32)
+        if mask_fn is None:
+            tw, vw = _stream_masks(win.index, win.n_valid, win.arrays["w"],
+                                   valid_rate, seed)
+        else:
+            live = np.zeros(win.rows, np.float32)
+            live[:win.n_valid] = 1.0
+            w = np.asarray(win.arrays["w"], np.float32) * live
+            t, v = mask_fn(win.index, y_raw)
+            tw, vw = (w * t).astype(np.float32), (w * v).astype(np.float32)
+        y = y_raw
         if y_transform is not None:
             y = np.asarray(y_transform(y), np.float32)
         dev = _device_put_window(mesh, {"y": y, "tw": tw, "vw": vw})
@@ -1133,7 +1154,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                        start_history: Optional[List] = None,
                        mesh=None,
                        cache_budget: Optional[int] = None,
-                       y_transform=None) -> ForestResult:
+                       y_transform=None, mask_fn=None) -> ForestResult:
     """Out-of-core GBT over a ResidentCache: windows that fit the device
     budget are mesh-sharded HBM residents (re-sweeping them costs no IO);
     only the tail past the budget re-streams from disk per level.  The
@@ -1159,7 +1180,8 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                           _default_cache_budget() if cache_budget is None
                           else cache_budget,
                           _gbt_prepare(mesh, settings.valid_rate,
-                                       settings.seed, n_bins, y_transform))
+                                       settings.seed, n_bins, y_transform,
+                                       mask_fn))
 
     # warm pass: width probe + init-score sums in one sweep
     c = None
@@ -1265,9 +1287,9 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
             n_nodes = 1 << level
             hist = jnp.zeros((n_nodes, c, n_bins, 3), jnp.float32)
             for it in cache.items():
-                hist = hist + _gbt_window_hist(
-                    it.arrays["bins"], it.arrays["y"], it.arrays["tw"],
-                    window_f(it), sf, lm,
+                hist = _gbt_window_hist(
+                    hist, it.arrays["bins"], it.arrays["y"],
+                    it.arrays["tw"], window_f(it), sf, lm,
                     n_nodes, n_bins, level, settings.loss, up,
                     _hist_mesh(mesh))
             sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
@@ -1280,9 +1302,9 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
         # slice (they are disk-bound anyway)
         sums_dev = jnp.zeros(4, jnp.float32)
         for it in cache.items():
-            f2, s4 = _gbt_window_update(
-                it.arrays["bins"], it.arrays["y"], it.arrays["tw"],
-                it.arrays["vw"], window_f(it),
+            f2, sums_dev = _gbt_window_update(
+                sums_dev, it.arrays["bins"], it.arrays["y"],
+                it.arrays["tw"], it.arrays["vw"], window_f(it),
                 sf, lm, lv, settings.learning_rate, settings.depth,
                 settings.loss)
             if it.resident:
@@ -1290,7 +1312,6 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
             else:
                 s, e = it.start, it.start + it.n_valid
                 f[s:e] = np.asarray(f2)[:it.n_valid]
-            sums_dev = sums_dev + s4
         absorb_fused([np.asarray(jnp.concatenate([
             sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
             lv, fi_add, sums_dev]))])
@@ -1327,15 +1348,20 @@ def _window_f(f: np.ndarray, win, mesh=None):
     return _shard_rows(out, mesh)
 
 
-def _rf_prepare(mesh, n_bins: int, y_transform=None):
+def _rf_prepare(mesh, n_bins: int, y_transform=None, mask_fn=None):
     """Window prepare hook for streamed RF: zero weights past n_valid once,
-    arrays onto the device (mesh-sharded over the data axis)."""
+    arrays onto the device (mesh-sharded over the data axis).
+    ``mask_fn(index, targets) -> (train_w, _)``: bagging/grid members
+    multiply their member's stateless row sample into the weights (the
+    out-of-bag vote still validates within the member's rows)."""
     from ..data.streaming import PreparedWindow
 
     def prep(win):
         w = np.asarray(win.arrays["w"], np.float32).copy()
         w[win.n_valid:] = 0.0
         y = np.asarray(win.arrays["y"], np.float32)
+        if mask_fn is not None:
+            w *= mask_fn(win.index, y)[0].astype(np.float32)
         if y_transform is not None:
             y = np.asarray(y_transform(y), np.float32)
         dev = _device_put_window(mesh, {"y": y, "w": w})
@@ -1363,7 +1389,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                       start_history: Optional[List] = None,
                       mesh=None,
                       cache_budget: Optional[int] = None,
-                      y_transform=None) -> ForestResult:
+                      y_transform=None, mask_fn=None) -> ForestResult:
     """Out-of-core RF over a ResidentCache: hash-based Poisson bags per
     (tree, row) keep bagging stateless across sweeps; oob vote caches
     (2 host arrays, rows x 4B) carry validation across trees.  Windows
@@ -1383,7 +1409,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
     cache = ResidentCache(stream,
                           _default_cache_budget() if cache_budget is None
                           else cache_budget,
-                          _rf_prepare(mesh, n_bins, y_transform))
+                          _rf_prepare(mesh, n_bins, y_transform, mask_fn))
     c = None
     for win in stream.windows():      # peek the first window for the width;
         c = int(win.arrays["bins"].shape[1])   # cache warms during useful
@@ -1432,17 +1458,16 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
         sums_dev = jnp.zeros(4, jnp.float32)
         for it in cache.items():
             osw, ocw = window_oob(it)
-            os2, oc2, s4 = _rf_window_update(
-                it.arrays["bins"], it.arrays["y"], it.arrays["w"],
-                window_bag(ti, it), osw, ocw, sf, lm, lv, depth,
-                settings.loss, settings.n_classes)
+            os2, oc2, sums_dev = _rf_window_update(
+                sums_dev, it.arrays["bins"], it.arrays["y"],
+                it.arrays["w"], window_bag(ti, it), osw, ocw, sf, lm, lv,
+                depth, settings.loss, settings.n_classes)
             if it.resident:
                 it.arrays["oob"] = (os2, oc2)
             else:
                 s, e = it.start, it.start + it.n_valid
                 oob_sum[s:e] = np.asarray(os2)[:it.n_valid]
                 oob_cnt[s:e] = np.asarray(oc2)[:it.n_valid]
-            sums_dev = sums_dev + s4
         return sums_dev
 
     # resumed/continuous: replay oob accumulation for stored trees
@@ -1512,10 +1537,11 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
             n_nodes = 1 << level
             hist = jnp.zeros((n_nodes, c, n_bins, n_stats), jnp.float32)
             for it in cache.items():
-                hist = hist + _rf_window_hist(
-                    it.arrays["bins"], it.arrays["y"], it.arrays["w"],
-                    window_bag(ti, it), sf, lm, n_nodes, n_bins, level,
-                    up, _hist_mesh(mesh), settings.n_classes)
+                hist = _rf_window_hist(
+                    hist, it.arrays["bins"], it.arrays["y"],
+                    it.arrays["w"], window_bag(ti, it), sf, lm, n_nodes,
+                    n_bins, level, up, _hist_mesh(mesh),
+                    settings.n_classes)
             sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
                 hist, cat, fa, settings.impurity, settings.min_instances,
                 settings.min_gain, hc, level, settings.depth,
@@ -1830,16 +1856,19 @@ def _run_tree_multi(proc, shards, col_nums, cat_mask, n_bins, alg,
     from ..train.grid_search import tree_stackable_groups
 
     mc = proc.model_config
-    data = shards.load_all()
-    bins, y, w = data["bins"].astype(np.int32), data["y"], data["w"]
-    n = len(y)
     mesh = device_mesh(n_ensemble=1)
     streaming = proc._use_streaming(shards, shards.schema) \
         if hasattr(proc, "_use_streaming") else False
+    if streaming and kfold and kfold > 1:
+        log.warning("k-fold CV ignores streaming mode (the held-out fold "
+                    "vote needs full-data passes); folds train in-RAM")
+        streaming = False
     if streaming:
-        log.warning("tree grid/bagging ignores streaming mode; members "
-                    "train in-RAM sequentially when data exceeds the "
-                    "budget, use fewer trials or more memory")
+        bins = y = w = None
+    else:
+        data = shards.load_all()
+        bins, y, w = data["bins"].astype(np.int32), data["y"], data["w"]
+        n = len(y)
 
     base = settings_from_params(mc.train.params if not is_gs else trials[0],
                                 mc.train, alg)
@@ -1860,31 +1889,85 @@ def _run_tree_multi(proc, shards, col_nums, cat_mask, n_bins, alg,
             os.remove(os.path.join(proc.paths.models_dir, f))
     os.makedirs(proc.paths.tmp_dir, exist_ok=True)
 
-    def run_members(idxs: List[int]) -> List[ForestResult]:
-        sl = [settings_list[i] for i in idxs]
-        if base.early_stop and alg == Algorithm.GBT:
-            # early stop is a per-run decision loop; honor it sequentially
-            return [train_gbt(bins, y, w * (tw_m[i] + vw_m[i] > 0), n_bins,
-                              cat_mask, sl[j], mesh=mesh)
-                    for j, i in enumerate(idxs)]
-        if alg == Algorithm.GBT:
-            return train_gbt_bagged(bins, y, tw_m[idxs] * w[None, :],
-                                    vw_m[idxs] * w[None, :], n_bins,
-                                    cat_mask, sl, mesh=mesh)
-        return train_rf_bagged(bins, y, tw_m[idxs] * w[None, :], n_bins,
-                               cat_mask, sl, mesh=mesh)
-
-    # sampling masks: grid trials share ONE split (isolate the hypers);
-    # bagging/k-fold members each get their bag/fold (reference bagging
-    # sample rate / CV folds)
     rf_like = alg != Algorithm.GBT
-    if is_gs:
-        tw1, vw1 = _tree_member_masks(mc, n, 1, -1, rf_like, y, base.seed)
-        tw_m = np.repeat(tw1, len(trials), axis=0)
-        vw_m = np.repeat(vw1, len(trials), axis=0)
+    if streaming:
+        # out-of-core members: sequential full streamed runs — the
+        # reference's own shape (one Guagua job per bag/combo over the
+        # same HDFS data, SHIFU_TRAIN_BAGGING_INPARALLEL queue); each
+        # member's bag/split is a stateless hash of the global row index
+        from ..data.streaming import mask_fn_from_settings
+        B = len(settings_list)
+
+        def member_mm(i: int):
+            """(mask_fn, row) for member i: grid trials share ONE split
+            (isolate the hypers); GBT bags draw their own split from their
+            own seed (in-RAM ``distinct=True`` — else default-config bags
+            are identical forests); RF bags share masks and differ by the
+            per-tree Poisson bag seed."""
+            if is_gs:
+                return mask_fn_from_settings(
+                    1, valid_rate=0.0 if rf_like else mc.train.validSetRate,
+                    sample_rate=mc.train.baggingSampleRate,
+                    replacement=mc.train.baggingWithReplacement,
+                    seed=base.seed), 0
+            if rf_like:
+                return mask_fn_from_settings(
+                    B, valid_rate=0.0,
+                    sample_rate=mc.train.baggingSampleRate,
+                    replacement=mc.train.baggingWithReplacement,
+                    seed=base.seed), i
+            return mask_fn_from_settings(
+                1, valid_rate=mc.train.validSetRate,
+                sample_rate=mc.train.baggingSampleRate,
+                replacement=mc.train.baggingWithReplacement,
+                seed=base.seed + i), 0
+
+        def run_members(idxs: List[int]) -> List[ForestResult]:
+            out = []
+            for i in idxs:
+                mm, b = member_mm(i)
+
+                def mf(idx, tgt, mm=mm, b=b):
+                    t, v = mm(idx, tgt)
+                    return t[b], v[b]
+                stream = _tree_stream(shards, mesh)
+                s = settings_list[i]
+                if alg == Algorithm.GBT:
+                    out.append(train_gbt_streamed(
+                        stream, n_bins, cat_mask, s, mesh=mesh,
+                        mask_fn=mf))
+                else:
+                    out.append(train_rf_streamed(
+                        stream, n_bins, cat_mask, s, mesh=mesh,
+                        mask_fn=mf))
+            return out
     else:
-        tw_m, vw_m = _tree_member_masks(mc, n, bags, kfold, rf_like, y,
-                                        base.seed, distinct=True)
+        def run_members(idxs: List[int]) -> List[ForestResult]:
+            sl = [settings_list[i] for i in idxs]
+            if base.early_stop and alg == Algorithm.GBT:
+                # early stop is a per-run decision loop; honor it
+                # sequentially
+                return [train_gbt(bins, y, w * (tw_m[i] + vw_m[i] > 0),
+                                  n_bins, cat_mask, sl[j], mesh=mesh)
+                        for j, i in enumerate(idxs)]
+            if alg == Algorithm.GBT:
+                return train_gbt_bagged(bins, y, tw_m[idxs] * w[None, :],
+                                        vw_m[idxs] * w[None, :], n_bins,
+                                        cat_mask, sl, mesh=mesh)
+            return train_rf_bagged(bins, y, tw_m[idxs] * w[None, :], n_bins,
+                                   cat_mask, sl, mesh=mesh)
+
+        # sampling masks: grid trials share ONE split (isolate the
+        # hypers); bagging/k-fold members each get their bag/fold
+        # (reference bagging sample rate / CV folds)
+        if is_gs:
+            tw1, vw1 = _tree_member_masks(mc, n, 1, -1, rf_like, y,
+                                          base.seed)
+            tw_m = np.repeat(tw1, len(trials), axis=0)
+            vw_m = np.repeat(vw1, len(trials), axis=0)
+        else:
+            tw_m, vw_m = _tree_member_masks(mc, n, bags, kfold, rf_like, y,
+                                            base.seed, distinct=True)
 
     results: List[Optional[ForestResult]] = [None] * len(settings_list)
     with open(proc.paths.progress_path, "w") as pf:

@@ -161,3 +161,95 @@ def test_pipeline_gbt_kfold(prepared_set):
     mdir = os.path.join(model_set, "models")
     paths = sorted(p for p in os.listdir(mdir) if p.startswith("model"))
     assert paths == ["model0.gbt", "model1.gbt", "model2.gbt"]
+
+
+def test_pipeline_tree_grid_streamed(prepared_set):
+    """Grid trials train out-of-core too (reference: any algorithm x any
+    data size; previously streamed mode fell back to in-RAM with a
+    warning).  Trials run as sequential streamed jobs over tiny windows;
+    the grid report still ranks and model0 is the best trial."""
+    model_set = prepared_set
+    from shifu_tpu.config import ModelConfig, environment
+    from shifu_tpu.config.model_config import Algorithm
+    from shifu_tpu.pipeline.train import TrainProcessor
+
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.train.algorithm = Algorithm.GBT
+    mc.train.params = {"TreeNum": 3, "MaxDepth": 3, "Loss": "log",
+                       "LearningRate": [0.1, 0.3]}
+    mc.save(mc_path)
+    environment.set_property("shifu.train.streaming", "on")
+    environment.set_property("shifu.train.windowRows", "512")
+    try:
+        assert TrainProcessor(model_set, params={}).run() == 0
+    finally:
+        environment.set_property("shifu.train.streaming", "auto")
+        environment.set_property("shifu.train.windowRows", "")
+    assert os.path.isfile(os.path.join(model_set, "models", "model0.gbt"))
+    report = json.load(open(os.path.join(model_set, "tmp",
+                                         "grid_search.json")))
+    assert len(report) == 2
+    errs = [r["validError"] for r in report]
+    assert errs == sorted(errs) and all(np.isfinite(e) for e in errs)
+
+
+def test_pipeline_rf_bagging_streamed(prepared_set):
+    """Streamed bagging: B sequential streamed RF jobs, genuinely
+    different forests, one model file per bag."""
+    model_set = prepared_set
+    from shifu_tpu.config import ModelConfig, environment
+    from shifu_tpu.config.model_config import Algorithm
+    from shifu_tpu.models import tree as tree_model
+    from shifu_tpu.pipeline.train import TrainProcessor
+
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.train.algorithm = Algorithm.RF
+    mc.train.baggingNum = 2
+    mc.train.params = {"TreeNum": 3, "MaxDepth": 3,
+                       "FeatureSubsetStrategy": "HALF"}
+    mc.save(mc_path)
+    environment.set_property("shifu.train.streaming", "on")
+    environment.set_property("shifu.train.windowRows", "512")
+    try:
+        assert TrainProcessor(model_set, params={}).run() == 0
+    finally:
+        environment.set_property("shifu.train.streaming", "auto")
+        environment.set_property("shifu.train.windowRows", "")
+    mdir = os.path.join(model_set, "models")
+    paths = sorted(p for p in os.listdir(mdir) if p.startswith("model"))
+    assert paths == ["model0.rf", "model1.rf"]
+    _, trees0 = tree_model.load_model(os.path.join(mdir, "model0.rf"))
+    _, trees1 = tree_model.load_model(os.path.join(mdir, "model1.rf"))
+    assert any((a.split_feat != b.split_feat).any()
+               for a, b in zip(trees0, trees1))
+
+
+def test_pipeline_gbt_bagging_streamed_distinct(prepared_set):
+    """Streamed GBT bags draw per-member splits (in-RAM ``distinct``
+    semantics) — default-config bags must NOT be identical forests."""
+    model_set = prepared_set
+    from shifu_tpu.config import ModelConfig, environment
+    from shifu_tpu.config.model_config import Algorithm
+    from shifu_tpu.models import tree as tree_model
+    from shifu_tpu.pipeline.train import TrainProcessor
+
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.train.algorithm = Algorithm.GBT
+    mc.train.baggingNum = 2
+    mc.train.params = {"TreeNum": 3, "MaxDepth": 3, "Loss": "log"}
+    mc.save(mc_path)
+    environment.set_property("shifu.train.streaming", "on")
+    environment.set_property("shifu.train.windowRows", "512")
+    try:
+        assert TrainProcessor(model_set, params={}).run() == 0
+    finally:
+        environment.set_property("shifu.train.streaming", "auto")
+        environment.set_property("shifu.train.windowRows", "")
+    mdir = os.path.join(model_set, "models")
+    _, trees0 = tree_model.load_model(os.path.join(mdir, "model0.gbt"))
+    _, trees1 = tree_model.load_model(os.path.join(mdir, "model1.gbt"))
+    assert any(not np.array_equal(a.leaf_value, b.leaf_value)
+               for a, b in zip(trees0, trees1))
