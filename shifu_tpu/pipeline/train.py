@@ -349,8 +349,8 @@ class TrainProcessor(BasicProcessor):
         ``train_ensemble_streamed``; sampling masks are stateless hashes of
         the global row index (``data.streaming``)."""
         from ..config import environment
-        from ..data.streaming import (ShardStream, auto_window_rows,
-                                      mask_fn_from_settings)
+        from ..data.streaming import (ShardStream, mask_fn_from_settings,
+                                      stream_window_rows)
         from ..parallel.mesh import device_mesh
         from ..train.nn_trainer import train_ensemble_streamed
 
@@ -382,10 +382,7 @@ class TrainProcessor(BasicProcessor):
             mesh_members = mesh_members * K
         mesh = device_mesh(n_ensemble=mesh_members)
         data_size = mesh.shape["data"]
-        budget = environment.get_int("shifu.train.memoryBudgetBytes", 1 << 31)
-        window_rows = environment.get_int("shifu.train.windowRows", 0) or \
-            auto_window_rows(4 * (d + 2), budget)
-        window_rows = max(data_size, window_rows - window_rows % data_size)
+        window_rows = stream_window_rows(4 * (d + 2), data_size, shards)
         log.info("train %s STREAMED: %d rows x %d features, window %d rows",
                  alg.name, n_rows, d, window_rows)
 

@@ -1615,14 +1615,10 @@ def _tree_stream(shards, mesh):
     """A ShardStream with the tree trainers' window geometry (env knobs +
     data-axis rounding) — the ONE place that computes it (main streamed
     path and per-class OVA sweeps must agree)."""
-    from ..config import environment
-    from ..data.streaming import ShardStream, auto_window_rows
-    budget = environment.get_int("shifu.train.memoryBudgetBytes", 1 << 31)
-    data_size = mesh.shape["data"]
+    from ..data.streaming import ShardStream, stream_window_rows
     ncols = len(shards.schema.get("columnNums", [])) or 1
-    window_rows = environment.get_int("shifu.train.windowRows", 0) or \
-        auto_window_rows(2 * ncols + 8, budget, multiple=data_size)
-    window_rows += (-window_rows) % data_size
+    window_rows = stream_window_rows(2 * ncols + 8, mesh.shape["data"],
+                                     shards)
     return ShardStream(shards, ("bins", "y", "w"), window_rows)
 
 

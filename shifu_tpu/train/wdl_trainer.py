@@ -481,18 +481,13 @@ def run_wdl_training(proc) -> int:
             pf.flush()
 
         if streaming:
-            from ..config import environment
-            from ..data.streaming import (auto_window_rows,
-                                          mask_fn_from_settings)
+            from ..data.streaming import (mask_fn_from_settings,
+                                          stream_window_rows)
             mesh = meshlib.device_mesh(n_ensemble=bags)
             data_size = mesh.shape["data"]
             d = len(schema.get("outputNames") or [])
-            budget = environment.get_int("shifu.train.memoryBudgetBytes",
-                                         1 << 31)
-            window_rows = environment.get_int("shifu.train.windowRows", 0) \
-                or auto_window_rows(6 * (d + 2), budget)
-            window_rows = max(data_size,
-                              window_rows - window_rows % data_size)
+            window_rows = stream_window_rows(6 * (d + 2), data_size,
+                                             norm)
             planes = ZippedPlanes(norm, clean, window_rows)
             # plane split derives from schema + ColumnConfig alone — no
             # window read needed

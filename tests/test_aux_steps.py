@@ -80,6 +80,13 @@ def test_export_pmml_nn_onehot(model_set):
     mc_path = os.path.join(model_set, "ModelConfig.json")
     mc = ModelConfig.load(mc_path)
     mc.normalize.normType = NormType.ZSCALE_ONEHOT
+    # txn_id must be meta here: a onehot norm would expand the id column
+    # into ~4000 indicator inputs (real configs flag id-like columns; the
+    # unflagged fixture is fine for non-expanding norms)
+    meta = os.path.join(model_set, "meta.names")
+    with open(meta, "w") as f:
+        f.write("txn_id\n")
+    mc.dataSet.metaColumnNameFile = meta
     mc.save(mc_path)
     _run_pipeline(model_set)
     assert ExportProcessor(model_set, params={"type": "pmml"}).run() == 0
