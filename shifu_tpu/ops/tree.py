@@ -140,7 +140,8 @@ def build_histograms(bins, node_idx, stats, n_nodes: int, n_bins: int,
     """Per-row stats into (node, feature, bin) cells.
 
     bins: [N, C] int32; node_idx: [N] int32 level-local (-1 = inactive);
-    stats: [N, S] float32 (S stat channels, e.g. [w, w*y, w*y^2]).
+    stats: [N, S] float32 (S stat channels: [w, w*y] for binary/regression
+    trees; per-class weight counts for multiclass).
     Returns [n_nodes, C, n_bins, S].
 
     Two lowerings: ``use_pallas=True`` → MXU one-hot-matmul kernel
@@ -174,9 +175,10 @@ def build_histograms(bins, node_idx, stats, n_nodes: int, n_bins: int,
 
 
 # ------------------------------------------------------------- split scan
-def _impurity_score(w, wy, wy2, kind: str):
+def _impurity_score(w, wy, kind: str):
     """Per-partition purity score; gain = score_L + score_R - score_P.
-    variance uses sum^2/weight (equivalent to SSE reduction);
+    variance uses sum^2/weight (equivalent to SSE reduction — the sum of
+    squares cancels out of the gain, so histograms carry only (w, wy));
     entropy/gini use binary class counts (pos = wy, neg = w - wy)."""
     if kind == "variance":
         return wy * wy / jnp.maximum(w, EPS)
@@ -215,7 +217,7 @@ def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
                 n_classes: int = 0, has_cat: bool = True):
     """Best split per node from the level histogram.
 
-    hist: [nodes, C, B, 3] (w, wy, wy2) — or, when ``n_classes > 2``,
+    hist: [nodes, C, B, 2] (w, wy) — or, when ``n_classes > 2``,
     [nodes, C, B, K] per-class weight counts (multiclass NATIVE mode).
     cat_mask: [C] bool (categorical → bins sorted by response before the
     prefix scan); feat_active: [C] bool (feature sub-sampling, reference
@@ -233,9 +235,8 @@ def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
         # (equals pos rate for K=2)
         kidx = jnp.arange(n_classes, dtype=hist.dtype)
         wy = (cls * kidx).sum(-1)
-        wy2 = jnp.zeros_like(w)
     else:
-        w, wy, wy2 = hist[..., 0], hist[..., 1], hist[..., 2]
+        w, wy = hist[..., 0], hist[..., 1]
     n_nodes, c, b = w.shape
 
     # ---- per-(node,feat) bin order: natural for numeric, response-sorted
@@ -257,14 +258,12 @@ def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
                              lambda: nat_order)
         w_o = jnp.take_along_axis(w, order, axis=-1)
         wy_o = jnp.take_along_axis(wy, order, axis=-1)
-        wy2_o = jnp.take_along_axis(wy2, order, axis=-1)
     else:
-        w_o, wy_o, wy2_o = w, wy, wy2
+        w_o, wy_o = w, wy
 
     cw = jnp.cumsum(w_o, axis=-1)
     cwy = jnp.cumsum(wy_o, axis=-1)
-    cwy2 = jnp.cumsum(wy2_o, axis=-1)
-    tw, twy, twy2 = cw[..., -1:], cwy[..., -1:], cwy2[..., -1:]
+    tw, twy = cw[..., -1:], cwy[..., -1:]
 
     if multiclass:
         cls_o = jnp.take_along_axis(cls, order[..., None], axis=2) \
@@ -282,9 +281,9 @@ def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
         diff = wr * cwy - wl * (twy - cwy)
         gain = diff * diff / jnp.maximum(wl * wr * (wl + wr), EPS)
     else:
-        score_l = _impurity_score(cw, cwy, cwy2, impurity)
-        score_r = _impurity_score(tw - cw, twy - cwy, twy2 - cwy2, impurity)
-        score_p = _impurity_score(tw, twy, twy2, impurity)
+        score_l = _impurity_score(cw, cwy, impurity)
+        score_r = _impurity_score(tw - cw, twy - cwy, impurity)
+        score_p = _impurity_score(tw, twy, impurity)
         gain = score_l + score_r - score_p                 # [nodes, C, B]
 
     valid = (cw >= min_instances) & (tw - cw >= min_instances)
@@ -410,7 +409,7 @@ def grow_tree(bins, targets, weights, n_bins: int, depth: int,
     bins = jnp.asarray(bins, jnp.int32)
     t = jnp.asarray(targets, jnp.float32)
     wt = jnp.asarray(weights, jnp.float32)
-    stats = jnp.stack([wt, wt * t, wt * t * t], axis=1)
+    stats = jnp.stack([wt, wt * t], axis=1)
     cat = jnp.zeros(c, bool) if cat_mask is None else jnp.asarray(cat_mask)
     fa = jnp.ones(c, bool) if feat_active is None else jnp.asarray(feat_active)
     split_feat, left_mask, leaf_value, _ = grow_tree_jit(

@@ -167,8 +167,7 @@ def _gbt_round_impl(bins, y, tw, vw, f, fa, cat, lr, min_instances,
     score update → train/valid error sums.  Only the tree arrays and two
     scalars cross to the host."""
     grad = _loss_grad(y, f, loss)
-    stats = jnp.stack([tw, tw * grad, tw * grad * grad], axis=1) \
-        .astype(jnp.float32)
+    stats = jnp.stack([tw, tw * grad], axis=1).astype(jnp.float32)
     sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
                                     impurity, min_instances, min_gain,
                                     use_pallas=use_pallas,
@@ -257,7 +256,7 @@ def _rf_round_impl(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
         stats = bw[:, None] * jax.nn.one_hot(yi, n_classes,
                                              dtype=jnp.float32)
     else:
-        stats = jnp.stack([bw, bw * y, bw * y * y], axis=1) \
+        stats = jnp.stack([bw, bw * y], axis=1) \
             .astype(jnp.float32)
     sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
                                     impurity, min_instances, min_gain,
@@ -849,8 +848,7 @@ def _gbt_window_hist(hist, bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
     programs can never overlap, on CPU or over a real tunnel."""
     node_idx = node_index_at_level(sf, lm, bins_w, level)
     grad = _loss_grad(y_w, f_w, loss)
-    stats = jnp.stack([tw_w, tw_w * grad, tw_w * grad * grad], axis=1) \
-        .astype(jnp.float32)
+    stats = jnp.stack([tw_w, tw_w * grad], axis=1).astype(jnp.float32)
     return hist + build_histograms(bins_w, node_idx, stats, n_nodes,
                                    n_bins, use_pallas, mesh)
 
@@ -868,7 +866,7 @@ def _rf_window_hist(hist, bins_w, y_w, w_w, bag_w, sf, lm, n_nodes: int,
         stats = bw_w[:, None] * jax.nn.one_hot(
             y_w.astype(jnp.int32), n_classes, dtype=jnp.float32)
     else:
-        stats = jnp.stack([bw_w, bw_w * y_w, bw_w * y_w * y_w], axis=1) \
+        stats = jnp.stack([bw_w, bw_w * y_w], axis=1) \
             .astype(jnp.float32)
     return hist + build_histograms(bins_w, node_idx, stats, n_nodes,
                                    n_bins, use_pallas, mesh)
@@ -996,11 +994,11 @@ def _gbt_tree_fused(wins, fa, cat, lr, min_instances, min_gain,
     fi_add = jnp.zeros(c, jnp.float32)
     for level in range(depth + 1):
         n_nodes = 1 << level
-        hist = jnp.zeros((n_nodes, c, n_bins, 3), jnp.float32)
+        hist = jnp.zeros((n_nodes, c, n_bins, 2), jnp.float32)
         for bins_w, y_w, tw_w, _, f_w in wins:
             node_idx = node_index_at_level(sf, lm, bins_w, level)
             grad = _loss_grad(y_w, f_w, loss)
-            stats = jnp.stack([tw_w, tw_w * grad, tw_w * grad * grad],
+            stats = jnp.stack([tw_w, tw_w * grad],
                               axis=1).astype(jnp.float32)
             hist = hist + build_histograms(bins_w, node_idx, stats,
                                            n_nodes, n_bins, use_pallas,
@@ -1039,7 +1037,7 @@ def _rf_tree_fused(wins, fa, cat, min_instances, min_gain, n_bins: int,
     total = n_tree_nodes(depth)
     c = wins[0][0].shape[1]
     multiclass = n_classes > 2
-    n_stats = n_classes if multiclass else 3
+    n_stats = n_classes if multiclass else 2
     sf = jnp.full(total, -1, jnp.int32)
     lm = jnp.zeros((total, n_bins), bool)
     lv = jnp.zeros((total, n_classes) if multiclass else total, jnp.float32)
@@ -1055,7 +1053,7 @@ def _rf_tree_fused(wins, fa, cat, min_instances, min_gain, n_bins: int,
                 stats = bw[:, None] * jax.nn.one_hot(
                     y_w.astype(jnp.int32), n_classes, dtype=jnp.float32)
             else:
-                stats = jnp.stack([bw, bw * y_w, bw * y_w * y_w],
+                stats = jnp.stack([bw, bw * y_w],
                                   axis=1).astype(jnp.float32)
             hist = hist + build_histograms(bins_w, node_idx, stats,
                                            n_nodes, n_bins, use_pallas,
@@ -1285,7 +1283,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
         fi_add = jnp.zeros(c, jnp.float32)
         for level in range(settings.depth + 1):
             n_nodes = 1 << level
-            hist = jnp.zeros((n_nodes, c, n_bins, 3), jnp.float32)
+            hist = jnp.zeros((n_nodes, c, n_bins, 2), jnp.float32)
             for it in cache.items():
                 hist = _gbt_window_hist(
                     hist, it.arrays["bins"], it.arrays["y"],
@@ -1532,7 +1530,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
         lv = jnp.zeros((total, K) if mc else total, jnp.float32)
         nodes_cnt = jnp.int32(1)
         fi_add = jnp.zeros(c, jnp.float32)
-        n_stats = K if mc else 3
+        n_stats = K if mc else 2
         for level in range(settings.depth + 1):
             n_nodes = 1 << level
             hist = jnp.zeros((n_nodes, c, n_bins, n_stats), jnp.float32)
