@@ -1620,12 +1620,92 @@ def _tree_stream(shards, mesh):
     return ShardStream(shards, ("bins", "y", "w"), window_rows)
 
 
+def _streamed_bag_mask_fn(mc, rf_like: bool, bags: int, seed: int,
+                          member: int):
+    """Streamed bagged member ``member``'s (train_w, valid_w) mask
+    function — THE seed/row policy for out-of-core bags (single-class
+    bagging and OVA x bagging must never drift): GBT bags draw their own
+    validation split from their own seed (the in-RAM ``distinct=True``
+    semantics — else default-config bags are identical forests); RF bags
+    share masks and differ by the per-tree Poisson bag seed.  Stratified
+    validation degrades to Bernoulli (needs a global pass) — callers warn
+    once."""
+    from ..data.streaming import mask_fn_from_settings
+    if rf_like:
+        mm = mask_fn_from_settings(
+            bags, valid_rate=0.0,
+            sample_rate=mc.train.baggingSampleRate,
+            replacement=mc.train.baggingWithReplacement, seed=seed)
+        row = member
+    else:
+        mm = mask_fn_from_settings(
+            1, valid_rate=mc.train.validSetRate,
+            sample_rate=mc.train.baggingSampleRate,
+            replacement=mc.train.baggingWithReplacement,
+            seed=seed + member)
+        row = 0
+
+    def mf(idx, tgt):
+        t, v = mm(idx, tgt)
+        return t[row], v[row]
+    return mf
+
+
+def _warn_streamed_stratified(mc) -> None:
+    if mc.train.stratifiedSample:
+        log.warning("streaming: stratified validation degrades to "
+                    "Bernoulli split (needs a global pass)")
+
+
+def _train_streamed_member(alg, shards, mesh, n_bins, cat_mask,
+                           settings: DTSettings, mask_fn,
+                           y_transform=None) -> ForestResult:
+    """One sequential out-of-core member job (the reference's
+    one-Guagua-job-per-bag/combo queue shape)."""
+    stream = _tree_stream(shards, mesh)
+    if alg == Algorithm.GBT:
+        return train_gbt_streamed(stream, n_bins, cat_mask, settings,
+                                  mesh=mesh, y_transform=y_transform,
+                                  mask_fn=mask_fn)
+    return train_rf_streamed(stream, n_bins, cat_mask, settings,
+                             mesh=mesh, y_transform=y_transform,
+                             mask_fn=mask_fn)
+
+
+def _save_ova_bag_results(proc, results, alg, k: int, K: int,
+                          settings: DTSettings, n_bins, col_nums,
+                          feature_names, ext: str, pf) -> None:
+    """Persist one OVA class's B bagged forests + progress trail (member
+    ``b*K + k`` scores class k via its ``class_index`` extra)."""
+    for b, res in enumerate(results):
+        if alg != Algorithm.GBT:
+            res.spec_kwargs["algorithm"] = \
+                "RF" if alg != Algorithm.DT else "DT"
+        res.spec_kwargs.setdefault("extra", {}).update(
+            {"class_index": k, "n_classes": K})
+        spec = tree_model.TreeModelSpec(
+            n_trees=len(res.trees), depth=settings.depth,
+            n_bins=n_bins, column_nums=list(col_nums),
+            feature_names=feature_names, **res.spec_kwargs)
+        tree_model.save_model(
+            proc.paths.model_path(b * K + k, ext), spec, res.trees)
+        for ti, (tr, va) in enumerate(res.history):
+            pf.write(f"Class {k} Bag {b} Tree #{ti + 1} Train "
+                     f"Error: {tr:.6f} Validation Error: "
+                     f"{va:.6f}\n")
+    pf.flush()
+    log.info("train %s OVA class %d/%d: %d bagged forests, valid "
+             "errs %s", alg.name, k + 1, K, len(results),
+             [round(r.valid_error, 6) for r in results])
+
+
 def _run_tree_ova_bagged(proc, shards, col_nums, cat_mask, n_bins,
                          settings: DTSettings, alg, K: int,
-                         bags: int) -> int:
+                         bags: int, streaming: bool = False) -> int:
     """OVA x bagging: B independent forests per class (reference runs one
     FULL bagging job per class, ``TrainModelProcessor.java:684-714``).
-    Each class's B bags train as ONE vmapped multi-forest run; model files
+    Each class's B bags train as ONE vmapped multi-forest run (in-RAM) or
+    as B sequential streamed jobs (``streaming=True``); model files
     follow the NN OVA convention (member ``b*K + k`` scores class k via
     its ``class_index`` extra — the scorer averages contributors per
     class, so file numbering is immaterial).  ``train -resume`` skips
@@ -1641,9 +1721,14 @@ def _run_tree_ova_bagged(proc, shards, col_nums, cat_mask, n_bins,
         for f in os.listdir(proc.paths.models_dir):
             if f.startswith("model"):
                 os.remove(os.path.join(proc.paths.models_dir, f))
-    data = shards.load_all()
-    bins, y, w = data["bins"].astype(np.int32), data["y"], data["w"]
-    n = len(y)
+    if streaming:
+        _warn_streamed_stratified(mc)
+        bins = y = w = None
+        n = 0
+    else:
+        data = shards.load_all()
+        bins, y, w = data["bins"].astype(np.int32), data["y"], data["w"]
+        n = len(y)
     rf_like = alg != Algorithm.GBT
     settings_list = [replace(settings, seed=settings.seed + b)
                      for b in range(bags)]
@@ -1670,6 +1755,27 @@ def _run_tree_ova_bagged(proc, shards, col_nums, cat_mask, n_bins,
                 log.info("train %s OVA class %d/%d: all %d bags complete, "
                          "skipping", alg.name, k + 1, K, bags)
                 continue
+            if streaming:
+                # out-of-core: K x B sequential streamed jobs (the
+                # reference's per-class bagging job queue); the class
+                # binarizes on device via y_transform, the bag is a
+                # stateless hash of the global row index
+                yt = (lambda yv, k=k:
+                      (np.asarray(yv) == k).astype(np.float32))
+                results = [
+                    _train_streamed_member(
+                        alg, shards, mesh, n_bins, cat_mask,
+                        settings_list[b],
+                        _streamed_bag_mask_fn(mc, rf_like, bags,
+                                              settings.seed, b),
+                        y_transform=yt)
+                    for b in range(bags)]
+                np.save(fi_path(k), np.sum([r.feature_importance
+                                            for r in results], axis=0))
+                _save_ova_bag_results(proc, results, alg, k, K, settings,
+                                      n_bins, col_nums, feature_names,
+                                      ext, pf)
+                continue
             yk = (np.asarray(y) == k).astype(np.float32)
             tw_m, vw_m = _tree_member_masks(mc, n, bags, -1, rf_like, yk,
                                             settings.seed, distinct=True)
@@ -1690,26 +1796,8 @@ def _run_tree_ova_bagged(proc, shards, col_nums, cat_mask, n_bins,
                     settings_list, mesh=mesh)
             np.save(fi_path(k), np.sum([r.feature_importance
                                         for r in results], axis=0))
-            for b, res in enumerate(results):
-                if alg != Algorithm.GBT:
-                    res.spec_kwargs["algorithm"] = \
-                        "RF" if alg != Algorithm.DT else "DT"
-                res.spec_kwargs.setdefault("extra", {}).update(
-                    {"class_index": k, "n_classes": K})
-                spec = tree_model.TreeModelSpec(
-                    n_trees=len(res.trees), depth=settings.depth,
-                    n_bins=n_bins, column_nums=list(col_nums),
-                    feature_names=feature_names, **res.spec_kwargs)
-                tree_model.save_model(
-                    proc.paths.model_path(b * K + k, ext), spec, res.trees)
-                for ti, (tr, va) in enumerate(res.history):
-                    pf.write(f"Class {k} Bag {b} Tree #{ti + 1} Train "
-                             f"Error: {tr:.6f} Validation Error: "
-                             f"{va:.6f}\n")
-            pf.flush()
-            log.info("train %s OVA class %d/%d: %d bagged forests, valid "
-                     "errs %s", alg.name, k + 1, K, bags,
-                     [round(r.valid_error, 6) for r in results])
+            _save_ova_bag_results(proc, results, alg, k, K, settings,
+                                  n_bins, col_nums, feature_names, ext, pf)
     for k in range(K):      # FI sidecars survive resume-skipped classes
         if os.path.isfile(fi_path(k)):
             fi_total += np.load(fi_path(k))
@@ -1890,51 +1978,31 @@ def _run_tree_multi(proc, shards, col_nums, cat_mask, n_bins, alg,
         # same HDFS data, SHIFU_TRAIN_BAGGING_INPARALLEL queue); each
         # member's bag/split is a stateless hash of the global row index
         from ..data.streaming import mask_fn_from_settings
+        _warn_streamed_stratified(mc)
         B = len(settings_list)
 
-        def member_mm(i: int):
-            """(mask_fn, row) for member i: grid trials share ONE split
-            (isolate the hypers); GBT bags draw their own split from their
-            own seed (in-RAM ``distinct=True`` — else default-config bags
-            are identical forests); RF bags share masks and differ by the
-            per-tree Poisson bag seed."""
-            if is_gs:
-                return mask_fn_from_settings(
-                    1, valid_rate=0.0 if rf_like else mc.train.validSetRate,
-                    sample_rate=mc.train.baggingSampleRate,
-                    replacement=mc.train.baggingWithReplacement,
-                    seed=base.seed), 0
-            if rf_like:
-                return mask_fn_from_settings(
-                    B, valid_rate=0.0,
-                    sample_rate=mc.train.baggingSampleRate,
-                    replacement=mc.train.baggingWithReplacement,
-                    seed=base.seed), i
-            return mask_fn_from_settings(
-                1, valid_rate=mc.train.validSetRate,
+        def member_mask(i: int):
+            """Member i's (train_w, valid_w) window mask: grid trials
+            share ONE split (isolate the hypers); bagging members follow
+            the shared :func:`_streamed_bag_mask_fn` seed/row policy."""
+            if not is_gs:
+                return _streamed_bag_mask_fn(mc, rf_like, B, base.seed, i)
+            mm = mask_fn_from_settings(
+                1, valid_rate=0.0 if rf_like else mc.train.validSetRate,
                 sample_rate=mc.train.baggingSampleRate,
                 replacement=mc.train.baggingWithReplacement,
-                seed=base.seed + i), 0
+                seed=base.seed)
+
+            def mf(idx, tgt):
+                t, v = mm(idx, tgt)
+                return t[0], v[0]
+            return mf
 
         def run_members(idxs: List[int]) -> List[ForestResult]:
-            out = []
-            for i in idxs:
-                mm, b = member_mm(i)
-
-                def mf(idx, tgt, mm=mm, b=b):
-                    t, v = mm(idx, tgt)
-                    return t[b], v[b]
-                stream = _tree_stream(shards, mesh)
-                s = settings_list[i]
-                if alg == Algorithm.GBT:
-                    out.append(train_gbt_streamed(
-                        stream, n_bins, cat_mask, s, mesh=mesh,
-                        mask_fn=mf))
-                else:
-                    out.append(train_rf_streamed(
-                        stream, n_bins, cat_mask, s, mesh=mesh,
-                        mask_fn=mf))
-            return out
+            return [_train_streamed_member(alg, shards, mesh, n_bins,
+                                           cat_mask, settings_list[i],
+                                           member_mask(i))
+                    for i in idxs]
     else:
         def run_members(idxs: List[int]) -> List[ForestResult]:
             sl = [settings_list[i] for i in idxs]
@@ -2054,12 +2122,9 @@ def run_tree_training(proc) -> int:
         if ova and bags > 1 and not is_gs and not (kfold and kfold > 1):
             streaming = proc._use_streaming(shards, shards.schema) \
                 if hasattr(proc, "_use_streaming") else False
-            if streaming:
-                log.warning("OVA bagging trains in-RAM (no streamed "
-                            "bagged mode); reduce baggingNum or memory "
-                            "budget pressure if this OOMs")
             return _run_tree_ova_bagged(proc, shards, col_nums, cat_mask,
-                                        n_bins, settings, alg, K, bags)
+                                        n_bins, settings, alg, K, bags,
+                                        streaming=streaming)
         from ..config.validator import ValidationError
         what = "grid search / k-fold" if (is_gs or (kfold and kfold > 1)) \
             else "bagging with NATIVE multi-class"

@@ -381,3 +381,41 @@ def test_e2e_nn_native_multiclass_streamed(mc_model_set):
         environment.set_property("shifu.train.streaming", "")
     assert rep["accuracy"] > 0.85
     assert rep["macroAuc"] > 0.9
+
+
+def test_e2e_gbt_ova_bagged_streamed(mc_model_set):
+    """OVA x bagging composes with out-of-core streaming: K x B
+    sequential streamed jobs (class binarized on device, bag a stateless
+    row-index hash) — previously an in-RAM fallback with a warning."""
+    from shifu_tpu.config import ModelConfig, environment
+    from shifu_tpu.models import tree as tree_model
+    mcp = os.path.join(mc_model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mcp)
+    mc.train.algorithm = "GBT"
+    mc.train.baggingNum = 2
+    mc.train.params = {"TreeNum": 4, "MaxDepth": 3, "Loss": "log",
+                       "LearningRate": 0.2}
+    mc.save(mcp)
+    environment.set_property("shifu.train.streaming", "on")
+    environment.set_property("shifu.train.windowRows", "512")
+    try:
+        rep = _run_steps(mc_model_set)
+    finally:
+        environment.set_property("shifu.train.streaming", "auto")
+        environment.set_property("shifu.train.windowRows", "")
+    mdir = os.path.join(mc_model_set, "models")
+    models = sorted(f for f in os.listdir(mdir) if f.startswith("model"))
+    assert len(models) == 6                       # 2 bags x 3 classes
+    by_class = {}
+    for f in models:
+        spec, _ = tree_model.load_model(os.path.join(mdir, f))
+        by_class.setdefault(spec.extra["class_index"], []).append(f)
+    assert {len(v) for v in by_class.values()} == {2}
+    assert rep["accuracy"] > 0.8
+    # distinct per-bag splits (GBT per-member seeds) really differ
+    f0, f1 = by_class[0]
+    _, t0 = tree_model.load_model(os.path.join(mdir, f0))
+    _, t1 = tree_model.load_model(os.path.join(mdir, f1))
+    assert any((a.split_feat != b.split_feat).any() or
+               (a.leaf_value != b.leaf_value).any()
+               for a, b in zip(t0, t1))
