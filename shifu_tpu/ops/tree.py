@@ -439,13 +439,32 @@ def node_index_at_level(split_feat, left_mask, bins, level: int):
 # ---------------------------------------------------------------- predict
 def traverse_nodes(split_feat, left_mask, bins, depth: int):
     """Terminal global node id per row after ``depth`` descents (shared by
-    predict and the `encode` step's leaf indexing)."""
+    predict and the `encode` step's leaf indexing).
+
+    The one-hot lowering works LEVEL-LOCALLY (selects against the 2^l
+    nodes of level l, not all 2^(depth+1)-1 nodes) so the [N, K] one-hot
+    width — and with it the :data:`ONEHOT_MAX_NODES` fast-path bound —
+    grows with the widest level, keeping MXU selects through the
+    reference's common depth range."""
     n = bins.shape[0]
     node = jnp.zeros(n, jnp.int32)           # global node ids, never -1
-    for _ in range(depth):
-        feat, goes_left = _level_select(bins, node, split_feat, left_mask)
+    for level in range(depth):
+        k = 1 << level
+        if _use_onehot(k):
+            base = k - 1
+            feat_l = jax.lax.dynamic_slice_in_dim(split_feat, base, k)
+            lm_l = jax.lax.dynamic_slice_in_dim(left_mask, base, k, axis=0)
+            loc = node - base                # frozen rows: loc < 0
+            in_level = loc >= 0
+            feat, goes_left = _level_select(
+                bins, jnp.clip(loc, 0, k - 1), feat_l, lm_l)
+            is_split = in_level & (feat >= 0)
+        else:
+            feat, goes_left = _level_select(bins, node, split_feat,
+                                            left_mask)
+            is_split = feat >= 0
         child = jnp.where(goes_left, 2 * node + 1, 2 * node + 2)
-        node = jnp.where(feat >= 0, child, node)
+        node = jnp.where(is_split, child, node)
     return node
 
 

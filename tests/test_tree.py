@@ -274,14 +274,17 @@ def test_best_splits_has_cat_fast_path_equivalent():
                                        atol=1e-6)
 
 
-def test_onehot_traversal_matches_gather(monkeypatch):
+@pytest.mark.parametrize("depth", [4, 10])
+def test_onehot_traversal_matches_gather(monkeypatch, depth):
     """The TPU one-hot (matmul-select) traversal must be bit-identical to
     the gather form: every select sums exactly one term at HIGHEST
-    precision (``ops/tree.py:_onehot_traversal``)."""
+    precision (``ops/tree.py:_onehot_traversal``).  depth 4 covers the
+    fully one-hot path incl. the leaf-value select; depth 10 covers
+    level-local one-hots with the >ONEHOT_MAX_NODES leaf fallback."""
     from shifu_tpu.ops import tree as ot
 
     rng = np.random.default_rng(7)
-    n, c, b, depth = 3000, 9, 8, 4
+    n, c, b = 3000, 9, 8
     total = n_tree_nodes(depth)
     bins = jnp.asarray(rng.integers(0, b, (n, c)), jnp.int32)
     sf = rng.integers(0, c, total).astype(np.int32)
@@ -295,7 +298,10 @@ def test_onehot_traversal_matches_gather(monkeypatch):
     for mode in ("0", "1"):
         monkeypatch.setenv("SHIFU_TREE_ONEHOT", mode)
         ot._onehot_traversal.cache_clear()   # resolved once per process
-        assert ot._use_onehot(total) == (mode == "1")
+        # the widest level always keeps the fast path; at depth 10 the
+        # total node count (2047) exceeds the cap -> leaf select falls back
+        assert ot._use_onehot(1 << (depth - 1)) == (mode == "1")
+        assert ot._use_onehot(total) == (mode == "1" and depth == 4)
         # jit caches would otherwise reuse the other mode's lowering
         pred = ot.predict_tree.__wrapped__(jnp.asarray(sf), jnp.asarray(lm),
                                            jnp.asarray(lv), bins, depth)
