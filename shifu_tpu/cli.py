@@ -83,6 +83,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("eval", help="evaluate model on eval sets")
     sp.add_argument("-run", dest="run_eval", metavar="EVALSET", nargs="?", const="")
     sp.add_argument("-score", dest="score", metavar="EVALSET", nargs="?", const="")
+    sp.add_argument("-nosort", dest="nosort", action="store_true",
+                    help="-score: keep input row order (default sorts the "
+                    "score file by the selected score column — "
+                    "performanceScoreSelector, or the winning class score "
+                    "for multi-class; reference `eval -score`)")
     sp.add_argument("-perf", dest="perf", metavar="EVALSET", nargs="?", const="")
     sp.add_argument("-confmat", dest="confmat", metavar="EVALSET", nargs="?", const="")
     sp.add_argument("-norm", dest="norm_eval", metavar="EVALSET", nargs="?",
@@ -106,6 +111,9 @@ def build_parser() -> argparse.ArgumentParser:
                     "training set, '*' = all sets, a name = that eval set")
     sp = sub.add_parser("encode", help="encode dataset by tree-leaf index")
     sp.add_argument("-evalset", dest="evalset", default=None)
+    sp.add_argument("-ref", dest="ref_model", default=None, metavar="DIR",
+                    help="encode with the tree model of another model-set "
+                    "dir (reference ENCODE_REF_MODEL)")
 
     sp = sub.add_parser("combo", help="multi-algorithm ensemble")
     sp.add_argument("action", choices=["new", "init", "run", "eval"])

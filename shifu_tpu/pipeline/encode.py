@@ -41,15 +41,52 @@ class EncodeProcessor(BasicProcessor):
 
     def process(self) -> int:
         mc = self.model_config
-        model_path = self.paths.model_path(0, None)
+        ref = self.params.get("ref_model")
+        if ref:
+            # `encode -ref <dir>`: leaf-encode with ANOTHER model set's
+            # trained tree model (reference ENCODE_REF_MODEL — champion
+            # model crosses for stacking)
+            from ..config import ModelConfig
+            from ..config.path_finder import PathFinder
+            ref_cfg = os.path.join(ref, "ModelConfig.json")
+            if not os.path.isfile(ref_cfg):
+                log.error("-ref %s is not a model-set dir (no "
+                          "ModelConfig.json)", ref)
+                return 1
+            ref_mc = ModelConfig.load(ref_cfg)
+            model_path = PathFinder(ref_mc, ref).model_path(0, None)
+        else:
+            model_path = self.paths.model_path(0, None)
         if not os.path.isfile(model_path):
-            log.error("no model at %s — encode needs a trained GBT/RF", model_path)
+            log.error("no model at %s — encode needs a trained GBT/RF",
+                      model_path)
             return 1
         model = load_any(model_path)
         if getattr(model, "input_kind", "norm") != "bins":
             log.error("encode requires a tree model (GBT/RF); found %s",
                       type(model).__name__)
             return 1
+        if ref:
+            # the model's split_feat/bin ids index THIS set's clean plane:
+            # a ref model trained on a different column selection or
+            # binning would emit silent garbage — require exact layout
+            # agreement (reference stacking assumes shared ColumnConfig)
+            from ..data.transform import model_input_columns
+            ours = [c.columnNum for c in
+                    model_input_columns(mc, self.column_configs)]
+            want = list(model.spec.column_nums or [])
+            our_bins = max((c.num_bins() + 1 for c in self.column_configs
+                            if c.columnNum in set(ours)), default=2)
+            if want and want != ours:
+                log.error("-ref model was trained on columns %s but this "
+                          "set's model inputs are %s — encode needs the "
+                          "same ColumnConfig selection/order", want, ours)
+                return 1
+            if model.spec.n_bins > our_bins:
+                log.error("-ref model uses %d bins but this set's binning "
+                          "yields %d — re-run stats/norm with matching "
+                          "binning", model.spec.n_bins, our_bins)
+                return 1
 
         evalset = self.params.get("evalset")
         if evalset:

@@ -184,6 +184,17 @@ class EvalProcessor(BasicProcessor):
                  ev.name, len(scores), int(targets.sum()),
                  int((1 - targets).sum()), n_models)
         if action == "score":
+            # reference `eval -score` sorts the score file by model score
+            # for review unless -nosort (EvalModelProcessor NOSORT; the
+            # cluster version runs an ORDER BY job)
+            if not self.params.get("nosort"):
+                with open(score_path) as f:
+                    header = f.readline()
+                    rows = f.readlines()
+                order = np.argsort(-scores, kind="stable")
+                with open(score_path, "w") as f:
+                    f.write(header)
+                    f.writelines(rows[i] for i in order)
             return 0
 
         # host sweep by choice: the per-row score CSV above already forced
@@ -263,6 +274,17 @@ class EvalProcessor(BasicProcessor):
         log.info("eval %s: scored %d records over %d classes with %d "
                  "model(s)", ev.name, len(t), len(tags), len(scorer.models))
         if action == "score":
+            if not self.params.get("nosort"):
+                # same default as the binary path: sorted for review,
+                # multiclass keyed by the winning class's score
+                path = self.paths.eval_score_path(ev.name)
+                with open(path) as f:
+                    header = f.readline()
+                    rows = f.readlines()
+                order = np.argsort(-cs.max(axis=1), kind="stable")
+                with open(path, "w") as f:
+                    f.write(header)
+                    f.writelines(rows[i] for i in order)
             return 0
         rep = evaluate_multiclass(cs, t, wgt)
         rep["tags"] = tags

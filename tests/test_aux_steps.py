@@ -422,3 +422,53 @@ def test_combo_resume_skips_trained(model_set):
     t0 = os.path.getmtime(m0)
     assert run_combo(model_set, "run", None, resume=True) == 0
     assert os.path.getmtime(m0) == t0          # untouched: skipped
+
+
+def test_encode_ref_model(prepared_set, tmp_path):
+    """`encode -ref <dir>`: leaf-encode with ANOTHER model set's tree
+    model (reference ModelDataEncodeProcessor ENCODE_REF_MODEL)."""
+    import shutil
+    model_set = prepared_set
+    from shifu_tpu.pipeline.encode import EncodeProcessor
+    _train_prepared(model_set, alg="RF",
+                    tree_params={"TreeNum": 3, "MaxDepth": 3})
+    # champion set = a copy holding the trained model; the working set's
+    # own models are deleted so only -ref can supply one
+    champ = str(tmp_path / "champion")
+    shutil.copytree(model_set, champ)
+    shutil.rmtree(os.path.join(model_set, "models"))
+    assert EncodeProcessor(model_set, params={}).run() == 1
+    assert EncodeProcessor(model_set,
+                           params={"ref_model": champ}).run() == 0
+    enc = os.path.join(model_set, "tmp", "EncodedData")
+    lines = open(enc).read().splitlines()
+    assert lines[0] == "target|tree0|tree1|tree2"
+    assert len(lines) == 4001
+
+
+def test_eval_score_sorted_and_nosort(prepared_set):
+    """`eval -score` writes the score file sorted by mean score
+    (reference sorts unless -nosort); -nosort keeps input order."""
+    model_set = prepared_set
+    from shifu_tpu.pipeline.evaluate import EvalProcessor
+    _train_prepared(model_set)
+
+    def means(path):
+        rows = open(path).read().splitlines()[1:]
+        return [float(r.split("|")[2]) for r in rows]
+
+    assert EvalProcessor(model_set, params={"score": ""}).run() == 0
+    score_path = os.path.join(model_set, "evals", "Eval1", "EvalScore")
+    hits = []
+    for root, _, files in os.walk(model_set):
+        for f in files:
+            if f.startswith("EvalScore"):
+                hits.append(os.path.join(root, f))
+    assert hits
+    sorted_means = means(hits[0])
+    assert sorted_means == sorted(sorted_means, reverse=True)
+    assert EvalProcessor(model_set,
+                         params={"score": "", "nosort": True}).run() == 0
+    unsorted_means = means(hits[0])
+    assert unsorted_means != sorted_means     # input order preserved
+    assert sorted(unsorted_means, reverse=True) == sorted_means
