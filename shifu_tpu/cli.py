@@ -131,6 +131,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("switch", help="restore a saved model-set version")
     sp.add_argument("name")
     sub.add_parser("history", help="list saved model-set versions")
+    sub.add_parser("show", help="print the current model-set version")
+    sp = sub.add_parser("delete", help="delete a saved model-set version")
+    sp.add_argument("name")
+    sp = sub.add_parser("cp", help="clone this model set's configs into a "
+                        "new scaffold dir")
+    sp.add_argument("dest")
     return p
 
 
@@ -207,6 +213,15 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
     if cmd == "save":
         from .pipeline.manage import save_version
         return save_version(args.dir, args.name)
+    if cmd == "show":
+        from .pipeline.manage import show_current
+        return show_current(args.dir)
+    if cmd == "delete":
+        from .pipeline.manage import delete_version
+        return delete_version(args.dir, args.name)
+    if cmd == "cp":
+        from .pipeline.manage import copy_model_set
+        return copy_model_set(args.dir, args.dest)
     if cmd == "switch":
         from .pipeline.manage import switch_version
         return switch_version(args.dir, args.name)

@@ -70,23 +70,31 @@ class EncodeProcessor(BasicProcessor):
             # the model's split_feat/bin ids index THIS set's clean plane:
             # a ref model trained on a different column selection or
             # binning would emit silent garbage — require exact layout
-            # agreement (reference stacking assumes shared ColumnConfig)
+            # agreement, per column (reference stacking assumes a shared
+            # ColumnConfig)
+            from ..config.column_config import load_column_configs
             from ..data.transform import model_input_columns
             ours = [c.columnNum for c in
                     model_input_columns(mc, self.column_configs)]
             want = list(model.spec.column_nums or [])
-            our_bins = max((c.num_bins() + 1 for c in self.column_configs
-                            if c.columnNum in set(ours)), default=2)
             if want and want != ours:
                 log.error("-ref model was trained on columns %s but this "
                           "set's model inputs are %s — encode needs the "
                           "same ColumnConfig selection/order", want, ours)
                 return 1
-            if model.spec.n_bins > our_bins:
-                log.error("-ref model uses %d bins but this set's binning "
-                          "yields %d — re-run stats/norm with matching "
-                          "binning", model.spec.n_bins, our_bins)
-                return 1
+            ref_cc_path = os.path.join(ref, "ColumnConfig.json")
+            if os.path.isfile(ref_cc_path):
+                ref_bins = {c.columnNum: c.num_bins()
+                            for c in load_column_configs(ref_cc_path)}
+                mine = {c.columnNum: c.num_bins()
+                        for c in self.column_configs}
+                bad = [cn for cn in (want or ours)
+                       if ref_bins.get(cn) != mine.get(cn)]
+                if bad:
+                    log.error("-ref model's binning disagrees on columns "
+                              "%s (per-column bin counts differ) — re-run "
+                              "stats/norm with matching binning", bad)
+                    return 1
 
         evalset = self.params.get("evalset")
         if evalset:

@@ -440,6 +440,21 @@ def test_encode_ref_model(prepared_set, tmp_path):
     assert EncodeProcessor(model_set, params={}).run() == 1
     assert EncodeProcessor(model_set,
                            params={"ref_model": champ}).run() == 0
+    # a per-column binning mismatch must be rejected loudly (silent
+    # garbage leaf ids otherwise)
+    import json as _json
+    ref_cc = os.path.join(champ, "ColumnConfig.json")
+    cc = _json.load(open(ref_cc))
+    for c in cc:
+        if (c.get("columnBinning") or {}).get("binBoundary"):
+            c["columnBinning"]["binBoundary"] = \
+                c["columnBinning"]["binBoundary"][:-1]
+            break
+    _json.dump(cc, open(ref_cc, "w"))
+    assert EncodeProcessor(model_set,
+                           params={"ref_model": champ}).run() == 1
+    assert EncodeProcessor(model_set,
+                           params={"ref_model": "/nonexistent"}).run() == 1
     enc = os.path.join(model_set, "tmp", "EncodedData")
     lines = open(enc).read().splitlines()
     assert lines[0] == "target|tree0|tree1|tree2"
@@ -458,7 +473,6 @@ def test_eval_score_sorted_and_nosort(prepared_set):
         return [float(r.split("|")[2]) for r in rows]
 
     assert EvalProcessor(model_set, params={"score": ""}).run() == 0
-    score_path = os.path.join(model_set, "evals", "Eval1", "EvalScore")
     hits = []
     for root, _, files in os.walk(model_set):
         for f in files:

@@ -81,6 +81,24 @@ def test_manage_save_switch(model_set, caplog):
     assert switch_version(model_set, "v2") == 0
     assert ModelConfig.load(mc_path).train.numTrainEpochs == 777
     assert switch_version(model_set, "nope") == 1
+    # show / delete / cp (reference ModelAction SHOW/DELETE + `shifu cp`)
+    from shifu_tpu.pipeline.manage import (copy_model_set, delete_version,
+                                           show_current)
+    import logging
+    with caplog.at_level(logging.INFO):
+        assert show_current(model_set) == 0
+    assert "current version: v2" in caplog.text
+    assert delete_version(model_set, "v1") == 0
+    assert "v1" not in list_versions(model_set)
+    assert delete_version(model_set, "v1") == 1
+    dst = os.path.join(os.path.dirname(model_set), "clone")
+    assert copy_model_set(model_set, dst) == 0
+    clone_mc = ModelConfig.load(os.path.join(dst, "ModelConfig.json"))
+    assert clone_mc.train.numTrainEpochs == 777       # config carried over
+    assert clone_mc.basic.name == "clone"
+    assert os.path.isfile(os.path.join(dst, "ColumnConfig.json"))
+    assert not os.path.isdir(os.path.join(dst, "models"))  # configs only
+    assert copy_model_set(model_set, dst) == 1        # refuses overwrite
 
 
 def test_checkpoint_save_restore_roundtrip(tmp_path):
