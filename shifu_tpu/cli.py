@@ -39,7 +39,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("new", help="create a new model-set scaffold")
     sp.add_argument("name")
-    sp.add_argument("--alg", default="NN", help="NN|LR|GBT|RF|DT|WDL|SVM")
+    sp.add_argument("--alg", "-t", default="NN", dest="alg",
+                    help="NN|LR|GBT|RF|DT|WDL|SVM (reference `new -t`)")
+    sp.add_argument("-m", dest="description", default=None,
+                    help="model-set description (reference `new -m`)")
 
     sub.add_parser("init", help="build initial ColumnConfig.json from header")
 
@@ -168,7 +171,8 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
     cmd = args.command
     if cmd == "new":
         from .pipeline.create import create_new_model
-        create_new_model(args.name, base_dir=args.dir, algorithm=args.alg)
+        create_new_model(args.name, base_dir=args.dir, algorithm=args.alg,
+                         description=args.description)
         return 0
     if cmd == "init":
         from .pipeline.create import InitProcessor

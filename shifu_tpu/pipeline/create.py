@@ -25,14 +25,16 @@ from .processor import BasicProcessor
 log = logging.getLogger(__name__)
 
 
-def create_new_model(name: str, base_dir: str = ".", algorithm: str = "NN") -> str:
-    """``shifu-tpu new <name>``: scaffold the model-set directory."""
+def create_new_model(name: str, base_dir: str = ".", algorithm: str = "NN",
+                     description: Optional[str] = None) -> str:
+    """``shifu-tpu new <name>``: scaffold the model-set directory
+    (reference ``new -t <alg> -m <description>``)."""
     model_dir = os.path.join(base_dir, name)
     os.makedirs(model_dir, exist_ok=True)
     mc_path = os.path.join(model_dir, "ModelConfig.json")
     if os.path.isfile(mc_path):
         raise FileExistsError(f"{mc_path} already exists")
-    mc = ModelConfig.create(name)
+    mc = ModelConfig.create(name, description)
     from ..config.jsonbean import parse_enum
     from ..config.model_config import Algorithm
     mc.train.algorithm = parse_enum(Algorithm, algorithm)
