@@ -26,11 +26,15 @@ import numpy as np
 MEASURED_CPU_ROWS_PER_SEC = 28850.5          # f64 backprop (2026-07-29)
 MEASURED_CPU_TREE_ROWS_TREES_PER_SEC = 43068.1   # np.add.at hist GBT (07-30)
 MEASURED_CPU_SCORE_ROWS_PER_SEC = 1505.9     # per-row bagged scorer (07-30)
+MEASURED_CPU_STATS_ROWS_PER_SEC = 30872.1    # np.add.at stats pass, 256 cols
+                                             # x 4096 buckets (07-31)
 BASELINE_CLUSTER_WORKERS = 100          # north-star cluster size (BASELINE.json)
 BASELINE_ROWS_PER_SEC = MEASURED_CPU_ROWS_PER_SEC * BASELINE_CLUSTER_WORKERS
 BASELINE_TREE_RATE = (MEASURED_CPU_TREE_ROWS_TREES_PER_SEC
                       * BASELINE_CLUSTER_WORKERS)
 BASELINE_SCORE_RATE = (MEASURED_CPU_SCORE_ROWS_PER_SEC
+                       * BASELINE_CLUSTER_WORKERS)
+BASELINE_STATS_RATE = (MEASURED_CPU_STATS_ROWS_PER_SEC
                        * BASELINE_CLUSTER_WORKERS)
 
 
@@ -300,6 +304,44 @@ def bench_eval(n_rows: int = 1 << 20, n_features: int = 256,
     return best
 
 
+def bench_stats(n_rows: int = 1 << 18, n_cols: int = 256,
+                num_buckets: int = 4096) -> float:
+    """Stats/ETL-plane throughput: the two-pass per-column sweep (moments +
+    fine histogram with pos/neg/weighted channels — the ``StatsSpdtI.pig``
+    + ``UpdateBinningInfo`` MR pair) in rows/sec at 256 columns.  The
+    histogram runs the two-level one-hot MXU kernel
+    (``ops/hist_pallas.stats_histograms_pallas``); data is generated in
+    HBM (a stats job ingests once; the host link is not the subject)."""
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.ops.binning import _histogram_kernel, _moments_kernel
+    from shifu_tpu.ops.hist_pallas import pallas_available
+
+    kx, kv, kt = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(kx, (n_rows, n_cols), jnp.float32)
+    valid = jax.random.uniform(kv, (n_rows, n_cols)) > 0.05
+    t = (jax.random.uniform(kt, (n_rows,)) < 0.3).astype(jnp.float32)
+    w = jnp.ones(n_rows, jnp.float32)
+    lo = jnp.full(n_cols, -6.0)
+    hi = jnp.full(n_cols, 6.0)
+    up = pallas_available()
+
+    def sweep():
+        m = _moments_kernel(x, valid)
+        h = _histogram_kernel(x, valid, t, w, lo, hi, num_buckets,
+                              use_pallas=up)
+        return m[0].sum() + h.sum()
+
+    float(sweep())                               # compile warmup
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(sweep())                           # value-forcing sync
+        best = max(best, n_rows / (time.perf_counter() - t0))
+    return best
+
+
 def run_benchmark() -> Dict[str, Any]:
     nn_rows_per_sec = bench_nn()
     extras: Dict[str, Any] = {}
@@ -326,12 +368,15 @@ def run_benchmark() -> Dict[str, Any]:
     record("rf_train_throughput", bench_rf, BASELINE_TREE_RATE)
     record("wdl_train_throughput", bench_wdl, BASELINE_ROWS_PER_SEC)
     record("eval_throughput", bench_eval, BASELINE_SCORE_RATE)
+    record("stats_throughput", bench_stats, BASELINE_STATS_RATE)
     extras["streamed_bench_shape"] = {
         "resident": "262144 rows x 8 trees (since r4; was 65536 x 4)",
         "tail": "65536 rows x 4 trees, budget forces disk tail"}
     extras["baselines"] = {
         "tree_rows_trees_per_sec_per_worker":
             MEASURED_CPU_TREE_ROWS_TREES_PER_SEC,
+        "stats_rows_per_sec_per_worker":
+            MEASURED_CPU_STATS_ROWS_PER_SEC,
         "score_rows_per_sec_per_worker": MEASURED_CPU_SCORE_ROWS_PER_SEC,
         "cluster_workers": BASELINE_CLUSTER_WORKERS,
         "provenance": "tools/measure_baseline.py on this rig (BASELINE.md)",

@@ -59,27 +59,36 @@ def _moments_kernel(x: jnp.ndarray, valid: jnp.ndarray):
     return cnt, mean, m2, m3, m4, mn, mx
 
 
-@functools.partial(jax.jit, static_argnames=("num_buckets",))
+@functools.partial(jax.jit, static_argnames=("num_buckets", "use_pallas"))
 def _histogram_kernel(x: jnp.ndarray, valid: jnp.ndarray, target: jnp.ndarray,
                       weight: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
-                      num_buckets: int):
-    """Fine-histogram scatter-add for one chunk.
+                      num_buckets: int, use_pallas: bool = False):
+    """Fine-histogram for one chunk.
 
     Returns [C, num_buckets, 4]: (#pos, #neg, w_pos, w_neg) per fine bucket.
-    One flattened ``segment_sum`` — the TPU analogue of the reference's
-    per-(column,bin) reducer accumulation.
+    Two lowerings, the tree-histogram story replayed for the ETL plane:
+    ``use_pallas=True`` → the two-level one-hot MXU kernel
+    (:func:`shifu_tpu.ops.hist_pallas.stats_histograms_pallas` — the TPU
+    serializes scatter-adds, and at north-star widths the scatter path
+    cannot keep up with object-storage IO); default → one flattened
+    ``segment_sum``, the reference's per-(column,bin) reducer accumulation.
     """
     R, C = x.shape
     scale = num_buckets / jnp.maximum(hi - lo, 1e-30)
     idx = jnp.clip(((x - lo) * scale), 0, num_buckets - 1).astype(jnp.int32)
-    flat = idx + jnp.arange(C, dtype=jnp.int32) * num_buckets
-    flat = jnp.where(valid, flat, C * num_buckets)  # overflow slot for invalid
     is_pos = (target >= 0.5)[:, None]
     w = weight[:, None]
     ones = jnp.ones((R, 1), x.dtype)
     vals = jnp.concatenate([
         jnp.where(is_pos, ones, 0.0), jnp.where(is_pos, 0.0, ones),
         jnp.where(is_pos, w, 0.0), jnp.where(is_pos, 0.0, w)], axis=1)  # [R,4]
+    if use_pallas:
+        from .hist_pallas import stats_histograms_pallas, target_platform
+        idx = jnp.where(valid, idx, -1)      # invalid cell -> matches no bin
+        return stats_histograms_pallas(idx, vals, num_buckets,
+                                       interpret=target_platform() != "tpu")
+    flat = idx + jnp.arange(C, dtype=jnp.int32) * num_buckets
+    flat = jnp.where(valid, flat, C * num_buckets)  # overflow slot for invalid
     data = jnp.broadcast_to(vals[:, None, :], (R, C, 4)).reshape(R * C, 4)
     seg = jax.ops.segment_sum(data, flat.reshape(-1),
                               num_segments=C * num_buckets + 1)
@@ -151,11 +160,14 @@ class NumericAccumulator:
     def update_histogram(self, x: np.ndarray, valid: np.ndarray,
                          target: np.ndarray, weight: np.ndarray) -> None:
         assert self.lo is not None, "call finalize_range() after pass 1"
+        from .hist_pallas import pallas_available
+        up = (pallas_available() and self.num_buckets % 64 == 0
+              and self.num_buckets <= 4096)
         h = _histogram_kernel(
             jnp.asarray(x, jnp.float32), jnp.asarray(valid),
             jnp.asarray(target, jnp.float32), jnp.asarray(weight, jnp.float32),
             jnp.asarray(self.lo, jnp.float32), jnp.asarray(self.hi, jnp.float32),
-            self.num_buckets)
+            self.num_buckets, use_pallas=up)
         h = np.asarray(h, np.float64)
         self.hist = h if self.hist is None else self.hist + h
         # missing-bin aggregation (invalid entries)

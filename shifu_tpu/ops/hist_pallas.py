@@ -40,6 +40,20 @@ from jax.experimental.pallas import tpu as pltpu
 LANE = 128
 
 
+def _bf16_split(a):
+    """bf16 (hi, lo) halves of an f32 operand — two native-rate MXU
+    passes recover ~f32 accuracy (residual ~eps_bf16^2).  The split must
+    NOT be written as a convert round-trip (a - f32(bf16(a))): XLA's
+    allow-excess-precision simplification — explicitly enabled on this
+    TPU toolchain — folds that to zero, silently degrading the kernel to
+    plain bf16.  Masking the low mantissa bits via bitcast is opaque to
+    the simplifier."""
+    hi_f = jax.lax.bitcast_convert_type(
+        jax.lax.bitcast_convert_type(a, jnp.uint32)
+        & jnp.uint32(0xFFFF0000), jnp.float32)            # bf16-exact
+    return hi_f.astype(jnp.bfloat16), (a - hi_f).astype(jnp.bfloat16)
+
+
 def _hist_kernel(bins_ref, node_ref, stats_ref, out_ref, *, n_stats: int,
                  n_nodes: int, b_pad: int, nblk: int, cblk: int,
                  pair: bool = False):
@@ -52,26 +66,17 @@ def _hist_kernel(bins_ref, node_ref, stats_ref, out_ref, *, n_stats: int,
     nview = node_ref[0:1, :]
     k_iota = jax.lax.broadcasted_iota(jnp.int32, (n_nodes, nblk), 0)
     node1h = (k_iota == nview).astype(jnp.float32)        # [K, nblk]
-    # f32 accuracy at bf16 speed: split each stats operand into bf16
-    # hi + lo halves (two native MXU passes ≈ 3x faster than the 6-pass
-    # f32-HIGHEST mode; residual error ~eps_bf16^2, and the one-hot
-    # operand is exact in bf16).  Stats channels feed split gains, and
-    # the reference accumulates in double (``DTWorker.java:850-852``) —
-    # plain bf16 rounding shifted chosen thresholds measurably (2.5%
-    # cell error at bench shapes), the hi/lo split does not.
-    # the split must NOT be written as a convert round-trip
-    # (a - f32(bf16(a))): XLA's allow-excess-precision simplification —
-    # explicitly enabled on this TPU toolchain — folds that to zero,
-    # silently degrading the kernel to plain bf16.  Masking the low
-    # mantissa bits out via bitcast is opaque to the simplifier.
+    # f32 accuracy at bf16 speed (see _bf16_split): stats channels feed
+    # split gains, and the reference accumulates in double
+    # (``DTWorker.java:850-852``) — plain bf16 rounding shifted chosen
+    # thresholds measurably (2.5% cell error at bench shapes), the hi/lo
+    # split does not.
     a_hi, a_lo = [], []
     for s in range(n_stats):
         a = node1h * stats_ref[s:s + 1, :]                # [K, nblk] f32
-        hi_f = jax.lax.bitcast_convert_type(
-            jax.lax.bitcast_convert_type(a, jnp.uint32)
-            & jnp.uint32(0xFFFF0000), jnp.float32)        # bf16-exact
-        a_hi.append(hi_f.astype(jnp.bfloat16))
-        a_lo.append((a - hi_f).astype(jnp.bfloat16))
+        hi_b, lo_b = _bf16_split(a)
+        a_hi.append(hi_b)
+        a_lo.append(lo_b)
     dims = (((1,), (1,)), ((), ()))
     half = LANE // 2
     if pair:
@@ -224,3 +229,89 @@ def pallas_available(mesh=None) -> bool:
     if env == "force":
         return True
     return target_platform(mesh) == "tpu"
+
+
+# ---------------------------------------------------- wide-B stats kernel
+def _stats_hist_kernel(idx_ref, stats_ref, out_ref, *, n_stats: int,
+                      hi_n: int, nblk: int, cblk: int):
+    """Fine-histogram build for the STATS plane (wide bucket axis).
+
+    The tree kernel's one-hot trick is linear in the bucket count (one
+    128-lane compare tile per 128 buckets), which is fine at B<=256 but
+    hopeless at the stats plane's 4096 fine buckets.  Wide histograms
+    factor instead: bucket id = hi*64 + lo, and
+
+        out[c, s, hi, lo] = sum_n [hi(n)==hi] * stats(n,s) * [lo(n)==lo]
+
+    is one [64, nblk] x [nblk, 64] ``dot_general`` per (column, stat) —
+    B-independent MXU work (the reference accumulates the same cells one
+    row at a time in ``UpdateBinningInfoMapper.java:71``'s combiner).
+    Invalid cells arrive as idx -1: the arithmetic shift keeps hi == -1,
+    which matches no one-hot row.  Same bf16 hi/lo-split accumulation as
+    :func:`_hist_kernel` (weighted counts feed KS/IV/WOE).
+    """
+    r = pl.program_id(1)
+
+    @pl.when(r == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (LANE // 2, nblk), 0)
+    dims = (((1,), (1,)), ((), ()))
+    for cf in range(cblk):
+        col = idx_ref[cf:cf + 1, :]                       # [1, nblk] int32
+        hi = col >> 6                                     # -1 stays -1
+        lo = col & 63
+        hi1h = (lane_iota == hi).astype(jnp.float32)      # [64, nblk]
+        lo1h = (lane_iota == lo).astype(jnp.bfloat16)     # [64, nblk]
+        for s in range(n_stats):
+            a = hi1h * stats_ref[s:s + 1, :]              # [64, nblk] f32
+            hi_b, lo_b = _bf16_split(a)
+            acc = jax.lax.dot_general(
+                hi_b, lo1h, dims,
+                preferred_element_type=jnp.float32)       # [64, 64]
+            acc += jax.lax.dot_general(
+                lo_b, lo1h, dims,
+                preferred_element_type=jnp.float32)
+            out_ref[cf, s, :, :] += acc[:hi_n, :]
+
+
+@partial(jax.jit, static_argnames=("num_buckets", "interpret"))
+def stats_histograms_pallas(idx, stats, num_buckets: int,
+                            interpret: bool = False):
+    """[C, num_buckets, S] fine-histogram from per-cell bucket ids.
+
+    idx: [N, C] int32, -1 = invalid cell (missing value — contributes
+    nowhere); stats: [N, S] float32 per-row channels (pos/neg indicators,
+    weighted variants).  ``num_buckets`` must be a multiple of 64 and at
+    most 4096 (the stats plane's fine-sketch width).
+    """
+    assert num_buckets % 64 == 0 and num_buckets <= 4096, num_buckets
+    n, c = idx.shape
+    s = stats.shape[1]
+    hi_n = num_buckets // 64
+    cblk = 8
+    c_pad = ((c + cblk - 1) // cblk) * cblk
+    nblk = 2048
+    n_pad = ((n + nblk - 1) // nblk) * nblk
+    idx_t = jnp.pad(idx, ((0, n_pad - n), (0, c_pad - c)),
+                    constant_values=-1).T                 # [C_pad, N_pad]
+    stats_t = jnp.pad(stats, ((0, n_pad - n), (0, 0))).T  # [S, N_pad]
+    grid = (c_pad // cblk, n_pad // nblk)
+    out = pl.pallas_call(
+        partial(_stats_hist_kernel, n_stats=s, hi_n=hi_n, nblk=nblk,
+                cblk=cblk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((cblk, nblk), lambda ci, r: (ci, r)),
+            pl.BlockSpec((s, nblk), lambda ci, r: (0, r)),
+        ],
+        out_specs=pl.BlockSpec((cblk, s, hi_n, 64),
+                               lambda ci, r: (ci, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((c_pad, s, hi_n, 64), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(idx_t, stats_t)
+    # [C_pad, S, HI, 64] -> [C, HI*64, S]
+    return out[:c].reshape(c, s, hi_n * 64).transpose(0, 2, 1)

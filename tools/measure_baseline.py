@@ -83,6 +83,7 @@ def main() -> None:
     out.update(measure_cpu_backprop())
     out.update(measure_cpu_tree_trainer())
     out.update(measure_cpu_scalar_scorer())
+    out.update(measure_cpu_stats_worker())
     print(json.dumps(out, indent=1))
 
 
@@ -226,6 +227,47 @@ def measure_cpu_scalar_scorer(n_rows: int = 2000, n_features: int = 256,
     return {"cpu_scalar_score_rows_per_sec": round(n_rows / dt, 1),
             "cpu_scalar_score_shapes":
                 f"{n_features}->{hidden}->1 x{n_models} models f64 per-row"}
+
+
+def measure_cpu_stats_worker(n_rows: int = 1 << 15, n_cols: int = 256,
+                             num_buckets: int = 4096) -> dict:
+    """Single-thread reference-style stats pass: per-column moments + a
+    (bucket, pos/neg, weighted) fine-histogram accumulated row-set by
+    row-set with np.add.at — the ``UpdateBinningInfoMapper.java:71`` /
+    ``BinningPartialDataUDF`` math without the Hadoop plumbing, same
+    measurement convention as the tree/scorer baselines."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n_rows, n_cols))
+    valid = rng.random((n_rows, n_cols)) > 0.05
+    t = (rng.random(n_rows) < 0.3)
+    w = rng.uniform(0.5, 2.0, n_rows)
+
+    def one_pass():
+        hist = np.zeros((n_cols, num_buckets, 4))
+        for c in range(n_cols):
+            v = valid[:, c]
+            xc = x[v, c]
+            # pass 1: moments + range
+            xc.sum(); (xc * xc).sum(); xc.min(); xc.max()
+            lo, hi = xc.min(), xc.max()
+            idx = np.clip(((xc - lo) * (num_buckets / max(hi - lo, 1e-30))),
+                          0, num_buckets - 1).astype(np.int64)
+            tp = t[v]
+            wv = w[v]
+            np.add.at(hist[c, :, 0], idx[tp], 1.0)
+            np.add.at(hist[c, :, 1], idx[~tp], 1.0)
+            np.add.at(hist[c, :, 2], idx[tp], wv[tp])
+            np.add.at(hist[c, :, 3], idx[~tp], wv[~tp])
+        return hist
+
+    one_pass()                                   # warm caches
+    t0 = time.time()
+    one_pass()
+    dt = time.time() - t0
+    return {"cpu_stats_rows_per_sec": round(n_rows / dt, 1),
+            "cpu_stats_shapes":
+                f"{n_rows} rows x {n_cols} cols x {num_buckets} buckets, "
+                "np.add.at per column, single thread"}
 
 
 if __name__ == "__main__":
