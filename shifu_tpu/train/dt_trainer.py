@@ -1144,6 +1144,28 @@ def _gbt_prepare(mesh, valid_rate: float, seed: int, n_bins: int,
     return prep
 
 
+def _progress_flusher(drain, history, progress, idx_off: int):
+    """(flush, mark) for batched streamed progress: lines arrive in
+    bursts of 8 (a per-tree fetch is a full link round-trip — the
+    resident path's convention).  ``idx_off`` maps history positions to
+    global tree indices (resume may restore trees without their history,
+    e.g. a checkpoint whose .meta.json is missing).  ``mark`` advances
+    the cursor after a caller emitted a line itself (per-tree sync
+    paths)."""
+    state = {"emitted": len(history)}
+
+    def flush() -> None:
+        drain()
+        if progress:
+            for j in range(state["emitted"], len(history)):
+                progress(j + idx_off, history[j][0], history[j][1])
+        state["emitted"] = len(history)
+
+    def mark() -> None:
+        state["emitted"] = len(history)
+    return flush, mark
+
+
 def train_gbt_streamed(stream, n_bins: int, cat_mask,
                        settings: DTSettings, progress=None,
                        init_trees: Optional[List[TreeArrays]] = None,
@@ -1247,7 +1269,10 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
             absorb_fused(np.asarray(jnp.stack(pending_fused)))
             pending_fused.clear()
 
-    sync_each = bool(progress) or settings.early_stop
+    # early stop must see every tree's error as it lands; a progress
+    # consumer only needs lines, batched by the shared flusher
+    flush_progress, mark_progress = _progress_flusher(
+        drain_fused, history, progress, len(trees) - len(history))
     for ti in range(len(trees) + len(pending_fused), settings.n_trees):
         fa = jnp.asarray(_feat_subset(settings, c, ti))
         if cache.warmed and cache.tail is None:
@@ -1265,18 +1290,21 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                 settings.max_leaves, hc, _hist_mesh(mesh))
             for it, f2 in zip(items, new_f):
                 it.arrays["f"] = f2
-            if sync_each:
+            if settings.early_stop:
                 absorb_fused([np.asarray(packed_d)])
                 tr_err, va_err = history[-1]
                 if progress:
                     progress(ti, tr_err, va_err)
+                mark_progress()
             else:
                 pending_fused.append(packed_d)
+                if progress and len(pending_fused) >= 8:
+                    flush_progress()
             if checkpoint_fn and settings.checkpoint_every and \
                     (ti + 1) % settings.checkpoint_every == 0:
-                drain_fused()
+                flush_progress()
                 checkpoint_fn(trees, history, init_score)
-            if sync_each and settings.early_stop and \
+            if settings.early_stop and \
                     stopper.add(history[-1][1]):
                 log.info("GBT early stop after %d trees (streamed)",
                          ti + 1)
@@ -1322,13 +1350,14 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
         tr_err, va_err = history[-1]
         if progress:
             progress(ti, tr_err, va_err)
+        mark_progress()
         if checkpoint_fn and settings.checkpoint_every and \
                 (ti + 1) % settings.checkpoint_every == 0:
             checkpoint_fn(trees, history, init_score)
         if settings.early_stop and stopper.add(va_err):
             log.info("GBT early stop after %d trees (streamed)", ti + 1)
             break
-    drain_fused()
+    flush_progress()
     return ForestResult(
         trees=trees,
         spec_kwargs={"algorithm": "GBT", "loss": settings.loss,
@@ -1501,7 +1530,9 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
             absorb_rf(np.asarray(jnp.stack(pending_rf)))
             pending_rf.clear()
 
-    sync_each = bool(progress)
+    flush_progress_rf, mark_progress_rf = _progress_flusher(
+        drain_rf, history, progress, len(trees) - len(history))
+
     for ti in range(len(trees) + len(pending_rf), settings.n_trees):
         bag_cache.clear()
         fa = jnp.asarray(_feat_subset(settings, c, ti))
@@ -1520,15 +1551,12 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                 settings.n_classes)
             for it, pair in zip(items, new_oob):
                 it.arrays["oob"] = pair
-            if sync_each:
-                absorb_rf([np.asarray(packed_d)])
-                tr_err, va_err = history[-1]
-                progress(ti, tr_err, va_err)
-            else:
-                pending_rf.append(packed_d)
+            pending_rf.append(packed_d)
+            if progress and len(pending_rf) >= 8:
+                flush_progress_rf()
             if checkpoint_fn and settings.checkpoint_every and \
                     (ti + 1) % settings.checkpoint_every == 0:
-                drain_rf()
+                flush_progress_rf()
                 checkpoint_fn(trees, history, None)
             continue
         sf = jnp.full(total, -1, jnp.int32)
@@ -1558,10 +1586,11 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
         tr_err, va_err = history[-1]
         if progress:
             progress(ti, tr_err, va_err)
+        mark_progress_rf()
         if checkpoint_fn and settings.checkpoint_every and \
                 (ti + 1) % settings.checkpoint_every == 0:
             checkpoint_fn(trees, history, None)
-    drain_rf()
+    flush_progress_rf()
     spec_kwargs: Dict[str, Any] = {"algorithm": "RF"}
     if mc:
         spec_kwargs["extra"] = {"n_classes": K}
