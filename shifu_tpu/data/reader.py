@@ -38,9 +38,11 @@ def _fsspec_paths(path: str):
         if path.startswith(scheme):
             raise ShifuError(
                 ErrorCode.ERROR_REMOTE_SOURCE,
-                f"{path!r}: no {scheme[:-3]} client in this runtime — "
-                "stage the files locally (hdfs dfs -get) or serve them "
-                "from object storage (gs://, s3://)")
+                f"{path!r}: no native {scheme[:-3]} client in this "
+                "runtime — point dataPath at the cluster's WebHDFS "
+                "gateway instead (webhdfs://namenode:9870/path streams "
+                "directly), stage the files locally (hdfs dfs -get), or "
+                "serve them from object storage (gs://, s3://)")
     import fsspec
     try:
         fs, _, paths = fsspec.get_fs_token_paths(path)

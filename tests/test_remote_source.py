@@ -117,3 +117,22 @@ def test_full_pipeline_over_memory_source(tmp_path):
                                        "EvalPerformance.json")))
     # plumbing test: the signal in this 3-feature synthetic caps AUC ~0.78
     assert perf["areaUnderRoc"] > 0.7
+
+
+def test_webhdfs_scheme_not_gated():
+    """webhdfs:// (fsspec's pure-HTTP Hadoop client, no libhdfs needed) is
+    a real route to cluster data — it must reach the fsspec backend, not
+    the coded hdfs gate; the gate's message points at it."""
+    from shifu_tpu.config.errors import ShifuError
+    from shifu_tpu.data.reader import _GATED_SCHEMES, resolve_data_files
+    assert not any(s.startswith("webhdfs") for s in _GATED_SCHEMES)
+    with pytest.raises(ShifuError, match="webhdfs://namenode"):
+        resolve_data_files("hdfs://nn:8020/data/part-*")
+    # the webhdfs path dies on CONNECTION (no cluster here), never on the
+    # gate — whatever fsspec raises, it is not the coded gate message
+    try:
+        resolve_data_files("webhdfs://127.0.0.1:1/404/part-*")
+    except ShifuError as e:                       # pragma: no cover
+        assert "no native" not in str(e)
+    except Exception:
+        pass                                      # connection error = ok
