@@ -59,10 +59,13 @@ def _moments_kernel(x: jnp.ndarray, valid: jnp.ndarray):
     return cnt, mean, m2, m3, m4, mn, mx
 
 
-@functools.partial(jax.jit, static_argnames=("num_buckets", "use_pallas"))
+@functools.partial(jax.jit,
+                   static_argnames=("num_buckets", "use_pallas",
+                                    "unit_weight", "expand"))
 def _histogram_kernel(x: jnp.ndarray, valid: jnp.ndarray, target: jnp.ndarray,
                       weight: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
-                      num_buckets: int, use_pallas: bool = False):
+                      num_buckets: int, use_pallas: bool = False,
+                      unit_weight: bool = False, expand: bool = True):
     """Fine-histogram for one chunk.
 
     Returns [C, num_buckets, 4]: (#pos, #neg, w_pos, w_neg) per fine bucket.
@@ -72,27 +75,49 @@ def _histogram_kernel(x: jnp.ndarray, valid: jnp.ndarray, target: jnp.ndarray,
     serializes scatter-adds, and at north-star widths the scatter path
     cannot keep up with object-storage IO); default → one flattened
     ``segment_sum``, the reference's per-(column,bin) reducer accumulation.
+
+    ``unit_weight=True`` (no weight column configured — the common case)
+    computes only the two 0/1 count channels and mirrors them into the
+    weighted slots: half the accumulation work, and both channels are
+    bf16-exact so the MXU path runs a single dot per column pair.
+    ``expand=False`` skips the mirroring and returns the raw [C, B, 2] —
+    for device-side accumulators whose drain pays link bandwidth per
+    channel (the host expands after the fetch).
     """
     R, C = x.shape
     scale = num_buckets / jnp.maximum(hi - lo, 1e-30)
     idx = jnp.clip(((x - lo) * scale), 0, num_buckets - 1).astype(jnp.int32)
     is_pos = (target >= 0.5)[:, None]
-    w = weight[:, None]
     ones = jnp.ones((R, 1), x.dtype)
-    vals = jnp.concatenate([
-        jnp.where(is_pos, ones, 0.0), jnp.where(is_pos, 0.0, ones),
-        jnp.where(is_pos, w, 0.0), jnp.where(is_pos, 0.0, w)], axis=1)  # [R,4]
+    pos_i = jnp.where(is_pos, ones, 0.0)
+    neg_i = jnp.where(is_pos, 0.0, ones)
+    if unit_weight:
+        vals = jnp.concatenate([pos_i, neg_i], axis=1)           # [R, 2]
+        exact = (True, True)
+    else:
+        w = weight[:, None]
+        vals = jnp.concatenate([
+            pos_i, neg_i,
+            jnp.where(is_pos, w, 0.0), jnp.where(is_pos, 0.0, w)],
+            axis=1)                                              # [R, 4]
+        exact = (True, True, False, False)
     if use_pallas:
         from .hist_pallas import stats_histograms_pallas, target_platform
-        idx = jnp.where(valid, idx, -1)      # invalid cell -> matches no bin
-        return stats_histograms_pallas(idx, vals, num_buckets,
-                                       interpret=target_platform() != "tpu")
-    flat = idx + jnp.arange(C, dtype=jnp.int32) * num_buckets
-    flat = jnp.where(valid, flat, C * num_buckets)  # overflow slot for invalid
-    data = jnp.broadcast_to(vals[:, None, :], (R, C, 4)).reshape(R * C, 4)
-    seg = jax.ops.segment_sum(data, flat.reshape(-1),
-                              num_segments=C * num_buckets + 1)
-    return seg[:-1].reshape(C, num_buckets, 4)
+        cidx = jnp.where(valid, idx, -1)     # invalid cell -> matches no bin
+        h = stats_histograms_pallas(cidx, vals, num_buckets,
+                                    interpret=target_platform() != "tpu",
+                                    exact=exact)
+    else:
+        S = vals.shape[1]
+        flat = idx + jnp.arange(C, dtype=jnp.int32) * num_buckets
+        flat = jnp.where(valid, flat, C * num_buckets)  # overflow slot
+        data = jnp.broadcast_to(vals[:, None, :], (R, C, S)).reshape(R * C, S)
+        seg = jax.ops.segment_sum(data, flat.reshape(-1),
+                                  num_segments=C * num_buckets + 1)
+        h = seg[:-1].reshape(C, num_buckets, S)
+    if unit_weight and expand:               # w_pos = #pos, w_neg = #neg
+        h = jnp.concatenate([h, h], axis=2)
+    return h
 
 
 # ------------------------------------------------------- moment combination
@@ -119,12 +144,48 @@ def _combine_moments(a: dict, b: Tuple[np.ndarray, ...]) -> dict:
             "max": np.maximum(a["max"], mxb)}
 
 
+@functools.partial(jax.jit, static_argnames=("unit_weight", "expand"))
+def _missing_agg_kernel(valid, target, weight, unit_weight: bool = False,
+                        expand: bool = True):
+    """[C, 4] (pos/neg/w_pos/w_neg) sums over INVALID cells — the
+    missing-bin aggregation as one device matmul instead of four host
+    passes over the [R, C] mask.  HIGHEST precision keeps f32-faithful
+    accumulation (counts are exact integers below 2^24; the bounded
+    drain in :class:`NumericAccumulator` keeps them there)."""
+    R = valid.shape[0]
+    inval = (~valid).astype(jnp.float32)               # [R, C]
+    is_pos = (target >= 0.5)[:, None]
+    ones = jnp.ones((R, 1), jnp.float32)
+    pos_i = jnp.where(is_pos, ones, 0.0)
+    neg_i = jnp.where(is_pos, 0.0, ones)
+    if unit_weight:
+        vals = jnp.concatenate([pos_i, neg_i], axis=1)
+    else:
+        w = weight[:, None]
+        vals = jnp.concatenate([pos_i, neg_i, jnp.where(is_pos, w, 0.0),
+                                jnp.where(is_pos, 0.0, w)], axis=1)
+    magg = jax.lax.dot_general(inval, vals, (((0,), (0,)), ((), ())),
+                               precision=jax.lax.Precision.HIGHEST,
+                               preferred_element_type=jnp.float32)  # [C, S]
+    if unit_weight and expand:
+        magg = jnp.concatenate([magg, magg], axis=1)
+    return magg
+
+
 # ------------------------------------------------------------- accumulators
 @dataclass
 class NumericAccumulator:
-    """Streaming accumulator over numeric columns (both passes)."""
+    """Streaming accumulator over numeric columns (both passes).
+
+    Device-side accumulation: per-chunk kernel outputs stay in HBM and
+    drain to host float64 in ONE packed fetch per pass (or per ~8M-row
+    super-chunk, which keeps f32 bucket counts integer-exact).  A host
+    fetch over a remote-device link is a full round trip — measured
+    ~98 ms on the dev tunnel — so the round-3 per-chunk ``np.asarray``
+    serialized the whole stats plane behind the link latency."""
     n_cols: int
     num_buckets: int = 4096
+    unit_weight: bool = False       # no weight column: w channels mirror counts
     moments: dict = field(default_factory=dict)
     total_rows: int = 0
     missing: Optional[np.ndarray] = None
@@ -139,22 +200,43 @@ class NumericAccumulator:
     # exact path is for LOCAL-scale runs; the sketch remains the default.
     exact: bool = False
     _exact_cols: Optional[list] = None     # [C] lists of (vals, pos, w)
+    _pend_moments: list = field(default_factory=list)  # [7, C] device chunks
+    _hist_dev: Optional[object] = None     # [C, K, 4] f32 on device
+    _magg_dev: Optional[object] = None     # [C, 4] f32 on device
+    _pend_hist_rows: int = 0
+    _lo_d: Optional[object] = None
+    _hi_d: Optional[object] = None
+
+    # f32 histogram counts are exact integers up to 2^24; drain to host
+    # float64 well before that so TB-scale streams lose nothing
+    DRAIN_ROWS = 8_000_000
 
     # ---- pass 1
     def update_moments(self, x: np.ndarray, valid: np.ndarray) -> None:
         out = _moments_kernel(jnp.asarray(x, jnp.float32), jnp.asarray(valid))
-        self.moments = _combine_moments(self.moments, out)
+        self._pend_moments.append(jnp.stack(out))      # [7, C], stays on device
         self.total_rows += x.shape[0]
-        miss = (~valid).sum(axis=0).astype(np.float64)
-        self.missing = miss if self.missing is None else self.missing + miss
+
+    def _drain_moments(self) -> None:
+        if not self._pend_moments:
+            return
+        chunks = np.asarray(jnp.stack(self._pend_moments), np.float64)
+        self._pend_moments.clear()
+        for m in chunks:                               # Chan combine in f64
+            self.moments = _combine_moments(self.moments, tuple(m))
+        # invalid cells among processed rows = rows - valid count
+        self.missing = self.total_rows - self.moments["count"]
 
     def finalize_range(self) -> None:
+        self._drain_moments()
         mn, mx = self.moments["min"].copy(), self.moments["max"].copy()
         empty = self.moments["count"] == 0
         mn[empty], mx[empty] = 0.0, 1.0
         same = mx <= mn
         mx[same] = mn[same] + 1.0
         self.lo, self.hi = mn, mx
+        self._lo_d = jnp.asarray(self.lo, jnp.float32)
+        self._hi_d = jnp.asarray(self.hi, jnp.float32)
 
     # ---- pass 2
     def update_histogram(self, x: np.ndarray, valid: np.ndarray,
@@ -163,22 +245,21 @@ class NumericAccumulator:
         from .hist_pallas import pallas_available
         up = (pallas_available() and self.num_buckets % 64 == 0
               and self.num_buckets <= 4096)
-        h = _histogram_kernel(
-            jnp.asarray(x, jnp.float32), jnp.asarray(valid),
-            jnp.asarray(target, jnp.float32), jnp.asarray(weight, jnp.float32),
-            jnp.asarray(self.lo, jnp.float32), jnp.asarray(self.hi, jnp.float32),
-            self.num_buckets, use_pallas=up)
-        h = np.asarray(h, np.float64)
-        self.hist = h if self.hist is None else self.hist + h
-        # missing-bin aggregation (invalid entries)
-        is_pos = target >= 0.5
-        inval = ~valid
-        magg = np.stack([
-            (inval & is_pos[:, None]).sum(0),
-            (inval & ~is_pos[:, None]).sum(0),
-            (inval * (weight * is_pos)[:, None]).sum(0),
-            (inval * (weight * ~is_pos)[:, None]).sum(0)], axis=1).astype(np.float64)
-        self.missing_agg = magg if self.missing_agg is None else self.missing_agg + magg
+        xd = jnp.asarray(x, jnp.float32)
+        vd = jnp.asarray(valid)
+        td = jnp.asarray(target, jnp.float32)
+        wd = jnp.asarray(weight, jnp.float32)
+        h = _histogram_kernel(xd, vd, td, wd, self._lo_d, self._hi_d,
+                              self.num_buckets, use_pallas=up,
+                              unit_weight=self.unit_weight, expand=False)
+        magg = _missing_agg_kernel(vd, td, wd, unit_weight=self.unit_weight,
+                                   expand=False)
+        self._hist_dev = h if self._hist_dev is None else self._hist_dev + h
+        self._magg_dev = (magg if self._magg_dev is None
+                          else self._magg_dev + magg)
+        self._pend_hist_rows += x.shape[0]
+        if self._pend_hist_rows >= self.DRAIN_ROWS:
+            self._drain_hist()
         if self.exact:
             if self._exact_cols is None:
                 self._exact_cols = [[] for _ in range(self.n_cols)]
@@ -189,6 +270,29 @@ class NumericAccumulator:
                 self._exact_cols[c].append(
                     (np.asarray(x[v, c], np.float64), pos_r[v], w64[v]))
 
+    def _drain_hist(self) -> None:
+        if self._hist_dev is None:
+            return
+        # ONE packed fetch for both accumulators (two would be two trips;
+        # with no weight column only the 2 count channels cross the link —
+        # the fetch is bandwidth-priced, ~35 MB/s on the dev tunnel)
+        nch = 2 if self.unit_weight else 4
+        packed = np.asarray(jnp.concatenate(
+            [self._hist_dev.reshape(-1), self._magg_dev.reshape(-1)]),
+            np.float64)
+        self._hist_dev = None
+        self._magg_dev = None
+        self._pend_hist_rows = 0
+        n_h = self.n_cols * self.num_buckets * nch
+        h = packed[:n_h].reshape(self.n_cols, self.num_buckets, nch)
+        magg = packed[n_h:].reshape(self.n_cols, nch)
+        if self.unit_weight:                 # w_pos = #pos, w_neg = #neg
+            h = np.concatenate([h, h], axis=2)
+            magg = np.concatenate([magg, magg], axis=1)
+        self.hist = h if self.hist is None else self.hist + h
+        self.missing_agg = (magg if self.missing_agg is None
+                            else self.missing_agg + magg)
+
     # ---- boundary derivation
     def bucket_edges(self, col: int) -> np.ndarray:
         return np.linspace(self.lo[col], self.hi[col], self.num_buckets + 1)
@@ -196,6 +300,7 @@ class NumericAccumulator:
     def compute_boundaries(self, method: BinningMethod, max_bins: int) -> List[np.ndarray]:
         """Per-column bin boundaries; element 0 is -inf like the reference's
         ``binBoundary`` (value v falls in bin i when b[i] <= v < b[i+1])."""
+        self._drain_hist()
         assert self.hist is not None
         out = []
         for c in range(self.n_cols):
@@ -293,6 +398,7 @@ class NumericAccumulator:
         missing aggregation.  The sketch-based :meth:`bin_counts` is only
         exact when boundaries sit on fine-bucket edges — exact-quantile
         boundaries don't."""
+        self._drain_hist()
         vals, pos, ws = self._exact_col(col)
         nb = len(boundaries)
         idx = np.clip(np.searchsorted(boundaries, vals, side="right") - 1,
@@ -309,6 +415,7 @@ class NumericAccumulator:
     def bin_counts(self, col: int, boundaries: np.ndarray) -> np.ndarray:
         """Exact per-bin (pos, neg, wpos, wneg) counts incl. trailing missing
         bin, derived by segment-summing fine buckets."""
+        self._drain_hist()
         edges = self.bucket_edges(col)
         # fine bucket k covers [edges[k], edges[k+1]); assign to final bin
         bucket_bin = np.searchsorted(boundaries, edges[:-1], side="right") - 1
@@ -322,6 +429,7 @@ class NumericAccumulator:
 
     def percentile(self, col: int, q: Sequence[float]) -> np.ndarray:
         """Approximate percentiles (to fine-bucket resolution) from the sketch."""
+        self._drain_hist()
         h = self.hist[col][:, 0] + self.hist[col][:, 1]
         total = h.sum()
         if total <= 0:
@@ -334,6 +442,7 @@ class NumericAccumulator:
     def distinct_estimate(self, col: int) -> int:
         """Lower-bound distinct estimate = occupied fine buckets (the
         reference uses HyperLogLog; this is the sketch-native analogue)."""
+        self._drain_hist()
         return int((self.hist[col].sum(axis=1) > 0).sum())
 
 

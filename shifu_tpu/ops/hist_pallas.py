@@ -233,7 +233,8 @@ def pallas_available(mesh=None) -> bool:
 
 # ---------------------------------------------------- wide-B stats kernel
 def _stats_hist_kernel(idx_ref, stats_ref, out_ref, *, n_stats: int,
-                      hi_n: int, nblk: int, cblk: int):
+                      hi_n: int, nblk: int, cblk: int,
+                      exact: tuple):
     """Fine-histogram build for the STATS plane (wide bucket axis).
 
     The tree kernel's one-hot trick is linear in the bucket count (one
@@ -243,12 +244,25 @@ def _stats_hist_kernel(idx_ref, stats_ref, out_ref, *, n_stats: int,
 
         out[c, s, hi, lo] = sum_n [hi(n)==hi] * stats(n,s) * [lo(n)==lo]
 
-    is one [64, nblk] x [nblk, 64] ``dot_general`` per (column, stat) —
-    B-independent MXU work (the reference accumulates the same cells one
-    row at a time in ``UpdateBinningInfoMapper.java:71``'s combiner).
-    Invalid cells arrive as idx -1: the arithmetic shift keeps hi == -1,
-    which matches no one-hot row.  Same bf16 hi/lo-split accumulation as
-    :func:`_hist_kernel` (weighted counts feed KS/IV/WOE).
+    is a ``dot_general`` per (column, stat-pair) — B-independent MXU work
+    (the reference accumulates the same cells one row at a time in
+    ``UpdateBinningInfoMapper.java:71``'s combiner).  Invalid cells
+    arrive as idx -1: the arithmetic shift keeps hi == -1, which matches
+    no one-hot row.
+
+    Two MXU economies over the naive per-channel hi/lo-split loop
+    (measured 5.5x at bench shapes together):
+
+    * channel pairs pack along the sublane axis — rows 0-63 of the
+      [128, nblk] left operand carry channel s's hi-one-hot, rows 64-127
+      channel s+1's, so one dot feeds the whole 128-row MXU tile instead
+      of two half-empty ones;
+    * ``exact[s]`` marks channels whose values are bf16-exact (0/1
+      indicators — the pos/neg count channels): the product
+      one-hot * stats is then exactly representable and the f32-recovery
+      lo dot (see :func:`_bf16_split`) is skipped entirely.  Weighted
+      channels keep the split (weights are arbitrary f32 and feed
+      KS/IV/WOE).
     """
     r = pl.program_id(1)
 
@@ -257,36 +271,76 @@ def _stats_hist_kernel(idx_ref, stats_ref, out_ref, *, n_stats: int,
         out_ref[...] = jnp.zeros_like(out_ref)
 
     lane_iota = jax.lax.broadcasted_iota(jnp.int32, (LANE // 2, nblk), 0)
+    pack_iota = jax.lax.broadcasted_iota(jnp.int32, (LANE, nblk), 0) % (LANE // 2)
     dims = (((1,), (1,)), ((), ()))
     for cf in range(cblk):
         col = idx_ref[cf:cf + 1, :]                       # [1, nblk] int32
         hi = col >> 6                                     # -1 stays -1
         lo = col & 63
-        hi1h = (lane_iota == hi).astype(jnp.float32)      # [64, nblk]
         lo1h = (lane_iota == lo).astype(jnp.bfloat16)     # [64, nblk]
-        for s in range(n_stats):
+        s = 0
+        while s < n_stats:
+            if s + 1 < n_stats:
+                # packed pair: [128, nblk] left operand, one (or two) dots
+                hi2 = (pack_iota == jnp.broadcast_to(hi, (LANE, nblk))) \
+                    .astype(jnp.float32)
+                st = jnp.concatenate([
+                    jnp.broadcast_to(stats_ref[s:s + 1, :],
+                                     (LANE // 2, nblk)),
+                    jnp.broadcast_to(stats_ref[s + 1:s + 2, :],
+                                     (LANE // 2, nblk))], axis=0)
+                a = hi2 * st                              # [128, nblk] f32
+                if exact[s] and exact[s + 1]:
+                    acc = jax.lax.dot_general(
+                        a.astype(jnp.bfloat16), lo1h, dims,
+                        preferred_element_type=jnp.float32)  # [128, 64]
+                else:
+                    hi_b, lo_b = _bf16_split(a)
+                    acc = jax.lax.dot_general(
+                        hi_b, lo1h, dims,
+                        preferred_element_type=jnp.float32)
+                    acc += jax.lax.dot_general(
+                        lo_b, lo1h, dims,
+                        preferred_element_type=jnp.float32)
+                out_ref[cf, s, :, :] += acc[:hi_n, :]
+                out_ref[cf, s + 1, :, :] += \
+                    acc[LANE // 2:LANE // 2 + hi_n, :]
+                s += 2
+                continue
+            hi1h = (lane_iota == hi).astype(jnp.float32)  # [64, nblk]
             a = hi1h * stats_ref[s:s + 1, :]              # [64, nblk] f32
-            hi_b, lo_b = _bf16_split(a)
-            acc = jax.lax.dot_general(
-                hi_b, lo1h, dims,
-                preferred_element_type=jnp.float32)       # [64, 64]
-            acc += jax.lax.dot_general(
-                lo_b, lo1h, dims,
-                preferred_element_type=jnp.float32)
+            if exact[s]:
+                acc = jax.lax.dot_general(
+                    a.astype(jnp.bfloat16), lo1h, dims,
+                    preferred_element_type=jnp.float32)   # [64, 64]
+            else:
+                hi_b, lo_b = _bf16_split(a)
+                acc = jax.lax.dot_general(
+                    hi_b, lo1h, dims,
+                    preferred_element_type=jnp.float32)
+                acc += jax.lax.dot_general(
+                    lo_b, lo1h, dims,
+                    preferred_element_type=jnp.float32)
             out_ref[cf, s, :, :] += acc[:hi_n, :]
+            s += 1
 
 
-@partial(jax.jit, static_argnames=("num_buckets", "interpret"))
+@partial(jax.jit, static_argnames=("num_buckets", "interpret", "exact"))
 def stats_histograms_pallas(idx, stats, num_buckets: int,
-                            interpret: bool = False):
+                            interpret: bool = False,
+                            exact: tuple = None):
     """[C, num_buckets, S] fine-histogram from per-cell bucket ids.
 
     idx: [N, C] int32, -1 = invalid cell (missing value — contributes
     nowhere); stats: [N, S] float32 per-row channels (pos/neg indicators,
     weighted variants).  ``num_buckets`` must be a multiple of 64 and at
-    most 4096 (the stats plane's fine-sketch width).
+    most 4096 (the stats plane's fine-sketch width).  ``exact[s]`` marks
+    channels whose values are exactly representable in bfloat16 (0/1
+    indicators) — those skip the f32-recovery second dot.
     """
     assert num_buckets % 64 == 0 and num_buckets <= 4096, num_buckets
+    if exact is None:
+        exact = (False,) * stats.shape[1]
     n, c = idx.shape
     s = stats.shape[1]
     hi_n = num_buckets // 64
@@ -300,7 +354,7 @@ def stats_histograms_pallas(idx, stats, num_buckets: int,
     grid = (c_pad // cblk, n_pad // nblk)
     out = pl.pallas_call(
         partial(_stats_hist_kernel, n_stats=s, hi_n=hi_n, nblk=nblk,
-                cblk=cblk),
+                cblk=cblk, exact=tuple(exact)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((cblk, nblk), lambda ci, r: (ci, r)),

@@ -45,7 +45,8 @@ class StatsProcessor(BasicProcessor):
         from ..config.model_config import BinningAlgorithm
         exact_alg = mc.stats.binningAlgorithm in (BinningAlgorithm.MunroPat,
                                                   BinningAlgorithm.MunroPatI)
-        num_acc = NumericAccumulator(n_cols=len(num_cols), exact=exact_alg)
+        num_acc = NumericAccumulator(n_cols=len(num_cols), exact=exact_alg,
+                                     unit_weight=not extractor.weight_name)
         cat_acc = CategoricalAccumulator()
         psi_col = mc.stats.psiColumnName if self.params.get("psi") or \
             mc.stats.psiColumnName else None

@@ -86,6 +86,38 @@ def test_equal_positive_binning_balances_positives(rng):
     assert pos_per_bin.std() / pos_per_bin.mean() < 0.35
 
 
+def test_unit_weight_accumulator_matches_weighted_path(rng):
+    """unit_weight=True (the production default when no weight column is
+    configured, pipeline/stats.py) runs the 2-channel device accumulators
+    and mirrors them into the weighted slots at drain — every field must
+    match the 4-channel path fed w=1, including missing aggregation and
+    multi-chunk drains."""
+    n = 12000
+    x = rng.normal(size=(n, 3))
+    valid = rng.random((n, 3)) > 0.1
+    y = (rng.random(n) < 0.3).astype(float)
+    w = np.ones(n)
+    accs = [NumericAccumulator(n_cols=3, unit_weight=uw) for uw in (True, False)]
+    for acc in accs:
+        for s in range(0, n, 4000):   # 3 chunks through the pending lists
+            acc.update_moments(x[s:s + 4000], valid[s:s + 4000])
+        acc.finalize_range()
+        for s in range(0, n, 4000):
+            acc.update_histogram(x[s:s + 4000], valid[s:s + 4000],
+                                 y[s:s + 4000], w[s:s + 4000])
+    a, b = accs
+    for col in range(3):
+        bnds = a.compute_boundaries(BinningMethod.EqualTotal, 8)[col]
+        bnds_b = b.compute_boundaries(BinningMethod.EqualTotal, 8)[col]
+        np.testing.assert_array_equal(bnds, bnds_b)
+        ca, cb = a.bin_counts(col, bnds), b.bin_counts(col, bnds)
+        np.testing.assert_allclose(ca, cb, atol=1e-6)
+        # weighted slots mirror counts exactly when w == 1
+        np.testing.assert_array_equal(ca[:, 2:], ca[:, :2])
+    np.testing.assert_allclose(a.missing_agg, b.missing_agg, atol=1e-6)
+    assert a.missing_agg[:, :2].sum() == (~valid).sum()
+
+
 def test_missing_values_go_to_last_bin(rng):
     x = rng.normal(size=(1000, 1))
     valid = rng.random((1000, 1)) > 0.2
