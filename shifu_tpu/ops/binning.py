@@ -59,6 +59,24 @@ def _moments_kernel(x: jnp.ndarray, valid: jnp.ndarray):
     return cnt, mean, m2, m3, m4, mn, mx
 
 
+def _stat_channels(target, weight, unit_weight: bool):
+    """Per-row stat channels + their bf16-exactness flags: [pos, neg]
+    (0/1 indicators, exact) or [pos, neg, w_pos, w_neg] — the ONE place
+    that knows the channel order (histogram and missing-bin aggregation
+    must never disagree on it)."""
+    R = target.shape[0]
+    is_pos = (target >= 0.5)[:, None]
+    ones = jnp.ones((R, 1), jnp.float32)
+    pos_i = jnp.where(is_pos, ones, 0.0)
+    neg_i = jnp.where(is_pos, 0.0, ones)
+    if unit_weight:
+        return jnp.concatenate([pos_i, neg_i], axis=1), (True, True)
+    w = weight[:, None]
+    return jnp.concatenate(
+        [pos_i, neg_i, jnp.where(is_pos, w, 0.0),
+         jnp.where(is_pos, 0.0, w)], axis=1), (True, True, False, False)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("num_buckets", "use_pallas",
                                     "unit_weight", "expand"))
@@ -87,20 +105,7 @@ def _histogram_kernel(x: jnp.ndarray, valid: jnp.ndarray, target: jnp.ndarray,
     R, C = x.shape
     scale = num_buckets / jnp.maximum(hi - lo, 1e-30)
     idx = jnp.clip(((x - lo) * scale), 0, num_buckets - 1).astype(jnp.int32)
-    is_pos = (target >= 0.5)[:, None]
-    ones = jnp.ones((R, 1), x.dtype)
-    pos_i = jnp.where(is_pos, ones, 0.0)
-    neg_i = jnp.where(is_pos, 0.0, ones)
-    if unit_weight:
-        vals = jnp.concatenate([pos_i, neg_i], axis=1)           # [R, 2]
-        exact = (True, True)
-    else:
-        w = weight[:, None]
-        vals = jnp.concatenate([
-            pos_i, neg_i,
-            jnp.where(is_pos, w, 0.0), jnp.where(is_pos, 0.0, w)],
-            axis=1)                                              # [R, 4]
-        exact = (True, True, False, False)
+    vals, exact = _stat_channels(target, weight, unit_weight)
     if use_pallas:
         from .hist_pallas import stats_histograms_pallas, target_platform
         cidx = jnp.where(valid, idx, -1)     # invalid cell -> matches no bin
@@ -152,18 +157,8 @@ def _missing_agg_kernel(valid, target, weight, unit_weight: bool = False,
     passes over the [R, C] mask.  HIGHEST precision keeps f32-faithful
     accumulation (counts are exact integers below 2^24; the bounded
     drain in :class:`NumericAccumulator` keeps them there)."""
-    R = valid.shape[0]
     inval = (~valid).astype(jnp.float32)               # [R, C]
-    is_pos = (target >= 0.5)[:, None]
-    ones = jnp.ones((R, 1), jnp.float32)
-    pos_i = jnp.where(is_pos, ones, 0.0)
-    neg_i = jnp.where(is_pos, 0.0, ones)
-    if unit_weight:
-        vals = jnp.concatenate([pos_i, neg_i], axis=1)
-    else:
-        w = weight[:, None]
-        vals = jnp.concatenate([pos_i, neg_i, jnp.where(is_pos, w, 0.0),
-                                jnp.where(is_pos, 0.0, w)], axis=1)
+    vals, _ = _stat_channels(target, weight, unit_weight)
     magg = jax.lax.dot_general(inval, vals, (((0,), (0,)), ((), ())),
                                precision=jax.lax.Precision.HIGHEST,
                                preferred_element_type=jnp.float32)  # [C, S]
@@ -201,6 +196,7 @@ class NumericAccumulator:
     exact: bool = False
     _exact_cols: Optional[list] = None     # [C] lists of (vals, pos, w)
     _pend_moments: list = field(default_factory=list)  # [7, C] device chunks
+    _pend_moment_rows: int = 0
     _hist_dev: Optional[object] = None     # [C, K, 4] f32 on device
     _magg_dev: Optional[object] = None     # [C, 4] f32 on device
     _pend_hist_rows: int = 0
@@ -216,12 +212,16 @@ class NumericAccumulator:
         out = _moments_kernel(jnp.asarray(x, jnp.float32), jnp.asarray(valid))
         self._pend_moments.append(jnp.stack(out))      # [7, C], stays on device
         self.total_rows += x.shape[0]
+        self._pend_moment_rows += x.shape[0]
+        if self._pend_moment_rows >= self.DRAIN_ROWS:  # bound the pending
+            self._drain_moments()                      # list and its HBM
 
     def _drain_moments(self) -> None:
         if not self._pend_moments:
             return
         chunks = np.asarray(jnp.stack(self._pend_moments), np.float64)
         self._pend_moments.clear()
+        self._pend_moment_rows = 0
         for m in chunks:                               # Chan combine in f64
             self.moments = _combine_moments(self.moments, tuple(m))
         # invalid cells among processed rows = rows - valid count

@@ -56,7 +56,7 @@ def _bf16_split(a):
 
 def _hist_kernel(bins_ref, node_ref, stats_ref, out_ref, *, n_stats: int,
                  n_nodes: int, b_pad: int, nblk: int, cblk: int,
-                 pair: bool = False):
+                 pair: bool = False, exact: bool = False):
     r = pl.program_id(1)
 
     @pl.when(r == 0)
@@ -70,15 +70,47 @@ def _hist_kernel(bins_ref, node_ref, stats_ref, out_ref, *, n_stats: int,
     # split gains, and the reference accumulates in double
     # (``DTWorker.java:850-852``) — plain bf16 rounding shifted chosen
     # thresholds measurably (2.5% cell error at bench shapes), the hi/lo
-    # split does not.
-    a_hi, a_lo = [], []
-    for s in range(n_stats):
-        a = node1h * stats_ref[s:s + 1, :]                # [K, nblk] f32
-        hi_b, lo_b = _bf16_split(a)
-        a_hi.append(hi_b)
-        a_lo.append(lo_b)
+    # split does not.  ``exact=True`` (every stats value bf16-exact —
+    # integer bag counts x 0/1 targets, the RF-without-weight-column
+    # case) skips the split and the recovery dot entirely.
+    #
+    # Stat-channel PAIRS pack along the sublane axis ([2K, nblk] left
+    # operands, K <= K_MAX = 64): one dot drives a full 128-row MXU tile
+    # where per-channel dots drove two half-empty ones.
+    a_hi, a_lo = [], []                  # per channel-GROUP operands
+    groups = []                          # (s0, n_in_group)
+    s = 0
+    while s < n_stats:
+        g = 2 if s + 1 < n_stats else 1
+        a = jnp.concatenate(
+            [node1h * stats_ref[s + j:s + j + 1, :] for j in range(g)],
+            axis=0)                       # [g*K, nblk] f32
+        if exact:
+            a_hi.append(a.astype(jnp.bfloat16))
+            a_lo.append(None)
+        else:
+            hi_b, lo_b = _bf16_split(a)
+            a_hi.append(hi_b)
+            a_lo.append(lo_b)
+        groups.append((s, g))
+        s += g
     dims = (((1,), (1,)), ((), ()))
     half = LANE // 2
+
+    def accumulate(oneh, store):
+        """One (or two) dots per channel group; ``store(gi, s, acc_s)``
+        writes channel s's [K, LANE] slice."""
+        for gi, (s0, g) in enumerate(groups):
+            acc = jax.lax.dot_general(
+                a_hi[gi], oneh, dims,
+                preferred_element_type=jnp.float32)       # [g*K, LANE]
+            if a_lo[gi] is not None:
+                acc += jax.lax.dot_general(
+                    a_lo[gi], oneh, dims,
+                    preferred_element_type=jnp.float32)
+            for j in range(g):
+                store(s0 + j, acc[j * n_nodes:(j + 1) * n_nodes, :])
+
     if pair:
         # n_bins <= 64: pack TWO features per 128-lane tile (lanes 0-63 =
         # feature cf's bins, 64-127 = feature cf+1's) — halves the dots
@@ -90,15 +122,11 @@ def _hist_kernel(bins_ref, node_ref, stats_ref, out_ref, *, n_stats: int,
             bview_b = bins_ref[cf + 1:cf + 2, :]
             oneh = (lane_val == jnp.where(lo_half, bview_a, bview_b)) \
                 .astype(jnp.bfloat16)                     # [LANE, nblk]
-            for s in range(n_stats):
-                acc = jax.lax.dot_general(
-                    a_hi[s], oneh, dims,
-                    preferred_element_type=jnp.float32)   # [K, LANE]
-                acc += jax.lax.dot_general(
-                    a_lo[s], oneh, dims,
-                    preferred_element_type=jnp.float32)
-                out_ref[cf, s, :, :] += acc[:, :half]
-                out_ref[cf + 1, s, :, :] += acc[:, half:]
+
+            def store_pair(s, acc_s, cf=cf):
+                out_ref[cf, s, :, :] += acc_s[:, :half]
+                out_ref[cf + 1, s, :, :] += acc_s[:, half:]
+            accumulate(oneh, store_pair)
         return
     for cf in range(cblk):
         bview = bins_ref[cf:cf + 1, :]                    # [1, nblk]
@@ -106,27 +134,28 @@ def _hist_kernel(bins_ref, node_ref, stats_ref, out_ref, *, n_stats: int,
             b_iota = jax.lax.broadcasted_iota(
                 jnp.int32, (LANE, nblk), 0) + bt * LANE
             oneh = (b_iota == bview).astype(jnp.bfloat16)  # [LANE, nblk]
-            for s in range(n_stats):
-                acc = jax.lax.dot_general(
-                    a_hi[s], oneh, dims,
-                    preferred_element_type=jnp.float32)   # [K, LANE]
-                acc += jax.lax.dot_general(
-                    a_lo[s], oneh, dims,
-                    preferred_element_type=jnp.float32)
-                out_ref[cf, s, :, bt * LANE:(bt + 1) * LANE] += acc
+
+            def store_flat(s, acc_s, cf=cf, bt=bt):
+                out_ref[cf, s, :, bt * LANE:(bt + 1) * LANE] += acc_s
+            accumulate(oneh, store_flat)
 
 
 K_MAX = 64   # per-call node cap: the [C_pad, S, K, B_pad] output must sit
              # under the ~16 MB VMEM scoped-allocation limit
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "interpret"))
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "interpret",
+                                   "exact"))
 def build_histograms_pallas(bins, node_idx, stats, n_nodes: int,
-                            n_bins: int, interpret: bool = False):
+                            n_bins: int, interpret: bool = False,
+                            exact: bool = False):
     """Drop-in for :func:`shifu_tpu.ops.tree.build_histograms` on TPU.
 
     bins: [N, C] int32; node_idx: [N] int32 (-1 = inactive);
     stats: [N, S] float32.  Returns [n_nodes, C, n_bins, S] float32.
+    ``exact=True`` asserts every stats value is exactly representable in
+    bfloat16 (small-integer bag counts x 0/1 indicators): the f32-recovery
+    dot is skipped (see ``_hist_kernel``).
 
     Deep levels decompose into K_MAX-node partitions: shifting
     ``node_idx`` by the partition base makes out-of-range rows match no
@@ -137,7 +166,7 @@ def build_histograms_pallas(bins, node_idx, stats, n_nodes: int,
         for k0 in range(0, n_nodes, K_MAX):
             parts.append(build_histograms_pallas(
                 bins, node_idx - k0, stats, min(K_MAX, n_nodes - k0),
-                n_bins, interpret))
+                n_bins, interpret, exact))
         return jnp.concatenate(parts, axis=0)
     n, c = bins.shape
     s = stats.shape[1]
@@ -160,7 +189,7 @@ def build_histograms_pallas(bins, node_idx, stats, n_nodes: int,
     grid = (c_pad // cblk, n_pad // nblk)
     out = pl.pallas_call(
         partial(_hist_kernel, n_stats=s, n_nodes=n_nodes, b_pad=b_pad,
-                nblk=nblk, cblk=cblk, pair=pair),
+                nblk=nblk, cblk=cblk, pair=pair, exact=exact),
         grid=grid,
         in_specs=[
             pl.BlockSpec((cblk, nblk), lambda ci, r: (ci, r)),
@@ -180,7 +209,8 @@ def build_histograms_pallas(bins, node_idx, stats, n_nodes: int,
 
 
 def build_histograms_sharded(bins, node_idx, stats, n_nodes: int,
-                             n_bins: int, mesh, interpret: bool = False):
+                             n_bins: int, mesh, interpret: bool = False,
+                             exact: bool = False):
     """Mesh lowering of the kernel: ``shard_map`` over the ``data`` axis.
 
     A ``pallas_call`` is opaque to the GSPMD partitioner, so under a
@@ -198,7 +228,8 @@ def build_histograms_sharded(bins, node_idx, stats, n_nodes: int,
     from jax.sharding import PartitionSpec as P
 
     def local(b, ni, st):
-        h = build_histograms_pallas(b, ni, st, n_nodes, n_bins, interpret)
+        h = build_histograms_pallas(b, ni, st, n_nodes, n_bins, interpret,
+                                    exact)
         return jax.lax.psum(h, "data")
 
     return jax.shard_map(

@@ -72,6 +72,9 @@ class DTSettings:
     resume: bool = False
     n_classes: int = 0                   # >2: RF multiclass NATIVE mode
     max_leaves: int = 0                  # >0: leaf-wise node budget
+    stats_exact: bool = False            # weights promised small-integer
+                                         # (no weight column): RF hist
+                                         # kernel skips f32-recovery dots
 
 
 def settings_from_params(params: Dict[str, Any], train_conf,
@@ -234,11 +237,23 @@ def _gbt_forest_multi(n_bins: int, depth: int, impurity: str, loss: str,
                                      0)))
 
 
+def _stats_bf16_exact(w) -> bool:
+    """True when every weight is a small non-negative integer, so RF stat
+    channels (Poisson bag counts x weights x 0/1 targets) are exactly
+    representable in bfloat16 and the histogram kernel may skip its
+    f32-recovery dots (``ops/hist_pallas._hist_kernel``, ~1.6x).  Bag
+    counts cap at 16, so w <= 16 keeps products <= 256 (bf16-exact)."""
+    w = np.asarray(w)
+    return bool(w.size and (w >= 0).all() and (w <= 16).all()
+                and (np.mod(w, 1) == 0).all())
+
+
 def _rf_round_impl(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
                    min_instances, min_gain, n_bins: int, depth: int,
                    impurity: str, loss: str, poisson: bool,
                    n_classes: int = 0, use_pallas: bool = False,
-                   max_leaves: int = 0, has_cat: bool = True, mesh=None):
+                   max_leaves: int = 0, has_cat: bool = True, mesh=None,
+                   stats_exact: bool = False):
     """One RF tree on device: Poisson bag → grow → oob accumulate →
     loss-consistent oob validation error (reference oob-as-validation,
     ``DTWorker.java:582-616``; round 1 hardcoded squared error).
@@ -261,7 +276,7 @@ def _rf_round_impl(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
     sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
                                     impurity, min_instances, min_gain,
                                     n_classes, use_pallas, max_leaves,
-                                    has_cat, mesh)
+                                    has_cat, mesh, stats_exact)
     pred = predict_tree(sf, lm, lv, bins, depth)   # [n, K] mc, [n] binary
     oob = (bag == 0) & (w > 0)
     if multiclass:
@@ -312,7 +327,8 @@ def _rf_forest_impl(bins, y, w, base_key, tree_ids, bag_rate, oob_sum,
                     n_bins: int, depth: int, impurity: str, loss: str,
                     poisson: bool, n_classes: int, n_trees: int,
                     use_pallas: bool = False, max_leaves: int = 0,
-                    has_cat: bool = True, mesh=None):
+                    has_cat: bool = True, mesh=None,
+                    stats_exact: bool = False):
     """A chunk of the RF forest as ONE executable (see :func:`_gbt_forest`).
     Per-tree keys fold the tree id into the base key on device — identical
     draws to the per-tree path, so resumed and scanned runs agree."""
@@ -325,7 +341,8 @@ def _rf_forest_impl(bins, y, w, base_key, tree_ids, bag_rate, oob_sum,
         sf, lm, lv, gfi, oob_sum2, oob_cnt2, tr, va = _rf_round_impl(
             bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
             min_instances, min_gain, n_bins, depth, impurity, loss,
-            poisson, n_classes, use_pallas, max_leaves, has_cat, mesh)
+            poisson, n_classes, use_pallas, max_leaves, has_cat, mesh,
+            stats_exact)
         return (oob_sum2, oob_cnt2), _pack_tree_impl(sf, lm, lv, gfi, tr, va)
 
     (oob_sum, oob_cnt), packed = jax.lax.scan(
@@ -336,14 +353,14 @@ def _rf_forest_impl(bins, y, w, base_key, tree_ids, bag_rate, oob_sum,
 _rf_forest = partial(jax.jit, static_argnames=(
     "n_bins", "depth", "impurity", "loss", "poisson", "n_classes",
     "n_trees", "use_pallas", "max_leaves", "has_cat",
-    "mesh"))(_rf_forest_impl)
+    "mesh", "stats_exact"))(_rf_forest_impl)
 
 
 @lru_cache(maxsize=None)
 def _rf_forest_multi(n_bins: int, depth: int, impurity: str, loss: str,
                      poisson: bool, n_classes: int, n_trees: int,
                      use_pallas: bool, max_leaves: int, has_cat: bool,
-                     mesh=None):
+                     mesh=None, stats_exact: bool = False):
     """vmapped :func:`_rf_forest_impl` over a leading member axis (see
     :func:`_gbt_forest_multi`); members vary in weights, keys, oob state,
     feature subsets, bag rate and the traced scalar hypers."""
@@ -353,7 +370,7 @@ def _rf_forest_multi(n_bins: int, depth: int, impurity: str, loss: str,
                                oob_sum, oob_cnt, fa_all, cat, mi, mg,
                                n_bins, depth, impurity, loss, poisson,
                                n_classes, n_trees, use_pallas, max_leaves,
-                               has_cat, mesh)
+                               has_cat, mesh, stats_exact)
     return jax.jit(jax.vmap(one,
                             in_axes=(None, None, 0, 0, None, 0, 0, 0, 0,
                                      None, 0, 0)))
@@ -584,6 +601,7 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
     """Independent Poisson-bagged trees; out-of-bag rows score validation
     with the configured loss."""
     n, c = bins.shape
+    se = settings.stats_exact or _stats_bf16_exact(w)
     bins_d = _put_bins(mesh, bins, n_bins)
     y_d, w_d = _device_put_rows(
         mesh, np.asarray(y, np.float32), np.asarray(w, np.float32))
@@ -647,7 +665,7 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
             settings.min_instances, settings.min_gain, n_bins,
             settings.depth, settings.impurity, settings.loss,
             settings.poisson_bagging, settings.n_classes, chunk, up,
-            settings.max_leaves, hc, _hist_mesh(mesh))
+            settings.max_leaves, hc, _hist_mesh(mesh), se)
         before = len(history)
         absorb(np.asarray(packed), with_history=True)
         if progress:
@@ -816,7 +834,8 @@ def train_rf_bagged(bins, y, w_m, n_bins: int, cat_mask,
     fn = _rf_forest_multi(n_bins, s0.depth, s0.impurity, s0.loss,
                           s0.poisson_bagging, s0.n_classes, s0.n_trees,
                           _use_pallas(mesh), s0.max_leaves, hc,
-                          _hist_mesh(mesh))
+                          _hist_mesh(mesh),
+                          s0.stats_exact or _stats_bf16_exact(w_m))
     _, _, packed = fn(bins_d, y_d, w_d, base_key, tree_ids, bag_rate,
                       oob_sum, oob_cnt, fa_all, cat, mi, mg)
     total = n_tree_nodes(s0.depth)
@@ -854,10 +873,12 @@ def _gbt_window_hist(hist, bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level",
-                                   "use_pallas", "mesh", "n_classes"))
+                                   "use_pallas", "mesh", "n_classes",
+                                   "stats_exact"))
 def _rf_window_hist(hist, bins_w, y_w, w_w, bag_w, sf, lm, n_nodes: int,
                     n_bins: int, level: int, use_pallas: bool = False,
-                    mesh=None, n_classes: int = 0):
+                    mesh=None, n_classes: int = 0,
+                    stats_exact: bool = False):
     """``hist`` accumulator as input — see :func:`_gbt_window_hist` on why
     window programs must chain."""
     bw_w = w_w * bag_w
@@ -869,7 +890,7 @@ def _rf_window_hist(hist, bins_w, y_w, w_w, bag_w, sf, lm, n_nodes: int,
         stats = jnp.stack([bw_w, bw_w * y_w], axis=1) \
             .astype(jnp.float32)
     return hist + build_histograms(bins_w, node_idx, stats, n_nodes,
-                                   n_bins, use_pallas, mesh)
+                                   n_bins, use_pallas, mesh, stats_exact)
 
 
 @partial(jax.jit, static_argnames=("depth", "loss"))
@@ -1024,11 +1045,12 @@ def _gbt_tree_fused(wins, fa, cat, lr, min_instances, min_gain,
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
                                    "use_pallas", "max_leaves", "has_cat",
-                                   "mesh", "n_classes"))
+                                   "mesh", "n_classes", "stats_exact"))
 def _rf_tree_fused(wins, fa, cat, min_instances, min_gain, n_bins: int,
                    depth: int, impurity: str, loss: str,
                    use_pallas: bool, max_leaves: int, has_cat: bool,
-                   mesh=None, n_classes: int = 0):
+                   mesh=None, n_classes: int = 0,
+                   stats_exact: bool = False):
     """One streamed RF tree over a FULLY-RESIDENT window cache as a single
     executable (see :func:`_gbt_tree_fused`).  ``wins``: tuple of
     (bins, y, w, bag, oob_sum, oob_cnt) per window.  Returns
@@ -1057,7 +1079,7 @@ def _rf_tree_fused(wins, fa, cat, min_instances, min_gain, n_bins: int,
                                   axis=1).astype(jnp.float32)
             hist = hist + build_histograms(bins_w, node_idx, stats,
                                            n_nodes, n_bins, use_pallas,
-                                           mesh)
+                                           mesh, stats_exact)
         sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
             hist, cat, fa, impurity, min_instances, min_gain, has_cat,
             level, depth, max_leaves, sf, lm, lv, nodes_cnt, fi_add,
@@ -1075,18 +1097,26 @@ def _rf_tree_fused(wins, fa, cat, min_instances, min_gain, n_bins: int,
     return packed, tuple(new_oob)
 
 
-def _device_put_window(mesh, arrays: Dict[str, np.ndarray]) -> Dict[str, Any]:
-    """Place a window's arrays: mesh-sharded over the data axis when a mesh
-    is given (rows must divide the axis), plain device arrays otherwise."""
+@lru_cache(maxsize=None)
+def _row_unstack(k: int):
+    return jax.jit(lambda d: tuple(d[i] for i in range(k)))
+
+
+def _put_row_floats(mesh, cols: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """A window's per-row f32 columns in ONE wire transfer: host-stack to
+    [K, W], put, unstack on device (slices propagate the data sharding).
+    Every host→device put pays a fixed protocol cost on top of bandwidth
+    (~25 ms on the bench tunnel) — per-column puts made streamed-window
+    prep transfer-bound."""
+    keys = list(cols)
+    stacked = np.stack([np.asarray(cols[k], np.float32) for k in keys])
     if mesh is None:
-        return {k: jnp.asarray(v) for k, v in arrays.items()}
-    from jax.sharding import NamedSharding
-    from jax.sharding import PartitionSpec as P
-    out = {}
-    for k, a in arrays.items():
-        spec = P("data") if a.ndim == 1 else P("data", None)
-        out[k] = jax.device_put(a, NamedSharding(mesh, spec))
-    return out
+        d = jnp.asarray(stacked)
+    else:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        d = jax.device_put(stacked, NamedSharding(mesh, P(None, "data")))
+    return dict(zip(keys, _row_unstack(len(keys))(d)))
 
 
 def _require_divisible(stream, mesh) -> None:
@@ -1137,7 +1167,7 @@ def _gbt_prepare(mesh, valid_rate: float, seed: int, n_bins: int,
         y = y_raw
         if y_transform is not None:
             y = np.asarray(y_transform(y), np.float32)
-        dev = _device_put_window(mesh, {"y": y, "tw": tw, "vw": vw})
+        dev = _put_row_floats(mesh, {"y": y, "tw": tw, "vw": vw})
         dev["bins"] = _put_bins(mesh, win.arrays["bins"], n_bins)
         return PreparedWindow(win.start, win.n_valid, win.rows,
                               win.index, dev)
@@ -1397,7 +1427,7 @@ def _rf_prepare(mesh, n_bins: int, y_transform=None, mask_fn=None):
             w *= mask_fn(win.index, y)[0].astype(np.float32)
         if y_transform is not None:
             y = np.asarray(y_transform(y), np.float32)
-        dev = _device_put_window(mesh, {"y": y, "w": w})
+        dev = _put_row_floats(mesh, {"y": y, "w": w})
         dev["bins"] = _put_bins(mesh, win.arrays["bins"], n_bins)
         return PreparedWindow(win.start, win.n_valid, win.rows,
                               win.index, dev)
@@ -1548,7 +1578,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                 wins, fa, cat, settings.min_instances, settings.min_gain,
                 n_bins, settings.depth, settings.impurity, settings.loss,
                 up, settings.max_leaves, hc, _hist_mesh(mesh),
-                settings.n_classes)
+                settings.n_classes, settings.stats_exact)
             for it, pair in zip(items, new_oob):
                 it.arrays["oob"] = pair
             pending_rf.append(packed_d)
@@ -1573,7 +1603,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                     hist, it.arrays["bins"], it.arrays["y"],
                     it.arrays["w"], window_bag(ti, it), sf, lm, n_nodes,
                     n_bins, level, up, _hist_mesh(mesh),
-                    settings.n_classes)
+                    settings.n_classes, settings.stats_exact)
             sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
                 hist, cat, fa, settings.impurity, settings.min_instances,
                 settings.min_gain, hc, level, settings.depth,
@@ -1989,9 +2019,12 @@ def _run_tree_multi(proc, shards, col_nums, cat_mask, n_bins, alg,
 
     base = settings_from_params(mc.train.params if not is_gs else trials[0],
                                 mc.train, alg)
+    base.stats_exact = not mc.dataSet.weightColumnName
     if is_gs:
         settings_list = [settings_from_params(t, mc.train, alg)
                          for t in trials]
+        for s in settings_list:
+            s.stats_exact = base.stats_exact
         member_trials = list(range(len(trials)))
     else:
         B = kfold if (kfold and kfold > 1) else bags
@@ -2148,6 +2181,10 @@ def run_tree_training(proc) -> int:
     settings = settings_from_params(trials[0], mc.train, alg)
     settings.resume = bool(proc.params.get("resume"))
     settings.checkpoint_dir = proc.paths.checkpoint_dir
+    # no weight column -> RF stat channels are small-integer-exact in bf16
+    # (streamed windows can't inspect the data up front; resident paths
+    # also auto-detect from the weights themselves)
+    settings.stats_exact = not mc.dataSet.weightColumnName
 
     K = len(mc.dataSet.posTags) if mc.is_multi_class() else 0
     if K > 2 and multi:

@@ -37,6 +37,28 @@ def test_pallas_matches_segment_sum(n, c, b, k, s):
     np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-5)
 
 
+@pytest.mark.parametrize("n,c,b,k,s", [(2000, 6, 32, 8, 2),
+                                       (1500, 5, 64, 64, 3)])
+def test_pallas_exact_channels_bit_match(n, c, b, k, s):
+    """``exact=True`` (small-integer stats — RF bag counts x 0/1 targets)
+    must BIT-match the split path: skipping the f32-recovery dot is only
+    legal because the products are exactly representable in bf16."""
+    rng = np.random.default_rng(3)
+    bins = jnp.asarray(rng.integers(0, b, (n, c)), jnp.int32)
+    node = jnp.asarray(rng.integers(-1, k, n), jnp.int32)
+    bag = rng.poisson(1.0, n).astype(np.float32)          # integer counts
+    y = (rng.random(n) < 0.4).astype(np.float32)
+    cols = [bag, bag * y, (bag > 0).astype(np.float32)]
+    stats = jnp.asarray(np.stack(cols[:s], axis=1))
+    a = np.asarray(build_histograms_pallas(bins, node, stats, k, b,
+                                           interpret=True))
+    e = np.asarray(build_histograms_pallas(bins, node, stats, k, b,
+                                           interpret=True, exact=True))
+    np.testing.assert_array_equal(a, e)
+    ref = np.asarray(build_histograms(bins, node, stats, k, b))
+    np.testing.assert_allclose(e, ref, atol=2e-4, rtol=2e-5)
+
+
 def test_sharded_kernel_matches_segment_sum():
     """shard_map'd kernel over the mesh data axis + psum == scatter path
     (the DTWorker→DTMaster merge on ICI, VERDICT r3 item 1)."""
