@@ -330,6 +330,7 @@ def bench_stats(chunk_rows: int = 1 << 18, n_cols: int = 256,
     n_rows = chunk_rows * n_chunks
 
     def sweep() -> None:
+        from shifu_tpu.config.model_config import BinningMethod
         acc = NumericAccumulator(n_cols=n_cols, num_buckets=num_buckets,
                                  unit_weight=True)
         for _ in range(n_chunks):                # pass 1, device-pending
@@ -337,8 +338,10 @@ def bench_stats(chunk_rows: int = 1 << 18, n_cols: int = 256,
         acc.finalize_range()                     # one packed moments drain
         for _ in range(n_chunks):                # pass 2, device-pending
             acc.update_histogram(x, valid, t, w)
-        acc._drain_hist()                        # one packed hist drain
-        assert acc.hist is not None and acc.total_rows == n_rows
+        # device-side finalize: boundaries/bin-stats/percentiles in one
+        # [C, max_bins]-sized fetch — the fine histogram stays in HBM
+        bnds, aggs, _, _ = acc.finalize_sketch(BinningMethod.EqualTotal, 20)
+        assert len(bnds) == n_cols and acc.total_rows == n_rows
 
     sweep()                                      # compile warmup
     best = 0.0

@@ -167,6 +167,89 @@ def _missing_agg_kernel(valid, target, weight, unit_weight: bool = False,
     return magg
 
 
+def _method_weight_col(hist, method_value: str, nch: int):
+    """[C, K] per-fine-bucket weight measure for a binning method (the
+    channel mix ``compute_boundaries`` reads off the histogram)."""
+    pos, neg = hist[..., 0], hist[..., 1]
+    wpos = hist[..., 2] if nch == 4 else pos
+    wneg = hist[..., 3] if nch == 4 else neg
+    return {
+        "EqualPositive": pos,
+        "EqualNegtive": neg,
+        "WeightEqualTotal": wpos + wneg,
+        "WeightEqualPositive": wpos,
+        "WeightEqualNegative": wneg,
+    }.get(method_value, pos + neg)
+
+
+@functools.partial(jax.jit, static_argnames=("method_value", "max_bins",
+                                             "num_buckets", "nch",
+                                             "interval"))
+def _finalize_sketch_kernel(hist, magg, lo, hi, method_value: str,
+                            max_bins: int, num_buckets: int, nch: int,
+                            interval: bool = False):
+    """The whole sketch→ColumnStats reduction ON DEVICE, one packed fetch.
+
+    Replaces the host path (drain the [C, 4096, ch] fine histogram —
+    8-16 MB over a ~35 MB/s link — then per-column numpy cumsums) with
+    device math whose output is only [C, max_bins]-sized.  The
+    fine-bucket→final-bin reduction needs no scatter: boundaries are
+    nondecreasing, so each final bin is a contiguous fine-bucket range
+    and per-bin sums are differences of the channel cumsum gathered at
+    the range ends (the ``UpdateBinningInfoReducer.java:57`` aggregation,
+    reformulated prefix-sum style).
+
+    Returns (boundaries [C, max_bins] incl. leading -inf and possible
+    duplicates — the host dedupes; agg [C, max_bins+1, nch] aligned to
+    the UNdeduped boundaries, missing bin last; pct [C, 3]; distinct [C];
+    totals [C] of the method measure — zero-total columns fall back to
+    the reference's single-bin shape host-side).
+    """
+    C = hist.shape[0]
+    weight_col = _method_weight_col(hist, method_value, nch)     # [C, K]
+    edges = lo[:, None] + (hi - lo)[:, None] * \
+        jnp.arange(num_buckets + 1, dtype=jnp.float32) / num_buckets
+    cum = jnp.cumsum(weight_col, axis=1)                         # [C, K]
+    total = cum[:, -1]                                           # [C]
+    frac = jnp.arange(1, max_bins, dtype=jnp.float32) / max_bins
+    if interval:                                 # EqualInterval: width, not
+        bnd = lo[:, None] + (hi - lo)[:, None] * frac      # population
+    else:
+        targets = total[:, None] * frac                          # [C, B-1]
+        pos = jax.vmap(lambda c, t: jnp.searchsorted(c, t, side="left"))(
+            cum, targets)                                        # [C, B-1]
+        bnd = jnp.take_along_axis(edges, pos + 1, axis=1)        # [C, B-1]
+    bnd_full = jnp.concatenate(
+        [jnp.full((C, 1), NEG_INF, jnp.float32), bnd], axis=1)   # [C, B]
+    # fine bucket k belongs to final bin searchsorted(bnd, edge_k, right)-1;
+    # the assignment is nondecreasing in k, so bin b covers fine buckets
+    # [hi_idx[b-1], hi_idx[b]) where hi_idx[b] = #buckets assigned <= b
+    bucket_bin = jnp.clip(
+        jax.vmap(lambda b, e: jnp.searchsorted(b, e, side="right"))(
+            bnd_full, edges[:, :-1]) - 1, 0, max_bins - 1)       # [C, K]
+    bins_iota = jnp.arange(max_bins)
+    # per-bin sums via a masked reduction rather than cumsum differences:
+    # large-minus-large f32 prefixes put ~1e-5 x TOTAL error on every bin;
+    # direct per-bin summation keeps the error proportional to the bin
+    onehot = (bucket_bin[:, :, None] == bins_iota[None, None, :]) \
+        .astype(hist.dtype)                                      # [C, K, B]
+    agg_bins = jnp.einsum('ckb,cks->cbs', onehot, hist,
+                          precision=jax.lax.Precision.HIGHEST)
+    agg = jnp.concatenate([agg_bins, magg[:, None, :]], axis=1)  # [C,B+1,ch]
+    # percentiles (count measure) to fine-bucket resolution; the count
+    # cumsum is exact (integer sums below 2^24)
+    cnt_cum = jnp.cumsum(hist[..., 0] + hist[..., 1], axis=1)    # [C, K]
+    q = jnp.asarray([0.25, 0.5, 0.75], jnp.float32)
+    qpos = jax.vmap(lambda c, t: jnp.searchsorted(c, t, side="left"))(
+        cnt_cum, cnt_cum[:, -1:] * q[None, :])
+    pct = jnp.take_along_axis(
+        edges, jnp.minimum(qpos + 1, num_buckets), axis=1)       # [C, 3]
+    distinct = (hist.sum(axis=2) > 0).sum(axis=1)                # [C]
+    return jnp.concatenate([
+        bnd_full.reshape(-1), agg.reshape(-1), pct.reshape(-1),
+        distinct.astype(jnp.float32), total])
+
+
 # ------------------------------------------------------------- accumulators
 @dataclass
 class NumericAccumulator:
@@ -293,6 +376,73 @@ class NumericAccumulator:
         self.missing_agg = (magg if self.missing_agg is None
                             else self.missing_agg + magg)
 
+    # ---- device-side finalize (the default stats path)
+    def finalize_sketch(self, method: BinningMethod, max_bins: int):
+        """Boundaries + per-bin stats + percentiles + distinct counts for
+        EVERY column in one small packed fetch — the fine histogram never
+        crosses the link (the drain path moves 8-16 MB at link bandwidth;
+        this moves [C, max_bins]-sized results).
+
+        Returns (boundaries: list of deduped [nb] arrays,
+        aggs: list of [nb+1, 4] bin stats incl. trailing missing bin,
+        pct: [C, 3] p25/median/p75, distinct: [C] ints) — element-exact
+        with ``compute_boundaries`` + ``bin_counts`` + ``percentile`` +
+        ``distinct_estimate`` (the parity test pins it)."""
+        if self.hist is not None:
+            # a mid-pass drain already moved counts to host float64 (>8M
+            # rows); re-uploading as f32 would round counts past 2^24 —
+            # stay on the exact host path for these TB-scale runs
+            self._drain_hist()
+            boundaries = self.compute_boundaries(method, max_bins)
+            aggs = [self.bin_counts(c, boundaries[c])
+                    for c in range(self.n_cols)]
+            pct = np.stack([self.percentile(c, [0.25, 0.5, 0.75])
+                            for c in range(self.n_cols)])
+            distinct = np.array([self.distinct_estimate(c)
+                                 for c in range(self.n_cols)])
+            return boundaries, aggs, pct, distinct
+        nch = 2 if self.unit_weight else 4
+        hist_d = self._hist_dev
+        magg_d = self._magg_dev
+        assert hist_d is not None, "finalize_sketch needs pass-2 data"
+        C, B = self.n_cols, max_bins
+        interval = method == BinningMethod.EqualInterval
+        packed = np.asarray(_finalize_sketch_kernel(
+            hist_d, magg_d, self._lo_d, self._hi_d, method.value,
+            B, self.num_buckets, nch, interval), np.float64)
+        bnd_all, agg_all, pct, distinct, totals = np.split(
+            packed, np.cumsum([C * B, C * (B + 1) * nch, C * 3, C]))
+        bnd_all = bnd_all.reshape(C, B)
+        agg_all = agg_all.reshape(C, B + 1, nch)
+        pct = pct.reshape(C, 3)
+        # all-missing columns have no percentiles (host path returns NaN,
+        # serialized as null — not the empty-range fallback edge value)
+        pct[np.asarray(self.moments["count"]) <= 0] = np.nan
+        if nch == 2:                  # w_pos/w_neg mirror the counts
+            agg_all = np.concatenate([agg_all, agg_all], axis=2)
+        boundaries, aggs = [], []
+        for c in range(C):
+            if totals[c] <= 0 and not interval:
+                # reference single-bin shape for a zero-measure column
+                boundaries.append(np.array([NEG_INF]))
+                agg = np.zeros((2, 4))
+                agg[0] = agg_all[c, :B].sum(axis=0)
+                agg[1] = agg_all[c, B]
+                aggs.append(agg)
+                continue
+            bnds = bnd_all[c]
+            keep = np.ones(B, bool)
+            keep[1:] = np.diff(bnds) > 0              # _dedupe semantics
+            # undeduped bin j collapses onto the last kept boundary <= j
+            dd = np.cumsum(keep) - 1
+            nb = int(keep.sum())
+            agg = np.zeros((nb + 1, 4))
+            np.add.at(agg, dd, agg_all[c, :B])
+            agg[nb] = agg_all[c, B]
+            boundaries.append(bnds[keep])
+            aggs.append(agg)
+        return boundaries, aggs, pct, distinct.astype(np.int64)
+
     # ---- boundary derivation
     def bucket_edges(self, col: int) -> np.ndarray:
         return np.linspace(self.lo[col], self.hi[col], self.num_buckets + 1)
@@ -310,15 +460,8 @@ class NumericAccumulator:
                 bnds = np.concatenate([[NEG_INF], inner[1:]])
                 out.append(_dedupe(bnds))
                 continue
-            weight_col = {
-                BinningMethod.EqualTotal: h[:, 0] + h[:, 1],
-                BinningMethod.EqualPositive: h[:, 0],
-                BinningMethod.EqualNegtive: h[:, 1],
-                BinningMethod.WeightEqualTotal: h[:, 2] + h[:, 3],
-                BinningMethod.WeightEqualPositive: h[:, 2],
-                BinningMethod.WeightEqualNegative: h[:, 3],
-                BinningMethod.WeightEqualInterval: h[:, 0] + h[:, 1],
-            }.get(method, h[:, 0] + h[:, 1])
+            # same channel mix as the device finalize (one mapping)
+            weight_col = _method_weight_col(h[None], method.value, 4)[0]
             total = weight_col.sum()
             if total <= 0:
                 out.append(np.array([NEG_INF]))

@@ -132,13 +132,17 @@ class StatsProcessor(BasicProcessor):
                           acc: NumericAccumulator, total_rows: int) -> None:
         mc = self.model_config
         # MunroPat/MunroPatI: exact data quantiles; everything else: the
-        # streaming fine-histogram sketch (SPDT-family stand-in)
+        # streaming fine-histogram sketch (SPDT-family stand-in), reduced
+        # to boundaries/bin-stats/percentiles ON DEVICE — the fine
+        # histogram never crosses the host link (finalize_sketch)
+        sketch = None
         if acc.exact:
             boundaries = acc.compute_boundaries_exact(mc.stats.binningMethod,
                                                       mc.stats.maxNumBin)
         else:
-            boundaries = acc.compute_boundaries(mc.stats.binningMethod,
-                                                mc.stats.maxNumBin)
+            sketch = acc.finalize_sketch(mc.stats.binningMethod,
+                                         mc.stats.maxNumBin)
+            boundaries = sketch[0]
         # skew/kurt directly from central moments (more stable than power sums)
         cnt = np.maximum(acc.moments["count"], 1.0)
         m2 = acc.moments["M2"] / cnt
@@ -154,7 +158,7 @@ class StatsProcessor(BasicProcessor):
             # exact mode counts from the materialized rows (mid-bucket
             # boundaries would misassign ties through the sketch)
             agg = acc.bin_counts_exact(i, bnds) if acc.exact \
-                else acc.bin_counts(i, bnds)   # [bins+1, 4]
+                else sketch[1][i]              # [bins+1, 4]
             cpos, cneg, wpos, wneg = agg[:, 0], agg[:, 1], agg[:, 2], agg[:, 3]
             cm = column_metrics(cneg[None, :], cpos[None, :])
             wm = column_metrics(wneg[None, :], wpos[None, :])
@@ -170,9 +174,11 @@ class StatsProcessor(BasicProcessor):
             st.stdDev = _f(std[i] if count > 1 else None)
             st.skewness = _f(skew[i])
             st.kurtosis = _f(kurt[i])
-            p = acc.percentile(i, [0.25, 0.5, 0.75])
+            p = acc.percentile(i, [0.25, 0.5, 0.75]) if acc.exact \
+                else sketch[2][i]
             st.p25th, st.median, st.p75th = _f(p[0]), _f(p[1]), _f(p[2])
-            st.distinctCount = acc.distinct_estimate(i)
+            st.distinctCount = acc.distinct_estimate(i) if acc.exact \
+                else int(sketch[3][i])
             st.ks = _f(cm.ks[0])
             st.iv = _f(cm.iv[0])
             st.woe = _f(cm.woe[0])

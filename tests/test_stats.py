@@ -118,6 +118,92 @@ def test_unit_weight_accumulator_matches_weighted_path(rng):
     assert a.missing_agg[:, :2].sum() == (~valid).sum()
 
 
+@pytest.mark.parametrize("method", [BinningMethod.EqualTotal,
+                                    BinningMethod.EqualPositive,
+                                    BinningMethod.EqualInterval,
+                                    BinningMethod.WeightEqualTotal])
+def test_finalize_sketch_matches_host_path(rng, method):
+    """The device-side finalize (one small packed fetch) must reproduce
+    the host drain path: same deduped boundaries (f32 rounding only),
+    bit-equal bin aggregates, same percentiles/distinct."""
+    n = 9000
+    x = rng.normal(size=(n, 4))
+    x[:, 2] = np.round(x[:, 2])          # few distinct values: dedupe path
+    valid = rng.random((n, 4)) > 0.08
+    y = (rng.random(n) < 0.35).astype(float)
+    w = rng.uniform(0.5, 2.0, n)
+    accs = [NumericAccumulator(n_cols=4) for _ in range(2)]
+    for acc in accs:
+        for s in range(0, n, 3000):
+            acc.update_moments(x[s:s + 3000], valid[s:s + 3000])
+        acc.finalize_range()
+        for s in range(0, n, 3000):
+            acc.update_histogram(x[s:s + 3000], valid[s:s + 3000],
+                                 y[s:s + 3000], w[s:s + 3000])
+    dev, host = accs
+    bnds_d, aggs_d, pct_d, dist_d = dev.finalize_sketch(method, 8)
+    bnds_h = host.compute_boundaries(method, 8)
+    for c in range(4):
+        assert len(bnds_d[c]) == len(bnds_h[c]), (c, bnds_d[c], bnds_h[c])
+        np.testing.assert_allclose(bnds_d[c][1:], bnds_h[c][1:],
+                                   rtol=2e-6, atol=1e-6)
+        agg_h = host.bin_counts(c, bnds_h[c])
+        # EqualInterval boundaries land exactly ON fine-bucket edges; the
+        # host f64 linspace rounds the tie by +-1 ulp either way (device
+        # f32 arithmetic ties exactly), so one fine bucket's rows may sit
+        # in the adjacent bin — allow exactly that much there
+        atol = 6.0 if method == BinningMethod.EqualInterval else 1e-4
+        np.testing.assert_allclose(aggs_d[c], agg_h, rtol=1e-6, atol=atol)
+        np.testing.assert_allclose(
+            pct_d[c], host.percentile(c, [0.25, 0.5, 0.75]),
+            rtol=2e-6, atol=1e-6)
+        assert dist_d[c] == host.distinct_estimate(c)
+
+
+def test_finalize_sketch_drained_fallback_and_missing_pct(rng):
+    """After a mid-pass drain (TB-scale path) finalize_sketch must take
+    the exact f64 host route (no f32 re-upload); an all-missing column
+    reports NaN percentiles, not the empty-range fallback edge."""
+    n = 4000
+    x = rng.normal(size=(n, 2))
+    valid = np.ones((n, 2), bool)
+    valid[:, 1] = False                    # column 1: all missing
+    y = (rng.random(n) < 0.3).astype(float)
+    accs = [NumericAccumulator(n_cols=2, unit_weight=True) for _ in range(2)]
+    for acc in accs:
+        acc.update_moments(x, valid)
+        acc.finalize_range()
+        acc.update_histogram(x, valid, y, np.ones(n))
+    drained, live = accs
+    drained._drain_hist()                  # simulate the >8M-row drain
+    assert drained.hist is not None and drained._hist_dev is None
+    for acc in accs:
+        bnds, aggs, pct, dist = acc.finalize_sketch(BinningMethod.EqualTotal, 6)
+        assert np.isnan(pct[1]).all()      # no data -> no percentiles
+        assert not np.isnan(pct[0]).any()
+        assert aggs[1][-1, :2].sum() == n  # all rows in the missing bin
+    b_d, a_d, p_d, _ = drained.finalize_sketch(BinningMethod.EqualTotal, 6)
+    b_l, a_l, p_l, _ = live.finalize_sketch(BinningMethod.EqualTotal, 6)
+    np.testing.assert_allclose(b_d[0][1:], b_l[0][1:], rtol=2e-6, atol=1e-6)
+    np.testing.assert_allclose(a_d[0], a_l[0], rtol=1e-5, atol=1e-3)
+
+
+def test_finalize_sketch_zero_measure_column(rng):
+    """A column with zero positives under EqualPositive collapses to the
+    reference single-bin shape (host fallback off the packed totals)."""
+    n = 2000
+    x = rng.normal(size=(n, 1))
+    y = np.zeros(n)                       # no positives at all
+    acc = NumericAccumulator(n_cols=1, unit_weight=True)
+    acc.update_moments(x, np.ones_like(x, bool))
+    acc.finalize_range()
+    acc.update_histogram(x, np.ones_like(x, bool), y, np.ones(n))
+    bnds, aggs, _, _ = acc.finalize_sketch(BinningMethod.EqualPositive, 8)
+    assert len(bnds[0]) == 1 and bnds[0][0] == float("-inf")
+    assert aggs[0].shape == (2, 4)
+    assert aggs[0][0, 1] == n             # all rows in the single bin (neg)
+
+
 def test_missing_values_go_to_last_bin(rng):
     x = rng.normal(size=(1000, 1))
     valid = rng.random((1000, 1)) > 0.2
