@@ -44,7 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-m", dest="description", default=None,
                     help="model-set description (reference `new -m`)")
 
-    sub.add_parser("init", help="build initial ColumnConfig.json from header")
+    sp = sub.add_parser("init",
+                        help="build initial ColumnConfig.json from header")
+    sp.add_argument("-model", dest="init_model", action="store_true",
+                    help="fill the algorithm's default train#params into "
+                    "ModelConfig.json (reference `init -model`)")
 
     sp = sub.add_parser("stats", help="per-column stats + binning (+psi/correlation)")
     sp.add_argument("-correlation", "-c", dest="correlation", action="store_true")
@@ -101,7 +105,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("export", help="export model "
                         "(pmml|baggingpmml|bagging|columnstats|woemapping|corr)")
+    sp.add_argument("type_pos", nargs="?", default=None, metavar="TYPE",
+                    help="same as -t (`shifu export pmml`)")
     sp.add_argument("-t", "--type", default="pmml")
+    sp.add_argument("-c", dest="concise", action="store_true",
+                    help="concise PMML: trim per-bin stats extensions "
+                    "(reference `export -c`)")
 
     sp = sub.add_parser("analysis", help="model spec analysis "
                         "(-fi MODEL: tree feature importance)")
@@ -175,6 +184,9 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                          description=args.description)
         return 0
     if cmd == "init":
+        if getattr(args, "init_model", False):
+            from .pipeline.create import check_algorithm_param
+            return check_algorithm_param(args.dir)
         from .pipeline.create import InitProcessor
         return InitProcessor(args.dir).run()
     if cmd == "stats":
@@ -197,6 +209,8 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
         return EvalProcessor(args.dir, params=vars(args)).run()
     if cmd == "export":
         from .pipeline.export import ExportProcessor
+        if getattr(args, "type_pos", None):
+            args.type = args.type_pos
         return ExportProcessor(args.dir, params=vars(args)).run()
     if cmd == "analysis":
         from .pipeline.analysis import analyze_model_fi

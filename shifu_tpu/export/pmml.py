@@ -214,9 +214,67 @@ def _zscore_transform(df: ET.Element, cc: ColumnConfig, cutoff: float) -> None:
     ET.SubElement(apply_div, "Constant").text = f"{std:.6f}"
 
 
+def _fmt_list(vals) -> str:
+    return "[" + ", ".join(str(v) for v in (vals or [])) + "]"
+
+
+def _model_stats(parent: ET.Element, columns: List[ColumnConfig],
+                 concise: bool) -> None:
+    """ModelStats with per-input UnivariateStats (reference
+    ``core/pmml/builder/impl/ModelStatsCreator.java:60-230``): numeric
+    columns carry NumericInfo (+ ContStats bin intervals unless concise),
+    categoricals a DiscrStats count array (+ bin-count Extensions unless
+    concise)."""
+    ms = ET.SubElement(parent, "ModelStats")
+    for cc in columns:
+        us = ET.SubElement(ms, "UnivariateStats", {"field": cc.columnName})
+        st, bn = cc.columnStats, cc.columnBinning
+        pos = bn.binCountPos or []
+        neg = bn.binCountNeg or []
+
+        def extensions(el: ET.Element) -> None:
+            for name, vals in (("BinCountPos", pos), ("BinCountNeg", neg),
+                               ("BinWeightedCountPos", bn.binWeightedPos),
+                               ("BinWeightedCountNeg", bn.binWeightedNeg),
+                               ("BinPosRate", bn.binPosRate)):
+                ET.SubElement(el, "Extension",
+                              {"name": name, "value": _fmt_list(vals)})
+        if cc.is_categorical():
+            ds = ET.SubElement(us, "DiscrStats")
+            if not concise:      # PMML content model: Extension* first
+                extensions(ds)
+            counts = [int(p) + int(n) for p, n in zip(pos, neg)]
+            arr = ET.SubElement(ds, "Array", {"type": "int",
+                                              "n": str(len(counts))})
+            arr.text = " ".join(str(v) for v in counts)
+        else:
+            attrs = {}
+            for k, v in (("minimum", st.min), ("maximum", st.max),
+                         ("mean", st.mean), ("median", st.median),
+                         ("standardDeviation", st.stdDev)):
+                if v is not None:
+                    attrs[k] = str(v)
+            ET.SubElement(us, "NumericInfo", attrs)
+            if not concise:
+                cs = ET.SubElement(us, "ContStats")
+                extensions(cs)   # PMML content model: Extension* first
+                bb = bn.binBoundary or []
+                for i in range(len(bb)):
+                    right = bb[i + 1] if i + 1 < len(bb) else float("inf")
+                    attrs_i = {"closure": "openClosed"}
+                    # +-inf margins are OMITTED (xs:double has no "inf"
+                    # lexical form; same convention as every Discretize
+                    # interval this file emits)
+                    if np.isfinite(bb[i]):
+                        attrs_i["leftMargin"] = str(bb[i])
+                    if np.isfinite(right):
+                        attrs_i["rightMargin"] = str(right)
+                    ET.SubElement(cs, "Interval", attrs_i)
+
+
 # ----------------------------------------------------------------- models
 def nn_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
-               spec, params) -> ET.ElementTree:
+               spec, params, concise: bool = False) -> ET.ElementTree:
     """NeuralNetwork PMML (reference NNPmmlModelCreator +
     NeuralNetworkModelIntegrator).  One-hot-expanding norms contribute one
     indicator field per bin; net input i == flat feature i."""
@@ -228,6 +286,7 @@ def nn_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
         "activationFunction": _pmml_act(spec.activations[0]
                                         if spec.activations else "tanh")})
     _mining_schema(nn, columns, target)
+    _model_stats(nn, columns, concise)
     feature_names = _local_transformations(nn, columns, model_config)
     if spec.input_dim != len(feature_names):
         raise PmmlUnsupportedError(
@@ -276,7 +335,7 @@ def nn_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
 
 
 def lr_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
-               spec, params) -> ET.ElementTree:
+               spec, params, concise: bool = False) -> ET.ElementTree:
     """RegressionModel PMML with logit normalization (reference
     RegressionPmmlModelCreator).  One-hot norms yield one predictor per
     expanded indicator feature."""
@@ -286,6 +345,7 @@ def lr_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
     rm = ET.SubElement(root, "RegressionModel", {
         "functionName": "regression", "normalizationMethod": "logit"})
     _mining_schema(rm, columns, target)
+    _model_stats(rm, columns, concise)
     feature_names = _local_transformations(rm, columns, model_config)
     if spec.input_dim != len(feature_names):
         raise PmmlUnsupportedError(
@@ -303,7 +363,7 @@ def lr_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
 
 
 def tree_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
-                 spec, trees) -> ET.ElementTree:
+                 spec, trees, concise: bool = False) -> ET.ElementTree:
     """MiningModel with TreeModel segments.  Split predicates test the
     ``bin(col)`` derived fields defined in LocalTransformations (Discretize /
     MapValues to bin index); GBT leaves are pre-scaled by shrinkage with an
@@ -316,6 +376,7 @@ def tree_to_pmml(model_config: ModelConfig, columns: List[ColumnConfig],
     _data_dictionary(root, columns, target)
     mm = ET.SubElement(root, "MiningModel", {"functionName": "regression"})
     _mining_schema(mm, columns, target)
+    _model_stats(mm, columns, concise)
     _bin_index_transforms(mm, columns)
     if is_gbt and spec.loss == "log":
         _logistic_output(mm)

@@ -43,6 +43,67 @@ def create_new_model(name: str, base_dir: str = ".", algorithm: str = "NN",
     return model_dir
 
 
+# per-algorithm train#params defaults (reference `shifu init -model`,
+# ``BasicModelProcessor.java:404-500`` checkAlgorithmParam): when the
+# sentinel key is absent the whole params map is replaced and saved
+_ALG_DEFAULT_PARAMS = {
+    "LR": ("LearningRate", {"LearningRate": 0.1}),
+    "NN": ("Propagation", {"Propagation": "R", "LearningRate": 0.1,
+                           "NumHiddenLayers": 2, "NumHiddenNodes": [20, 10],
+                           "ActivationFunc": ["tanh", "tanh"]}),
+    "SVM": ("Kernel", {"Kernel": "linear", "Gamma": 1.0, "Const": 1.0}),
+    "RF": ("MaxDepth", {"TreeNum": 10,
+                        "FeatureSubsetStrategy": "TWOTHIRDS",
+                        "MaxDepth": 14, "MinInstancesPerNode": 1,
+                        "MinInfoGain": 0.0, "Impurity": "entropy",
+                        "Loss": "squared"}),
+    "GBT": ("MaxDepth", {"TreeNum": 100,
+                         "FeatureSubsetStrategy": "TWOTHIRDS",
+                         "MaxDepth": 7, "MinInstancesPerNode": 5,
+                         "MinInfoGain": 0.0, "DropoutRate": 0.0,
+                         "Impurity": "variance", "LearningRate": 0.05,
+                         "Loss": "squared"}),
+}
+
+
+def check_algorithm_param(model_dir: str) -> int:
+    """``shifu init -model``: fill the configured algorithm's default
+    train#params when they are missing and save ModelConfig.json
+    (reference ``ShifuCLI.java:632`` → checkAlgorithmParam).  DT /
+    TENSORFLOW / WDL take no defaults, like the reference."""
+    import logging
+    import os
+
+    from ..config.model_config import ModelConfig
+
+    log = logging.getLogger(__name__)
+    mc_path = os.path.join(model_dir, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    alg = (mc.train.algorithm.value if hasattr(mc.train.algorithm, "value")
+           else str(mc.train.algorithm)).upper()
+    entry = _ALG_DEFAULT_PARAMS.get(alg)
+    if entry is None:
+        if alg in ("DT", "TENSORFLOW", "WDL", "GENERIC"):
+            log.info("init -model: no defaults for %s (reference parity)",
+                     alg)
+            return 0
+        log.error("init -model: unsupported algorithm %s", alg)
+        return 1
+    sentinel, defaults = entry
+    params = dict(mc.train.params or {})
+    if sentinel in params:
+        log.info("init -model: %s params already set (%s present)", alg,
+                 sentinel)
+        return 0
+    mc.train.params = dict(defaults)
+    if alg == "GBT":   # the reference also widens the epoch budget for GBT
+        mc.train.numTrainEpochs = 10000
+    mc.save(mc_path)
+    log.info("init -model: filled %s default params into ModelConfig.json",
+             alg)
+    return 0
+
+
 def _read_column_file(path: Optional[str], base_dir: str) -> List[str]:
     if not path:
         return []

@@ -82,13 +82,17 @@ class ExportProcessor(BasicProcessor):
             log.error("no models to export — run `train` first")
             return 1
         from ..export.pmml import PmmlUnsupportedError
+        # reference `export -c`: concise PMML trims the per-bin stats
+        # extensions (ShifuCLI.java:366, ModelStatsCreator isConcise)
+        concise = bool(self.params.get("concise"))
         for i, mp in enumerate(paths):
             kind = spec_kind(mp)
             try:
                 if kind == "tree":
                     from ..models import tree as tree_model
                     spec, trees = tree_model.load_model(mp)
-                    doc = pmml_mod.tree_to_pmml(mc, columns, spec, trees)
+                    doc = pmml_mod.tree_to_pmml(mc, columns, spec, trees,
+                                                concise=concise)
                 elif kind == "wdl":
                     raise PmmlUnsupportedError(
                         "WDL (embedding) models have no PMML mapping yet — "
@@ -97,9 +101,11 @@ class ExportProcessor(BasicProcessor):
                     from ..models import nn as nn_model
                     spec, params = nn_model.load_model(mp)
                     if spec.hidden_nodes:
-                        doc = pmml_mod.nn_to_pmml(mc, columns, spec, params)
+                        doc = pmml_mod.nn_to_pmml(mc, columns, spec, params,
+                                                  concise=concise)
                     else:
-                        doc = pmml_mod.lr_to_pmml(mc, columns, spec, params)
+                        doc = pmml_mod.lr_to_pmml(mc, columns, spec, params,
+                                                  concise=concise)
             except PmmlUnsupportedError as e:
                 log.error("pmml export of %s failed: %s", mp, e)
                 return 1

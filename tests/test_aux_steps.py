@@ -47,6 +47,51 @@ def _run_pipeline(model_set, alg=None, tree_params=None):
 NS = {"p": "http://www.dmg.org/PMML-4_2"}
 
 
+def test_export_pmml_model_stats_and_concise(prepared_set):
+    """Default export carries ModelStats with per-bin Extensions
+    (reference ModelStatsCreator); `export -c` trims them
+    (ShifuCLI.java:366 IS_CONCISE)."""
+    model_set = prepared_set
+    from shifu_tpu.pipeline.export import ExportProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+    assert TrainProcessor(model_set, params={}).run() == 0
+    for concise, want_ext in ((False, True), (True, False)):
+        assert ExportProcessor(model_set, params={
+            "type": "pmml", "concise": concise}).run() == 0
+        f = [x for x in os.listdir(os.path.join(model_set, "export"))
+             if x.endswith(".pmml")][0]
+        doc = ET.parse(os.path.join(model_set, "export", f))
+        body = ET.tostring(doc.getroot(), encoding="unicode")
+        assert "ModelStats" in body and "UnivariateStats" in body
+        assert ("BinCountPos" in body) == want_ext
+
+
+def test_init_model_fills_algorithm_defaults(model_set):
+    """`shifu init -model` fills the reference's per-algorithm default
+    train#params (BasicModelProcessor.java:404-500) and is idempotent."""
+    import json
+
+    from shifu_tpu.pipeline.create import check_algorithm_param
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    with open(mc_path) as f:
+        mc = json.load(f)
+    mc["train"]["algorithm"] = "RF"
+    mc["train"]["params"] = {}
+    with open(mc_path, "w") as f:
+        json.dump(mc, f)
+    assert check_algorithm_param(model_set) == 0
+    with open(mc_path) as f:
+        mc = json.load(f)
+    assert mc["train"]["params"]["MaxDepth"] == 14
+    assert mc["train"]["params"]["Impurity"] == "entropy"
+    mc["train"]["params"]["MaxDepth"] = 5        # user edit survives re-run
+    with open(mc_path, "w") as f:
+        json.dump(mc, f)
+    assert check_algorithm_param(model_set) == 0
+    with open(mc_path) as f:
+        assert json.load(f)["train"]["params"]["MaxDepth"] == 5
+
+
 def test_export_pmml_nn(prepared_set):
     model_set = prepared_set
     from shifu_tpu.pipeline.export import ExportProcessor
