@@ -1174,6 +1174,29 @@ def _gbt_prepare(mesh, valid_rate: float, seed: int, n_bins: int,
     return prep
 
 
+@lru_cache(maxsize=None)
+def _init_score_jit(loss: str):
+    """Device GBT prior from [sum(w*y), sum(w)] sums — keeps the streamed
+    warm pass fetch-free."""
+    def f(sums):
+        prior = sums[0] / jnp.maximum(sums[1], 1e-9)
+        if loss == "log":
+            p = jnp.clip(prior, 1e-6, 1 - 1e-6)
+            return jnp.log(p / (1 - p))
+        return prior
+    return jax.jit(f)
+
+
+@lru_cache(maxsize=None)
+def _bcast_rows(rows: int, mesh=None):
+    """jit broadcasting a device scalar to a (sharded) row vector."""
+    kw = {}
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        kw["out_shardings"] = NamedSharding(mesh, P("data"))
+    return jax.jit(lambda s: jnp.broadcast_to(s, (rows,)), **kw)
+
+
 def _progress_flusher(drain, history, progress, idx_off: int):
     """(flush, mark) for batched streamed progress: lines arrive in
     bursts of 8 (a per-tree fetch is a full link round-trip — the
@@ -1249,19 +1272,31 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
             sums_d = s if sums_d is None else sums_d + s
     if c is None:
         raise RuntimeError("streamed GBT: empty shard stream")
+    init_d = None
     if init_score is None:
-        sy, sw = (float(x) for x in np.asarray(sums_d))
-        prior = sy / max(sw, 1e-9)
-        if settings.loss == "log":
-            prior = float(np.clip(prior, 1e-6, 1 - 1e-6))
-            init_score = float(np.log(prior / (1 - prior)))
+        if cache.tail is None and not trees:
+            # fully-resident fresh run (the common fused path): keep the
+            # prior ON DEVICE — the host float() here was a full link
+            # round trip blocking the first tree (fetched lazily below
+            # only for checkpoints / the final result)
+            init_d = _init_score_jit(settings.loss)(sums_d)
         else:
-            init_score = prior
+            init_score = float(_init_score_jit(settings.loss)(sums_d))
+
+    def init_host() -> float:
+        """The prior as a host float — materialized at most once, off the
+        tree-dispatch critical path."""
+        nonlocal init_score
+        if init_score is None:
+            init_score = float(init_d)
+        return init_score
+
     cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
     hc = bool(np.asarray(cat).any())
     fi_dev = jnp.zeros(c, jnp.float32)     # device-accumulated split gains
 
-    f = np.full(n_rows, init_score, np.float32)
+    f = None if init_d is not None else np.full(n_rows, init_score,
+                                                np.float32)
     for t in trees:  # resumed/continuous: replay stored trees over the cache
         sf, lm, lv = (jnp.asarray(t.split_feat), jnp.asarray(t.left_mask),
                       jnp.asarray(t.leaf_value))
@@ -1272,11 +1307,14 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
 
     def window_f(it):
         """Resident windows keep their score slice ON DEVICE across trees
-        and levels (zero fetches); only tail windows round-trip host f."""
+        and levels (zero fetches); only tail windows round-trip host f.
+        A deferred device prior broadcasts on device (f is None only on
+        the fully-resident fresh path, where no tail window exists)."""
         if it.resident:
             fw = it.arrays.get("f")
             if fw is None:
-                fw = _window_f(f, it, mesh)
+                fw = (_window_f(f, it, mesh) if f is not None
+                      else _bcast_rows(it.rows, mesh)(init_d))
                 it.arrays["f"] = fw
             return fw
         return _window_f(f, it, mesh)
@@ -1333,7 +1371,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
             if checkpoint_fn and settings.checkpoint_every and \
                     (ti + 1) % settings.checkpoint_every == 0:
                 flush_progress()
-                checkpoint_fn(trees, history, init_score)
+                checkpoint_fn(trees, history, init_host())
             if settings.early_stop and \
                     stopper.add(history[-1][1]):
                 log.info("GBT early stop after %d trees (streamed)",
@@ -1383,7 +1421,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
         mark_progress()
         if checkpoint_fn and settings.checkpoint_every and \
                 (ti + 1) % settings.checkpoint_every == 0:
-            checkpoint_fn(trees, history, init_score)
+            checkpoint_fn(trees, history, init_host())
         if settings.early_stop and stopper.add(va_err):
             log.info("GBT early stop after %d trees (streamed)", ti + 1)
             break
@@ -1392,7 +1430,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
         trees=trees,
         spec_kwargs={"algorithm": "GBT", "loss": settings.loss,
                      "learning_rate": settings.learning_rate,
-                     "init_score": init_score},
+                     "init_score": init_host()},
         train_error=history[-1][0] if history else float("nan"),
         valid_error=history[-1][1] if history else float("nan"),
         feature_importance=np.asarray(fi_dev, np.float64),
