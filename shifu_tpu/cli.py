@@ -136,7 +136,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("convert", help="convert model spec zip<->binary")
     sp.add_argument("-tozipb", dest="tozipb", action="store_true")
-    sp.add_argument("-tob", dest="tob", action="store_true")
+    sp.add_argument("-tob", "-totreeb", dest="tob", action="store_true",
+                    help="(reference TO_TREEB)")
 
     sp = sub.add_parser("save", help="snapshot model-set version")
     sp.add_argument("name", nargs="?", default=None)

@@ -1131,6 +1131,26 @@ def _default_cache_budget() -> int:
     return environment.get_int("shifu.train.deviceCacheBytes", 1 << 30)
 
 
+# trees grown per disk-tail sweep in streamed RF (histogram state is
+# ~[TB, 2^depth, C, B, S] f32 at the deepest level — 8 stays tens of MB
+# at north-star widths while cutting tail re-streams 8x)
+RF_TAIL_TREE_BATCH = 8
+
+
+@lru_cache(maxsize=None)
+def _pack_streamed_batch():
+    """jitted [TB, L] packer for a tail batch — an EAGER stack of
+    concatenates aborts XLA:CPU when the per-tree parts carry mixed mesh
+    shardings (the known eager-reshard SIGABRT); inside jit the
+    partitioner handles it."""
+    def pack(parts):
+        return jnp.stack([jnp.concatenate([
+            sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
+            lv.reshape(-1), fi, sums])
+            for sf, lm, lv, fi, sums in parts])
+    return jax.jit(pack)
+
+
 def _stream_masks(idx: np.ndarray, n_valid: int, w_w: np.ndarray,
                   valid_rate: float, seed: int):
     """Hash-based train/valid weights for a window (stateless row split)."""
@@ -1601,12 +1621,13 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
     flush_progress_rf, mark_progress_rf = _progress_flusher(
         drain_rf, history, progress, len(trees) - len(history))
 
-    for ti in range(len(trees) + len(pending_rf), settings.n_trees):
+    ti = len(trees) + len(pending_rf)
+    while ti < settings.n_trees:
         bag_cache.clear()
-        fa = jnp.asarray(_feat_subset(settings, c, ti))
         if cache.warmed and cache.tail is None:
             # fully resident: whole tree is ONE executable (see
             # _rf_tree_fused); packed trees drain in batched fetches
+            fa = jnp.asarray(_feat_subset(settings, c, ti))
             items = list(cache.items())
             wins = tuple(
                 (it.arrays["bins"], it.arrays["y"], it.arrays["w"],
@@ -1626,37 +1647,76 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                     (ti + 1) % settings.checkpoint_every == 0:
                 flush_progress_rf()
                 checkpoint_fn(trees, history, None)
+            ti += 1
             continue
-        sf = jnp.full(total, -1, jnp.int32)
-        lm = jnp.zeros((total, n_bins), bool)
-        lv = jnp.zeros((total, K) if mc else total, jnp.float32)
-        nodes_cnt = jnp.int32(1)
-        fi_add = jnp.zeros(c, jnp.float32)
+        # disk-tail regime: grow a BATCH of independent trees per sweep —
+        # the reference's DTMaster grows ALL RF trees simultaneously, one
+        # stats pass per level for the whole forest (``DTMaster.java:91``
+        # toDoQueue spans trees); per-tree sweeps would re-stream the
+        # disk tail TreeNum times per level.  Bit-identical to the
+        # per-tree order: bags are stateless per (tree, row) and oob
+        # votes chain through the batch in tree order per window.
+        TB = min(settings.n_trees - ti, RF_TAIL_TREE_BATCH)
+        if checkpoint_fn and settings.checkpoint_every:
+            nxt = ((ti // settings.checkpoint_every) + 1) * \
+                settings.checkpoint_every
+            TB = max(1, min(TB, nxt - ti))
+        tis = list(range(ti, ti + TB))
+        fa_t = [jnp.asarray(_feat_subset(settings, c, t)) for t in tis]
+        sf_t = [jnp.full(total, -1, jnp.int32) for _ in tis]
+        lm_t = [jnp.zeros((total, n_bins), bool) for _ in tis]
+        lv_t = [jnp.zeros((total, K) if mc else total, jnp.float32)
+                for _ in tis]
+        cnt_t = [jnp.int32(1) for _ in tis]
+        fi_t = [jnp.zeros(c, jnp.float32) for _ in tis]
         n_stats = K if mc else 2
         for level in range(settings.depth + 1):
             n_nodes = 1 << level
-            hist = jnp.zeros((n_nodes, c, n_bins, n_stats), jnp.float32)
+            hist_t = [jnp.zeros((n_nodes, c, n_bins, n_stats), jnp.float32)
+                      for _ in tis]
             for it in cache.items():
-                hist = _rf_window_hist(
-                    hist, it.arrays["bins"], it.arrays["y"],
-                    it.arrays["w"], window_bag(ti, it), sf, lm, n_nodes,
-                    n_bins, level, up, _hist_mesh(mesh),
-                    settings.n_classes, settings.stats_exact)
-            sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
-                hist, cat, fa, settings.impurity, settings.min_instances,
-                settings.min_gain, hc, level, settings.depth,
-                settings.max_leaves, sf, lm, lv, nodes_cnt, fi_add,
-                settings.n_classes)
-        sums_dev = accumulate_oob(ti, sf, lm, lv, settings.depth)
-        absorb_rf([np.asarray(jnp.concatenate([
-            sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
-            lv.reshape(-1), fi_add, sums_dev]))])
-        tr_err, va_err = history[-1]
+                for j, t in enumerate(tis):
+                    hist_t[j] = _rf_window_hist(
+                        hist_t[j], it.arrays["bins"], it.arrays["y"],
+                        it.arrays["w"], window_bag(t, it), sf_t[j],
+                        lm_t[j], n_nodes, n_bins, level, up,
+                        _hist_mesh(mesh), settings.n_classes,
+                        settings.stats_exact)
+            for j in range(TB):
+                sf_t[j], lm_t[j], lv_t[j], cnt_t[j], fi_t[j] = \
+                    _tree_level_step(
+                        hist_t[j], cat, fa_t[j], settings.impurity,
+                        settings.min_instances, settings.min_gain, hc,
+                        level, settings.depth, settings.max_leaves,
+                        sf_t[j], lm_t[j], lv_t[j], cnt_t[j], fi_t[j],
+                        settings.n_classes)
+        # one more sweep: oob votes + error sums for the whole batch,
+        # trees chained in order per window
+        sums_t = [jnp.zeros(4, jnp.float32) for _ in tis]
+        for it in cache.items():
+            osw, ocw = window_oob(it)
+            for j, t in enumerate(tis):
+                osw, ocw, sums_t[j] = _rf_window_update(
+                    sums_t[j], it.arrays["bins"], it.arrays["y"],
+                    it.arrays["w"], window_bag(t, it), osw, ocw,
+                    sf_t[j], lm_t[j], lv_t[j], settings.depth,
+                    settings.loss, settings.n_classes)
+            if it.resident:
+                it.arrays["oob"] = (osw, ocw)
+            else:
+                s, e = it.start, it.start + it.n_valid
+                oob_sum[s:e] = np.asarray(osw)[:it.n_valid]
+                oob_cnt[s:e] = np.asarray(ocw)[:it.n_valid]
+        absorb_rf(np.asarray(_pack_streamed_batch()(
+            tuple(zip(sf_t, lm_t, lv_t, fi_t, sums_t)))))
         if progress:
-            progress(ti, tr_err, va_err)
+            for j, t in enumerate(tis):
+                tr_err, va_err = history[len(history) - TB + j]
+                progress(t, tr_err, va_err)
         mark_progress_rf()
+        ti += TB
         if checkpoint_fn and settings.checkpoint_every and \
-                (ti + 1) % settings.checkpoint_every == 0:
+                ti % settings.checkpoint_every == 0:
             checkpoint_fn(trees, history, None)
     flush_progress_rf()
     spec_kwargs: Dict[str, Any] = {"algorithm": "RF"}

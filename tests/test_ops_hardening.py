@@ -101,6 +101,24 @@ def test_manage_save_switch(model_set, caplog):
     assert copy_model_set(model_set, dst) == 1        # refuses overwrite
 
 
+def test_device_trace_knob_emits_xplane(model_set, tmp_path):
+    """-Dshifu.profile=<dir> wraps the step in a jax.profiler trace
+    (SURVEY §5 tracing — the TPU-native upgrade of the reference's
+    wall-clock log lines); the knob off emits nothing."""
+    from shifu_tpu.config import environment
+    from shifu_tpu.pipeline.create import InitProcessor
+
+    trace_dir = str(tmp_path / "trace")
+    environment.set_property("shifu.profile", trace_dir)
+    try:
+        assert InitProcessor(model_set).run() == 0
+    finally:
+        environment.set_property("shifu.profile", "")
+    hits = [os.path.join(r, f) for r, _, fs in os.walk(trace_dir)
+            for f in fs if f.endswith(".xplane.pb")]
+    assert hits, f"no xplane trace written under {trace_dir}"
+
+
 def test_checkpoint_save_restore_roundtrip(tmp_path):
     import jax
     from shifu_tpu.train import checkpoint as ckpt
