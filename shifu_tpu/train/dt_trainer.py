@@ -893,6 +893,30 @@ def _rf_window_hist(hist, bins_w, y_w, w_w, bag_w, sf, lm, n_nodes: int,
                                    n_bins, use_pallas, mesh, stats_exact)
 
 
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level",
+                                   "use_pallas", "mesh", "n_classes",
+                                   "stats_exact"))
+def _rf_window_hist_batch(hist_b, bins_w, y_w, w_w, bags_b, sf_b, lm_b,
+                          n_nodes: int, n_bins: int, level: int,
+                          use_pallas: bool = False, mesh=None,
+                          n_classes: int = 0, stats_exact: bool = False):
+    """Tail-batch histogram sweep for ONE window as ONE executable.
+
+    The per-tree histograms of a tail batch are mutually independent, and
+    independent mesh programs that overlap deadlock XLA:CPU's in-process
+    collectives (see :func:`_gbt_window_hist`) — dispatching them as TB
+    separate programs was the round-4 SIGABRT.  Folding the TB trees into
+    a single program keeps every collective in one totally-ordered
+    executable, chains across windows via the stacked ``hist_b``
+    accumulator input, and costs one dispatch per (window, level) instead
+    of TB."""
+    return jnp.stack([
+        _rf_window_hist(hist_b[j], bins_w, y_w, w_w, bags_b[j], sf_b[j],
+                        lm_b[j], n_nodes, n_bins, level, use_pallas, mesh,
+                        n_classes, stats_exact)
+        for j in range(hist_b.shape[0])])
+
+
 @partial(jax.jit, static_argnames=("depth", "loss"))
 def _gbt_window_update(sums_in, bins_w, y_w, tw_w, vw_w, f_w, sf, lm, lv,
                        lr, depth: int, loss: str):
@@ -945,6 +969,22 @@ def _rf_window_update(sums_in, bins_w, y_w, w_w, bag_w, oob_sum_w,
     return oob_sum2, oob_cnt2, sums_in + sums
 
 
+@partial(jax.jit, static_argnames=("depth", "loss", "n_classes"))
+def _rf_window_update_batch(sums_b, bins_w, y_w, w_w, bags_b, oob_sum_w,
+                            oob_cnt_w, sf_b, lm_b, lv_b, depth: int,
+                            loss: str, n_classes: int = 0):
+    """Tail-batch oob/error sweep for ONE window as ONE executable — the
+    oob vote caches chain through the batch in tree order exactly as the
+    per-tree sequence would, and the single program keeps the row-sum
+    AllReduces totally ordered (see :func:`_rf_window_hist_batch`)."""
+    osw, ocw = oob_sum_w, oob_cnt_w
+    sums = []
+    for j in range(sums_b.shape[0]):
+        osw, ocw, s = _rf_window_update(
+            sums_b[j], bins_w, y_w, w_w, bags_b[j], osw, ocw, sf_b[j],
+            lm_b[j], lv_b[j], depth, loss, n_classes)
+        sums.append(s)
+    return osw, ocw, jnp.stack(sums)
 
 
 def _unpack_streamed(packed: np.ndarray, total: int, n_bins: int, c: int,
@@ -989,6 +1029,23 @@ def _tree_level_step(hist, cat, fa, impurity: str, min_instances,
                   0.0).astype(jnp.float32),
         jnp.maximum(feat, 0), num_segments=hist.shape[1])
     return sf, lm, lv, nodes_cnt, fi_add
+
+
+@partial(jax.jit, static_argnames=("impurity", "has_cat", "level", "depth",
+                                   "max_leaves", "n_classes"))
+def _tree_level_step_batch(hist_b, cat, fa_b, impurity: str, min_instances,
+                           min_gain, has_cat: bool, level: int, depth: int,
+                           max_leaves: int, sf_b, lm_b, lv_b, cnt_b, fi_b,
+                           n_classes: int = 0):
+    """Tail-batch level step as ONE executable (one dispatch per level
+    for the whole batch; see :func:`_rf_window_hist_batch` on why the
+    trees must not run as independent programs)."""
+    outs = [_tree_level_step(hist_b[j], cat, fa_b[j], impurity,
+                             min_instances, min_gain, has_cat, level,
+                             depth, max_leaves, sf_b[j], lm_b[j], lv_b[j],
+                             cnt_b[j], fi_b[j], n_classes)
+            for j in range(hist_b.shape[0])]
+    return tuple(jnp.stack(x) for x in zip(*outs))
 
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
@@ -1137,18 +1194,16 @@ def _default_cache_budget() -> int:
 RF_TAIL_TREE_BATCH = 8
 
 
-@lru_cache(maxsize=None)
-def _pack_streamed_batch():
-    """jitted [TB, L] packer for a tail batch — an EAGER stack of
-    concatenates aborts XLA:CPU when the per-tree parts carry mixed mesh
-    shardings (the known eager-reshard SIGABRT); inside jit the
-    partitioner handles it."""
-    def pack(parts):
-        return jnp.stack([jnp.concatenate([
-            sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
-            lv.reshape(-1), fi, sums])
-            for sf, lm, lv, fi, sums in parts])
-    return jax.jit(pack)
+@jax.jit
+def _pack_streamed_stacked(sf_b, lm_b, lv_b, fi_b, sums_b):
+    """[TB, L] packer for a stacked tail batch — jitted so the
+    partitioner reconciles whatever shardings the parts carry (an eager
+    concatenate of mixed-sharding parts aborts XLA:CPU)."""
+    tb = sf_b.shape[0]
+    return jnp.concatenate([
+        sf_b.astype(jnp.float32),
+        lm_b.reshape(tb, -1).astype(jnp.float32),
+        lv_b.reshape(tb, -1), fi_b, sums_b], axis=1)
 
 
 def _stream_masks(idx: np.ndarray, n_valid: int, w_w: np.ndarray,
@@ -1503,6 +1558,16 @@ def _shard_rows(a: np.ndarray, mesh=None):
     return jax.device_put(a, NamedSharding(mesh, spec))
 
 
+def _shard_rows_batch(a: np.ndarray, mesh=None):
+    """[TB, rows] stacked per-tree row arrays, rows sharded over the mesh
+    data axis (one put for the whole tail batch)."""
+    if mesh is None:
+        return jnp.asarray(a)
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    return jax.device_put(a, NamedSharding(mesh, P(None, "data")))
+
+
 def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                       progress=None,
                       checkpoint_fn: Optional[Callable] = None,
@@ -1549,15 +1614,33 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
     # sweeps of a tree hash/upload each window's bag once
     bag_cache: Dict[Tuple[int, int], Any] = {}
 
+    def host_bag(ti: int, it) -> np.ndarray:
+        """The per-(tree, window) stateless bag — the ONE place that knows
+        the hash stream, shared by the per-tree and tail-batch paths so
+        they stay bit-identical."""
+        u = row_uniform(settings.seed, 5000 + ti, it.index)
+        bag = _hash_poisson(settings.bagging_rate, u) \
+            if settings.poisson_bagging else np.ones(it.rows, np.float32)
+        bag[it.n_valid:] = 0.0
+        return bag.astype(np.float32)
+
     def window_bag(ti: int, it):
         key = (ti, it.start)
         dev = bag_cache.get(key)
         if dev is None:
-            u = row_uniform(settings.seed, 5000 + ti, it.index)
-            bag = _hash_poisson(settings.bagging_rate, u) \
-                if settings.poisson_bagging else np.ones(it.rows, np.float32)
-            bag[it.n_valid:] = 0.0
-            dev = _shard_rows(bag.astype(np.float32), mesh)
+            dev = _shard_rows(host_bag(ti, it), mesh)
+            if it.resident:      # tail bags would grow with the dataset
+                bag_cache[key] = dev
+        return dev
+
+    def window_bags(tis, it):
+        """Stacked [TB, rows] bags for a tail batch — hashed once and put
+        as ONE transfer per (batch, window)."""
+        key = (tis[0], -1 - it.start)     # distinct keyspace from window_bag
+        dev = bag_cache.get(key)
+        if dev is None:
+            dev = _shard_rows_batch(
+                np.stack([host_bag(t, it) for t in tis]), mesh)
             if it.resident:      # tail bags would grow with the dataset
                 bag_cache[key] = dev
         return dev
@@ -1662,53 +1745,48 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                 settings.checkpoint_every
             TB = max(1, min(TB, nxt - ti))
         tis = list(range(ti, ti + TB))
-        fa_t = [jnp.asarray(_feat_subset(settings, c, t)) for t in tis]
-        sf_t = [jnp.full(total, -1, jnp.int32) for _ in tis]
-        lm_t = [jnp.zeros((total, n_bins), bool) for _ in tis]
-        lv_t = [jnp.zeros((total, K) if mc else total, jnp.float32)
-                for _ in tis]
-        cnt_t = [jnp.int32(1) for _ in tis]
-        fi_t = [jnp.zeros(c, jnp.float32) for _ in tis]
+        fa_b = jnp.asarray(np.stack(
+            [np.asarray(_feat_subset(settings, c, t)) for t in tis]))
+        sf_b = jnp.full((TB, total), -1, jnp.int32)
+        lm_b = jnp.zeros((TB, total, n_bins), bool)
+        lv_b = jnp.zeros((TB, total, K) if mc else (TB, total),
+                         jnp.float32)
+        cnt_b = jnp.ones(TB, jnp.int32)
+        fi_b = jnp.zeros((TB, c), jnp.float32)
         n_stats = K if mc else 2
         for level in range(settings.depth + 1):
             n_nodes = 1 << level
-            hist_t = [jnp.zeros((n_nodes, c, n_bins, n_stats), jnp.float32)
-                      for _ in tis]
+            hist_b = jnp.zeros((TB, n_nodes, c, n_bins, n_stats),
+                               jnp.float32)
             for it in cache.items():
-                for j, t in enumerate(tis):
-                    hist_t[j] = _rf_window_hist(
-                        hist_t[j], it.arrays["bins"], it.arrays["y"],
-                        it.arrays["w"], window_bag(t, it), sf_t[j],
-                        lm_t[j], n_nodes, n_bins, level, up,
-                        _hist_mesh(mesh), settings.n_classes,
-                        settings.stats_exact)
-            for j in range(TB):
-                sf_t[j], lm_t[j], lv_t[j], cnt_t[j], fi_t[j] = \
-                    _tree_level_step(
-                        hist_t[j], cat, fa_t[j], settings.impurity,
-                        settings.min_instances, settings.min_gain, hc,
-                        level, settings.depth, settings.max_leaves,
-                        sf_t[j], lm_t[j], lv_t[j], cnt_t[j], fi_t[j],
-                        settings.n_classes)
+                hist_b = _rf_window_hist_batch(
+                    hist_b, it.arrays["bins"], it.arrays["y"],
+                    it.arrays["w"], window_bags(tis, it), sf_b, lm_b,
+                    n_nodes, n_bins, level, up, _hist_mesh(mesh),
+                    settings.n_classes, settings.stats_exact)
+            sf_b, lm_b, lv_b, cnt_b, fi_b = _tree_level_step_batch(
+                hist_b, cat, fa_b, settings.impurity,
+                settings.min_instances, settings.min_gain, hc, level,
+                settings.depth, settings.max_leaves, sf_b, lm_b, lv_b,
+                cnt_b, fi_b, settings.n_classes)
         # one more sweep: oob votes + error sums for the whole batch,
         # trees chained in order per window
-        sums_t = [jnp.zeros(4, jnp.float32) for _ in tis]
+        sums_b = jnp.zeros((TB, 4), jnp.float32)
         for it in cache.items():
             osw, ocw = window_oob(it)
-            for j, t in enumerate(tis):
-                osw, ocw, sums_t[j] = _rf_window_update(
-                    sums_t[j], it.arrays["bins"], it.arrays["y"],
-                    it.arrays["w"], window_bag(t, it), osw, ocw,
-                    sf_t[j], lm_t[j], lv_t[j], settings.depth,
-                    settings.loss, settings.n_classes)
+            osw, ocw, sums_b = _rf_window_update_batch(
+                sums_b, it.arrays["bins"], it.arrays["y"],
+                it.arrays["w"], window_bags(tis, it), osw, ocw,
+                sf_b, lm_b, lv_b, settings.depth, settings.loss,
+                settings.n_classes)
             if it.resident:
                 it.arrays["oob"] = (osw, ocw)
             else:
                 s, e = it.start, it.start + it.n_valid
                 oob_sum[s:e] = np.asarray(osw)[:it.n_valid]
                 oob_cnt[s:e] = np.asarray(ocw)[:it.n_valid]
-        absorb_rf(np.asarray(_pack_streamed_batch()(
-            tuple(zip(sf_t, lm_t, lv_t, fi_t, sums_t)))))
+        absorb_rf(np.asarray(_pack_streamed_stacked(
+            sf_b, lm_b, lv_b, fi_b, sums_b)))
         if progress:
             for j, t in enumerate(tis):
                 tr_err, va_err = history[len(history) - TB + j]
