@@ -63,22 +63,38 @@ class CaseScoreResult:
 class Scorer:
     """Multi-model batch scorer over normalized feature matrices."""
 
-    def __init__(self, models: Sequence, scale: float = SCORE_SCALE):
+    def __init__(self, models: Sequence, scale: float = SCORE_SCALE,
+                 mesh=None):
         if not models:
             raise ValueError("no models to score with")
         self.models = list(models)
         self.scale = scale
+        # (ensemble, data) mesh: batch rows shard over the data axis so
+        # every chip scores its own rows (the reference spreads eval over
+        # the cluster, ``EvalModelProcessor.java:424-436``); None = the
+        # single-chip layout
+        self.mesh = mesh
         self._groups = None          # lazy same-shape NN stacks
 
     @classmethod
-    def from_dir(cls, models_dir: str, scale: float = SCORE_SCALE) -> "Scorer":
+    def from_dir(cls, models_dir: str, scale: float = SCORE_SCALE,
+                 mesh=None) -> "Scorer":
         paths = discover_model_paths(models_dir)
         models = [load_any(p) for p in paths]
         if not models:
             from ..config.errors import ErrorCode, ShifuError
             raise ShifuError(ErrorCode.ERROR_MODEL_FILE_NOT_FOUND,
                              f"no model files in {models_dir} — run `train`")
-        return cls(models, scale)
+        return cls(models, scale, mesh=mesh)
+
+    def _put(self, a, dtype=None):
+        """Rows onto the device, data-axis sharded (and zero-padded to
+        divide it) under a multi-device mesh — :meth:`score` trims the
+        padded scores after the fetch."""
+        from ..parallel.mesh import shard_chunk_rows
+        if dtype is not None:
+            a = np.asarray(a, dtype)
+        return shard_chunk_rows(self.mesh, a)[0]
 
     def _stacked_nn_groups(self):
         """Same-shape NN/LR models stacked for ONE vmapped forward — the
@@ -120,11 +136,10 @@ class Scorer:
         Same-shape NN models score as one stacked jit call.  Thin host
         wrapper over :meth:`score_device` — ONE [n, M] fetch, aggregates
         on host (the dispatch rules live in one place)."""
-        import jax.numpy as jnp
         raw_d, _ = self.score_device(
-            jnp.asarray(x, jnp.float32),
-            None if bins is None else jnp.asarray(bins))
-        raw = np.asarray(raw_d)
+            self._put(x, np.float32),
+            None if bins is None else self._put(bins))
+        raw = np.asarray(raw_d)[:len(x)]     # drop mesh padding rows
         return CaseScoreResult(scores=raw, mean=raw.mean(axis=1),
                                max=raw.max(axis=1), min=raw.min(axis=1),
                                median=np.median(raw, axis=1))
@@ -206,11 +221,12 @@ class ModelRunner:
     also the engine inside ``EvalScoreUDF``)."""
 
     def __init__(self, model_config, column_configs, models: Sequence,
-                 for_eval_set: Optional[int] = None, scale: float = SCORE_SCALE):
+                 for_eval_set: Optional[int] = None, scale: float = SCORE_SCALE,
+                 mesh=None):
         from ..data.transform import DatasetTransformer
         self.transformer = DatasetTransformer(model_config, column_configs,
                                               for_eval_set=for_eval_set)
-        self.scorer = Scorer(models, scale)
+        self.scorer = Scorer(models, scale, mesh=mesh)
 
     def compute(self, chunk) -> Dict[str, np.ndarray]:
         tc = self.transformer.transform(chunk)

@@ -79,11 +79,12 @@ def _stat_channels(target, weight, unit_weight: bool):
 
 @functools.partial(jax.jit,
                    static_argnames=("num_buckets", "use_pallas",
-                                    "unit_weight", "expand"))
+                                    "unit_weight", "expand", "mesh"))
 def _histogram_kernel(x: jnp.ndarray, valid: jnp.ndarray, target: jnp.ndarray,
                       weight: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
                       num_buckets: int, use_pallas: bool = False,
-                      unit_weight: bool = False, expand: bool = True):
+                      unit_weight: bool = False, expand: bool = True,
+                      mesh=None):
     """Fine-histogram for one chunk.
 
     Returns [C, num_buckets, 4]: (#pos, #neg, w_pos, w_neg) per fine bucket.
@@ -107,11 +108,16 @@ def _histogram_kernel(x: jnp.ndarray, valid: jnp.ndarray, target: jnp.ndarray,
     idx = jnp.clip(((x - lo) * scale), 0, num_buckets - 1).astype(jnp.int32)
     vals, exact = _stat_channels(target, weight, unit_weight)
     if use_pallas:
-        from .hist_pallas import stats_histograms_pallas, target_platform
+        from .hist_pallas import (stats_histograms_pallas,
+                                  stats_histograms_sharded, target_platform)
         cidx = jnp.where(valid, idx, -1)     # invalid cell -> matches no bin
-        h = stats_histograms_pallas(cidx, vals, num_buckets,
-                                    interpret=target_platform() != "tpu",
-                                    exact=exact)
+        interp = target_platform(mesh) != "tpu"
+        if mesh is not None and mesh.size > 1:
+            h = stats_histograms_sharded(cidx, vals, num_buckets, mesh,
+                                         interpret=interp, exact=exact)
+        else:
+            h = stats_histograms_pallas(cidx, vals, num_buckets,
+                                        interpret=interp, exact=exact)
     else:
         S = vals.shape[1]
         flat = idx + jnp.arange(C, dtype=jnp.int32) * num_buckets
@@ -150,14 +156,20 @@ def _combine_moments(a: dict, b: Tuple[np.ndarray, ...]) -> dict:
 
 
 @functools.partial(jax.jit, static_argnames=("unit_weight", "expand"))
-def _missing_agg_kernel(valid, target, weight, unit_weight: bool = False,
-                        expand: bool = True):
+def _missing_agg_kernel(valid, target, weight, live=None,
+                        unit_weight: bool = False, expand: bool = True):
     """[C, 4] (pos/neg/w_pos/w_neg) sums over INVALID cells — the
     missing-bin aggregation as one device matmul instead of four host
     passes over the [R, C] mask.  HIGHEST precision keeps f32-faithful
     accumulation (counts are exact integers below 2^24; the bounded
-    drain in :class:`NumericAccumulator` keeps them there)."""
+    drain in :class:`NumericAccumulator` keeps them there).
+
+    ``live`` [R] bool marks real rows: mesh-sharded chunks pad rows to
+    the data-axis extent, and a padded all-invalid row must NOT count as
+    missing (every other kernel drops invalid cells on its own)."""
     inval = (~valid).astype(jnp.float32)               # [R, C]
+    if live is not None:
+        inval = inval * live.astype(jnp.float32)[:, None]
     vals, _ = _stat_channels(target, weight, unit_weight)
     magg = jax.lax.dot_general(inval, vals, (((0,), (0,)), ((), ())),
                                precision=jax.lax.Precision.HIGHEST,
@@ -264,6 +276,11 @@ class NumericAccumulator:
     n_cols: int
     num_buckets: int = 4096
     unit_weight: bool = False       # no weight column: w channels mirror counts
+    # (ensemble, data) mesh: chunk rows shard over the data axis and the
+    # per-chunk reductions psum on ICI — the reference's up-to-999 stats
+    # reducers (``MapReducerStatsWorker.java:111-139``); None or a 1-device
+    # mesh keeps the single-chip layout
+    mesh: Optional[object] = None
     moments: dict = field(default_factory=dict)
     total_rows: int = 0
     missing: Optional[np.ndarray] = None
@@ -290,9 +307,21 @@ class NumericAccumulator:
     # float64 well before that so TB-scale streams lose nothing
     DRAIN_ROWS = 8_000_000
 
+    def _data_size(self) -> int:
+        return int(self.mesh.shape["data"]) if self.mesh is not None else 1
+
+    def _put_rows(self, *arrays):
+        """Chunk rows onto the mesh (padded, data-axis sharded) — see
+        :func:`shifu_tpu.parallel.mesh.shard_chunk_rows`.  Padded rows are
+        all-invalid with weight/target 0."""
+        from ..parallel.mesh import shard_chunk_rows
+        return shard_chunk_rows(self.mesh, *arrays)
+
     # ---- pass 1
     def update_moments(self, x: np.ndarray, valid: np.ndarray) -> None:
-        out = _moments_kernel(jnp.asarray(x, jnp.float32), jnp.asarray(valid))
+        xd, vd, _ = self._put_rows(np.asarray(x, np.float32),
+                                   np.asarray(valid))
+        out = _moments_kernel(xd, vd)
         self._pend_moments.append(jnp.stack(out))      # [7, C], stays on device
         self.total_rows += x.shape[0]
         self._pend_moment_rows += x.shape[0]
@@ -326,16 +355,18 @@ class NumericAccumulator:
                          target: np.ndarray, weight: np.ndarray) -> None:
         assert self.lo is not None, "call finalize_range() after pass 1"
         from .hist_pallas import pallas_available
-        up = (pallas_available() and self.num_buckets % 64 == 0
+        up = (pallas_available(self.mesh) and self.num_buckets % 64 == 0
               and self.num_buckets <= 4096)
-        xd = jnp.asarray(x, jnp.float32)
-        vd = jnp.asarray(valid)
-        td = jnp.asarray(target, jnp.float32)
-        wd = jnp.asarray(weight, jnp.float32)
+        xd, vd, td, wd, live = self._put_rows(
+            np.asarray(x, np.float32), np.asarray(valid),
+            np.asarray(target, np.float32), np.asarray(weight, np.float32))
         h = _histogram_kernel(xd, vd, td, wd, self._lo_d, self._hi_d,
                               self.num_buckets, use_pallas=up,
-                              unit_weight=self.unit_weight, expand=False)
-        magg = _missing_agg_kernel(vd, td, wd, unit_weight=self.unit_weight,
+                              unit_weight=self.unit_weight, expand=False,
+                              mesh=self.mesh if self._data_size() > 1
+                              else None)
+        magg = _missing_agg_kernel(vd, td, wd, live,
+                                   unit_weight=self.unit_weight,
                                    expand=False)
         self._hist_dev = h if self._hist_dev is None else self._hist_dev + h
         self._magg_dev = (magg if self._magg_dev is None

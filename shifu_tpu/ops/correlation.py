@@ -47,15 +47,20 @@ class CorrelationAccumulator:
     f32 stable); None = no shift."""
     n_cols: int
     offset: Optional[np.ndarray] = None
+    # data-axis row sharding (padded rows are invalid → contribute nothing
+    # to the masked matmuls); the reference's CorrelationMapper fan-out
+    mesh: Optional[object] = None
     n: Optional[np.ndarray] = None
     sx: Optional[np.ndarray] = None
     sxy: Optional[np.ndarray] = None
     sxx: Optional[np.ndarray] = None
 
     def update(self, x: np.ndarray, valid: np.ndarray) -> None:
+        from ..parallel.mesh import shard_chunk_rows
         off = np.zeros(self.n_cols) if self.offset is None else self.offset
-        out = _pair_sums(jnp.asarray(x, jnp.float32), jnp.asarray(valid),
-                         jnp.asarray(off, jnp.float32))
+        xd, vd, _ = shard_chunk_rows(self.mesh, np.asarray(x, np.float32),
+                                     np.asarray(valid))
+        out = _pair_sums(xd, vd, jnp.asarray(off, jnp.float32))
         n, sx, sxy, sxx = (np.asarray(a, np.float64) for a in out)
         if self.n is None:
             self.n, self.sx, self.sxy, self.sxx = n, sx, sxy, sxx

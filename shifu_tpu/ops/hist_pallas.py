@@ -400,3 +400,22 @@ def stats_histograms_pallas(idx, stats, num_buckets: int,
     )(idx_t, stats_t)
     # [C_pad, S, HI, 64] -> [C, HI*64, S]
     return out[:c].reshape(c, s, hi_n * 64).transpose(0, 2, 1)
+
+
+def stats_histograms_sharded(idx, stats, num_buckets: int, mesh,
+                             interpret: bool = False, exact: tuple = None):
+    """Mesh lowering of the stats fine-histogram: ``shard_map`` over the
+    ``data`` axis (see :func:`build_histograms_sharded` — the pallas_call
+    is opaque to GSPMD, so each device sketches its local rows and a
+    ``psum`` merges on ICI; the reference's up-to-999 stats reducers,
+    ``MapReducerStatsWorker.java:111-139``).  Rows must already be sharded
+    over ``data`` and divide the axis (the accumulator pads)."""
+    from jax.sharding import PartitionSpec as P
+
+    def local(i, st):
+        h = stats_histograms_pallas(i, st, num_buckets, interpret, exact)
+        return jax.lax.psum(h, "data")
+
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(P("data", None), P("data", None)),
+        out_specs=P(), check_vma=False)(idx, stats)

@@ -52,6 +52,38 @@ def pad_rows(n: int, multiple: int) -> int:
     return 0 if r == 0 else multiple - r
 
 
+def shard_chunk_rows(mesh, *arrays):
+    """Device-put per-row chunk arrays (1D [R] or 2D [R, C]) with rows
+    sharded over the mesh ``data`` axis, zero-padded so every shard is
+    equal-sized (shard_mapped kernels need that; zero rows are invalid/
+    weightless by construction at every call site).  Returns the device
+    arrays plus a live-row bool mask marking real rows — ``None`` mask
+    (and plain single-device arrays) when ``mesh`` is None or its data
+    axis is 1.  This is the stats/eval-plane row scatter, the counterpart
+    of the trainers' ``_shard_rows`` (reference: each Guagua/MR worker
+    reads its own input split, ``ShifuInputFormat``)."""
+    import jax.numpy as jnp
+
+    ds = int(mesh.shape["data"]) if mesh is not None else 1
+    if ds <= 1:
+        return tuple(jnp.asarray(a) for a in arrays) + (None,)
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    n = arrays[0].shape[0]
+    pad = pad_rows(n, ds)
+    live = np.ones(n, bool)          # padded below like every other array
+    out = []
+    for a in list(arrays) + [live]:
+        a = np.asarray(a)
+        if pad:
+            a = np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+        spec = P("data") if a.ndim == 1 else P("data", None)
+        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+    return tuple(out)
+
+
 # ------------------------------------------------------------- multi-host
 def initialize_distributed(coordinator: Optional[str] = None,
                            num_processes: Optional[int] = None,

@@ -127,7 +127,11 @@ class EvalProcessor(BasicProcessor):
         return list(range(len(evals)))
 
     def _run(self, name: Optional[str], action: str) -> int:
-        scorer = Scorer.from_dir(self.paths.models_dir)  # load models once
+        from ..parallel.mesh import device_mesh
+        # rows shard across every chip during scoring (the reference's
+        # cluster eval, ``EvalModelProcessor.java:424-436``)
+        scorer = Scorer.from_dir(self.paths.models_dir,
+                                 mesh=device_mesh())  # load models once
         rc = 0
         for i in self._eval_sets(name):
             rc |= self._run_one(i, action, scorer)
@@ -139,7 +143,7 @@ class EvalProcessor(BasicProcessor):
             return self._run_one_multiclass(idx, action, scorer)
         ev = mc.evals[idx]
         runner = ModelRunner(mc, self.column_configs, scorer.models,
-                             for_eval_set=idx)
+                             for_eval_set=idx, mesh=scorer.mesh)
         ds = ev.dataSet
         source = DataSource(self._abs(ds.dataPath), ds.dataDelimiter,
                             header_path=self._abs(ds.headerPath),
@@ -227,7 +231,7 @@ class EvalProcessor(BasicProcessor):
         mc = self.model_config
         ev = mc.evals[idx]
         runner = ModelRunner(mc, self.column_configs, scorer.models,
-                             for_eval_set=idx)
+                             for_eval_set=idx, mesh=scorer.mesh)
         ds = ev.dataSet
         source = DataSource(self._abs(ds.dataPath), ds.dataDelimiter,
                             header_path=self._abs(ds.headerPath),

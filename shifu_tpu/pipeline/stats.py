@@ -43,10 +43,17 @@ class StatsProcessor(BasicProcessor):
                             header_delimiter=mc.dataSet.headerDelimiter)
 
         from ..config.model_config import BinningAlgorithm
+        from ..parallel.mesh import device_mesh
         exact_alg = mc.stats.binningAlgorithm in (BinningAlgorithm.MunroPat,
                                                   BinningAlgorithm.MunroPatI)
+        # pure data-parallel mesh: chunk rows shard across every chip and
+        # the per-chunk reductions psum on ICI — the reference's stats MR
+        # fan-out (``MapReducerStatsWorker.java:111-139``); degenerates to
+        # the single-chip layout on a 1-device rig
+        mesh = device_mesh()
         num_acc = NumericAccumulator(n_cols=len(num_cols), exact=exact_alg,
-                                     unit_weight=not extractor.weight_name)
+                                     unit_weight=not extractor.weight_name,
+                                     mesh=mesh)
         cat_acc = CategoricalAccumulator()
         psi_col = mc.stats.psiColumnName if self.params.get("psi") or \
             mc.stats.psiColumnName else None
@@ -74,7 +81,8 @@ class StatsProcessor(BasicProcessor):
         corr_acc = None
         if want_corr and num_cols and not cat_cols:
             corr_acc = CorrelationAccumulator(
-                n_cols=len(num_cols), offset=num_acc.moments["mean"])
+                n_cols=len(num_cols), offset=num_acc.moments["mean"],
+                mesh=mesh)
         psi_units: Dict[str, Dict[str, np.ndarray]] = {}
         with self.phase("pass2_histograms"):
             for ci, chunk in enumerate(source.iter_chunks()):
@@ -264,10 +272,12 @@ class StatsProcessor(BasicProcessor):
                                         for i, c in enumerate(cats)
                                         if i < len(pr) and pr[i] is not None}
         # offsets: pass-1 means for numerics, 0.5 for pos-rate encodings
+        from ..parallel.mesh import device_mesh
         num_means = [c.columnStats.mean or 0.0 for c in num_cols]
         acc = CorrelationAccumulator(
             n_cols=len(cols),
-            offset=np.asarray(num_means + [0.5] * len(cat_cols)))
+            offset=np.asarray(num_means + [0.5] * len(cat_cols)),
+            mesh=device_mesh())
         miss = {m.strip().lower() for m in extractor.missing_values}
         for ci, chunk in enumerate(source.iter_chunks()):
             ex = extractor.extract(_sample_raw(chunk, rate, ci))

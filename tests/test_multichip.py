@@ -99,3 +99,68 @@ def test_one_vs_eight_device_equivalence(bags):
         flat8 = jax.tree_util.tree_leaves(p8)
         for a, b in zip(flat1, flat8):
             np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)
+
+
+def test_stats_accumulator_mesh_equivalence():
+    """NumericAccumulator on a 1-device vs 8-device mesh: the data-axis
+    sharding must only change WHERE rows live (reference stats fan-out,
+    ``MapReducerStatsWorker.java:111-139``).  Counts are integer-exact
+    either way; weighted sums may differ by reduction order only."""
+    from shifu_tpu.config.model_config import BinningMethod
+    from shifu_tpu.ops.binning import NumericAccumulator
+    from shifu_tpu.parallel.mesh import device_mesh
+
+    rng = np.random.default_rng(11)
+    n, c = 997, 5                       # deliberately NOT divisible by 8
+    x = rng.normal(size=(n, c)).astype(np.float32) * [1, 10, 100, 1, 1]
+    valid = rng.random((n, c)) > 0.07
+    target = (rng.random(n) < 0.3).astype(np.float32)
+    weight = rng.uniform(0.5, 2.0, n).astype(np.float32)
+    devs = jax.devices("cpu")
+
+    def run(mesh):
+        acc = NumericAccumulator(n_cols=c, num_buckets=256, mesh=mesh)
+        for s, e in ((0, 400), (400, n)):    # two uneven chunks
+            acc.update_moments(x[s:e], valid[s:e])
+        acc.finalize_range()
+        for s, e in ((0, 400), (400, n)):
+            acc.update_histogram(x[s:e], valid[s:e], target[s:e],
+                                 weight[s:e])
+        return acc, acc.finalize_sketch(BinningMethod.EqualTotal, 8)
+
+    acc1, (b1, a1, p1, d1) = run(None)
+    acc8, (b8, a8, p8, d8) = run(device_mesh(devices=devs[:8]))
+    assert acc1.total_rows == acc8.total_rows == n
+    np.testing.assert_array_equal(acc1.missing, acc8.missing)
+    np.testing.assert_allclose(acc1.moments["mean"], acc8.moments["mean"],
+                               rtol=1e-5)
+    for i in range(c):
+        np.testing.assert_array_equal(b1[i], b8[i])          # boundaries
+        np.testing.assert_array_equal(a1[i][:, :2], a8[i][:, :2])  # counts
+        np.testing.assert_allclose(a1[i][:, 2:], a8[i][:, 2:], rtol=1e-5)
+    np.testing.assert_array_equal(d1, d8)
+    np.testing.assert_allclose(p1, p8, rtol=1e-6)
+
+
+def test_scorer_mesh_equivalence(tmp_path):
+    """Scorer with a data-sharded mesh scores identically to the
+    single-device layout (reference cluster eval,
+    ``EvalModelProcessor.java:424-436``)."""
+    from shifu_tpu.eval.scorer import Scorer
+    from shifu_tpu.models import nn as nn_model
+    from shifu_tpu.models.nn import IndependentNNModel
+    from shifu_tpu.parallel.mesh import device_mesh
+
+    rng = np.random.default_rng(5)
+    d = 6
+    spec = nn_model.NNModelSpec(input_dim=d, hidden_nodes=[8],
+                                activations=["tanh"])
+    models = [IndependentNNModel(
+        spec, nn_model.init_params(jax.random.PRNGKey(i), spec))
+        for i in range(3)]
+    x = rng.normal(size=(997, d)).astype(np.float32)   # not divisible by 8
+    devs = jax.devices("cpu")
+    r1 = Scorer(models).score(x)
+    r8 = Scorer(models, mesh=device_mesh(devices=devs[:8])).score(x)
+    np.testing.assert_allclose(r1.scores, r8.scores, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(r1.mean, r8.mean, rtol=1e-5, atol=1e-5)
