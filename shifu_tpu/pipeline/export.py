@@ -35,8 +35,58 @@ class ExportProcessor(BasicProcessor):
             return self._export_woe()
         if t == "corr":
             return self._export_corr()
+        if t in ("spec", "ref", "reference"):
+            return self._export_reference_spec()
         log.error("unknown export type %s", t)
         return 1
+
+    def _export_reference_spec(self) -> int:
+        """`export -t spec`: emit every trained member in the reference's
+        own serialized formats — Encog-EG ``model*.nn`` and
+        ``BinaryDTSerializer`` ``model*.gbt``/``model*.rf`` — so the
+        reference's dependency-free Java consumers (``IndependentNNModel``,
+        ``IndependentTreeModel``, ``shifu convert``) load them unchanged
+        (reference model-spec layer, ``BinaryDTSerializer.java:60-160``)."""
+        from ..eval.scorer import discover_model_paths
+        from ..export import reference_spec as ref
+        from ..models import load_any
+        paths = discover_model_paths(self.paths.models_dir)
+        if not paths:
+            log.error("no models to export — run `train` first")
+            return 1
+        out_dir = os.path.join(self.paths.export_dir, "reference")
+        os.makedirs(out_dir, exist_ok=True)
+        n = 0
+        for i, p in enumerate(paths):
+            m = load_any(p)
+            kind = type(m).__name__
+            try:
+                if kind == "IndependentNNModel":
+                    out = os.path.join(out_dir, f"model{i}.nn")
+                    ref.write_encog_nn(out, m.spec, m.params)
+                elif kind == "IndependentTreeModel":
+                    suffix = "gbt" if m.spec.algorithm == "GBT" else "rf"
+                    out = os.path.join(out_dir, f"model{i}.{suffix}")
+                    ref.write_reference_tree(out, m.spec, m.trees,
+                                             self.column_configs)
+                elif kind == "IndependentWDLModel":
+                    out = os.path.join(out_dir, f"model{i}.wdl")
+                    ref.write_reference_wdl(out, m.spec, m.params,
+                                            self.column_configs)
+                else:
+                    log.warning("model %s (%s): no reference format; "
+                                "skipped", p, kind)
+                    continue
+            except Exception as e:
+                log.error("reference export of %s failed: %s", p, e)
+                return 1
+            log.info("reference spec -> %s", out)
+            n += 1
+        if n == 0:
+            log.error("reference export: no model had a reference format")
+            return 1
+        log.info("reference export: %d model(s) -> %s", n, out_dir)
+        return 0
 
     def _export_bagging(self) -> int:
         """Bundle all bagged members + an ensemble manifest into export/

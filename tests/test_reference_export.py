@@ -1,0 +1,195 @@
+"""Round-trip tests for the reference-format model WRITERS
+(``export/reference_spec.py``): write → re-read through
+``models/reference_import.py`` (the byte-level oracle built against the
+reference's own Java readers) → score parity with the native model.
+
+Mirrors the reference's own spec-layer regression pattern: a model trained
+here must be consumable by ``IndependentNNModel`` / ``IndependentTreeModel``
+/ ``IndependentWDLModel`` byte-for-byte (``BinaryDTSerializer.java:60-160``,
+``BinaryWDLSerializer.java:66-125``, Encog EG persistence).
+"""
+
+import os
+
+import numpy as np
+import jax
+
+from shifu_tpu.config import ModelConfig
+
+
+def _train(prepared_set, algorithm, params):
+    from shifu_tpu.pipeline.train import TrainProcessor
+    mc_path = os.path.join(prepared_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.train.algorithm = algorithm
+    mc.train.params = params
+    mc.save(mc_path)
+    assert TrainProcessor(prepared_set, params={}).run() == 0
+
+
+def _export_spec(prepared_set):
+    from shifu_tpu.pipeline.export import ExportProcessor
+    assert ExportProcessor(prepared_set, params={"type": "spec"}).run() == 0
+    out = os.path.join(prepared_set, "export", "reference")
+    assert os.path.isdir(out)
+    return out
+
+
+def _fraud_eval_arrays(prepared_set, column_nums=None):
+    """(bins, x, raw_by_columnNum, missing_row_mask) over the training csv
+    through the SAME transform the scorer uses.  ``column_nums``: the
+    model spec's feature order (defaults to the transform's own)."""
+    from shifu_tpu.config.column_config import load_column_configs
+    from shifu_tpu.data import DataSource
+    from shifu_tpu.data.transform import DatasetTransformer
+    mc = ModelConfig.load(os.path.join(prepared_set, "ModelConfig.json"))
+    ccs = load_column_configs(
+        os.path.join(prepared_set, "ColumnConfig.json"))
+    src = DataSource(mc.dataSet.dataPath, mc.dataSet.dataDelimiter)
+    tf = DatasetTransformer(mc, ccs)
+    chunks = list(src.iter_chunks())
+    assert len(chunks) == 1
+    tc = tf.transform(chunks[0])
+    df = chunks[0].data
+    raw = {}
+    missing = np.zeros(tc.n, bool)
+    by_num = {cc.columnNum: cc for cc in ccs}
+    nums = column_nums if column_nums is not None else \
+        [nc.cc.columnNum for nc in tf.norm_cols]
+    sel = [by_num[n] for n in nums]
+    for j, cc in enumerate(sel):
+        if cc.is_categorical():
+            # the ref model consumes category INDICES; our bin index IS the
+            # category index (missing bin == the ref missing bucket)
+            raw[cc.columnNum] = tc.bins[:, j].astype(np.float64)
+        else:
+            v = np.array([float(x) if str(x).strip() not in ("", "NA")
+                          else np.nan for x in df[cc.columnName]])
+            raw[cc.columnNum] = v
+            missing |= ~np.isfinite(v)
+    return tc.bins, tc.x, raw, missing, sel
+
+
+def test_encog_nn_roundtrip(tmp_path):
+    from shifu_tpu.export.reference_spec import write_encog_nn
+    from shifu_tpu.models import nn as nn_model
+    from shifu_tpu.models.reference_import import load_encog_nn
+
+    spec = nn_model.NNModelSpec(input_dim=7, hidden_nodes=[9, 5],
+                                activations=["tanh", "relu"])
+    params = nn_model.init_params(jax.random.PRNGKey(3), spec)
+    params = [{"w": np.asarray(p["w"]), "b": np.asarray(p["b"])}
+              for p in params]
+    path = str(tmp_path / "model0.nn")
+    write_encog_nn(path, spec, params)
+    spec2, params2 = load_encog_nn(path)
+    assert spec2.input_dim == 7
+    assert spec2.hidden_nodes == [9, 5]
+    assert [a for a in spec2.activations] == ["tanh", "relu"]
+    assert spec2.output_activation == "sigmoid"
+    x = np.random.default_rng(0).normal(size=(64, 7)).astype(np.float32)
+    y1 = np.asarray(nn_model.forward(params, spec, x))
+    y2 = np.asarray(nn_model.forward(params2, spec2, x))
+    # text doubles round-trip via repr() exactly
+    np.testing.assert_allclose(y1, y2, rtol=1e-6, atol=1e-7)
+
+
+def test_gbt_reference_export_roundtrip(prepared_set):
+    from shifu_tpu.models.reference_import import load_reference_tree
+    from shifu_tpu.models.tree import IndependentTreeModel
+
+    _train(prepared_set, "GBT",
+           {"TreeNum": 8, "MaxDepth": 3, "Loss": "log", "LearningRate": 0.1})
+    out = _export_spec(prepared_set)
+    path = os.path.join(out, "model0.gbt")
+    assert os.path.isfile(path)
+    ref = load_reference_tree(path)
+    assert ref.algorithm == "GBT" and ref.version == 4
+    native = IndependentTreeModel.load(
+        os.path.join(prepared_set, "models", "model0.gbt"))
+    bins, _, raw, missing, _ = _fraud_eval_arrays(
+        prepared_set, native.spec.column_nums)
+    ours = native.compute(bins)[:, 0]
+    logits = ref.compute(raw)
+    theirs = 1.0 / (1.0 + np.exp(-logits))
+    ok = ~missing
+    assert ok.sum() > 1000
+    # rows with every numeric present score IDENTICALLY; missing rows
+    # follow the format's mean-imputation path (see reference_spec doc)
+    np.testing.assert_allclose(ours[ok], theirs[ok], rtol=1e-5, atol=1e-6)
+
+
+def test_rf_reference_export_roundtrip(prepared_set):
+    from shifu_tpu.models.reference_import import load_reference_tree
+    from shifu_tpu.models.tree import IndependentTreeModel
+
+    _train(prepared_set, "RF",
+           {"TreeNum": 6, "MaxDepth": 3, "Impurity": "variance"})
+    out = _export_spec(prepared_set)
+    path = os.path.join(out, "model0.rf")
+    assert os.path.isfile(path)
+    ref = load_reference_tree(path)
+    assert ref.algorithm == "RF"
+    native = IndependentTreeModel.load(
+        os.path.join(prepared_set, "models", "model0.rf"))
+    bins, _, raw, missing, _ = _fraud_eval_arrays(
+        prepared_set, native.spec.column_nums)
+    ours = native.compute(bins)[:, 0]
+    theirs = ref.compute(raw)                        # mean leaf, no link
+    ok = ~missing
+    np.testing.assert_allclose(ours[ok], theirs[ok], rtol=1e-5, atol=1e-6)
+
+
+def test_wdl_reference_roundtrip(tmp_path):
+    from shifu_tpu.export.reference_spec import write_reference_wdl
+    from shifu_tpu.models import wdl as wdl_model
+    from shifu_tpu.models.reference_import import load_reference_wdl
+
+    spec = wdl_model.WDLModelSpec(numeric_dim=4, cat_cardinalities=[5, 3],
+                                  embed_dim=4, hidden_nodes=[8],
+                                  activations=["relu"],
+                                  column_nums=[2, 3, 4, 5],
+                                  cat_column_nums=[6, 7])
+    params = wdl_model.init_params(jax.random.PRNGKey(1), spec)
+    # perturb so wide/bias terms are nonzero in the parity check
+    rng = np.random.default_rng(2)
+    params["wide_cat"] = [np.asarray(rng.normal(size=v.shape), np.float32)
+                          for v in params["wide_cat"]]
+    params["wide_num"] = np.asarray(
+        rng.normal(size=params["wide_num"].shape), np.float32)
+    params["bias"] = np.asarray([0.3], np.float32)
+    path = str(tmp_path / "model0.wdl")
+    write_reference_wdl(path, spec, params)
+    spec2, params2, col_stats = load_reference_wdl(path)
+    assert spec2.numeric_dim == 4
+    assert spec2.cat_cardinalities == [5, 3]
+    assert spec2.hidden_nodes == [8]
+    assert spec2.cat_column_nums == [6, 7]
+    x_num = rng.normal(size=(32, 4)).astype(np.float32)
+    x_cat = np.stack([rng.integers(0, 5, 32),
+                      rng.integers(0, 3, 32)], axis=1).astype(np.int32)
+    y1 = np.asarray(wdl_model.forward(params, spec, x_num, x_cat))
+    y2 = np.asarray(wdl_model.forward(params2, spec2, x_num, x_cat))
+    # f32 binary round trip is exact up to jit reassociation
+    np.testing.assert_allclose(y1, y2, rtol=1e-6, atol=1e-7)
+
+
+def test_nn_export_cli_spec(prepared_set):
+    from shifu_tpu.models import nn as nn_model
+    from shifu_tpu.models.reference_import import load_encog_nn
+
+    _train(prepared_set, "NN",
+           {"Propagation": "B", "LearningRate": 0.1,
+            "NumHiddenNodes": [8], "ActivationFunc": ["tanh"]})
+    out = _export_spec(prepared_set)
+    path = os.path.join(out, "model0.nn")
+    assert os.path.isfile(path)
+    spec2, params2 = load_encog_nn(path)
+    from shifu_tpu.models.nn import IndependentNNModel
+    native = IndependentNNModel.load(
+        os.path.join(prepared_set, "models", "model0.nn"))
+    _, x, _, _, _ = _fraud_eval_arrays(prepared_set)
+    y1 = native.compute(x)[:, 0]
+    y2 = np.asarray(nn_model.forward(params2, spec2,
+                                     np.asarray(x, np.float32)))[:, 0]
+    np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)
