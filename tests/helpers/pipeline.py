@@ -1,0 +1,16 @@
+"""Shared pipeline-driving helpers for export round-trip tests."""
+
+import os
+
+from shifu_tpu.config import ModelConfig
+
+
+def train_algorithm(model_set: str, algorithm: str, params: dict) -> None:
+    """Set train.algorithm/params on a prepared model set and run TRAIN."""
+    from shifu_tpu.pipeline.train import TrainProcessor
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.train.algorithm = algorithm
+    mc.train.params = params
+    mc.save(mc_path)
+    assert TrainProcessor(model_set, params={}).run() == 0

@@ -10,21 +10,16 @@ here must be consumable by ``IndependentNNModel`` / ``IndependentTreeModel``
 """
 
 import os
+import sys
 
 import numpy as np
 import jax
 
 from shifu_tpu.config import ModelConfig
 
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "helpers"))
 
-def _train(prepared_set, algorithm, params):
-    from shifu_tpu.pipeline.train import TrainProcessor
-    mc_path = os.path.join(prepared_set, "ModelConfig.json")
-    mc = ModelConfig.load(mc_path)
-    mc.train.algorithm = algorithm
-    mc.train.params = params
-    mc.save(mc_path)
-    assert TrainProcessor(prepared_set, params={}).run() == 0
+from pipeline import train_algorithm as _train  # noqa: E402
 
 
 def _export_spec(prepared_set):
