@@ -1,0 +1,160 @@
+"""Device-side splitmix64 — the streaming row-hash, on chip.
+
+The streamed trainers key every stateless decision (bags, splits) off
+``data.streaming.row_uniform`` — host splitmix64 over the global row
+index.  Replaying those draws on device (no uint64 there without x64
+mode: 64-bit values ride as uint32 hi/lo pairs, products built from
+16-bit limbs) lets a fully-resident streamed forest draw its per-tree
+bags in-graph instead of hashing + transferring [N] floats per tree over
+the host link.  Poisson counts compare the 53-bit uniform against
+integer CDF thresholds, so device bags are BIT-IDENTICAL to the host's
+(``tests/test_ops_hardening.py::test_device_hash_bags_match_host``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_MASK16 = jnp.uint32(0xFFFF)
+
+
+def _add64(ahi, alo, bhi, blo):
+    lo = alo + blo
+    carry = (lo < alo).astype(jnp.uint32)
+    return ahi + bhi + carry, lo
+
+
+def _mul32x32(a, b):
+    """(hi, lo) of the 64-bit product of two uint32 (16-bit limbs)."""
+    a0, a1 = a & _MASK16, a >> 16
+    b0, b1 = b & _MASK16, b >> 16
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> 16) + (p01 & _MASK16) + (p10 & _MASK16)
+    lo = (p00 & _MASK16) | (mid << 16)
+    hi = p11 + (p01 >> 16) + (p10 >> 16) + (mid >> 16)
+    return hi, lo
+
+
+def _mul64(ahi, alo, bhi, blo):
+    """Low 64 bits of a 64x64 product."""
+    hi, lo = _mul32x32(alo, blo)
+    hi = hi + alo * bhi + ahi * blo          # wrapping uint32 products
+    return hi, lo
+
+
+def _xorshift_r(hi, lo, k: int):
+    """(hi, lo) ^ ((hi, lo) >> k) for 0 < k < 64."""
+    if k < 32:
+        shi = hi >> k
+        slo = (lo >> k) | (hi << (32 - k))
+    else:
+        shi = jnp.zeros_like(hi)
+        slo = hi >> (k - 32)
+    return hi ^ shi, lo ^ slo
+
+
+def _const64(v: int):
+    return jnp.uint32(v >> 32), jnp.uint32(v & 0xFFFFFFFF)
+
+
+def _splitmix64_dev(hi, lo):
+    hi, lo = _add64(hi, lo, *_const64(0x9E3779B97F4A7C15))
+    hi, lo = _xorshift_r(hi, lo, 30)
+    hi, lo = _mul64(hi, lo, *_const64(0xBF58476D1CE4E5B9))
+    hi, lo = _xorshift_r(hi, lo, 27)
+    hi, lo = _mul64(hi, lo, *_const64(0x94D049BB133111EB))
+    return _xorshift_r(hi, lo, 31)
+
+
+def _row_key(seed: int, stream: int) -> int:
+    """Host scalar half of ``row_uniform``: splitmix64(seed * FNV + stream)
+    (``data/streaming.py:40-46``)."""
+    z = ((seed & 0xFFFFFFFF) * 0x100000001B3
+         + (stream & 0xFFFFFFFF)) & 0xFFFFFFFFFFFFFFFF
+    z = (z + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    z ^= z >> 30
+    z = (z * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    z ^= z >> 27
+    z = (z * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    z ^= z >> 31
+    return z
+
+
+def poisson_thresholds(lam: float, kmax: int = 16) -> np.ndarray:
+    """[kmax] uint64 CDF thresholds over the 53-bit uniform lattice —
+    ``count = sum_k [u53 >= t_k]`` reproduces ``_hash_poisson`` exactly
+    (its float compare ``u >= cdf`` over u = u53 * 2^-53)."""
+    p = np.exp(-lam)
+    cdf = p
+    term = p
+    out = np.empty(kmax, np.uint64)
+    for k in range(1, kmax + 1):
+        # u >= cdf  <=>  u53 >= ceil(cdf * 2^53)  (u53 = u * 2^53 exact)
+        out[k - 1] = np.uint64(min(np.ceil(cdf * (1 << 53)), 1 << 53))
+        term = term * lam / k
+        cdf = cdf + term
+    return out
+
+
+@partial(jax.jit, static_argnames=("seed", "stream", "lam", "kmax"))
+def hash_poisson_device(idx_hi, idx_lo, seed: int, stream: int,
+                        lam: float, kmax: int = 16):
+    """[N] f32 Poisson(lam) bag counts from global row indices — the
+    device replay of ``_hash_poisson(lam, row_uniform(seed, stream, idx))``,
+    bit-identical to the host draw."""
+    key = _row_key(seed, stream)
+    khi, klo = jnp.uint32(key >> 32), jnp.uint32(key & 0xFFFFFFFF)
+    zhi, zlo = _splitmix64_dev(idx_hi ^ khi, idx_lo ^ klo)
+    # u53 = z >> 11: hi 21 bits + lo 32 bits
+    uhi = zhi >> 11
+    ulo = (zlo >> 11) | (zhi << 21)
+    th = poisson_thresholds(lam, kmax)
+    cnt = jnp.zeros(idx_lo.shape, jnp.float32)
+    for t in th:
+        thi = jnp.uint32(int(t) >> 32)
+        tlo = jnp.uint32(int(t) & 0xFFFFFFFF)
+        ge = (uhi > thi) | ((uhi == thi) & (ulo >= tlo))
+        cnt = cnt + ge.astype(jnp.float32)
+    return cnt
+
+
+def row_key_u32(seed: int, stream: int) -> Tuple[np.uint32, np.uint32]:
+    """(hi, lo) halves of the host row key — TRACED inputs for
+    :func:`hash_poisson_traced`, so a per-tree stream does not recompile."""
+    key = _row_key(seed, stream)
+    return np.uint32(key >> 32), np.uint32(key & 0xFFFFFFFF)
+
+
+def thresholds_u32(lam: float, kmax: int = 16):
+    """(hi, lo) uint32 halves of :func:`poisson_thresholds`."""
+    th = poisson_thresholds(lam, kmax)
+    return ((th >> np.uint64(32)).astype(np.uint32),
+            (th & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+
+
+def hash_poisson_traced(idx_hi, idx_lo, khi, klo, thi, tlo):
+    """Traced-key variant of :func:`hash_poisson_device` (key + CDF
+    thresholds as device scalars/arrays — one executable serves every
+    tree of a streamed forest)."""
+    zhi, zlo = _splitmix64_dev(idx_hi ^ khi, idx_lo ^ klo)
+    uhi = zhi >> 11
+    ulo = (zlo >> 11) | (zhi << 21)
+    ge = (uhi[:, None] > thi[None, :]) | \
+        ((uhi[:, None] == thi[None, :]) & (ulo[:, None] >= tlo[None, :]))
+    return ge.sum(axis=1).astype(jnp.float32)
+
+
+def split_index_u32(idx: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host row-index array -> (hi, lo) uint32 halves for the device hash."""
+    idx = np.asarray(idx, np.uint64)
+    return ((idx >> np.uint64(32)).astype(np.uint32),
+            (idx & np.uint64(0xFFFFFFFF)).astype(np.uint32))

@@ -178,7 +178,11 @@ def build_histograms_pallas(bins, node_idx, stats, n_nodes: int,
     # [K, nblk] + [B_pad, nblk] VMEM operands stay comfortably resident;
     # shallow levels (tiny K) take wider blocks — they are grid-step
     # bound, not VMEM bound (K is already <= K_MAX here)
-    nblk = 4096 if n_nodes <= 32 else 2048
+    # wider row blocks when the one-hot node operand is small (histogram
+    # subtraction keeps K <= 32 through depth 6): fewer grid steps, same
+    # VMEM envelope (~10 MB at 16384)
+    nblk = int(os.environ.get("SHIFU_HIST_NBLK", 0)) or \
+        (16384 if n_nodes <= 16 else 8192 if n_nodes <= 32 else 2048)
     n_pad = ((n + nblk - 1) // nblk) * nblk
 
     bins_t = jnp.pad(bins, ((0, n_pad - n), (0, c_pad - c))).T  # [C_pad, N_pad]

@@ -381,18 +381,47 @@ def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
     feats, lmasks, leaves = [], [], []
     gain_fi = jnp.zeros(c, jnp.float32)
     node_idx = jnp.zeros(n, jnp.int32)       # level-local position, -1 done
+    leaf_glob = jnp.zeros(n, jnp.int32)      # global node id where row rests
     nodes_cnt = jnp.int32(1)                 # leaf-wise budget state
+    hist_prev = None
+    feat_prev = None
     for level in range(depth + 1):
         n_nodes = 1 << level
-        hist = build_histograms(bins, node_idx, stats, n_nodes, n_bins,
-                                use_pallas, mesh, stats_exact)
+        if level == depth:
+            # the bottom level never splits — best_splits' gain/feat/lmask
+            # would be discarded, so the full [K, C, B, S] histogram (the
+            # deepest, most expensive kernel call of the tree) is pure
+            # waste.  Leaf values need only per-node stat sums: one
+            # [S, N] x [N, K] dot (HIGHEST precision keeps f32-exact
+            # counts; frozen rows mask to no column).
+            leaves.append(_level_leaf_sums(stats, node_idx, n_nodes,
+                                           n_classes))
+            feats.append(jnp.full(n_nodes, -1, jnp.int32))
+            lmasks.append(jnp.zeros((n_nodes, n_bins), bool))
+            break
+        if level == 0:
+            hist = build_histograms(bins, node_idx, stats, n_nodes, n_bins,
+                                    use_pallas, mesh, stats_exact)
+        else:
+            # histogram SUBTRACTION (the LightGBM trick the reference's
+            # level-wise DTMaster never had): build only the LEFT-child
+            # histograms — half the one-hot node width, so half the MXU
+            # work — and derive each right child as parent - left.  A
+            # frozen (unsplit) parent contributes neither child: its left
+            # rows map to no node (idx -1) and its right half is masked
+            # to zero instead of inheriting the parent's histogram.
+            hl = build_histograms(
+                bins, _left_child_index(node_idx), stats, n_nodes // 2,
+                n_bins, use_pallas, mesh, stats_exact)
+            split_ok = feat_prev >= 0
+            hr = jnp.where(split_ok[:, None, None, None],
+                           hist_prev - hl, 0.0)
+            hist = jnp.stack([hl, hr], axis=1) \
+                .reshape(n_nodes, c, hl.shape[2], hl.shape[3])
         gain, feat, lmask, leaf, node_w = best_splits(
             hist, cat, fa, impurity, min_instances, min_gain, n_classes,
             has_cat)
-        if level == depth:                   # bottom level never splits
-            feat = jnp.full(n_nodes, -1, jnp.int32)
-            lmask = jnp.zeros((n_nodes, n_bins), bool)
-        elif max_leaves > 0:
+        if max_leaves > 0:
             feat, lmask, nodes_cnt = cap_splits_by_leaves(
                 gain, feat, lmask, nodes_cnt, max_leaves)
         feats.append(feat)
@@ -401,10 +430,37 @@ def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
         gain_fi = gain_fi + jax.ops.segment_sum(
             jnp.where(feat >= 0, jnp.maximum(gain, 0.0), 0.0).astype(jnp.float32),
             jnp.maximum(feat, 0), num_segments=c)
-        if level < depth:
-            node_idx = _descend(bins, node_idx, feat, lmask)
+        hist_prev, feat_prev = hist, feat
+        node_idx = _descend(bins, node_idx, feat, lmask)
+        # rows that just descended rest at their child's GLOBAL id; frozen
+        # rows keep the node they stopped at — after the loop this is the
+        # terminal node per row (predict = leaf_value[leaf_glob], no
+        # re-walk; see traverse_nodes for the standalone path)
+        leaf_glob = jnp.where(node_idx >= 0,
+                              ((1 << (level + 1)) - 1) + node_idx,
+                              leaf_glob)
     return (jnp.concatenate(feats), jnp.concatenate(lmasks, axis=0),
-            jnp.concatenate(leaves), gain_fi)
+            jnp.concatenate(leaves), gain_fi, leaf_glob)
+
+
+def _level_leaf_sums(stats, node_idx, n_nodes: int, n_classes: int = 0):
+    """Per-node leaf values from stat sums alone: [K] ``wy/w`` (binary /
+    regression) or [K, n_classes] class distributions (multiclass)."""
+    oh = jax.nn.one_hot(node_idx, n_nodes, dtype=jnp.float32)  # -1 -> 0s
+    sums = jax.lax.dot_general(stats, oh, (((0,), (0,)), ((), ())),
+                               precision=jax.lax.Precision.HIGHEST)  # [S, K]
+    if n_classes > 2:
+        w = sums.sum(axis=0)                               # [K]
+        return (sums / jnp.maximum(w, EPS)[None, :]).T     # [K, S]
+    return sums[1] / jnp.maximum(sums[0], EPS)
+
+
+def _left_child_index(node_idx):
+    """Level-local LEFT-child selector for histogram subtraction: a row in
+    left child ``2p`` maps to parent slot ``p``; right-child and frozen
+    rows map to -1 (contribute to no one-hot node)."""
+    return jnp.where((node_idx >= 0) & (node_idx % 2 == 0),
+                     node_idx // 2, -1)
 
 
 def grow_tree(bins, targets, weights, n_bins: int, depth: int,
@@ -419,7 +475,7 @@ def grow_tree(bins, targets, weights, n_bins: int, depth: int,
     stats = jnp.stack([wt, wt * t], axis=1)
     cat = jnp.zeros(c, bool) if cat_mask is None else jnp.asarray(cat_mask)
     fa = jnp.ones(c, bool) if feat_active is None else jnp.asarray(feat_active)
-    split_feat, left_mask, leaf_value, _ = grow_tree_jit(
+    split_feat, left_mask, leaf_value, _, _ = grow_tree_jit(
         bins, stats, cat, fa, n_bins, depth, impurity,
         float(min_instances), float(min_gain))
     return TreeArrays(split_feat=np.asarray(split_feat),

@@ -171,13 +171,12 @@ def _gbt_round_impl(bins, y, tw, vw, f, fa, cat, lr, min_instances,
     scalars cross to the host."""
     grad = _loss_grad(y, f, loss)
     stats = jnp.stack([tw, tw * grad], axis=1).astype(jnp.float32)
-    sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
-                                    impurity, min_instances, min_gain,
-                                    use_pallas=use_pallas,
-                                    max_leaves=max_leaves, has_cat=has_cat,
-                                    mesh=mesh)
-    pred = predict_tree(sf, lm, lv, bins, depth)
-    f2 = f + lr * pred
+    sf, lm, lv, gfi, leaf_glob = grow_tree_jit(
+        bins, stats, cat, fa, n_bins, depth, impurity, min_instances,
+        min_gain, use_pallas=use_pallas, max_leaves=max_leaves,
+        has_cat=has_cat, mesh=mesh)
+    pred = jnp.take(lv, leaf_glob, axis=0)   # growth already walked the
+    f2 = f + lr * pred                       # rows to their leaves
     per = _per_row_loss(y, f2, loss)
     tr = (per * tw).sum() / jnp.maximum(tw.sum(), 1e-9)
     va = (per * vw).sum() / jnp.maximum(vw.sum(), 1e-9)
@@ -262,9 +261,24 @@ def _rf_round_impl(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
     class distributions, misclassification-rate errors (reference
     ``dt/Impurity.java:368,553`` multiclass Entropy/Gini)."""
     n = bins.shape[0]
-    multiclass = n_classes > 2
     bag = jax.random.poisson(key, bag_rate, (n,)).astype(jnp.float32) \
         if poisson else jnp.ones(n, jnp.float32)
+    return _rf_round_from_bag(bins, y, w, bag, oob_sum, oob_cnt, fa, cat,
+                              min_instances, min_gain, n_bins, depth,
+                              impurity, loss, n_classes, use_pallas,
+                              max_leaves, has_cat, mesh, stats_exact)
+
+
+def _rf_round_from_bag(bins, y, w, bag, oob_sum, oob_cnt, fa, cat,
+                       min_instances, min_gain, n_bins: int, depth: int,
+                       impurity: str, loss: str, n_classes: int = 0,
+                       use_pallas: bool = False, max_leaves: int = 0,
+                       has_cat: bool = True, mesh=None,
+                       stats_exact: bool = False):
+    """RF round body given a PRECOMPUTED bag — shared by the resident
+    path (Poisson drawn in-graph above) and the streamed mega path
+    (hash bags replayed on device, ``ops/hashing.py``)."""
+    multiclass = n_classes > 2
     bw = w * bag
     if multiclass:
         yi = y.astype(jnp.int32)
@@ -273,11 +287,11 @@ def _rf_round_impl(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
     else:
         stats = jnp.stack([bw, bw * y], axis=1) \
             .astype(jnp.float32)
-    sf, lm, lv, gfi = grow_tree_jit(bins, stats, cat, fa, n_bins, depth,
-                                    impurity, min_instances, min_gain,
-                                    n_classes, use_pallas, max_leaves,
-                                    has_cat, mesh, stats_exact)
-    pred = predict_tree(sf, lm, lv, bins, depth)   # [n, K] mc, [n] binary
+    sf, lm, lv, gfi, leaf_glob = grow_tree_jit(
+        bins, stats, cat, fa, n_bins, depth, impurity, min_instances,
+        min_gain, n_classes, use_pallas, max_leaves, has_cat, mesh,
+        stats_exact)
+    pred = jnp.take(lv, leaf_glob, axis=0)         # [n, K] mc, [n] binary
     oob = (bag == 0) & (w > 0)
     if multiclass:
         oob_sum = oob_sum + jnp.where(oob[:, None], pred, 0.0)
@@ -308,6 +322,29 @@ def _rf_round_impl(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
     return sf, lm, lv, gfi, oob_sum, oob_cnt, tr, va
 
 
+
+def _mask_nbytes(total: int, n_bins: int) -> int:
+    return (total * n_bins + 7) // 8
+
+
+def _pack_mask_bits(lm):
+    """left_mask bits packed 8-per-byte-value (f32-exact 0..255) for the
+    host fetch — the mask is ~96%% of a packed tree's floats, so bit
+    packing shrinks every tree transfer ~8x on the wire.  MSB-first to
+    match ``np.unpackbits`` in :func:`_unpack_mask_bits`."""
+    flat = lm.reshape(-1).astype(jnp.float32)
+    pad = (-flat.shape[0]) % 8
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros(pad, jnp.float32)])
+    w = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.float32)
+    return flat.reshape(-1, 8) @ w
+
+
+def _unpack_mask_bits(vals: np.ndarray, total: int, n_bins: int):
+    bits = np.unpackbits(np.asarray(np.rint(vals), np.uint8))
+    return bits[:total * n_bins].reshape(total, n_bins) > 0
+
+
 def _pack_tree_impl(sf, lm, lv, gfi, tr, va):
     """Flatten one round's outputs into a single f32 vector so the host
     fetches the whole tree in ONE transfer.  The tunnel to the chip costs
@@ -315,7 +352,7 @@ def _pack_tree_impl(sf, lm, lv, gfi, tr, va):
     unbatched per-array fetches dominated round-2 GBT wall-clock ~15:1
     over compute."""
     return jnp.concatenate([
-        sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
+        sf.astype(jnp.float32), _pack_mask_bits(lm),
         lv.reshape(-1).astype(jnp.float32), gfi.astype(jnp.float32),
         jnp.stack([tr, va]).astype(jnp.float32)])
 
@@ -380,13 +417,13 @@ def _unpack_tree(vec: np.ndarray, total: int, n_bins: int, c: int,
                  depth: int, n_classes: int = 0):
     """Host-side inverse of :func:`_pack_tree`."""
     k = n_classes if n_classes > 2 else 1
-    sizes = [total, total * n_bins, total * k, c, 2]
+    sizes = [total, _mask_nbytes(total, n_bins), total * k, c, 2]
     parts = np.split(vec, np.cumsum(sizes)[:-1])
     lv = parts[2].astype(np.float32)
     if k > 1:
         lv = lv.reshape(total, k)
     tree = TreeArrays(split_feat=parts[0].astype(np.int32),
-                      left_mask=parts[1].reshape(total, n_bins) > 0.5,
+                      left_mask=_unpack_mask_bits(parts[1], total, n_bins),
                       leaf_value=lv, depth=depth)
     return tree, parts[3].astype(np.float64), float(parts[4][0]), \
         float(parts[4][1])
@@ -987,18 +1024,21 @@ def _rf_window_update_batch(sums_b, bins_w, y_w, w_w, bags_b, oob_sum_w,
     return osw, ocw, jnp.stack(sums)
 
 
+
+
 def _unpack_streamed(packed: np.ndarray, total: int, n_bins: int, c: int,
                      depth: int, n_classes: int = 0):
     """Host-side inverse of the fused/streamed packed layout
     [sf, lm, lv, fi, sums] — the ONE place that knows it."""
     k = n_classes if n_classes > 2 else 1
     sf_h, lm_h, lv_h, fi_h, sums = np.split(
-        packed, np.cumsum([total, total * n_bins, total * k, c]))
+        packed,
+        np.cumsum([total, _mask_nbytes(total, n_bins), total * k, c]))
     lv = lv_h.astype(np.float32)
     if k > 1:
         lv = lv.reshape(total, k)
     tree = TreeArrays(split_feat=sf_h.astype(np.int32),
-                      left_mask=lm_h.reshape(total, n_bins) > 0.5,
+                      left_mask=_unpack_mask_bits(lm_h, total, n_bins),
                       leaf_value=lv, depth=depth)
     return tree, fi_h.astype(np.float32), sums
 
@@ -1048,110 +1088,6 @@ def _tree_level_step_batch(hist_b, cat, fa_b, impurity: str, min_instances,
     return tuple(jnp.stack(x) for x in zip(*outs))
 
 
-@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
-                                   "use_pallas", "max_leaves", "has_cat",
-                                   "mesh"))
-def _gbt_tree_fused(wins, fa, cat, lr, min_instances, min_gain,
-                    n_bins: int, depth: int, impurity: str, loss: str,
-                    use_pallas: bool, max_leaves: int, has_cat: bool,
-                    mesh=None):
-    """One streamed GBT tree over a FULLY-RESIDENT window cache as a single
-    executable: all (depth+1) level sweeps + the update pass fuse, so a
-    tree costs one program execution + one packed fetch — the per-level
-    per-window dispatch pattern only remains for disk-tail windows.
-
-    ``wins``: tuple of (bins, y, tw, vw, f) per resident window (static
-    count/shapes).  Returns (packed [tree + fi + sums], new f per window).
-    """
-    total = n_tree_nodes(depth)
-    c = wins[0][0].shape[1]
-    sf = jnp.full(total, -1, jnp.int32)
-    lm = jnp.zeros((total, n_bins), bool)
-    lv = jnp.zeros(total, jnp.float32)
-    nodes_cnt = jnp.int32(1)
-    fi_add = jnp.zeros(c, jnp.float32)
-    for level in range(depth + 1):
-        n_nodes = 1 << level
-        hist = jnp.zeros((n_nodes, c, n_bins, 2), jnp.float32)
-        for bins_w, y_w, tw_w, _, f_w in wins:
-            node_idx = node_index_at_level(sf, lm, bins_w, level)
-            grad = _loss_grad(y_w, f_w, loss)
-            stats = jnp.stack([tw_w, tw_w * grad],
-                              axis=1).astype(jnp.float32)
-            hist = hist + build_histograms(bins_w, node_idx, stats,
-                                           n_nodes, n_bins, use_pallas,
-                                           mesh)
-        sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
-            hist, cat, fa, impurity, min_instances, min_gain, has_cat,
-            level, depth, max_leaves, sf, lm, lv, nodes_cnt, fi_add)
-    sums = jnp.zeros(4, jnp.float32)
-    new_f = []
-    for bins_w, y_w, tw_w, vw_w, f_w in wins:
-        pred = predict_tree(sf, lm, lv, bins_w, depth)
-        f2 = f_w + lr * pred
-        per = _per_row_loss(y_w, f2, loss)
-        sums = sums + jnp.stack([(per * tw_w).sum(), tw_w.sum(),
-                                 (per * vw_w).sum(), vw_w.sum()])
-        new_f.append(f2)
-    packed = jnp.concatenate([
-        sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
-        lv, fi_add, sums])
-    return packed, tuple(new_f)
-
-
-
-@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
-                                   "use_pallas", "max_leaves", "has_cat",
-                                   "mesh", "n_classes", "stats_exact"))
-def _rf_tree_fused(wins, fa, cat, min_instances, min_gain, n_bins: int,
-                   depth: int, impurity: str, loss: str,
-                   use_pallas: bool, max_leaves: int, has_cat: bool,
-                   mesh=None, n_classes: int = 0,
-                   stats_exact: bool = False):
-    """One streamed RF tree over a FULLY-RESIDENT window cache as a single
-    executable (see :func:`_gbt_tree_fused`).  ``wins``: tuple of
-    (bins, y, w, bag, oob_sum, oob_cnt) per window.  Returns
-    (packed [tree + fi + sums], new (oob_sum, oob_cnt) per window).
-    Multiclass NATIVE: per-class stat channels + leaf distributions."""
-    total = n_tree_nodes(depth)
-    c = wins[0][0].shape[1]
-    multiclass = n_classes > 2
-    n_stats = n_classes if multiclass else 2
-    sf = jnp.full(total, -1, jnp.int32)
-    lm = jnp.zeros((total, n_bins), bool)
-    lv = jnp.zeros((total, n_classes) if multiclass else total, jnp.float32)
-    nodes_cnt = jnp.int32(1)
-    fi_add = jnp.zeros(c, jnp.float32)
-    for level in range(depth + 1):
-        n_nodes = 1 << level
-        hist = jnp.zeros((n_nodes, c, n_bins, n_stats), jnp.float32)
-        for bins_w, y_w, w_w, bag_w, _, _ in wins:
-            bw = w_w * bag_w
-            node_idx = node_index_at_level(sf, lm, bins_w, level)
-            if multiclass:
-                stats = bw[:, None] * jax.nn.one_hot(
-                    y_w.astype(jnp.int32), n_classes, dtype=jnp.float32)
-            else:
-                stats = jnp.stack([bw, bw * y_w],
-                                  axis=1).astype(jnp.float32)
-            hist = hist + build_histograms(bins_w, node_idx, stats,
-                                           n_nodes, n_bins, use_pallas,
-                                           mesh, stats_exact)
-        sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
-            hist, cat, fa, impurity, min_instances, min_gain, has_cat,
-            level, depth, max_leaves, sf, lm, lv, nodes_cnt, fi_add,
-            n_classes)
-    sums = jnp.zeros(4, jnp.float32)
-    new_oob = []
-    for bins_w, y_w, w_w, bag_w, os_w, oc_w in wins:
-        os2, oc2, sums = _rf_window_update(
-            sums, bins_w, y_w, w_w, bag_w, os_w, oc_w, sf, lm, lv, depth,
-            loss, n_classes)
-        new_oob.append((os2, oc2))
-    packed = jnp.concatenate([
-        sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
-        lv.reshape(-1), fi_add, sums])
-    return packed, tuple(new_oob)
 
 
 @lru_cache(maxsize=None)
@@ -1202,7 +1138,7 @@ def _pack_streamed_stacked(sf_b, lm_b, lv_b, fi_b, sums_b):
     tb = sf_b.shape[0]
     return jnp.concatenate([
         sf_b.astype(jnp.float32),
-        lm_b.reshape(tb, -1).astype(jnp.float32),
+        jax.vmap(_pack_mask_bits)(lm_b),
         lv_b.reshape(tb, -1), fi_b, sums_b], axis=1)
 
 
@@ -1416,23 +1352,29 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
     # consumer only needs lines, batched by the shared flusher
     flush_progress, mark_progress = _progress_flusher(
         drain_fused, history, progress, len(trees) - len(history))
+
+    # fully-resident: COALESCE the windows into one device-resident row
+    # block once and run the RESIDENT per-tree round on it — the
+    # per-(window, level) dispatch pattern cost ~(depth+2) x windows
+    # kernel launches per tree (measured ~10x the resident path at bench
+    # shapes), and the resident round carries every tree-kernel
+    # optimization (histogram subtraction, leaf-sum bottom level, fused
+    # predict).  Tail regimes keep the window loop below.
+    mega = None
+    if cache.warmed and cache.tail is None:
+        items = list(cache.items())
+        mega = {k: _concat_rows([it.arrays[k] for it in items])
+                for k in ("bins", "y", "tw", "vw")}
+        mega["f"] = _concat_rows([window_f(it) for it in items])
     for ti in range(len(trees) + len(pending_fused), settings.n_trees):
         fa = jnp.asarray(_feat_subset(settings, c, ti))
-        if cache.warmed and cache.tail is None:
-            # everything fits the device budget: the whole tree (levels +
-            # update) is ONE executable (see _gbt_tree_fused); with no
-            # live consumer the packed trees drain in one batched fetch
-            items = list(cache.items())
-            wins = tuple((it.arrays["bins"], it.arrays["y"],
-                          it.arrays["tw"], it.arrays["vw"], window_f(it))
-                         for it in items)
-            packed_d, new_f = _gbt_tree_fused(
-                wins, fa, cat, settings.learning_rate,
-                settings.min_instances, settings.min_gain, n_bins,
-                settings.depth, imp, settings.loss, up,
-                settings.max_leaves, hc, _hist_mesh(mesh))
-            for it, f2 in zip(items, new_f):
-                it.arrays["f"] = f2
+        if mega is not None:
+            packed_d, mega["f"] = _gbt_round_streamed(
+                mega["bins"], mega["y"], mega["tw"], mega["vw"], mega["f"],
+                fa, cat, settings.learning_rate, settings.min_instances,
+                settings.min_gain, n_bins, settings.depth, imp,
+                settings.loss, up, settings.max_leaves, hc,
+                _hist_mesh(mesh))
             if settings.early_stop:
                 absorb_fused([np.asarray(packed_d)])
                 tr_err, va_err = history[-1]
@@ -1488,7 +1430,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                 s, e = it.start, it.start + it.n_valid
                 f[s:e] = np.asarray(f2)[:it.n_valid]
         absorb_fused([np.asarray(jnp.concatenate([
-            sf.astype(jnp.float32), lm.reshape(-1).astype(jnp.float32),
+            sf.astype(jnp.float32), _pack_mask_bits(lm),
             lv, fi_add, sums_dev]))])
         tr_err, va_err = history[-1]
         if progress:
@@ -1511,6 +1453,67 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
         feature_importance=np.asarray(fi_dev, np.float64),
         trees_built=len(trees), history=history,
         disk_passes=cache.disk_passes)
+
+
+@lru_cache(maxsize=None)
+def _concat_rows_jit(k: int):
+    """jitted row-concat — eager concatenation of mesh-sharded window
+    arrays aborts XLA:CPU (the known eager-reshard SIGABRT); under jit
+    the partitioner inserts the reshard."""
+    return jax.jit(lambda *xs: jnp.concatenate(xs, axis=0))
+
+
+def _concat_rows(xs):
+    return xs[0] if len(xs) == 1 else _concat_rows_jit(len(xs))(*xs)
+
+
+_gbt_round_streamed = partial(jax.jit, static_argnames=(
+    "n_bins", "depth", "impurity", "loss", "use_pallas", "max_leaves",
+    "has_cat", "mesh"))(
+    lambda bins, y, tw, vw, f, fa, cat, lr, mi, mg, n_bins, depth,
+    impurity, loss, use_pallas, max_leaves, has_cat, mesh:
+    _pack_round_streamed(*_gbt_round_impl(
+        bins, y, tw, vw, f, fa, cat, lr, mi, mg, n_bins, depth, impurity,
+        loss, use_pallas, max_leaves, has_cat, mesh)))
+
+
+def _pack_round_streamed(sf, lm, lv, gfi, f2, tr, va):
+    """Resident-round outputs in the STREAMED packed layout
+    ([sf, mask-bits, lv, fi, sums4] — :func:`_unpack_streamed` divides
+    sums pairwise, so unit denominators carry the ready-made errors)."""
+    one = jnp.ones((), jnp.float32)
+    return jnp.concatenate([
+        sf.astype(jnp.float32), _pack_mask_bits(lm), lv, gfi,
+        jnp.stack([tr, one, va, one])]), f2
+
+
+@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
+                                   "poisson", "n_classes", "use_pallas",
+                                   "max_leaves", "has_cat", "mesh",
+                                   "stats_exact"))
+def _rf_round_streamed(bins, y, w, idx_hi, idx_lo, khi, klo, thi, tlo,
+                       oob_sum, oob_cnt, fa, cat, mi, mg, n_bins: int,
+                       depth: int, impurity: str, loss: str,
+                       poisson: bool, n_classes: int = 0,
+                       use_pallas: bool = False, max_leaves: int = 0,
+                       has_cat: bool = True, mesh=None,
+                       stats_exact: bool = False):
+    """Streamed-RF resident round: the per-tree hash bag replays ON
+    DEVICE (``ops/hashing.py`` splitmix64, bit-identical to the host
+    ``window_bag`` stream), then the shared RF round body runs and packs
+    in the streamed layout."""
+    from ..ops.hashing import hash_poisson_traced
+    bag = hash_poisson_traced(idx_hi, idx_lo, khi, klo, thi, tlo) \
+        if poisson else jnp.ones(w.shape[0], jnp.float32)
+    sf, lm, lv, gfi, os2, oc2, tr, va = _rf_round_from_bag(
+        bins, y, w, bag, oob_sum, oob_cnt, fa, cat, mi, mg, n_bins,
+        depth, impurity, loss, n_classes, use_pallas, max_leaves,
+        has_cat, mesh, stats_exact)
+    one = jnp.ones((), jnp.float32)
+    packed = jnp.concatenate([
+        sf.astype(jnp.float32), _pack_mask_bits(lm), lv.reshape(-1), gfi,
+        jnp.stack([tr, one, va, one])])
+    return packed, os2, oc2
 
 
 def _window_f(f: np.ndarray, win, mesh=None):
@@ -1705,24 +1708,45 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
         drain_rf, history, progress, len(trees) - len(history))
 
     ti = len(trees) + len(pending_rf)
+    mega = None                 # fully-resident: ONE coalesced row block
     while ti < settings.n_trees:
         bag_cache.clear()
-        if cache.warmed and cache.tail is None:
-            # fully resident: whole tree is ONE executable (see
-            # _rf_tree_fused); packed trees drain in batched fetches
-            fa = jnp.asarray(_feat_subset(settings, c, ti))
+        if mega is None and cache.warmed and cache.tail is None:
+            # fully resident: coalesce windows once and run the resident
+            # round per tree (see the GBT mega path).  Bags replay the
+            # SAME host hash stream on device, BIT-identical
+            # (ops/hashing.py) — resume replays over windows therefore
+            # see exactly the bags these trees trained with; the
+            # histogram arithmetic itself follows the resident kernel's
+            # subtraction order (f32-equivalent, not byte-equal, to the
+            # window sweep)
+            from ..ops.hashing import split_index_u32, thresholds_u32
             items = list(cache.items())
-            wins = tuple(
-                (it.arrays["bins"], it.arrays["y"], it.arrays["w"],
-                 window_bag(ti, it)) + window_oob(it)
-                for it in items)
-            packed_d, new_oob = _rf_tree_fused(
-                wins, fa, cat, settings.min_instances, settings.min_gain,
-                n_bins, settings.depth, settings.impurity, settings.loss,
-                up, settings.max_leaves, hc, _hist_mesh(mesh),
-                settings.n_classes, settings.stats_exact)
-            for it, pair in zip(items, new_oob):
-                it.arrays["oob"] = pair
+            mega = {k: _concat_rows([it.arrays[k] for it in items])
+                    for k in ("bins", "y", "w")}
+            oobs = [window_oob(it) for it in items]
+            mega["oob_sum"] = _concat_rows([o[0] for o in oobs])
+            mega["oob_cnt"] = _concat_rows([o[1] for o in oobs])
+            ih, il = split_index_u32(np.concatenate(
+                [np.asarray(it.index, np.uint64) for it in items]))
+            mega["idx_hi"] = _shard_rows(ih, mesh)
+            mega["idx_lo"] = _shard_rows(il, mesh)
+            thi, tlo = thresholds_u32(settings.bagging_rate)
+            mega["thi"] = jnp.asarray(thi)
+            mega["tlo"] = jnp.asarray(tlo)
+        if mega is not None:
+            from ..ops.hashing import row_key_u32
+            khi, klo = row_key_u32(settings.seed, 5000 + ti)
+            packed_d, mega["oob_sum"], mega["oob_cnt"] = _rf_round_streamed(
+                mega["bins"], mega["y"], mega["w"], mega["idx_hi"],
+                mega["idx_lo"], jnp.uint32(khi), jnp.uint32(klo),
+                mega["thi"], mega["tlo"], mega["oob_sum"],
+                mega["oob_cnt"], jnp.asarray(_feat_subset(settings, c, ti)),
+                cat, settings.min_instances, settings.min_gain, n_bins,
+                settings.depth, settings.impurity, settings.loss,
+                settings.poisson_bagging, settings.n_classes, up,
+                settings.max_leaves, hc, _hist_mesh(mesh),
+                settings.stats_exact)
             pending_rf.append(packed_d)
             if progress and len(pending_rf) >= 8:
                 flush_progress_rf()

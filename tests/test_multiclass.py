@@ -41,7 +41,7 @@ def test_multiclass_tree_kernel_pure_split():
     bins[:, 0] = y * 2
     stats = np.ones(n, np.float32)[:, None] * \
         np.asarray(jax.nn.one_hot(y, 3), np.float32)
-    sf, lm, lv, _ = grow_tree_jit(
+    sf, lm, lv, _, _ = grow_tree_jit(
         jnp.asarray(bins), jnp.asarray(stats), jnp.zeros(3, bool),
         jnp.ones(3, bool), 8, 2, "entropy", 1.0, 0.0, 3)
     assert lv.shape == (7, 3)           # leaf class distributions

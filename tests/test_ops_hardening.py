@@ -159,3 +159,24 @@ def test_train_resume_continues_from_checkpoint():
         res2 = train_ensemble(x, y, tw, tw, spec, s2)
         assert len(res2.history) == 10          # only the new epochs ran
         assert res2.train_errors[0] <= res1.train_errors[0] + 1e-6
+
+
+def test_device_hash_bags_match_host():
+    """Device splitmix64 Poisson bags are BIT-identical to the host hash
+    draw the streamed trainers key every stateless decision off."""
+    import jax.numpy as jnp
+
+    from shifu_tpu.data.streaming import _hash_poisson, row_uniform
+    from shifu_tpu.ops.hashing import hash_poisson_device, split_index_u32
+
+    rng = np.random.default_rng(9)
+    idx = np.concatenate([
+        rng.integers(0, 1 << 31, 4000),
+        rng.integers(0, 1 << 62, 1000)]).astype(np.uint64)
+    for seed, stream, lam in ((0, 5000, 1.0), (7, 5003, 0.5),
+                              (123, 6001, 2.5)):
+        host = _hash_poisson(lam, row_uniform(seed, stream, idx))
+        hi, lo = split_index_u32(idx)
+        dev = np.asarray(hash_poisson_device(
+            jnp.asarray(hi), jnp.asarray(lo), seed, stream, lam))
+        np.testing.assert_array_equal(host, dev)

@@ -193,7 +193,7 @@ def test_leafwise_node_budget():
     fa = jnp.ones(c, bool)
 
     def node_count(max_leaves):
-        sf, _, _, _ = grow_tree_jit(
+        sf, _, _, _, _ = grow_tree_jit(
             jnp.asarray(bins), stats, cat, fa, b, depth, "variance",
             1.0, 0.0, 0, False, max_leaves)
         return int((np.asarray(sf) >= 0).sum()) * 2 + 1
@@ -203,7 +203,7 @@ def test_leafwise_node_budget():
     capped = node_count(7)                     # budget of 7 nodes
     assert capped <= 7
     # the root split (strongest gain) must survive the cap
-    sf, _, _, _ = grow_tree_jit(
+    sf, _, _, _, _ = grow_tree_jit(
         jnp.asarray(bins), stats, cat, fa, b, depth, "variance",
         1.0, 0.0, 0, False, 3)
     sf = np.asarray(sf)
