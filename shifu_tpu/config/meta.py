@@ -94,6 +94,8 @@ TRAIN_PARAM_RULES: Dict[str, Rule] = {
                                    "poly", "sigmoid"), algs=("SVM",)),
     "Gamma": Rule("float", lo=0.0, lo_open=True, algs=("SVM",)),
     "Const": Rule("float", lo=0.0, lo_open=True, algs=("SVM",)),
+    "Coef0": Rule("float", algs=("SVM",)),
+    "Degree": Rule("int", lo=1, hi=10, algs=("SVM",)),
     "Seed": Rule("int"),
     "CheckpointInterval": Rule("int", lo=0),
     # tree family

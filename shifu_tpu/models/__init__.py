@@ -34,4 +34,7 @@ def load_any(path: str):
     if kind == "wdl":
         from .wdl import IndependentWDLModel
         return IndependentWDLModel.load(path)
+    if kind == "svm":
+        from .svm import IndependentSVMModel
+        return IndependentSVMModel.load(path)
     raise ValueError(f"unknown model kind {kind!r} in {path}")

@@ -1,0 +1,112 @@
+"""Kernel SVM model spec + standalone scorer.
+
+The reference's SVM is Encog/libsvm C-SVC with linear/poly/sigmoid/RBF
+kernels, trained LOCAL-only (``core/alg/SVMTrainer.java:80-145``,
+``SVMType.SupportVectorClassification``).  The TPU-shaped model keeps the
+support vectors and dual coefficients; scoring is one kernel-matrix matmul
+against the SVs — libsvm's per-row SV loop becomes an MXU batch.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class SVMModelSpec:
+    input_dim: int
+    kernel: str = "rbf"                 # linear | poly | sigmoid | rbf
+    gamma: float = 0.1
+    coef0: float = 0.0
+    degree: int = 3
+    column_nums: Optional[List[int]] = None
+    feature_names: Optional[List[str]] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": 1, "kind": "svm", "input_dim": self.input_dim,
+            "kernel": self.kernel, "gamma": self.gamma,
+            "coef0": self.coef0, "degree": self.degree,
+            "column_nums": self.column_nums,
+            "feature_names": self.feature_names, "extra": self.extra})
+
+    @classmethod
+    def from_json(cls, s: str) -> "SVMModelSpec":
+        d = json.loads(s)
+        return cls(input_dim=d["input_dim"], kernel=d.get("kernel", "rbf"),
+                   gamma=d.get("gamma", 0.1), coef0=d.get("coef0", 0.0),
+                   degree=d.get("degree", 3),
+                   column_nums=d.get("column_nums"),
+                   feature_names=d.get("feature_names"),
+                   extra=d.get("extra", {}))
+
+
+def kernel_matrix(spec: SVMModelSpec, a, b):
+    """[n, m] kernel values, libsvm conventions (``svm.h`` kernel_type):
+    rbf ``exp(-gamma |a-b|^2)``, poly ``(gamma a.b + coef0)^degree``,
+    sigmoid ``tanh(gamma a.b + coef0)``, linear ``a.b``.  One dot_general
+    feeds every kernel — the MXU does libsvm's inner loop."""
+    dot = a @ b.T
+    if spec.kernel == "linear":
+        return dot
+    if spec.kernel == "poly":
+        return (spec.gamma * dot + spec.coef0) ** spec.degree
+    if spec.kernel == "sigmoid":
+        return jnp.tanh(spec.gamma * dot + spec.coef0)
+    sq = ((a * a).sum(1)[:, None] + (b * b).sum(1)[None, :] - 2.0 * dot)
+    return jnp.exp(-spec.gamma * jnp.maximum(sq, 0.0))
+
+
+def save_model(path: str, spec: SVMModelSpec, sv_x: np.ndarray,
+               alpha_y: np.ndarray) -> None:
+    arrays = {"__spec__": np.frombuffer(spec.to_json().encode(), np.uint8),
+              "sv_x": np.asarray(sv_x, np.float32),
+              "alpha_y": np.asarray(alpha_y, np.float32)}
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+
+def load_model(path: str):
+    data = np.load(path)
+    spec = SVMModelSpec.from_json(bytes(data["__spec__"]).decode())
+    return spec, data["sv_x"], data["alpha_y"]
+
+
+class IndependentSVMModel:
+    """Standalone kernel-SVM scorer over saved support vectors.  The
+    decision value maps through a sigmoid so scores live in [0, 1] like
+    every other scorer (AUC/gain ordering is sigmoid-invariant)."""
+
+    input_kind = "norm"
+
+    def __init__(self, spec: SVMModelSpec, sv_x, alpha_y):
+        self.spec = spec
+        self.sv_x = jnp.asarray(sv_x, jnp.float32)
+        self.alpha_y = jnp.asarray(alpha_y, jnp.float32)
+        self._fwd = jax.jit(self._decision)
+
+    def _decision(self, x):
+        # the +1 term is the regularized bias fold (augmented kernel —
+        # see train/svm_trainer.py)
+        k = kernel_matrix(self.spec, x, self.sv_x) + 1.0
+        return jax.nn.sigmoid(k @ self.alpha_y)[:, None]
+
+    @classmethod
+    def load(cls, path: str) -> "IndependentSVMModel":
+        return cls(*load_model(path))
+
+    def compute(self, x) -> np.ndarray:
+        return np.asarray(self._fwd(jnp.asarray(x, jnp.float32)))

@@ -147,6 +147,11 @@ class ExportProcessor(BasicProcessor):
                     raise PmmlUnsupportedError(
                         "WDL (embedding) models have no PMML mapping yet — "
                         "use the native .wdl spec")
+                elif kind == "svm":
+                    raise PmmlUnsupportedError(
+                        "kernel SVM models have no PMML mapping (the "
+                        "reference's PMML layer covers NN/LR/trees only) — "
+                        "use the native .svm spec")
                 else:
                     from ..models import nn as nn_model
                     spec, params = nn_model.load_model(mp)

@@ -91,19 +91,21 @@ def lr_spec(input_dim: int, params: Dict[str, Any], column_nums: List[int],
 def svm_spec(input_dim: int, params: Dict[str, Any], column_nums: List[int],
              feature_names: List[str]) -> nn_model.NNModelSpec:
     """Linear SVM: hinge loss on a linear head (reference
-    ``core/alg/SVMTrainer.java`` Kernel/Gamma/Const params).  Only the
-    linear kernel is implemented — the reference's libsvm RBF/poly/sigmoid
-    kernels have no TPU-shaped analogue here; asking for one is a coded
-    error (an NN with hidden layers is the nonlinear option), NOT a silent
-    fallback.  ``Const`` (the C penalty) maps to L2 ``1/(2C)`` on the
-    weights — the textbook soft-margin objective scaled by C."""
+    ``core/alg/SVMTrainer.java`` Kernel/Gamma/Const params).  Nonlinear
+    kernels (rbf/poly/sigmoid) train through the kernel-matrix dual solver
+    (``train/svm_trainer.py``) and never reach this spec — except in
+    STREAMED mode, where the kernel matrix cannot be materialized
+    (coded error; the reference's libsvm SVM is local-only too).
+    ``Const`` (the C penalty) maps to L2 ``1/(2C)`` on the weights — the
+    textbook soft-margin objective scaled by C."""
     kernel = str(params.get("Kernel", "linear")).lower()
     if kernel != "linear":
         from ..config.errors import ErrorCode, ShifuError
         raise ShifuError(ErrorCode.ERROR_MODELCONFIG_NOT_VALIDATION,
-                         f"SVM Kernel={kernel!r} is not supported (linear "
-                         "only); for a nonlinear decision surface use "
-                         "algorithm NN with hidden layers")
+                         f"SVM Kernel={kernel!r} cannot run in streamed/"
+                         "out-of-core mode (the kernel matrix is "
+                         "local-scale by nature); drop "
+                         "-Dshifu.train.streaming or use NN/GBT")
     c_penalty = float(params.get("Const", 1.0))
     return nn_model.NNModelSpec(
         input_dim=input_dim, hidden_nodes=[], activations=[],
@@ -135,6 +137,11 @@ class TrainProcessor(BasicProcessor):
             # TENSORFLOW: the reference bridges to TF-on-YARN
             # (TrainModelProcessor.java:395-449); tpu-native IS the bridge —
             # the same net trains as the jitted NN path
+            if alg == Algorithm.TENSORFLOW:
+                log.info("algorithm TENSORFLOW: training the same network "
+                         "on the native jitted NN path (documented "
+                         "deviation — no TF interop; the reference's "
+                         "TF-on-YARN bridge role is served by XLA)")
             return self._train_nn_family(
                 Algorithm.NN if alg == Algorithm.TENSORFLOW else alg)
         if alg in (Algorithm.GBT, Algorithm.RF, Algorithm.DT):
@@ -211,6 +218,14 @@ class TrainProcessor(BasicProcessor):
         feature_names = schema.get("outputNames", [])
         n, d = x.shape
         log.info("train %s: %d rows x %d features", alg.name, n, d)
+
+        if alg == Algorithm.SVM and str((mc.train.params or {}).get(
+                "Kernel", "linear")).lower() != "linear":
+            # nonlinear kernels leave the shared NN machinery: the
+            # reference's libsvm C-SVC becomes an MXU kernel-matrix dual
+            # solve (train/svm_trainer.py)
+            return self._train_kernel_svm(x, y, w, column_nums,
+                                          feature_names)
 
         params = dict(mc.train.params or {})
         trials = self._trials(params)
@@ -327,6 +342,49 @@ class TrainProcessor(BasicProcessor):
         return 0
 
     # -------------------------------------------------------- streaming
+    def _train_kernel_svm(self, x, y, w, column_nums, feature_names) -> int:
+        """Nonlinear-kernel SVM bags (reference ``SVMTrainer.java``
+        Kernel/Gamma/Const; local-scale by design — see
+        ``train/svm_trainer.py`` for the dual formulation)."""
+        from ..models.svm import SVMModelSpec, save_model
+        from ..train.svm_trainer import train_kernel_svm
+        from ..train.sampling import member_masks
+
+        mc = self.model_config
+        params = dict(mc.train.params or {})
+        if grid_search.is_grid_search(params):
+            raise ValueError("grid search is not supported for kernel SVM "
+                             "(single local-scale solve per bag)")
+        n, d = x.shape
+        kernel = str(params.get("Kernel", "linear")).lower()
+        kernel = {"radialbasisfunction": "rbf"}.get(kernel, kernel)
+        spec = SVMModelSpec(
+            input_dim=d, kernel=kernel,
+            gamma=float(params.get("Gamma", 1.0 / max(d, 1))),
+            coef0=float(params.get("Coef0", 0.0)),
+            degree=int(params.get("Degree", 3)),
+            column_nums=column_nums, feature_names=feature_names,
+            extra={"algorithm": "SVM"})
+        c_penalty = float(params.get("Const", 1.0))
+        bags = max(1, mc.train.baggingNum)
+        os.makedirs(self.paths.models_dir, exist_ok=True)
+        with open(self.paths.progress_path, "w") as pf:
+            for b in range(bags):
+                tw, _ = member_masks(
+                    n, 1, valid_rate=mc.train.validSetRate,
+                    sample_rate=mc.train.baggingSampleRate,
+                    replacement=mc.train.baggingWithReplacement,
+                    targets=y, seed=b)
+                train_mask = (tw[0] > 0) & (w > 0)
+                sv_x, alpha_y, tr, va, n_sv = train_kernel_svm(
+                    x, y, train_mask, spec, c_penalty)
+                path = os.path.join(self.paths.models_dir, f"model{b}.svm")
+                save_model(path, spec, sv_x, alpha_y)
+                pf.write(f"Trainer #{b} Train Error: {tr:.6f} "
+                         f"Validation Error: {va:.6f} ({n_sv} SVs)\n")
+                log.info("svm bag %d: %d SVs -> %s", b, n_sv, path)
+        return 0
+
     def _use_streaming(self, shards: Shards, schema: dict) -> bool:
         """Out-of-core mode when the materialized data exceeds the memory
         budget (reference ``guagua.data.memoryFraction`` role) or when

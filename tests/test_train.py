@@ -115,12 +115,15 @@ def test_svm_hinge_learns_margin():
     assert res.train_errors[0] < 0.7          # mean hinge well under 1
 
 
-def test_svm_nonlinear_kernel_rejected():
+def test_svm_nonlinear_kernel_rejected_in_streamed_mode():
+    """Nonlinear kernels train via the kernel-matrix dual solver in-RAM
+    (tests/test_svm_kernel.py); the STREAMED path cannot materialize the
+    kernel matrix and must reject with a coded error."""
     import pytest
     from shifu_tpu.config.errors import ShifuError
     from shifu_tpu.pipeline.train import svm_spec
 
-    with pytest.raises(ShifuError, match="linear"):
+    with pytest.raises(ShifuError, match="streamed"):
         svm_spec(4, {"Kernel": "RBF"}, [0, 1, 2, 3], [])
 
 
