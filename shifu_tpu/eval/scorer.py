@@ -90,11 +90,13 @@ class Scorer:
     def _put(self, a, dtype=None):
         """Rows onto the device, data-axis sharded (and zero-padded to
         divide it) under a multi-device mesh — :meth:`score` trims the
-        padded scores after the fetch."""
+        padded scores after the fetch.  Single-device: jnp.asarray, so a
+        device-resident batch never round-trips the host."""
+        import jax.numpy as jnp
+        if self.mesh is None or int(self.mesh.shape.get("data", 1)) <= 1:
+            return jnp.asarray(a) if dtype is None else jnp.asarray(a, dtype)
         from ..parallel.mesh import shard_chunk_rows
-        if dtype is not None:
-            a = np.asarray(a, dtype)
-        return shard_chunk_rows(self.mesh, a)[0]
+        return shard_chunk_rows(self.mesh, np.asarray(a, dtype))[0]
 
     def _stacked_nn_groups(self):
         """Same-shape NN/LR models stacked for ONE vmapped forward — the

@@ -319,8 +319,14 @@ class NumericAccumulator:
 
     # ---- pass 1
     def update_moments(self, x: np.ndarray, valid: np.ndarray) -> None:
-        xd, vd, _ = self._put_rows(np.asarray(x, np.float32),
-                                   np.asarray(valid))
+        if self._data_size() <= 1:
+            # jnp.asarray: a device-resident chunk stays put (np.asarray
+            # would round-trip it through the host — catastrophic over a
+            # remote-device link)
+            xd, vd = jnp.asarray(x, jnp.float32), jnp.asarray(valid)
+        else:
+            xd, vd, _ = self._put_rows(np.asarray(x, np.float32),
+                                       np.asarray(valid))
         out = _moments_kernel(xd, vd)
         self._pend_moments.append(jnp.stack(out))      # [7, C], stays on device
         self.total_rows += x.shape[0]
@@ -357,9 +363,17 @@ class NumericAccumulator:
         from .hist_pallas import pallas_available
         up = (pallas_available(self.mesh) and self.num_buckets % 64 == 0
               and self.num_buckets <= 4096)
-        xd, vd, td, wd, live = self._put_rows(
-            np.asarray(x, np.float32), np.asarray(valid),
-            np.asarray(target, np.float32), np.asarray(weight, np.float32))
+        if self._data_size() <= 1:     # see update_moments on jnp.asarray
+            xd = jnp.asarray(x, jnp.float32)
+            vd = jnp.asarray(valid)
+            td = jnp.asarray(target, jnp.float32)
+            wd = jnp.asarray(weight, jnp.float32)
+            live = None
+        else:
+            xd, vd, td, wd, live = self._put_rows(
+                np.asarray(x, np.float32), np.asarray(valid),
+                np.asarray(target, np.float32),
+                np.asarray(weight, np.float32))
         h = _histogram_kernel(xd, vd, td, wd, self._lo_d, self._hi_d,
                               self.num_buckets, use_pallas=up,
                               unit_weight=self.unit_weight, expand=False,

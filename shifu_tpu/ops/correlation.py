@@ -56,10 +56,14 @@ class CorrelationAccumulator:
     sxx: Optional[np.ndarray] = None
 
     def update(self, x: np.ndarray, valid: np.ndarray) -> None:
-        from ..parallel.mesh import shard_chunk_rows
         off = np.zeros(self.n_cols) if self.offset is None else self.offset
-        xd, vd, _ = shard_chunk_rows(self.mesh, np.asarray(x, np.float32),
-                                     np.asarray(valid))
+        if self.mesh is None or int(self.mesh.shape["data"]) <= 1:
+            # jnp.asarray keeps device-resident chunks on device
+            xd, vd = jnp.asarray(x, jnp.float32), jnp.asarray(valid)
+        else:
+            from ..parallel.mesh import shard_chunk_rows
+            xd, vd, _ = shard_chunk_rows(
+                self.mesh, np.asarray(x, np.float32), np.asarray(valid))
         out = _pair_sums(xd, vd, jnp.asarray(off, jnp.float32))
         n, sx, sxy, sxx = (np.asarray(a, np.float64) for a in out)
         if self.n is None:

@@ -10,6 +10,11 @@ nproc = int(sys.argv[2])
 port = sys.argv[3]
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# own compilation cache: the suite's persistent cache (conftest) may hold
+# AOT entries whose recorded machine features mismatch this worker's
+# loader and fail with "Target machine feature ... not supported"
+os.environ["JAX_COMPILATION_CACHE_DIR"] = \
+    os.environ.get("SHIFU_MH_CACHE", "/tmp/shifu_tpu_mh_cache")
 # force EXACTLY 4 local devices, replacing any inherited count (pytest's
 # conftest exports 8)
 flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
@@ -20,8 +25,11 @@ os.environ["XLA_FLAGS"] = " ".join(flags)
 import jax  # noqa: E402
 import jax._src.xla_bridge as _xb  # noqa: E402
 
+# keep "tpu" registered like the suite conftest does: pallas/mosaic
+# registers tpu MLIR lowerings at import time and needs the platform
+# known, even under JAX_PLATFORMS=cpu
 for _name in [n for n in list(getattr(_xb, "_backend_factories", {}))
-              if n != "cpu"]:
+              if n not in ("cpu", "tpu")]:
     _xb._backend_factories.pop(_name, None)
 jax.config.update("jax_platforms", "cpu")
 
@@ -101,5 +109,24 @@ res_mb = train_ensemble(x_global, y_all, tw, vw,
                         mesh=mesh)
 assert np.isfinite(res_mb.train_errors[0])
 print(f"proc {pid}: MULTIHOST-MINIBATCH ok", flush=True)
+
+# ---- stats plane across hosts: chunk rows shard over the GLOBAL data
+# axis and the moment/histogram reductions psum across the DCN (the
+# reference's up-to-999 stats reducers, MapReducerStatsWorker.java)
+from shifu_tpu.config.model_config import BinningMethod  # noqa: E402
+from shifu_tpu.ops.binning import NumericAccumulator  # noqa: E402
+
+C = 3
+xs = rng.normal(size=(200, C)).astype(np.float32)   # same on both hosts
+valid = np.ones((200, C), bool)
+tgt = (rng.random(200) < 0.4).astype(np.float32)
+acc = NumericAccumulator(n_cols=C, num_buckets=64, mesh=mesh)
+acc.update_moments(xs, valid)
+acc.finalize_range()
+acc.update_histogram(xs, valid, tgt, np.ones(200, np.float32))
+bnds, aggs, _, _ = acc.finalize_sketch(BinningMethod.EqualTotal, 4)
+assert int(aggs[0][:, :2].sum()) == 200
+stats_sum = float(sum(np.sum(np.abs(b[np.isfinite(b)])) for b in bnds))
+print(f"proc {pid}: MULTIHOST-STATS bnds={stats_sum:.8f}", flush=True)
 
 print(f"proc {pid}: MULTIHOST-OK total={total}", flush=True)

@@ -48,3 +48,8 @@ def test_two_process_mesh_and_global_reduction():
     sums = [re.search(r"MULTIHOST-TRAIN weights=([0-9.]+)", out).group(1)
             for out in outs]
     assert sums[0] == sums[1], sums
+    # the stats plane also ran across the boundary with identical results
+    # on both controllers (data-axis psum over the DCN)
+    st = [re.search(r"MULTIHOST-STATS bnds=([0-9.]+)", out).group(1)
+          for out in outs]
+    assert st[0] == st[1], st
