@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -87,13 +87,26 @@ def _gather_np(a) -> np.ndarray:
     return np.asarray(a)
 
 
-@jax.jit
-def _pack_leaves(leaves):
+def _pack_leaves_impl(leaves):
     """Flatten a tuple of 4-byte-dtype arrays into ONE f32 vector (bitcast,
     not convert — int leaves round-trip exactly)."""
     return jnp.concatenate([
         jax.lax.bitcast_convert_type(l, jnp.float32).reshape(-1)
         for l in leaves])
+
+
+_pack_leaves = jax.jit(_pack_leaves_impl)
+
+
+@lru_cache(maxsize=None)
+def _pack_leaves_replicated(mesh):
+    """Multi-controller :func:`_pack_leaves`: the REPLICATED out-sharding
+    makes XLA fuse every leaf's cross-host allgather into the one packing
+    program, after which each process reads its own addressable copy."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    return jax.jit(_pack_leaves_impl,
+                   out_shardings=NamedSharding(mesh, P()))
 
 
 def _to_host(tree):
@@ -102,13 +115,26 @@ def _to_host(tree):
     link at ~0.1-0.25 s per transfer, a WDL param tree (per-column
     embedding tables, ~70 leaves) made every epoch's best-params copy
     slower than the epoch's compute.  Leaves pack (bitcast) into one f32
-    vector on device and split back on the host; multi-host runs keep the
-    per-leaf allgather path (correctness over speed there)."""
+    vector on device and split back on the host; multi-controller runs
+    pack through :func:`_pack_leaves_replicated` (one program whose
+    output every process holds) instead of the old per-leaf allgather
+    walk."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    if not leaves or jax.process_count() > 1 or \
-            any(l.dtype.itemsize != 4 for l in leaves):
+    if not leaves or any(l.dtype.itemsize != 4 for l in leaves):
         return jax.tree_util.tree_map(_gather_np, tree)
-    flat = np.asarray(_pack_leaves(tuple(leaves)))
+    if jax.process_count() > 1:
+        meshes = {l.sharding.mesh for l in leaves
+                  if hasattr(getattr(l, "sharding", None), "mesh")}
+        if len(meshes) != 1 or any(
+                not hasattr(getattr(l, "sharding", None), "mesh")
+                for l in leaves):
+            # heterogeneous/mesh-less leaves cannot ride one pinned
+            # program — keep the conservative per-leaf gather for them
+            return jax.tree_util.tree_map(_gather_np, tree)
+        flat = np.asarray(
+            _pack_leaves_replicated(meshes.pop())(tuple(leaves)))
+    else:
+        flat = np.asarray(_pack_leaves(tuple(leaves)))
     out, off = [], 0
     for l in leaves:
         size = int(np.prod(l.shape)) if l.shape else 1
