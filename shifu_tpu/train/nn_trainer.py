@@ -123,16 +123,14 @@ def _to_host(tree):
     if not leaves or any(l.dtype.itemsize != 4 for l in leaves):
         return jax.tree_util.tree_map(_gather_np, tree)
     if jax.process_count() > 1:
-        meshes = {l.sharding.mesh for l in leaves
-                  if hasattr(getattr(l, "sharding", None), "mesh")}
-        if len(meshes) != 1 or any(
-                not hasattr(getattr(l, "sharding", None), "mesh")
-                for l in leaves):
+        shardings = [getattr(l, "sharding", None) for l in leaves]
+        if any(not hasattr(sh, "mesh") for sh in shardings) or \
+                len({sh.mesh for sh in shardings}) != 1:
             # heterogeneous/mesh-less leaves cannot ride one pinned
             # program — keep the conservative per-leaf gather for them
             return jax.tree_util.tree_map(_gather_np, tree)
         flat = np.asarray(
-            _pack_leaves_replicated(meshes.pop())(tuple(leaves)))
+            _pack_leaves_replicated(shardings[0].mesh)(tuple(leaves)))
     else:
         flat = np.asarray(_pack_leaves(tuple(leaves)))
     out, off = [], 0
