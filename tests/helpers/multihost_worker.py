@@ -110,6 +110,36 @@ res_mb = train_ensemble(x_global, y_all, tw, vw,
 assert np.isfinite(res_mb.train_errors[0])
 print(f"proc {pid}: MULTIHOST-MINIBATCH ok", flush=True)
 
+# ---- a STREAMED trainer across hosts: windows shard over the GLOBAL
+# data axis (ResidentCache + mega coalescing under 2 controllers); both
+# processes must absorb identical forests from the replicated fetches
+import json  # noqa: E402
+import tempfile  # noqa: E402
+
+from shifu_tpu.data.shards import Shards  # noqa: E402
+from shifu_tpu.data.streaming import ShardStream  # noqa: E402
+from shifu_tpu.train.dt_trainer import (DTSettings,  # noqa: E402
+                                        train_gbt_streamed)
+
+_td_ctx = tempfile.TemporaryDirectory(prefix=f"mh_stream_{pid}_")
+td = _td_ctx.name                               # auto-removed at exit
+rng_t = np.random.default_rng(17)               # same data on both hosts
+tbins = rng_t.integers(0, 8, size=(128, 6)).astype(np.int16)
+ty = (rng_t.random(128) < 0.4).astype(np.float32)
+np.savez(os.path.join(td, "part-00000.npz"), bins=tbins, y=ty,
+         w=np.ones(128, np.float32))
+with open(os.path.join(td, "schema.json"), "w") as f:
+    json.dump({"columnNums": list(range(6)), "numShards": 1,
+               "numRows": 128}, f)
+stream_t = ShardStream(Shards.open(td), ("bins", "y", "w"),
+                       window_rows=64)
+sres = train_gbt_streamed(stream_t, 8, None,
+                          DTSettings(n_trees=2, depth=2, loss="log",
+                                     learning_rate=0.1), mesh=mesh)
+tree_sum = float(sum(np.abs(t.leaf_value).sum() + (t.split_feat >= 0).sum()
+                     for t in sres.trees))
+print(f"proc {pid}: MULTIHOST-STREAMED trees={tree_sum:.8f}", flush=True)
+
 # ---- stats plane across hosts: chunk rows shard over the GLOBAL data
 # axis and the moment/histogram reductions psum across the DCN (the
 # reference's up-to-999 stats reducers, MapReducerStatsWorker.java)

@@ -53,3 +53,8 @@ def test_two_process_mesh_and_global_reduction():
     st = [re.search(r"MULTIHOST-STATS bnds=([0-9.]+)", out).group(1)
           for out in outs]
     assert st[0] == st[1], st
+    # and the STREAMED trainer (ResidentCache + coalesced mega path)
+    # built the same forest on both controllers
+    tr = [re.search(r"MULTIHOST-STREAMED trees=([0-9.]+)", out).group(1)
+          for out in outs]
+    assert tr[0] == tr[1], tr
