@@ -50,31 +50,22 @@ def rng():
 @pytest.fixture(scope="session")
 def fraud_csv(tmp_path_factory):
     """Synthetic fraud-style dataset: mixed numeric/categorical, missing
-    values, a weight column, '|' delimited like the reference's tutorial data."""
-    rng = np.random.default_rng(7)
-    n = 4000
-    amount = rng.lognormal(3.0, 1.2, n)
-    velocity = rng.poisson(3, n).astype(float)
-    age_days = rng.integers(0, 2000, n).astype(float)
-    country = rng.choice(["US", "GB", "DE", "CN", "BR"], n, p=[.5, .15, .15, .1, .1])
-    channel = rng.choice(["web", "app", "pos"], n)
-    noise = rng.normal(0, 1, n)
-    logit = (0.8 * np.log1p(amount) - 0.004 * age_days + 0.35 * velocity
-             + (country == "BR") * 1.2 + (channel == "web") * 0.4 - 4.0)
-    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(int)
-    tag = np.where(y == 1, "bad", "good")
-    weight = np.round(rng.uniform(0.5, 2.0, n), 3)
-    miss = rng.random(n) < 0.05
-    amount_s = np.round(amount, 4).astype(str)
-    amount_s[miss] = ""
-    rows = ["txn_id|amount|velocity|age_days|country|channel|noise|weight|tag"]
-    for i in range(n):
-        rows.append(f"t{i}|{amount_s[i]}|{velocity[i]:.0f}|{age_days[i]:.0f}|"
-                    f"{country[i]}|{channel[i]}|{noise[i]:.5f}|{weight[i]}|{tag[i]}")
+    values, a weight column, '|' delimited like the reference's tutorial
+    data.  ONE generator serves the suite and the tutorial
+    (``examples/make_fraud_data.py``) so they can never drift — the
+    golden-parity pins ride on this exact byte stream."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "make_fraud_data",
+        os.path.join(os.path.dirname(__file__), "..", "examples",
+                     "make_fraud_data.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
     d = tmp_path_factory.mktemp("fraud")
-    path = d / "part-000.csv"
-    path.write_text("\n".join(rows) + "\n")
-    return str(path)
+    src = mod.make(str(d), n=4000)
+    path = os.path.join(str(d), "part-000.csv")
+    os.rename(src, path)
+    return path
 
 
 def _scaffold_model_set(base_dir: str, fraud_csv: str) -> str:
