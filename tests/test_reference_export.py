@@ -153,9 +153,27 @@ def test_wdl_reference_roundtrip(tmp_path):
     params["wide_num"] = np.asarray(
         rng.normal(size=params["wide_num"].shape), np.float32)
     params["bias"] = np.asarray([0.3], np.float32)
+    ccs = [_cc(n, f"num{n}", bounds=[float("-inf"), 0.0], mean=0.5)
+           for n in (2, 3, 4, 5)] + \
+          [_cc(6, "catA", cats=["x", "y", "z", "w", "v"]),
+           _cc(7, "catB", cats=["p", "q", "r"])]
+    for cc in ccs:
+        cc.columnStats.stdDev = 1.25
+        cc.columnBinning.binCountNeg = [10, 5]
+        cc.columnBinning.binCountPos = [2, 3]
+        cc.columnBinning.binCountWoe = [-0.5, 0.7]
+        cc.columnBinning.binPosRate = [0.17, 0.38]
     path = str(tmp_path / "model0.wdl")
-    write_reference_wdl(path, spec, params)
+    write_reference_wdl(path, spec, params, ccs)
     spec2, params2, col_stats = load_reference_wdl(path)
+    # NNColumnStats round-trip: names/types/means and bin tables survive
+    assert set(col_stats) == {2, 3, 4, 5, 6, 7}
+    assert col_stats[6]["type"] == 2 and col_stats[2]["type"] == 1
+    assert col_stats[6]["categories"] == ["x", "y", "z", "w", "v"]
+    assert col_stats[2]["mean"] == 0.5 and col_stats[2]["stddev"] == 1.25
+    np.testing.assert_allclose(col_stats[3]["count_woes"], [-0.5, 0.7])
+    np.testing.assert_allclose(col_stats[7]["pos_rates"], [0.17, 0.38])
+    assert col_stats[7]["woe_mean"] != 0.0     # computed, not zero-filled
     assert spec2.numeric_dim == 4
     assert spec2.cat_cardinalities == [5, 3]
     assert spec2.hidden_nodes == [8]
@@ -188,3 +206,81 @@ def test_nn_export_cli_spec(prepared_set):
     y2 = np.asarray(nn_model.forward(params2, spec2,
                                      np.asarray(x, np.float32)))[:, 0]
     np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)
+
+
+def _cc(num, name, cats=None, bounds=None, mean=0.0):
+    from shifu_tpu.config.column_config import ColumnConfig, ColumnType
+    cc = ColumnConfig(columnNum=num, columnName=name,
+                      columnType=ColumnType.C if cats else ColumnType.N)
+    cc.columnBinning.binCategory = cats
+    cc.columnBinning.binBoundary = bounds
+    cc.columnStats.mean = mean
+    return cc
+
+
+def test_tree_writer_categorical_missing_routing(tmp_path):
+    """The format routes missing to the NON-bitset side: a tree sending
+    the missing bin LEFT must emit isLeft=False with the complement
+    bitset; RIGHT-routed missing emits isLeft=True with the left cats.
+    Both must score missing and in-set rows exactly like the native
+    bin-walk (the reference missing bucket == our missing bin)."""
+    from shifu_tpu.export.reference_spec import write_reference_tree
+    from shifu_tpu.models.reference_import import load_reference_tree
+    from shifu_tpu.models.tree import IndependentTreeModel, TreeModelSpec
+    from shifu_tpu.ops.tree import TreeArrays
+
+    cats = ["a", "b", "c"]               # bins 0..2, missing bin = 3
+    n_bins = 4
+    for missing_left in (True, False):
+        # root splits on the categorical: {a, c} (+ missing?) go left
+        lm = np.zeros((3, n_bins), bool)
+        lm[0, [0, 2]] = True
+        lm[0, 3] = missing_left
+        tree = TreeArrays(
+            split_feat=np.array([0, -1, -1], np.int32),
+            left_mask=lm,
+            leaf_value=np.array([0.0, 0.25, 0.75], np.float32), depth=1)
+        spec = TreeModelSpec(algorithm="RF", n_trees=1, depth=1,
+                             n_bins=n_bins, column_nums=[5])
+        path = str(tmp_path / f"m_{missing_left}.rf")
+        write_reference_tree(path, spec, [tree],
+                             [_cc(5, "cat", cats=cats)])
+        ref = load_reference_tree(path)
+        native = IndependentTreeModel(spec, [tree])
+        # rows: each category + a missing value
+        bins = np.array([[0], [1], [2], [3]], np.int32)
+        ours = native.compute(bins)[:, 0]
+        theirs = ref.compute({5: np.array([0.0, 1.0, 2.0, np.nan])})
+        np.testing.assert_allclose(ours, theirs, rtol=1e-6)
+
+
+def test_tree_writer_numeric_threshold_edges(tmp_path):
+    """Numeric split edge cases: left-mask covering NO value bins
+    (threshold = first boundary) and ALL value bins (threshold = +inf,
+    only missing goes right) must round-trip to the same routing."""
+    from shifu_tpu.export.reference_spec import write_reference_tree
+    from shifu_tpu.models.reference_import import load_reference_tree
+    from shifu_tpu.models.tree import IndependentTreeModel, TreeModelSpec
+    from shifu_tpu.ops.tree import TreeArrays
+
+    bounds = [float("-inf"), 1.0, 2.0]   # bins 0,1,2; missing bin = 3
+    n_bins = 4
+    for k_bins in (0, 3):
+        lm = np.zeros((3, n_bins), bool)
+        lm[0, :k_bins] = True            # 0 => empty left; 3 => all values
+        tree = TreeArrays(
+            split_feat=np.array([0, -1, -1], np.int32),
+            left_mask=lm,
+            leaf_value=np.array([0.0, 0.2, 0.8], np.float32), depth=1)
+        spec = TreeModelSpec(algorithm="RF", n_trees=1, depth=1,
+                             n_bins=n_bins, column_nums=[3])
+        path = str(tmp_path / f"m_{k_bins}.rf")
+        write_reference_tree(path, spec, [tree],
+                             [_cc(3, "num", bounds=bounds, mean=1.5)])
+        ref = load_reference_tree(path)
+        native = IndependentTreeModel(spec, [tree])
+        raw = np.array([0.5, 1.5, 2.5])  # one value per bin
+        bins = np.array([[0], [1], [2]], np.int32)
+        ours = native.compute(bins)[:, 0]
+        theirs = ref.compute({3: raw})
+        np.testing.assert_allclose(ours, theirs, rtol=1e-6)
