@@ -3,21 +3,24 @@
 Headline: end-to-end `train` throughput (rows/sec) of the flagship NN trainer
 on a synthetic fraud-style dataset, vs the YARN-cluster-derived baseline.
 Runs on whatever jax.devices() offers (one real TPU chip under the driver).
+
+With SHIFU_TPU_TELEMETRY=1 the per-plane numbers also land as a telemetry
+JSONL block under ./telemetry/ (same schema as the pipeline steps — the
+schema-version handshake is enforced inside run_benchmark, which fails
+loudly on a bench/obs schema mismatch).
 """
 
 import json
-import time
-
-import numpy as np
 
 
 def main() -> None:
-    import jax
-    import jax.numpy as jnp
-
+    from shifu_tpu import obs
     from shifu_tpu.bench import run_benchmark
 
     result = run_benchmark()
+    if obs.enabled():
+        obs.flush("telemetry/trace.jsonl", step="BENCH",
+                  extra_meta={"headline": result["metric"]})
     print(json.dumps(result))
 
 

@@ -12,4 +12,54 @@ stack collapses into
   contract as the reference (reference: ``container/obj/ModelConfig.java:57-95``).
 """
 
+import logging as _logging
+import os as _os
+
 __version__ = "0.1.0"
+
+_LOG_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+# library convention: the package logger never prints unless the APP (CLI,
+# pytest, an embedding service) configures handlers — programmatic use of
+# the processors/trainers stays silent instead of spraying lastResort
+# stderr lines or double-configuring the root logger
+_logging.getLogger(__name__).addHandler(_logging.NullHandler())
+
+
+def _env_level():
+    """``SHIFU_TPU_LOG=<level>`` (DEBUG/INFO/WARNING/... or a number)."""
+    name = _os.environ.get("SHIFU_TPU_LOG", "").strip()
+    if not name:
+        return None
+    if name.isdigit():
+        return int(name)
+    return getattr(_logging, name.upper(), None)
+
+
+# library entry point honoring SHIFU_TPU_LOG: importing shifu_tpu under
+# pytest/bench/notebooks with the env var set attaches ONE stream handler
+# to the package logger (root logging untouched, so an app's own config
+# never double-prints)
+_env_handler = None
+if _env_level() is not None:
+    _env_handler = _logging.StreamHandler()
+    _env_handler.setFormatter(_logging.Formatter(_LOG_FORMAT))
+    _pkg = _logging.getLogger(__name__)
+    _pkg.addHandler(_env_handler)
+    _pkg.setLevel(_env_level())
+
+
+def configure_logging(verbose: bool = False) -> None:
+    """CLI entry point: configure root logging once.  Level precedence:
+    ``SHIFU_TPU_LOG`` env override > ``-v`` > INFO.  Removes the
+    library-entry env handler first so CLI runs never double-print."""
+    global _env_handler
+    level = _env_level()
+    if level is None:
+        level = _logging.DEBUG if verbose else _logging.INFO
+    pkg = _logging.getLogger(__name__)
+    if _env_handler is not None:
+        pkg.removeHandler(_env_handler)
+        _env_handler = None
+    _logging.basicConfig(level=level, format=_LOG_FORMAT)
+    pkg.setLevel(level)

@@ -20,6 +20,15 @@ from typing import Any, Dict
 
 import numpy as np
 
+from . import obs
+
+# the JSONL/metric schema THIS bench emits its per-plane numbers in.
+# Hand-maintained on purpose: if obs/ bumps SCHEMA_VERSION without the
+# bench being updated (re-validated against the new field layout),
+# run_benchmark refuses to run rather than silently emitting records the
+# round's BENCH_r0N.json consumers would mis-join with telemetry traces.
+BENCH_TELEMETRY_SCHEMA = 1
+
 # measured on this rig (tools/measure_baseline.py); provenance in
 # BASELINE.md — every headline divides by a MEASURED reference-class
 # single-worker rate x the north-star cluster size
@@ -356,15 +365,29 @@ def bench_stats(chunk_rows: int = 1 << 18, n_cols: int = 256,
 
 
 def run_benchmark() -> Dict[str, Any]:
+    if BENCH_TELEMETRY_SCHEMA != obs.SCHEMA_VERSION:
+        raise RuntimeError(
+            f"bench telemetry schema v{BENCH_TELEMETRY_SCHEMA} disagrees "
+            f"with shifu_tpu.obs SCHEMA_VERSION v{obs.SCHEMA_VERSION} — "
+            "update bench.py's per-plane metric emission for the new "
+            "schema and bump BENCH_TELEMETRY_SCHEMA")
+    if obs.enabled():
+        obs.ensure_compile_listener()
     nn_rows_per_sec = bench_nn()
+    obs.gauge("bench.nn_train_throughput").set(nn_rows_per_sec)
     extras: Dict[str, Any] = {}
 
     def record(key: str, fn, baseline: float) -> None:
-        """Every extra carries its own measured-denominator ratio."""
+        """Every extra carries its own measured-denominator ratio; the
+        same numbers flow through the obs registry so BENCH_r0N.json and
+        the telemetry JSONL share one schema."""
         try:
-            v = fn()
+            with obs.span(f"bench.{key}", kind="bench"):
+                v = fn()
             extras[key] = round(v, 1)
             extras[key + "_vs_baseline"] = round(v / baseline, 3)
+            obs.gauge(f"bench.{key}").set(v)
+            obs.gauge(f"bench.{key}_vs_baseline").set(v / baseline)
         except Exception as e:                  # pragma: no cover
             extras[key + "_error"] = str(e)[:200]
 
@@ -402,6 +425,7 @@ def run_benchmark() -> Dict[str, Any]:
         "metric": "nn_train_throughput",
         "value": round(nn_rows_per_sec, 1),
         "unit": "rows/sec",
+        "telemetry_schema_version": BENCH_TELEMETRY_SCHEMA,
         "vs_baseline": round(nn_rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
         "baseline_rows_per_sec": BASELINE_ROWS_PER_SEC,
         "baseline_provenance": "measured 28850.5 rows/s/worker f64 backprop "

@@ -9,7 +9,6 @@ Environment tier (reference ``ShifuCLI.java:430-453``).
 from __future__ import annotations
 
 import argparse
-import logging
 import sys
 from typing import List, Optional
 
@@ -113,8 +112,13 @@ def build_parser() -> argparse.ArgumentParser:
                     "(reference `export -c`)")
 
     sp = sub.add_parser("analysis", help="model spec analysis "
-                        "(-fi MODEL: tree feature importance)")
+                        "(-fi MODEL: tree feature importance; --telemetry: "
+                        "render the last run's span/metric trace)")
     sp.add_argument("-fi", dest="fi_model", metavar="MODELPATH")
+    sp.add_argument("-telemetry", "--telemetry", dest="telemetry_report",
+                    action="store_true",
+                    help="render <modelset>/telemetry/trace.jsonl as a "
+                    "per-step span tree with self-time and rows/sec")
 
     sp = sub.add_parser("test", help="pipeline smoke test on a data sample")
     sp.add_argument("-filter", dest="filter_target", nargs="?", const="",
@@ -150,6 +154,25 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("cp", help="clone this model set's configs into a "
                         "new scaffold dir")
     sp.add_argument("dest")
+
+    # telemetry/profiling knobs on EVERY step (`shifu-tpu train --profile`):
+    # --telemetry enables the span/metric trace for this run (same as
+    # SHIFU_TPU_TELEMETRY=1); --profile [dir] captures a jax.profiler
+    # device timeline per step (same as -Dshifu.profile=dir)
+    seen = set()                        # aliases share one parser object
+    for name, spx in sub.choices.items():
+        if id(spx) in seen:
+            continue
+        seen.add(id(spx))
+        spx.add_argument("--profile", dest="profile_dir", nargs="?",
+                         const="profile", default=None, metavar="DIR",
+                         help="capture a jax.profiler trace under DIR "
+                         "(default ./profile)")
+        if name != "analysis":          # analysis --telemetry = the report
+            spx.add_argument("--telemetry", dest="telemetry",
+                             action="store_true",
+                             help="record span/metric telemetry to "
+                             "<modelset>/telemetry/trace.jsonl")
     return p
 
 
@@ -169,9 +192,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 def _dispatch(argv: Optional[List[str]] = None) -> int:
     argv = _split_props(list(argv if argv is not None else sys.argv[1:]))
     args = build_parser().parse_args(argv)
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    from . import configure_logging
+    configure_logging(verbose=args.verbose)   # honors SHIFU_TPU_LOG
+
+    if getattr(args, "telemetry", False):
+        from . import obs
+        obs.set_enabled(True)
+    if getattr(args, "profile_dir", None):
+        environment.set_property("shifu.profile", args.profile_dir)
 
     # multi-host bootstrap: no-op unless the launcher set SHIFU_COORDINATOR
     # (one process per host; jax.devices() then spans the fleet)
@@ -214,6 +242,10 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
             args.type = args.type_pos
         return ExportProcessor(args.dir, params=vars(args)).run()
     if cmd == "analysis":
+        if getattr(args, "telemetry_report", False):
+            from .obs.report import render_telemetry
+            print(render_telemetry(args.dir))
+            return 0
         from .pipeline.analysis import analyze_model_fi
         return analyze_model_fi(args.fi_model)
     if cmd == "test":

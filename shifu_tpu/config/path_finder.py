@@ -143,6 +143,17 @@ class PathFinder:
     def pmml_path(self, index: int) -> str:
         return os.path.join(self.export_dir, f"{self.model_config.basic.name}{index}.pmml")
 
+    # ----------------------------------------------------------- telemetry
+    @property
+    def telemetry_dir(self) -> str:
+        """Span/metric JSONL traces (``obs/``) — the counters/ logs
+        surface the reference kept in YARN job history."""
+        return os.path.join(self.root, "telemetry")
+
+    @property
+    def telemetry_trace_path(self) -> str:
+        return os.path.join(self.telemetry_dir, "trace.jsonl")
+
     # ------------------------------------------------------------- backups
     @property
     def backup_dir(self) -> str:

@@ -1,0 +1,32 @@
+"""Pipeline-wide observability: span tracing, metrics, profiler hooks.
+
+The TPU-native replacement for the reference's Hadoop/YARN counters and
+Guagua master logs (``ShifuCLI`` step timing lines, MR job counters): one
+in-process telemetry layer every step processor, trainer, and plane
+reports through, with a JSONL sink under ``<modelset>/telemetry/`` and a
+CLI report surface (``shifu-tpu analysis --telemetry``).
+
+Four modules:
+
+- :mod:`tracer` — nested wall-clock spans (optionally
+  ``jax.block_until_ready``-fenced) + point events, thread-safe
+  collector, JSONL sink;
+- :mod:`registry` — named counters/gauges/histograms (rows, epochs,
+  loss, throughput, device-memory high-water, XLA compile accounting);
+- :mod:`profiler` — opt-in ``jax.profiler.trace()`` capture around any
+  step (``shifu-tpu <step> --profile [dir]``);
+- :mod:`report` — renders the last run's spans/metrics as a tree with
+  per-step self-time and rows/sec.
+
+Everything is ZERO-COST when disabled (the default): ``span()`` returns
+a shared no-op singleton, instruments are no-op singletons, no fencing,
+no files.  Enable with env ``SHIFU_TPU_TELEMETRY=1``, property
+``-Dshifu.telemetry=on``, or the per-step ``--telemetry`` flag.
+"""
+
+from .registry import (counter, gauge, histogram,             # noqa: F401
+                       sample_device_memory, ensure_compile_listener,
+                       snapshot, get_registry)
+from .tracer import (SCHEMA_VERSION, enabled, set_enabled,    # noqa: F401
+                     fencing_enabled, span, event, fence, flush,
+                     pending_records, reset_for_tests)

@@ -1,0 +1,39 @@
+"""Profiler hook — opt-in ``jax.profiler.trace()`` capture per step.
+
+``shifu-tpu <step> --profile [dir]`` (or ``-Dshifu.profile=<dir>``) wraps
+the step's process() in a device-timeline capture viewable in
+TensorBoard/Perfetto — the TPU-native upgrade of the reference's
+wall-clock log lines (``TrainModelProcessor.java:214``,
+``DTWorker.java:687`` nano timers).  The always-on wall-clock spans live
+in :mod:`shifu_tpu.obs.tracer`; this knob adds the compiled-op view when
+asked.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from contextlib import nullcontext
+
+log = logging.getLogger(__name__)
+
+
+def profile_dir() -> str:
+    """The configured capture root ('' = profiling off)."""
+    from ..config import environment
+    return environment.get_property("shifu.profile", "") or ""
+
+
+def profile_step(step_name: str):
+    """Context manager: a ``jax.profiler.trace`` capture under
+    ``<profile_dir>/<step_name>`` when profiling is configured, else a
+    free nullcontext."""
+    trace_dir = profile_dir()
+    if not trace_dir:
+        return nullcontext()
+    import jax
+    out = os.path.join(os.path.abspath(trace_dir), step_name.lower())
+    log.info("device trace -> %s (tensorboard --logdir or Perfetto)", out)
+    from . import tracer
+    tracer.event("profile_capture", step=step_name, dir=out)
+    return jax.profiler.trace(out)

@@ -1,0 +1,240 @@
+"""Metrics registry — named counters/gauges/histograms, host-side only.
+
+The role the reference's Hadoop counters played (rows processed, records
+filtered, per-job timings aggregated by the JobTracker): one process-wide
+registry every plane reports into, snapshotted into the telemetry JSONL
+at each step flush.
+
+Conventions:
+
+- metrics are recorded HOST-SIDE only: instruments coerce through
+  ``float()``, so passing a jax tracer (recording from inside ``jit`` /
+  ``pjit``) raises — fetch the value first (``float(loss)``), which is
+  what every call site does anyway after its value-forcing sync;
+- instruments are created on first use and aggregate for the life of the
+  step (the step flush resets them);
+- when telemetry is disabled every factory returns a shared no-op
+  instrument — zero allocation, zero lock traffic.
+
+Device accounting helpers:
+
+- :func:`sample_device_memory` — HBM in-use/peak via
+  ``jax.local_devices()[0].memory_stats()`` (absent on some backends;
+  silently skipped);
+- :func:`ensure_compile_listener` — XLA compile count/time via
+  ``jax.monitoring`` duration events (keys containing ``compile``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from . import tracer
+
+
+class Counter:
+    """Monotonic accumulator (rows processed, epochs, trees built)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += float(n)
+
+    def to_record(self) -> Dict[str, Any]:
+        return {"kind": "metric", "type": "counter", "name": self.name,
+                "value": self.value}
+
+
+class Gauge:
+    """Last-value instrument with a high-water option (loss, throughput,
+    device-memory peak)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: Optional[float] = None
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def set_max(self, v: float) -> None:
+        v = float(v)
+        if self.value is None or v > self.value:
+            self.value = v
+
+    def to_record(self) -> Dict[str, Any]:
+        return {"kind": "metric", "type": "gauge", "name": self.name,
+                "value": self.value}
+
+
+class Histogram:
+    """Streaming summary (count/sum/min/max/last) — enough for epoch
+    times and window throughputs without bucket bookkeeping."""
+
+    __slots__ = ("name", "count", "sum", "min", "max", "last")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.last: Optional[float] = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None or v < self.min else self.min
+        self.max = v if self.max is None or v > self.max else self.max
+        self.last = v
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def to_record(self) -> Dict[str, Any]:
+        return {"kind": "metric", "type": "histogram", "name": self.name,
+                "count": self.count, "sum": round(self.sum, 6),
+                "min": self.min, "max": self.max, "last": self.last}
+
+
+class _NullInstrument:
+    """Shared no-op standing in for every instrument when disabled."""
+
+    __slots__ = ()
+
+    def inc(self, n: float = 1.0) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def set_max(self, v: float) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+
+_NULL = _NullInstrument()
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, Any] = {}
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = cls(name)
+            elif not isinstance(inst, cls):
+                raise TypeError(f"metric {name!r} already registered as "
+                                f"{type(inst).__name__}")
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self, reset: bool = False) -> List[Dict[str, Any]]:
+        with self._lock:
+            recs = [inst.to_record()
+                    for _, inst in sorted(self._instruments.items())]
+            if reset:
+                self._instruments.clear()
+            return recs
+
+    def reset(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+
+_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _registry
+
+
+def counter(name: str):
+    return _registry.counter(name) if tracer.enabled() else _NULL
+
+
+def gauge(name: str):
+    return _registry.gauge(name) if tracer.enabled() else _NULL
+
+
+def histogram(name: str):
+    return _registry.histogram(name) if tracer.enabled() else _NULL
+
+
+def snapshot(reset: bool = False) -> List[Dict[str, Any]]:
+    return _registry.snapshot(reset=reset)
+
+
+# -------------------------------------------------------- device helpers
+def sample_device_memory() -> None:
+    """Record HBM in-use/peak gauges for local device 0 (the per-step
+    high-water mark the YARN container memory counters used to show).
+    Backends without ``memory_stats`` (CPU) are silently skipped."""
+    if not tracer.enabled():
+        return
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return
+    if not stats:
+        return
+    for key, metric in (("bytes_in_use", "device.bytes_in_use"),
+                        ("peak_bytes_in_use", "device.peak_bytes_in_use"),
+                        ("bytes_limit", "device.bytes_limit")):
+        if key in stats:
+            _registry.gauge(metric).set_max(stats[key])
+
+
+_compile_listener_installed = False
+
+
+def ensure_compile_listener() -> None:
+    """Install (once per process) a ``jax.monitoring`` duration listener
+    that accumulates XLA compile count/time into ``xla.compile_count`` /
+    ``xla.compile_time_s``.  The listener itself checks ``enabled()`` so
+    a later disable costs one branch per compile, nothing more."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return
+    try:
+        try:
+            from jax.monitoring import \
+                register_event_duration_secs_listener as _register
+        except ImportError:
+            from jax._src.monitoring import \
+                register_event_duration_secs_listener as _register
+    except Exception:
+        return
+
+    def _listener(name: str, secs: float, **kw) -> None:
+        if "compile" in name and tracer.enabled():
+            _registry.counter("xla.compile_count").inc()
+            _registry.counter("xla.compile_time_s").inc(secs)
+
+    try:
+        _register(_listener)
+        _compile_listener_installed = True
+    except Exception:
+        pass

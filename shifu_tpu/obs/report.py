@@ -1,0 +1,158 @@
+"""Report surface — render a telemetry trace as a per-step span tree.
+
+``shifu-tpu analysis --telemetry`` reads ``<modelset>/telemetry/
+trace.jsonl`` (blocks appended by each step's flush, see
+:mod:`shifu_tpu.obs.tracer` for the schema) and prints, per step: the
+span tree with total and SELF time (total minus direct children — where
+the step actually spent its wall-clock), rows/sec where a span carries a
+``rows`` attribute, summarized per-epoch/tree events, and the metric
+snapshot.  The closing line aggregates the whole pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List
+
+TRACE_BASENAME = "trace.jsonl"
+
+
+def trace_path(model_set_dir: str) -> str:
+    return os.path.join(os.path.abspath(model_set_dir), "telemetry",
+                        TRACE_BASENAME)
+
+
+def load_blocks(path: str) -> List[Dict[str, Any]]:
+    """Parse the JSONL into flush blocks: ``{"meta", "spans", "events",
+    "metrics"}`` per block, skipping unparseable lines (a crashed run may
+    truncate the tail)."""
+    blocks: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            kind = rec.get("kind")
+            if kind == "meta":
+                blocks.append({"meta": rec, "spans": [], "events": [],
+                               "metrics": []})
+                continue
+            if not blocks:       # tolerate a headerless fragment
+                blocks.append({"meta": {"step": None, "ts": None},
+                               "spans": [], "events": [], "metrics": []})
+            if kind == "span":
+                blocks[-1]["spans"].append(rec)
+            elif kind == "event":
+                blocks[-1]["events"].append(rec)
+            elif kind == "metric":
+                blocks[-1]["metrics"].append(rec)
+    return blocks
+
+
+def _fmt_attrs(attrs: Dict[str, Any], dur: float) -> str:
+    parts = []
+    rows = attrs.get("rows")
+    if isinstance(rows, (int, float)) and dur > 0:
+        parts.append(f"{rows:,.0f} rows ({rows / dur:,.0f} rows/s)")
+    for k, v in attrs.items():
+        if k in ("rows", "kind"):
+            continue
+        parts.append(f"{k}={v}")
+    return ("  " + " ".join(parts)) if parts else ""
+
+
+def _render_block(block: Dict[str, Any], out: List[str]) -> float:
+    meta = block["meta"]
+    spans = block["spans"]
+    by_id = {s["id"]: s for s in spans}
+    children: Dict[Any, List[dict]] = {}
+    roots: List[dict] = []
+    for s in spans:
+        parent = s.get("parent")
+        if parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    ev_by_parent: Dict[Any, List[dict]] = {}
+    for e in block["events"]:
+        ev_by_parent.setdefault(e.get("parent"), []).append(e)
+
+    total = sum(s["dur_s"] for s in roots)
+    ts = meta.get("ts")
+    when = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts)) \
+        if ts else "?"
+    out.append(f"== {meta.get('step') or '(unlabeled)'}  {when}  "
+               f"total {total:.3f}s")
+
+    def _events_line(span_id: Any, indent: str) -> None:
+        evs = ev_by_parent.pop(span_id, None)
+        if not evs:
+            return
+        by_name: Dict[str, List[dict]] = {}
+        for e in evs:
+            by_name.setdefault(e["name"], []).append(e)
+        for name, group in by_name.items():
+            last = group[-1]["attrs"]
+            tail = " ".join(f"{k}={_num(v)}" for k, v in last.items())
+            out.append(f"{indent}· {name} ×{len(group)}"
+                       + (f"  (last: {tail})" if tail else ""))
+
+    def _walk(s: dict, depth: int) -> None:
+        kids = sorted(children.get(s["id"], []), key=lambda c: c["ts"])
+        self_s = s["dur_s"] - sum(k["dur_s"] for k in kids)
+        indent = "  " * depth
+        label = f"{indent}{s['name']}"
+        out.append(f"{label:<38}{s['dur_s']:>10.3f}s  self "
+                   f"{max(self_s, 0.0):>8.3f}s"
+                   f"{_fmt_attrs(s.get('attrs') or {}, s['dur_s'])}")
+        _events_line(s["id"], indent + "  ")
+        for k in kids:
+            _walk(k, depth + 1)
+
+    for r in sorted(roots, key=lambda s: s["ts"]):
+        _walk(r, 1)
+    _events_line(None, "  ")          # events outside any span
+    for m in block["metrics"]:
+        if m["type"] == "histogram":
+            mean = m["sum"] / m["count"] if m.get("count") else 0.0
+            out.append(f"  metric {m['name']}: count={m['count']} "
+                       f"mean={mean:.4g} min={_num(m['min'])} "
+                       f"max={_num(m['max'])}")
+        else:
+            out.append(f"  metric {m['name']}: {_num(m.get('value'))} "
+                       f"({m['type']})")
+    return total
+
+
+def _num(v: Any) -> Any:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return v
+
+
+def render_telemetry(model_set_dir: str) -> str:
+    """The ``analysis --telemetry`` payload for a model-set dir."""
+    path = trace_path(model_set_dir)
+    if not os.path.isfile(path):
+        return (f"no telemetry trace at {path}\n"
+                "run steps with SHIFU_TPU_TELEMETRY=1 (or --telemetry / "
+                "-Dshifu.telemetry=on) first")
+    blocks = load_blocks(path)
+    if not blocks:
+        return f"telemetry trace {path} is empty"
+    out: List[str] = [f"telemetry: {path}",
+                      f"schema v{blocks[-1]['meta'].get('schema_version')}"
+                      f", {len(blocks)} step record(s)", ""]
+    grand = 0.0
+    for block in blocks:
+        grand += _render_block(block, out)
+        out.append("")
+    out.append(f"pipeline total: {grand:.3f}s across {len(blocks)} "
+               "step record(s)")
+    return "\n".join(out)

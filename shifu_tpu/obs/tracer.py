@@ -1,0 +1,271 @@
+"""Span tracer — nested wall-clock spans with a thread-safe collector.
+
+The in-process analogue of the reference's per-step wall-clock log lines
+and MR job counters: every pipeline step runs under a root span, phases
+and trainer epochs nest inside it, and the whole trace lands as JSONL
+under ``<modelset>/telemetry/`` for ``analysis --telemetry`` to render.
+
+JSONL schema (``SCHEMA_VERSION``) — one JSON object per line, keyed by
+``kind``:
+
+- ``meta``:   ``{kind, schema_version, step, ts, pid}`` — opens a flush
+  block (one per step run / bench flush);
+- ``span``:   ``{kind, name, id, parent, ts, dur_s, attrs}`` — ``parent``
+  is the enclosing span's ``id`` (``null`` for roots); ``ts`` is epoch
+  seconds at entry; durations come from ``time.perf_counter``;
+- ``event``:  ``{kind, name, ts, parent, attrs}`` — a point-in-time
+  record (per-epoch trainer metrics, early stops, profile captures);
+- ``metric``: one registry instrument snapshot (see
+  :mod:`shifu_tpu.obs.registry`).
+
+Zero-cost when disabled: :func:`span` returns a shared no-op singleton
+(one function call + one branch per call site), :func:`event` returns
+immediately, :func:`fence` never touches jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+_TRUE = ("1", "true", "on", "yes")
+
+# tri-state enable: explicit set_enabled() override > cached env/property
+# lookup.  The cache keeps enabled() at one global read + branch on the
+# hot path; reset_for_tests()/set_enabled(None) clears it.
+_enabled_override: Optional[bool] = None
+_enabled_cache: Optional[bool] = None
+_fence_cache: Optional[bool] = None
+
+
+def _truthy(v: Optional[str]) -> bool:
+    return v is not None and str(v).strip().lower() in _TRUE
+
+
+def _lookup(env_key: str, *prop_keys: str) -> bool:
+    v = os.environ.get(env_key)
+    if v is None:
+        from ..config import environment
+        for k in prop_keys:
+            v = environment.get_property(k)
+            if v is not None:
+                break
+    return _truthy(v)
+
+
+def enabled() -> bool:
+    """Is telemetry on?  env ``SHIFU_TPU_TELEMETRY`` / property
+    ``shifu.telemetry`` / :func:`set_enabled` (CLI ``--telemetry``)."""
+    if _enabled_override is not None:
+        return _enabled_override
+    global _enabled_cache
+    if _enabled_cache is None:
+        _enabled_cache = _lookup("SHIFU_TPU_TELEMETRY",
+                                 "shifu.telemetry", "shifu.tpu.telemetry")
+    return _enabled_cache
+
+
+def set_enabled(value: Optional[bool]) -> None:
+    """Programmatic override (CLI flag, tests); ``None`` restores the
+    env/property lookup."""
+    global _enabled_override, _enabled_cache, _fence_cache
+    _enabled_override = value
+    _enabled_cache = None
+    _fence_cache = None
+
+
+def fencing_enabled() -> bool:
+    """Fenced spans: ``jax.block_until_ready`` at :meth:`Span.fence` so a
+    span's wall-clock covers the device work it launched, not just the
+    dispatch.  Env ``SHIFU_TPU_TELEMETRY_FENCE`` / property
+    ``shifu.telemetry.fence``; only active while telemetry is on."""
+    global _fence_cache
+    if not enabled():
+        return False
+    if _fence_cache is None:
+        _fence_cache = _lookup("SHIFU_TPU_TELEMETRY_FENCE",
+                               "shifu.telemetry.fence")
+    return _fence_cache
+
+
+# ------------------------------------------------------------- collector
+class _Collector:
+    """Thread-safe record buffer + per-thread span stack."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._records: List[Dict[str, Any]] = []
+        self._tls = threading.local()
+        self._next_id = 0
+
+    def new_id(self) -> int:
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    @property
+    def stack(self) -> List[int]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def current_parent(self) -> Optional[int]:
+        st = self.stack
+        return st[-1] if st else None
+
+    def add(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            self._records.append(rec)
+
+    def drain(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            out, self._records = self._records, []
+            return out
+
+    def peek(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._records)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+        self._tls = threading.local()
+
+
+_collector = _Collector()
+
+
+class Span:
+    """A live span; use via ``with span("name", k=v) as sp:``.  Extra
+    attributes attach with :meth:`set`; :meth:`fence` blocks on device
+    values when fencing is on so the duration covers real work."""
+
+    __slots__ = ("name", "attrs", "id", "parent", "_ts", "_t0")
+
+    def __init__(self, name: str, attrs: Dict[str, Any]):
+        self.name = name
+        self.attrs = attrs
+        self.id = _collector.new_id()
+        self.parent: Optional[int] = None
+        self._ts = 0.0
+        self._t0 = 0.0
+
+    def __enter__(self) -> "Span":
+        self.parent = _collector.current_parent()
+        _collector.stack.append(self.id)
+        self._ts = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dur = time.perf_counter() - self._t0
+        st = _collector.stack
+        if st and st[-1] == self.id:
+            st.pop()
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        _collector.add({"kind": "span", "name": self.name, "id": self.id,
+                        "parent": self.parent, "ts": round(self._ts, 3),
+                        "dur_s": round(dur, 6), "attrs": self.attrs})
+        return False
+
+    def set(self, **attrs: Any) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def fence(self, value: Any) -> Any:
+        """Block until ``value``'s device buffers are ready (fencing mode
+        only) so async dispatch doesn't flatter this span; returns the
+        value either way."""
+        if fencing_enabled():
+            import jax
+            jax.block_until_ready(value)
+        return value
+
+
+class _NullSpan:
+    """Shared no-op span: the disabled path allocates nothing."""
+
+    __slots__ = ()
+    id = None
+    parent = None
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **attrs: Any) -> "_NullSpan":
+        return self
+
+    def fence(self, value: Any) -> Any:
+        return value
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def span(name: str, /, **attrs: Any):
+    """Open a (nested) span.  No-op singleton when telemetry is off."""
+    if not enabled():
+        return _NULL_SPAN
+    return Span(name, attrs)
+
+
+def event(name: str, /, **attrs: Any) -> None:
+    """Record a point-in-time event under the current span (per-epoch
+    trainer metrics, early stops, ...)."""
+    if not enabled():
+        return
+    _collector.add({"kind": "event", "name": name,
+                    "ts": round(time.time(), 3),
+                    "parent": _collector.current_parent(), "attrs": attrs})
+
+
+def fence(value: Any) -> Any:
+    """Module-level fence for call sites without a span handle."""
+    if fencing_enabled():
+        import jax
+        jax.block_until_ready(value)
+    return value
+
+
+def pending_records() -> List[Dict[str, Any]]:
+    """Snapshot of not-yet-flushed records (tests, bench)."""
+    return _collector.peek()
+
+
+def flush(path: str, step: Optional[str] = None,
+          extra_meta: Optional[Dict[str, Any]] = None) -> bool:
+    """Append the buffered spans/events plus a registry snapshot to
+    ``path`` as one JSONL block opened by a ``meta`` line, then clear
+    both.  Returns False (and writes nothing) when telemetry is off."""
+    if not enabled():
+        return False
+    from . import registry
+    records = _collector.drain()
+    metrics = registry.snapshot(reset=True)
+    meta: Dict[str, Any] = {"kind": "meta", "schema_version": SCHEMA_VERSION,
+                            "step": step, "ts": round(time.time(), 3),
+                            "pid": os.getpid()}
+    if extra_meta:
+        meta.update(extra_meta)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a") as f:
+        for rec in [meta] + records + metrics:
+            f.write(json.dumps(rec) + "\n")
+    return True
+
+
+def reset_for_tests() -> None:
+    from .registry import get_registry
+    set_enabled(None)
+    _collector.clear()
+    get_registry().reset()

@@ -22,10 +22,12 @@ import csv
 import json
 import logging
 import os
+import time
 from typing import List, Optional
 
 import numpy as np
 
+from .. import obs
 from ..config.model_config import EvalConfig, RawSourceData
 from ..config.validator import ModelStep
 from ..data import DataSource
@@ -162,7 +164,9 @@ class EvalProcessor(BasicProcessor):
         all_scores, all_targets, all_weights = [], [], []
         score_path = self.paths.eval_score_path(ev.name)
         n_models = len(scorer.models)
-        with open(score_path, "w") as sf:
+        score_t0 = time.perf_counter()
+        with self.phase(f"score:{ev.name}") as ph, \
+                open(score_path, "w") as sf:
             w = csv.writer(sf, delimiter="|")
             w.writerow(["tag", "weight", "mean", "max", "min", "median"]
                        + [f"model{i}" for i in range(n_models)])
@@ -185,12 +189,18 @@ class EvalProcessor(BasicProcessor):
                     + [np.char.mod("%.3f", res.scores[:, m])
                        for m in range(n_models)])
                 w.writerows(block.tolist())
+            ph.set(rows=int(sum(len(s) for s in all_scores)))
         if not all_scores:
             log.error("eval %s: no records scored", ev.name)
             return 1
         scores = np.concatenate(all_scores)
         targets = np.concatenate(all_targets)
         weights = np.concatenate(all_weights)
+        obs.counter("eval.rows_scored").inc(len(scores))
+        obs.gauge("eval.rows_per_sec").set(
+            len(scores) / max(time.perf_counter() - score_t0, 1e-9))
+        obs.event("eval_set", eval_set=ev.name, rows=len(scores),
+                  models=n_models, action=action)
         log.info("eval %s: scored %d records (%d pos / %d neg) with %d model(s)",
                  ev.name, len(scores), int(targets.sum()),
                  int((1 - targets).sum()), n_models)
@@ -224,6 +234,8 @@ class EvalProcessor(BasicProcessor):
         from ..eval.report import html_report
         with open(os.path.join(eval_dir, "report.html"), "w") as f:
             f.write(html_report(ev.name, curves, result))
+        obs.gauge(f"eval.{ev.name}.auc").set(result.areaUnderRoc)
+        obs.gauge(f"eval.{ev.name}.pr_auc").set(result.areaUnderPr)
         log.info("eval %s: AUC %.6f weighted AUC %.6f PR-AUC %.6f",
                  ev.name, result.areaUnderRoc, result.weightedAuc,
                  result.areaUnderPr)

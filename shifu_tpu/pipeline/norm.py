@@ -13,10 +13,12 @@ from __future__ import annotations
 import json
 import logging
 import os
+import time
 from typing import List, Optional
 
 import numpy as np
 
+from .. import obs
 from ..config.validator import ModelStep
 from ..data import DataSource, sample_mask
 from ..data.transform import DatasetTransformer
@@ -46,27 +48,37 @@ class NormalizeProcessor(BasicProcessor):
         neg_only = mc.normalize.sampleNegOnly
         shard, rows, seen, total_out = 0, 0, 0, 0
         bufx, bufb, bufy, bufw = [], [], [], []
-        for chunk in source.iter_chunks():
-            tc = transformer.transform(chunk)
-            if tc.n == 0:
-                continue
-            keep = sample_mask(tc.n, rate, seed=seen, neg_only=neg_only,
-                               targets=tc.target)
-            seen += tc.n
-            bufx.append(tc.x[keep]); bufb.append(tc.bins[keep])
-            bufy.append(tc.target[keep]); bufw.append(tc.weight[keep])
-            rows += int(keep.sum())
-            total_out += int(keep.sum())
-            if rows >= SHARD_ROWS:
-                self._flush(norm_dir, clean_dir, shard, bufx, bufb, bufy, bufw)
-                shard += 1; rows = 0
-                bufx, bufb, bufy, bufw = [], [], [], []
-        if rows:
-            self._flush(norm_dir, clean_dir, shard, bufx, bufb, bufy, bufw)
-            shard += 1
+        t0 = time.perf_counter()
+        with self.phase("transform") as ph:
+            for chunk in source.iter_chunks():
+                tc = transformer.transform(chunk)
+                if tc.n == 0:
+                    continue
+                keep = sample_mask(tc.n, rate, seed=seen, neg_only=neg_only,
+                                   targets=tc.target)
+                seen += tc.n
+                bufx.append(tc.x[keep]); bufb.append(tc.bins[keep])
+                bufy.append(tc.target[keep]); bufw.append(tc.weight[keep])
+                rows += int(keep.sum())
+                total_out += int(keep.sum())
+                if rows >= SHARD_ROWS:
+                    self._flush(norm_dir, clean_dir, shard, bufx, bufb,
+                                bufy, bufw)
+                    shard += 1; rows = 0
+                    bufx, bufb, bufy, bufw = [], [], [], []
+            if rows:
+                self._flush(norm_dir, clean_dir, shard, bufx, bufb, bufy,
+                            bufw)
+                shard += 1
+            ph.set(rows=total_out)
         if self.params.get("shuffle"):
-            self._shuffle(norm_dir)
-            self._shuffle(clean_dir)
+            with self.phase("shuffle"):
+                self._shuffle(norm_dir)
+                self._shuffle(clean_dir)
+        obs.counter("norm.rows").inc(total_out)
+        obs.gauge("norm.shards").set(shard)
+        obs.gauge("norm.rows_per_sec").set(
+            total_out / max(time.perf_counter() - t0, 1e-9))
         schema = {
             "outputNames": transformer.output_names,
             "columnNums": [c.columnNum for c in transformer.columns],

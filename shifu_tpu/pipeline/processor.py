@@ -15,6 +15,7 @@ import shutil
 import time
 from typing import List, Optional
 
+from .. import obs
 from ..config import (ColumnConfig, ModelConfig, PathFinder,
                       load_column_configs, save_column_configs)
 from ..config.validator import ModelStep, probe
@@ -106,37 +107,57 @@ class BasicProcessor:
     def run(self) -> int:
         t0 = time.time()
         log.info("step %s start", self.step.name)
-        self.setup()
-        with self._device_trace():
-            code = self.process()
+        telemetry = obs.enabled()
+        if telemetry:
+            obs.ensure_compile_listener()
+        try:
+            with obs.span(self.profile_name, kind="step") as root:
+                with obs.span("setup", kind="phase"):
+                    self.setup()
+                with self._device_trace(), \
+                        obs.span("process", kind="phase"):
+                    code = self.process()
+                root.set(exit_code=code)
+        finally:
+            # flush even when the step raised: a crashed run's partial
+            # trace (with the error-marked span) is exactly the one you
+            # want to read
+            if telemetry:
+                self._flush_telemetry()
         total = time.time() - t0
         log.info("step %s done in %.2fs", self.step.name, total)
         self._write_profile(total)
         return code
 
     def _device_trace(self):
-        """``-Dshifu.profile=<dir>``: wrap the step in a ``jax.profiler``
-        trace (XLA device timeline, viewable in TensorBoard/Perfetto) —
-        the TPU-native upgrade of the reference's wall-clock log lines
-        (``TrainModelProcessor.java:214``, ``DTWorker.java:687`` nano
-        timers, SURVEY §5 tracing).  The wall-clock ``phase()`` spans in
-        tmp/profile.json stay always-on; this knob adds the compiled-op
-        view when asked."""
-        from contextlib import nullcontext
+        """``shifu-tpu <step> --profile [dir]`` / ``-Dshifu.profile=<dir>``:
+        wrap the step in a ``jax.profiler`` trace (XLA device timeline,
+        viewable in TensorBoard/Perfetto) — see ``obs/profiler.py``.  The
+        wall-clock ``phase()`` spans stay always-on (when telemetry is);
+        this knob adds the compiled-op view when asked."""
+        from ..obs.profiler import profile_step
+        return profile_step(self.step.name.lower())
 
-        from ..config import environment
-        trace_dir = environment.get_property("shifu.profile", "")
-        if not trace_dir:
-            return nullcontext()
-        import jax
-        out = os.path.join(os.path.abspath(trace_dir), self.step.name.lower())
-        log.info("device trace -> %s (tensorboard --logdir or Perfetto)", out)
-        return jax.profiler.trace(out)
+    def _flush_telemetry(self) -> None:
+        """Append this run's spans/events + metrics snapshot to
+        ``<modelset>/telemetry/trace.jsonl`` — the file ``analysis
+        --telemetry`` renders.  Device-memory high-water samples here, at
+        the step boundary (the per-step peak is the YARN-container-memory
+        counter analogue)."""
+        try:
+            obs.sample_device_memory()
+            path = self.paths.telemetry_trace_path if self.paths else \
+                os.path.join(self.dir, "telemetry", "trace.jsonl")
+            obs.flush(path, step=self.profile_name)
+        except Exception:                   # telemetry must never fail a step
+            log.debug("telemetry flush failed", exc_info=True)
 
     # ------------------------------------------------------------ profiling
     def phase(self, name: str):
         """Time a named phase inside the step (reference aux tracing role,
-        SURVEY §5): accumulates into ``tmp/profile.json`` per step."""
+        SURVEY §5): accumulates into ``tmp/profile.json`` per step AND
+        opens a telemetry span nested under the step's root (no-op when
+        telemetry is off)."""
         return _PhaseSpan(self._phases, name)
 
     @property
@@ -181,12 +202,27 @@ class _PhaseSpan:
     def __init__(self, store: dict, name: str):
         self.store = store
         self.name = name
+        self._obs = None
+        self._pending: dict = {}
 
     def __enter__(self):
+        self._obs = obs.span(self.name, kind="phase", **self._pending)
+        self._obs.__enter__()
         self.t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         self.store[self.name] = self.store.get(self.name, 0.0) \
             + (time.perf_counter() - self.t0)
+        self._obs.__exit__(*exc)
         return False
+
+    def set(self, **attrs):
+        """Attach telemetry attributes (e.g. ``rows=`` for rows/sec in
+        the report); usable before or inside the ``with``; no-op when
+        telemetry is off."""
+        if self._obs is None:
+            self._pending.update(attrs)
+        else:
+            self._obs.set(**attrs)
+        return self

@@ -13,10 +13,12 @@ from __future__ import annotations
 import logging
 import math
 import os
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from .. import obs
 from ..config import ColumnConfig
 from ..config.validator import ModelStep
 from ..data import DataSource
@@ -61,7 +63,8 @@ class StatsProcessor(BasicProcessor):
 
         # ---------------- pass 1: moments/min/max (numeric)
         total_rows = 0
-        with self.phase("pass1_moments"):
+        sweep_t0 = time.perf_counter()
+        with self.phase("pass1_moments") as ph:
             for ci, chunk in enumerate(source.iter_chunks()):
                 ex = extractor.extract(_sample_raw(chunk, rate, ci))
                 if ex.n == 0:
@@ -69,6 +72,7 @@ class StatsProcessor(BasicProcessor):
                 total_rows += ex.n
                 if num_cols:
                     num_acc.update_moments(ex.numeric, ex.numeric_valid)
+            ph.set(rows=total_rows)
         if total_rows == 0:
             raise RuntimeError("stats: dataset is empty after filtering")
         if num_cols:
@@ -84,7 +88,7 @@ class StatsProcessor(BasicProcessor):
                 n_cols=len(num_cols), offset=num_acc.moments["mean"],
                 mesh=mesh)
         psi_units: Dict[str, Dict[str, np.ndarray]] = {}
-        with self.phase("pass2_histograms"):
+        with self.phase("pass2_histograms").set(rows=total_rows):
             for ci, chunk in enumerate(source.iter_chunks()):
                 ex = extractor.extract(_sample_raw(chunk, rate, ci),
                                        keep_raw=psi_col is not None)
@@ -129,6 +133,10 @@ class StatsProcessor(BasicProcessor):
         if self.params.get("rebin"):
             self._dynamic_rebin()
 
+        obs.counter("stats.rows").inc(total_rows)
+        obs.gauge("stats.columns").set(len(num_cols) + len(cat_cols))
+        obs.gauge("stats.rows_per_sec").set(
+            total_rows / max(time.perf_counter() - sweep_t0, 1e-9))
         self.save_column_configs()
         log.info("stats: %d rows, %d numeric, %d categorical columns",
                  total_rows, len(num_cols), len(cat_cols))

@@ -40,6 +40,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..config.model_config import Algorithm
 from ..data.shards import Shards
 from ..models import tree as tree_model
@@ -616,6 +617,7 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
                 drain()
                 checkpoint_fn(trees, history, init_score)
             if settings.early_stop and stopper.add(va_err):
+                obs.event("early_stop", trainer="gbt", tree=ti + 1)
                 log.info("GBT early stop after %d trees", ti + 1)
                 break
         drain()
@@ -1391,6 +1393,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                 checkpoint_fn(trees, history, init_host())
             if settings.early_stop and \
                     stopper.add(history[-1][1]):
+                obs.event("early_stop", trainer="gbt_streamed", tree=ti + 1)
                 log.info("GBT early stop after %d trees (streamed)",
                          ti + 1)
                 break
@@ -1440,6 +1443,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                 (ti + 1) % settings.checkpoint_every == 0:
             checkpoint_fn(trees, history, init_host())
         if settings.early_stop and stopper.add(va_err):
+            obs.event("early_stop", trainer="gbt_streamed", tree=ti + 1)
             log.info("GBT early stop after %d trees (streamed)", ti + 1)
             break
     flush_progress()
@@ -2311,6 +2315,10 @@ def _run_tree_multi(proc, shards, col_nums, cat_mask, n_bins, alg,
                     pf.write(f"{label} Tree #{ti + 1} Train Error: "
                              f"{tr:.6f} Validation Error: {va:.6f}\n")
                 pf.flush()
+                obs.counter("train.trees").inc(res.trees_built)
+                obs.event("forest_member", trainer=alg.name.lower(),
+                          member=j, trees=res.trees_built,
+                          valid_err=round(res.valid_error, 6))
 
     if rf_like and kfold and kfold > 1 and not is_gs:
         # RF k-fold: oob error is in-fold; the CV figure of merit is the
@@ -2432,6 +2440,9 @@ def run_tree_training(proc) -> int:
                     f"Validation Error: {va:.6f}")
             pf.write(line + "\n")
             pf.flush()
+            obs.counter("train.trees").inc()
+            obs.event("tree", trainer=alg.name.lower(), tree=ti + 1,
+                      train_err=round(tr, 6), valid_err=round(va, 6))
             if (ti + 1) % 5 == 0 or ti == 0:
                 log.info(line)
 
@@ -2495,6 +2506,8 @@ def run_tree_training(proc) -> int:
     with open(os.path.join(proc.paths.tmp_dir, "feature_importance.json"),
               "w") as fjson:
         json.dump({k: v for k, v in fi_named}, fjson, indent=2)
+    obs.gauge("train.valid_err").set(res.valid_error)
+    obs.gauge("train.trees_built").set(res.trees_built)
     log.info("train %s done: %d trees, train err %.6f valid err %.6f; "
              "top features %s", alg.name, res.trees_built, res.train_error,
              res.valid_error, [n for n, _ in fi_named[:5]])

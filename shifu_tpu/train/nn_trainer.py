@@ -19,6 +19,7 @@ Reference mapping:
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 from functools import lru_cache, partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -28,6 +29,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..models import nn as nn_model
 from ..parallel import mesh as meshlib
 from .early_stop import WindowEarlyStop
@@ -373,7 +375,9 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
                                     jnp.arange(n_b, dtype=jnp.int32))
         return st, os_
 
+    obs_on = obs.enabled()
     for epoch in range(start_epoch, settings.epochs):
+        ep_t0 = time.perf_counter()
         key, sub = jax.random.split(key)
         rngs = jax.random.split(sub, bags)
         if bs and bs < n_padded:
@@ -390,6 +394,17 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
         tr, va = _gather_np(jnp.stack([tr, va]))       # one fetch
         history.append((float(tr.mean()), float(va.mean())))
         epochs_run = epoch + 1
+        if obs_on:
+            # host-side per-epoch metrics: the _gather_np fetch above IS
+            # the value-forcing sync, so the wall-clock covers real work
+            dt = time.perf_counter() - ep_t0
+            obs.counter("train.epochs").inc()
+            obs.histogram("train.epoch_s").observe(dt)
+            obs.gauge("train.valid_err").set(float(va.mean()))
+            obs.event("epoch", trainer="nn", epoch=epoch,
+                      train_err=round(float(tr.mean()), 6),
+                      valid_err=round(float(va.mean()), 6), rows=n,
+                      rows_per_sec=round(n / max(dt, 1e-9), 1))
 
         improved = np.flatnonzero(va < best_valid)
         if improved.size:
@@ -415,6 +430,8 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
             # counters must advance uniformly) then stop when all agree
             flags = [s.should_stop(float(v)) for s, v in zip(stops, va)]
             if all(flags):
+                obs.event("early_stop", trainer="nn", epoch=epoch,
+                          window=settings.early_stop_window)
                 log.info("early stop at epoch %d (window %d)", epoch,
                          settings.early_stop_window)
                 break
@@ -666,6 +683,11 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
                     lambda a: a[i].copy(), host)
         if progress:
             progress(epoch_done, float(tr.mean()), float(va.mean()))
+        obs.counter("train.epochs").inc()
+        obs.event("epoch", trainer="nn_streamed", epoch=epoch_done,
+                  train_err=round(float(tr.mean()), 6),
+                  valid_err=round(float(va.mean()), 6),
+                  rows=stream.num_rows)
         if settings.early_stop_window > 0:
             flags = [s.should_stop(float(v)) for s, v in zip(stops, va)]
             return all(flags)
@@ -721,6 +743,8 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
         if settings.learning_decay > 0:
             lr_scale *= (1.0 - settings.learning_decay)
         if stopped:
+            obs.event("early_stop", trainer="nn_streamed", epoch=epoch,
+                      window=settings.early_stop_window)
             log.info("early stop at epoch %d (window %d, streamed)",
                      epoch, settings.early_stop_window)
             break

@@ -24,6 +24,7 @@ surfaces are what NN/GBT are for.
 from __future__ import annotations
 
 import logging
+import time
 from functools import partial
 from typing import Tuple
 
@@ -32,6 +33,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..models.svm import SVMModelSpec, kernel_matrix
 
 log = logging.getLogger(__name__)
@@ -91,14 +93,21 @@ def train_kernel_svm(x: np.ndarray, y01: np.ndarray, train_mask: np.ndarray,
     y_pm = jnp.asarray(2.0 * np.asarray(y01, np.float32) - 1.0)
     tm = jnp.asarray(np.asarray(train_mask, np.float32))
     c_box = tm * float(c_penalty)
+    t0 = time.perf_counter()
     alpha, f, tr, va = _solve_dual(
         jnp.asarray(x, jnp.float32), y_pm, tm, c_box,
         float(spec.gamma), float(spec.coef0),
         (spec.kernel, spec.degree), iters)
-    alpha = np.asarray(alpha)
+    alpha = np.asarray(alpha)            # value-forcing fetch = the sync
+    solve_s = time.perf_counter() - t0
     keep = alpha > 1e-6
     sv_x = np.asarray(x, np.float32)[keep]
     alpha_y = (alpha * np.asarray(y_pm))[keep].astype(np.float32)
+    obs.counter("train.epochs").inc(iters)   # dual iterations ≈ epochs
+    obs.event("svm_solve", trainer="svm", kernel=spec.kernel,
+              n_sv=int(keep.sum()), rows=n, iters=iters,
+              train_err=round(float(tr), 6), valid_err=round(float(va), 6),
+              dur_s=round(solve_s, 3))
     log.info("kernel SVM (%s): %d SVs of %d train rows, "
              "train hinge %.6f valid hinge %.6f", spec.kernel,
              int(keep.sum()), int(np.asarray(tm).sum()), float(tr),

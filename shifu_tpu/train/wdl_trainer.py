@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -23,6 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..data.shards import Shards
 from ..models import wdl as wdl_model
 from ..parallel import mesh as meshlib
@@ -223,7 +225,9 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
     epochs_run = 0
     tr = va = np.zeros(bags)
     order_rng = np.random.default_rng([settings.seed, 1])
+    obs_on = obs.enabled()
     for epoch in range(settings.epochs):
+        ep_t0 = time.perf_counter()
         if bs and bs < n_padded:
             # rows were shuffled once; re-randomize the BATCH ORDER each
             # epoch (cheap host-side; no gather, no recompile)
@@ -238,6 +242,15 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
         tr, va = np.asarray(jnp.stack([tr, va]))       # one fetch
         history.append((float(tr.mean()), float(va.mean())))
         epochs_run = epoch + 1
+        if obs_on:
+            dt = time.perf_counter() - ep_t0
+            obs.counter("train.epochs").inc()
+            obs.histogram("train.epoch_s").observe(dt)
+            obs.gauge("train.valid_err").set(float(va.mean()))
+            obs.event("epoch", trainer="wdl", epoch=epoch,
+                      train_err=round(float(tr.mean()), 6),
+                      valid_err=round(float(va.mean()), 6), rows=n,
+                      rows_per_sec=round(n / max(dt, 1e-9), 1))
         improved = np.flatnonzero(va < best_valid)
         if improved.size:
             host = _to_host(stacked)
@@ -250,6 +263,8 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
         if settings.early_stop_window > 0:
             flags = [s.should_stop(float(v)) for s, v in zip(stops, va)]
             if all(flags):
+                obs.event("early_stop", trainer="wdl", epoch=epoch,
+                          window=settings.early_stop_window)
                 log.info("WDL early stop at epoch %d", epoch)
                 break
     final = _to_host(stacked)
@@ -397,6 +412,11 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
                     lambda a: a[i].copy(), host)
         if progress:
             progress(epoch_done, float(tr.mean()), float(va.mean()))
+        obs.counter("train.epochs").inc()
+        obs.event("epoch", trainer="wdl_streamed", epoch=epoch_done,
+                  train_err=round(float(tr.mean()), 6),
+                  valid_err=round(float(va.mean()), 6),
+                  rows=planes.num_rows)
         if settings.early_stop_window > 0:
             return all(s.should_stop(float(v)) for s, v in zip(stops, va))
         return False
@@ -423,6 +443,8 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
                                           jnp.asarray(stats[:, 1]))
         epochs_run = epoch + 1
         if stopped:
+            obs.event("early_stop", trainer="wdl_streamed", epoch=epoch,
+                      window=settings.early_stop_window)
             log.info("WDL early stop at epoch %d (streamed)", epoch)
             break
     if not stopped:

@@ -1,0 +1,127 @@
+"""Byte-level golden fixture for ``BinaryWDLSerializer``
+(``export/reference_spec.write_reference_wdl``).
+
+The round-trip test (``test_reference_export.test_wdl_reference_roundtrip``)
+validates the WDL binary format only against our own reader — a
+self-consistent-but-wrong drift in BOTH writer and reader would pass it.
+This test pins the writer's exact output bytes for a small deterministic
+model against a checked-in fixture (``tests/golden/wdl_model_golden.bin``,
+the gzip-DECOMPRESSED stream — the gzip header embeds an mtime, so raw
+file bytes are not stable), so any byte-layout change is a loud, reviewed
+event.
+
+Regenerate (only after verifying the new layout against the reference's
+``IndependentWDLModel.loadFromStream``):
+``python tests/test_wdl_golden.py --regen``
+"""
+
+import gzip
+import os
+import sys
+
+import numpy as np
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "wdl_model_golden.bin")
+
+
+def _grid(shape, scale=0.125, offset=-0.5):
+    """Deterministic f32 grid exactly representable in binary (eighths):
+    immune to RNG/numpy version drift."""
+    n = int(np.prod(shape))
+    return ((np.arange(n, dtype=np.float32) % 16) * scale
+            + offset).reshape(shape)
+
+
+def _cc(num, name, cats=None, bounds=None, mean=0.25):
+    from shifu_tpu.config.column_config import ColumnConfig, ColumnType
+    cc = ColumnConfig(columnNum=num, columnName=name,
+                      columnType=ColumnType.C if cats else ColumnType.N)
+    cc.columnBinning.binCategory = cats
+    cc.columnBinning.binBoundary = bounds
+    cc.columnBinning.binCountNeg = [10, 5]
+    cc.columnBinning.binCountPos = [2, 3]
+    cc.columnBinning.binCountWoe = [-0.5, 0.75]
+    cc.columnBinning.binWeightedWoe = [-0.25, 0.5]
+    cc.columnBinning.binPosRate = [0.125, 0.375]
+    cc.columnStats.mean = mean
+    cc.columnStats.stdDev = 1.25
+    return cc
+
+
+def _golden_model():
+    """The pinned model: 2 numerics, 2 categoricals (cards 3/2), embed 2,
+    one hidden layer of 3 — every array an exact-f32 grid."""
+    from shifu_tpu.models.wdl import WDLModelSpec
+    spec = WDLModelSpec(numeric_dim=2, cat_cardinalities=[3, 2],
+                        embed_dim=2, hidden_nodes=[3],
+                        activations=["relu"], column_nums=[1, 2],
+                        cat_column_nums=[5, 6])
+    params = {
+        "embed": [_grid((3, 2)), _grid((2, 2), offset=-0.25)],
+        "deep": [{"w": _grid((6, 3)), "b": _grid((3,), offset=0.0)},
+                 {"w": _grid((3, 1), offset=0.375), "b": _grid((1,))}],
+        "wide_cat": [_grid((3,), offset=0.125), _grid((2,), offset=-0.375)],
+        "wide_num": _grid((2, 1), offset=0.5),
+        "bias": np.asarray([0.25], np.float32),
+    }
+    ccs = [_cc(1, "num1", bounds=[float("-inf"), 0.5]),
+           _cc(2, "num2", bounds=[float("-inf"), 0.0], mean=-0.75),
+           _cc(5, "catA", cats=["a", "b"]),
+           _cc(6, "catB", cats=["x"])]
+    return spec, params, ccs
+
+
+def _serialize(tmp_path) -> bytes:
+    from shifu_tpu.export.reference_spec import write_reference_wdl
+    spec, params, ccs = _golden_model()
+    path = os.path.join(str(tmp_path), "model0.wdl")
+    write_reference_wdl(path, spec, params, ccs)
+    with open(path, "rb") as f:
+        return gzip.decompress(f.read())
+
+
+def test_wdl_serializer_bytes_match_golden(tmp_path):
+    payload = _serialize(tmp_path)
+    assert os.path.isfile(GOLDEN), \
+        f"golden fixture missing — run `python {__file__} --regen`"
+    with open(GOLDEN, "rb") as f:
+        expected = f.read()
+    assert payload == expected, (
+        f"BinaryWDLSerializer output drifted from the golden fixture "
+        f"({len(payload)} vs {len(expected)} bytes) — if the layout change "
+        "is intentional, re-validate against the reference's "
+        "IndependentWDLModel.loadFromStream and regenerate the fixture")
+
+
+def test_wdl_golden_model_still_roundtrips(tmp_path):
+    """The pinned bytes must stay loadable by our reader with exact
+    values — guards reader/writer drifting together AWAY from the pin."""
+    from shifu_tpu.models.reference_import import load_reference_wdl
+    from shifu_tpu.export.reference_spec import write_reference_wdl
+    spec, params, ccs = _golden_model()
+    path = os.path.join(str(tmp_path), "model0.wdl")
+    write_reference_wdl(path, spec, params, ccs)
+    spec2, params2, col_stats = load_reference_wdl(path)
+    assert spec2.numeric_dim == 2
+    assert spec2.cat_cardinalities == [3, 2]
+    assert col_stats[5]["categories"] == ["a", "b"]
+    np.testing.assert_array_equal(np.asarray(params2["embed"][0]),
+                                  params["embed"][0])
+    np.testing.assert_array_equal(np.asarray(params2["deep"][0]["w"]),
+                                  params["deep"][0]["w"])
+    np.testing.assert_array_equal(np.asarray(params2["wide_num"]),
+                                  params["wide_num"])
+
+
+if __name__ == "__main__":
+    if "--regen" in sys.argv:
+        import tempfile
+        os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+        with tempfile.TemporaryDirectory() as td:
+            payload = _serialize(td)
+        with open(GOLDEN, "wb") as f:
+            f.write(payload)
+        print(f"wrote {len(payload)} bytes -> {GOLDEN}")
+    else:
+        print(__doc__)
