@@ -4,12 +4,17 @@ Headline: end-to-end `train` throughput (rows/sec) of the flagship NN trainer
 on a synthetic fraud-style dataset, vs the YARN-cluster-derived baseline.
 Runs on whatever jax.devices() offers (one real TPU chip under the driver).
 
+``--plane tail`` runs ONLY the disk-tail streamed-GBT benchmark (the
+out-of-core ingest path) — seconds instead of minutes, for iterating on
+the spill-cache / H2D pipeline in isolation.
+
 With SHIFU_TPU_TELEMETRY=1 the per-plane numbers also land as a telemetry
 JSONL block under ./telemetry/ (same schema as the pipeline steps — the
 schema-version handshake is enforced inside run_benchmark, which fails
 loudly on a bench/obs schema mismatch).
 """
 
+import argparse
 import json
 
 
@@ -17,7 +22,12 @@ def main() -> None:
     from shifu_tpu import obs
     from shifu_tpu.bench import run_benchmark
 
-    result = run_benchmark()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--plane", choices=("all", "tail"), default="all",
+                    help="'tail' = quick disk-tail streamed-GBT bench only")
+    args = ap.parse_args()
+
+    result = run_benchmark(plane=args.plane)
     if obs.enabled():
         obs.flush("telemetry/trace.jsonl", step="BENCH",
                   extra_meta={"headline": result["metric"]})

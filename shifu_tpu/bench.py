@@ -27,7 +27,8 @@ from . import obs
 # bench being updated (re-validated against the new field layout),
 # run_benchmark refuses to run rather than silently emitting records the
 # round's BENCH_r0N.json consumers would mis-join with telemetry traces.
-BENCH_TELEMETRY_SCHEMA = 1
+# v2: ingest.* counters (spill cache / H2D stall instrumentation).
+BENCH_TELEMETRY_SCHEMA = 2
 
 # measured on this rig (tools/measure_baseline.py); provenance in
 # BASELINE.md — every headline divides by a MEASURED reference-class
@@ -364,15 +365,62 @@ def bench_stats(chunk_rows: int = 1 << 18, n_cols: int = 256,
     return best
 
 
-def run_benchmark() -> Dict[str, Any]:
+# disk-tail forced: the budget fits ~half the 16384-row windows, the rest
+# re-streams per level — the real out-of-core configuration.  Per-window
+# accounting since r6: bins ride the compact uint8 wire INTO HBM (1 B/cell
+# instead of the old int32's 4), so a prepared GBT window is
+# W*(C*1 + 4*4) bytes (bins + y/tw/vw/f f32).
+TAIL_BENCH_BUDGET = 2 * 16384 * (64 * 1 + 4 * 4)
+
+
+def bench_gbt_streamed_tail() -> float:
+    """The disk-tail quick mode (`bench.py --plane tail`): small forest,
+    budget forces half the windows to re-stream from disk per level —
+    isolates the out-of-core ingest path the spill cache + pipelined H2D
+    prep exist for."""
+    return bench_gbt_streamed(n_rows=1 << 16, n_trees=4,
+                              cache_budget=TAIL_BENCH_BUDGET)
+
+
+def _check_schema_handshake() -> None:
     if BENCH_TELEMETRY_SCHEMA != obs.SCHEMA_VERSION:
         raise RuntimeError(
             f"bench telemetry schema v{BENCH_TELEMETRY_SCHEMA} disagrees "
             f"with shifu_tpu.obs SCHEMA_VERSION v{obs.SCHEMA_VERSION} — "
             "update bench.py's per-plane metric emission for the new "
             "schema and bump BENCH_TELEMETRY_SCHEMA")
+
+
+def run_benchmark(plane: str = None) -> Dict[str, Any]:
+    """Full sweep by default; ``plane="tail"`` runs ONLY the disk-tail
+    streamed-GBT benchmark (seconds, not minutes) so the out-of-core
+    path can be iterated on in isolation."""
+    _check_schema_handshake()
     if obs.enabled():
         obs.ensure_compile_listener()
+    if plane == "tail":
+        with obs.span("bench.gbt_train_throughput_streamed_tail",
+                      kind="bench"):
+            v = bench_gbt_streamed_tail()
+        obs.gauge("bench.gbt_train_throughput_streamed_tail").set(v)
+        obs.gauge("bench.gbt_train_throughput_streamed_tail_vs_baseline") \
+            .set(v / BASELINE_TREE_RATE)
+        return {
+            "metric": "gbt_train_throughput_streamed_tail",
+            "value": round(v, 1),
+            "unit": "rows*trees/sec",
+            "plane": "tail",
+            "telemetry_schema_version": BENCH_TELEMETRY_SCHEMA,
+            "vs_baseline": round(v / BASELINE_TREE_RATE, 3),
+            "baseline_rows_per_sec": BASELINE_TREE_RATE,
+            "baseline_provenance": "measured 43068.1 rows*trees/s/worker "
+                                   "np.add.at hist GBT on this rig x 100 "
+                                   "north-star workers (BASELINE.md)",
+            "shape": "65536 rows x 4 trees, budget forces disk tail "
+                     "(uint8-resident accounting since r6)",
+        }
+    if plane not in (None, "all"):
+        raise ValueError(f"unknown bench plane {plane!r} (tail|all)")
     nn_rows_per_sec = bench_nn()
     obs.gauge("bench.nn_train_throughput").set(nn_rows_per_sec)
     extras: Dict[str, Any] = {}
@@ -394,12 +442,7 @@ def run_benchmark() -> Dict[str, Any]:
     record("gbt_train_throughput_resident", bench_gbt, BASELINE_TREE_RATE)
     record("gbt_train_throughput_streamed", bench_gbt_streamed,
            BASELINE_TREE_RATE)
-    # disk-tail forced: budget fits ~half the 16384-row windows, the rest
-    # re-streams per level — the real out-of-core configuration
-    tail_budget = 2 * 16384 * (64 * 4 + 4 * 4)
-    record("gbt_train_throughput_streamed_tail",
-           lambda: bench_gbt_streamed(n_rows=1 << 16, n_trees=4,
-                                      cache_budget=tail_budget),
+    record("gbt_train_throughput_streamed_tail", bench_gbt_streamed_tail,
            BASELINE_TREE_RATE)
     record("rf_train_throughput", bench_rf, BASELINE_TREE_RATE)
     record("wdl_train_throughput", bench_wdl, BASELINE_ROWS_PER_SEC)
@@ -411,7 +454,9 @@ def run_benchmark() -> Dict[str, Any]:
                     "a real default train amortizes)",
         "gbt_resident": "131072 rows x 100 trees (since r5; was x 32 — "
                         "100 = the default TreeNum)",
-        "tail": "65536 rows x 4 trees, budget forces disk tail"}
+        "tail": "65536 rows x 4 trees, budget forces disk tail (uint8-"
+                "resident bins accounting since r6; warm pass builds the "
+                "mmap spill cache, tail sweeps re-read it zero-decode)"}
     extras["baselines"] = {
         "tree_rows_trees_per_sec_per_worker":
             MEASURED_CPU_TREE_ROWS_TREES_PER_SEC,

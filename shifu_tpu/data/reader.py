@@ -213,10 +213,17 @@ class DataSource:
 
     def iter_chunks(self, chunk_rows: int = 262144) -> Iterator[RawChunk]:
         """Yield RawChunks of up to ``chunk_rows`` rows across all files."""
+        from .. import obs
+        bytes_c = obs.counter("ingest.bytes_read")
         if self.parquet:
             yield from self._iter_parquet(chunk_rows)
             return
         for path in self.files:
+            try:                  # raw ingest accounting (stats/norm plane)
+                if not _is_remote(path):
+                    bytes_c.inc(os.path.getsize(path))
+            except OSError:
+                pass
             reader = pd.read_csv(
                 path, sep=self.delimiter, engine="c", header=None,
                 names=self.header, dtype=str, chunksize=chunk_rows,

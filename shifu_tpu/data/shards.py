@@ -4,10 +4,48 @@ from __future__ import annotations
 
 import json
 import os
-from dataclasses import dataclass
+import zipfile
+from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
 
 import numpy as np
+
+# sidecar manifest of per-shard row counts (written on first scan; the
+# norm step writes the counts straight into schema.json as "shardRows",
+# so materialized datasets never scan at all)
+ROWS_SIDECAR = ".shard_rows.json"
+
+
+def bins_wire_dtype(n_bins: int) -> np.dtype:
+    """The ONE compact storage/wire dtype policy for bin ids 0..n_bins-1:
+    norm shards, the spill cache and the host→device transfer all use it
+    (the reference stores worker rows as short[] bin ids,
+    ``DTWorker.java:100`` — f32/int32 on the wire is pure waste)."""
+    if n_bins <= 1 << 8:
+        return np.dtype(np.uint8)
+    if n_bins <= 1 << 16:
+        return np.dtype(np.uint16)
+    return np.dtype(np.int32)
+
+
+def _npz_rows(path: str) -> int:
+    """Row count of one npz shard WITHOUT decoding any array: read the
+    npy header of one member through the zip directory.  Falls back to a
+    full load on any format surprise."""
+    try:
+        from numpy.lib import format as npf
+        with zipfile.ZipFile(path) as z:
+            names = z.namelist()
+            name = "y.npy" if "y.npy" in names else names[0]
+            with z.open(name) as f:
+                ver = npf.read_magic(f)
+                if ver == (1, 0):
+                    shape, _, _ = npf.read_array_header_1_0(f)
+                else:
+                    shape, _, _ = npf.read_array_header_2_0(f)
+                return int(shape[0]) if shape else 0
+    except Exception:
+        return int(len(np.load(path)["y"]))
 
 
 @dataclass
@@ -15,6 +53,8 @@ class Shards:
     directory: str
     schema: dict
     files: List[str]
+    _shard_rows: Optional[List[int]] = field(default=None, repr=False,
+                                             compare=False)
 
     @classmethod
     def open(cls, directory: str) -> "Shards":
@@ -34,6 +74,53 @@ class Shards:
             raise FileNotFoundError(f"no shards in {self.directory}")
         return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
 
+    def _sidecar_sig(self) -> List[List]:
+        return [[os.path.basename(f), os.path.getsize(f)]
+                for f in self.files]
+
+    @property
+    def shard_rows(self) -> List[int]:
+        """Per-shard row counts without decoding shards: schema
+        ``shardRows`` (norm writes it), else the sidecar manifest, else a
+        one-time npy-header scan persisted back to the sidecar."""
+        if self._shard_rows is not None:
+            return self._shard_rows
+        sr = self.schema.get("shardRows")
+        if isinstance(sr, list) and len(sr) == len(self.files):
+            self._shard_rows = [int(x) for x in sr]
+            return self._shard_rows
+        side = os.path.join(self.directory, ROWS_SIDECAR)
+        sig = self._sidecar_sig()
+        try:
+            with open(side) as f:
+                d = json.load(f)
+            if d.get("source") == sig and len(d.get("rows", [])) == \
+                    len(self.files):
+                self._shard_rows = [int(x) for x in d["rows"]]
+                return self._shard_rows
+        except (OSError, ValueError):
+            pass
+        rows = [_npz_rows(f) for f in self.files]
+        try:                       # best effort: dir may be read-only
+            tmp = side + f".tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"source": sig, "rows": rows}, f)
+            os.replace(tmp, side)
+        except OSError:
+            pass
+        self._shard_rows = rows
+        return rows
+
     @property
     def num_rows(self) -> int:
-        return sum(len(np.load(f)["y"]) for f in self.files)
+        return sum(self.shard_rows)
+
+    def source_signature(self) -> List[List]:
+        """[(name, size, mtime_ns)] identity of the shard set — the spill
+        cache's staleness check (re-running norm rewrites files and
+        invalidates any spill built over them)."""
+        out = []
+        for f in self.files:
+            st = os.stat(f)
+            out.append([os.path.basename(f), st.st_size, st.st_mtime_ns])
+        return out

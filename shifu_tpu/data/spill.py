@@ -1,0 +1,303 @@
+"""Binned spill cache — mmap-backed re-read path for shard streams.
+
+The out-of-core tree/stats planes sweep the same materialized shards many
+times per forest ((depth+2) level sweeps x trees for the GBT disk tail).
+The npz container makes every one of those sweeps a zip decode on a single
+thread.  The reference system never paid that: ``MemoryDiskFloatMLDataSet``
+(``core/dtrain/dataset/MemoryDiskFloatMLDataSet.java:315-361``) fills a
+heap tier once and spills the remainder to a FLAT row file it re-reads
+directly on every subsequent iterator chain.
+
+This module is that spill tier, columnar: on the first full pass over a
+shard stream the selected columns land in flat raw files (one per key)
+next to a sidecar ``manifest.json`` (row counts per shard, dtypes,
+trailing shapes, source signature).  Every later sweep is ``np.memmap``
+slicing — zero zip/npz decode, zero host copies until the bytes are
+actually consumed (typically by ``jax.device_put``).
+
+Layout under ``<shards dir>/.spill_cache/spill-<keys>/``::
+
+    manifest.json      commit point; see MANIFEST_* fields below
+    <key>.raw          rows-major flat array, dtype/shape from manifest
+
+Integer columns (bin ids) are narrowed to the smallest unsigned dtype the
+data fits (uint8 for <=256 bins) — the same compact wire format the
+trainers ship to the device, so a spill window's bins transfer without a
+single host-side cast or copy.
+
+Knobs (``config.environment`` properties / ``SHIFU_*`` env):
+
+- ``shifu.stream.spill``            on/off (default on)
+- ``shifu.stream.spillBudgetBytes`` cap on raw-file bytes (default 8 GiB;
+  a stream larger than the budget streams npz as before — the manifest
+  records the abort so later epochs don't retry the write)
+- ``shifu.stream.spillDir``         base directory override (default: the
+  shard directory itself)
+
+Staleness: the manifest pins ``(basename, size, mtime_ns)`` of every
+source npz; any mismatch invalidates the spill and the next pass rebuilds
+it.  Writers commit via tmp-file + ``os.replace`` with the manifest last,
+so readers never observe a torn cache.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MANIFEST = "manifest.json"
+SPILL_FORMAT_VERSION = 1
+
+_tmp_lock = threading.Lock()
+_tmp_seq = 0
+
+
+def _tmp_suffix() -> str:
+    """Process-unique temp suffix (two concurrent streams in one pid must
+    not append to each other's raw files)."""
+    global _tmp_seq
+    with _tmp_lock:
+        _tmp_seq += 1
+        return f".tmp-{os.getpid()}-{_tmp_seq}"
+
+
+def spill_enabled() -> bool:
+    from ..config import environment
+    return environment.get_bool("shifu.stream.spill", True)
+
+
+def spill_budget_bytes() -> int:
+    from ..config import environment
+    return environment.get_int("shifu.stream.spillBudgetBytes", 1 << 33)
+
+
+def spill_base_dir(shards_dir: str) -> str:
+    from ..config import environment
+    base = environment.get_property("shifu.stream.spillDir") or shards_dir
+    return os.path.join(base, ".spill_cache")
+
+
+def spill_dir_for(shards_dir: str, keys: Sequence[str]) -> str:
+    return os.path.join(spill_base_dir(shards_dir),
+                        "spill-" + "-".join(keys))
+
+
+def _narrow_int_dtype(a: np.ndarray) -> np.dtype:
+    """Storage dtype for one column: integers narrow to the smallest
+    unsigned type the observed values fit (the compact wire format);
+    floats store as-is."""
+    if a.dtype.kind in "iu" and a.size:
+        lo, hi = int(a.min()), int(a.max())
+        if lo >= 0:
+            if hi < 1 << 8:
+                return np.dtype(np.uint8)
+            if hi < 1 << 16:
+                return np.dtype(np.uint16)
+    return a.dtype
+
+
+class SpillWriter:
+    """Write-through spill built during one cold pass over the shards.
+
+    ``append`` per shard in order; ``finish`` commits (raw renames, then
+    the manifest — the commit point); ``abort`` discards, optionally
+    leaving an ``aborted`` marker so later passes skip the write (budget
+    overflow would just recur)."""
+
+    def __init__(self, directory: str, keys: Sequence[str], source_sig,
+                 budget_bytes: int):
+        self.directory = directory
+        self.keys = tuple(keys)
+        self.sig = source_sig
+        self.budget = int(budget_bytes)
+        self._suffix = _tmp_suffix()
+        self._files: Dict[str, object] = {}
+        self._dtypes: Dict[str, np.dtype] = {}
+        self._shapes: Dict[str, Tuple[int, ...]] = {}
+        self._row_bytes = 0
+        self._rows = 0
+        self._bytes = 0
+        self._shard_rows: List[int] = []
+        self._dead = False
+        os.makedirs(directory, exist_ok=True)
+
+    def _raw_path(self, key: str) -> str:
+        return os.path.join(self.directory, key + ".raw")
+
+    def append(self, part: Dict[str, np.ndarray]) -> bool:
+        """Append one shard's selected columns.  Returns False once the
+        spill is abandoned (budget / dtype overflow / IO error) — the
+        caller keeps streaming npz, unaffected."""
+        if self._dead:
+            return False
+        try:
+            n = int(len(next(iter(part.values()))))
+            if not self._files:
+                for k in self.keys:
+                    a = np.asarray(part[k])
+                    self._dtypes[k] = _narrow_int_dtype(a)
+                    self._shapes[k] = tuple(a.shape[1:])
+                self._row_bytes = sum(
+                    int(np.prod(self._shapes[k], dtype=np.int64))
+                    * self._dtypes[k].itemsize for k in self.keys)
+                for k in self.keys:
+                    self._files[k] = open(self._raw_path(k) + self._suffix,
+                                          "wb")
+            if self._bytes + n * self._row_bytes > self.budget:
+                self.abort(mark=f"budget {self.budget} bytes exceeded at "
+                                f"row {self._rows}")
+                return False
+            for k in self.keys:
+                a = np.ascontiguousarray(np.asarray(part[k]))
+                dt = self._dtypes[k]
+                if a.dtype != dt:
+                    if a.size and dt.kind == "u" and (
+                            int(a.min()) < 0
+                            or int(a.max()) >= 1 << (8 * dt.itemsize)):
+                        # a later shard outgrew the first shard's narrow
+                        # dtype — cannot widen a half-written file
+                        self.abort(mark=f"column {k!r} outgrew "
+                                        f"{dt.name} mid-stream")
+                        return False
+                    a = a.astype(dt)
+                a.tofile(self._files[k])
+            self._rows += n
+            self._bytes += n * self._row_bytes
+            self._shard_rows.append(n)
+            return True
+        except OSError:
+            self.abort()
+            return False
+
+    def finish(self) -> bool:
+        """Commit the completed spill (the pass reached the dataset end)."""
+        if self._dead:
+            return False
+        try:
+            for f in self._files.values():
+                f.close()
+            for k in self._files:
+                os.replace(self._raw_path(k) + self._suffix,
+                           self._raw_path(k))
+            man = {"version": SPILL_FORMAT_VERSION,
+                   "keys": list(self.keys),
+                   "dtypes": {k: self._dtypes[k].str for k in self._files},
+                   "shapes": {k: list(self._shapes[k]) for k in self._files},
+                   "rows": self._rows,
+                   "shard_rows": self._shard_rows,
+                   "bytes": self._bytes,
+                   "source": self.sig}
+            self._write_manifest(man)
+            self._dead = True
+            return True
+        except OSError:
+            self.abort()
+            return False
+
+    def abort(self, mark: Optional[str] = None) -> None:
+        """Drop the half-written spill.  ``mark`` records a permanent
+        reason (budget/dtype) so later passes don't re-attempt; an
+        unmarked abort (consumer abandoned the stream) leaves nothing and
+        the next full pass retries."""
+        if self._dead:
+            return
+        self._dead = True
+        for f in self._files.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        for k in self._files:
+            try:
+                os.remove(self._raw_path(k) + self._suffix)
+            except OSError:
+                pass
+        if mark:
+            try:
+                self._write_manifest({"version": SPILL_FORMAT_VERSION,
+                                      "keys": list(self.keys),
+                                      "aborted": mark,
+                                      "source": self.sig})
+            except OSError:
+                pass
+
+    def _write_manifest(self, man: dict) -> None:
+        tmp = os.path.join(self.directory, MANIFEST + self._suffix)
+        with open(tmp, "w") as f:
+            json.dump(man, f)
+        os.replace(tmp, os.path.join(self.directory, MANIFEST))
+
+
+class SpillReader:
+    """mmap view over a committed spill."""
+
+    def __init__(self, directory: str, man: dict):
+        self.directory = directory
+        self.man = man
+        self.keys = tuple(man["keys"])
+        self.rows = int(man["rows"])
+        self.shard_rows = [int(x) for x in man["shard_rows"]]
+        # prefix sums: cum[i] = global row of shard i's first row
+        self.cum = np.concatenate(
+            [[0], np.cumsum(self.shard_rows)]).astype(np.int64)
+        self._mms: Dict[str, np.memmap] = {}
+
+    def memmap(self, key: str) -> np.memmap:
+        mm = self._mms.get(key)
+        if mm is None:
+            dt = np.dtype(self.man["dtypes"][key])
+            shape = (self.rows,) + tuple(self.man["shapes"][key])
+            mm = np.memmap(os.path.join(self.directory, key + ".raw"),
+                           dtype=dt, mode="r", shape=shape)
+            self._mms[key] = mm
+        return mm
+
+    def global_of(self, shard: int, offset: int) -> Optional[int]:
+        """Global row index of (shard, row offset); None when the request
+        falls outside what the manifest covers."""
+        if not 0 <= shard < len(self.shard_rows):
+            return None
+        g = int(self.cum[shard]) + int(offset)
+        return g if 0 <= g <= self.rows else None
+
+    def src_of(self, g: int) -> Tuple[int, int]:
+        """(shard idx, row offset) of global row ``g`` — the inverse of
+        :meth:`global_of`, matching the npz stream's per-window ``src``
+        bookkeeping exactly (zero-row shards are skipped the same way)."""
+        si = int(np.searchsorted(self.cum, g, side="right") - 1)
+        return si, int(g - self.cum[si])
+
+
+def open_spill(directory: str, keys: Sequence[str],
+               source_sig) -> Tuple[Optional[SpillReader], bool]:
+    """(reader, writable): ``reader`` is a valid committed spill or None;
+    ``writable`` says whether a cold pass should (re)build one — False
+    when a marker records a permanent abort for this exact source."""
+    path = os.path.join(directory, MANIFEST)
+    try:
+        with open(path) as f:
+            man = json.load(f)
+    except (OSError, ValueError):
+        return None, True
+    if man.get("version") != SPILL_FORMAT_VERSION \
+            or list(man.get("keys") or []) != list(keys) \
+            or man.get("source") != source_sig:
+        return None, True                      # stale / other keyset
+    if man.get("aborted"):
+        return None, False
+    try:
+        rows = int(man["rows"])
+        for k in keys:
+            dt = np.dtype(man["dtypes"][k])
+            need = rows * int(np.prod(man["shapes"][k] or [1],
+                                      dtype=np.int64)) * dt.itemsize
+            if rows and os.path.getsize(
+                    os.path.join(directory, k + ".raw")) < need:
+                return None, True              # torn raw file
+        return SpillReader(directory, man), False
+    except (OSError, KeyError, ValueError, TypeError):
+        return None, True

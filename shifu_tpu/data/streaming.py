@@ -17,14 +17,37 @@ replacing the reference's load-time per-record assignment
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import obs
 from .shards import Shards
+
+
+def stream_prefetch_depth(override=None) -> int:
+    """Prefetch/pipeline depth for shard streams: explicit override >
+    env ``SHIFU_TPU_PREFETCH`` > property ``-Dshifu.stream.prefetch=N``
+    > default 2.  Depth bounds both the shard read-ahead queue and the
+    prepared-window (H2D double-buffer) queue."""
+    if override is not None:
+        try:
+            return max(0, int(override))
+        except (TypeError, ValueError):
+            pass
+    v = os.environ.get("SHIFU_TPU_PREFETCH")
+    if v:
+        try:
+            return max(0, int(v))
+        except ValueError:
+            pass
+    from ..config import environment
+    return max(0, environment.get_int("shifu.stream.prefetch", 2))
 
 # ------------------------------------------------------------ hash uniforms
 _U64 = np.uint64
@@ -120,27 +143,77 @@ class Window:
 
 
 class ShardStream:
-    """Windowed, prefetching iterator over npz shards.
+    """Windowed, prefetching iterator over npz shards — with an mmap
+    spill-cache fast path for every sweep after the first.
 
     - ``window_rows`` fixes every emitted window's row count (jit-stable
       shapes; the last window is zero-padded).
-    - a daemon thread reads shard files ahead into a bounded queue
-      (``prefetch`` deep) so disk IO overlaps device compute.
+    - the FIRST full pass reads npz on a daemon thread (a bounded queue
+      ``prefetch`` deep overlaps disk IO with consumption) and spills the
+      selected columns into flat raw files (:mod:`shifu_tpu.data.spill`);
+      every later pass — including the ResidentCache's per-level tail
+      re-streams — is pure ``np.memmap`` slicing: no zip decode, no
+      reader thread, no copies until the bytes are consumed.
     - ``keys`` selects which arrays to materialize (e.g. ``("x","y","w")``
-      for the NN path, ``("bins","y","w")`` for trees).
+      for the NN path, ``("bins","y","w")`` for trees).  Integer columns
+      re-emerge from the spill in the compact wire dtype (uint8 for
+      <=256 bins) — values identical, 2-4x fewer bytes touched.
     """
 
     def __init__(self, shards: Shards, keys: Sequence[str],
-                 window_rows: int, prefetch: int = 2):
+                 window_rows: int, prefetch: Optional[int] = None,
+                 spill: Optional[bool] = None):
+        from .spill import spill_enabled
         assert window_rows > 0
         self.shards = shards
         self.keys = tuple(keys)
         self.window_rows = int(window_rows)
-        self.prefetch = prefetch
+        self.prefetch = stream_prefetch_depth(prefetch)
+        self.spill = spill_enabled() if spill is None else bool(spill)
+        self._spill_off = False         # sticky: aborted marker / IO error
+        self._spill_rd = None           # validated SpillReader
 
-    # background shard reader
+    # ------------------------------------------------------ spill plumbing
+    def _spill_dir(self) -> str:
+        from .spill import spill_dir_for
+        return spill_dir_for(self.shards.directory, self.keys)
+
+    def _spill_reader(self):
+        if not self.spill or self._spill_off:
+            return None
+        if self._spill_rd is not None:
+            return self._spill_rd
+        from .spill import open_spill
+        try:
+            rd, writable = open_spill(self._spill_dir(), self.keys,
+                                      self.shards.source_signature())
+        except OSError:
+            self._spill_off = True
+            return None
+        if rd is not None:
+            self._spill_rd = rd
+        elif not writable:
+            self._spill_off = True      # permanent abort marker on disk
+        return rd
+
+    def _spill_writer(self):
+        """A writer for the cold pass, or None (disabled / already built /
+        permanently aborted)."""
+        if not self.spill or self._spill_off or self._spill_rd is not None:
+            return None
+        from .spill import SpillWriter, spill_budget_bytes
+        try:
+            return SpillWriter(self._spill_dir(), self.keys,
+                               self.shards.source_signature(),
+                               spill_budget_bytes())
+        except OSError:
+            self._spill_off = True
+            return None
+
+    # background shard reader (cold npz path); the spill write-through
+    # happens HERE, off the consumer's critical path
     def _reader(self, q: "queue.Queue", stop: threading.Event,
-                start_shard: int, shard_offset: int) -> None:
+                start_shard: int, shard_offset: int, writer=None) -> None:
         def put(item) -> bool:
             while not stop.is_set():
                 try:
@@ -152,24 +225,72 @@ class ShardStream:
         try:
             for si, part in enumerate(self.shards.iter_shards(start_shard)):
                 item = {k: part[k] for k in self.keys}
+                if writer is not None and not writer.append(item):
+                    writer = None             # abandoned; keep streaming
                 if si == 0 and shard_offset:
                     item = {k: v[shard_offset:] for k, v in item.items()}
                 if not put((start_shard + si, shard_offset if si == 0 else 0,
                             item)):
-                    return                    # consumer abandoned mid-epoch
+                    if writer is not None:
+                        writer.abort()        # consumer abandoned mid-epoch
+                    return
+            if writer is not None:
+                writer.finish()
             put(None)
         except BaseException as e:  # surface IO errors on the consumer side
+            if writer is not None:
+                writer.abort()
             put(e)
 
     def windows(self, start_shard: int = 0, shard_offset: int = 0,
                 start_row: int = 0) -> Iterator[Window]:
         """Window the shard sequence.  The three offsets resume mid-dataset
         (the ResidentCache tail: skip fully-cached shard files entirely,
-        slice into the first partial one, keep global row ids aligned)."""
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        slice into the first partial one, keep global row ids aligned).
+        A committed spill serves the whole call by mmap slicing."""
+        rd = self._spill_reader()
+        if rd is not None:
+            g0 = rd.global_of(start_shard, shard_offset)
+            if g0 is not None:
+                obs.counter("ingest.spill_hits").inc()
+                yield from self._windows_mmap(rd, g0, start_row)
+                return
+        obs.counter("ingest.spill_misses").inc()
+        yield from self._windows_npz(start_shard, shard_offset, start_row)
+
+    def _windows_mmap(self, rd, g0: int, start_row: int) -> Iterator[Window]:
+        """Serve windows as raw-file slices — the hot path for every sweep
+        after the first (src/start bookkeeping identical to the npz path,
+        so ResidentCache tail resumes are oblivious to which path ran)."""
+        W = self.window_rows
+        if rd.rows <= g0:
+            return
+        mms = {k: rd.memmap(k) for k in self.keys}
+        bytes_c = obs.counter("ingest.bytes_read")
+        win_c = obs.counter("ingest.windows_emitted")
+        start, g = start_row, g0
+        while g < rd.rows:
+            e = min(g + W, rd.rows)
+            arrays = {k: np.asarray(mms[k][g:e]) for k in self.keys}
+            nv = e - g
+            if nv < W:
+                arrays = {k: _pad_rows(a, W) for k, a in arrays.items()}
+            bytes_c.inc(sum(a.nbytes for a in arrays.values()))
+            win_c.inc()
+            yield Window(start=start, n_valid=nv, arrays=arrays,
+                         src=rd.src_of(g))
+            start += W
+            g += W
+
+    def _windows_npz(self, start_shard: int = 0, shard_offset: int = 0,
+                     start_row: int = 0) -> Iterator[Window]:
+        writer = self._spill_writer() \
+            if (start_shard == 0 and shard_offset == 0) else None
+        q: "queue.Queue" = queue.Queue(maxsize=max(1, self.prefetch))
         stop = threading.Event()
         t = threading.Thread(target=self._reader,
-                             args=(q, stop, start_shard, shard_offset),
+                             args=(q, stop, start_shard, shard_offset,
+                                   writer),
                              daemon=True)
         t.start()
         try:
@@ -180,6 +301,8 @@ class ShardStream:
             buffered = 0
             start = start_row
             W = self.window_rows
+            bytes_c = obs.counter("ingest.bytes_read")
+            win_c = obs.counter("ingest.windows_emitted")
 
             def consume(rows: int) -> Tuple[int, int]:
                 """Pop ``rows`` rows off the source list; return the (shard,
@@ -212,21 +335,115 @@ class ShardStream:
                 buffered += n
                 while buffered >= W:
                     arrays, buf, buffered = _take(buf, W, self.keys)
+                    bytes_c.inc(sum(a.nbytes for a in arrays.values()))
+                    win_c.inc()
                     yield Window(start=start, n_valid=W, arrays=arrays,
                                  src=consume(W))
                     start += W
             if buffered:
                 arrays, buf, _ = _take(buf, buffered, self.keys)
+                arrays = {k: _pad_rows(a, W) for k, a in arrays.items()}
+                bytes_c.inc(sum(a.nbytes for a in arrays.values()))
+                win_c.inc()
                 yield Window(start=start, n_valid=buffered,
-                             arrays={k: _pad_rows(a, W)
-                                     for k, a in arrays.items()},
-                             src=consume(buffered))
+                             arrays=arrays, src=consume(buffered))
         finally:
             # unblock + retire the reader even when the generator is
             # abandoned mid-iteration (jit error, early stop, interrupt);
             # JOIN it so no daemon thread survives into interpreter
             # shutdown (a live thread racing stdio finalization is a
             # "Fatal Python error: _enter_buffered_busy" waiting to happen)
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5.0)
+
+    def prepared(self, prepare: Callable[["Window"], "PreparedWindow"],
+                 start_shard: int = 0, shard_offset: int = 0,
+                 start_row: int = 0,
+                 depth: Optional[int] = None) -> Iterator["PreparedWindow"]:
+        """Pipelined window prep + H2D double-buffering: window assembly
+        AND the trainer's ``prepare`` hook (hash masks, host stacking,
+        ``jax.device_put``) run on a background thread, ``depth`` windows
+        ahead of the consumer — the put for window N+1 is issued while
+        window N's executable runs, so the fixed per-put protocol cost
+        and host prep overlap device compute instead of serializing with
+        it (the TF-sys / sync-SGD input-pipelining prescription).
+
+        ``depth=None`` uses the stream's prefetch depth; ``depth<=0``
+        runs inline (multi-device CPU meshes must stay inline: a second
+        thread dispatching collective programs can interleave two mesh
+        programs, the known XLA:CPU rendezvous deadlock).  Time the
+        consumer spends blocked on the queue lands in the
+        ``ingest.h2d_wait_seconds`` counter — the ingest stall the
+        telemetry report surfaces."""
+        depth = self.prefetch if depth is None else int(depth)
+
+        def _prep(win: "Window") -> "PreparedWindow":
+            item = prepare(win)
+            if getattr(item, "src", None) is None:
+                try:
+                    item.src = win.src    # tail bookkeeping (ResidentCache)
+                except AttributeError:
+                    pass
+            return item
+
+        if depth <= 0:
+            # inline: every second of window fetch + prep IS consumer
+            # stall — record it so the report's stall line still reads
+            # true on rigs that must prep inline (multi-device CPU mesh)
+            wait_c = obs.counter("ingest.h2d_wait_seconds")
+            it = self.windows(start_shard, shard_offset, start_row)
+            while True:
+                t0 = time.perf_counter()
+                win = next(it, None)
+                if win is None:
+                    return
+                item = _prep(win)
+                wait_c.inc(time.perf_counter() - t0)
+                yield item
+            return
+
+        q: "queue.Queue" = queue.Queue(maxsize=depth)
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker() -> None:
+            try:
+                for win in self.windows(start_shard, shard_offset,
+                                        start_row):
+                    if not put(_prep(win)):
+                        return
+                put(None)
+            except BaseException as e:
+                put(e)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        wait_s = 0.0
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = q.get()
+                wait_s += time.perf_counter() - t0
+                if isinstance(item, BaseException):
+                    raise item
+                if item is None:
+                    break
+                yield item
+        finally:
+            obs.counter("ingest.h2d_wait_seconds").inc(wait_s)
             stop.set()
             try:
                 while True:
@@ -262,13 +479,16 @@ def _pad_rows(a: np.ndarray, rows: int) -> np.ndarray:
 @dataclass
 class PreparedWindow:
     """A window after the trainer's ``prepare`` hook — arrays may live on
-    device (sharded over a mesh) or host."""
+    device (sharded over a mesh) or host.  ``src`` is filled in by
+    ``ShardStream.prepared`` / ``ResidentCache`` from the source window
+    (tail resume bookkeeping); hooks need not set it."""
     start: int
     n_valid: int
     rows: int
     index: np.ndarray
     arrays: Dict[str, object]
     resident: bool = False
+    src: Optional[Tuple[int, int]] = None
 
     @property
     def nbytes(self) -> int:
@@ -287,44 +507,57 @@ class ResidentCache:
     ZERO disk passes after the single warm pass — the round-2 design's
     (depth+2) full re-reads collapse to ~1/forest.  ``disk_passes`` counts
     actual stream traversals for tests/telemetry.
-    """
+
+    Window prep runs through ``ShardStream.prepared`` (assembly + masks +
+    ``device_put`` pipelined ``pipeline_depth`` windows ahead on a
+    background thread); resident windows keep their device buffers — and
+    any per-row state the trainer attaches (GBT scores ``f``, RF oob
+    votes) — alive across every subsequent sweep.  ``pipeline_depth=0``
+    forces inline prep (required on multi-device CPU meshes, see
+    ``ShardStream.prepared``)."""
 
     def __init__(self, stream: "ShardStream", budget_bytes: int,
-                 prepare: Callable[[Window], PreparedWindow]):
+                 prepare: Callable[[Window], PreparedWindow],
+                 pipeline_depth: Optional[int] = None):
         self.stream = stream
         self.budget = int(budget_bytes)
         self.prepare = prepare
+        self.pipeline_depth = pipeline_depth
         self.cached: list = []
         self.tail: Optional[Tuple[int, int, int]] = None  # shard, offset, row
         self.disk_passes = 0
         self._warm = False
+
+    def _prepared(self, start_shard: int = 0, shard_offset: int = 0,
+                  start_row: int = 0) -> Iterator[PreparedWindow]:
+        return self.stream.prepared(self.prepare, start_shard, shard_offset,
+                                    start_row, depth=self.pipeline_depth)
 
     def items(self) -> Iterator[PreparedWindow]:
         if not self._warm:
             used = 0
             caching = True
             self.disk_passes += 1
-            for win in self.stream.windows():
-                item = self.prepare(win)
+            obs.counter("ingest.disk_passes").inc()
+            for item in self._prepared():
                 if caching and used + item.nbytes <= self.budget:
                     item.resident = True
                     self.cached.append(item)
                     used += item.nbytes
                 elif caching:
                     caching = False
-                    self.tail = (win.src[0], win.src[1], win.start) \
-                        if win.src else (0, 0, 0)
+                    self.tail = (item.src[0], item.src[1], item.start) \
+                        if item.src else (0, 0, 0)
                 yield item
             self._warm = True
         else:
             yield from self.cached
             if self.tail is not None:
                 self.disk_passes += 1
+                obs.counter("ingest.disk_passes").inc()
                 sh, off, row = self.tail
-                for win in self.stream.windows(start_shard=sh,
-                                               shard_offset=off,
-                                               start_row=row):
-                    yield self.prepare(win)
+                yield from self._prepared(start_shard=sh, shard_offset=off,
+                                          start_row=row)
 
     @property
     def resident_rows(self) -> int:

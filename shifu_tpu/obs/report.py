@@ -127,6 +127,16 @@ def _render_block(block: Dict[str, Any], out: List[str]) -> float:
         else:
             out.append(f"  metric {m['name']}: {_num(m.get('value'))} "
                        f"({m['type']})")
+    # ingest stall: fraction of the step's wall-clock the consumer spent
+    # blocked waiting for windows/H2D (the accelerator-starvation signal
+    # the out-of-core overhaul exists to drive toward zero)
+    wait = next((m.get("value") for m in block["metrics"]
+                 if m.get("name") == "ingest.h2d_wait_seconds"), None)
+    if wait is not None and total > 0:
+        frac = min(float(wait) / total, 1.0)
+        out.append(f"  ingest stall fraction: {frac:.1%} "
+                   f"({float(wait):.3f}s blocked on ingest of "
+                   f"{total:.3f}s wall)")
     return total
 
 

@@ -31,7 +31,10 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-SCHEMA_VERSION = 1
+# v2: ingest instrumentation (ingest.bytes_read / windows_emitted /
+# h2d_wait_seconds / disk_passes / spill_hits / spill_misses counters;
+# the report's "ingest stall fraction" line derives from them)
+SCHEMA_VERSION = 2
 
 _TRUE = ("1", "true", "on", "yes")
 

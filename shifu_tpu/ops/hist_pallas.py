@@ -161,7 +161,8 @@ def build_histograms_pallas(bins, node_idx, stats, n_nodes: int,
     ``node_idx`` by the partition base makes out-of-range rows match no
     one-hot row, so each call accumulates exactly its node range.
     """
-    if n_nodes > K_MAX:
+    bins = bins.astype(jnp.int32)   # narrow-wire (uint8/uint16) bins widen
+    if n_nodes > K_MAX:             # here; Mosaic sees the one int32 layout
         parts = []
         for k0 in range(0, n_nodes, K_MAX):
             parts.append(build_histograms_pallas(

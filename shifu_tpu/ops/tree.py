@@ -111,7 +111,8 @@ def _level_select(bins, node, feat, lmask):
         return node_feat, _goes_left(lmask, oh, row_bin)
     node_feat = feat[node]
     row_bin = jnp.take_along_axis(
-        bins, jnp.maximum(node_feat, 0)[:, None], axis=1)[:, 0]
+        bins, jnp.maximum(node_feat, 0)[:, None],
+        axis=1)[:, 0].astype(jnp.int32)    # bins may ride the narrow wire
     return node_feat, lmask[node, row_bin]
 
 
@@ -140,9 +141,12 @@ def build_histograms(bins, node_idx, stats, n_nodes: int, n_bins: int,
                      stats_exact: bool = False):
     """Per-row stats into (node, feature, bin) cells.
 
-    bins: [N, C] int32; node_idx: [N] int32 level-local (-1 = inactive);
-    stats: [N, S] float32 (S stat channels: [w, w*y] for binary/regression
-    trees; per-class weight counts for multiclass).
+    bins: [N, C] any integer dtype — the trainers keep bins in the compact
+    uint8/uint16 wire format all the way into HBM (4x the resident-cache
+    capacity of int32); the widen to int32 happens here, in-graph, where
+    XLA fuses it into the first consumer.  node_idx: [N] int32 level-local
+    (-1 = inactive); stats: [N, S] float32 (S stat channels: [w, w*y] for
+    binary/regression trees; per-class weight counts for multiclass).
     Returns [n_nodes, C, n_bins, S].
 
     Two lowerings: ``use_pallas=True`` → MXU one-hot-matmul kernel
@@ -155,6 +159,7 @@ def build_histograms(bins, node_idx, stats, n_nodes: int, n_bins: int,
     integer bag counts x 0/1 targets — RF without a weight column): the
     kernel skips its f32-recovery dots, ~1.6x at bench shapes.
     """
+    bins = bins.astype(jnp.int32)      # no-op for int32 inputs
     if use_pallas:
         from .hist_pallas import (build_histograms_pallas,
                                   build_histograms_sharded, target_platform)

@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import shutil
 import time
 from typing import List, Optional
 
@@ -21,6 +22,7 @@ import numpy as np
 from .. import obs
 from ..config.validator import ModelStep
 from ..data import DataSource, sample_mask
+from ..data.shards import bins_wire_dtype
 from ..data.transform import DatasetTransformer
 from .processor import BasicProcessor
 
@@ -42,7 +44,18 @@ class NormalizeProcessor(BasicProcessor):
         for d in (norm_dir, clean_dir):
             os.makedirs(d, exist_ok=True)
             for f in os.listdir(d):
-                os.remove(os.path.join(d, f))
+                p = os.path.join(d, f)
+                # subdirs too: a previous train left its .spill_cache here
+                shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
+
+        # compact bins storage: the narrowest dtype the ColumnConfig bin
+        # space fits (uint8 for <=256 bins) — the same wire format the
+        # trainers ship to the device, so clean shards decode AND transfer
+        # without a cast
+        n_bins = max((c.num_bins() + 1 for c in transformer.columns),
+                     default=2)
+        self._bins_dtype = bins_wire_dtype(n_bins)
+        self._shard_counts: List[int] = []
 
         rate = mc.normalize.sampleRate
         neg_only = mc.normalize.sampleNegOnly
@@ -73,7 +86,8 @@ class NormalizeProcessor(BasicProcessor):
             ph.set(rows=total_out)
         if self.params.get("shuffle"):
             with self.phase("shuffle"):
-                self._shuffle(norm_dir)
+                self._shard_counts = self._shuffle(norm_dir) \
+                    or self._shard_counts
                 self._shuffle(clean_dir)
         obs.counter("norm.rows").inc(total_out)
         obs.gauge("norm.shards").set(shard)
@@ -86,6 +100,10 @@ class NormalizeProcessor(BasicProcessor):
             "normType": mc.normalize.normType.name,
             "numShards": shard,
             "numRows": total_out,
+            # per-shard row counts: Shards.num_rows / the spill cache read
+            # these instead of decoding every npz just to count rows
+            "shardRows": list(self._shard_counts),
+            "binsDtype": np.dtype(self._bins_dtype).name,
             "width": transformer.width,
         }
         with open(os.path.join(norm_dir, "schema.json"), "w") as f:
@@ -103,14 +121,16 @@ class NormalizeProcessor(BasicProcessor):
         np.savez(os.path.join(norm_dir, f"part-{shard:05d}.npz"),
                  x=x, y=y, w=w)
         np.savez(os.path.join(clean_dir, f"part-{shard:05d}.npz"),
-                 bins=b.astype(np.int16), y=y, w=w)
+                 bins=b.astype(self._bins_dtype), y=y, w=w)
+        self._shard_counts.append(int(len(y)))
 
-    def _shuffle(self, d: str) -> None:
+    def _shuffle(self, d: str) -> Optional[List[int]]:
         """Load all shards, permute rows globally, rewrite (reference
-        ``core/shuffle/MapReduceShuffle.java``)."""
+        ``core/shuffle/MapReduceShuffle.java``).  Returns the rewritten
+        per-shard row counts (array_split re-balances them)."""
         files = sorted(f for f in os.listdir(d) if f.endswith(".npz"))
         if not files:
-            return
+            return None
         datas = [dict(np.load(os.path.join(d, f))) for f in files]
         keys = datas[0].keys()
         merged = {k: np.concatenate([dd[k] for dd in datas]) for k in keys}
@@ -120,4 +140,5 @@ class NormalizeProcessor(BasicProcessor):
         for i, f in enumerate(files):
             sel = perm[splits[i]]
             np.savez(os.path.join(d, f), **{k: merged[k][sel] for k in keys})
+        return [len(s) for s in splits]
 

@@ -448,27 +448,21 @@ def _hist_mesh(mesh):
 
 
 def _wire_bins_dtype(n_bins: int):
-    """Narrowest host→device wire dtype that holds bin ids 0..n_bins-1.
-    The transfer is a real cost (the bench tunnel moves ~20 MB/s; real rigs
+    """Narrowest host→device wire dtype that holds bin ids 0..n_bins-1
+    (``data.shards.bins_wire_dtype`` — uint8 for <=256 bins).  The
+    transfer is a real cost (the bench tunnel moves ~20 MB/s; real rigs
     pay PCIe), and the reference itself stores worker rows as short[] bin
     ids (``DTWorker.java:100``) — int32 on the wire is pure waste."""
-    if n_bins <= 127:
-        return np.int8
-    if n_bins <= 32767:
-        return np.int16
-    return np.int32
-
-
-@lru_cache(maxsize=None)
-def _widen_i32():
-    """Device-side widen after a narrow-wire transfer: HBM keeps int32 so
-    every executable (Pallas kernel included) sees the one layout; jit
-    propagates the input's mesh sharding."""
-    return jax.jit(lambda b: b.astype(jnp.int32))
+    from ..data.shards import bins_wire_dtype
+    return bins_wire_dtype(n_bins)
 
 
 def _put_bins(mesh, bins, n_bins: int):
-    """bins → device: narrow dtype over the wire, int32 in HBM."""
+    """bins → device in the compact wire dtype — and KEPT narrow in HBM
+    (4x more resident windows per cache budget at uint8); the tree
+    kernels widen to int32 in-graph (``ops.tree.build_histograms``).
+    Spill-cache windows already arrive in the wire dtype, so the put is a
+    zero-copy read straight out of the mmap."""
     bins = np.asarray(bins)
     wire = _wire_bins_dtype(n_bins)
     if wire != bins.dtype and bins.size:
@@ -480,8 +474,9 @@ def _put_bins(mesh, bins, n_bins: int):
                 f"bin ids [{lo}, {hi}] out of range for n_bins={n_bins} — "
                 "the materialized clean data does not match the current "
                 "ColumnConfig binning; re-run `norm`")
-    [b] = _device_put_rows(mesh, bins.astype(wire, copy=False))
-    return _widen_i32()(b)
+        bins = bins.astype(wire)
+    [b] = _device_put_rows(mesh, bins)
+    return b
 
 
 def _device_put_rows(mesh, *arrays):
@@ -1126,6 +1121,17 @@ def _default_cache_budget() -> int:
     return environment.get_int("shifu.train.deviceCacheBytes", 1 << 30)
 
 
+def _pipeline_depth(mesh) -> Optional[int]:
+    """Pipelined window prep (background-thread masks + device_put) is
+    single-device only: a second thread dispatching programs against a
+    multi-device CPU mesh can interleave two collective programs, the
+    known XLA:CPU in-process rendezvous deadlock (see
+    :func:`_gbt_window_hist`).  None = the stream's prefetch depth."""
+    if mesh is not None and getattr(mesh, "size", 1) > 1:
+        return 0
+    return None
+
+
 # trees grown per disk-tail sweep in streamed RF (histogram state is
 # ~[TB, 2^depth, C, B, S] f32 at the deepest level — 8 stays tens of MB
 # at north-star widths while cutting tail re-streams 8x)
@@ -1156,14 +1162,22 @@ def _stream_masks(idx: np.ndarray, n_valid: int, w_w: np.ndarray,
 
 
 def _gbt_prepare(mesh, valid_rate: float, seed: int, n_bins: int,
-                 y_transform=None, mask_fn=None):
+                 y_transform=None, mask_fn=None, f_ref=None):
     """Window prepare hook for streamed GBT: hash train/valid masks once,
     arrays onto the device (mesh-sharded over the data axis).
     ``y_transform`` maps the raw window targets (one-vs-all binarization,
     reference per-class jobs ``TrainModelProcessor.java:684-714``);
     ``mask_fn(index, targets) -> (train_w, valid_w)`` overrides the plain
     valid-rate split (grid/bagging members supply their member's
-    stateless bag/split, ``data.streaming.window_member_masks``)."""
+    stateless bag/split, ``data.streaming.window_member_masks``).
+
+    ``f_ref`` is a one-slot cell the trainer points at its host score
+    cache: when set, the window's score slice ships as ``f_prep`` FROM
+    THE PREP THREAD, so the tail path's per-window put overlaps device
+    compute instead of serializing on the consumer (safe: a window's
+    slice is only written by the consumer AFTER it consumed that window,
+    and rows are disjoint across windows).  Resident windows ignore
+    ``f_prep`` — their persistent device score cache lives under ``f``."""
     from ..data.streaming import PreparedWindow
 
     def prep(win):
@@ -1182,6 +1196,9 @@ def _gbt_prepare(mesh, valid_rate: float, seed: int, n_bins: int,
             y = np.asarray(y_transform(y), np.float32)
         dev = _put_row_floats(mesh, {"y": y, "tw": tw, "vw": vw})
         dev["bins"] = _put_bins(mesh, win.arrays["bins"], n_bins)
+        fh = f_ref.get("f") if f_ref is not None else None
+        if fh is not None:
+            dev["f_prep"] = _window_f(fh, win, mesh)
         return PreparedWindow(win.start, win.n_valid, win.rows,
                               win.index, dev)
     return prep
@@ -1262,12 +1279,14 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
     for _, va_prev in history:
         stopper.add(va_prev)
 
+    f_ref: Dict[str, Any] = {"f": None}   # prep-thread view of host scores
     cache = ResidentCache(stream,
                           _default_cache_budget() if cache_budget is None
                           else cache_budget,
                           _gbt_prepare(mesh, settings.valid_rate,
                                        settings.seed, n_bins, y_transform,
-                                       mask_fn))
+                                       mask_fn, f_ref),
+                          pipeline_depth=_pipeline_depth(mesh))
 
     # warm pass: width probe + init-score sums in one sweep.  The sums
     # accumulate ON DEVICE (chained adds) and fetch once at the end — a
@@ -1310,6 +1329,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
 
     f = None if init_d is not None else np.full(n_rows, init_score,
                                                 np.float32)
+    f_ref["f"] = f
     for t in trees:  # resumed/continuous: replay stored trees over the cache
         sf, lm, lv = (jnp.asarray(t.split_feat), jnp.asarray(t.left_mask),
                       jnp.asarray(t.leaf_value))
@@ -1320,17 +1340,21 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
 
     def window_f(it):
         """Resident windows keep their score slice ON DEVICE across trees
-        and levels (zero fetches); only tail windows round-trip host f.
+        and levels (zero fetches); only tail windows round-trip host f —
+        and their slice was already put FROM THE PREP THREAD (``f_prep``,
+        see :func:`_gbt_prepare`) so the transfer overlapped compute.
         A deferred device prior broadcasts on device (f is None only on
         the fully-resident fresh path, where no tail window exists)."""
         if it.resident:
-            fw = it.arrays.get("f")
-            if fw is None:
+            it.arrays.pop("f_prep", None)   # resumed warm pass: free the
+            fw = it.arrays.get("f")         # prep-shipped slice, the
+            if fw is None:                  # persistent cache wins
                 fw = (_window_f(f, it, mesh) if f is not None
                       else _bcast_rows(it.rows, mesh)(init_d))
                 it.arrays["f"] = fw
             return fw
-        return _window_f(f, it, mesh)
+        fp = it.arrays.pop("f_prep", None)
+        return fp if fp is not None else _window_f(f, it, mesh)
 
     imp = "friedmanmse" if settings.impurity == "friedmanmse" else "variance"
     pending_fused: List[Any] = []
@@ -1602,7 +1626,8 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
     cache = ResidentCache(stream,
                           _default_cache_budget() if cache_budget is None
                           else cache_budget,
-                          _rf_prepare(mesh, n_bins, y_transform, mask_fn))
+                          _rf_prepare(mesh, n_bins, y_transform, mask_fn),
+                          pipeline_depth=_pipeline_depth(mesh))
     c = None
     for win in stream.windows():      # peek the first window for the width;
         c = int(win.arrays["bins"].shape[1])   # cache warms during useful
@@ -1878,15 +1903,19 @@ def _write_feature_importance(proc, col_nums, feature_names, fi_total):
         json.dump({k: v for k, v in fi_named}, fjson, indent=2)
 
 
-def _tree_stream(shards, mesh):
+def _tree_stream(shards, mesh, params=None):
     """A ShardStream with the tree trainers' window geometry (env knobs +
     data-axis rounding) — the ONE place that computes it (main streamed
-    path and per-class OVA sweeps must agree)."""
+    path and per-class OVA sweeps must agree).  ``params`` may carry a
+    ``StreamPrefetch`` train-param override for the prefetch/pipeline
+    depth (else ``SHIFU_TPU_PREFETCH`` / ``-Dshifu.stream.prefetch``)."""
     from ..data.streaming import ShardStream, stream_window_rows
     ncols = len(shards.schema.get("columnNums", [])) or 1
     window_rows = stream_window_rows(2 * ncols + 8, mesh.shape["data"],
                                      shards)
-    return ShardStream(shards, ("bins", "y", "w"), window_rows)
+    prefetch = (params or {}).get("StreamPrefetch")
+    return ShardStream(shards, ("bins", "y", "w"), window_rows,
+                       prefetch=prefetch)
 
 
 def _streamed_bag_mask_fn(mc, rf_like: bool, bags: int, seed: int,
@@ -2451,7 +2480,7 @@ def run_tree_training(proc) -> int:
         from ..parallel.mesh import device_mesh
         mesh = device_mesh(n_ensemble=1)   # trees are sequential: all devices
         if streaming:                      # on the data axis
-            stream = _tree_stream(shards, mesh)
+            stream = _tree_stream(shards, mesh, dict(mc.train.params or {}))
             log.info("train %s STREAMED: %d rows, window %d rows, mesh %s",
                      alg.name, stream.num_rows, stream.window_rows,
                      dict(mesh.shape))

@@ -143,7 +143,13 @@ def test_norm_step_end_to_end(model_set):
     assert data["x"].shape[0] == n and data["x"].dtype == np.float32
     assert set(np.unique(data["y"])) == {0.0, 1.0}
     assert (data["w"] > 0).all()
-    assert bins["bins"].dtype == np.int16
+    # compact wire format: bins materialize in the narrowest dtype the
+    # ColumnConfig bin space fits (uint16 here — one high-cardinality
+    # categorical exceeds 256 bins; pure-numeric sets get uint8)
+    assert bins["bins"].dtype == np.dtype(clean.schema["binsDtype"])
+    assert bins["bins"].dtype.itemsize <= 2
+    assert clean.schema["shardRows"] == clean.shard_rows
+    assert sum(clean.schema["shardRows"]) == n
     assert bins["bins"].min() >= 0
     # zscaled features should be roughly centered
     assert abs(np.nanmean(data["x"])) < 1.0

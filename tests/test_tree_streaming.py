@@ -300,7 +300,8 @@ def test_streamed_rf_native_multiclass(tmp_path):
     assert np.isfinite(full.valid_error)
     votes = predict_forest(full.trees, bins)           # [n, 3] mean dist
     assert (votes.argmax(1) == y).mean() > 0.95
-    win_bytes = 256 * (c * 4 + 4 * 4)
+    # per prepared RF window: bins ride uint8 (c bytes/row) + y/w f32
+    win_bytes = 256 * (c * 1 + 2 * 4)
     tail = train_rf_streamed(
         ShardStream(shards, ("bins", "y", "w"), window_rows=256),
         n_bins, None, settings, cache_budget=2 * win_bytes + 64)
@@ -367,8 +368,9 @@ def test_resident_cache_tail_restream_matches_full_residency(tmp_path):
     full = train_gbt_streamed(
         ShardStream(shards, ("bins", "y", "w"), window_rows=256),
         8, None, settings, cache_budget=1 << 30)
-    # one 256-row window is ~256*(6*4+4+4+4+4) bytes; cap to fit ~2 windows
-    win_bytes = 256 * (6 * 4 + 4 * 4)
+    # one prepared 256-row GBT window is 256*(6*1 + 3*4) bytes (uint8 bins
+    # + y/tw/vw f32); cap to fit ~2 of the 4 windows
+    win_bytes = 256 * (6 * 1 + 3 * 4)
     tail = train_gbt_streamed(
         ShardStream(shards, ("bins", "y", "w"), window_rows=256),
         8, None, settings, cache_budget=2 * win_bytes + 64)
@@ -392,7 +394,8 @@ def test_rf_fused_matches_tail_restream(tmp_path):
     full = train_rf_streamed(
         ShardStream(shards, ("bins", "y", "w"), window_rows=256),
         8, None, settings, cache_budget=1 << 30)
-    win_bytes = 256 * (6 * 4 + 4 * 4)
+    # per prepared RF window: uint8 bins + y/w f32
+    win_bytes = 256 * (6 * 1 + 2 * 4)
     tail = train_rf_streamed(
         ShardStream(shards, ("bins", "y", "w"), window_rows=256),
         8, None, settings, cache_budget=2 * win_bytes + 64)
