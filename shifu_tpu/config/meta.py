@@ -100,6 +100,13 @@ TRAIN_PARAM_RULES: Dict[str, Rule] = {
     "CheckpointInterval": Rule("int", lo=0),
     # tree family
     "TreeNum": Rule("int", lo=1, hi=100000, algs=TREE_FAMILY),
+    # trees between device-side early-stop decisions (sync-free growth:
+    # errors accumulate on device and fetch in bulk)
+    "EarlyStopCheckInterval": Rule("int", lo=1, hi=10000,
+                                   algs=TREE_FAMILY),
+    # RF same-round trees grown per batched device program (multi-tree
+    # Pallas histogram grids); 0 = auto
+    "TreeBatch": Rule("int", lo=0, hi=64, algs=TREE_FAMILY),
     "MaxDepth": Rule("int", lo=1, hi=20, algs=TREE_FAMILY),
     # -1 (default) = level-wise; >0 enables the leaf-wise node budget
     # (reference DTMaster.java:129-137 MaxLeaves / isLeafWise)
@@ -109,6 +116,16 @@ TRAIN_PARAM_RULES: Dict[str, Rule] = {
                                   algs=TREE_FAMILY),
     "MinInstancesPerNode": Rule("float", lo=0.0, algs=TREE_FAMILY),
     "MinInfoGain": Rule("float", lo=0.0, algs=TREE_FAMILY),
+    # TENSORFLOW-only topology/resource keys (reference TF-on-YARN bridge,
+    # ``TrainModelProcessor.java:395-449`` session setup): recognized so
+    # they don't read as typos, but the tpu-native NN path that serves
+    # algorithm=TENSORFLOW has no ps/worker topology — a TRAIN probe with
+    # any of them present fails loudly (``tf_ignored_param_problems``)
+    # instead of training while silently ignoring them
+    "NumPS": Rule("int", lo=1, algs=("TENSORFLOW",)),
+    "NumTFWorkers": Rule("int", lo=1, algs=("TENSORFLOW",)),
+    "TFWorkerMemory": Rule("int", lo=1, algs=("TENSORFLOW",)),
+    "TFPSMemory": Rule("int", lo=1, algs=("TENSORFLOW",)),
     # WDL family
     "EmbedColumnNum": Rule("int", lo=1, algs=("WDL",)),
     "EmbedDim": Rule("int", lo=1, algs=("WDL",)),
@@ -205,6 +222,28 @@ def _check_value(key: str, v: Any, rule: Rule) -> List[str]:
                                     f"{list(rule.allowed)}")
                     break
     return problems
+
+
+TF_ONLY_PARAMS = tuple(k for k, r in TRAIN_PARAM_RULES.items()
+                       if r.algs == ("TENSORFLOW",))
+
+
+def tf_ignored_param_problems(train_conf) -> List[str]:
+    """``algorithm=TENSORFLOW`` remaps onto the native jitted NN path
+    (``pipeline/train.py`` TrainProcessor.process) — TF-on-YARN-only
+    topology/resource params would train-while-ignored there, the exact
+    silent failure MetaFactory exists to prevent.  Fail loudly, listing
+    every offender."""
+    if train_conf.algorithm != Algorithm.TENSORFLOW:
+        return []
+    present = sorted(k for k in (train_conf.params or {})
+                     if k in TF_ONLY_PARAMS)
+    if not present:
+        return []
+    return [f"algorithm TENSORFLOW trains on the native NN path (no "
+            f"TF-on-YARN ps/worker topology) — train#params {present} "
+            "would be silently ignored; remove them or use a TF-on-YARN "
+            "deployment"]
 
 
 def unknown_param_problems(params: Dict[str, Any]) -> List[str]:

@@ -108,6 +108,10 @@ def probe(mc: ModelConfig, step: ModelStep, model_set_dir: str = ".") -> None:
         # every train#params key checked; unknown keys (typos) are hard
         # errors; grid-search candidate lists expand per trial
         problems.extend(validate_train_conf(mc.train))
+        # TENSORFLOW remaps to the native NN trainer — TF-only params it
+        # would silently ignore are a loud, listed failure
+        from .meta import tf_ignored_param_problems
+        problems.extend(tf_ignored_param_problems(mc.train))
 
     if step in (ModelStep.INIT, ModelStep.STATS, ModelStep.NORMALIZE,
                 ModelStep.VARSELECT, ModelStep.TRAIN, ModelStep.POSTTRAIN):

@@ -194,6 +194,29 @@ def _method_weight_col(hist, method_value: str, nch: int):
     }.get(method_value, pos + neg)
 
 
+@functools.partial(jax.jit, static_argnames=("num_buckets",))
+def _refine_prov_kernel(prov, plo, phi, lo, hi, num_buckets: int):
+    """Re-bin a PROVISIONAL-grid fine histogram onto the exact final grid,
+    on device (the fused one-pass sweep's refinement step — see
+    :class:`shifu_tpu.ops.sketches.RangeSketch`).
+
+    Each provisional bucket lands whole in the final bucket its center
+    falls in: counts are conserved exactly; placement error is bounded by
+    one provisional bucket width ((phi-plo)/K — with the sketch margin,
+    ~1.5/K of the value range, far inside the fine-sketch resolution the
+    boundaries are read at anyway)."""
+    kk = jnp.arange(num_buckets, dtype=jnp.float32)
+    centers = plo[:, None] + (phi - plo)[:, None] * \
+        (kk[None, :] + 0.5) / num_buckets                     # [C, K]
+    scale = num_buckets / jnp.maximum(hi - lo, 1e-30)
+    idx = jnp.clip((centers - lo[:, None]) * scale[:, None],
+                   0, num_buckets - 1).astype(jnp.int32)      # [C, K]
+    return jax.vmap(
+        lambda p, i: jax.ops.segment_sum(p, i,
+                                         num_segments=num_buckets))(
+        prov, idx)
+
+
 @functools.partial(jax.jit, static_argnames=("method_value", "max_bins",
                                              "num_buckets", "nch",
                                              "interval"))
@@ -302,10 +325,34 @@ class NumericAccumulator:
     _pend_hist_rows: int = 0
     _lo_d: Optional[object] = None
     _hi_d: Optional[object] = None
+    # fused one-pass sweep state (update_fused/finalize_fused): chunks
+    # ship H2D ONCE and stay device-resident up to ``fused_budget`` bytes;
+    # past it, chunks accumulate into a PROVISIONAL-range histogram that
+    # refines onto the exact grid at finalize (ops/sketches.RangeSketch)
+    fused_budget: int = 1 << 30
+    _fused_chunks: list = field(default_factory=list)
+    _fused_bytes: int = 0
+    _prov_hist_dev: Optional[object] = None
+    _prov_magg_dev: Optional[object] = None
+    _prov_lo_d: Optional[object] = None
+    _prov_hi_d: Optional[object] = None
 
     # f32 histogram counts are exact integers up to 2^24; drain to host
     # float64 well before that so TB-scale streams lose nothing
     DRAIN_ROWS = 8_000_000
+
+    def __post_init__(self):
+        # the fine-histogram bucket axis must stay MXU-tile-aligned: the
+        # two-level one-hot stats kernel factors bucket ids as hi*64+lo
+        # (64 sublanes x 64 lanes per dot tile) and caps at 4096 — a
+        # misaligned count would silently fall off the kernel path onto
+        # the serialized scatter lowering
+        if self.num_buckets % 64 != 0 or not \
+                (64 <= self.num_buckets <= 4096):
+            raise ValueError(
+                f"num_buckets={self.num_buckets} is not MXU-tile-aligned: "
+                "the stats fine histogram requires a multiple of 64 in "
+                "[64, 4096] (ops/hist_pallas.stats_histograms_pallas)")
 
     def _data_size(self) -> int:
         return int(self.mesh.shape["data"]) if self.mesh is not None else 1
@@ -397,6 +444,115 @@ class NumericAccumulator:
                 v = valid[:, c]
                 self._exact_cols[c].append(
                     (np.asarray(x[v, c], np.float64), pos_r[v], w64[v]))
+
+    # ---- fused one-pass sweep (moments + histogram in ONE disk pass)
+    def _kernel_gate(self) -> bool:
+        from .hist_pallas import pallas_available
+        return bool(pallas_available(self.mesh))
+
+    def update_fused(self, x: np.ndarray, valid: np.ndarray,
+                     target: np.ndarray, weight: np.ndarray) -> None:
+        """One-pass chunk update: moments accumulate as in pass 1 AND the
+        chunk's device arrays are RETAINED (up to ``fused_budget`` bytes)
+        so :meth:`finalize_fused` can build the exact-range fine histogram
+        without re-reading or re-shipping the chunk — each shard window is
+        read, parsed and put H2D ONCE (the two-pass plane paid all three
+        twice).  Chunks past the budget accumulate immediately into a
+        PROVISIONAL-range histogram (sketch-first boundaries,
+        :class:`shifu_tpu.ops.sketches.RangeSketch`) refined on device at
+        finalize.  Resident-path results are BIT-identical to the
+        two-pass sweep (same kernels, same inputs, same order)."""
+        assert not self.exact, \
+            "fused sweep serves the sketch path; exact (MunroPat) " \
+            "binning keeps the two-pass flow"
+        if self._data_size() <= 1:
+            xd, vd = jnp.asarray(x, jnp.float32), jnp.asarray(valid)
+            td = jnp.asarray(target, jnp.float32)
+            wd = jnp.asarray(weight, jnp.float32)
+            live = None
+        else:
+            xd, vd, td, wd, live = self._put_rows(
+                np.asarray(x, np.float32), np.asarray(valid),
+                np.asarray(target, np.float32),
+                np.asarray(weight, np.float32))
+        self._pend_moments.append(jnp.stack(_moments_kernel(xd, vd)))
+        self.total_rows += x.shape[0]
+        self._pend_moment_rows += x.shape[0]
+        if self._pend_moment_rows >= self.DRAIN_ROWS:
+            self._drain_moments()
+        nbytes = x.shape[0] * (5 * self.n_cols + 8)   # f32 x + bool v + t/w
+        if self._fused_bytes + nbytes <= self.fused_budget:
+            self._fused_chunks.append((xd, vd, td, wd, live, x.shape[0]))
+            self._fused_bytes += nbytes
+            return
+        if self._prov_lo_d is None:
+            self._freeze_provisional()     # ONE sync, at first overflow
+        h = _histogram_kernel(xd, vd, td, wd, self._prov_lo_d,
+                              self._prov_hi_d, self.num_buckets,
+                              use_pallas=self._kernel_gate(),
+                              unit_weight=self.unit_weight, expand=False,
+                              mesh=self.mesh if self._data_size() > 1
+                              else None)
+        magg = _missing_agg_kernel(vd, td, wd, live,
+                                   unit_weight=self.unit_weight,
+                                   expand=False)
+        self._prov_hist_dev = h if self._prov_hist_dev is None \
+            else self._prov_hist_dev + h
+        self._prov_magg_dev = magg if self._prov_magg_dev is None \
+            else self._prov_magg_dev + magg
+
+    def _freeze_provisional(self) -> None:
+        """Freeze the provisional fine-histogram range from the running
+        range sketch — drains pending moments (the single host sync the
+        overflow path pays, once per job)."""
+        from .sketches import RangeSketch
+        self._drain_moments()
+        rs = RangeSketch(self.n_cols)
+        rs.update(self.moments["min"], self.moments["max"])
+        plo, phi = rs.provisional_bounds()
+        self._prov_lo_d = jnp.asarray(plo, jnp.float32)
+        self._prov_hi_d = jnp.asarray(phi, jnp.float32)
+
+    def finalize_fused(self) -> None:
+        """Close the fused sweep: exact [lo, hi] from the drained moments,
+        then the retained device chunks replay through the histogram
+        kernel on the exact grid (zero disk reads, zero H2D) and the
+        provisional overflow histogram re-bins onto the exact grid ON
+        DEVICE.  Afterwards the accumulator is in the same state pass 2
+        would have left — ``finalize_sketch`` / ``compute_boundaries``
+        work unchanged."""
+        self.finalize_range()
+        up = self._kernel_gate()
+        for xd, vd, td, wd, live, rows in self._fused_chunks:
+            h = _histogram_kernel(xd, vd, td, wd, self._lo_d, self._hi_d,
+                                  self.num_buckets, use_pallas=up,
+                                  unit_weight=self.unit_weight,
+                                  expand=False,
+                                  mesh=self.mesh if self._data_size() > 1
+                                  else None)
+            magg = _missing_agg_kernel(vd, td, wd, live,
+                                       unit_weight=self.unit_weight,
+                                       expand=False)
+            self._hist_dev = h if self._hist_dev is None \
+                else self._hist_dev + h
+            self._magg_dev = magg if self._magg_dev is None \
+                else self._magg_dev + magg
+            self._pend_hist_rows += rows
+            if self._pend_hist_rows >= self.DRAIN_ROWS:
+                self._drain_hist()
+        self._fused_chunks.clear()
+        self._fused_bytes = 0
+        if self._prov_hist_dev is not None:
+            refined = _refine_prov_kernel(
+                self._prov_hist_dev, self._prov_lo_d, self._prov_hi_d,
+                self._lo_d, self._hi_d, self.num_buckets)
+            self._hist_dev = refined if self._hist_dev is None \
+                else self._hist_dev + refined
+            self._magg_dev = self._prov_magg_dev \
+                if self._magg_dev is None \
+                else self._magg_dev + self._prov_magg_dev
+            self._prov_hist_dev = None
+            self._prov_magg_dev = None
 
     def _drain_hist(self) -> None:
         if self._hist_dev is None:

@@ -39,6 +39,24 @@ from jax.experimental.pallas import tpu as pltpu
 
 LANE = 128
 
+# jax renamed TPUCompilerParams -> CompilerParams across 0.4/0.5; accept
+# whichever this toolchain ships so the kernels lower on both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """``jax.shard_map`` with the replication check off, across the
+    0.4/0.5 API split (top-level ``shard_map(check_vma=)`` vs
+    ``jax.experimental.shard_map.shard_map(check_rep=)``) — the checker
+    can't see through a pallas_call either way."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
 
 def _bf16_split(a):
     """bf16 (hi, lo) halves of an f32 operand — two native-rate MXU
@@ -140,6 +158,99 @@ def _hist_kernel(bins_ref, node_ref, stats_ref, out_ref, *, n_stats: int,
             accumulate(oneh, store_flat)
 
 
+def _hist_kernel_batch(bins_ref, node_ref, stats_ref, out_ref, *,
+                       n_stats: int, n_trees: int, n_nodes: int, b_pad: int,
+                       nblk: int, cblk: int, pair: bool = False,
+                       exact: bool = False):
+    """Multi-TREE histogram grid: TB independent trees' level histograms in
+    ONE kernel launch.
+
+    Same one-hot-matmul formulation as :func:`_hist_kernel`, with a
+    tree-batch axis: each tree t has its own level-local ``node_ref[t]``
+    row positions and its own ``stats_ref[t*S:(t+1)*S]`` channels (RF bags
+    differ per tree), while the bins one-hot — the dominant VPU work at
+    shallow levels — is built ONCE per (feature, row-block) grid cell and
+    shared by every tree's dots.  The per-tree dot sequence (row blocks in
+    grid order, channel pairs packed on the sublane axis, the bf16 hi/lo
+    split) is IDENTICAL to the single-tree kernel's, so each tree's
+    histogram is bit-identical to what ``_hist_kernel`` would produce —
+    the batched==sequential parity guard pins this.
+
+    Replaces TB sequential launches in the forest inner loop
+    (``DTWorker.java:763-884`` runs the same per-tree loop thread-parallel;
+    ``DTMaster.java:91`` grows all RF trees of a round simultaneously).
+    """
+    r = pl.program_id(1)
+
+    @pl.when(r == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    k_iota = jax.lax.broadcasted_iota(jnp.int32, (n_nodes, nblk), 0)
+    a_hi, a_lo = [], []                  # per (tree, channel-group) operands
+    groups = []                          # (tree, s0, n_in_group)
+    for t in range(n_trees):
+        node1h = (k_iota == node_ref[t:t + 1, :]).astype(jnp.float32)
+        s = 0
+        while s < n_stats:
+            g = 2 if s + 1 < n_stats else 1
+            a = jnp.concatenate(
+                [node1h * stats_ref[t * n_stats + s + j:
+                                    t * n_stats + s + j + 1, :]
+                 for j in range(g)], axis=0)          # [g*K, nblk] f32
+            if exact:
+                a_hi.append(a.astype(jnp.bfloat16))
+                a_lo.append(None)
+            else:
+                hi_b, lo_b = _bf16_split(a)
+                a_hi.append(hi_b)
+                a_lo.append(lo_b)
+            groups.append((t, s, g))
+            s += g
+    dims = (((1,), (1,)), ((), ()))
+    half = LANE // 2
+
+    def accumulate(oneh, store):
+        """One (or two) dots per (tree, channel group); ``store(t, s,
+        acc_s)`` writes tree t / channel s's [K, LANE] slice."""
+        for gi, (t, s0, g) in enumerate(groups):
+            acc = jax.lax.dot_general(
+                a_hi[gi], oneh, dims,
+                preferred_element_type=jnp.float32)       # [g*K, LANE]
+            if a_lo[gi] is not None:
+                acc += jax.lax.dot_general(
+                    a_lo[gi], oneh, dims,
+                    preferred_element_type=jnp.float32)
+            for j in range(g):
+                store(t, s0 + j, acc[j * n_nodes:(j + 1) * n_nodes, :])
+
+    if pair:
+        b_iota = jax.lax.broadcasted_iota(jnp.int32, (LANE, nblk), 0)
+        lo_half = b_iota < half
+        lane_val = jnp.where(lo_half, b_iota, b_iota - half)
+        for cf in range(0, cblk, 2):
+            bview_a = bins_ref[cf:cf + 1, :]              # [1, nblk]
+            bview_b = bins_ref[cf + 1:cf + 2, :]
+            oneh = (lane_val == jnp.where(lo_half, bview_a, bview_b)) \
+                .astype(jnp.bfloat16)                     # [LANE, nblk]
+
+            def store_pair(t, s, acc_s, cf=cf):
+                out_ref[cf, t, s, :, :] += acc_s[:, :half]
+                out_ref[cf + 1, t, s, :, :] += acc_s[:, half:]
+            accumulate(oneh, store_pair)
+        return
+    for cf in range(cblk):
+        bview = bins_ref[cf:cf + 1, :]                    # [1, nblk]
+        for bt in range(b_pad // LANE):
+            b_iota = jax.lax.broadcasted_iota(
+                jnp.int32, (LANE, nblk), 0) + bt * LANE
+            oneh = (b_iota == bview).astype(jnp.bfloat16)  # [LANE, nblk]
+
+            def store_flat(t, s, acc_s, cf=cf, bt=bt):
+                out_ref[cf, t, s, :, bt * LANE:(bt + 1) * LANE] += acc_s
+            accumulate(oneh, store_flat)
+
+
 K_MAX = 64   # per-call node cap: the [C_pad, S, K, B_pad] output must sit
              # under the ~16 MB VMEM scoped-allocation limit
 
@@ -205,12 +316,131 @@ def build_histograms_pallas(bins, node_idx, stats, n_nodes: int,
                                lambda ci, r: (ci, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((c_pad, s, n_nodes, b_pad),
                                        jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(bins_t, node_t, stats_t)
     # [C_pad, S, K, B_pad] -> [K, C, B, S]
     return out[:c, :, :, :n_bins].transpose(2, 0, 3, 1)
+
+
+def _batch_vmem_bytes(tb: int, s: int, n_nodes: int, b_pad: int,
+                      nblk: int, cblk: int, exact: bool) -> int:
+    """Rough VMEM footprint of one batched grid cell: output block +
+    per-(tree, group) dot operands (the hi/lo split doubles them) +
+    double-buffered input blocks."""
+    out = cblk * tb * s * n_nodes * b_pad * 4
+    n_groups = (s + 1) // 2
+    opnd = tb * n_groups * min(2, s) * n_nodes * nblk * 2
+    if not exact:
+        opnd *= 2
+    inputs = 2 * nblk * (cblk * 4 + tb * 4 + tb * s * 4)
+    return out + opnd + inputs
+
+
+_BATCH_VMEM_BUDGET = 10 << 20     # leave headroom under the ~16 MB scope
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "interpret",
+                                   "exact"))
+def build_histograms_pallas_batch(bins, node_idx_b, stats_b, n_nodes: int,
+                                  n_bins: int, interpret: bool = False,
+                                  exact: bool = False):
+    """Batched drop-in for :func:`build_histograms_pallas` over a leading
+    TREE axis: B independent trees' level histograms in ONE launch.
+
+    bins: [N, C] shared row matrix; node_idx_b: [TB, N] per-tree level-local
+    positions (-1 = inactive); stats_b: [TB, N, S] per-tree stat channels.
+    Returns [TB, n_nodes, C, n_bins, S] float32.
+
+    Every per-tree parameter that shapes the accumulation order (nblk row
+    blocking, K_MAX node partitioning, channel pairing, bf16 hi/lo split)
+    matches the single-tree kernel exactly, so each tree's slice is
+    BIT-identical to a sequential :func:`build_histograms_pallas` call —
+    only the dispatch count changes (1 launch instead of TB, with the bins
+    one-hot built once per grid cell instead of TB times).  Tree batches
+    that would overflow the VMEM scope split transparently.
+    """
+    bins = bins.astype(jnp.int32)
+    tb, n = node_idx_b.shape
+    s = stats_b.shape[2]
+    if n_nodes > K_MAX:             # deep levels: same node partitioning
+        parts = []                  # as the single-tree path
+        for k0 in range(0, n_nodes, K_MAX):
+            parts.append(build_histograms_pallas_batch(
+                bins, node_idx_b - k0, stats_b, min(K_MAX, n_nodes - k0),
+                n_bins, interpret, exact))
+        return jnp.concatenate(parts, axis=1)
+    c = bins.shape[1]
+    pair = n_bins <= LANE // 2
+    b_pad = LANE // 2 if pair else ((n_bins + LANE - 1) // LANE) * LANE
+    cblk = 8
+    c_pad = ((c + cblk - 1) // cblk) * cblk
+    # nblk MUST be the single-tree formula for the given node count — the
+    # row-block accumulation order is what makes batched == sequential
+    # bit-identical
+    nblk = int(os.environ.get("SHIFU_HIST_NBLK", 0)) or \
+        (16384 if n_nodes <= 16 else 8192 if n_nodes <= 32 else 2048)
+    while tb > 1 and _batch_vmem_bytes(tb, s, n_nodes, b_pad, nblk, cblk,
+                                       exact) > _BATCH_VMEM_BUDGET:
+        # split the tree batch, not the row block: nblk is pinned by the
+        # bit-identity contract above
+        half_tb = tb // 2
+        return jnp.concatenate([
+            build_histograms_pallas_batch(
+                bins, node_idx_b[:half_tb], stats_b[:half_tb], n_nodes,
+                n_bins, interpret, exact),
+            build_histograms_pallas_batch(
+                bins, node_idx_b[half_tb:], stats_b[half_tb:], n_nodes,
+                n_bins, interpret, exact)], axis=0)
+    n_pad = ((n + nblk - 1) // nblk) * nblk
+
+    bins_t = jnp.pad(bins, ((0, n_pad - n), (0, c_pad - c))).T  # [C_pad, N_pad]
+    node_t = jnp.pad(node_idx_b, ((0, 0), (0, n_pad - n)),
+                     constant_values=-1)                  # [TB, N_pad]
+    stats_t = jnp.pad(stats_b, ((0, 0), (0, n_pad - n), (0, 0))) \
+        .transpose(0, 2, 1).reshape(tb * s, n_pad)        # [TB*S, N_pad]
+
+    grid = (c_pad // cblk, n_pad // nblk)
+    out = pl.pallas_call(
+        partial(_hist_kernel_batch, n_stats=s, n_trees=tb, n_nodes=n_nodes,
+                b_pad=b_pad, nblk=nblk, cblk=cblk, pair=pair, exact=exact),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((cblk, nblk), lambda ci, r: (ci, r)),
+            pl.BlockSpec((tb, nblk), lambda ci, r: (0, r)),
+            pl.BlockSpec((tb * s, nblk), lambda ci, r: (0, r)),
+        ],
+        out_specs=pl.BlockSpec((cblk, tb, s, n_nodes, b_pad),
+                               lambda ci, r: (ci, 0, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((c_pad, tb, s, n_nodes, b_pad),
+                                       jnp.float32),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(bins_t, node_t, stats_t)
+    # [C_pad, TB, S, K, B_pad] -> [TB, K, C, B, S]
+    return out[:c, :, :, :, :n_bins].transpose(1, 3, 0, 4, 2)
+
+
+def build_histograms_batch_sharded(bins, node_idx_b, stats_b, n_nodes: int,
+                                   n_bins: int, mesh,
+                                   interpret: bool = False,
+                                   exact: bool = False):
+    """Mesh lowering of the batched kernel (see
+    :func:`build_histograms_sharded`): rows shard over ``data``, the tree
+    axis replicates, one psum merges the per-device tree-batch grids."""
+    from jax.sharding import PartitionSpec as P
+
+    def local(b, ni, st):
+        h = build_histograms_pallas_batch(b, ni, st, n_nodes, n_bins,
+                                          interpret, exact)
+        return jax.lax.psum(h, "data")
+
+    return _shard_map(
+        local, mesh,
+        in_specs=(P("data", None), P(None, "data"), P(None, "data", None)),
+        out_specs=P())(bins, node_idx_b, stats_b)
 
 
 def build_histograms_sharded(bins, node_idx, stats, n_nodes: int,
@@ -237,10 +467,10 @@ def build_histograms_sharded(bins, node_idx, stats, n_nodes: int,
                                     exact)
         return jax.lax.psum(h, "data")
 
-    return jax.shard_map(
-        local, mesh=mesh,
+    return _shard_map(
+        local, mesh,
         in_specs=(P("data", None), P("data"), P("data", None)),
-        out_specs=P(), check_vma=False)(bins, node_idx, stats)
+        out_specs=P())(bins, node_idx, stats)
 
 
 def target_platform(mesh=None) -> str:
@@ -399,7 +629,7 @@ def stats_histograms_pallas(idx, stats, num_buckets: int,
         out_specs=pl.BlockSpec((cblk, s, hi_n, 64),
                                lambda ci, r: (ci, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((c_pad, s, hi_n, 64), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(idx_t, stats_t)
@@ -421,6 +651,6 @@ def stats_histograms_sharded(idx, stats, num_buckets: int, mesh,
         h = stats_histograms_pallas(i, st, num_buckets, interpret, exact)
         return jax.lax.psum(h, "data")
 
-    return jax.shard_map(
-        local, mesh=mesh, in_specs=(P("data", None), P("data", None)),
-        out_specs=P(), check_vma=False)(idx, stats)
+    return _shard_map(
+        local, mesh, in_specs=(P("data", None), P("data", None)),
+        out_specs=P())(idx, stats)

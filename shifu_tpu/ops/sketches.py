@@ -50,6 +50,47 @@ class HyperLogLog:
         return int(round(e))
 
 
+class RangeSketch:
+    """Streaming per-column value-range sketch → PROVISIONAL fine-histogram
+    boundaries for the fused one-pass stats sweep.
+
+    The two-pass stats plane needs pass 1 only to learn each column's
+    [min, max] before the fine equal-width histogram of pass 2.  The fused
+    sweep instead sketches the range as chunks stream by and, when its
+    device chunk cache overflows, freezes an EXPANDED provisional range
+    (margin headroom on both sides, the MunroPat-family "provisional
+    boundaries, refine later" idea — reference
+    ``core/binning/MunroPatBinning.java``).  Overflow chunks accumulate
+    into the provisional grid; at finalize the provisional buckets re-bin
+    onto the exact [min, max] grid ON DEVICE
+    (``ops.binning._refine_prov_kernel``) — counts are conserved exactly,
+    placement error is bounded by one provisional bucket width.
+    """
+
+    def __init__(self, n_cols: int, margin: float = 0.25):
+        self.margin = margin
+        self.mn = np.full(n_cols, np.inf)
+        self.mx = np.full(n_cols, -np.inf)
+
+    def update(self, mn: np.ndarray, mx: np.ndarray) -> None:
+        np.minimum(self.mn, np.asarray(mn, np.float64), out=self.mn)
+        np.maximum(self.mx, np.asarray(mx, np.float64), out=self.mx)
+
+    def provisional_bounds(self):
+        """(lo, hi) float64 arrays: the observed range expanded by
+        ``margin`` on each side (late-arriving tails clip into the edge
+        provisional buckets, bounded by the refinement error above).
+        Degenerate columns take the same fallbacks as
+        ``NumericAccumulator.finalize_range``."""
+        lo, hi = self.mn.copy(), self.mx.copy()
+        empty = ~np.isfinite(lo) | ~np.isfinite(hi)
+        lo[empty], hi[empty] = 0.0, 1.0
+        same = hi <= lo
+        hi[same] = lo[same] + 1.0
+        span = hi - lo
+        return lo - self.margin * span, hi + self.margin * span
+
+
 class FrequentItems:
     """Bounded frequent-item counter with Misra-Gries merging (reference
     ``CountAndFrequentItemsWritable`` role): batches merge vectorized via

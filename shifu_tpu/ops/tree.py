@@ -172,6 +172,13 @@ def build_histograms(bins, node_idx, stats, n_nodes: int, n_bins: int,
                                             stats_exact)
         return build_histograms_pallas(bins, node_idx, stats, n_nodes,
                                        n_bins, interpret, stats_exact)
+    return _hist_scatter(bins, node_idx, stats, n_nodes, n_bins)
+
+
+def _hist_scatter(bins, node_idx, stats, n_nodes: int, n_bins: int):
+    """segment_sum lowering of the histogram build — the CPU/test path and
+    the batched fallback's per-tree body (one implementation, so batched
+    and sequential scatter results are bit-identical)."""
     active = node_idx >= 0
     seg_base = jnp.where(active, node_idx, 0) * n_bins
     masked = stats * active[:, None].astype(stats.dtype)
@@ -183,6 +190,42 @@ def build_histograms(bins, node_idx, stats, n_nodes: int, n_bins: int,
     out = jax.vmap(per_feature, in_axes=1)(bins)        # [C, nodes*bins, S]
     c = bins.shape[1]
     return out.reshape(c, n_nodes, n_bins, -1).transpose(1, 0, 2, 3)
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "use_pallas",
+                                   "mesh", "stats_exact"))
+def build_histograms_batch(bins, node_idx_b, stats_b, n_nodes: int,
+                           n_bins: int, use_pallas: bool = False, mesh=None,
+                           stats_exact: bool = False):
+    """Tree-batched :func:`build_histograms`: B independent trees' level
+    histograms in ONE device program / ONE kernel launch.
+
+    bins: [N, C] shared rows (narrow wire dtypes widen here, in-graph);
+    node_idx_b: [TB, N] per-tree level-local positions (-1 = inactive);
+    stats_b: [TB, N, S] per-tree channels.  Returns
+    [TB, n_nodes, C, n_bins, S].
+
+    The MXU lowering shares the bins one-hot across the tree batch
+    (:func:`shifu_tpu.ops.hist_pallas.build_histograms_pallas_batch`) —
+    one launch instead of TB, with each tree's slice bit-identical to its
+    sequential build; the scatter fallback vmaps the shared per-tree body.
+    """
+    bins = bins.astype(jnp.int32)
+    if use_pallas:
+        from .hist_pallas import (build_histograms_batch_sharded,
+                                  build_histograms_pallas_batch,
+                                  target_platform)
+        interpret = target_platform(mesh) != "tpu"
+        if mesh is not None and mesh.size > 1:
+            return build_histograms_batch_sharded(
+                bins, node_idx_b, stats_b, n_nodes, n_bins, mesh, interpret,
+                stats_exact)
+        return build_histograms_pallas_batch(bins, node_idx_b, stats_b,
+                                             n_nodes, n_bins, interpret,
+                                             stats_exact)
+    return jax.vmap(
+        lambda ni, st: _hist_scatter(bins, ni, st, n_nodes, n_bins))(
+        node_idx_b, stats_b)
 
 
 # ------------------------------------------------------------- split scan
@@ -242,10 +285,15 @@ def best_splits(hist, cat_mask, feat_active, impurity: str = "variance",
     if multiclass:
         cls = hist                                         # [nodes, C, B, K]
         w = cls.sum(-1)
-        # scalar "response" for categorical ordering: mean class index
-        # (equals pos rate for K=2)
-        kidx = jnp.arange(n_classes, dtype=hist.dtype)
-        wy = (cls * kidx).sum(-1)
+        if has_cat:
+            # scalar "response" for categorical ordering: mean class index
+            # (equals pos rate for K=2).  Only the categorical sort reads
+            # it — ``has_cat=False`` (static) drops the [nodes, C, B, K]
+            # reduction entirely (the active impurity never touches wy)
+            kidx = jnp.arange(n_classes, dtype=hist.dtype)
+            wy = (cls * kidx).sum(-1)
+        else:
+            wy = w          # placeholder, compiled out (w_o path unused)
     else:
         w, wy = hist[..., 0], hist[..., 1]
     n_nodes, c, b = w.shape
@@ -446,6 +494,94 @@ def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
                               leaf_glob)
     return (jnp.concatenate(feats), jnp.concatenate(lmasks, axis=0),
             jnp.concatenate(leaves), gain_fi, leaf_glob)
+
+
+@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity",
+                                   "n_classes", "use_pallas", "max_leaves",
+                                   "has_cat", "mesh", "stats_exact"))
+def grow_forest_jit(bins, stats_b, cat, fa_b, n_bins: int, depth: int,
+                    impurity: str, min_instances: float, min_gain: float,
+                    n_classes: int = 0, use_pallas: bool = False,
+                    max_leaves: int = 0, has_cat: bool = True, mesh=None,
+                    stats_exact: bool = False):
+    """TB independent same-structure trees grown level-wise as ONE jitted
+    program — the tree-batched :func:`grow_tree_jit` (reference
+    ``DTMaster.java:91``: the toDoQueue spans ALL RF trees of a round, one
+    stats pass per level for the whole forest).
+
+    stats_b: [TB, N, S] per-tree stat channels (RF bags differ per tree);
+    fa_b: [TB, C] per-tree feature subsets; ``bins``/``cat`` are shared.
+    Each level's TB histograms build in ONE kernel launch
+    (:func:`build_histograms_batch` — the bins one-hot amortizes across
+    the batch, and shallow levels' skinny [K, nblk] node operands stack
+    into full MXU tiles).  Histogram subtraction, the leaf-sum bottom
+    level and the leaf-wise budget all apply per tree exactly as in
+    :func:`grow_tree_jit`; every per-tree result is bit-identical to a
+    sequential grow (the batched==sequential parity guard pins it).
+
+    Returns ([TB, total] split_feat, [TB, total, B] left_mask,
+    [TB, total] (or [TB, total, K]) leaf_value, [TB, C] gain_fi,
+    [TB, N] leaf_glob).
+    """
+    n, c = bins.shape
+    tb = stats_b.shape[0]
+    feats, lmasks, leaves = [], [], []
+    gain_fi = jnp.zeros((tb, c), jnp.float32)
+    node_idx = jnp.zeros((tb, n), jnp.int32)
+    leaf_glob = jnp.zeros((tb, n), jnp.int32)
+    nodes_cnt = jnp.ones(tb, jnp.int32)
+    hist_prev = None
+    feat_prev = None
+    for level in range(depth + 1):
+        n_nodes = 1 << level
+        if level == depth:
+            leaves.append(jax.vmap(
+                lambda st, ni: _level_leaf_sums(st, ni, n_nodes,
+                                                n_classes))(
+                stats_b, node_idx))
+            feats.append(jnp.full((tb, n_nodes), -1, jnp.int32))
+            lmasks.append(jnp.zeros((tb, n_nodes, n_bins), bool))
+            break
+        if level == 0:
+            hist = build_histograms_batch(bins, node_idx, stats_b, n_nodes,
+                                          n_bins, use_pallas, mesh,
+                                          stats_exact)
+        else:
+            hl = build_histograms_batch(
+                bins, jax.vmap(_left_child_index)(node_idx), stats_b,
+                n_nodes // 2, n_bins, use_pallas, mesh, stats_exact)
+            split_ok = feat_prev >= 0                      # [TB, K/2]
+            hr = jnp.where(split_ok[:, :, None, None, None],
+                           hist_prev - hl, 0.0)
+            hist = jnp.stack([hl, hr], axis=2) \
+                .reshape(tb, n_nodes, c, hl.shape[3], hl.shape[4])
+        gain, feat, lmask, leaf, _ = jax.vmap(
+            lambda h, f: best_splits(h, cat, f, impurity, min_instances,
+                                     min_gain, n_classes, has_cat))(
+            hist, fa_b)
+        if max_leaves > 0:
+            feat, lmask, nodes_cnt = jax.vmap(
+                lambda g, f, lm, nc: cap_splits_by_leaves(g, f, lm, nc,
+                                                          max_leaves))(
+                gain, feat, lmask, nodes_cnt)
+        feats.append(feat)
+        lmasks.append(lmask)
+        leaves.append(leaf)
+        gain_fi = gain_fi + jax.vmap(
+            lambda g, f: jax.ops.segment_sum(
+                jnp.where(f >= 0, jnp.maximum(g, 0.0),
+                          0.0).astype(jnp.float32),
+                jnp.maximum(f, 0), num_segments=c))(gain, feat)
+        hist_prev, feat_prev = hist, feat
+        node_idx = jax.vmap(
+            lambda ni, f, lm: _descend(bins, ni, f, lm))(node_idx, feat,
+                                                         lmask)
+        leaf_glob = jnp.where(node_idx >= 0,
+                              ((1 << (level + 1)) - 1) + node_idx,
+                              leaf_glob)
+    return (jnp.concatenate(feats, axis=1),
+            jnp.concatenate(lmasks, axis=1),
+            jnp.concatenate(leaves, axis=1), gain_fi, leaf_glob)
 
 
 def _level_leaf_sums(stats, node_idx, n_nodes: int, n_classes: int = 0):

@@ -44,6 +44,7 @@ class StatsProcessor(BasicProcessor):
                             header_path=self._abs(mc.dataSet.headerPath),
                             header_delimiter=mc.dataSet.headerDelimiter)
 
+        from ..config import environment
         from ..config.model_config import BinningAlgorithm
         from ..parallel.mesh import device_mesh
         exact_alg = mc.stats.binningAlgorithm in (BinningAlgorithm.MunroPat,
@@ -53,66 +54,116 @@ class StatsProcessor(BasicProcessor):
         # fan-out (``MapReducerStatsWorker.java:111-139``); degenerates to
         # the single-chip layout on a 1-device rig
         mesh = device_mesh()
-        num_acc = NumericAccumulator(n_cols=len(num_cols), exact=exact_alg,
-                                     unit_weight=not extractor.weight_name,
-                                     mesh=mesh)
+        num_acc = NumericAccumulator(
+            n_cols=len(num_cols), exact=exact_alg,
+            unit_weight=not extractor.weight_name, mesh=mesh,
+            fused_budget=environment.get_int(
+                "shifu.stats.fusedBudgetBytes", 1 << 30))
         cat_acc = CategoricalAccumulator()
         psi_col = mc.stats.psiColumnName if self.params.get("psi") or \
             mc.stats.psiColumnName else None
         rate = float(mc.stats.sampleRate)
-
-        # ---------------- pass 1: moments/min/max (numeric)
-        total_rows = 0
-        sweep_t0 = time.perf_counter()
-        with self.phase("pass1_moments") as ph:
-            for ci, chunk in enumerate(source.iter_chunks()):
-                ex = extractor.extract(_sample_raw(chunk, rate, ci))
-                if ex.n == 0:
-                    continue
-                total_rows += ex.n
-                if num_cols:
-                    num_acc.update_moments(ex.numeric, ex.numeric_valid)
-            ph.set(rows=total_rows)
-        if total_rows == 0:
-            raise RuntimeError("stats: dataset is empty after filtering")
-        if num_cols:
-            num_acc.finalize_range()
-
-        # ---------------- pass 2: fine histograms + categorical
-        # correlation piggybacks pass 2 when only numerics participate;
-        # categorical pos-rate encodings need finished bin stats (3rd pass)
+        # ONE-PASS fused sweep (default): moments + fine histogram +
+        # categorical aggregation in a single streamed read — each chunk
+        # is read, parsed and shipped H2D once (device-resident up to the
+        # fused budget; the overflow tail takes sketch-first provisional
+        # boundaries with device-side refinement, ops/sketches.py).
+        # MunroPat exact binning keeps the two-pass flow (it materializes
+        # rows anyway); ``-Dshifu.stats.onePass=false`` restores it.
+        fused = not exact_alg and environment.get_bool(
+            "shifu.stats.onePass", True)
         want_corr = bool(self.params.get("correlation"))
         corr_acc = None
-        if want_corr and num_cols and not cat_cols:
-            corr_acc = CorrelationAccumulator(
-                n_cols=len(num_cols), offset=num_acc.moments["mean"],
-                mesh=mesh)
-        psi_units: Dict[str, Dict[str, np.ndarray]] = {}
-        with self.phase("pass2_histograms").set(rows=total_rows):
-            for ci, chunk in enumerate(source.iter_chunks()):
-                ex = extractor.extract(_sample_raw(chunk, rate, ci),
-                                       keep_raw=psi_col is not None)
-                if ex.n == 0:
-                    continue
-                # multi-class: bin pos/neg stats binarize as class 0 vs rest
-                # so KS/IV/WOE stay defined (class ids are ordinal only)
-                tgt = (ex.target > 0).astype(ex.target.dtype) \
-                    if extractor.multiclass else ex.target
-                if num_cols:
-                    num_acc.update_histogram(ex.numeric, ex.numeric_valid,
+
+        def cat_update(ex, tgt) -> None:
+            missing_set = {m.strip().lower()
+                           for m in extractor.missing_values}
+            for cc in cat_cols:
+                vals = ex.categorical[cc.columnName]
+                import pandas as pd
+                s = pd.Series(vals, dtype=str).str.strip()
+                valid = (~s.str.lower().isin(missing_set)).to_numpy()
+                cat_acc.update(cc.columnName, s.to_numpy(), valid, tgt,
+                               ex.weight, stripped=True)
+
+        def binarized(ex):
+            # multi-class: bin pos/neg stats binarize as class 0 vs rest
+            # so KS/IV/WOE stay defined (class ids are ordinal only)
+            return (ex.target > 0).astype(ex.target.dtype) \
+                if extractor.multiclass else ex.target
+
+        total_rows = 0
+        sweep_t0 = time.perf_counter()
+        if fused:
+            with self.phase("fused_sweep") as ph:
+                for ci, chunk in enumerate(source.iter_chunks()):
+                    ex = extractor.extract(_sample_raw(chunk, rate, ci))
+                    if ex.n == 0:
+                        continue
+                    total_rows += ex.n
+                    tgt = binarized(ex)
+                    if num_cols:
+                        num_acc.update_fused(ex.numeric, ex.numeric_valid,
                                              tgt, ex.weight)
-                    if corr_acc is not None:
-                        corr_acc.update(np.nan_to_num(ex.numeric),
-                                        ex.numeric_valid)
-                missing_set = {m.strip().lower()
-                               for m in extractor.missing_values}
-                for cc in cat_cols:
-                    vals = ex.categorical[cc.columnName]
-                    import pandas as pd
-                    s = pd.Series(vals, dtype=str).str.strip()
-                    valid = (~s.str.lower().isin(missing_set)).to_numpy()
-                    cat_acc.update(cc.columnName, s.to_numpy(), valid, tgt,
-                                   ex.weight, stripped=True)
+                        if want_corr and not cat_cols:
+                            if corr_acc is None:
+                                # Pearson is shift-invariant; the first
+                                # chunk's means condition the f32 sums
+                                with np.errstate(invalid="ignore"):
+                                    off = np.nanmean(np.where(
+                                        ex.numeric_valid, ex.numeric,
+                                        np.nan), axis=0)
+                                corr_acc = CorrelationAccumulator(
+                                    n_cols=len(num_cols),
+                                    offset=np.nan_to_num(off), mesh=mesh)
+                            corr_acc.update(np.nan_to_num(ex.numeric),
+                                            ex.numeric_valid)
+                    cat_update(ex, tgt)
+                ph.set(rows=total_rows)
+            if total_rows == 0:
+                raise RuntimeError("stats: dataset is empty after "
+                                   "filtering")
+            if num_cols:
+                num_acc.finalize_fused()
+        else:
+            # ---------------- pass 1: moments/min/max (numeric)
+            with self.phase("pass1_moments") as ph:
+                for ci, chunk in enumerate(source.iter_chunks()):
+                    ex = extractor.extract(_sample_raw(chunk, rate, ci))
+                    if ex.n == 0:
+                        continue
+                    total_rows += ex.n
+                    if num_cols:
+                        num_acc.update_moments(ex.numeric, ex.numeric_valid)
+                ph.set(rows=total_rows)
+            if total_rows == 0:
+                raise RuntimeError("stats: dataset is empty after "
+                                   "filtering")
+            if num_cols:
+                num_acc.finalize_range()
+
+            # ---------------- pass 2: fine histograms + categorical
+            # correlation piggybacks pass 2 when only numerics
+            # participate; categorical pos-rate encodings need finished
+            # bin stats (3rd pass)
+            if want_corr and num_cols and not cat_cols:
+                corr_acc = CorrelationAccumulator(
+                    n_cols=len(num_cols), offset=num_acc.moments["mean"],
+                    mesh=mesh)
+            with self.phase("pass2_histograms").set(rows=total_rows):
+                for ci, chunk in enumerate(source.iter_chunks()):
+                    ex = extractor.extract(_sample_raw(chunk, rate, ci))
+                    if ex.n == 0:
+                        continue
+                    tgt = binarized(ex)
+                    if num_cols:
+                        num_acc.update_histogram(ex.numeric,
+                                                 ex.numeric_valid,
+                                                 tgt, ex.weight)
+                        if corr_acc is not None:
+                            corr_acc.update(np.nan_to_num(ex.numeric),
+                                            ex.numeric_valid)
+                    cat_update(ex, tgt)
         # ---------------- finalize numeric columns
         with self.phase("finalize"):
             if num_cols:

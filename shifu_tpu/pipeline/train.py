@@ -138,6 +138,14 @@ class TrainProcessor(BasicProcessor):
             # (TrainModelProcessor.java:395-449); tpu-native IS the bridge —
             # the same net trains as the jitted NN path
             if alg == Algorithm.TENSORFLOW:
+                # the probe step enforces this too; the direct-API path
+                # (callers constructing TrainProcessor without probe)
+                # must hit the same coded wall, not a silent remap
+                from ..config.meta import tf_ignored_param_problems
+                from ..config.validator import ValidationError
+                tf_problems = tf_ignored_param_problems(mc.train)
+                if tf_problems:
+                    raise ValidationError(tf_problems)
                 log.info("algorithm TENSORFLOW: training the same network "
                          "on the native jitted NN path (documented "
                          "deviation — no TF interop; the reference's "

@@ -45,7 +45,8 @@ from ..config.model_config import Algorithm
 from ..data.shards import Shards
 from ..models import tree as tree_model
 from ..ops.tree import (TreeArrays, best_splits, build_histograms,
-                        cap_splits_by_leaves, grow_tree_jit, n_tree_nodes,
+                        build_histograms_batch, cap_splits_by_leaves,
+                        grow_forest_jit, grow_tree_jit, n_tree_nodes,
                         node_index_at_level, predict_tree)
 from .early_stop import GBTEarlyStopDecider
 from .sampling import validation_split
@@ -76,6 +77,12 @@ class DTSettings:
     stats_exact: bool = False            # weights promised small-integer
                                          # (no weight column): RF hist
                                          # kernel skips f32-recovery dots
+    tree_batch: int = 0                  # RF same-round trees grown per
+                                         # batched device program; 0 = auto
+                                         # (RF_TREE_BATCH)
+    early_stop_check: int = 8            # trees between early-stop
+                                         # decisions (device-accumulated
+                                         # errors fetch in bulk)
 
 
 def settings_from_params(params: Dict[str, Any], train_conf,
@@ -100,7 +107,9 @@ def settings_from_params(params: Dict[str, Any], train_conf,
         poisson_bagging=alg != Algorithm.DT,  # plain DT = one tree, full data
         early_stop=bool(train_conf.earlyStopEnable),
         seed=int(p.get("Seed", 0)),
-        checkpoint_every=int(p.get("CheckpointInterval", 25)))
+        checkpoint_every=int(p.get("CheckpointInterval", 25)),
+        tree_batch=int(p.get("TreeBatch", 0)),
+        early_stop_check=max(1, int(p.get("EarlyStopCheckInterval", 8))))
 
 
 def subset_count(strategy: str, c: int) -> int:
@@ -184,10 +193,6 @@ def _gbt_round_impl(bins, y, tw, vw, f, fa, cat, lr, min_instances,
     return sf, lm, lv, gfi, f2, tr, va
 
 
-_gbt_round = partial(jax.jit, static_argnames=(
-    "n_bins", "depth", "impurity", "loss", "use_pallas",
-    "max_leaves", "has_cat", "mesh"))(_gbt_round_impl)
-
 
 def _gbt_forest_impl(bins, y, tw, vw, f, fa_all, cat, lr, min_instances,
                      min_gain, n_bins: int, depth: int, impurity: str,
@@ -270,31 +275,27 @@ def _rf_round_impl(bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
                               max_leaves, has_cat, mesh, stats_exact)
 
 
-def _rf_round_from_bag(bins, y, w, bag, oob_sum, oob_cnt, fa, cat,
-                       min_instances, min_gain, n_bins: int, depth: int,
-                       impurity: str, loss: str, n_classes: int = 0,
-                       use_pallas: bool = False, max_leaves: int = 0,
-                       has_cat: bool = True, mesh=None,
-                       stats_exact: bool = False):
-    """RF round body given a PRECOMPUTED bag — shared by the resident
-    path (Poisson drawn in-graph above) and the streamed mega path
-    (hash bags replayed on device, ``ops/hashing.py``)."""
-    multiclass = n_classes > 2
+def _rf_stats_from_bag(y, w, bag, n_classes: int):
+    """Per-row stat channels of one RF tree's bag — the ONE place the
+    channel layout lives (per-tree, batched and streamed paths must never
+    drift)."""
     bw = w * bag
-    if multiclass:
-        yi = y.astype(jnp.int32)
-        stats = bw[:, None] * jax.nn.one_hot(yi, n_classes,
-                                             dtype=jnp.float32)
-    else:
-        stats = jnp.stack([bw, bw * y], axis=1) \
-            .astype(jnp.float32)
-    sf, lm, lv, gfi, leaf_glob = grow_tree_jit(
-        bins, stats, cat, fa, n_bins, depth, impurity, min_instances,
-        min_gain, n_classes, use_pallas, max_leaves, has_cat, mesh,
-        stats_exact)
-    pred = jnp.take(lv, leaf_glob, axis=0)         # [n, K] mc, [n] binary
+    if n_classes > 2:
+        return bw[:, None] * jax.nn.one_hot(y.astype(jnp.int32), n_classes,
+                                            dtype=jnp.float32)
+    return jnp.stack([bw, bw * y], axis=1).astype(jnp.float32)
+
+
+def _rf_oob_update(pred, y, w, bag, oob_sum, oob_cnt, loss: str,
+                   n_classes: int):
+    """Out-of-bag vote accumulation + loss-consistent errors for ONE grown
+    tree (reference oob-as-validation, ``DTWorker.java:582-616``) —
+    shared by the per-tree round and the tree-batched round so their
+    error streams stay bit-identical.  Returns (oob_sum, oob_cnt, tr, va).
+    """
     oob = (bag == 0) & (w > 0)
-    if multiclass:
+    if n_classes > 2:
+        yi = y.astype(jnp.int32)
         oob_sum = oob_sum + jnp.where(oob[:, None], pred, 0.0)
         oob_cnt = oob_cnt + oob.astype(oob_cnt.dtype)
         seen = oob_cnt > 0
@@ -303,7 +304,7 @@ def _rf_round_from_bag(bins, y, w, bag, oob_sum, oob_cnt, fa, cat,
         wv = w * seen
         va = (per_v * wv).sum() / jnp.maximum(wv.sum(), 1e-9)
         tr = (per_t * w).sum() / jnp.maximum(w.sum(), 1e-9)
-        return sf, lm, lv, gfi, oob_sum, oob_cnt, tr, va
+        return oob_sum, oob_cnt, tr, va
     oob_sum = oob_sum + jnp.where(oob, pred, 0.0)
     oob_cnt = oob_cnt + oob.astype(oob_cnt.dtype)
     seen = oob_cnt > 0
@@ -320,6 +321,26 @@ def _rf_round_from_bag(bins, y, w, bag, oob_sum, oob_cnt, fa, cat,
         -(y * jnp.log(jnp.clip(pred, 1e-9, 1 - 1e-9))
           + (1 - y) * jnp.log(jnp.clip(1 - pred, 1e-9, 1 - 1e-9)))
     tr = (per_t * w).sum() / jnp.maximum(w.sum(), 1e-9)
+    return oob_sum, oob_cnt, tr, va
+
+
+def _rf_round_from_bag(bins, y, w, bag, oob_sum, oob_cnt, fa, cat,
+                       min_instances, min_gain, n_bins: int, depth: int,
+                       impurity: str, loss: str, n_classes: int = 0,
+                       use_pallas: bool = False, max_leaves: int = 0,
+                       has_cat: bool = True, mesh=None,
+                       stats_exact: bool = False):
+    """RF round body given a PRECOMPUTED bag — shared by the resident
+    path (Poisson drawn in-graph above) and the streamed mega path
+    (hash bags replayed on device, ``ops/hashing.py``)."""
+    stats = _rf_stats_from_bag(y, w, bag, n_classes)
+    sf, lm, lv, gfi, leaf_glob = grow_tree_jit(
+        bins, stats, cat, fa, n_bins, depth, impurity, min_instances,
+        min_gain, n_classes, use_pallas, max_leaves, has_cat, mesh,
+        stats_exact)
+    pred = jnp.take(lv, leaf_glob, axis=0)         # [n, K] mc, [n] binary
+    oob_sum, oob_cnt, tr, va = _rf_oob_update(
+        pred, y, w, bag, oob_sum, oob_cnt, loss, n_classes)
     return sf, lm, lv, gfi, oob_sum, oob_cnt, tr, va
 
 
@@ -360,21 +381,48 @@ def _pack_tree_impl(sf, lm, lv, gfi, tr, va):
 
 _pack_tree = jax.jit(_pack_tree_impl)
 
+# RF same-round trees grown per batched device program in the RESIDENT
+# path (``grow_forest_jit``): each level's TB histograms build in ONE
+# kernel launch with the bins one-hot shared across the batch.  8 matches
+# the tail-sweep batch and the progress burst size.
+RF_TREE_BATCH = 8
+
+
+def _effective_tree_batch(settings: DTSettings) -> int:
+    """The RF resident tree-batch width: ``TreeBatch`` train param /
+    ``SHIFU_TREE_BATCH`` env; 0 = auto (:data:`RF_TREE_BATCH`)."""
+    env = os.environ.get("SHIFU_TREE_BATCH")
+    if env:
+        return max(1, int(env))
+    return settings.tree_batch if settings.tree_batch > 0 \
+        else RF_TREE_BATCH
+
+
 def _rf_forest_impl(bins, y, w, base_key, tree_ids, bag_rate, oob_sum,
                     oob_cnt, fa_all, cat, min_instances, min_gain,
                     n_bins: int, depth: int, impurity: str, loss: str,
                     poisson: bool, n_classes: int, n_trees: int,
                     use_pallas: bool = False, max_leaves: int = 0,
                     has_cat: bool = True, mesh=None,
-                    stats_exact: bool = False):
+                    stats_exact: bool = False, tree_batch: int = 1):
     """A chunk of the RF forest as ONE executable (see :func:`_gbt_forest`).
     Per-tree keys fold the tree id into the base key on device — identical
-    draws to the per-tree path, so resumed and scanned runs agree."""
-    del n_trees
+    draws to the per-tree path, so resumed and scanned runs agree.
 
-    def body(carry, inp):
+    ``tree_batch > 1``: RF trees are mutually independent, so the scan
+    grows TB same-round trees per step through :func:`grow_forest_jit` —
+    each level's TB histograms build in ONE kernel launch (the reference's
+    ``DTMaster`` grows all RF trees of a round simultaneously,
+    ``DTMaster.java:91`` toDoQueue).  Bags/keys/oob votes replay the exact
+    per-tree stream (bags are per-tree key folds; oob votes chain through
+    the batch in tree order), so results are bit-identical to
+    ``tree_batch=1``; a chunk remainder past the last full batch runs the
+    per-tree scan."""
+    del n_trees
+    n = bins.shape[0]
+
+    def one_tree(carry, fa, ti):
         oob_sum, oob_cnt = carry
-        fa, ti = inp
         key = jax.random.fold_in(base_key, ti)
         sf, lm, lv, gfi, oob_sum2, oob_cnt2, tr, va = _rf_round_impl(
             bins, y, w, key, bag_rate, oob_sum, oob_cnt, fa, cat,
@@ -383,15 +431,57 @@ def _rf_forest_impl(bins, y, w, base_key, tree_ids, bag_rate, oob_sum,
             stats_exact)
         return (oob_sum2, oob_cnt2), _pack_tree_impl(sf, lm, lv, gfi, tr, va)
 
-    (oob_sum, oob_cnt), packed = jax.lax.scan(
-        body, (oob_sum, oob_cnt), (fa_all, tree_ids))
+    def body(carry, inp):
+        fa, ti = inp
+        return one_tree(carry, fa, ti)
+
+    def body_batched(carry, inp):
+        oob_sum, oob_cnt = carry
+        fa_b, ti_b = inp                       # [TB, C], [TB]
+        keys = jax.vmap(lambda t: jax.random.fold_in(base_key, t))(ti_b)
+        if poisson:
+            bags = jax.vmap(lambda k: jax.random.poisson(
+                k, bag_rate, (n,)).astype(jnp.float32))(keys)
+        else:
+            bags = jnp.ones((tree_batch, n), jnp.float32)
+        stats_b = jax.vmap(
+            lambda bag: _rf_stats_from_bag(y, w, bag, n_classes))(bags)
+        sf_b, lm_b, lv_b, gfi_b, lg_b = grow_forest_jit(
+            bins, stats_b, cat, fa_b, n_bins, depth, impurity,
+            min_instances, min_gain, n_classes, use_pallas, max_leaves,
+            has_cat, mesh, stats_exact)
+        packed = []
+        for j in range(tree_batch):            # oob votes chain in order
+            pred = jnp.take(lv_b[j], lg_b[j], axis=0)
+            oob_sum, oob_cnt, tr, va = _rf_oob_update(
+                pred, y, w, bags[j], oob_sum, oob_cnt, loss, n_classes)
+            packed.append(_pack_tree_impl(sf_b[j], lm_b[j], lv_b[j],
+                                          gfi_b[j], tr, va))
+        return (oob_sum, oob_cnt), jnp.stack(packed)
+
+    t_total = fa_all.shape[0]
+    tb = max(1, tree_batch)
+    main = (t_total // tb) * tb if tb > 1 else 0
+    parts = []
+    carry = (oob_sum, oob_cnt)
+    if main:
+        fa_g = fa_all[:main].reshape(main // tb, tb, fa_all.shape[1])
+        ti_g = tree_ids[:main].reshape(main // tb, tb)
+        carry, packed_g = jax.lax.scan(body_batched, carry, (fa_g, ti_g))
+        parts.append(packed_g.reshape(main, -1))
+    if main < t_total:
+        carry, packed_r = jax.lax.scan(
+            body, carry, (fa_all[main:], tree_ids[main:]))
+        parts.append(packed_r)
+    oob_sum, oob_cnt = carry
+    packed = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     return oob_sum, oob_cnt, packed
 
 
 _rf_forest = partial(jax.jit, static_argnames=(
     "n_bins", "depth", "impurity", "loss", "poisson", "n_classes",
     "n_trees", "use_pallas", "max_leaves", "has_cat",
-    "mesh", "stats_exact"))(_rf_forest_impl)
+    "mesh", "stats_exact", "tree_batch"))(_rf_forest_impl)
 
 
 @lru_cache(maxsize=None)
@@ -428,6 +518,15 @@ def _unpack_tree(vec: np.ndarray, total: int, n_bins: int, c: int,
                       leaf_value=lv, depth=depth)
     return tree, parts[3].astype(np.float64), float(parts[4][0]), \
         float(parts[4][1])
+
+
+def _fetch(x) -> np.ndarray:
+    """Device→host materialization of packed trainer results — the ONE
+    counted host-sync point.  The telemetry counter lets tests (and
+    ``analysis --telemetry``) pin that syncs scale with checkpoint/progress
+    intervals, not with trees (tentpole: sync-free growth)."""
+    obs.counter("train.host_syncs").inc()
+    return np.asarray(x)
 
 
 def _use_pallas(mesh) -> bool:
@@ -544,78 +643,54 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
     ckpt = settings.checkpoint_every if (checkpoint_fn and
                                          settings.checkpoint_every) else 0
 
-    def absorb(flat: np.ndarray, with_history: bool):
-        nonlocal fi
-        for vec in flat:
+    # whole-forest scan: one executable + one fetch per chunk — zero
+    # per-tree host round-trips.  A progress consumer gets its lines in
+    # bursts of 8 trees (the progress file is a tail surface, and
+    # per-tree fetches cost ~0.8 s each over a remote-device link).
+    # Early stop no longer forces a per-tree sync either: errors
+    # accumulate ON DEVICE inside the scan and the stop decision is
+    # checked every ``early_stop_check`` trees on the bulk-fetched error
+    # history; a mid-chunk trigger truncates the forest to the exact tree
+    # the per-tree loop would have stopped at (trees are a prefix), so
+    # results stay bit-identical at 1/K the syncs.
+    ti = len(trees)
+    stopped = False
+    while ti < settings.n_trees and not stopped:
+        chunk = settings.n_trees - ti
+        if ckpt:
+            chunk = min(chunk, ((ti // ckpt) + 1) * ckpt - ti)
+        if progress:
+            chunk = min(chunk, 8)
+        if settings.early_stop:
+            chunk = min(chunk, settings.early_stop_check)
+        fa_all = jnp.asarray(np.stack(
+            [_feat_subset(settings, c, t)
+             for t in range(ti, ti + chunk)]))
+        f, packed = _gbt_forest(
+            bins_d, y_d, tw_d, vw_d, f, fa_all, cat,
+            settings.learning_rate, settings.min_instances,
+            settings.min_gain, n_bins, settings.depth, imp,
+            settings.loss, chunk, up, settings.max_leaves, hc,
+            _hist_mesh(mesh))
+        for j, vec in enumerate(_fetch(packed)):
             tree, gfi, tr_err, va_err = _unpack_tree(
                 vec, total, n_bins, c, settings.depth)
             trees.append(tree)
             fi += gfi
-            if with_history:
-                history.append((tr_err, va_err))
-
-    if not settings.early_stop:
-        # whole-forest scan: one executable + one fetch per chunk — zero
-        # per-tree host round-trips.  A progress consumer gets its lines
-        # in bursts of 8 trees (the progress file is a tail surface, and
-        # per-tree fetches cost ~0.8 s each over a remote-device link)
-        ti = len(trees)
-        while ti < settings.n_trees:
-            chunk = settings.n_trees - ti
-            if ckpt:
-                chunk = min(chunk, ((ti // ckpt) + 1) * ckpt - ti)
-            if progress:
-                chunk = min(chunk, 8)
-            fa_all = jnp.asarray(np.stack(
-                [_feat_subset(settings, c, t)
-                 for t in range(ti, ti + chunk)]))
-            f, packed = _gbt_forest(
-                bins_d, y_d, tw_d, vw_d, f, fa_all, cat,
-                settings.learning_rate, settings.min_instances,
-                settings.min_gain, n_bins, settings.depth, imp,
-                settings.loss, chunk, up, settings.max_leaves, hc,
-                _hist_mesh(mesh))
-            before = len(history)
-            absorb(np.asarray(packed), with_history=True)
-            if progress:
-                for j, (tr_err, va_err) in enumerate(history[before:],
-                                                     start=ti):
-                    progress(j, tr_err, va_err)
-            ti += chunk
-            if ckpt and ti % ckpt == 0:
-                checkpoint_fn(trees, history, init_score)
-    else:
-        # per-tree loop: early stop decides after every tree; packed
-        # outputs still drain in batched fetches
-        pending: List[Any] = []
-
-        def drain():
-            if pending:
-                absorb(np.asarray(jnp.stack(pending)), with_history=False)
-                pending.clear()
-
-        for ti in range(len(trees), settings.n_trees):
-            fa = jnp.asarray(_feat_subset(settings, c, ti))
-            sf, lm, lv, gfi, f, tr, va = _gbt_round(
-                bins_d, y_d, tw_d, vw_d, f, fa, cat,
-                settings.learning_rate, settings.min_instances,
-                settings.min_gain, n_bins, settings.depth, imp,
-                settings.loss, up, settings.max_leaves, hc,
-                _hist_mesh(mesh))
-            pending.append(_pack_tree(sf, lm, lv, gfi, tr, va))
-            tr_err, va_err = (float(x) for x in
-                              np.asarray(jnp.stack([tr, va])))
             history.append((tr_err, va_err))
             if progress:
-                progress(ti, tr_err, va_err)
-            if ckpt and (ti + 1) % ckpt == 0:
-                drain()
-                checkpoint_fn(trees, history, init_score)
+                progress(ti + j, tr_err, va_err)
             if settings.early_stop and stopper.add(va_err):
-                obs.event("early_stop", trainer="gbt", tree=ti + 1)
-                log.info("GBT early stop after %d trees", ti + 1)
+                # ignore the chunk tail past the trigger — exactly the
+                # forest (and FI/history) the per-tree decision loop
+                # would have kept
+                obs.event("early_stop", trainer="gbt", tree=ti + j + 1)
+                log.info("GBT early stop after %d trees", ti + j + 1)
+                stopped = True
                 break
-        drain()
+        ti += chunk
+        if ckpt and not stopped and ti % ckpt == 0:
+            checkpoint_fn(trees, history, init_score)
     return ForestResult(
         trees=trees,
         spec_kwargs={"algorithm": "GBT", "loss": settings.loss,
@@ -699,9 +774,10 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
             settings.min_instances, settings.min_gain, n_bins,
             settings.depth, settings.impurity, settings.loss,
             settings.poisson_bagging, settings.n_classes, chunk, up,
-            settings.max_leaves, hc, _hist_mesh(mesh), se)
+            settings.max_leaves, hc, _hist_mesh(mesh), se,
+            _effective_tree_batch(settings))
         before = len(history)
-        absorb(np.asarray(packed), with_history=True)
+        absorb(_fetch(packed), with_history=True)
         if progress:
             for j, (tr_err, va_err) in enumerate(history[before:],
                                                  start=ti):
@@ -818,9 +894,12 @@ def train_gbt_bagged(bins, y, tw_m, vw_m, n_bins: int, cat_mask,
     fa_all = jnp.asarray(np.stack(
         [[_feat_subset(s, c, t) for t in range(s0.n_trees)]
          for s in settings_list]))                       # [B, T, C]
-    lr = jnp.asarray([s.learning_rate for s in settings_list])
-    mi = jnp.asarray([s.min_instances for s in settings_list])
-    mg = jnp.asarray([s.min_gain for s in settings_list])
+    # f32 pins the vmapped scan carry dtype under JAX_ENABLE_X64 rigs
+    lr = jnp.asarray([s.learning_rate for s in settings_list],
+                     jnp.float32)
+    mi = jnp.asarray([s.min_instances for s in settings_list],
+                     jnp.float32)
+    mg = jnp.asarray([s.min_gain for s in settings_list], jnp.float32)
     imp = "friedmanmse" if s0.impurity == "friedmanmse" else "variance"
     fn = _gbt_forest_multi(n_bins, s0.depth, imp, s0.loss, s0.n_trees,
                            _use_pallas(mesh), s0.max_leaves, hc,
@@ -859,12 +938,14 @@ def train_rf_bagged(bins, y, w_m, n_bins: int, cat_mask,
     base_key = jnp.stack([jax.random.PRNGKey(s.seed)
                           for s in settings_list])
     tree_ids = jnp.arange(s0.n_trees, dtype=jnp.uint32)
-    bag_rate = jnp.asarray([s.bagging_rate for s in settings_list])
+    bag_rate = jnp.asarray([s.bagging_rate for s in settings_list],
+                           jnp.float32)
     fa_all = jnp.asarray(np.stack(
         [[_feat_subset(s, c, t) for t in range(s0.n_trees)]
          for s in settings_list]))
-    mi = jnp.asarray([s.min_instances for s in settings_list])
-    mg = jnp.asarray([s.min_gain for s in settings_list])
+    mi = jnp.asarray([s.min_instances for s in settings_list],
+                     jnp.float32)
+    mg = jnp.asarray([s.min_gain for s in settings_list], jnp.float32)
     fn = _rf_forest_multi(n_bins, s0.depth, s0.impurity, s0.loss,
                           s0.poisson_bagging, s0.n_classes, s0.n_trees,
                           _use_pallas(mesh), s0.max_leaves, hc,
@@ -909,46 +990,35 @@ def _gbt_window_hist(hist, bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level",
                                    "use_pallas", "mesh", "n_classes",
                                    "stats_exact"))
-def _rf_window_hist(hist, bins_w, y_w, w_w, bag_w, sf, lm, n_nodes: int,
-                    n_bins: int, level: int, use_pallas: bool = False,
-                    mesh=None, n_classes: int = 0,
-                    stats_exact: bool = False):
-    """``hist`` accumulator as input — see :func:`_gbt_window_hist` on why
-    window programs must chain."""
-    bw_w = w_w * bag_w
-    node_idx = node_index_at_level(sf, lm, bins_w, level)
-    if n_classes > 2:      # NATIVE multiclass: per-class weight channels
-        stats = bw_w[:, None] * jax.nn.one_hot(
-            y_w.astype(jnp.int32), n_classes, dtype=jnp.float32)
-    else:
-        stats = jnp.stack([bw_w, bw_w * y_w], axis=1) \
-            .astype(jnp.float32)
-    return hist + build_histograms(bins_w, node_idx, stats, n_nodes,
-                                   n_bins, use_pallas, mesh, stats_exact)
-
-
-@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level",
-                                   "use_pallas", "mesh", "n_classes",
-                                   "stats_exact"))
 def _rf_window_hist_batch(hist_b, bins_w, y_w, w_w, bags_b, sf_b, lm_b,
                           n_nodes: int, n_bins: int, level: int,
                           use_pallas: bool = False, mesh=None,
                           n_classes: int = 0, stats_exact: bool = False):
-    """Tail-batch histogram sweep for ONE window as ONE executable.
+    """Tail-batch histogram sweep for ONE window as ONE executable — and,
+    since the multi-tree kernel round, ONE kernel launch: the TB trees'
+    level histograms build through :func:`build_histograms_batch` (the
+    bins one-hot is shared across the batch) instead of TB stacked
+    single-tree kernels.
 
     The per-tree histograms of a tail batch are mutually independent, and
     independent mesh programs that overlap deadlock XLA:CPU's in-process
     collectives (see :func:`_gbt_window_hist`) — dispatching them as TB
-    separate programs was the round-4 SIGABRT.  Folding the TB trees into
-    a single program keeps every collective in one totally-ordered
-    executable, chains across windows via the stacked ``hist_b``
-    accumulator input, and costs one dispatch per (window, level) instead
-    of TB."""
-    return jnp.stack([
-        _rf_window_hist(hist_b[j], bins_w, y_w, w_w, bags_b[j], sf_b[j],
-                        lm_b[j], n_nodes, n_bins, level, use_pallas, mesh,
-                        n_classes, stats_exact)
-        for j in range(hist_b.shape[0])])
+    separate programs was the round-4 SIGABRT.  The single program keeps
+    every collective in one totally-ordered executable and chains across
+    windows via the stacked ``hist_b`` accumulator input."""
+    node_b = jax.vmap(
+        lambda sf, lm: node_index_at_level(sf, lm, bins_w, level))(
+        sf_b, lm_b)
+    bw_b = w_w[None, :] * bags_b
+    if n_classes > 2:      # NATIVE multiclass: per-class weight channels
+        stats_b = bw_b[:, :, None] * jax.nn.one_hot(
+            y_w.astype(jnp.int32), n_classes, dtype=jnp.float32)[None]
+    else:
+        stats_b = jnp.stack([bw_b, bw_b * y_w[None, :]], axis=2) \
+            .astype(jnp.float32)
+    return hist_b + build_histograms_batch(bins_w, node_b, stats_b,
+                                           n_nodes, n_bins, use_pallas,
+                                           mesh, stats_exact)
 
 
 @partial(jax.jit, static_argnames=("depth", "loss"))
@@ -1325,7 +1395,9 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
 
     cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
     hc = bool(np.asarray(cat).any())
-    fi_dev = jnp.zeros(c, jnp.float32)     # device-accumulated split gains
+    fi_parts: List[np.ndarray] = []    # per-tree split gains [C] (ride the
+                                       # packed fetch; a mid-batch early
+                                       # stop drops the tail's parts too)
 
     f = None if init_d is not None else np.full(n_rows, init_score,
                                                 np.float32)
@@ -1360,24 +1432,26 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
     pending_fused: List[Any] = []
 
     def absorb_fused(flat_list) -> None:
-        nonlocal fi_dev
         for packed in flat_list:
             tree, fi_h, sums = _unpack_streamed(packed, total, n_bins, c,
                                                 settings.depth)
-            fi_dev = fi_dev + jnp.asarray(fi_h)
+            fi_parts.append(fi_h.astype(np.float64))
             trees.append(tree)
             history.append((float(sums[0]) / max(float(sums[1]), 1e-9),
                             float(sums[2]) / max(float(sums[3]), 1e-9)))
 
     def drain_fused() -> None:
         if pending_fused:
-            absorb_fused(np.asarray(jnp.stack(pending_fused)))
+            absorb_fused(_fetch(jnp.stack(pending_fused)))
             pending_fused.clear()
 
-    # early stop must see every tree's error as it lands; a progress
-    # consumer only needs lines, batched by the shared flusher
+    # early stop reads the bulk-fetched error stream every
+    # ``early_stop_check`` trees; a progress consumer's lines batch
+    # through the shared flusher
     flush_progress, mark_progress = _progress_flusher(
         drain_fused, history, progress, len(trees) - len(history))
+    es_checked = len(history)       # stopper already replayed these
+    h0 = len(history)               # fi_parts align with history[h0:]
 
     # fully-resident: COALESCE the windows into one device-resident row
     # block once and run the RESIDENT per-tree round on it — the
@@ -1401,26 +1475,38 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                 settings.min_gain, n_bins, settings.depth, imp,
                 settings.loss, up, settings.max_leaves, hc,
                 _hist_mesh(mesh))
-            if settings.early_stop:
-                absorb_fused([np.asarray(packed_d)])
-                tr_err, va_err = history[-1]
-                if progress:
-                    progress(ti, tr_err, va_err)
-                mark_progress()
-            else:
-                pending_fused.append(packed_d)
-                if progress and len(pending_fused) >= 8:
-                    flush_progress()
+            pending_fused.append(packed_d)
+            # early stop checks the bulk-fetched error stream every
+            # ``early_stop_check`` trees (device-side accumulation in
+            # between — no per-tree sync); a mid-batch trigger truncates
+            # to the exact tree the per-tree decision would have kept
+            if settings.early_stop and \
+                    (len(pending_fused) >= settings.early_stop_check
+                     or ti + 1 == settings.n_trees):
+                drain_fused()
+                triggered = None
+                for j, (_, va_err) in enumerate(history[es_checked:]):
+                    if stopper.add(va_err):
+                        triggered = es_checked + j
+                        break
+                if triggered is not None:
+                    kept = triggered + 1
+                    del trees[kept + len(trees) - len(history):]
+                    del fi_parts[kept - h0:]
+                    del history[kept:]
+                    obs.event("early_stop", trainer="gbt_streamed",
+                              tree=len(trees))
+                    log.info("GBT early stop after %d trees (streamed)",
+                             len(trees))
+                    break
+                es_checked = len(history)
+                flush_progress()
+            elif progress and len(pending_fused) >= 8:
+                flush_progress()
             if checkpoint_fn and settings.checkpoint_every and \
                     (ti + 1) % settings.checkpoint_every == 0:
                 flush_progress()
                 checkpoint_fn(trees, history, init_host())
-            if settings.early_stop and \
-                    stopper.add(history[-1][1]):
-                obs.event("early_stop", trainer="gbt_streamed", tree=ti + 1)
-                log.info("GBT early stop after %d trees (streamed)",
-                         ti + 1)
-                break
             continue
         sf = jnp.full(total, -1, jnp.int32)
         lm = jnp.zeros((total, n_bins), bool)
@@ -1456,13 +1542,14 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
             else:
                 s, e = it.start, it.start + it.n_valid
                 f[s:e] = np.asarray(f2)[:it.n_valid]
-        absorb_fused([np.asarray(jnp.concatenate([
+        absorb_fused([_fetch(jnp.concatenate([
             sf.astype(jnp.float32), _pack_mask_bits(lm),
             lv, fi_add, sums_dev]))])
         tr_err, va_err = history[-1]
         if progress:
             progress(ti, tr_err, va_err)
         mark_progress()
+        es_checked = len(history)      # disk-tail trees feed the stopper
         if checkpoint_fn and settings.checkpoint_every and \
                 (ti + 1) % settings.checkpoint_every == 0:
             checkpoint_fn(trees, history, init_host())
@@ -1478,7 +1565,8 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                      "init_score": init_host()},
         train_error=history[-1][0] if history else float("nan"),
         valid_error=history[-1][1] if history else float("nan"),
-        feature_importance=np.asarray(fi_dev, np.float64),
+        feature_importance=(np.sum(fi_parts, axis=0) if fi_parts
+                            else np.zeros(c)),
         trees_built=len(trees), history=history,
         disk_passes=cache.disk_passes)
 
@@ -1730,7 +1818,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
 
     def drain_rf() -> None:
         if pending_rf:
-            absorb_rf(np.asarray(jnp.stack(pending_rf)))
+            absorb_rf(_fetch(jnp.stack(pending_rf)))
             pending_rf.clear()
 
     flush_progress_rf, mark_progress_rf = _progress_flusher(
@@ -1838,7 +1926,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                 s, e = it.start, it.start + it.n_valid
                 oob_sum[s:e] = np.asarray(osw)[:it.n_valid]
                 oob_cnt[s:e] = np.asarray(ocw)[:it.n_valid]
-        absorb_rf(np.asarray(_pack_streamed_stacked(
+        absorb_rf(_fetch(_pack_streamed_stacked(
             sf_b, lm_b, lv_b, fi_b, sums_b)))
         if progress:
             for j, t in enumerate(tis):

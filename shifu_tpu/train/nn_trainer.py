@@ -89,12 +89,35 @@ def _gather_np(a) -> np.ndarray:
     return np.asarray(a)
 
 
-def _pack_leaves_impl(leaves):
+def _pack_leaves_impl(leaves, mesh=None):
     """Flatten a tuple of 4-byte-dtype arrays into ONE f32 vector (bitcast,
-    not convert — int leaves round-trip exactly)."""
+    not convert — int leaves round-trip exactly).
+
+    Each flat leaf is constrained to REPLICATED before the concatenate:
+    this toolchain's partitioner mis-lowers a concatenate of
+    ensemble-sharded flat vectors whose lengths don't divide the mesh —
+    the output arrives as UNREDUCED partial sums (every value scaled by
+    the data-axis size).  The explicit constraint forces the resharding
+    BEFORE the concatenate, where it is a plain allgather."""
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        rep = NamedSharding(mesh, P())
+        return jnp.concatenate([
+            jax.lax.with_sharding_constraint(
+                jax.lax.bitcast_convert_type(l, jnp.float32).reshape(-1),
+                rep)
+            for l in leaves])
     return jnp.concatenate([
         jax.lax.bitcast_convert_type(l, jnp.float32).reshape(-1)
         for l in leaves])
+
+
+@lru_cache(maxsize=None)
+def _pack_leaves_meshed(mesh):
+    """Single-controller packer pinned to ``mesh`` (see the partial-sum
+    trap in :func:`_pack_leaves_impl`)."""
+    return jax.jit(partial(_pack_leaves_impl, mesh=mesh))
 
 
 _pack_leaves = jax.jit(_pack_leaves_impl)
@@ -107,7 +130,7 @@ def _pack_leaves_replicated(mesh):
     program, after which each process reads its own addressable copy."""
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
-    return jax.jit(_pack_leaves_impl,
+    return jax.jit(partial(_pack_leaves_impl, mesh=mesh),
                    out_shardings=NamedSharding(mesh, P()))
 
 
@@ -124,15 +147,21 @@ def _to_host(tree):
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves or any(l.dtype.itemsize != 4 for l in leaves):
         return jax.tree_util.tree_map(_gather_np, tree)
+    shardings = [getattr(l, "sharding", None) for l in leaves]
+    meshed = (all(hasattr(sh, "mesh") for sh in shardings)
+              and len({sh.mesh for sh in shardings}) == 1)
     if jax.process_count() > 1:
-        shardings = [getattr(l, "sharding", None) for l in leaves]
-        if any(not hasattr(sh, "mesh") for sh in shardings) or \
-                len({sh.mesh for sh in shardings}) != 1:
+        if not meshed:
             # heterogeneous/mesh-less leaves cannot ride one pinned
             # program — keep the conservative per-leaf gather for them
             return jax.tree_util.tree_map(_gather_np, tree)
         flat = np.asarray(
             _pack_leaves_replicated(shardings[0].mesh)(tuple(leaves)))
+    elif meshed and shardings[0].mesh.size > 1:
+        # mesh-sharded leaves take the constrained packer (see the
+        # partial-sum trap in _pack_leaves_impl)
+        flat = np.asarray(_pack_leaves_meshed(shardings[0].mesh)(
+            tuple(leaves)))
     else:
         flat = np.asarray(_pack_leaves(tuple(leaves)))
     out, off = [], 0

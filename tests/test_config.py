@@ -149,8 +149,9 @@ def test_profile_json_written(model_set):
     assert TrainProcessor(model_set, params={}).run() == 0
     prof = json.load(open(os.path.join(model_set, "tmp", "profile.json")))
     assert prof["STATS"]["total_s"] > 0
-    assert "pass1_moments" in prof["STATS"]["phases_s"]
-    assert "pass2_histograms" in prof["STATS"]["phases_s"]
+    # the default stats plane is the fused one-pass sweep (moments +
+    # histograms in one streamed read)
+    assert "fused_sweep" in prof["STATS"]["phases_s"]
     assert "train" in prof["TRAIN"]["phases_s"]
     assert "load_data" in prof["TRAIN"]["phases_s"]
 

@@ -56,7 +56,10 @@ def test_device_sweep_matches_host_exactly():
     weights = rng.random(n) + 0.5
     host = evaluate_scores(scores, targets, weights)
     import jax
-    with jax.enable_x64():        # exactness check at f64 (TPU runs f32)
+    enable_x64 = getattr(jax, "enable_x64", None)
+    if enable_x64 is None:                 # jax<0.5 spells it experimental
+        from jax.experimental import enable_x64
+    with enable_x64():            # exactness check at f64 (TPU runs f32)
         curves, dev = evaluate_scores_device(scores, targets, weights)
     assert dev.areaUnderRoc == pytest.approx(host.areaUnderRoc, abs=1e-12)
     assert dev.weightedAuc == pytest.approx(host.weightedAuc, abs=1e-12)

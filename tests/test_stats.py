@@ -360,3 +360,112 @@ def test_correlation_pairwise_complete_and_categorical(model_set):
         pd.to_numeric(raw["age_days"], errors="coerce"))
     np.testing.assert_allclose(df.loc["amount", "age_days"], expect,
                                atol=1e-4)
+
+
+# ------------------------------------------------- fused one-pass sweep
+def test_fused_sweep_bit_matches_two_pass(rng):
+    """Resident fused sweep (chunks retained on device, ONE read + ONE
+    H2D) must be BIT-identical to the two-pass flow — same kernels, same
+    inputs, same order."""
+    n, C = 24000, 4
+    x = rng.normal(5, 3, size=(n, C))
+    x[:, 2] *= 50
+    valid = rng.random((n, C)) > 0.07
+    y = (rng.random(n) < 0.3).astype(float)
+    w = rng.uniform(0.5, 2.0, n)
+
+    two = NumericAccumulator(n_cols=C, num_buckets=256)
+    for s in range(0, n, 7000):
+        two.update_moments(x[s:s + 7000], valid[s:s + 7000])
+    two.finalize_range()
+    for s in range(0, n, 7000):
+        two.update_histogram(x[s:s + 7000], valid[s:s + 7000],
+                             y[s:s + 7000], w[s:s + 7000])
+    one = NumericAccumulator(n_cols=C, num_buckets=256)
+    for s in range(0, n, 7000):
+        one.update_fused(x[s:s + 7000], valid[s:s + 7000], y[s:s + 7000],
+                         w[s:s + 7000])
+    one.finalize_fused()
+    ra = two.finalize_sketch(BinningMethod.EqualTotal, 12)
+    rb = one.finalize_sketch(BinningMethod.EqualTotal, 12)
+    for c in range(C):
+        np.testing.assert_array_equal(ra[0][c], rb[0][c])   # boundaries
+        np.testing.assert_array_equal(ra[1][c], rb[1][c])   # bin stats
+    np.testing.assert_array_equal(ra[2], rb[2])             # percentiles
+    np.testing.assert_array_equal(ra[3], rb[3])             # distinct
+
+
+def test_fused_sweep_overflow_refinement_within_bucket(rng):
+    """Past the device budget the fused sweep accumulates on the
+    PROVISIONAL grid and refines on device: counts conserved exactly,
+    boundaries within one provisional bucket of the exact sweep."""
+    n, C, K = 24000, 3, 256
+    x = rng.normal(0, 2, size=(n, C))
+    valid = rng.random((n, C)) > 0.05
+    y = (rng.random(n) < 0.3).astype(float)
+    w = np.ones(n)
+    chunk = 6000
+    exact_acc = NumericAccumulator(n_cols=C, num_buckets=K)
+    for s in range(0, n, chunk):
+        exact_acc.update_moments(x[s:s + chunk], valid[s:s + chunk])
+    exact_acc.finalize_range()
+    for s in range(0, n, chunk):
+        exact_acc.update_histogram(x[s:s + chunk], valid[s:s + chunk],
+                                   y[s:s + chunk], w[s:s + chunk])
+    # budget fits ~1.5 chunks: chunks 2..4 go through the provisional grid
+    budget = int(1.5 * chunk * (5 * C + 8))
+    fused = NumericAccumulator(n_cols=C, num_buckets=K,
+                               fused_budget=budget)
+    for s in range(0, n, chunk):
+        fused.update_fused(x[s:s + chunk], valid[s:s + chunk],
+                           y[s:s + chunk], w[s:s + chunk])
+    assert fused._prov_hist_dev is not None     # overflow really happened
+    fused.finalize_fused()
+    ra = exact_acc.finalize_sketch(BinningMethod.EqualTotal, 10)
+    rb = fused.finalize_sketch(BinningMethod.EqualTotal, 10)
+    # total counts conserved exactly (valid cells all land somewhere)
+    tot_a = np.sum([g[:, :2].sum() for g in ra[1]])
+    tot_b = np.sum([g[:, :2].sum() for g in rb[1]])
+    assert tot_a == tot_b
+    # boundaries within ~1 provisional bucket (1.5x range / K)
+    for c in range(C):
+        span = (exact_acc.hi[c] - exact_acc.lo[c]) * 1.5 / K
+        m = min(len(ra[0][c]), len(rb[0][c]))
+        np.testing.assert_allclose(ra[0][c][1:m], rb[0][c][1:m],
+                                   atol=1.01 * span)
+
+
+def test_fused_sweep_is_stats_default_and_matches_two_pass(model_set):
+    """End-to-end: the stats step defaults to the fused sweep and writes
+    the SAME ColumnConfig stats the two-pass flow does
+    (``-Dshifu.stats.onePass=false`` restores two-pass)."""
+    import json
+    import shutil
+
+    from shifu_tpu.config import environment
+
+    set2 = model_set + "_twopass"
+    shutil.copytree(model_set, set2)
+    assert InitProcessor(model_set).run() == 0
+    assert StatsProcessor(model_set, params={}).run() == 0
+    environment.set_property("shifu.stats.onePass", "false")
+    try:
+        assert InitProcessor(set2).run() == 0
+        assert StatsProcessor(set2, params={}).run() == 0
+    finally:
+        environment.set_property("shifu.stats.onePass", "true")
+    cc1 = json.load(open(os.path.join(model_set, "ColumnConfig.json")))
+    cc2 = json.load(open(os.path.join(set2, "ColumnConfig.json")))
+    assert cc1 == cc2
+
+
+def test_num_buckets_must_be_mxu_tile_aligned():
+    """The fine-histogram bucket axis must stay a multiple of 64 in
+    [64, 4096] — the two-level one-hot kernel's tile factorization
+    (hi*64+lo); a misaligned count would silently fall off the MXU
+    path."""
+    for bad in (100, 63, 4097, 8192, 0):
+        with pytest.raises(ValueError, match="MXU-tile-aligned"):
+            NumericAccumulator(n_cols=3, num_buckets=bad)
+    NumericAccumulator(n_cols=3, num_buckets=64)
+    NumericAccumulator(n_cols=3, num_buckets=4096)

@@ -111,18 +111,14 @@ def test_wdl_save_load_roundtrip(tmp_path):
     np.testing.assert_allclose(m.compute(x_num, x_cat), want, rtol=1e-6)
 
 
-def test_wdl_pipeline_end_to_end(model_set):
+def test_wdl_pipeline_end_to_end(prepared_set):
     from shifu_tpu.config import ModelConfig
     from shifu_tpu.config.model_config import Algorithm
-    from shifu_tpu.pipeline.create import InitProcessor
-    from shifu_tpu.pipeline.stats import StatsProcessor
-    from shifu_tpu.pipeline.norm import NormalizeProcessor
     from shifu_tpu.pipeline.train import TrainProcessor
     from shifu_tpu.pipeline.evaluate import EvalProcessor
     import json
 
-    assert InitProcessor(model_set).run() == 0
-    assert StatsProcessor(model_set, params={}).run() == 0
+    model_set = prepared_set          # init/stats/norm ran in the template
     mc_path = os.path.join(model_set, "ModelConfig.json")
     mc = ModelConfig.load(mc_path)
     mc.train.algorithm = Algorithm.WDL
@@ -130,7 +126,6 @@ def test_wdl_pipeline_end_to_end(model_set):
     mc.train.params = {"NumHiddenNodes": [16], "ActivationFunc": ["relu"],
                        "EmbedDim": 4, "LearningRate": 0.01, "MiniBatchs": 512}
     mc.save(mc_path)
-    assert NormalizeProcessor(model_set, params={}).run() == 0
     assert TrainProcessor(model_set, params={}).run() == 0
     assert os.path.isfile(os.path.join(model_set, "models", "model0.wdl"))
     assert EvalProcessor(model_set, params={"run_eval": ""}).run() == 0
@@ -189,15 +184,13 @@ def test_wdl_pipeline_grid_search(prepared_set):
     assert "Trial [1]" in progress
 
 
-def test_wdl_pipeline_streamed(model_set):
+def test_wdl_pipeline_streamed(prepared_set):
     """WDL trains streamed (forced) through the pipeline and still scores."""
     from shifu_tpu.config import ModelConfig, environment
-    from shifu_tpu.pipeline.create import InitProcessor
-    from shifu_tpu.pipeline.stats import StatsProcessor
-    from shifu_tpu.pipeline.norm import NormalizeProcessor
     from shifu_tpu.pipeline.train import TrainProcessor
     from shifu_tpu.pipeline.evaluate import EvalProcessor
 
+    model_set = prepared_set          # init/stats/norm ran in the template
     mcp = os.path.join(model_set, "ModelConfig.json")
     mc = ModelConfig.load(mcp)
     mc.train.algorithm = "WDL"
@@ -207,9 +200,6 @@ def test_wdl_pipeline_streamed(model_set):
                        "EmbedDim": 4, "NumHiddenNodes": [8],
                        "ActivationFunc": ["relu"]}
     mc.save(mcp)
-    assert InitProcessor(model_set).run() == 0
-    assert StatsProcessor(model_set, params={}).run() == 0
-    assert NormalizeProcessor(model_set, params={}).run() == 0
     environment.set_property("shifu.train.streaming", "on")
     environment.set_property("shifu.train.windowRows", 512)
     try:
