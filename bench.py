@@ -23,14 +23,17 @@ def main() -> None:
     from shifu_tpu.bench import run_benchmark
 
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--plane", choices=("all", "tail", "rf-repeat", "e2e"),
+    ap.add_argument("--plane",
+                    choices=("all", "tail", "rf-repeat", "e2e", "resume"),
                     default="all",
                     help="'tail' = quick disk-tail streamed-GBT bench; "
                          "'rf-repeat' = RF variance triage (cold-compile "
                          "vs warm-window decomposition); 'e2e' = scripted "
                          "init->stats->norm->train(GBT+NN)->eval rehearsal "
                          "(SHIFU_BENCH_E2E_ROWS sets the row count, "
-                         "default 10M)")
+                         "default 10M); 'resume' = restart-recovery "
+                         "overhead (time-to-first-tree from a mid-forest "
+                         "checkpoint vs cold/warm starts)")
     args = ap.parse_args()
 
     result = run_benchmark(plane=args.plane)

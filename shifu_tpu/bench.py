@@ -529,6 +529,64 @@ def bench_pipeline_e2e(n_rows: int = None,
     return out
 
 
+def bench_resume(n_rows: int = 1 << 16, n_features: int = 64,
+                 n_bins: int = 64, n_trees: int = 24,
+                 depth: int = 5) -> Dict[str, Any]:
+    """Resume-overhead plane (``bench.py --plane resume``): how long until
+    the FIRST NEW TREE lands after a restart from a mid-forest checkpoint
+    vs a start from scratch.  Three windows:
+
+    - ``cold_first_tree_s``   fresh process state: XLA compile + ingest +
+      tree 0 (what a cold `train` pays);
+    - ``warm_first_tree_s``   second from-scratch run, executables cached
+      (isolates compile from the comparison);
+    - ``resume_first_tree_s`` restore 2/3 of the forest and grow the next
+      tree — the checkpoint-replay overhead (f rebuilt by replaying the
+      committed trees) plus one tree.
+
+    ``resume_overhead_vs_warm`` is the honest headline: the replay cost a
+    restarted run pays before producing new work."""
+    from shifu_tpu.train.dt_trainer import DTSettings, train_gbt
+
+    rng = np.random.default_rng(0)
+    bins = rng.integers(0, n_bins, size=(n_rows, n_features)).astype(np.int32)
+    y = (rng.random(n_rows) < 0.3).astype(np.float32)
+    w = np.ones(n_rows, np.float32)
+    cat = np.zeros(n_features, bool)
+    settings = DTSettings(n_trees=n_trees, depth=depth, loss="log",
+                          learning_rate=0.1)
+
+    def window(init_trees=None, start_history=None):
+        marks = {}
+        t0 = time.perf_counter()
+
+        def progress(ti, tr, va):
+            marks.setdefault("first", time.perf_counter() - t0)
+        res = train_gbt(bins, y, w, n_bins, cat, settings,
+                        progress=progress, init_trees=init_trees,
+                        start_history=start_history)
+        return res, marks["first"], time.perf_counter() - t0
+
+    cold_res, cold_first, cold_total = window()
+    _, warm_first, warm_total = window()
+    k = (2 * n_trees) // 3                 # the "checkpoint" restore point
+    _, resume_first, resume_total = window(
+        init_trees=list(cold_res.trees[:k]),
+        start_history=list(cold_res.history[:k]))
+    return {
+        "resume_first_tree_s": round(resume_first, 4),
+        "cold_first_tree_s": round(cold_first, 4),
+        "warm_first_tree_s": round(warm_first, 4),
+        "resume_overhead_vs_warm": round(resume_first - warm_first, 4),
+        "resume_total_s": round(resume_total, 4),
+        "cold_total_s": round(cold_total, 4),
+        "warm_total_s": round(warm_total, 4),
+        "restored_trees": k,
+        "shape": f"{n_rows} rows x {n_features} feats, {n_trees} trees "
+                 f"depth {depth}, restore at {k}",
+    }
+
+
 def _check_schema_handshake() -> None:
     if BENCH_TELEMETRY_SCHEMA != obs.SCHEMA_VERSION:
         raise RuntimeError(
@@ -596,9 +654,23 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
             "telemetry_schema_version": BENCH_TELEMETRY_SCHEMA,
             "extra": rep,
         }
+    if plane == "resume":
+        with obs.span("bench.resume", kind="bench"):
+            rep = bench_resume()
+        for k, v in rep.items():
+            if isinstance(v, (int, float)):
+                obs.gauge(f"bench.resume_{k}").set(float(v))
+        return {
+            "metric": "resume_first_tree_s",
+            "value": rep["resume_first_tree_s"],
+            "unit": "seconds",
+            "plane": "resume",
+            "telemetry_schema_version": BENCH_TELEMETRY_SCHEMA,
+            "extra": rep,
+        }
     if plane not in (None, "all"):
         raise ValueError(
-            f"unknown bench plane {plane!r} (tail|rf-repeat|e2e|all)")
+            f"unknown bench plane {plane!r} (tail|rf-repeat|e2e|resume|all)")
     nn_rows_per_sec = bench_nn()
     obs.gauge("bench.nn_train_throughput").set(nn_rows_per_sec)
     extras: Dict[str, Any] = {}

@@ -34,6 +34,10 @@ class ErrorCode(enum.Enum):
     # cluster steps fail inside Pig/Hadoop instead)
     ERROR_STEP_PRECONDITION = (
         1061, "A prerequisite pipeline step has not run")
+    # rebuild-specific: a step's commit journal says its artifacts are
+    # torn/incomplete (crash-consistency layer, pipeline/journal.py)
+    ERROR_TORN_ARTIFACT = (
+        1062, "A pipeline artifact is torn or incomplete")
     # --- data shape (1150s)
     ERROR_EXCEED_COL = (1151, "Input data has more fields than the header")
     ERROR_LESS_COL = (1152, "Input data has fewer fields than the header")
@@ -41,6 +45,10 @@ class ErrorCode(enum.Enum):
         1153, "Input data length is not equal to column config size")
     ERROR_NO_TARGET_COLUMN = (1154, "No target column in training data")
     ERROR_INVALID_TARGET_VALUE = (1155, "Invalid target value")
+    # rebuild-specific: quarantined bad rows/shards exceeded
+    # shifu.data.badThreshold (bounded bad-input tolerance)
+    ERROR_BAD_DATA_THRESHOLD = (
+        1156, "Malformed input exceeded the configured bad-data threshold")
     # --- models (1250s)
     ERROR_MODEL_FILE_NOT_FOUND = (1250, "The model file is not found")
     ERROR_FAIL_TO_LOAD_MODEL_FILE = (1251, "Failed to load the model file")

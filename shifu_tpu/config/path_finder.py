@@ -98,6 +98,20 @@ class PathFinder:
     def checkpoint_dir(self) -> str:
         return os.path.join(self.tmp_dir, "checkpoints")
 
+    # ------------------------------------------------------------ journals
+    @property
+    def journal_dir(self) -> str:
+        """Per-step commit journals (crash consistency, pipeline/journal)."""
+        return os.path.join(self.tmp_dir, "journal")
+
+    def journal_path(self, step: str) -> str:
+        return os.path.join(self.journal_dir, f"{step}.json")
+
+    @property
+    def stats_partial_path(self) -> str:
+        """Mid-sweep stats accumulator checkpoint (resume support)."""
+        return os.path.join(self.stats_dir, "partial_sweep.npz")
+
     @property
     def progress_path(self) -> str:
         return os.path.join(self.tmp_dir, "train.progress")
@@ -161,5 +175,6 @@ class PathFinder:
 
     def ensure_dirs(self) -> None:
         for d in (self.tmp_dir, self.stats_dir, self.models_dir,
-                  self.tmp_models_dir, self.checkpoint_dir):
+                  self.tmp_models_dir, self.checkpoint_dir,
+                  self.journal_dir):
             os.makedirs(d, exist_ok=True)

@@ -13,12 +13,15 @@ from __future__ import annotations
 import glob
 import gzip
 import io
+import logging
 import os
 from dataclasses import dataclass
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 import pandas as pd
+
+log = logging.getLogger(__name__)
 
 
 # Hadoop-cluster filesystems stay gated (no libhdfs in this runtime);
@@ -212,42 +215,100 @@ class DataSource:
         self.header = header
 
     def iter_chunks(self, chunk_rows: int = 262144) -> Iterator[RawChunk]:
-        """Yield RawChunks of up to ``chunk_rows`` rows across all files."""
-        from .. import obs
+        """Yield RawChunks of up to ``chunk_rows`` rows across all files.
+
+        Transient ``OSError``s on shard open ride the bounded-retry
+        ladder (``ioutil.io_retry``).  With ``shifu.data.badThreshold``
+        > 0, structurally-bad input (wrong column count, unreadable
+        file) is QUARANTINED — counted, logged with provenance, dropped
+        — instead of aborting the run; the run still fails with a coded
+        error if the quarantined fraction exceeds the threshold
+        (reference Shifu's bad-record tolerance)."""
+        from .. import faults, obs
+        from ..config import environment
+        from ..config.errors import ErrorCode, ShifuError
+        from ..ioutil import io_retry
         bytes_c = obs.counter("ingest.bytes_read")
         if self.parquet:
             yield from self._iter_parquet(chunk_rows)
             return
-        for path in self.files:
+        bad_threshold = environment.get_float("shifu.data.badThreshold", 0.0)
+        q_rows = obs.counter("data.quarantined_rows")
+        q_shards = obs.counter("data.quarantined_shards")
+        quarantined_rows = yielded_rows = quarantined_files = 0
+        provenance: List[str] = []
+
+        def quarantine(what: str, rows: int = 0, files: int = 0) -> None:
+            nonlocal quarantined_rows, quarantined_files
+            quarantined_rows += rows
+            quarantined_files += files
+            q_rows.inc(rows)
+            q_shards.inc(files)
+            provenance.append(what)
+            log.warning("bad input quarantined: %s (%d rows, %d files "
+                        "quarantined so far)", what,
+                        quarantined_rows, quarantined_files)
+
+        for fi, path in enumerate(self.files):
             try:                  # raw ingest accounting (stats/norm plane)
                 if not _is_remote(path):
                     bytes_c.inc(os.path.getsize(path))
             except OSError:
                 pass
-            reader = pd.read_csv(
-                path, sep=self.delimiter, engine="c", header=None,
-                names=self.header, dtype=str, chunksize=chunk_rows,
-                keep_default_na=False, na_filter=False, quoting=3,
-                on_bad_lines="skip", compression="infer")
+
+            def _open(path=path, fi=fi):
+                faults.fire("reader", "file", fi, path=path)
+                return pd.read_csv(
+                    path, sep=self.delimiter, engine="c", header=None,
+                    names=self.header, dtype=str, chunksize=chunk_rows,
+                    keep_default_na=False, na_filter=False, quoting=3,
+                    on_bad_lines="skip", compression="infer")
+            try:
+                reader = io_retry(_open, "shard open", path)
+            except OSError as e:
+                if bad_threshold > 0:
+                    quarantine(f"{path}: unreadable ({e})", files=1)
+                    continue
+                raise
             first = True
-            for df in reader:
-                if first:
-                    first = False
-                    # drop a literal header row if present in the data file
-                    row0 = df.iloc[0].tolist()
-                    if row0 == list(self.header):
-                        df = df.iloc[1:]
-                        if df.empty:
+            try:
+                for df in reader:
+                    if first:
+                        first = False
+                        # drop a literal header row if present in the file
+                        row0 = df.iloc[0].tolist()
+                        if row0 == list(self.header):
+                            df = df.iloc[1:]
+                            if df.empty:
+                                continue
+                    if len(df.columns) != len(self.header):
+                        code = ErrorCode.ERROR_EXCEED_COL \
+                            if len(df.columns) > len(self.header) \
+                            else ErrorCode.ERROR_LESS_COL
+                        msg = (f"{path}: {len(df.columns)} fields vs "
+                               f"{len(self.header)} header cols")
+                        if bad_threshold > 0:
+                            quarantine(msg, rows=len(df))
                             continue
-                if len(df.columns) != len(self.header):
-                    from ..config.errors import ErrorCode, ShifuError
-                    code = ErrorCode.ERROR_EXCEED_COL \
-                        if len(df.columns) > len(self.header) \
-                        else ErrorCode.ERROR_LESS_COL
-                    raise ShifuError(code,
-                                     f"{path}: {len(df.columns)} fields vs "
-                                     f"{len(self.header)} header cols")
-                yield RawChunk(columns=self.header, data=df)
+                        raise ShifuError(code, msg)
+                    yielded_rows += len(df)
+                    yield RawChunk(columns=self.header, data=df)
+            except (OSError, pd.errors.ParserError) as e:
+                if bad_threshold <= 0:
+                    raise
+                quarantine(f"{path}: read died mid-stream ({e})", files=1)
+
+        if quarantined_rows or quarantined_files:
+            frac_rows = quarantined_rows / max(
+                yielded_rows + quarantined_rows, 1)
+            frac_files = quarantined_files / max(len(self.files), 1)
+            if max(frac_rows, frac_files) > bad_threshold:
+                raise ShifuError(
+                    ErrorCode.ERROR_BAD_DATA_THRESHOLD,
+                    f"quarantined {quarantined_rows} row(s) / "
+                    f"{quarantined_files} file(s) exceeds "
+                    f"shifu.data.badThreshold={bad_threshold}; first "
+                    f"offender: {provenance[0]}")
 
     def _iter_parquet(self, chunk_rows: int) -> Iterator[RawChunk]:
         """Columnar parquet ingest (reference ``NNParquetWorker`` /

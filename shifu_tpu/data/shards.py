@@ -3,12 +3,15 @@
 from __future__ import annotations
 
 import json
+import logging
 import os
 import zipfile
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 # sidecar manifest of per-shard row counts (written on first scan; the
 # norm step writes the counts straight into schema.json as "shardRows",
@@ -64,9 +67,39 @@ class Shards:
                        if f.endswith(".npz"))
         return cls(directory, schema, files)
 
-    def iter_shards(self, start: int = 0) -> Iterator[Dict[str, np.ndarray]]:
-        for f in self.files[start:]:
-            yield dict(np.load(f))
+    def iter_shards(self, start: int = 0,
+                    strict: bool = False) -> Iterator[Dict[str, np.ndarray]]:
+        """Decode shards in order.  Opens ride the transient-IO retry
+        ladder; with ``shifu.data.badThreshold`` > 0 an undecodable shard
+        is quarantined (skipped + counted, provenance logged) as long as
+        the quarantined fraction stays under the threshold.  ``strict``
+        disables quarantine — the streaming window planes index rows by
+        shard position and cannot tolerate a silently missing shard."""
+        from .. import faults, obs
+        from ..config import environment
+        from ..ioutil import io_retry
+        bad_threshold = 0.0 if strict else \
+            environment.get_float("shifu.data.badThreshold", 0.0)
+        quarantined = 0
+        for i, f in enumerate(self.files[start:], start=start):
+            def _load(f=f, i=i):
+                faults.fire("shards", "shard", i, path=f)
+                return dict(np.load(f))
+            try:
+                yield io_retry(_load, "shard decode", f)
+            except (OSError, ValueError, zipfile.BadZipFile) as e:
+                if bad_threshold <= 0:
+                    raise
+                quarantined += 1
+                obs.counter("data.quarantined_shards").inc()
+                log.warning("quarantined undecodable shard %s: %s", f, e)
+                if quarantined / max(len(self.files), 1) > bad_threshold:
+                    from ..config.errors import ErrorCode, ShifuError
+                    raise ShifuError(
+                        ErrorCode.ERROR_BAD_DATA_THRESHOLD,
+                        f"{quarantined}/{len(self.files)} shards "
+                        f"quarantined exceeds shifu.data.badThreshold="
+                        f"{bad_threshold}; last: {f} ({e})") from e
 
     def load_all(self) -> Dict[str, np.ndarray]:
         parts = list(self.iter_shards())

@@ -135,6 +135,8 @@ class SpillWriter:
         if self._dead:
             return False
         try:
+            from .. import faults
+            faults.fire("spill", "append", len(self._shard_rows))
             n = int(len(next(iter(part.values()))))
             if not self._files:
                 for k in self.keys:
@@ -226,10 +228,16 @@ class SpillWriter:
                 pass
 
     def _write_manifest(self, man: dict) -> None:
+        from .. import faults
+        from ..ioutil import io_retry
         tmp = os.path.join(self.directory, MANIFEST + self._suffix)
-        with open(tmp, "w") as f:
-            json.dump(man, f)
-        os.replace(tmp, os.path.join(self.directory, MANIFEST))
+
+        def write():
+            faults.fire("spill", "manifest", 0, path=tmp)
+            with open(tmp, "w") as f:
+                json.dump(man, f)
+            os.replace(tmp, os.path.join(self.directory, MANIFEST))
+        io_retry(write, "spill manifest commit", self.directory)
 
 
 class SpillReader:
@@ -249,10 +257,13 @@ class SpillReader:
     def memmap(self, key: str) -> np.memmap:
         mm = self._mms.get(key)
         if mm is None:
+            from ..ioutil import io_retry
             dt = np.dtype(self.man["dtypes"][key])
             shape = (self.rows,) + tuple(self.man["shapes"][key])
-            mm = np.memmap(os.path.join(self.directory, key + ".raw"),
-                           dtype=dt, mode="r", shape=shape)
+            path = os.path.join(self.directory, key + ".raw")
+            mm = io_retry(
+                lambda: np.memmap(path, dtype=dt, mode="r", shape=shape),
+                "spill mmap open", path)
             self._mms[key] = mm
         return mm
 
@@ -278,9 +289,17 @@ def open_spill(directory: str, keys: Sequence[str],
     ``writable`` says whether a cold pass should (re)build one — False
     when a marker records a permanent abort for this exact source."""
     path = os.path.join(directory, MANIFEST)
-    try:
+
+    def read():
+        if not os.path.isfile(path):   # absence is final, not transient
+            return None
         with open(path) as f:
-            man = json.load(f)
+            return json.load(f)
+    try:
+        from ..ioutil import io_retry
+        man = io_retry(read, "spill manifest read", path)
+        if man is None:
+            return None, True
     except (OSError, ValueError):
         return None, True
     if man.get("version") != SPILL_FORMAT_VERSION \

@@ -223,7 +223,8 @@ class ShardStream:
                     continue
             return False
         try:
-            for si, part in enumerate(self.shards.iter_shards(start_shard)):
+            for si, part in enumerate(
+                    self.shards.iter_shards(start_shard, strict=True)):
                 item = {k: part[k] for k in self.keys}
                 if writer is not None and not writer.append(item):
                     writer = None             # abandoned; keep streaming

@@ -1,0 +1,141 @@
+"""Deterministic fault-injection harness — makes recovery *testable*.
+
+The reference system inherited its fault story from Hadoop (failed map
+tasks re-run, Guagua restarts from the last iteration); proving OUR
+recovery paths work needs a way to make the pipeline fail at an exact,
+named point, deterministically.  This module is that switchboard: hot
+paths call :func:`fire` at phase boundaries (norm shard commits, stats
+chunks, train trees/epochs, reader/spill IO) and a spec names which of
+those points should fail, how.
+
+Spec grammar (env ``SHIFU_TPU_FAULTS`` or property ``-Dshifu.faults``)::
+
+    clause[,clause...]
+    clause := <site>:<point>=<value>:<action>[@<count>]
+
+    SHIFU_TPU_FAULTS="norm:shard=3:ioerror,train:tree=17:kill"
+    SHIFU_TPU_FAULTS="reader:file=0:ioerror@2"    # first 2 hits fail
+
+Sites/points wired today (grep ``faults.fire`` for the live set):
+
+    norm:shard=<k>      before shard k's commit record lands
+    stats:chunk=<ci>    before chunk ci is absorbed by the accumulators
+    train:tree=<ti>     after tree ti's progress line (GBT/RF)
+    train:epoch=<e>     after epoch e's progress line (NN/LR/WDL/SVM)
+    train:bag=<b>       before kernel-SVM bag b trains
+    reader:file=<i>     opening the i-th raw input file
+    shards:shard=<i>    decoding the i-th materialized npz shard
+    spill:append=<k>    spill write-through of shard k
+    spill:manifest=0    spill manifest commit
+    step:phase=<name>   entering a named processor phase span
+
+Actions:
+
+- ``ioerror``   raise :class:`InjectedFault` (an ``OSError``) — exercises
+  the transient-IO retry ladder and step-failure paths in-process;
+- ``kill``      ``os._exit(137)`` — a SIGKILL-equivalent hard death (no
+  atexit, no flushing); subprocess tests resume afterwards;
+- ``truncate``  truncate the target file to half its size, then hard-exit
+  — manufactures a torn, committed-looking artifact.
+
+Each clause fires ``count`` times (default 1) then disarms, so a retry
+ladder can be tested to succeed on attempt 2 (``@1``, the default) or be
+driven to exhaustion (``@99``).  Parsing is lazy and cached; with no
+spec configured :func:`fire` is a dict-lookup no-op.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+_ACTIONS = ("ioerror", "kill", "truncate")
+
+_clauses: Optional[Dict[Tuple[str, str, str], List]] = None  # [action, left]
+
+
+class InjectedFault(OSError):
+    """An injected IO failure (distinguishable from real OS errors)."""
+
+
+def _spec_string() -> str:
+    spec = os.environ.get("SHIFU_TPU_FAULTS")
+    if spec:
+        return spec
+    from .config import environment
+    return environment.get_property("shifu.faults") or ""
+
+
+def parse_spec(spec: str) -> Dict[Tuple[str, str, str], List]:
+    """``"norm:shard=3:ioerror@2"`` -> {("norm","shard","3"): ["ioerror", 2]}.
+
+    Malformed clauses fail loudly — a typo'd fault spec silently testing
+    nothing is worse than no spec."""
+    out: Dict[Tuple[str, str, str], List] = {}
+    for clause in filter(None, (c.strip() for c in spec.split(","))):
+        try:
+            site, point_eq, action = clause.split(":")
+            point, _, value = point_eq.partition("=")
+            count = 1
+            if "@" in action:
+                action, _, cnt = action.partition("@")
+                count = int(cnt)
+            if action not in _ACTIONS or not point or not value:
+                raise ValueError(action)
+        except ValueError:
+            raise ValueError(
+                f"bad fault clause {clause!r} — expected "
+                "<site>:<point>=<value>:<action>[@<count>] with action in "
+                f"{_ACTIONS}") from None
+        out[(site, point, value)] = [action, count]
+    return out
+
+
+def _armed() -> Dict[Tuple[str, str, str], List]:
+    global _clauses
+    if _clauses is None:
+        _clauses = parse_spec(_spec_string())
+    return _clauses
+
+
+def active() -> bool:
+    return bool(_armed())
+
+
+def fire(site: str, point: str, value, path: Optional[str] = None) -> None:
+    """Fault hook: no-op unless an armed clause matches (site, point,
+    value).  ``path`` names the artifact a ``truncate`` action mangles."""
+    clauses = _armed()
+    if not clauses:
+        return
+    hit = clauses.get((site, point, str(value)))
+    if hit is None or hit[1] <= 0:
+        return
+    hit[1] -= 1
+    action = hit[0]
+    log.warning("FAULT INJECTED at %s:%s=%s action=%s path=%s",
+                site, point, value, action, path)
+    if action == "ioerror":
+        raise InjectedFault(
+            f"injected IO error at {site}:{point}={value}"
+            + (f" ({path})" if path else ""))
+    if action == "truncate" and path and os.path.isfile(path):
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+    # kill (and truncate's tail): a SIGKILL-equivalent hard death — no
+    # atexit handlers, no buffered writes, exactly what a preempted VM
+    # or OOM-killed container leaves behind
+    os.sys.stderr.write(
+        f"shifu-tpu: injected hard exit at {site}:{point}={value}\n")
+    os.sys.stderr.flush()
+    os._exit(137)
+
+
+def reset_for_tests() -> None:
+    """Drop the parsed-spec cache (tests flip the env/property per case)."""
+    global _clauses
+    _clauses = None

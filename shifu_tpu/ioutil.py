@@ -1,0 +1,112 @@
+"""Crash-consistent file IO: atomic commits + transient-failure retry.
+
+Two small primitives every artifact writer in the pipeline shares:
+
+- **atomic writes** — content lands in a same-directory temp file and
+  ``os.replace``s into place, so a reader (or a resumed run) never
+  observes a torn file; the journal/manifest layer decides *commit*
+  separately, these helpers only guarantee each file is all-or-nothing.
+- **bounded retry with exponential backoff + jitter** — shard reads and
+  spill IO ride shared filesystems (GCS fuse, NFS, preemptible local
+  SSD) where transient ``OSError``s are weather, not bugs.  ``io_retry``
+  absorbs up to ``shifu.io.retries`` of them (telemetry counter
+  ``ingest.retries``); the final attempt re-raises with the artifact's
+  provenance in the message so the operator knows *which* shard died.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import os
+import random
+import time
+from typing import Any, Callable, TypeVar
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+def _retries() -> int:
+    from .config import environment
+    return max(0, environment.get_int("shifu.io.retries", 3))
+
+
+def _retry_base_s() -> float:
+    from .config import environment
+    return environment.get_int("shifu.io.retryBaseMs", 50) / 1000.0
+
+
+def io_retry(fn: Callable[[], T], what: str, path: str = "") -> T:
+    """Run ``fn``, absorbing transient ``OSError``s with exponential
+    backoff + jitter.  The final failure re-raises the original error
+    wrapped with provenance (``what`` + ``path``)."""
+    attempts = _retries() + 1
+    base = _retry_base_s()
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except OSError as e:
+            if attempt + 1 >= attempts:
+                raise OSError(
+                    f"{what} failed after {attempts} attempt(s)"
+                    f"{f' [{path}]' if path else ''}: {e}") from e
+            from . import obs
+            obs.counter("ingest.retries").inc()
+            delay = base * (2 ** attempt) * (1.0 + random.random())
+            log.warning("transient IO error in %s%s (attempt %d/%d, "
+                        "retrying in %.0f ms): %s", what,
+                        f" [{path}]" if path else "", attempt + 1,
+                        attempts, delay * 1000, e)
+            time.sleep(delay)
+    raise AssertionError("unreachable")
+
+
+def _tmp_path(path: str) -> str:
+    return f"{path}.tmp{os.getpid()}"
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    tmp = _tmp_path(path)
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"))
+
+
+def atomic_write_json(path: str, obj: Any, indent: int = 2) -> None:
+    atomic_write_text(path, json.dumps(obj, indent=indent))
+
+
+def atomic_savez(path: str, **arrays: np.ndarray) -> None:
+    """npz written whole-or-not-at-all (np.savez writing directly to the
+    final path leaves a torn zip on a crash mid-write)."""
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    atomic_write_bytes(path, buf.getvalue())
+
+
+def sweep_orphan_tmp(directory: str) -> int:
+    """Remove ``*.tmp<pid>`` droppings a previous crash left next to the
+    artifacts.  Returns the number removed (best-effort)."""
+    n = 0
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return 0
+    for f in entries:
+        stem, tmp, pid = f.rpartition(".tmp")
+        if tmp and pid.isdigit():
+            try:
+                os.remove(os.path.join(directory, f))
+                n += 1
+            except OSError:
+                pass
+    return n

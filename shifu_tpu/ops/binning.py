@@ -554,6 +554,74 @@ class NumericAccumulator:
             self._prov_hist_dev = None
             self._prov_magg_dev = None
 
+    # ---- mid-sweep checkpointing (stats-step crash resume)
+    def spill_resident(self) -> None:
+        """Migrate the device-resident fused chunks into the PROVISIONAL
+        histogram (freezing provisional bounds on first use) so the
+        fused-sweep state becomes a few host-serializable arrays instead
+        of a dataset-sized chunk list.  Afterwards the budget is zeroed:
+        every later chunk accumulates provisionally too, which keeps an
+        uninterrupted checkpointing run and a crash-resumed one on the
+        SAME numeric path (both refine the identical provisional grid at
+        finalize)."""
+        if self._prov_lo_d is None:
+            self._freeze_provisional()
+        up = self._kernel_gate()
+        for xd, vd, td, wd, live, _rows in self._fused_chunks:
+            h = _histogram_kernel(xd, vd, td, wd, self._prov_lo_d,
+                                  self._prov_hi_d, self.num_buckets,
+                                  use_pallas=up,
+                                  unit_weight=self.unit_weight,
+                                  expand=False,
+                                  mesh=self.mesh if self._data_size() > 1
+                                  else None)
+            magg = _missing_agg_kernel(vd, td, wd, live,
+                                       unit_weight=self.unit_weight,
+                                       expand=False)
+            self._prov_hist_dev = h if self._prov_hist_dev is None \
+                else self._prov_hist_dev + h
+            self._prov_magg_dev = magg if self._prov_magg_dev is None \
+                else self._prov_magg_dev + magg
+        self._fused_chunks.clear()
+        self._fused_bytes = 0
+        self.fused_budget = 0
+
+    def checkpoint_state(self) -> Dict[str, np.ndarray]:
+        """Host-serializable snapshot of the fused-sweep accumulation
+        (moments + provisional histogram).  Restoring it and replaying
+        the remaining chunks reproduces an uninterrupted checkpointing
+        run exactly (f32 provisional counts round-trip bit-identically)."""
+        assert not self.exact, "exact (MunroPat) stats do not checkpoint"
+        self.spill_resident()
+        self._drain_moments()
+        out: Dict[str, np.ndarray] = {
+            "total_rows": np.asarray(self.total_rows, np.int64)}
+        for k, v in self.moments.items():
+            out[f"m_{k}"] = np.asarray(v)
+        out["prov_lo"] = np.asarray(self._prov_lo_d)
+        out["prov_hi"] = np.asarray(self._prov_hi_d)
+        if self._prov_hist_dev is not None:
+            out["prov_hist"] = np.asarray(self._prov_hist_dev)
+            out["prov_magg"] = np.asarray(self._prov_magg_dev)
+        return out
+
+    def restore_checkpoint(self, state: Dict[str, np.ndarray]) -> None:
+        self.total_rows = int(state["total_rows"])
+        self.moments = {k[2:]: np.asarray(state[k], np.float64)
+                        for k in state if k.startswith("m_")}
+        if "count" in self.moments:
+            self.missing = self.total_rows - self.moments["count"]
+        self._prov_lo_d = jnp.asarray(state["prov_lo"], jnp.float32)
+        self._prov_hi_d = jnp.asarray(state["prov_hi"], jnp.float32)
+        if "prov_hist" in state:
+            self._prov_hist_dev = jnp.asarray(state["prov_hist"],
+                                              jnp.float32)
+            self._prov_magg_dev = jnp.asarray(state["prov_magg"],
+                                              jnp.float32)
+        self._fused_chunks.clear()
+        self._fused_bytes = 0
+        self.fused_budget = 0          # continue in provisional mode
+
     def _drain_hist(self) -> None:
         if self._hist_dev is None:
             return
@@ -838,6 +906,23 @@ class CategoricalAccumulator:
         if m.any():
             prev = d.get(_MISSING_KEY)
             d[_MISSING_KEY] = m if prev is None else prev + m
+
+    def state_lists(self):
+        """(meta, arrays) host snapshot for mid-sweep checkpoints: per
+        column a category list (JSON side) + a [k, 4] count matrix."""
+        meta, arrays = {}, {}
+        for i, (col, d) in enumerate(self.stats.items()):
+            cats = list(d.keys())
+            meta[col] = {"i": i, "cats": cats}
+            arrays[f"cat_{i}"] = (np.stack([d[c] for c in cats])
+                                  if cats else np.zeros((0, 4)))
+        return meta, arrays
+
+    def load_state(self, meta, arrays) -> None:
+        self.stats = {
+            col: {c: np.asarray(arrays[f"cat_{m['i']}"][j], np.float64)
+                  for j, c in enumerate(m["cats"])}
+            for col, m in meta.items()}
 
     def finalize(self, col_name: str, max_cates: int = 0):
         """Return (categories, counts[cats+1, 4], n_distinct, n_missing) —

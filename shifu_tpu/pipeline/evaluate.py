@@ -227,8 +227,9 @@ class EvalProcessor(BasicProcessor):
         curves = sweep(scores, targets, weights)   # ONE sort; two consumers
         result = evaluate_curves(curves, buckets=ev.performanceBucketNum)
         result.modelCount = n_models
-        with open(self.paths.eval_performance_path(ev.name), "w") as f:
-            json.dump(result.to_dict(), f, indent=2)
+        from ..ioutil import atomic_write_json
+        atomic_write_json(self.paths.eval_performance_path(ev.name),
+                          result.to_dict())
         self._write_confusion(ev.name, result)
         self._write_gains(eval_dir, result)
         from ..eval.report import html_report
@@ -311,8 +312,8 @@ class EvalProcessor(BasicProcessor):
             return 0
         rep = evaluate_multiclass(cs, t, wgt)
         rep["tags"] = tags
-        with open(self.paths.eval_performance_path(ev.name), "w") as f:
-            json.dump(rep, f, indent=2)
+        from ..ioutil import atomic_write_json
+        atomic_write_json(self.paths.eval_performance_path(ev.name), rep)
         log.info("eval %s: accuracy %.6f macro OvR AUC %.6f", ev.name,
                  rep["accuracy"], rep["macroAuc"])
         return 0

@@ -6,24 +6,35 @@ DatasetTransformer and writes npz shards of (x float32, bins int32, target,
 weight) to ``tmp/NormalizedData`` / ``tmp/CleanedData``, plus a schema json.
 The optional ``-shuffle`` reshuffles rows across shards (reference
 ``MapReduceShuffle``).
+
+Crash consistency: every shard pair commits atomically (tmp + rename)
+and lands a per-shard record in the step journal
+(``tmp/journal/NORMALIZE.json``).  A re-run after a crash verifies the
+committed prefix against the journal (sizes must match — truncated
+committed-looking files drop out) and resumes writing at the first
+uncommitted shard; the transform replay is deterministic (per-chunk
+sampling substreams), so resumed shard bytes are identical to an
+uninterrupted run's.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
 import shutil
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
-from .. import obs
+from .. import faults, obs
 from ..config.validator import ModelStep
 from ..data import DataSource, sample_mask
 from ..data.shards import bins_wire_dtype
 from ..data.transform import DatasetTransformer
+from ..ioutil import atomic_savez, atomic_write_json
 from .processor import BasicProcessor
 
 log = logging.getLogger(__name__)
@@ -41,12 +52,33 @@ class NormalizeProcessor(BasicProcessor):
                             header_path=self._abs(mc.dataSet.headerPath),
                             header_delimiter=mc.dataSet.headerDelimiter)
         norm_dir, clean_dir = self.paths.norm_dir, self.paths.clean_dir
+
+        # ---- resume: verified committed-shard prefix from a torn run.
+        # -shuffle rewrites every shard at the end, so mid-step resume
+        # is meaningless there (the journal resets and the run is clean).
+        do_shuffle = bool(self.params.get("shuffle"))
+        items = self.journal.arm(self._signature(source),
+                                 resume=not do_shuffle)
+        committed: Dict[int, dict] = {}
+        for name, meta in items.items():
+            if name.startswith("shard-"):
+                committed[int(name.split("-", 1)[1])] = meta
+        resume_upto = 0                 # first uncommitted shard index
+        while resume_upto in committed:
+            resume_upto += 1
+        keep_names = {f"part-{k:05d}.npz" for k in range(resume_upto)}
         for d in (norm_dir, clean_dir):
             os.makedirs(d, exist_ok=True)
             for f in os.listdir(d):
+                if f in keep_names:
+                    continue
                 p = os.path.join(d, f)
                 # subdirs too: a previous train left its .spill_cache here
                 shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
+        if resume_upto:
+            obs.counter("norm.resumed_shards").inc(resume_upto)
+            log.info("norm: resuming — %d committed shard(s) verified, "
+                     "restart at shard %d", resume_upto, resume_upto)
 
         # compact bins storage: the narrowest dtype the ColumnConfig bin
         # space fits (uint8 for <=256 bins) — the same wire format the
@@ -56,6 +88,8 @@ class NormalizeProcessor(BasicProcessor):
                      default=2)
         self._bins_dtype = bins_wire_dtype(n_bins)
         self._shard_counts: List[int] = []
+        self._resume_upto = resume_upto
+        self._committed = committed
 
         rate = mc.normalize.sampleRate
         neg_only = mc.normalize.sampleNegOnly
@@ -84,11 +118,13 @@ class NormalizeProcessor(BasicProcessor):
                             bufw)
                 shard += 1
             ph.set(rows=total_out)
-        if self.params.get("shuffle"):
+        if do_shuffle:
             with self.phase("shuffle"):
                 self._shard_counts = self._shuffle(norm_dir) \
                     or self._shard_counts
                 self._shuffle(clean_dir)
+                self._recommit_shuffled(norm_dir, clean_dir,
+                                        self._shard_counts)
         obs.counter("norm.rows").inc(total_out)
         obs.gauge("norm.shards").set(shard)
         obs.gauge("norm.rows_per_sec").set(
@@ -106,22 +142,59 @@ class NormalizeProcessor(BasicProcessor):
             "binsDtype": np.dtype(self._bins_dtype).name,
             "width": transformer.width,
         }
-        with open(os.path.join(norm_dir, "schema.json"), "w") as f:
-            json.dump(schema, f, indent=2)
-        with open(os.path.join(clean_dir, "schema.json"), "w") as f:
-            json.dump(schema, f, indent=2)
+        atomic_write_json(os.path.join(norm_dir, "schema.json"), schema)
+        atomic_write_json(os.path.join(clean_dir, "schema.json"), schema)
         log.info("norm: %d shards, %d input cols -> %d features",
                  shard, len(transformer.columns), transformer.width)
         return 0
+
+    def _signature(self, source: DataSource) -> dict:
+        """Identity of the run's inputs + transform config — a resume is
+        only valid when the replayed stream produces the same bytes."""
+        mc = self.model_config
+        files = []
+        for f in source.files:
+            try:
+                st = os.stat(f)
+                files.append([os.path.basename(f), st.st_size,
+                              st.st_mtime_ns])
+            except OSError:                    # remote URL: pin by name
+                files.append([f, None, None])
+        try:
+            with open(self.paths.column_config_path, "rb") as f:
+                cc_hash = hashlib.md5(f.read()).hexdigest()
+        except OSError:
+            cc_hash = None
+        return {"files": files, "columnConfig": cc_hash,
+                "normType": mc.normalize.normType.name,
+                "sampleRate": mc.normalize.sampleRate,
+                "sampleNegOnly": bool(mc.normalize.sampleNegOnly),
+                "shardRows": SHARD_ROWS}
 
     def _flush(self, norm_dir: str, clean_dir: str, shard: int,
                bufx: List[np.ndarray], bufb, bufy, bufw) -> None:
         x = np.concatenate(bufx); b = np.concatenate(bufb)
         y = np.concatenate(bufy); w = np.concatenate(bufw)
-        np.savez(os.path.join(norm_dir, f"part-{shard:05d}.npz"),
-                 x=x, y=y, w=w)
-        np.savez(os.path.join(clean_dir, f"part-{shard:05d}.npz"),
-                 bins=b.astype(self._bins_dtype), y=y, w=w)
+        np_path = os.path.join(norm_dir, f"part-{shard:05d}.npz")
+        cl_path = os.path.join(clean_dir, f"part-{shard:05d}.npz")
+        prev = self._committed.get(shard) if shard < self._resume_upto \
+            else None
+        if prev is not None and int(prev.get("rows", -1)) == len(y):
+            # verified committed shard from the interrupted run: the
+            # deterministic replay reproduced the same row count, so the
+            # bytes on disk are the bytes this flush would write — skip
+            # the write, keep the commit record
+            self._shard_counts.append(int(len(y)))
+            return
+        if prev is not None:
+            log.warning("norm resume: shard %d row count diverged "
+                        "(journal %s vs replay %d) — rewriting",
+                        shard, prev.get("rows"), len(y))
+        faults.fire("norm", "shard", shard, path=np_path)
+        atomic_savez(np_path, x=x, y=y, w=w)
+        atomic_savez(cl_path, bins=b.astype(self._bins_dtype), y=y, w=w)
+        self.journal.commit_item(f"shard-{shard:05d}",
+                                 files=[np_path, cl_path], rows=int(len(y)))
         self._shard_counts.append(int(len(y)))
 
     def _shuffle(self, d: str) -> Optional[List[int]]:
@@ -139,6 +212,18 @@ class NormalizeProcessor(BasicProcessor):
         splits = np.array_split(np.arange(n), len(files))
         for i, f in enumerate(files):
             sel = perm[splits[i]]
-            np.savez(os.path.join(d, f), **{k: merged[k][sel] for k in keys})
+            atomic_savez(os.path.join(d, f),
+                         **{k: merged[k][sel] for k in keys})
         return [len(s) for s in splits]
 
+    def _recommit_shuffled(self, norm_dir: str, clean_dir: str,
+                           counts: List[int]) -> None:
+        """Shuffle rewrote every shard — re-pin the journal records to
+        the shuffled sizes so downstream verification stays truthful."""
+        for k, rows in enumerate(counts):
+            name = f"part-{k:05d}.npz"
+            self.journal.commit_item(
+                f"shard-{k:05d}",
+                files=[os.path.join(norm_dir, name),
+                       os.path.join(clean_dir, name)],
+                rows=int(rows), shuffled=True)

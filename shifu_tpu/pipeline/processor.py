@@ -15,10 +15,11 @@ import shutil
 import time
 from typing import List, Optional
 
-from .. import obs
+from .. import faults, obs
 from ..config import (ColumnConfig, ModelConfig, PathFinder,
                       load_column_configs, save_column_configs)
 from ..config.validator import ModelStep, probe
+from .journal import StepJournal
 
 log = logging.getLogger(__name__)
 
@@ -41,6 +42,7 @@ class BasicProcessor:
         self.model_config: Optional[ModelConfig] = None
         self.column_configs: List[ColumnConfig] = []
         self.paths: Optional[PathFinder] = None
+        self.journal: Optional[StepJournal] = None
 
     # ------------------------------------------------------------ lifecycle
     def setup(self, require_columns: Optional[bool] = None) -> None:
@@ -60,6 +62,9 @@ class BasicProcessor:
             raise FileNotFoundError(
                 f"{cc_path} not found — run `shifu-tpu init` first")
         self.paths.ensure_dirs()
+        self.journal = StepJournal(
+            self.paths.journal_path(self.profile_name), self.profile_name,
+            self.dir)
         self._check_step_preconditions()
 
     def _check_step_preconditions(self) -> None:
@@ -86,6 +91,24 @@ class BasicProcessor:
                     ErrorCode.ERROR_STEP_PRECONDITION,
                     "`train` needs the materialized data plane — run "
                     "`norm` first")
+            # journal completeness, not just file existence: a norm run
+            # that died mid-step (or whose committed shards were later
+            # truncated) must not feed the trainers half a dataset.
+            # Absence of a journal = pre-journal artifacts, trust files.
+            nj = StepJournal(self.paths.journal_path("NORMALIZE"),
+                             "NORMALIZE", self.dir)
+            if nj.is_torn():
+                raise ShifuError(
+                    ErrorCode.ERROR_TORN_ARTIFACT,
+                    "the last `norm` run did not complete (journal "
+                    "status=running) — re-run `norm` (it resumes at the "
+                    "first uncommitted shard)")
+            if nj.status and not nj.verify_all():
+                raise ShifuError(
+                    ErrorCode.ERROR_TORN_ARTIFACT,
+                    "materialized norm shards no longer match their "
+                    "journaled sizes (torn/corrupted artifact) — re-run "
+                    "`norm`")
 
     def _abs(self, p: Optional[str]) -> Optional[str]:
         """Resolve a config-relative path against the model-set dir.
@@ -114,10 +137,16 @@ class BasicProcessor:
             with obs.span(self.profile_name, kind="step") as root:
                 with obs.span("setup", kind="phase"):
                     self.setup()
+                # torn-run detection: the journal stays "running" until
+                # the step commits, so a crash anywhere below leaves the
+                # marker the next run (and downstream preconditions) read
+                self.journal.open_run()
                 with self._device_trace(), \
                         obs.span("process", kind="phase"):
                     code = self.process()
                 root.set(exit_code=code)
+                if code == 0:
+                    self.journal.complete(exit_code=0)
         finally:
             # flush even when the step raised: a crashed run's partial
             # trace (with the error-marked span) is exactly the one you
@@ -206,6 +235,7 @@ class _PhaseSpan:
         self._pending: dict = {}
 
     def __enter__(self):
+        faults.fire("step", "phase", self.name)
         self._obs = obs.span(self.name, kind="phase", **self._pending)
         self._obs.__enter__()
         self.t0 = time.perf_counter()

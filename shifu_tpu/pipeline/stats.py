@@ -10,6 +10,8 @@ reference writes: mean/std/min/max/median/p25/p75, missing counts, KS/IV/WOE
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import math
 import os
@@ -18,8 +20,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from .. import obs
+from .. import faults, obs
 from ..config import ColumnConfig
+from ..ioutil import atomic_savez, atomic_write_text
 from ..config.validator import ModelStep
 from ..data import DataSource
 from ..data.extract import ChunkExtractor
@@ -75,6 +78,53 @@ class StatsProcessor(BasicProcessor):
         want_corr = bool(self.params.get("correlation"))
         corr_acc = None
 
+        # mid-sweep checkpointing (fused path only): every N chunks the
+        # accumulators snapshot to tmp/stats/partial_sweep.npz; a crash
+        # resumes at the first un-checkpointed chunk.  0 = off (default;
+        # checkpointing routes the sweep through the provisional-grid
+        # path, trading the resident-exact fast path for resumability).
+        ckpt_chunks = environment.get_int("shifu.stats.checkpointChunks", 0)
+        partial_path = self.paths.stats_partial_path
+        sig = self._sweep_signature(source, fused, ckpt_chunks)
+        items = self.journal.arm(sig, resume=bool(ckpt_chunks and fused))
+        resume_chunk, total_rows = 0, 0
+        if ckpt_chunks and fused and items.get("sweep"):
+            restored = _load_partial(partial_path, _sig_hash(sig))
+            if restored is not None:
+                meta, arrays = restored
+                resume_chunk = int(meta["chunk_next"])
+                total_rows = int(meta["total_rows"])
+                if num_cols:
+                    num_acc.restore_checkpoint(
+                        {k[4:]: v for k, v in arrays.items()
+                         if k.startswith("num_")})
+                cat_acc.load_state(meta["cat"], arrays)
+                obs.counter("stats.resumed_chunks").inc(resume_chunk)
+                log.info("stats: resuming fused sweep at chunk %d "
+                         "(%d rows already accumulated)", resume_chunk,
+                         total_rows)
+        elif os.path.isfile(partial_path):
+            try:                       # stale partial from another config
+                os.remove(partial_path)
+            except OSError:
+                pass
+
+        def save_partial(chunk_next: int, rows: int) -> None:
+            arrays: Dict[str, np.ndarray] = {}
+            if num_cols:
+                for k, v in num_acc.checkpoint_state().items():
+                    arrays["num_" + k] = v
+            cat_meta, cat_arrays = cat_acc.state_lists()
+            arrays.update(cat_arrays)
+            meta = {"version": 1, "chunk_next": chunk_next,
+                    "total_rows": rows, "sig": _sig_hash(sig),
+                    "cat": cat_meta}
+            arrays["__meta__"] = np.frombuffer(
+                json.dumps(meta).encode(), np.uint8)
+            atomic_savez(partial_path, **arrays)
+            self.journal.commit_item("sweep", files=[partial_path],
+                                     chunk_next=chunk_next)
+
         def cat_update(ex, tgt) -> None:
             missing_set = {m.strip().lower()
                            for m in extractor.missing_values}
@@ -92,11 +142,13 @@ class StatsProcessor(BasicProcessor):
             return (ex.target > 0).astype(ex.target.dtype) \
                 if extractor.multiclass else ex.target
 
-        total_rows = 0
         sweep_t0 = time.perf_counter()
         if fused:
             with self.phase("fused_sweep") as ph:
                 for ci, chunk in enumerate(source.iter_chunks()):
+                    if ci < resume_chunk:
+                        continue       # restored partial covers this chunk
+                    faults.fire("stats", "chunk", ci)
                     ex = extractor.extract(_sample_raw(chunk, rate, ci))
                     if ex.n == 0:
                         continue
@@ -105,7 +157,10 @@ class StatsProcessor(BasicProcessor):
                     if num_cols:
                         num_acc.update_fused(ex.numeric, ex.numeric_valid,
                                              tgt, ex.weight)
-                        if want_corr and not cat_cols:
+                        # a resumed sweep skipped chunks the piggyback
+                        # correlation never saw — it falls back to the
+                        # dedicated full-pass below (corr_acc stays None)
+                        if want_corr and not cat_cols and not resume_chunk:
                             if corr_acc is None:
                                 # Pearson is shift-invariant; the first
                                 # chunk's means condition the f32 sums
@@ -119,6 +174,8 @@ class StatsProcessor(BasicProcessor):
                             corr_acc.update(np.nan_to_num(ex.numeric),
                                             ex.numeric_valid)
                     cat_update(ex, tgt)
+                    if ckpt_chunks and (ci + 1) % ckpt_chunks == 0:
+                        save_partial(ci + 1, total_rows)
                 ph.set(rows=total_rows)
             if total_rows == 0:
                 raise RuntimeError("stats: dataset is empty after "
@@ -129,6 +186,7 @@ class StatsProcessor(BasicProcessor):
             # ---------------- pass 1: moments/min/max (numeric)
             with self.phase("pass1_moments") as ph:
                 for ci, chunk in enumerate(source.iter_chunks()):
+                    faults.fire("stats", "chunk", ci)
                     ex = extractor.extract(_sample_raw(chunk, rate, ci))
                     if ex.n == 0:
                         continue
@@ -189,9 +247,34 @@ class StatsProcessor(BasicProcessor):
         obs.gauge("stats.rows_per_sec").set(
             total_rows / max(time.perf_counter() - sweep_t0, 1e-9))
         self.save_column_configs()
+        if os.path.isfile(partial_path):
+            try:                       # the sweep committed — drop partials
+                os.remove(partial_path)
+            except OSError:
+                pass
         log.info("stats: %d rows, %d numeric, %d categorical columns",
                  total_rows, len(num_cols), len(cat_cols))
         return 0
+
+    def _sweep_signature(self, source: DataSource, fused: bool,
+                         ckpt_chunks: int) -> dict:
+        """Inputs + config identity a resumed sweep must match."""
+        mc = self.model_config
+        files = []
+        for f in source.files:
+            try:
+                st = os.stat(f)
+                files.append([os.path.basename(f), st.st_size,
+                              st.st_mtime_ns])
+            except OSError:
+                files.append([f, None, None])
+        return {"files": files,
+                "sampleRate": float(mc.stats.sampleRate),
+                "binningAlgorithm": mc.stats.binningAlgorithm.value,
+                "binningMethod": mc.stats.binningMethod.value,
+                "maxNumBin": int(mc.stats.maxNumBin),
+                "fused": bool(fused),
+                "checkpointChunks": int(ckpt_chunks)}
 
 
     # ------------------------------------------------------------- numeric
@@ -362,11 +445,11 @@ class StatsProcessor(BasicProcessor):
     def _write_corr_matrix(self, corr: np.ndarray, names: List[str],
                            n_cat: int) -> None:
         path = self.paths.correlation_path
-        with open(path, "w") as f:
-            f.write("," + ",".join(names) + "\n")
-            for i, n in enumerate(names):
-                f.write(n + "," + ",".join(
-                    f"{corr[i, j]:.6f}" for j in range(len(names))) + "\n")
+        lines = ["," + ",".join(names)]
+        for i, n in enumerate(names):
+            lines.append(n + "," + ",".join(
+                f"{corr[i, j]:.6f}" for j in range(len(names))))
+        atomic_write_text(path, "\n".join(lines) + "\n")
         log.info("correlation matrix (%d columns incl. %d categorical) -> %s",
                  len(names), n_cat, path)
 
@@ -442,6 +525,25 @@ class StatsProcessor(BasicProcessor):
             cc.columnStats.unitStats = [
                 f"{u}:{psi(overall, acc[uid, s:e]):.6f}"
                 for u, uid in units_sorted]
+
+
+def _sig_hash(sig: dict) -> str:
+    return hashlib.md5(
+        json.dumps(sig, sort_keys=True).encode()).hexdigest()
+
+
+def _load_partial(path: str, sig_hash: str):
+    """(meta, arrays) of a mid-sweep partial, or None when missing, torn,
+    or written under a different input/config signature."""
+    import zipfile
+    try:
+        data = np.load(path)
+        meta = json.loads(bytes(data["__meta__"]).decode())
+        if meta.get("version") != 1 or meta.get("sig") != sig_hash:
+            return None
+        return meta, {k: data[k] for k in data.files if k != "__meta__"}
+    except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+        return None
 
 
 def _sample_raw(chunk, rate: float, chunk_idx: int):

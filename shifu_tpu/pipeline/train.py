@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from .. import faults
 from ..config.model_config import Algorithm
 from ..config.validator import ModelStep
 from ..data.shards import Shards
@@ -132,6 +133,14 @@ class TrainProcessor(BasicProcessor):
             log.info("dry run: algorithm=%s bags=%d epochs=%d", alg.name,
                      mc.train.baggingNum, mc.train.numTrainEpochs)
             return 0
+        if self.journal.was_torn and not self.params.get("resume"):
+            # the previous train died mid-step (journal never committed):
+            # auto-resume from the trainer-state checkpoints — exactly
+            # what an explicit `train -resume` would do; with no
+            # checkpoint on disk the trainers fall back to fresh init
+            log.info("train: previous run was interrupted — resuming "
+                     "from trainer checkpoints")
+            self.params["resume"] = True
         if alg in (Algorithm.NN, Algorithm.LR, Algorithm.SVM,
                    Algorithm.TENSORFLOW):
             # TENSORFLOW: the reference bridges to TF-on-YARN
@@ -376,8 +385,19 @@ class TrainProcessor(BasicProcessor):
         c_penalty = float(params.get("Const", 1.0))
         bags = max(1, mc.train.baggingNum)
         os.makedirs(self.paths.models_dir, exist_ok=True)
-        with open(self.paths.progress_path, "w") as pf:
+        # per-bag commit hooks: each solved bag journals its model, so an
+        # interrupted multi-bag run resumes at the first unsolved bag
+        # (the kernel SVM's "epoch" is the whole dual solve)
+        items = self.journal.arm({"alg": "SVM", "kernel": spec.kernel,
+                                  "const": c_penalty, "bags": bags},
+                                 resume=bool(self.params.get("resume")))
+        with open(self.paths.progress_path, "a" if items else "w") as pf:
             for b in range(bags):
+                path = os.path.join(self.paths.models_dir, f"model{b}.svm")
+                if items.get(f"bag-{b}"):
+                    log.info("svm bag %d: already solved, skipping", b)
+                    continue
+                faults.fire("train", "bag", b, path=path)
                 tw, _ = member_masks(
                     n, 1, valid_rate=mc.train.validSetRate,
                     sample_rate=mc.train.baggingSampleRate,
@@ -386,8 +406,9 @@ class TrainProcessor(BasicProcessor):
                 train_mask = (tw[0] > 0) & (w > 0)
                 sv_x, alpha_y, tr, va, n_sv = train_kernel_svm(
                     x, y, train_mask, spec, c_penalty)
-                path = os.path.join(self.paths.models_dir, f"model{b}.svm")
                 save_model(path, spec, sv_x, alpha_y)
+                self.journal.commit_item(f"bag-{b}", files=[path],
+                                         valid_err=float(va))
                 pf.write(f"Trainer #{b} Train Error: {tr:.6f} "
                          f"Validation Error: {va:.6f} ({n_sv} SVs)\n")
                 log.info("svm bag %d: %d SVs -> %s", b, n_sv, path)
@@ -533,6 +554,7 @@ class TrainProcessor(BasicProcessor):
                     f"Train Error: {tr:.6f} Validation Error: {va:.6f}")
             pf.write(line + "\n")
             pf.flush()
+            faults.fire("train", "epoch", epoch + 1)
             log.info(line)
         return progress
 

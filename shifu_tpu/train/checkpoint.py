@@ -32,6 +32,14 @@ _NAME = re.compile(r"ckpt-(\d+)\.npz$")
 def save_state(directory: str, epoch: int, state: Any, keep: int = 3) -> str:
     """state: arbitrary pytree of arrays (params, opt_state, rng key...)."""
     os.makedirs(directory, exist_ok=True)
+    # sweep orphaned tmp files a previous crash left mid-rename — they
+    # are never valid checkpoints and would otherwise accumulate forever
+    for f in os.listdir(directory):
+        if f.endswith(".npz.tmp"):
+            try:
+                os.remove(os.path.join(directory, f))
+            except OSError:
+                pass
     leaves, treedef = jax.tree_util.tree_flatten(state)
     arrays = {f"leaf{i}": np.asarray(l) for i, l in enumerate(leaves)}
     arrays["__meta__"] = np.frombuffer(json.dumps(
@@ -74,6 +82,15 @@ def restore_state(directory: str, template: Any) -> Optional[Tuple[int, Any]]:
         if tuple(a.shape) != tuple(np.shape(tmpl)):
             log.warning("checkpoint leaf %d shape %s != template %s — "
                         "ignoring checkpoint", i, a.shape, np.shape(tmpl))
+            return None
+        tmpl_dt = np.dtype(getattr(tmpl, "dtype", None)
+                           or np.asarray(tmpl).dtype)
+        if a.dtype != tmpl_dt:
+            # shape-only acceptance silently CAST the restored leaves
+            # (e.g. an f32 checkpoint onto an int opt-state slot) — a
+            # config change this subtle must fall back to fresh init
+            log.warning("checkpoint leaf %d dtype %s != template %s — "
+                        "ignoring checkpoint", i, a.dtype, tmpl_dt)
             return None
         new_leaves.append(a)
     return meta["epoch"], jax.tree_util.tree_unflatten(treedef, new_leaves)

@@ -40,7 +40,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .. import obs
+from .. import faults, obs
 from ..config.model_config import Algorithm
 from ..data.shards import Shards
 from ..models import tree as tree_model
@@ -603,7 +603,8 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
               progress=None, init_trees: Optional[List[TreeArrays]] = None,
               init_score: Optional[float] = None, mesh=None,
               checkpoint_fn: Optional[Callable] = None,
-              start_history: Optional[List] = None) -> ForestResult:
+              start_history: Optional[List] = None,
+              init_scores: Optional[np.ndarray] = None) -> ForestResult:
     n, c = bins.shape
     vmask = validation_split(n, settings.valid_rate, settings.seed)
     wt = np.asarray(w, np.float64) * ~vmask
@@ -622,20 +623,32 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
     y_d, tw_d, vw_d = _device_put_rows(
         mesh, y64.astype(np.float32),
         wt.astype(np.float32), wv.astype(np.float32))
-    f = jnp.full(bins_d.shape[0], init_score, jnp.float32)
     cat = jnp.asarray(cat_mask if cat_mask is not None else np.zeros(c, bool))
     hc = bool(np.asarray(cat).any())
 
     trees: List[TreeArrays] = list(init_trees or [])
-    for t in trees:  # continuous/resumed training: replay existing trees
-        f = f + settings.learning_rate * predict_tree(
-            jnp.asarray(t.split_feat), jnp.asarray(t.left_mask),
-            jnp.asarray(t.leaf_value), bins_d, t.depth)
+    if trees and init_scores is not None and len(init_scores) == n:
+        # checkpointed per-row scores: restore f BYTE-exact.  Replaying
+        # trees eagerly is only f32-equivalent — XLA fuses the in-scan
+        # `f + lr * predict` differently (FMA), so a replayed f can flip
+        # borderline splits and break the bit-identical-resume contract
+        [f] = _device_put_rows(mesh,
+                               np.asarray(init_scores, np.float32))
+    else:
+        f = jnp.full(bins_d.shape[0], init_score, jnp.float32)
+        for t in trees:  # continuous training: replay existing trees
+            f = f + settings.learning_rate * predict_tree(
+                jnp.asarray(t.split_feat), jnp.asarray(t.left_mask),
+                jnp.asarray(t.leaf_value), bins_d, t.depth)
 
     stopper = GBTEarlyStopDecider()
     history: List[Tuple[float, float]] = list(start_history or [])
+    replay_stopped = False
     for tr_prev, va_prev in history:
-        stopper.add(va_prev)
+        # a restored forest that already hit its stop must not grow —
+        # the checkpointed trees ARE the truncated early-stop forest
+        if stopper.add(va_prev) and settings.early_stop:
+            replay_stopped = True
     fi = np.zeros(c)
     total = n_tree_nodes(settings.depth)
     imp = "friedmanmse" if settings.impurity == "friedmanmse" else "variance"
@@ -654,7 +667,7 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
     # the per-tree loop would have stopped at (trees are a prefix), so
     # results stay bit-identical at 1/K the syncs.
     ti = len(trees)
-    stopped = False
+    stopped = replay_stopped
     while ti < settings.n_trees and not stopped:
         chunk = settings.n_trees - ti
         if ckpt:
@@ -689,8 +702,17 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
                 stopped = True
                 break
         ti += chunk
-        if ckpt and not stopped and ti % ckpt == 0:
-            checkpoint_fn(trees, history, init_score)
+        if ckpt:
+            # TreeBatch-boundary checkpointing: every chunk is a commit
+            # point (checkpoint_every stays the upper bound via the chunk
+            # cap above); an early-stopped chunk checkpoints its
+            # TRUNCATED forest so a crash before the final model write
+            # resumes to the identical stop state.  Scores ride along so
+            # resume restores f byte-exact (None after a stop: f holds
+            # the dropped tail trees' updates, and a stopped forest
+            # never grows again anyway)
+            checkpoint_fn(trees, history, init_score,
+                          None if stopped else np.asarray(f)[:n])
     return ForestResult(
         trees=trees,
         spec_kwargs={"algorithm": "GBT", "loss": settings.loss,
@@ -783,7 +805,7 @@ def train_rf(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
                                                  start=ti):
                 progress(j, tr_err, va_err)
         ti += chunk
-        if ckpt and ti % ckpt == 0:
+        if ckpt:                       # TreeBatch-boundary checkpointing
             checkpoint_fn(trees, history, None)
     spec_kwargs: Dict[str, Any] = {"algorithm": "RF"}
     if mc:
@@ -1327,7 +1349,9 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                        start_history: Optional[List] = None,
                        mesh=None,
                        cache_budget: Optional[int] = None,
-                       y_transform=None, mask_fn=None) -> ForestResult:
+                       y_transform=None, mask_fn=None,
+                       init_scores: Optional[np.ndarray] = None
+                       ) -> ForestResult:
     """Out-of-core GBT over a ResidentCache: windows that fit the device
     budget are mesh-sharded HBM residents (re-sweeping them costs no IO);
     only the tail past the budget re-streams from disk per level.  The
@@ -1346,8 +1370,12 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
     trees: List[TreeArrays] = list(init_trees or [])
     history: List[Tuple[float, float]] = list(start_history or [])
     stopper = GBTEarlyStopDecider()
+    replay_stopped = False
     for _, va_prev in history:
-        stopper.add(va_prev)
+        # see train_gbt: a restored forest that already early-stopped
+        # must not grow past its truncation point
+        if stopper.add(va_prev) and settings.early_stop:
+            replay_stopped = True
 
     f_ref: Dict[str, Any] = {"f": None}   # prep-thread view of host scores
     cache = ResidentCache(stream,
@@ -1402,13 +1430,22 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
     f = None if init_d is not None else np.full(n_rows, init_score,
                                                 np.float32)
     f_ref["f"] = f
-    for t in trees:  # resumed/continuous: replay stored trees over the cache
-        sf, lm, lv = (jnp.asarray(t.split_feat), jnp.asarray(t.left_mask),
-                      jnp.asarray(t.leaf_value))
-        for it in cache.items():
-            pred = predict_tree(sf, lm, lv, it.arrays["bins"], t.depth)
-            s, e = it.start, it.start + it.n_valid
-            f[s:e] += settings.learning_rate * np.asarray(pred)[:it.n_valid]
+    if trees and init_scores is not None and len(init_scores) == n_rows:
+        # checkpointed scores restore f byte-exact (see train_gbt: the
+        # eager replay below is only f32-equivalent to the in-stream
+        # update and can flip borderline splits)
+        f = np.asarray(init_scores, np.float32).copy()
+        f_ref["f"] = f
+    else:
+        for t in trees:  # continuous: replay stored trees over the cache
+            sf, lm, lv = (jnp.asarray(t.split_feat),
+                          jnp.asarray(t.left_mask),
+                          jnp.asarray(t.leaf_value))
+            for it in cache.items():
+                pred = predict_tree(sf, lm, lv, it.arrays["bins"], t.depth)
+                s, e = it.start, it.start + it.n_valid
+                f[s:e] += settings.learning_rate * \
+                    np.asarray(pred)[:it.n_valid]
 
     def window_f(it):
         """Resident windows keep their score slice ON DEVICE across trees
@@ -1466,7 +1503,9 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
         mega = {k: _concat_rows([it.arrays[k] for it in items])
                 for k in ("bins", "y", "tw", "vw")}
         mega["f"] = _concat_rows([window_f(it) for it in items])
-    for ti in range(len(trees) + len(pending_fused), settings.n_trees):
+    start_ti = settings.n_trees if replay_stopped \
+        else len(trees) + len(pending_fused)
+    for ti in range(start_ti, settings.n_trees):
         fa = jnp.asarray(_feat_subset(settings, c, ti))
         if mega is not None:
             packed_d, mega["f"] = _gbt_round_streamed(
@@ -1498,15 +1537,22 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                               tree=len(trees))
                     log.info("GBT early stop after %d trees (streamed)",
                              len(trees))
+                    if checkpoint_fn and settings.checkpoint_every:
+                        # pin the truncated forest: a crash before the
+                        # final model write resumes to this exact state
+                        # (no scores — a stopped forest never grows)
+                        checkpoint_fn(trees, history, init_host())
                     break
                 es_checked = len(history)
                 flush_progress()
             elif progress and len(pending_fused) >= 8:
                 flush_progress()
             if checkpoint_fn and settings.checkpoint_every and \
-                    (ti + 1) % settings.checkpoint_every == 0:
+                    (ti + 1) % min(settings.checkpoint_every, 8) == 0:
+                # TreeBatch-boundary cadence (8 = the fused drain burst)
                 flush_progress()
-                checkpoint_fn(trees, history, init_host())
+                checkpoint_fn(trees, history, init_host(),
+                              np.asarray(mega["f"])[:n_rows])
             continue
         sf = jnp.full(total, -1, jnp.int32)
         lm = jnp.zeros((total, n_bins), bool)
@@ -1531,17 +1577,27 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
         # absorb_fused) — tail windows additionally round-trip their f
         # slice (they are disk-bound anyway)
         sums_dev = jnp.zeros(4, jnp.float32)
+        # TreeBatch-boundary checkpoint: on a checkpoint tree the update
+        # pass additionally snapshots every window's post-update scores
+        # (resident windows would otherwise need a second device fetch)
+        ckpt_due = bool(checkpoint_fn and settings.checkpoint_every and
+                        (ti + 1) % min(settings.checkpoint_every, 8) == 0)
+        scores = np.empty(n_rows, np.float32) if ckpt_due else None
         for it in cache.items():
             f2, sums_dev = _gbt_window_update(
                 sums_dev, it.arrays["bins"], it.arrays["y"],
                 it.arrays["tw"], it.arrays["vw"], window_f(it),
                 sf, lm, lv, settings.learning_rate, settings.depth,
                 settings.loss)
+            s, e = it.start, it.start + it.n_valid
             if it.resident:
                 it.arrays["f"] = f2
+                if scores is not None:
+                    scores[s:e] = np.asarray(f2)[:it.n_valid]
             else:
-                s, e = it.start, it.start + it.n_valid
                 f[s:e] = np.asarray(f2)[:it.n_valid]
+                if scores is not None:
+                    scores[s:e] = f[s:e]
         absorb_fused([_fetch(jnp.concatenate([
             sf.astype(jnp.float32), _pack_mask_bits(lm),
             lv, fi_add, sums_dev]))])
@@ -1550,12 +1606,13 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
             progress(ti, tr_err, va_err)
         mark_progress()
         es_checked = len(history)      # disk-tail trees feed the stopper
-        if checkpoint_fn and settings.checkpoint_every and \
-                (ti + 1) % settings.checkpoint_every == 0:
-            checkpoint_fn(trees, history, init_host())
+        if ckpt_due:
+            checkpoint_fn(trees, history, init_host(), scores)
         if settings.early_stop and stopper.add(va_err):
             obs.event("early_stop", trainer="gbt_streamed", tree=ti + 1)
             log.info("GBT early stop after %d trees (streamed)", ti + 1)
+            if checkpoint_fn and settings.checkpoint_every:
+                checkpoint_fn(trees, history, init_host())
             break
     flush_progress()
     return ForestResult(
@@ -1868,7 +1925,8 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
             if progress and len(pending_rf) >= 8:
                 flush_progress_rf()
             if checkpoint_fn and settings.checkpoint_every and \
-                    (ti + 1) % settings.checkpoint_every == 0:
+                    (ti + 1) % min(settings.checkpoint_every, 8) == 0:
+                # TreeBatch-boundary cadence (8 = the fetch burst)
                 flush_progress_rf()
                 checkpoint_fn(trees, history, None)
             ti += 1
@@ -1934,8 +1992,8 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                 progress(t, tr_err, va_err)
         mark_progress_rf()
         ti += TB
-        if checkpoint_fn and settings.checkpoint_every and \
-                ti % settings.checkpoint_every == 0:
+        if checkpoint_fn and settings.checkpoint_every:
+            # every tail batch is a TreeBatch boundary — commit it
             checkpoint_fn(trees, history, None)
     flush_progress_rf()
     spec_kwargs: Dict[str, Any] = {"algorithm": "RF"}
@@ -2231,6 +2289,7 @@ def _run_tree_ova(proc, shards, col_nums, cat_mask, n_bins,
                              spec_k.n_trees)
                     continue
             init_trees, init_score, start_history = (None, None, None)
+            init_scores = None
             if settings.resume:
                 ck = _forest_checkpoint_path(proc, f"_c{k}")
                 if os.path.isfile(ck):
@@ -2242,6 +2301,12 @@ def _run_tree_ova(proc, shards, col_nums, cat_mask, n_bins,
                             meta = json.load(f)
                     start_history = [tuple(h)
                                      for h in meta.get("history", [])]
+                    try:               # byte-exact f restore (see
+                        d = np.load(ck + ".scores.npz")  # _restore_or_…)
+                        if int(d["trees_done"]) == len(init_trees):
+                            init_scores = np.asarray(d["f"], np.float32)
+                    except (OSError, ValueError, KeyError):
+                        pass
                     log.info("OVA resume: class %d restarts from %d "
                              "checkpointed trees", k, len(init_trees))
             ckpt_fn = _forest_checkpoint_fn(proc, settings, alg, n_bins,
@@ -2262,7 +2327,8 @@ def _run_tree_ova(proc, shards, col_nums, cat_mask, n_bins,
                         settings, progress, init_trees=init_trees,
                         init_score=init_score, checkpoint_fn=ckpt_fn,
                         start_history=start_history, mesh=mesh,
-                        y_transform=yk_transform)
+                        y_transform=yk_transform,
+                        init_scores=init_scores)
                 else:
                     res = train_rf_streamed(
                         _tree_stream(shards, mesh), n_bins, cat_mask,
@@ -2277,7 +2343,8 @@ def _run_tree_ova(proc, shards, col_nums, cat_mask, n_bins,
                                     progress, init_trees=init_trees,
                                     init_score=init_score,
                                     checkpoint_fn=ckpt_fn,
-                                    start_history=start_history, mesh=mesh)
+                                    start_history=start_history, mesh=mesh,
+                                    init_scores=init_scores)
                 else:
                     res = train_rf(bins, yk, w, n_bins, cat_mask, settings,
                                    progress, checkpoint_fn=ckpt_fn,
@@ -2560,11 +2627,12 @@ def run_tree_training(proc) -> int:
             obs.counter("train.trees").inc()
             obs.event("tree", trainer=alg.name.lower(), tree=ti + 1,
                       train_err=round(tr, 6), valid_err=round(va, 6))
+            faults.fire("train", "tree", ti + 1)
             if (ti + 1) % 5 == 0 or ti == 0:
                 log.info(line)
 
-        init_trees, init_score, start_history = _restore_or_continuous(
-            proc, alg, settings)
+        init_trees, init_score, start_history, init_scores = \
+            _restore_or_continuous(proc, alg, settings)
         from ..parallel.mesh import device_mesh
         mesh = device_mesh(n_ensemble=1)   # trees are sequential: all devices
         if streaming:                      # on the data axis
@@ -2578,7 +2646,8 @@ def run_tree_training(proc) -> int:
                                          init_score=init_score,
                                          checkpoint_fn=ckpt_fn,
                                          start_history=start_history,
-                                         mesh=mesh)
+                                         mesh=mesh,
+                                         init_scores=init_scores)
             else:
                 res = train_rf_streamed(stream, n_bins, cat_mask, settings,
                                         progress, checkpoint_fn=ckpt_fn,
@@ -2595,7 +2664,8 @@ def run_tree_training(proc) -> int:
                 res = train_gbt(bins, y, w, n_bins, cat_mask, settings,
                                 progress, init_trees=init_trees,
                                 init_score=init_score, checkpoint_fn=ckpt_fn,
-                                start_history=start_history, mesh=mesh)
+                                start_history=start_history, mesh=mesh,
+                                init_scores=init_scores)
             else:
                 res = train_rf(bins, y, w, n_bins, cat_mask, settings,
                                progress, checkpoint_fn=ckpt_fn,
@@ -2641,8 +2711,11 @@ def _forest_checkpoint_fn(proc, settings: DTSettings, alg, n_bins, col_nums,
     """Mid-forest checkpoint (reference ``DTMaster.doCheckPoint`` every
     checkpointInterval iterations): partial forest + history persist; a
     killed run resumes from the last saved tree.  ``suffix`` separates
-    per-class OVA checkpoints (``forest_ckpt_c{k}.npz``)."""
-    def save(trees, history, init_score):
+    per-class OVA checkpoints (``forest_ckpt_c{k}.npz``).  ``scores``
+    (GBT per-row f) rides a sidecar so resume restores f BYTE-exact
+    instead of replaying trees (replay is only f32-equivalent)."""
+    def save(trees, history, init_score, scores=None):
+        from ..ioutil import atomic_savez, atomic_write_json
         os.makedirs(proc.paths.checkpoint_dir, exist_ok=True)
         spec = tree_model.TreeModelSpec(
             n_trees=len(trees), depth=settings.depth, n_bins=n_bins,
@@ -2655,16 +2728,28 @@ def _forest_checkpoint_fn(proc, settings: DTSettings, alg, n_bins, col_nums,
         tmp = path + ".tmp"
         tree_model.save_model(tmp, spec, trees)
         os.replace(tmp, path)
-        with open(path + ".meta.json", "w") as f:
-            json.dump({"trees_done": len(trees), "history": history,
-                       "seed": settings.seed}, f)
+        spath = path + ".scores.npz"
+        if scores is not None:
+            atomic_savez(spath, f=np.asarray(scores, np.float32),
+                         trees_done=np.asarray(len(trees), np.int64))
+        else:
+            try:           # never pair stale scores with a newer forest
+                os.remove(spath)
+            except OSError:
+                pass
+        atomic_write_json(path + ".meta.json",
+                          {"trees_done": len(trees), "history": history,
+                           "seed": settings.seed}, indent=0)
         log.info("forest checkpoint: %d trees", len(trees))
     return save
 
 
 def _restore_or_continuous(proc, alg, settings: DTSettings):
     """Resume order: explicit ``train -resume`` from the mid-forest
-    checkpoint, else continuous training from the final saved model."""
+    checkpoint, else continuous training from the final saved model.
+    Returns (trees, init_score, history, scores) — ``scores`` is the
+    checkpointed per-row f (None for continuous / legacy checkpoints;
+    the trainers then fall back to tree replay)."""
     if settings.resume:
         path = _forest_checkpoint_path(proc)
         if os.path.isfile(path):
@@ -2674,11 +2759,19 @@ def _restore_or_continuous(proc, alg, settings: DTSettings):
                 with open(path + ".meta.json") as f:
                     meta = json.load(f)
             history = [tuple(h) for h in meta.get("history", [])]
-            log.info("resume: restored %d trees from forest checkpoint",
-                     len(trees))
-            return trees, spec.init_score, history
+            scores = None
+            try:
+                d = np.load(path + ".scores.npz")
+                if int(d["trees_done"]) == len(trees):
+                    scores = np.asarray(d["f"], np.float32)
+            except (OSError, ValueError, KeyError):
+                pass
+            log.info("resume: restored %d trees from forest checkpoint"
+                     "%s", len(trees),
+                     " (+ per-row scores)" if scores is not None else "")
+            return trees, spec.init_score, history, scores
     init_trees, init_score = _continuous_trees(proc, alg, settings)
-    return init_trees, init_score, None
+    return init_trees, init_score, None, None
 
 
 def _continuous_trees(proc, alg, settings: DTSettings

@@ -178,6 +178,46 @@ def _unstack(tree, n: int) -> List[Any]:
     return [jax.tree_util.tree_map(lambda a: a[i], host) for i in range(n)]
 
 
+# -------------------------------------------- trainer-state checkpointing
+# The checkpoint must carry MORE than (params, opt_state, key): the final
+# model is each member's BEST-epoch params, and early stop is a stateful
+# window — dropping either made a resumed run pick a different model than
+# the uninterrupted one whenever the global best predated the crash.
+def _ckpt_template(stacked, opt_state, key, bags: int):
+    zf = np.zeros(bags, np.float64)
+    zi = np.zeros(bags, np.int64)
+    return (stacked, opt_state, np.asarray(key), zf, zf.copy(), stacked,
+            zf.copy(), zi)
+
+
+def _ckpt_state(stacked, opt_state, key, best_valid, best_train,
+                best_params, stops):
+    host = _to_host(stacked)
+    bp = [p if p is not None
+          else jax.tree_util.tree_map(lambda a, i=i: a[i], host)
+          for i, p in enumerate(best_params)]
+    best_stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *bp)
+    return (host, _to_host(opt_state), np.asarray(key),
+            np.asarray(best_valid, np.float64),
+            np.asarray(best_train, np.float64), best_stacked,
+            np.asarray([s.best for s in stops], np.float64),
+            np.asarray([s.since_best for s in stops], np.int64))
+
+
+def _restore_tracking(state, best_valid, best_train, best_params,
+                      stops) -> None:
+    _, _, _, bv, bt, best_stacked, es_b, es_s = state
+    best_valid[:] = bv
+    best_train[:] = bt
+    for i in range(len(best_params)):
+        if np.isfinite(bv[i]):
+            best_params[i] = jax.tree_util.tree_map(
+                lambda a, i=i: a[i].copy(), best_stacked)
+    for s, b, n in zip(stops, es_b, es_s):
+        s.best = float(b)
+        s.since_best = int(n)
+
+
 def train_ensemble(x: np.ndarray, y: np.ndarray,
                    train_w: np.ndarray, valid_w: np.ndarray,
                    spec: nn_model.NNModelSpec,
@@ -359,16 +399,24 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
     start_epoch = 0
     if settings.resume and settings.checkpoint_dir:
         from . import checkpoint as ckpt
-        restored = ckpt.restore_state(settings.checkpoint_dir,
-                                      (stacked, opt_state, key))
+        restored = ckpt.restore_state(
+            settings.checkpoint_dir,
+            _ckpt_template(stacked, opt_state, key, bags))
         if restored is not None:
-            start_epoch, (st_h, os_h, key_h) = restored
-            stacked = jax.device_put(st_h, sh_ens)
-            opt_state = jax.device_put(os_h, sh_ens)
-            key = jnp.asarray(key_h)
+            start_epoch, state = restored
+            stacked = jax.device_put(state[0], sh_ens)
+            opt_state = jax.device_put(state[1], sh_ens)
+            key = jnp.asarray(state[2])
+            _restore_tracking(state, best_valid, best_train, best_params,
+                              stops)
             lr_scale = (1.0 - settings.learning_decay) ** start_epoch \
                 if settings.learning_decay > 0 else 1.0
             log.info("resumed trainer state at epoch %d", start_epoch)
+            if settings.early_stop_window > 0 and \
+                    all(s.since_best >= s.window_size for s in stops):
+                # the interrupted run had already early-stopped — don't
+                # grow past its stop point
+                start_epoch = settings.epochs
 
     n_padded = xd.shape[0]
 
@@ -446,24 +494,29 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
         if checkpoint and settings.tmp_model_every and \
                 (epoch + 1) % settings.tmp_model_every == 0:
             checkpoint(epoch, _unstack(stacked, bags))
-        if settings.checkpoint_dir and settings.checkpoint_every and \
-                (epoch + 1) % settings.checkpoint_every == 0:
-            from . import checkpoint as ckpt
-            ckpt.save_state(settings.checkpoint_dir, epoch + 1,
-                            (_to_host(stacked), _to_host(opt_state),
-                             np.asarray(key)))
         if settings.learning_decay > 0:
             lr_scale *= (1.0 - settings.learning_decay)
+        stop_now = False
         if settings.early_stop_window > 0:
             # evaluate every member's window (no short-circuit: the stop
             # counters must advance uniformly) then stop when all agree
             flags = [s.should_stop(float(v)) for s, v in zip(stops, va)]
-            if all(flags):
-                obs.event("early_stop", trainer="nn", epoch=epoch,
-                          window=settings.early_stop_window)
-                log.info("early stop at epoch %d (window %d)", epoch,
-                         settings.early_stop_window)
-                break
+            stop_now = all(flags)
+        if settings.checkpoint_dir and settings.checkpoint_every and \
+                ((epoch + 1) % settings.checkpoint_every == 0 or stop_now):
+            # saved AFTER the early-stop windows advanced (and forced on
+            # the stop epoch): a resumed run replays the exact stop state
+            from . import checkpoint as ckpt
+            ckpt.save_state(settings.checkpoint_dir, epoch + 1,
+                            _ckpt_state(stacked, opt_state, key,
+                                        best_valid, best_train,
+                                        best_params, stops))
+        if stop_now:
+            obs.event("early_stop", trainer="nn", epoch=epoch,
+                      window=settings.early_stop_window)
+            log.info("early stop at epoch %d (window %d)", epoch,
+                     settings.early_stop_window)
+            break
 
     final = _to_host(stacked)
     for i in range(bags):
@@ -674,16 +727,22 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
     start_epoch = 0
     if settings.resume and settings.checkpoint_dir:
         from . import checkpoint as ckpt
-        restored = ckpt.restore_state(settings.checkpoint_dir,
-                                      (stacked, opt_state, key))
+        restored = ckpt.restore_state(
+            settings.checkpoint_dir,
+            _ckpt_template(stacked, opt_state, key, bags))
         if restored is not None:
-            start_epoch, (st_h, os_h, key_h) = restored
-            stacked = jax.device_put(st_h, sh_ens)
-            opt_state = jax.device_put(os_h, sh_ens)
-            key = jnp.asarray(key_h)
+            start_epoch, state = restored
+            stacked = jax.device_put(state[0], sh_ens)
+            opt_state = jax.device_put(state[1], sh_ens)
+            key = jnp.asarray(state[2])
+            _restore_tracking(state, best_valid, best_train, best_params,
+                              stops)
             lr_scale = (1.0 - settings.learning_decay) ** start_epoch \
                 if settings.learning_decay > 0 else 1.0
             log.info("resumed streamed trainer state at epoch %d", start_epoch)
+            if settings.early_stop_window > 0 and \
+                    all(s.since_best >= s.window_size for s in stops):
+                start_epoch = settings.epochs   # already early-stopped
 
     def put_window(win):
         xb = jax.device_put(win.arrays["x"].astype(np.float32), sh_x)
@@ -752,8 +811,12 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
         stats = np.asarray(stats_acc)
         # stats were measured on the params entering this epoch => they close
         # the ledger of the PREVIOUS epoch (snapshot the matching params, not
-        # the post-minibatch-update ones)
-        if epoch > start_epoch:
+        # the post-minibatch-update ones).  ``epoch > 0`` (not
+        # ``> start_epoch``): a RESUMED epoch's stats close the ledger of
+        # the last pre-crash epoch, which the checkpoint deliberately did
+        # not record — skipping it would desync best-params tracking from
+        # an uninterrupted run
+        if epoch > 0:
             stopped = bookkeep(epoch - 1, stats, params_entering)
         if full_batch:
             stacked, opt_state = apply_update(
@@ -764,11 +827,12 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
                 (epoch + 1) % settings.tmp_model_every == 0:
             checkpoint(epoch, _unstack(stacked, bags))
         if settings.checkpoint_dir and settings.checkpoint_every and \
-                (epoch + 1) % settings.checkpoint_every == 0:
+                ((epoch + 1) % settings.checkpoint_every == 0 or stopped):
             from . import checkpoint as ckpt
             ckpt.save_state(settings.checkpoint_dir, epoch + 1,
-                            (_to_host(stacked), _to_host(opt_state),
-                             np.asarray(key)))
+                            _ckpt_state(stacked, opt_state, key,
+                                        best_valid, best_train,
+                                        best_params, stops))
         if settings.learning_decay > 0:
             lr_scale *= (1.0 - settings.learning_decay)
         if stopped:

@@ -29,7 +29,8 @@ from ..data.shards import Shards
 from ..models import wdl as wdl_model
 from ..parallel import mesh as meshlib
 from .early_stop import WindowEarlyStop
-from .nn_trainer import TrainSettings, _stack, _to_host
+from .nn_trainer import (TrainSettings, _ckpt_state, _ckpt_template,
+                         _restore_tracking, _stack, _to_host)
 from .optimizers import make_optimizer
 from .sampling import member_masks
 
@@ -226,7 +227,29 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
     tr = va = np.zeros(bags)
     order_rng = np.random.default_rng([settings.seed, 1])
     obs_on = obs.enabled()
-    for epoch in range(settings.epochs):
+    start_epoch = 0
+    if settings.resume and settings.checkpoint_dir:
+        from . import checkpoint as ckpt
+        restored = ckpt.restore_state(
+            settings.checkpoint_dir,
+            _ckpt_template(stacked, opt_state, key, bags))
+        if restored is not None:
+            start_epoch, state = restored
+            stacked = jax.device_put(state[0], sh_ens)
+            opt_state = jax.device_put(state[1], sh_ens)
+            _restore_tracking(state, best_valid, best_train, best_params,
+                              stops)
+            # replay the batch-order RNG stream up to the resume point so
+            # the remaining epochs see the same permutations
+            for _ in range(start_epoch):
+                if bs and bs < n_padded:
+                    order_rng.permutation(
+                        np.arange(0, n_padded - bs + 1, bs).astype(np.int32))
+            log.info("resumed WDL trainer state at epoch %d", start_epoch)
+            if settings.early_stop_window > 0 and \
+                    all(s.since_best >= s.window_size for s in stops):
+                start_epoch = settings.epochs   # already early-stopped
+    for epoch in range(start_epoch, settings.epochs):
         ep_t0 = time.perf_counter()
         if bs and bs < n_padded:
             # rows were shuffled once; re-randomize the BATCH ORDER each
@@ -260,13 +283,22 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
                     lambda a: a[i].copy(), host)
         if progress:
             progress(epoch, float(tr.mean()), float(va.mean()))
+        stop_now = False
         if settings.early_stop_window > 0:
             flags = [s.should_stop(float(v)) for s, v in zip(stops, va)]
-            if all(flags):
-                obs.event("early_stop", trainer="wdl", epoch=epoch,
-                          window=settings.early_stop_window)
-                log.info("WDL early stop at epoch %d", epoch)
-                break
+            stop_now = all(flags)
+        if settings.checkpoint_dir and settings.checkpoint_every and \
+                ((epoch + 1) % settings.checkpoint_every == 0 or stop_now):
+            from . import checkpoint as ckpt
+            ckpt.save_state(settings.checkpoint_dir, epoch + 1,
+                            _ckpt_state(stacked, opt_state, key,
+                                        best_valid, best_train,
+                                        best_params, stops))
+        if stop_now:
+            obs.event("early_stop", trainer="wdl", epoch=epoch,
+                      window=settings.early_stop_window)
+            log.info("WDL early stop at epoch %d", epoch)
+            break
     final = _to_host(stacked)
     for i in range(bags):
         if best_params[i] is None:
@@ -423,7 +455,26 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
 
     epochs_run = 0
     stopped = False
-    for epoch in range(settings.epochs):
+    start_epoch = 0
+    if settings.resume and settings.checkpoint_dir:
+        from . import checkpoint as ckpt
+        restored = ckpt.restore_state(
+            settings.checkpoint_dir,
+            _ckpt_template(stacked, opt_state, key, bags))
+        if restored is not None:
+            start_epoch, state = restored
+            stacked = jax.device_put(state[0], sh_ens)
+            opt_state = jax.device_put(state[1], sh_ens)
+            _restore_tracking(state, best_valid, best_train, best_params,
+                              stops)
+            log.info("resumed streamed WDL trainer state at epoch %d",
+                     start_epoch)
+            epochs_run = start_epoch
+            if settings.early_stop_window > 0 and \
+                    all(s.since_best >= s.window_size for s in stops):
+                start_epoch = settings.epochs   # already early-stopped
+                stopped = True
+    for epoch in range(start_epoch, settings.epochs):
         stats_acc = jnp.zeros((bags, 4))
         grad_acc = zero_grads
         params_entering = stacked
@@ -442,6 +493,13 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
         stacked, opt_state = apply_update(stacked, opt_state, grad_acc,
                                           jnp.asarray(stats[:, 1]))
         epochs_run = epoch + 1
+        if settings.checkpoint_dir and settings.checkpoint_every and \
+                ((epoch + 1) % settings.checkpoint_every == 0 or stopped):
+            from . import checkpoint as ckpt
+            ckpt.save_state(settings.checkpoint_dir, epoch + 1,
+                            _ckpt_state(stacked, opt_state, key,
+                                        best_valid, best_train,
+                                        best_params, stops))
         if stopped:
             obs.event("early_stop", trainer="wdl_streamed", epoch=epoch,
                       window=settings.early_stop_window)
@@ -491,6 +549,11 @@ def run_wdl_training(proc) -> int:
     p = mc.train.params or {}
     bags = max(1, mc.train.baggingNum)
     settings = _wdl_settings(mc, p)
+    # trainer-state fail-over checkpoints + `train -resume` — the same
+    # epoch hooks the NN family has (grid trials stay checkpoint-free)
+    settings.checkpoint_dir = proc.paths.checkpoint_dir
+    settings.checkpoint_every = int(p.get("CheckpointInterval", 25))
+    settings.resume = bool(proc.params.get("resume"))
 
     by_num = {c.columnNum: c for c in proc.column_configs}
     streaming = proc._use_streaming(norm, schema) \

@@ -1,0 +1,516 @@
+"""Fault-injection suite (fast, in-process subset — tier-1 safe).
+
+Exercises the crash-consistency layer end to end WITHOUT subprocesses:
+injected ``ioerror`` faults kill a step mid-flight inside this process,
+then the re-run proves the journal/resume machinery reproduces the
+artifacts an uninterrupted run writes — bit-identically for the
+trainers.  Hard-kill (SIGKILL-equivalent) coverage lives in
+``test_resume_e2e.py`` (marked slow).
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from shifu_tpu import faults, obs
+from shifu_tpu.config import environment
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    environment.reset_for_tests()
+    faults.reset_for_tests()
+    yield
+    environment.reset_for_tests()
+    faults.reset_for_tests()
+    obs.set_enabled(False)
+
+
+def set_faults(spec: str) -> None:
+    environment.set_property("shifu.faults", spec)
+    faults.reset_for_tests()
+
+
+def _init_stats(mdir: str) -> None:
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    assert InitProcessor(mdir).run() == 0
+    assert StatsProcessor(mdir, params={}).run() == 0
+
+
+def _small_chunks_and_shards(monkeypatch, chunk_rows=500, shard_rows=1024):
+    """Shrink the reader chunk + shard size so the 4k-row fixture yields
+    several shards (shards flush on chunk boundaries once the buffer
+    crosses SHARD_ROWS)."""
+    from shifu_tpu.data.reader import DataSource
+    orig = DataSource.iter_chunks
+    monkeypatch.setattr(
+        DataSource, "iter_chunks",
+        lambda self, cr=chunk_rows: orig(self, chunk_rows))
+    monkeypatch.setattr("shifu_tpu.pipeline.norm.SHARD_ROWS", shard_rows)
+
+
+def _shard_arrays(d: str):
+    out = {}
+    for f in sorted(os.listdir(d)):
+        if f.endswith(".npz"):
+            out[f] = {k: v.copy()
+                      for k, v in np.load(os.path.join(d, f)).items()}
+    return out
+
+
+def _assert_same_shards(a, b):
+    assert a.keys() == b.keys()
+    for f in a:
+        assert a[f].keys() == b[f].keys(), f
+        for k in a[f]:
+            x, y = a[f][k], b[f][k]
+            assert x.dtype == y.dtype and x.shape == y.shape, (f, k)
+            assert x.tobytes() == y.tobytes(), (f, k)
+
+
+# ------------------------------------------------------------ harness unit
+def test_parse_spec():
+    c = faults.parse_spec("norm:shard=3:ioerror,train:tree=17:kill,"
+                          "reader:file=0:ioerror@2")
+    assert c[("norm", "shard", "3")] == ["ioerror", 1]
+    assert c[("train", "tree", "17")] == ["kill", 1]
+    assert c[("reader", "file", "0")] == ["ioerror", 2]
+    assert faults.parse_spec("") == {}
+
+
+def test_parse_spec_rejects_garbage():
+    with pytest.raises(ValueError, match="bad fault clause"):
+        faults.parse_spec("norm:shard=3:explode")
+    with pytest.raises(ValueError, match="bad fault clause"):
+        faults.parse_spec("norm=3")
+
+
+def test_fire_disarms_after_count():
+    set_faults("x:p=1:ioerror@2")
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("x", "p", 1)
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("x", "p", 1)
+    faults.fire("x", "p", 1)            # spent — no-op
+    faults.fire("x", "p", 2)            # different value — no-op
+
+
+# -------------------------------------------------------- journal / ioutil
+def test_journal_arm_and_verify(tmp_path):
+    from shifu_tpu.pipeline.journal import StepJournal
+    root = str(tmp_path)
+    art = os.path.join(root, "a.bin")
+    with open(art, "wb") as f:
+        f.write(b"x" * 100)
+    j = StepJournal(os.path.join(root, "J.json"), "T", root)
+    j.open_run()
+    j.arm({"v": 1})
+    j.commit_item("a", files=[art], rows=5)
+    assert j.verify_all()
+    # a second run over the TORN journal with the same signature resumes
+    j2 = StepJournal(os.path.join(root, "J.json"), "T", root)
+    assert j2.was_torn
+    j2.open_run()
+    assert set(j2.arm({"v": 1})) == {"a"}
+    # signature change drops the items
+    j3 = StepJournal(os.path.join(root, "J.json"), "T", root)
+    j3.open_run()
+    assert j3.arm({"v": 2}) == {}
+    # a completed run does NOT resume (idempotent full re-run)
+    j4 = StepJournal(os.path.join(root, "J.json"), "T", root)
+    j4.open_run()
+    j4.commit_item("a", files=[art])
+    j4.complete()
+    j5 = StepJournal(os.path.join(root, "J.json"), "T", root)
+    assert not j5.was_torn
+    j5.open_run()
+    assert j5.arm({"v": 2}) == {}
+
+
+def test_journal_detects_truncated_artifact(tmp_path):
+    from shifu_tpu.pipeline.journal import StepJournal
+    root = str(tmp_path)
+    art = os.path.join(root, "a.bin")
+    with open(art, "wb") as f:
+        f.write(b"x" * 100)
+    j = StepJournal(os.path.join(root, "J.json"), "T", root)
+    j.open_run()
+    j.arm({})
+    j.commit_item("a", files=[art])
+    with open(art, "r+b") as f:
+        f.truncate(37)                 # committed-looking but torn
+    j2 = StepJournal(os.path.join(root, "J.json"), "T", root)
+    j2.open_run()
+    assert j2.arm({}) == {}            # the torn item dropped out
+    assert not j.verify_item({"files": [["a.bin", 100]]})
+
+
+def test_io_retry_counts_and_provenance(tmp_path):
+    from shifu_tpu.ioutil import io_retry
+    environment.set_property("shifu.io.retryBaseMs", "1")
+    obs.set_enabled(True)
+    obs.get_registry().reset()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient weather")
+        return "ok"
+    assert io_retry(flaky, "unit read", "/data/part-7") == "ok"
+    assert obs.get_registry().counter("ingest.retries").value == 2
+
+    environment.set_property("shifu.io.retries", "1")
+    with pytest.raises(OSError, match=r"part-9.*permanent"):
+        io_retry(lambda: (_ for _ in ()).throw(OSError("permanent")),
+                 "unit read", "/data/part-9")
+
+
+# ------------------------------------------------------- checkpoint fixes
+def test_checkpoint_rejects_dtype_mismatch(tmp_path):
+    from shifu_tpu.train import checkpoint as ckpt
+    d = str(tmp_path)
+    ckpt.save_state(d, 3, {"a": np.arange(4, dtype=np.float32)})
+    ok = ckpt.restore_state(d, {"a": np.zeros(4, np.float32)})
+    assert ok is not None and ok[0] == 3
+    # same shape, different dtype: must be rejected, not silently cast
+    assert ckpt.restore_state(d, {"a": np.zeros(4, np.float64)}) is None
+    assert ckpt.restore_state(d, {"a": np.zeros(4, np.int32)}) is None
+
+
+def test_checkpoint_sweeps_orphan_tmp(tmp_path):
+    from shifu_tpu.train import checkpoint as ckpt
+    d = str(tmp_path)
+    orphan = os.path.join(d, "ckpt-9.npz.tmp")
+    os.makedirs(d, exist_ok=True)
+    with open(orphan, "wb") as f:
+        f.write(b"torn")
+    ckpt.save_state(d, 1, {"a": np.zeros(2, np.float32)})
+    assert not os.path.exists(orphan)
+    assert ckpt.latest_epoch(d) == 1
+
+
+# ------------------------------------------------------ retry in the data plane
+def test_reader_retries_transient_open(fraud_csv):
+    from shifu_tpu.data.reader import DataSource
+    environment.set_property("shifu.io.retryBaseMs", "1")
+    obs.set_enabled(True)
+    obs.get_registry().reset()
+    set_faults("reader:file=0:ioerror")
+    ds = DataSource(fraud_csv, "|")
+    rows = sum(len(c) for c in ds.iter_chunks())
+    assert rows > 0
+    assert obs.get_registry().counter("ingest.retries").value >= 1
+
+
+def test_reader_retry_exhaustion_names_the_shard(fraud_csv):
+    from shifu_tpu.data.reader import DataSource
+    environment.set_property("shifu.io.retryBaseMs", "1")
+    environment.set_property("shifu.io.retries", "1")
+    set_faults("reader:file=0:ioerror@10")
+    ds = DataSource(fraud_csv, "|")
+    with pytest.raises(OSError, match=os.path.basename(fraud_csv)):
+        list(ds.iter_chunks())
+
+
+def test_spill_manifest_commit_retries(tmp_path):
+    from shifu_tpu.data.spill import SpillWriter, open_spill
+    environment.set_property("shifu.io.retryBaseMs", "1")
+    set_faults("spill:manifest=0:ioerror")
+    d = str(tmp_path / "spill")
+    w = SpillWriter(d, ("y",), [["s", 1, 2]], 1 << 20)
+    assert w.append({"y": np.arange(8, dtype=np.float32)})
+    assert w.finish()                   # first manifest attempt faulted
+    rd, writable = open_spill(d, ("y",), [["s", 1, 2]])
+    assert rd is not None and rd.rows == 8
+
+
+# ------------------------------------------------- bounded bad-input tolerance
+def _mixed_dir(tmp_path) -> str:
+    d = tmp_path / "data"
+    d.mkdir()
+    with open(d / "part-aaa.csv", "w") as f:
+        for i in range(20):
+            f.write(f"{i}|{i * 2}|good\n")
+    # a .gz that is NOT gzip: decodes fine as a name, dies on first read
+    with open(d / "part-bbb.csv.gz", "wb") as f:
+        f.write(b"this is not gzip data\n" * 5)
+    return str(d)
+
+
+def test_bad_threshold_default_strict(tmp_path):
+    from shifu_tpu.data.reader import DataSource
+    ds = DataSource(_mixed_dir(tmp_path), "|",
+                    header=["a", "b", "tag"])
+    with pytest.raises(OSError):       # gzip.BadGzipFile is an OSError
+        list(ds.iter_chunks())
+
+
+def test_bad_threshold_quarantines_unreadable_file(tmp_path):
+    from shifu_tpu.data.reader import DataSource
+    environment.set_property("shifu.data.badThreshold", "0.6")
+    obs.set_enabled(True)
+    obs.get_registry().reset()
+    ds = DataSource(_mixed_dir(tmp_path), "|",
+                    header=["a", "b", "tag"])
+    rows = sum(len(c) for c in ds.iter_chunks())
+    assert rows == 20                  # the good file's rows survive
+    assert obs.get_registry().counter(
+        "data.quarantined_shards").value == 1
+
+
+def test_bad_threshold_exceeded_is_coded(tmp_path):
+    from shifu_tpu.config.errors import ErrorCode, ShifuError
+    from shifu_tpu.data.reader import DataSource
+    environment.set_property("shifu.data.badThreshold", "0.05")
+    ds = DataSource(_mixed_dir(tmp_path), "|",
+                    header=["a", "b", "tag"])
+    with pytest.raises(ShifuError) as ei:
+        list(ds.iter_chunks())
+    assert ei.value.error_code == ErrorCode.ERROR_BAD_DATA_THRESHOLD
+    assert "part-bbb" in str(ei.value)
+
+
+def _shard_set(tmp_path, n_shards=4, rows=32) -> str:
+    d = tmp_path / "shards"
+    d.mkdir()
+    for s in range(n_shards):
+        np.savez(d / f"part-{s:05d}.npz",
+                 y=np.full(rows, s, np.float32),
+                 w=np.ones(rows, np.float32))
+    with open(d / "schema.json", "w") as f:
+        json.dump({"numShards": n_shards, "numRows": n_shards * rows}, f)
+    return str(d)
+
+
+def test_shards_quarantine_undecodable(tmp_path):
+    from shifu_tpu.data.shards import Shards
+    d = _shard_set(tmp_path)
+    bad = os.path.join(d, "part-00002.npz")
+    with open(bad, "r+b") as f:
+        f.truncate(os.path.getsize(bad) // 2)      # torn zip
+    # strict (default, threshold 0): raises
+    with pytest.raises(Exception):
+        Shards.open(d).load_all()
+    environment.set_property("shifu.data.badThreshold", "0.5")
+    obs.set_enabled(True)
+    obs.get_registry().reset()
+    data = Shards.open(d).load_all()
+    assert len(data["y"]) == 3 * 32                # shard 2 quarantined
+    assert 2.0 not in data["y"]
+    assert obs.get_registry().counter(
+        "data.quarantined_shards").value == 1
+    # streaming stays strict even with the threshold set
+    with pytest.raises(Exception):
+        list(Shards.open(d).iter_shards(strict=True))
+
+
+# -------------------------------------------------- norm: resume mid-step
+def test_norm_resumes_at_first_uncommitted_shard(model_set, monkeypatch):
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    _init_stats(model_set)
+    control = model_set + "_ctl"
+    shutil.copytree(model_set, control)
+    _small_chunks_and_shards(monkeypatch)
+
+    set_faults("norm:shard=2:ioerror")
+    with pytest.raises(faults.InjectedFault):
+        NormalizeProcessor(model_set, params={}).run()
+
+    jpath = os.path.join(model_set, "tmp", "journal", "NORMALIZE.json")
+    with open(jpath) as f:
+        doc = json.load(f)
+    assert doc["status"] == "running"
+    assert "shard-00000" in doc["items"] and "shard-00001" in doc["items"]
+    assert "shard-00002" not in doc["items"]
+
+    ndir = os.path.join(model_set, "tmp", "NormalizedData")
+    part0 = os.path.join(ndir, "part-00000.npz")
+    mtime0 = os.stat(part0).st_mtime_ns
+
+    set_faults("")
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    # the committed prefix was NOT rewritten — resume started at shard 2
+    assert os.stat(part0).st_mtime_ns == mtime0
+    with open(jpath) as f:
+        assert json.load(f)["status"] == "complete"
+
+    assert NormalizeProcessor(control, params={}).run() == 0
+    for sub in ("NormalizedData", "CleanedData"):
+        _assert_same_shards(
+            _shard_arrays(os.path.join(model_set, "tmp", sub)),
+            _shard_arrays(os.path.join(control, "tmp", sub)))
+        with open(os.path.join(model_set, "tmp", sub, "schema.json")) as f:
+            sa = f.read()
+        with open(os.path.join(control, "tmp", sub, "schema.json")) as f:
+            assert sa == f.read()
+
+
+def test_norm_resume_rewrites_truncated_committed_shard(model_set,
+                                                        monkeypatch):
+    """A committed-LOOKING shard that was later truncated fails journal
+    verification on resume and its unit re-runs cleanly."""
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    _init_stats(model_set)
+    control = model_set + "_ctl"
+    shutil.copytree(model_set, control)
+    _small_chunks_and_shards(monkeypatch)
+
+    set_faults("norm:shard=2:ioerror")
+    with pytest.raises(faults.InjectedFault):
+        NormalizeProcessor(model_set, params={}).run()
+    ndir = os.path.join(model_set, "tmp", "NormalizedData")
+    part1 = os.path.join(ndir, "part-00001.npz")
+    with open(part1, "r+b") as f:
+        f.truncate(os.path.getsize(part1) // 2)
+
+    set_faults("")
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    assert NormalizeProcessor(control, params={}).run() == 0
+    for sub in ("NormalizedData", "CleanedData"):
+        _assert_same_shards(
+            _shard_arrays(os.path.join(model_set, "tmp", sub)),
+            _shard_arrays(os.path.join(control, "tmp", sub)))
+
+
+def test_train_precondition_rejects_torn_norm_artifacts(prepared_set):
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.config.errors import ErrorCode, ShifuError
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+    mc_path = os.path.join(prepared_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.train.algorithm = "GBT"
+    mc.train.params = {"TreeNum": 3, "MaxDepth": 3}
+    mc.save(mc_path)
+    ndir = os.path.join(prepared_set, "tmp", "NormalizedData")
+    shard = os.path.join(ndir, "part-00000.npz")
+    size = os.path.getsize(shard)
+    with open(shard, "r+b") as f:
+        f.truncate(size // 2)
+    with pytest.raises(ShifuError) as ei:
+        TrainProcessor(prepared_set, params={}).run()
+    assert ei.value.error_code == ErrorCode.ERROR_TORN_ARTIFACT
+    # re-running norm heals the plane; train then proceeds
+    assert NormalizeProcessor(prepared_set, params={}).run() == 0
+    assert TrainProcessor(prepared_set, params={}).run() == 0
+
+
+# --------------------------------------- stats: mid-sweep partial resume
+def test_stats_checkpoint_resume_matches_uninterrupted(model_set,
+                                                       monkeypatch):
+    from shifu_tpu.data.reader import DataSource
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    assert InitProcessor(model_set).run() == 0
+    control = model_set + "_ctl"
+    shutil.copytree(model_set, control)
+
+    orig = DataSource.iter_chunks
+    monkeypatch.setattr(DataSource, "iter_chunks",
+                        lambda self, chunk_rows=500: orig(self, 500))
+    environment.set_property("shifu.stats.checkpointChunks", "3")
+
+    assert StatsProcessor(control, params={}).run() == 0
+
+    set_faults("stats:chunk=5:ioerror")
+    with pytest.raises(faults.InjectedFault):
+        StatsProcessor(model_set, params={}).run()
+    partial = os.path.join(model_set, "tmp", "stats", "partial_sweep.npz")
+    assert os.path.isfile(partial)     # chunk-3 checkpoint landed
+
+    set_faults("")
+    assert StatsProcessor(model_set, params={}).run() == 0
+    assert not os.path.isfile(partial)  # committed runs drop partials
+    with open(os.path.join(model_set, "ColumnConfig.json")) as f:
+        resumed = f.read()
+    with open(os.path.join(control, "ColumnConfig.json")) as f:
+        assert resumed == f.read()
+
+
+# ------------------------------------- train: crash + resume, bit parity
+def _set_train(mdir, alg, params, epochs=None):
+    from shifu_tpu.config import ModelConfig
+    mc_path = os.path.join(mdir, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.train.algorithm = alg
+    mc.train.params = params
+    if epochs is not None:
+        mc.train.numTrainEpochs = epochs
+    mc.save(mc_path)
+
+
+def _load_trees(mdir):
+    from shifu_tpu.models import tree as tree_model
+    spec, trees = tree_model.load_model(
+        os.path.join(mdir, "models", "model0.gbt"))
+    return spec, trees
+
+
+def test_gbt_crash_resume_bit_identical(prepared_set):
+    from shifu_tpu.pipeline.train import TrainProcessor
+    control = prepared_set + "_ctl"
+    shutil.copytree(prepared_set, control)
+    params = {"TreeNum": 12, "MaxDepth": 3, "CheckpointInterval": 4}
+    _set_train(prepared_set, "GBT", params)
+    _set_train(control, "GBT", params)
+
+    assert TrainProcessor(control, params={}).run() == 0
+
+    set_faults("train:tree=9:ioerror")
+    with pytest.raises(faults.InjectedFault):
+        TrainProcessor(prepared_set, params={}).run()
+    # a mid-forest checkpoint committed at a TreeBatch boundary
+    assert os.path.isfile(os.path.join(prepared_set, "tmp", "checkpoints",
+                                       "forest_ckpt.npz"))
+
+    set_faults("")
+    # NO explicit -resume: the torn journal triggers auto-resume
+    assert TrainProcessor(prepared_set, params={}).run() == 0
+
+    _, trees_c = _load_trees(control)
+    _, trees_r = _load_trees(prepared_set)
+    assert len(trees_c) == len(trees_r) == 12
+    for tc, tr in zip(trees_c, trees_r):
+        assert np.asarray(tc.split_feat).tobytes() == \
+            np.asarray(tr.split_feat).tobytes()
+        assert np.asarray(tc.left_mask).tobytes() == \
+            np.asarray(tr.left_mask).tobytes()
+        assert np.asarray(tc.leaf_value).tobytes() == \
+            np.asarray(tr.leaf_value).tobytes()
+
+
+def test_nn_crash_resume_bit_identical(prepared_set):
+    from shifu_tpu.models import nn as nn_model
+    from shifu_tpu.pipeline.train import TrainProcessor
+    control = prepared_set + "_ctl"
+    shutil.copytree(prepared_set, control)
+    params = {"NumHiddenNodes": [8], "CheckpointInterval": 3,
+              "Propagation": "R"}
+    _set_train(prepared_set, "NN", params, epochs=9)
+    _set_train(control, "NN", params, epochs=9)
+
+    assert TrainProcessor(control, params={}).run() == 0
+
+    set_faults("train:epoch=6:ioerror")
+    with pytest.raises(faults.InjectedFault):
+        TrainProcessor(prepared_set, params={}).run()
+
+    set_faults("")
+    assert TrainProcessor(prepared_set, params={}).run() == 0
+
+    _, pc = nn_model.load_model(os.path.join(control, "models",
+                                             "model0.nn"))
+    _, pr = nn_model.load_model(os.path.join(prepared_set, "models",
+                                             "model0.nn"))
+    assert len(pc) == len(pr)
+    for lc, lr in zip(pc, pr):
+        for k in lc:
+            assert np.asarray(lc[k]).tobytes() == \
+                np.asarray(lr[k]).tobytes(), k

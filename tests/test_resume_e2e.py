@@ -1,0 +1,147 @@
+"""Kill-and-resume end-to-end suite (subprocess, SIGKILL-equivalent).
+
+Drives the real CLI in subprocesses, hard-kills it at injected phase
+boundaries (``SHIFU_TPU_FAULTS=...:kill`` → ``os._exit(137)``, no
+cleanup — what a preempted VM leaves behind), resumes, and asserts the
+final model AND eval artifacts are bit-identical to an uninterrupted
+run.  Marked ``slow`` (each leg pays a fresh interpreter + XLA compile);
+the in-process fast subset lives in ``test_faults.py``.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.faults]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(mdir, args, faults_spec="", expect=0):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_ENABLE_X64"] = "true"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/shifu_tpu_jax_cache")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if faults_spec:
+        env["SHIFU_TPU_FAULTS"] = faults_spec
+    else:
+        env.pop("SHIFU_TPU_FAULTS", None)
+    p = subprocess.run(
+        [sys.executable, "-m", "shifu_tpu.cli", "--dir", mdir] + args,
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert p.returncode == expect, \
+        f"rc={p.returncode} (wanted {expect})\n{p.stdout}\n{p.stderr}"
+    return p
+
+
+def _set_train(mdir, alg, params, epochs=None):
+    from shifu_tpu.config import ModelConfig
+    mc_path = os.path.join(mdir, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.train.algorithm = alg
+    mc.train.params = params
+    if epochs is not None:
+        mc.train.numTrainEpochs = epochs
+    mc.save(mc_path)
+
+
+def _eval_performance(mdir):
+    p = os.path.join(mdir, "evals", "Eval1", "EvalPerformance.json")
+    with open(p) as f:
+        return f.read()
+
+
+def test_gbt_sigkill_resume_bit_identical_artifacts(prepared_set):
+    from shifu_tpu.models import tree as tree_model
+    control = prepared_set + "_ctl"
+    shutil.copytree(prepared_set, control)
+    params = {"TreeNum": 12, "MaxDepth": 3, "CheckpointInterval": 4}
+    _set_train(prepared_set, "GBT", params)
+    _set_train(control, "GBT", params)
+
+    _run_cli(control, ["train"])
+    # hard death right after tree 9's progress line (post tree-batch 8's
+    # checkpoint commit)
+    _run_cli(prepared_set, ["train"], faults_spec="train:tree=9:kill",
+             expect=137)
+    assert os.path.isfile(os.path.join(
+        prepared_set, "tmp", "checkpoints", "forest_ckpt.npz"))
+    # plain re-run: the torn journal auto-resumes from the checkpoint
+    _run_cli(prepared_set, ["train"])
+
+    _, tc = tree_model.load_model(os.path.join(control, "models",
+                                               "model0.gbt"))
+    _, tr = tree_model.load_model(os.path.join(prepared_set, "models",
+                                               "model0.gbt"))
+    assert len(tc) == len(tr) == 12
+    for a, b in zip(tc, tr):
+        assert np.asarray(a.split_feat).tobytes() == \
+            np.asarray(b.split_feat).tobytes()
+        assert np.asarray(a.left_mask).tobytes() == \
+            np.asarray(b.left_mask).tobytes()
+        assert np.asarray(a.leaf_value).tobytes() == \
+            np.asarray(b.leaf_value).tobytes()
+
+    _run_cli(control, ["eval", "-run"])
+    _run_cli(prepared_set, ["eval", "-run"])
+    assert _eval_performance(control) == _eval_performance(prepared_set)
+
+
+def test_nn_sigkill_resume_bit_identical_artifacts(prepared_set):
+    from shifu_tpu.models import nn as nn_model
+    control = prepared_set + "_ctl"
+    shutil.copytree(prepared_set, control)
+    params = {"NumHiddenNodes": [8], "CheckpointInterval": 3,
+              "Propagation": "R"}
+    _set_train(prepared_set, "NN", params, epochs=9)
+    _set_train(control, "NN", params, epochs=9)
+
+    _run_cli(control, ["train"])
+    _run_cli(prepared_set, ["train"], faults_spec="train:epoch=6:kill",
+             expect=137)
+    _run_cli(prepared_set, ["train"])
+
+    _, pc = nn_model.load_model(os.path.join(control, "models",
+                                             "model0.nn"))
+    _, pr = nn_model.load_model(os.path.join(prepared_set, "models",
+                                             "model0.nn"))
+    assert len(pc) == len(pr)
+    for lc, lr in zip(pc, pr):
+        for k in lc:
+            assert np.asarray(lc[k]).tobytes() == \
+                np.asarray(lr[k]).tobytes(), k
+
+    _run_cli(control, ["eval", "-run"])
+    _run_cli(prepared_set, ["eval", "-run"])
+    assert _eval_performance(control) == _eval_performance(prepared_set)
+
+
+def test_norm_sigkill_resume_completes_cleanly(model_set):
+    """Kill `norm` mid-shard-commit via the harness, re-run, and verify
+    the journal reaches complete with a consistent schema."""
+    _run_cli(model_set, ["init"])
+    _run_cli(model_set, ["stats"])
+    # kill on shard 0's commit: the whole step is uncommitted
+    _run_cli(model_set, ["norm"], faults_spec="norm:shard=0:kill",
+             expect=137)
+    jpath = os.path.join(model_set, "tmp", "journal", "NORMALIZE.json")
+    with open(jpath) as f:
+        assert json.load(f)["status"] == "running"
+    _run_cli(model_set, ["norm"])
+    with open(jpath) as f:
+        doc = json.load(f)
+    assert doc["status"] == "complete"
+    ndir = os.path.join(model_set, "tmp", "NormalizedData")
+    with open(os.path.join(ndir, "schema.json")) as f:
+        schema = json.load(f)
+    parts = [x for x in os.listdir(ndir) if x.endswith(".npz")]
+    assert len(parts) == schema["numShards"]
+    assert sum(schema["shardRows"]) == schema["numRows"] > 0
