@@ -24,7 +24,8 @@ def main() -> None:
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--plane",
-                    choices=("all", "tail", "rf-repeat", "e2e", "resume"),
+                    choices=("all", "tail", "rf-repeat", "e2e", "resume",
+                             "varsel"),
                     default="all",
                     help="'tail' = quick disk-tail streamed-GBT bench; "
                          "'rf-repeat' = RF variance triage (cold-compile "
@@ -33,7 +34,10 @@ def main() -> None:
                          "(SHIFU_BENCH_E2E_ROWS sets the row count, "
                          "default 10M); 'resume' = restart-recovery "
                          "overhead (time-to-first-tree from a mid-forest "
-                         "checkpoint vs cold/warm starts)")
+                         "checkpoint vs cold/warm starts); 'varsel' = "
+                         "streamed mask-batched SE sensitivity vs the "
+                         "single-worker per-column loop at identical "
+                         "selections")
     args = ap.parse_args()
 
     result = run_benchmark(plane=args.plane)

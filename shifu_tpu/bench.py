@@ -28,7 +28,9 @@ from . import obs
 # run_benchmark refuses to run rather than silently emitting records the
 # round's BENCH_r0N.json consumers would mis-join with telemetry traces.
 # v2: ingest.* counters (spill cache / H2D stall instrumentation).
-BENCH_TELEMETRY_SCHEMA = 2
+# v3: varsel_* extras + varsel.* counters (streamed mask-batched
+# sensitivity plane: host_syncs / mask_batches / windows / rows_per_sec).
+BENCH_TELEMETRY_SCHEMA = 3
 
 # measured on this rig (tools/measure_baseline.py); provenance in
 # BASELINE.md — every headline divides by a MEASURED reference-class
@@ -38,6 +40,9 @@ MEASURED_CPU_TREE_ROWS_TREES_PER_SEC = 43068.1   # np.add.at hist GBT (07-30)
 MEASURED_CPU_SCORE_ROWS_PER_SEC = 1505.9     # per-row bagged scorer (07-30)
 MEASURED_CPU_STATS_ROWS_PER_SEC = 30872.1    # np.add.at stats pass, 256 cols
                                              # x 4096 buckets (07-31)
+MEASURED_CPU_VARSEL_ROWS_COLS_PER_SEC = 510610.6  # f64 per-column frozen-
+                                             # forward SE loop, 256-col
+                                             # plane x 1x16-tanh net (08-04)
 BASELINE_CLUSTER_WORKERS = 100          # north-star cluster size (BASELINE.json)
 BASELINE_ROWS_PER_SEC = MEASURED_CPU_ROWS_PER_SEC * BASELINE_CLUSTER_WORKERS
 BASELINE_TREE_RATE = (MEASURED_CPU_TREE_ROWS_TREES_PER_SEC
@@ -46,6 +51,8 @@ BASELINE_SCORE_RATE = (MEASURED_CPU_SCORE_ROWS_PER_SEC
                        * BASELINE_CLUSTER_WORKERS)
 BASELINE_STATS_RATE = (MEASURED_CPU_STATS_ROWS_PER_SEC
                        * BASELINE_CLUSTER_WORKERS)
+BASELINE_VARSEL_RATE = (MEASURED_CPU_VARSEL_ROWS_COLS_PER_SEC
+                        * BASELINE_CLUSTER_WORKERS)
 
 
 def bench_nn(n_rows: int = 1 << 17, n_features: int = 256,
@@ -587,6 +594,116 @@ def bench_resume(n_rows: int = 1 << 16, n_features: int = 64,
     }
 
 
+def bench_varsel(n_rows: int = 1 << 15, n_features: int = 256,
+                 n_candidates: int = 128, hidden: int = 16,
+                 filter_num: int = 24,
+                 mask_batch: int = None) -> Dict[str, Any]:
+    """Variable-selection plane (``bench.py --plane varsel``): the
+    streamed, mask-batched SE sensitivity job vs the single-worker NumPy
+    per-column loop — the reference's ``VarSelectMapper.java:93-120`` MR
+    computation, f64 forwards, one frozen column at a time — timed live
+    on the same rig AT IDENTICAL SELECTIONS (the top-``filter_num``
+    candidate sets must agree, else the speedup is meaningless).
+
+    Rates are rows*candidates/sec (every candidate mask re-scores every
+    row, like rows*trees for forests).  The recorded BASELINE.md
+    denominator (``MEASURED_CPU_VARSEL_ROWS_COLS_PER_SEC``) comes from
+    ``tools/measure_baseline.py`` at the bench NN shapes; the live loop
+    here runs the *same* computation at this bench's smaller shape so the
+    selections can be compared in seconds."""
+    import json
+    import os
+    import tempfile
+
+    import jax
+
+    from shifu_tpu.data.shards import Shards
+    from shifu_tpu.data.streaming import ShardStream, stream_window_rows
+    from shifu_tpu.models.nn import NNModelSpec, init_params
+    from shifu_tpu.ops import sensitivity as sens
+    from shifu_tpu.parallel.mesh import device_mesh
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n_rows, n_features)).astype(np.float32)
+    wv = rng.normal(size=n_features) / np.sqrt(n_features)
+    y = (rng.random(n_rows) < 1 / (1 + np.exp(-(x @ wv)))) \
+        .astype(np.float32)
+    spec = NNModelSpec(input_dim=n_features, hidden_nodes=[hidden],
+                       activations=["tanh"])
+    params = init_params(jax.random.PRNGKey(0), spec)
+    masks = sens.mask_matrix(n_features,
+                             [[c] for c in range(n_candidates)])
+
+    # ---- single-worker NumPy f64 per-column loop (reference-class)
+    w0 = np.asarray(params[0]["w"], np.float64)
+    b0 = np.asarray(params[0]["b"], np.float64)
+    w1 = np.asarray(params[1]["w"], np.float64)
+    b1 = np.asarray(params[1]["b"], np.float64)
+    x64 = x.astype(np.float64)
+    y64 = y.astype(np.float64)[:, None]
+
+    def np_mse(m):
+        h = np.tanh(m @ w0 + b0)
+        p = 1.0 / (1.0 + np.exp(-(h @ w1 + b1)))
+        return float(((p - y64) ** 2).mean())
+
+    mean_x = x64.mean(axis=0)
+    t0 = time.perf_counter()
+    base64 = np_mse(x64)
+    loop_mse = np.empty(n_candidates)
+    for c in range(n_candidates):
+        xf = x64.copy()
+        xf[:, c] = mean_x[c]
+        loop_mse[c] = np_mse(xf)
+    loop_dt = time.perf_counter() - t0
+    loop_rate = n_rows * n_candidates / loop_dt
+    sel_loop = set(np.argsort(-(loop_mse - base64))[:filter_num])
+
+    # ---- streamed mask-batched device job over materialized shards
+    with tempfile.TemporaryDirectory() as td:
+        shard_rows = 8192
+        k = 0
+        for s in range(0, n_rows, shard_rows):
+            e = min(s + shard_rows, n_rows)
+            np.savez(os.path.join(td, f"part-{k:05d}.npz"),
+                     x=x[s:e], y=y[s:e])
+            k += 1
+        with open(os.path.join(td, "schema.json"), "w") as f:
+            json.dump({"outputNames": [f"c{i}" for i in
+                                       range(n_features)],
+                       "columnNums": list(range(n_features)),
+                       "numShards": k, "numRows": n_rows}, f)
+        shards = Shards.open(td)
+        mesh = device_mesh()
+        window_rows = stream_window_rows(4 * (n_features + 2),
+                                         int(mesh.shape["data"]), shards)
+
+        def run():
+            stream = ShardStream(shards, ("x", "y"), window_rows)
+            return sens.streamed_sensitivity(stream, spec, params, masks,
+                                             mesh=mesh,
+                                             mask_batch=mask_batch)
+
+        run()                    # compile warmup + spill-cache build
+        best, mse, base = 0.0, None, None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            mse, base, nr = run()
+            dt = time.perf_counter() - t0
+            assert nr == n_rows
+            best = max(best, n_rows * n_candidates / dt)
+    sel_stream = set(np.argsort(-(mse - base))[:filter_num])
+
+    return {
+        "varsel_stream_rows_cols_per_sec": round(best, 1),
+        "varsel_loop_rows_cols_per_sec": round(loop_rate, 1),
+        "varsel_speedup_vs_loop": round(best / loop_rate, 2),
+        "varsel_selections_match": sel_stream == sel_loop,
+        "varsel_shape": f"{n_rows} rows x {n_features} feats, "
+                        f"{n_candidates} candidates, top {filter_num}",
+    }
+
+
 def _check_schema_handshake() -> None:
     if BENCH_TELEMETRY_SCHEMA != obs.SCHEMA_VERSION:
         raise RuntimeError(
@@ -668,9 +785,31 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
             "telemetry_schema_version": BENCH_TELEMETRY_SCHEMA,
             "extra": rep,
         }
+    if plane == "varsel":
+        with obs.span("bench.varsel", kind="bench"):
+            rep = bench_varsel()
+        for k, v in rep.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                obs.gauge(f"bench.{k}").set(float(v))
+        v = rep["varsel_stream_rows_cols_per_sec"]
+        return {
+            "metric": "varsel_stream_rows_cols_per_sec",
+            "value": v,
+            "unit": "rows*cols/sec",
+            "plane": "varsel",
+            "telemetry_schema_version": BENCH_TELEMETRY_SCHEMA,
+            "vs_baseline": round(v / BASELINE_VARSEL_RATE, 3),
+            "baseline_rows_per_sec": BASELINE_VARSEL_RATE,
+            "baseline_provenance": "measured 510610.6 rows*cols/s/worker "
+                                   "f64 per-column frozen-forward loop on "
+                                   "this rig x 100 north-star workers "
+                                   "(BASELINE.md)",
+            "extra": rep,
+        }
     if plane not in (None, "all"):
         raise ValueError(
-            f"unknown bench plane {plane!r} (tail|rf-repeat|e2e|resume|all)")
+            f"unknown bench plane {plane!r} "
+            "(tail|rf-repeat|e2e|resume|varsel|all)")
     nn_rows_per_sec = bench_nn()
     obs.gauge("bench.nn_train_throughput").set(nn_rows_per_sec)
     extras: Dict[str, Any] = {}
@@ -698,6 +837,18 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
     record("wdl_train_throughput", bench_wdl, BASELINE_ROWS_PER_SEC)
     record("eval_throughput", bench_eval, BASELINE_SCORE_RATE)
     record("stats_throughput", bench_stats, BASELINE_STATS_RATE)
+    try:
+        with obs.span("bench.varsel", kind="bench"):
+            rep = bench_varsel()
+        extras.update(rep)
+        extras["varsel_throughput_vs_baseline"] = round(
+            rep["varsel_stream_rows_cols_per_sec"] / BASELINE_VARSEL_RATE,
+            3)
+        for k, v in rep.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                obs.gauge(f"bench.{k}").set(float(v))
+    except Exception as e:                      # pragma: no cover
+        extras["varsel_throughput_error"] = str(e)[:200]
     extras["streamed_bench_shape"] = {
         "resident": "262144 rows x 100 trees (since r5; was x 8 — 100 = "
                     "the default TreeNum, amortizing the one-time ingest "

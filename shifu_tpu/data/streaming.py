@@ -49,6 +49,37 @@ def stream_prefetch_depth(override=None) -> int:
     from ..config import environment
     return max(0, environment.get_int("shifu.stream.prefetch", 2))
 
+
+def pipeline_depth_for(mesh) -> Optional[int]:
+    """Pipelined window prep (background-thread masks + device_put) is
+    single-device only: a second thread dispatching programs against a
+    multi-device CPU mesh can interleave two collective programs, the
+    known XLA:CPU in-process rendezvous deadlock.  None = the stream's
+    prefetch depth.  Shared by every streamed plane (trees, varselect,
+    genetic wrapper) — per-plane copies had already drifted once."""
+    if mesh is not None and getattr(mesh, "size", 1) > 1:
+        return 0
+    return None
+
+
+def should_stream(shards, schema: Optional[dict] = None) -> bool:
+    """THE resident-vs-streamed decision every plane shares (train NN/WDL,
+    varselect sensitivity, genetic wrapper): stream out-of-core when the
+    f32 norm plane would not fit ``shifu.train.memoryBudgetBytes``;
+    forced either way via ``-Dshifu.train.streaming=on|off``."""
+    from ..config import environment
+    mode = (environment.get_property("shifu.train.streaming", "auto")
+            or "auto").lower()
+    if mode in ("on", "true", "force"):
+        return True
+    if mode in ("off", "false"):
+        return False
+    schema = schema if schema is not None else getattr(shards, "schema", {})
+    budget = environment.get_int("shifu.train.memoryBudgetBytes", 1 << 31)
+    width = len(schema.get("outputNames") or []) or 1
+    n_rows = schema.get("numRows") or shards.num_rows
+    return n_rows * 4 * (width + 2) > budget
+
 # ------------------------------------------------------------ hash uniforms
 _U64 = np.uint64
 

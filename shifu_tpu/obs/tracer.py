@@ -34,7 +34,10 @@ from typing import Any, Dict, List, Optional
 # v2: ingest instrumentation (ingest.bytes_read / windows_emitted /
 # h2d_wait_seconds / disk_passes / spill_hits / spill_misses counters;
 # the report's "ingest stall fraction" line derives from them)
-SCHEMA_VERSION = 2
+# v3: variable-selection plane instrumentation (varsel.host_syncs /
+# mask_batches / windows counters, varsel.rows_per_sec / candidates
+# gauges; bench varsel_* extras ride the same version)
+SCHEMA_VERSION = 3
 
 _TRUE = ("1", "true", "on", "yes")
 

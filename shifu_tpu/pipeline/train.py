@@ -417,18 +417,11 @@ class TrainProcessor(BasicProcessor):
     def _use_streaming(self, shards: Shards, schema: dict) -> bool:
         """Out-of-core mode when the materialized data exceeds the memory
         budget (reference ``guagua.data.memoryFraction`` role) or when
-        forced via ``-Dshifu.train.streaming=on``."""
-        from ..config import environment
-        mode = (environment.get_property("shifu.train.streaming", "auto")
-                or "auto").lower()
-        if mode in ("on", "true", "force"):
-            return True
-        if mode in ("off", "false"):
-            return False
-        budget = environment.get_int("shifu.train.memoryBudgetBytes", 1 << 31)
-        width = len(schema.get("outputNames") or []) or 1
-        n_rows = schema.get("numRows") or shards.num_rows
-        return n_rows * 4 * (width + 2) > budget
+        forced via ``-Dshifu.train.streaming=on`` — the shared
+        :func:`data.streaming.should_stream` decision (varselect's
+        sensitivity/genetic planes consult the same one)."""
+        from ..data.streaming import should_stream
+        return should_stream(shards, schema)
 
     def _train_nn_streamed(self, alg: Algorithm, shards: Shards,
                            n_classes: int = 0, ova: bool = False) -> int:

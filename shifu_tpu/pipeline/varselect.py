@@ -9,8 +9,11 @@ Paths implemented:
 - SE / ST sensitivity: the reference trains an NN then runs an MR job that
   re-scores every record with feature i frozen to its mean
   (``core/varselect/VarSelectMapper.java:93-120``) — here that whole job is
-  one vmapped batched forward over columns: score[i] = MSE rise when column
-  i's feature block is frozen;
+  the STREAMED, mask-batched device program of
+  :mod:`shifu_tpu.ops.sensitivity`: the norm plane streams window-by-window
+  (never host-resident), each window evaluates ``MaskBatch`` frozen-column
+  masks per vmapped launch, scores fetch ONCE at the end; score[i] = MSE
+  rise when column i's feature block is frozen;
 - force-select / force-remove name files; ``-list`` / ``-reset`` /
   ``-recover`` bookkeeping with a varsel history file.
 """
@@ -20,7 +23,6 @@ from __future__ import annotations
 import json
 import logging
 import os
-import shutil
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -36,17 +38,18 @@ log = logging.getLogger(__name__)
 
 def pareto_front_ranks(ks: np.ndarray, iv: np.ndarray) -> np.ndarray:
     """Iterative Pareto fronts over (ks, iv): rank 0 = first front
-    (reference PARETO filter)."""
+    (reference PARETO filter).  Each front computes ONE broadcast
+    domination matrix (dominated[i] = any j with k_j>=k_i, v_j>=v_i and a
+    strict edge) instead of the former per-point Python scan."""
     n = len(ks)
     remaining = np.arange(n)
     ranks = np.zeros(n, int)
     r = 0
     while len(remaining):
         k, v = ks[remaining], iv[remaining]
-        dominated = np.zeros(len(remaining), bool)
-        for i in range(len(remaining)):
-            dominated[i] = np.any((k >= k[i]) & (v >= v[i]) &
-                                  ((k > k[i]) | (v > v[i])))
+        ge = (k[:, None] >= k[None, :]) & (v[:, None] >= v[None, :])
+        gt = (k[:, None] > k[None, :]) | (v[:, None] > v[None, :])
+        dominated = np.any(ge & gt, axis=0)
         front = remaining[~dominated]
         ranks[front] = r
         remaining = remaining[dominated]
@@ -102,8 +105,12 @@ class VarSelectProcessor(BasicProcessor):
             log.error("%s history empty", what)
             return False
         apply_fn(json.loads(lines[-1]))
-        with open(path, "w") as f:
-            f.write("\n".join(lines[:-1]) + ("\n" if lines[:-1] else ""))
+        # atomic truncation: a crash mid-rewrite must not tear the
+        # remaining history (the torn-write hazard PR 4 eliminated for
+        # every other artifact)
+        from ..ioutil import atomic_write_text
+        atomic_write_text(path, "\n".join(lines[:-1])
+                          + ("\n" if lines[:-1] else ""))
         return True
 
     def _recover(self) -> int:
@@ -230,8 +237,8 @@ class VarSelectProcessor(BasicProcessor):
             self._snapshot_round(i + 1)
             se_src = os.path.join(self.paths.varsel_dir, "se.json")
             if os.path.isfile(se_src):
-                shutil.copy(se_src, os.path.join(self.paths.varsel_dir,
-                                                 f"se.{i}.json"))
+                _atomic_copy(se_src, os.path.join(self.paths.varsel_dir,
+                                                  f"se.{i}.json"))
             log.info("recursive varselect round %d/%d: %d selected",
                      i + 1, rounds, len(self._selected()))
         return 0
@@ -239,8 +246,8 @@ class VarSelectProcessor(BasicProcessor):
     def _snapshot_round(self, i: int) -> None:
         src = self.paths.column_config_path
         if os.path.isfile(src):
-            shutil.copy(src, os.path.join(self.paths.varsel_dir,
-                                          f"ColumnConfig.json.{i}"))
+            _atomic_copy(src, os.path.join(self.paths.varsel_dir,
+                                           f"ColumnConfig.json.{i}"))
 
     def _select_once(self) -> int:
         vs = self.model_config.varSelect
@@ -358,18 +365,20 @@ class VarSelectProcessor(BasicProcessor):
                             for line in f])
         idx = {n: i for i, n in enumerate(header)}
         ranked = sorted(cols, key=lambda c: -(c.columnStats.ks or 0))
+        # index the matrix ONCE per candidate and compare against all kept
+        # rows with a numpy mask (the former kept-vs-candidate inner loop
+        # was nested dict lookups per pair)
+        abs_mat = np.abs(mat)
         kept: List[ColumnConfig] = []
+        kept_rows: List[int] = []            # matrix rows of kept columns
         for c in ranked:
             i = idx.get(c.columnName)
-            ok = True
-            if i is not None:
-                for k in kept:
-                    j = idx.get(k.columnName)
-                    if j is not None and abs(mat[i, j]) > vs.correlationThreshold:
-                        ok = False
-                        break
-            if ok:
+            if i is None or not kept_rows or \
+                    not np.any(abs_mat[i, kept_rows]
+                               > vs.correlationThreshold):
                 kept.append(c)
+                if i is not None:
+                    kept_rows.append(i)
         kept_names = {c.columnName for c in kept}
         return [c for c in cols if c.columnName in kept_names], \
             len(cols) - len(kept)
@@ -380,14 +389,19 @@ class VarSelectProcessor(BasicProcessor):
         """SE/ST: ΔMSE when a column's feature block is frozen to its mean.
 
         The reference trains one NN then fans out an MR job
-        (``VarSelectMapper.java:66``); here: one trained model (train step
-        must have run), one vmapped forward per column over the norm matrix.
-        ST additionally normalizes by the column's score variance share."""
-        import jax
-        import jax.numpy as jnp
-
+        (``VarSelectMapper.java:66``); here the whole job is the streamed,
+        mask-batched device program of :mod:`shifu_tpu.ops.sensitivity`:
+        the norm plane streams window-by-window (never resident on host),
+        each window evaluates ``MaskBatch`` candidate masks per vmapped
+        launch, and the scores come back in ONE end-of-job fetch.
+        ``-Dshifu.varsel.batched=false`` restores the seed's resident
+        per-column loop (the parity oracle)."""
+        from .. import obs
+        from ..config import environment
         from ..data.shards import Shards
+        from ..ioutil import atomic_write_json
         from ..models import nn as nn_model
+        from ..ops import sensitivity as sens
 
         model_path = self.paths.model_path(0, None)
         if not os.path.isfile(model_path):
@@ -396,60 +410,92 @@ class VarSelectProcessor(BasicProcessor):
                 "model; run `train` first (reference trains one inline)")
         spec, params = nn_model.load_model(model_path)
         shards = Shards.open(self.paths.norm_dir)
-        data = shards.load_all()
-        x = jnp.asarray(data["x"])
-        y = jnp.asarray(data["y"])[:, None]
         names = shards.schema["outputNames"]
         col_nums = shards.schema["columnNums"]
 
-        base_pred = nn_model.forward(params, spec, x)
-        base_mse = float(jnp.mean((base_pred - y) ** 2))
-        mean_x = x.mean(axis=0)
-
-        # map candidate column -> its feature indices (onehot/woe blocks)
+        # map candidate column -> its feature indices (onehot/woe blocks,
+        # frozen as WHOLE blocks)
         blocks = _column_blocks(names, col_nums, candidates)
+        in_plane = [c for c in candidates if blocks.get(c.columnNum)]
+        if not in_plane:
+            raise RuntimeError("SE/ST varselect: no candidate feature "
+                               "blocks in the normalized plane — run `norm`")
+        masks = sens.mask_matrix(
+            len(names), [blocks[c.columnNum] for c in in_plane])
 
-        @jax.jit
-        def frozen_mse(feat_idx_mask):
-            xf = jnp.where(feat_idx_mask[None, :], mean_x[None, :], x)
-            pred = nn_model.forward(params, spec, xf)
-            return jnp.mean((pred - y) ** 2)
+        t0 = time.perf_counter()
+        with obs.span("varselect.sensitivity", kind="phase"):
+            if environment.get_bool("shifu.varsel.batched", True):
+                n_rows = self._run_streamed_sensitivity(
+                    shards, spec, params, masks)
+                mse, base_mse = self._sens_result
+            else:               # escape hatch: the seed's resident loop
+                data = shards.load_all()
+                mse, base_mse = sens.per_column_scores(
+                    spec, params, data["x"], data["y"], masks)
+                n_rows = len(data["y"])
+                self._sens_result = (mse, base_mse)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        obs.gauge("varsel.rows_per_sec").set(n_rows * len(in_plane) / dt)
+        obs.gauge("varsel.candidates").set(float(len(in_plane)))
+        log.info("sensitivity: %d candidates x %d rows in %.2fs "
+                 "(%.0f rows*cols/s)", len(in_plane), n_rows, dt,
+                 n_rows * len(in_plane) / dt)
 
-        scores: Dict[int, float] = {}
-        for c in candidates:
-            fidx = blocks.get(c.columnNum)
-            if fidx is None:
-                # not in the trained model's feature plane (e.g. dropped in
-                # an earlier recursive round): rank LAST — a 0.0 here would
-                # outrank in-model columns with negative sensitivity and
-                # re-select a column the scoring model never saw
-                scores[c.columnNum] = float("-inf")
-                continue
-            mask = np.zeros(x.shape[1], bool)
-            mask[fidx] = True
-            mse = float(frozen_mse(jnp.asarray(mask)))
-            # SE: absolute sensitivity; ST: relative rise over base
-            scores[c.columnNum] = (mse - base_mse) if fb == FilterBy.SE \
-                else (mse - base_mse) / max(base_mse, 1e-12)
-        sens_path = os.path.join(self.paths.varsel_dir, "se.json")
+        scores = _scores_from_mse(candidates,
+                                  [c.columnNum for c in in_plane],
+                                  mse, base_mse, fb)
         os.makedirs(self.paths.varsel_dir, exist_ok=True)
-        with open(sens_path, "w") as f:
-            json.dump({str(k): v for k, v in
-                       sorted(scores.items(), key=lambda kv: -kv[1])
-                       if v != float("-inf")}, f, indent=2)
+        atomic_write_json(
+            os.path.join(self.paths.varsel_dir, "se.json"),
+            {str(k): v for k, v in
+             sorted(scores.items(), key=lambda kv: -kv[1])
+             if v != float("-inf")})
         return scores
+
+    def _run_streamed_sensitivity(self, shards, spec, params,
+                                  masks) -> int:
+        """Window geometry + stream wiring for the mask-batched job;
+        stashes (mse, base_mse) on ``self._sens_result`` and returns the
+        row count."""
+        from ..data.streaming import ShardStream, stream_window_rows
+        from ..ops import sensitivity as sens
+        from ..parallel.mesh import device_mesh
+
+        vs = self.model_config.varSelect
+        B = sens.mask_batch_size(vs.params)
+        mesh = device_mesh()
+        d = len(shards.schema["outputNames"])
+        # the vmapped launch holds ~B frozen window copies: account B in
+        # the row-bytes estimate so the auto window shrinks with the batch
+        window_rows = stream_window_rows(4 * (d + 2) * max(1, B // 4),
+                                         int(mesh.shape["data"]), shards)
+        stream = ShardStream(shards, ("x", "y"), window_rows)
+        log.info("sensitivity STREAMED: window %d rows, mask batch %d "
+                 "(%d programs/window)", window_rows, B,
+                 -(-len(masks) // B))
+        mse, base_mse, n_rows = sens.streamed_sensitivity(
+            stream, spec, params, masks, mesh=mesh, mask_batch=B)
+        self._sens_result = (mse, base_mse)
+        return n_rows
 
     def _genetic_scores(self, candidates: List[ColumnConfig],
                         vs) -> Dict[int, float]:
         """dvarsel wrapper search: a population of column subsets evolves by
         inherit/crossover/mutation, fitness = masked-NN validation loss, all
         candidates trained as one vmapped run (reference ``core/dvarsel/``;
-        see ``train/dvarsel.py``).  Needs `norm` to have run."""
+        see ``train/dvarsel.py``).  Needs `norm` to have run.  Data mode
+        follows the shared streaming decision (``should_stream``): planes
+        past the memory budget evaluate fitness as minibatch scans over
+        prepared windows instead of loading the matrix."""
         from ..data.shards import Shards
-        from ..train.dvarsel import WrapperSettings, genetic_varselect
+        from ..data.streaming import (ShardStream, should_stream,
+                                      stream_window_rows)
+        from ..ioutil import atomic_write_json
+        from ..train.dvarsel import (WrapperSettings, genetic_varselect,
+                                     genetic_varselect_streamed)
 
         shards = Shards.open(self.paths.norm_dir)
-        data = shards.load_all()
         names = shards.schema["outputNames"]
         col_nums = shards.schema["columnNums"]
         blocks = _column_blocks(names, col_nums, candidates)
@@ -460,15 +506,27 @@ class VarSelectProcessor(BasicProcessor):
         settings = WrapperSettings.from_params(
             vs.params, n_select=min(vs.filterNum, len(blocks)),
             valid_rate=self.model_config.train.validSetRate)
-        scores, history = genetic_varselect(
-            data["x"], data["y"], data["w"], blocks, settings)
+        if should_stream(shards):
+            from ..parallel.mesh import device_mesh
+            mesh = device_mesh(n_ensemble=settings.population)
+            window_rows = stream_window_rows(4 * (len(names) + 2),
+                                             int(mesh.shape["data"]),
+                                             shards)
+            stream = ShardStream(shards, ("x", "y", "w"), window_rows)
+            log.info("genetic varselect STREAMED: window %d rows, "
+                     "population %d", window_rows, settings.population)
+            scores, history = genetic_varselect_streamed(
+                stream, blocks, settings, mesh=mesh)
+        else:
+            data = shards.load_all()
+            scores, history = genetic_varselect(
+                data["x"], data["y"], data["w"], blocks, settings)
         os.makedirs(self.paths.varsel_dir, exist_ok=True)
-        with open(os.path.join(self.paths.varsel_dir, "genetic.json"),
-                  "w") as f:
-            json.dump({"history": history,
-                       "credit": {str(k): v for k, v in sorted(
-                           scores.items(), key=lambda kv: -kv[1])}},
-                      f, indent=2)
+        atomic_write_json(
+            os.path.join(self.paths.varsel_dir, "genetic.json"),
+            {"history": history,
+             "credit": {str(k): v for k, v in sorted(
+                 scores.items(), key=lambda kv: -kv[1])}})
         # columns with no feature block rank last
         for c in candidates:
             scores.setdefault(c.columnNum, -1.0)
@@ -507,6 +565,30 @@ def _column_blocks(names: List[str], col_nums: List[int],
         if cn is not None:
             blocks.setdefault(cn, []).append(i)
     return blocks
+
+
+def _scores_from_mse(candidates: List[ColumnConfig],
+                     in_plane_ids: List[int], mse: np.ndarray,
+                     base_mse: float, fb: FilterBy) -> Dict[int, float]:
+    """Frozen-MSE vector -> per-column SE/ST scores.  Candidates absent
+    from the trained model's feature plane (e.g. dropped in an earlier
+    recursive round) score ``-inf``: never selectable, not merely last —
+    a 0.0 would outrank in-model columns with negative sensitivity and
+    re-select a column the scoring model never saw."""
+    scores = {c.columnNum: float("-inf") for c in candidates}
+    for cn, m in zip(in_plane_ids, mse):
+        # SE: absolute sensitivity; ST: relative rise over base
+        scores[cn] = (float(m) - base_mse) if fb == FilterBy.SE \
+            else (float(m) - base_mse) / max(base_mse, 1e-12)
+    return scores
+
+
+def _atomic_copy(src: str, dst: str) -> None:
+    """Whole-or-nothing snapshot copy (``shutil.copy`` can leave a torn
+    destination on a crash mid-write)."""
+    from ..ioutil import atomic_write_bytes
+    with open(src, "rb") as f:
+        atomic_write_bytes(dst, f.read())
 
 
 def _rank_of(scores: Dict[int, float]) -> Dict[int, int]:

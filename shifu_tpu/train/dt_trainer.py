@@ -1214,14 +1214,11 @@ def _default_cache_budget() -> int:
 
 
 def _pipeline_depth(mesh) -> Optional[int]:
-    """Pipelined window prep (background-thread masks + device_put) is
-    single-device only: a second thread dispatching programs against a
-    multi-device CPU mesh can interleave two collective programs, the
-    known XLA:CPU in-process rendezvous deadlock (see
-    :func:`_gbt_window_hist`).  None = the stream's prefetch depth."""
-    if mesh is not None and getattr(mesh, "size", 1) > 1:
-        return 0
-    return None
+    """See :func:`data.streaming.pipeline_depth_for` — the shared
+    single-device-only pipelined-prep rule (XLA:CPU in-process rendezvous
+    deadlock, see :func:`_gbt_window_hist`)."""
+    from ..data.streaming import pipeline_depth_for
+    return pipeline_depth_for(mesh)
 
 
 # trees grown per disk-tail sweep in streamed RF (histogram state is

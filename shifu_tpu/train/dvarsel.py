@@ -10,13 +10,20 @@ simultaneously as ONE vmapped program — a candidate's subset is a binary
 mask on the first-layer weights (``x @ (w * mask)`` ≡ masking the inputs),
 so every member shares a single compiled graph and the population fans out
 on the vmap/ensemble axis instead of worker threads.
+
+Two data modes share one search loop (:func:`_genetic_search`):
+resident (:func:`genetic_varselect`, the matrix in HBM) and streamed
+(:func:`genetic_varselect_streamed`, fitness epochs as minibatch scans
+over prepared ``ShardStream`` windows — the out-of-core treatment the
+train/stats/sensitivity planes already get; the norm plane is never
+host-resident).
 """
 
 from __future__ import annotations
 
 import logging
 from dataclasses import dataclass
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -137,26 +144,178 @@ def evaluate_population(x, y, tw, vw, feat_masks,
     return make_population_evaluator(x, y, tw, vw, settings)(feat_masks)
 
 
+def make_streamed_population_evaluator(stream, settings: WrapperSettings,
+                                       mesh=None,
+                                       cache_budget: Optional[int] = None):
+    """Out-of-core counterpart of :func:`make_population_evaluator`: the
+    whole population still trains as ONE vmapped program, but fitness
+    epochs are **minibatch scans over prepared windows** — the norm plane
+    streams through ``ShardStream.prepared`` (prefetch/H2D pipelining +
+    the mmap spill fast path) with windows under the device cache budget
+    staying HBM-resident across every epoch and generation, so the
+    dataset never materializes on host.  Members shard over the mesh
+    ``ensemble`` axis, rows over ``data``.
+
+    Train/validation split derives statelessly from the global row index
+    (``row_uniform``, same stream/seed convention as the streamed
+    trainers) — the resident evaluator's load-time ``rng.random`` split
+    needs the whole plane in one array, which streaming by definition
+    does not have.
+
+    Returns ``(evaluate, d)``: ``evaluate(feat_masks [P, D]) -> val-loss
+    [P]`` with ONE ``[P, 2]`` device fetch per generation (counted by
+    ``varsel.host_syncs``)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as Spec
+
+    from .. import obs
+    from ..data.streaming import (PreparedWindow, ResidentCache,
+                                  pipeline_depth_for, row_uniform)
+    from ..parallel import mesh as meshlib
+
+    names = stream.shards.schema.get("outputNames") or []
+    if not names:
+        raise ValueError("streamed dvarsel needs schema outputNames "
+                         "(run `norm` to materialize the plane)")
+    d = len(names)
+    P = settings.population
+    if mesh is None:
+        mesh = meshlib.device_mesh(n_ensemble=P)
+    data_size = int(mesh.shape["data"])
+    assert stream.window_rows % data_size == 0, \
+        f"window_rows {stream.window_rows} must divide data axis {data_size}"
+
+    spec = nn_model.NNModelSpec(input_dim=d,
+                                hidden_nodes=[settings.hidden],
+                                activations=["tanh"], loss="log")
+    p0 = nn_model.init_params(jax.random.PRNGKey(settings.seed), spec)
+    opt = make_optimizer("ADAM", settings.learning_rate)
+    os0 = opt.init(p0)
+
+    sh_ens = NamedSharding(mesh, Spec("ensemble"))
+    sh_x = NamedSharding(mesh, Spec("data", None))
+    sh_r = NamedSharding(mesh, Spec("data"))
+    stacked0 = jax.device_put(jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a, (P,) + a.shape), p0), sh_ens)
+    opt0 = jax.device_put(jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a, (P,) + a.shape), os0), sh_ens)
+
+    def masked_params(params, m):
+        return [{"w": params[0]["w"] * m[:, None], "b": params[0]["b"]}] \
+            + params[1:]
+
+    @jax.jit
+    def window_update(stacked, opt_state, masks, xb, yb, tw):
+        """One minibatch (= window) ADAM step for every member at once."""
+        def one(params, ostate, m):
+            def loss(p):
+                pred = nn_model.forward(masked_params(p, m), spec, xb)
+                per = nn_model.per_row_loss(pred, yb[:, None], spec)
+                return (per * tw).sum() / jnp.maximum(tw.sum(), 1e-9)
+            grads = jax.grad(loss)(params)
+            delta, ostate = opt.update(grads, ostate, params)
+            params = jax.tree_util.tree_map(lambda p_, dl: p_ + dl,
+                                            params, delta)
+            return params, ostate
+        return jax.vmap(one)(stacked, opt_state, masks)
+
+    @jax.jit
+    def window_fitness(stacked, masks, acc, xb, yb, vw):
+        def one(params, m):
+            pred = nn_model.forward(masked_params(params, m), spec, xb)
+            per = nn_model.per_row_loss(pred, yb[:, None], spec)
+            return jnp.stack([(per * vw).sum(), vw.sum()])
+        return acc + jax.vmap(one)(stacked, masks)
+
+    def prepare(win):
+        xb = jax.device_put(win.arrays["x"].astype(np.float32, copy=False),
+                            sh_x)
+        yb = jax.device_put(win.arrays["y"].astype(np.float32, copy=False),
+                            sh_r)
+        vmask = row_uniform(settings.seed, 11, win.index) \
+            < settings.valid_rate
+        wcol = np.asarray(win.arrays["w"], np.float32).copy()
+        wcol[win.n_valid:] = 0.0
+        tw = jax.device_put((wcol * ~vmask).astype(np.float32), sh_r)
+        vw = jax.device_put((wcol * vmask).astype(np.float32), sh_r)
+        return PreparedWindow(start=win.start, n_valid=win.n_valid,
+                              rows=win.rows, index=win.index,
+                              arrays={"x": xb, "y": yb, "tw": tw,
+                                      "vw": vw})
+
+    if cache_budget is None:
+        from ..config import environment
+        cache_budget = environment.get_int("shifu.train.deviceCacheBytes",
+                                           1 << 30)
+    cache = ResidentCache(stream, cache_budget, prepare,
+                          pipeline_depth=pipeline_depth_for(mesh))
+
+    def evaluate(feat_masks: np.ndarray) -> np.ndarray:
+        masks = jax.device_put(
+            np.asarray(feat_masks, np.float32),
+            NamedSharding(mesh, Spec("ensemble", None)))
+        stacked, opt_state = stacked0, opt0
+        for _ in range(settings.epochs):
+            for it in cache.items():
+                stacked, opt_state = window_update(
+                    stacked, opt_state, masks, it.arrays["x"],
+                    it.arrays["y"], it.arrays["tw"])
+                obs.counter("varsel.windows").inc()
+        acc = jnp.zeros((feat_masks.shape[0], 2))
+        for it in cache.items():
+            acc = window_fitness(stacked, masks, acc, it.arrays["x"],
+                                 it.arrays["y"], it.arrays["vw"])
+        a = np.asarray(acc)        # the generation's ONE device fetch
+        obs.counter("varsel.host_syncs").inc()
+        return a[:, 0] / np.maximum(a[:, 1], 1e-9)
+
+    return evaluate, d
+
+
 def genetic_varselect(x: np.ndarray, y: np.ndarray, w: np.ndarray,
                       blocks: Dict[int, List[int]],
                       settings: WrapperSettings
                       ) -> Tuple[Dict[int, float], List[dict]]:
-    """Evolve column subsets; returns (per-column credit scores, history).
+    """Evolve column subsets over a RESIDENT matrix; returns (per-column
+    credit scores, history).  See :func:`_genetic_search` for the loop;
+    :func:`genetic_varselect_streamed` is the out-of-core twin."""
+    rng = np.random.default_rng(settings.seed)
+    vmask = rng.random(len(y)) < settings.valid_rate
+    tw = np.asarray(w, np.float32) * ~vmask
+    vw = np.asarray(w, np.float32) * vmask
+    evaluate = make_population_evaluator(x, y, tw, vw, settings)
+    return _genetic_search(evaluate, blocks, settings, x.shape[1], rng)
+
+
+def genetic_varselect_streamed(stream, blocks: Dict[int, List[int]],
+                               settings: WrapperSettings, mesh=None,
+                               cache_budget: Optional[int] = None
+                               ) -> Tuple[Dict[int, float], List[dict]]:
+    """Out-of-core genetic wrapper: same search
+    (``CandidateGenerator``/``SeedCredit`` semantics, shared loop), with
+    fitness evaluated by minibatch scans over prepared norm-plane windows
+    instead of a resident matrix."""
+    evaluate, d = make_streamed_population_evaluator(stream, settings,
+                                                     mesh, cache_budget)
+    return _genetic_search(evaluate, blocks, settings, d,
+                           np.random.default_rng(settings.seed))
+
+
+def _genetic_search(evaluate, blocks: Dict[int, List[int]],
+                    settings: WrapperSettings, d: int,
+                    rng: np.random.Generator
+                    ) -> Tuple[Dict[int, float], List[dict]]:
+    """The generation loop both data modes share.
 
     Seeds are column-id sets of size ``n_select``; each generation ranks
-    them by masked-NN validation loss, then builds the next from inherit +
-    crossover + mutation (``CandidateGenerator.java``); per-column credit
-    accumulates rank-weighted wins (``SeedCredit.java``)."""
-    rng = np.random.default_rng(settings.seed)
+    them by masked-NN validation loss (``evaluate(feat_masks [P, d])``),
+    then builds the next from inherit + crossover + mutation
+    (``CandidateGenerator.java``); per-column credit accumulates
+    rank-weighted wins (``SeedCredit.java``)."""
     col_ids = sorted(blocks.keys())
     C = len(col_ids)
     k = min(settings.n_select, C)
     P = settings.population
-    d = x.shape[1]
-
-    vmask = rng.random(len(y)) < settings.valid_rate
-    tw = np.asarray(w, np.float32) * ~vmask
-    vw = np.asarray(w, np.float32) * vmask
 
     def feat_mask(seed_cols: np.ndarray) -> np.ndarray:
         m = np.zeros(d, bool)
@@ -169,7 +328,6 @@ def genetic_varselect(x: np.ndarray, y: np.ndarray, w: np.ndarray,
                     "seed holds ALL columns, the search is degenerate; set "
                     "EXPECT_VARIABLE_CNT (or filterNum) below the candidate "
                     "count", k, C)
-    evaluate = make_population_evaluator(x, y, tw, vw, settings)
     pop = np.stack([rng.choice(C, size=k, replace=False) for _ in range(P)])
     credit = np.zeros(C)
     history: List[dict] = []

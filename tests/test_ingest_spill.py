@@ -299,10 +299,11 @@ def test_report_renders_ingest_stall_fraction(tmp_path):
 
 def test_bench_tail_plane_schema():
     """`--plane tail` quick mode exists and the bench/obs schema handshake
-    still holds after the v2 bump."""
+    still holds past the v2 (ingest.*) bump — v3 added the varsel.*
+    instrumentation; the ingest counters this suite pins remain."""
     from shifu_tpu import obs
     from shifu_tpu.bench import BENCH_TELEMETRY_SCHEMA
-    assert BENCH_TELEMETRY_SCHEMA == obs.SCHEMA_VERSION == 2
+    assert BENCH_TELEMETRY_SCHEMA == obs.SCHEMA_VERSION >= 2
     import shifu_tpu.bench as bench_mod
     assert callable(bench_mod.bench_gbt_streamed_tail)
     with pytest.raises(ValueError):

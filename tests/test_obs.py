@@ -262,9 +262,15 @@ def test_disabled_telemetry_overhead_within_noise(telemetry_off):
 
 def test_bench_schema_matches_obs():
     """bench.py must fail loudly when its emitted schema version and the
-    obs schema diverge — this pin is the loud failure's test double."""
-    from shifu_tpu.bench import BENCH_TELEMETRY_SCHEMA
+    obs schema diverge — this pin is the loud failure's test double.
+    v3 added the varsel_* extras (streamed mask-batched sensitivity
+    plane): the version must be current AND the plane registered, so a
+    schema bump cannot land without the varsel emission being
+    re-validated."""
+    from shifu_tpu.bench import BENCH_TELEMETRY_SCHEMA, bench_varsel
     assert BENCH_TELEMETRY_SCHEMA == obs.SCHEMA_VERSION
+    assert BENCH_TELEMETRY_SCHEMA >= 3          # varsel_* extras era
+    assert callable(bench_varsel)
 
 
 def test_bench_refuses_schema_mismatch(monkeypatch):

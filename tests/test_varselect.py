@@ -64,7 +64,8 @@ def test_varselect_other_filters(model_set, by):
     assert sum(c.finalSelect for c in _ccs(model_set)) == 3
 
 
-def test_varselect_se_sensitivity(model_set):
+def test_varselect_se_sensitivity(model_set, monkeypatch):
+    from shifu_tpu.data.shards import Shards
     from shifu_tpu.pipeline.varselect import VarSelectProcessor
     from shifu_tpu.config.model_config import FilterBy
     _prep(model_set, train_first=True)
@@ -73,6 +74,13 @@ def test_varselect_se_sensitivity(model_set):
     mc.varSelect.filterNum = 3
     mc.varSelect.filterBy = FilterBy.SE
     mc.save(mc_path)
+
+    # the streamed sensitivity plane must NEVER materialize the full norm
+    # plane on host (the 1TB-north-star constraint)
+    def _no_load_all(self):
+        raise AssertionError("SE varselect called Shards.load_all — the "
+                             "streamed plane must not materialize")
+    monkeypatch.setattr(Shards, "load_all", _no_load_all)
     assert VarSelectProcessor(model_set, params={}).run() == 0
     sel = {c.columnName for c in _ccs(model_set) if c.finalSelect}
     assert len(sel) == 3

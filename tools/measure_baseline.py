@@ -84,6 +84,7 @@ def main() -> None:
     out.update(measure_cpu_tree_trainer())
     out.update(measure_cpu_scalar_scorer())
     out.update(measure_cpu_stats_worker())
+    out.update(measure_cpu_varsel_worker())
     print(json.dumps(out, indent=1))
 
 
@@ -268,6 +269,51 @@ def measure_cpu_stats_worker(n_rows: int = 1 << 15, n_cols: int = 256,
             "cpu_stats_shapes":
                 f"{n_rows} rows x {n_cols} cols x {num_buckets} buckets, "
                 "np.add.at per column, single thread"}
+
+
+def measure_cpu_varsel_worker(n_rows: int = 1 << 15, n_features: int = 256,
+                              hidden=(16,), candidates: int = 8) -> dict:
+    """Single-worker reference-style SE sensitivity loop: the varselect MR
+    job (``VarSelectMapper.java:93-120``) re-scores every record with one
+    candidate column frozen to its mean through the trained NN and
+    accumulates the squared-error rise.  Stand-in: f64 NumPy forwards at
+    the varsel bench shapes (fraud-width feature plane, wrapper-scale
+    1x16-tanh net — the model class SE/ST actually scores), one frozen
+    column at a time (vectorized matvecs where the mapper loops rows —
+    generous), single thread.  Rate is rows*candidates/s; bench.py
+    divides its device rate by this x the north-star worker count."""
+    rng = np.random.default_rng(0)
+    dims = [n_features, *hidden, 1]
+    ws = [rng.normal(size=(a, b)) / np.sqrt(a)
+          for a, b in zip(dims[:-1], dims[1:])]
+    bs = [np.zeros(b) for b in dims[1:]]
+    x = rng.normal(size=(n_rows, n_features))
+    y = (rng.random((n_rows, 1)) < 0.3).astype(np.float64)
+
+    def fwd(m):
+        h = m
+        for w, b in zip(ws[:-1], bs[:-1]):
+            h = np.tanh(h @ w + b)
+        return 1.0 / (1.0 + np.exp(-(h @ ws[-1] + bs[-1])))
+
+    mean_x = x.mean(axis=0)
+    base = ((fwd(x) - y) ** 2).mean()
+
+    def one_candidate(c):
+        xf = x.copy()
+        xf[:, c] = mean_x[c]
+        return ((fwd(xf) - y) ** 2).mean() - base
+
+    one_candidate(0)                             # warm caches
+    t0 = time.time()
+    for c in range(1, 1 + candidates):
+        one_candidate(c)
+    dt = time.time() - t0
+    return {"cpu_varsel_rows_cols_per_sec":
+                round(candidates * n_rows / dt, 1),
+            "cpu_varsel_shapes":
+                f"{n_rows} rows x {n_features}->{hidden}->1 f64, "
+                f"{candidates} frozen-column forwards, single thread"}
 
 
 if __name__ == "__main__":
