@@ -16,6 +16,7 @@ loudly on a bench/obs schema mismatch).
 
 import argparse
 import json
+import sys
 
 
 def main() -> None:
@@ -40,7 +41,15 @@ def main() -> None:
                          "selections")
     args = ap.parse_args()
 
-    result = run_benchmark(plane=args.plane)
+    try:
+        result = run_benchmark(plane=args.plane)
+    except RuntimeError as e:
+        # schema-version handshake failure (bench/obs drift) must land as
+        # a nonzero exit for CI, not a stack trace mistaken for a crash
+        if "schema" in str(e):
+            print(f"bench: {e}", file=sys.stderr)
+            sys.exit(2)
+        raise
     if obs.enabled():
         obs.flush("telemetry/trace.jsonl", step="BENCH",
                   extra_meta={"headline": result["metric"]})

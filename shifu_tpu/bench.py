@@ -30,7 +30,10 @@ from . import obs
 # v2: ingest.* counters (spill cache / H2D stall instrumentation).
 # v3: varsel_* extras + varsel.* counters (streamed mask-batched
 # sensitivity plane: host_syncs / mask_batches / windows / rows_per_sec).
-BENCH_TELEMETRY_SCHEMA = 3
+# v4: disk-tail super-batch round — tail_* extras (disk passes / tail
+# sweeps / bytes read PER TREE, dual-schedule c2f vs exact rates, RF
+# super-batch width) + train.tail_sweeps / tail_repairs counters.
+BENCH_TELEMETRY_SCHEMA = 4
 
 # measured on this rig (tools/measure_baseline.py); provenance in
 # BASELINE.md — every headline divides by a MEASURED reference-class
@@ -148,15 +151,40 @@ def bench_gbt(n_rows: int = 1 << 17, n_features: int = 64, n_bins: int = 64,
         n_rows, n_features, n_bins)
 
 
+def _bench_tree_rows(rng, n_rows: int, n_features: int, n_bins: int,
+                     learnable: bool):
+    """Synthetic binned rows.  ``learnable=True`` derives y from a sparse
+    logit over a few binned columns (fraud-style signal, like the e2e
+    plane) instead of pure label noise — the regime real training runs
+    in, and the design point of the coarse-to-fine tail: under pure
+    noise every split is a coin toss on f32 summation order, so
+    resident-prefix speculation diverges adversarially often."""
+    bins = rng.integers(0, n_bins, size=(n_rows, n_features)) \
+        .astype(np.int16)
+    if learnable:
+        logit = (0.12 * bins[:, 0] + 0.08 * bins[:, 3]
+                 - 0.10 * bins[:, 7] + 0.05 * bins[:, 11]) / n_bins * 8 - 2
+        y = (rng.random(n_rows) < 1 / (1 + np.exp(-logit))) \
+            .astype(np.float32)
+    else:
+        y = (rng.random(n_rows) < 0.3).astype(np.float32)
+    return bins, y
+
+
 def bench_gbt_streamed(n_rows: int = 1 << 18, n_features: int = 64,
                        n_bins: int = 64, n_trees: int = 100,
                        depth: int = 5,
-                       cache_budget: int = None) -> float:
+                       cache_budget: int = None,
+                       learnable: bool = False,
+                       reps: int = 5,
+                       collect: Dict[str, Any] = None) -> float:
     """GBT throughput in out-of-core streamed mode (windows re-read from the
     stream; measures the full IO+compute path).  ``cache_budget`` caps the
     HBM-resident window cache — pass a budget smaller than the dataset to
     force the disk-tail path (windows past the budget re-stream per level),
-    the configuration the 1TB-dataset scenario actually runs."""
+    the configuration the 1TB-dataset scenario actually runs.  ``collect``
+    (optional dict) receives the ingest accounting of the last timed run:
+    disk_passes / tail_sweeps / bytes_read / trees."""
     import json
     import os
     import tempfile
@@ -166,8 +194,7 @@ def bench_gbt_streamed(n_rows: int = 1 << 18, n_features: int = 64,
     from shifu_tpu.train.dt_trainer import DTSettings, train_gbt_streamed
 
     rng = np.random.default_rng(0)
-    bins = rng.integers(0, n_bins, size=(n_rows, n_features)).astype(np.int16)
-    y = (rng.random(n_rows) < 0.3).astype(np.float32)
+    bins, y = _bench_tree_rows(rng, n_rows, n_features, n_bins, learnable)
     w = np.ones(n_rows, np.float32)
     cat = np.zeros(n_features, bool)
     with tempfile.TemporaryDirectory() as td:
@@ -190,7 +217,7 @@ def bench_gbt_streamed(n_rows: int = 1 << 18, n_features: int = 64,
         train_gbt_streamed(stream, n_bins, cat, settings,
                            cache_budget=cache_budget)
         best = 0.0
-        for _ in range(5):
+        for _ in range(reps):
             t0 = time.perf_counter()
             res = train_gbt_streamed(stream, n_bins, cat, settings,
                                      cache_budget=cache_budget)
@@ -199,6 +226,11 @@ def bench_gbt_streamed(n_rows: int = 1 << 18, n_features: int = 64,
             if cache_budget is not None:
                 assert res.disk_passes > 1   # the tail really re-streamed
             best = max(best, n_rows * n_trees / dt)
+        if collect is not None:
+            collect.update(disk_passes=res.disk_passes,
+                           tail_sweeps=res.tail_sweeps,
+                           bytes_read=res.bytes_read,
+                           trees=res.trees_built)
     return best
 
 
@@ -379,14 +411,165 @@ def bench_stats(chunk_rows: int = 1 << 18, n_cols: int = 256,
 # W*(C*1 + 4*4) bytes (bins + y/tw/vw/f f32).
 TAIL_BENCH_BUDGET = 2 * 16384 * (64 * 1 + 4 * 4)
 
+# quick-mode throughput floor (rows*trees/s, SHIFU_BENCH_TAIL_FLOOR to
+# override): deliberately far below any functioning rig's rate — it
+# exists to catch a catastrophic schedule regression (e.g. silent
+# fallback to per-(depth x tree) re-streams), not to benchmark the rig
+TAIL_BENCH_FLOOR = 5000.0
 
-def bench_gbt_streamed_tail() -> float:
+
+def bench_gbt_streamed_tail(n_rows: int = 1 << 16, n_trees: int = 4,
+                            depth: int = 5) -> Dict[str, Any]:
     """The disk-tail quick mode (`bench.py --plane tail`): small forest,
-    budget forces half the windows to re-stream from disk per level —
-    isolates the out-of-core ingest path the spill cache + pipelined H2D
-    prep exist for."""
-    return bench_gbt_streamed(n_rows=1 << 16, n_trees=4,
-                              cache_budget=TAIL_BENCH_BUDGET)
+    budget forces half the windows past the resident cache — the
+    out-of-core configuration the super-batched tail schedule exists
+    for.  Reports BOTH GBT schedules (coarse-to-fine default vs exact
+    per-level sweeps) plus the RF super-batch probe, with per-tree disk
+    passes / tail sweeps / bytes read, and enforces the schedule guards:
+    c2f tail sweeps per tree bounded (~1 + repairs, >> cheaper than the
+    old depth+2), RF passes per tree <= ceil(depth/SB)+1, and a
+    conservative throughput floor (SHIFU_BENCH_TAIL_FLOOR)."""
+    import os
+
+    from shifu_tpu.train.dt_trainer import _tail_coarse_to_fine
+
+    # both schedules, knob pinned per run, on a learnable fraud-style
+    # target — see _bench_tree_rows on why label noise is adversarial
+    # for speculation and unrepresentative of training.  The headline is
+    # whichever schedule the rig's DEFAULT resolves to (c2f on
+    # accelerator backends, exact on CPU — see _tail_coarse_to_fine).
+    default_c2f = _tail_coarse_to_fine()
+    rates: Dict[str, float] = {}
+    stats: Dict[str, Dict[str, Any]] = {}
+    prev = os.environ.get("SHIFU_TREE_TAIL_C2F")
+    try:
+        for tag, knob in (("c2f", "1"), ("exact", "0")):
+            os.environ["SHIFU_TREE_TAIL_C2F"] = knob
+            col: Dict[str, Any] = {}
+            rates[tag] = bench_gbt_streamed(
+                n_rows=n_rows, n_trees=n_trees, depth=depth,
+                cache_budget=TAIL_BENCH_BUDGET, learnable=True,
+                reps=5 if (knob == "1") == default_c2f else 3,
+                collect=col)
+            stats[tag] = col
+    finally:
+        if prev is None:
+            del os.environ["SHIFU_TREE_TAIL_C2F"]
+        else:
+            os.environ["SHIFU_TREE_TAIL_C2F"] = prev
+    rf = bench_rf_streamed_tail(n_rows=n_rows, depth=depth)
+
+    head = "c2f" if default_c2f else "exact"
+    v = rates[head]
+    rep = {
+        "tail_rows_trees_per_sec": round(v, 1),
+        "tail_default_schedule": head,
+        "tail_disk_passes_per_tree": round(
+            stats[head]["disk_passes"] / stats[head]["trees"], 3),
+        "tail_bytes_read_per_tree": int(
+            stats[head]["bytes_read"] // stats[head]["trees"]),
+        "tail_c2f_rows_trees_per_sec": round(rates["c2f"], 1),
+        "tail_c2f_sweeps_per_tree": round(
+            stats["c2f"]["tail_sweeps"] / stats["c2f"]["trees"], 3),
+        "tail_c2f_bytes_read_per_tree": int(
+            stats["c2f"]["bytes_read"] // stats["c2f"]["trees"]),
+        "tail_exact_rows_trees_per_sec": round(rates["exact"], 1),
+        "tail_exact_sweeps_per_tree": round(
+            stats["exact"]["tail_sweeps"] / stats["exact"]["trees"], 3),
+        "tail_exact_bytes_read_per_tree": int(
+            stats["exact"]["bytes_read"] // stats["exact"]["trees"]),
+        "tail_shape": f"{n_rows} rows x {n_trees} trees depth {depth}, "
+                      "budget fits ~half the windows (uint8 wire), "
+                      "learnable logit target since r9",
+    }
+    rep.update(rf)
+    # schedule guards — the quick mode's job is to fail loudly if the
+    # super-batch schedule silently degrades to per-(depth x tree)
+    # re-streams (e.g. a knob regression or an always-on repair path)
+    floor = float(os.environ.get("SHIFU_BENCH_TAIL_FLOOR",
+                                 TAIL_BENCH_FLOOR))
+    spt = rep["tail_c2f_sweeps_per_tree"]
+    if spt > depth:
+        raise AssertionError(
+            f"GBT coarse-to-fine tail swept {spt:.2f}x per tree "
+            f"(> depth {depth}) — speculation is repairing at the root "
+            "near-always; on learnable data the stale-evidence gate "
+            "should confirm the upper levels")
+    if rep["tail_exact_sweeps_per_tree"] > depth + 2:
+        raise AssertionError(
+            f"GBT exact tail swept "
+            f"{rep['tail_exact_sweeps_per_tree']:.2f}x per tree (> "
+            f"depth+2 = {depth + 2}) — the subtraction/leaf-sum "
+            "schedule regressed toward per-(depth x tree) re-streams")
+    if rep["tail_rf_sweeps_per_tree"] > rep["tail_rf_sweeps_bound"]:
+        raise AssertionError(
+            f"RF tail swept {rep['tail_rf_sweeps_per_tree']:.2f}x per "
+            f"tree > ceil(depth/SB)+1 = {rep['tail_rf_sweeps_bound']} — "
+            "the super-batch schedule regressed toward per-tree sweeps")
+    if v < floor:
+        raise AssertionError(
+            f"disk-tail throughput {v:.0f} rows*trees/s below the "
+            f"floor {floor:.0f} (SHIFU_BENCH_TAIL_FLOOR)")
+    return rep
+
+
+def bench_rf_streamed_tail(n_rows: int = 1 << 16, n_features: int = 64,
+                           n_bins: int = 64, n_trees: int = 16,
+                           depth: int = 5) -> Dict[str, Any]:
+    """RF disk-tail probe: one super-batch of trees per (depth+2) tail
+    sweeps — the acceptance-criterion measurement (passes per tree <=
+    ceil(depth/SB)+1) plus throughput."""
+    import json
+    import math
+    import os
+    import tempfile
+
+    from shifu_tpu.data.shards import Shards
+    from shifu_tpu.data.streaming import ShardStream
+    from shifu_tpu.train.dt_trainer import (DTSettings, _tail_super_batch,
+                                            train_rf_streamed)
+
+    rng = np.random.default_rng(1)
+    bins, y = _bench_tree_rows(rng, n_rows, n_features, n_bins,
+                               learnable=True)
+    w = np.ones(n_rows, np.float32)
+    cat = np.zeros(n_features, bool)
+    settings = DTSettings(n_trees=n_trees, depth=depth,
+                          impurity="entropy", loss="log",
+                          feature_subset="SQRT")
+    with tempfile.TemporaryDirectory() as td:
+        shard_rows = 8192
+        n_shards = 0
+        for s in range(0, n_rows, shard_rows):
+            e = min(s + shard_rows, n_rows)
+            np.savez(os.path.join(td, f"part-{n_shards:05d}.npz"),
+                     bins=bins[s:e], y=y[s:e], w=w[s:e])
+            n_shards += 1
+        with open(os.path.join(td, "schema.json"), "w") as f:
+            json.dump({"columnNums": list(range(n_features)),
+                       "numShards": n_shards, "numRows": n_rows}, f)
+        stream = ShardStream(Shards.open(td), ("bins", "y", "w"),
+                             window_rows=16384)
+        train_rf_streamed(stream, n_bins, cat, settings,
+                          cache_budget=TAIL_BENCH_BUDGET)  # warmup
+        best, res = 0.0, None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            res = train_rf_streamed(stream, n_bins, cat, settings,
+                                    cache_budget=TAIL_BENCH_BUDGET)
+            dt = time.perf_counter() - t0
+            assert res.trees_built == n_trees
+            assert res.disk_passes > 1
+            best = max(best, n_rows * n_trees / dt)
+    sb = min(n_trees, _tail_super_batch(settings, n_features, n_bins, 2))
+    return {
+        "tail_rf_rows_trees_per_sec": round(best, 1),
+        "tail_rf_super_batch": sb,
+        "tail_rf_sweeps_per_tree": round(res.tail_sweeps / n_trees, 3),
+        "tail_rf_sweeps_bound": math.ceil(depth / sb) + 1,
+        "tail_rf_bytes_read_per_tree": int(res.bytes_read // n_trees),
+        "tail_rf_shape": f"{n_rows} rows x {n_trees} trees depth {depth}",
+    }
 
 
 def bench_rf_repeat(n_rows: int = 1 << 17, n_features: int = 64,
@@ -723,7 +906,11 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
     if plane == "tail":
         with obs.span("bench.gbt_train_throughput_streamed_tail",
                       kind="bench"):
-            v = bench_gbt_streamed_tail()
+            rep = bench_gbt_streamed_tail()
+        v = rep["tail_rows_trees_per_sec"]
+        for k, val in rep.items():
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                obs.gauge(f"bench.{k}").set(float(val))
         obs.gauge("bench.gbt_train_throughput_streamed_tail").set(v)
         obs.gauge("bench.gbt_train_throughput_streamed_tail_vs_baseline") \
             .set(v / BASELINE_TREE_RATE)
@@ -738,8 +925,8 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
             "baseline_provenance": "measured 43068.1 rows*trees/s/worker "
                                    "np.add.at hist GBT on this rig x 100 "
                                    "north-star workers (BASELINE.md)",
-            "shape": "65536 rows x 4 trees, budget forces disk tail "
-                     "(uint8-resident accounting since r6)",
+            "shape": rep["tail_shape"],
+            "extra": rep,
         }
     if plane == "rf-repeat":
         with obs.span("bench.rf_repeat", kind="bench"):
@@ -831,8 +1018,23 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
     record("gbt_train_throughput_resident", bench_gbt, BASELINE_TREE_RATE)
     record("gbt_train_throughput_streamed", bench_gbt_streamed,
            BASELINE_TREE_RATE)
-    record("gbt_train_throughput_streamed_tail", bench_gbt_streamed_tail,
-           BASELINE_TREE_RATE)
+    try:
+        with obs.span("bench.gbt_train_throughput_streamed_tail",
+                      kind="bench"):
+            tail_rep = bench_gbt_streamed_tail()
+        v = tail_rep["tail_rows_trees_per_sec"]
+        extras["gbt_train_throughput_streamed_tail"] = v
+        extras["gbt_train_throughput_streamed_tail_vs_baseline"] = round(
+            v / BASELINE_TREE_RATE, 3)
+        extras.update(tail_rep)
+        obs.gauge("bench.gbt_train_throughput_streamed_tail").set(v)
+        obs.gauge("bench.gbt_train_throughput_streamed_tail_vs_baseline") \
+            .set(v / BASELINE_TREE_RATE)
+        for k, val in tail_rep.items():
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                obs.gauge(f"bench.{k}").set(float(val))
+    except Exception as e:                      # pragma: no cover
+        extras["gbt_train_throughput_streamed_tail_error"] = str(e)[:200]
     record("rf_train_throughput", bench_rf, BASELINE_TREE_RATE)
     record("wdl_train_throughput", bench_wdl, BASELINE_ROWS_PER_SEC)
     record("eval_throughput", bench_eval, BASELINE_SCORE_RATE)
@@ -857,7 +1059,9 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
                         "100 = the default TreeNum)",
         "tail": "65536 rows x 4 trees, budget forces disk tail (uint8-"
                 "resident bins accounting since r6; warm pass builds the "
-                "mmap spill cache, tail sweeps re-read it zero-decode)"}
+                "mmap spill cache, tail sweeps re-read it zero-decode; "
+                "learnable logit target + dual-schedule c2f/exact "
+                "reporting since r9)"}
     extras["baselines"] = {
         "tree_rows_trees_per_sec_per_worker":
             MEASURED_CPU_TREE_ROWS_TREES_PER_SEC,

@@ -107,6 +107,10 @@ TRAIN_PARAM_RULES: Dict[str, Rule] = {
     # RF same-round trees grown per batched device program (multi-tree
     # Pallas histogram grids); 0 = auto
     "TreeBatch": Rule("int", lo=0, hi=64, algs=TREE_FAMILY),
+    # disk-tail super-batch: trees fed by ONE tail re-stream in streamed
+    # RF (one disk pass feeds the whole batch's level histograms); 0 =
+    # auto (budget-derived from shifu.tree.tailSuperBatchBytes)
+    "TailTreeBatch": Rule("int", lo=0, hi=1024, algs=TREE_FAMILY),
     "MaxDepth": Rule("int", lo=1, hi=20, algs=TREE_FAMILY),
     # -1 (default) = level-wise; >0 enables the leaf-wise node budget
     # (reference DTMaster.java:129-137 MaxLeaves / isLeafWise)

@@ -43,6 +43,7 @@ so readers never observe a torn cache.
 from __future__ import annotations
 
 import json
+import mmap
 import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -264,6 +265,15 @@ class SpillReader:
             mm = io_retry(
                 lambda: np.memmap(path, dtype=dt, mode="r", shape=shape),
                 "spill mmap open", path)
+            # the super-batched tail re-streams walk each raw file front
+            # to back, many times per forest — tell the VM to read ahead
+            # aggressively and not to keep pages hot behind the cursor
+            # (without this the first tail sweep after a cold page cache
+            # faults 4 KiB at a time)
+            try:
+                mm._mmap.madvise(mmap.MADV_SEQUENTIAL)
+            except (AttributeError, ValueError, OSError):
+                pass                       # platform without madvise
             self._mms[key] = mm
         return mm
 

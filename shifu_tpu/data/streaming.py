@@ -203,6 +203,9 @@ class ShardStream:
         self.spill = spill_enabled() if spill is None else bool(spill)
         self._spill_off = False         # sticky: aborted marker / IO error
         self._spill_rd = None           # validated SpillReader
+        self.bytes_read = 0             # host-side total across sweeps
+                                        # (always on — bench/guard tests
+                                        # read it without telemetry)
 
     # ------------------------------------------------------ spill plumbing
     def _spill_dir(self) -> str:
@@ -307,7 +310,9 @@ class ShardStream:
             nv = e - g
             if nv < W:
                 arrays = {k: _pad_rows(a, W) for k, a in arrays.items()}
-            bytes_c.inc(sum(a.nbytes for a in arrays.values()))
+            nb = sum(a.nbytes for a in arrays.values())
+            bytes_c.inc(nb)
+            self.bytes_read += nb
             win_c.inc()
             yield Window(start=start, n_valid=nv, arrays=arrays,
                          src=rd.src_of(g))
@@ -367,7 +372,9 @@ class ShardStream:
                 buffered += n
                 while buffered >= W:
                     arrays, buf, buffered = _take(buf, W, self.keys)
-                    bytes_c.inc(sum(a.nbytes for a in arrays.values()))
+                    nb = sum(a.nbytes for a in arrays.values())
+                    bytes_c.inc(nb)
+                    self.bytes_read += nb
                     win_c.inc()
                     yield Window(start=start, n_valid=W, arrays=arrays,
                                  src=consume(W))
@@ -375,7 +382,9 @@ class ShardStream:
             if buffered:
                 arrays, buf, _ = _take(buf, buffered, self.keys)
                 arrays = {k: _pad_rows(a, W) for k, a in arrays.items()}
-                bytes_c.inc(sum(a.nbytes for a in arrays.values()))
+                nb = sum(a.nbytes for a in arrays.values())
+                bytes_c.inc(nb)
+                self.bytes_read += nb
                 win_c.inc()
                 yield Window(start=start, n_valid=buffered,
                              arrays=arrays, src=consume(buffered))
@@ -558,6 +567,7 @@ class ResidentCache:
         self.cached: list = []
         self.tail: Optional[Tuple[int, int, int]] = None  # shard, offset, row
         self.disk_passes = 0
+        self.tail_sweeps = 0
         self._warm = False
 
     def _prepared(self, start_shard: int = 0, shard_offset: int = 0,
@@ -585,11 +595,28 @@ class ResidentCache:
         else:
             yield from self.cached
             if self.tail is not None:
-                self.disk_passes += 1
-                obs.counter("ingest.disk_passes").inc()
-                sh, off, row = self.tail
-                yield from self._prepared(start_shard=sh, shard_offset=off,
-                                          start_row=row)
+                yield from self.tail_items()
+
+    def tail_items(self) -> Iterator[PreparedWindow]:
+        """Re-stream ONLY the tail (windows past the resident budget) —
+        one disk pass over the spill/npz remainder, prep pipelined like
+        the warm pass.  The super-batched tree trainers sweep the
+        resident set as a coalesced device block and drive the disk tail
+        through this; ``train.tail_sweeps`` counts the tail re-streams
+        the schedule actually paid (the disk-passes guard tests and the
+        ``analysis --telemetry`` tail stall line read it)."""
+        if not self._warm:
+            raise RuntimeError("tail_items() before the warm pass — "
+                               "iterate items() once first")
+        if self.tail is None:
+            return
+        self.disk_passes += 1
+        self.tail_sweeps += 1
+        obs.counter("ingest.disk_passes").inc()
+        obs.counter("train.tail_sweeps").inc()
+        sh, off, row = self.tail
+        yield from self._prepared(start_shard=sh, shard_offset=off,
+                                  start_row=row)
 
     @property
     def resident_rows(self) -> int:

@@ -21,6 +21,11 @@ Sites/points wired today (grep ``faults.fire`` for the live set):
     norm:shard=<k>      before shard k's commit record lands
     stats:chunk=<ci>    before chunk ci is absorbed by the accumulators
     train:tree=<ti>     after tree ti's progress line (GBT/RF)
+    train:superbatch=<k>  after disk-tail super-batch drain k lands its
+                        trees on host (streamed GBT coarse-to-fine pend
+                        drain / streamed RF tail batch commit) — the
+                        checkpoint-cadence boundary of the one-pass tail
+                        schedule
     train:epoch=<e>     after epoch e's progress line (NN/LR/WDL/SVM)
     train:bag=<b>       before kernel-SVM bag b trains
     reader:file=<i>     opening the i-th raw input file

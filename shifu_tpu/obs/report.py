@@ -137,6 +137,21 @@ def _render_block(block: Dict[str, Any], out: List[str]) -> float:
         out.append(f"  ingest stall fraction: {frac:.1%} "
                    f"({float(wait):.3f}s blocked on ingest of "
                    f"{total:.3f}s wall)")
+    # disk-tail plane: how often the out-of-core remainder re-streamed
+    # (the super-batch schedule's cost driver — passes, not rows, are
+    # what the one-pass-feeds-everything restructure bounds)
+    mvals = {m.get("name"): m.get("value") for m in block["metrics"]}
+    sweeps = mvals.get("train.tail_sweeps")
+    if sweeps:
+        passes = mvals.get("ingest.disk_passes") or 0
+        repairs = mvals.get("train.tail_repairs") or 0
+        rlevels = mvals.get("train.tail_repair_levels") or 0
+        line = (f"  tail sweeps: {int(sweeps)} "
+                f"({int(passes)} disk passes total")
+        if repairs:
+            line += (f", {int(repairs)} speculation repairs over "
+                     f"{int(rlevels)} levels")
+        out.append(line + ")")
     return total
 
 

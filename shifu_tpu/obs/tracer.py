@@ -37,7 +37,12 @@ from typing import Any, Dict, List, Optional
 # v3: variable-selection plane instrumentation (varsel.host_syncs /
 # mask_batches / windows counters, varsel.rows_per_sec / candidates
 # gauges; bench varsel_* extras ride the same version)
-SCHEMA_VERSION = 3
+# v4: disk-tail super-batch instrumentation (train.tail_sweeps /
+# tail_repairs / tail_repair_levels counters; the report's tail-plane
+# "tail sweeps" + ingest-stall lines and bench tail_* extras —
+# disk_passes / bytes_read per tree, dual-schedule rates — derive
+# from them)
+SCHEMA_VERSION = 4
 
 _TRUE = ("1", "true", "on", "yes")
 

@@ -414,12 +414,15 @@ def _descend(bins, node_idx, feat, lmask):
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity",
                                    "n_classes", "use_pallas", "max_leaves",
-                                   "has_cat", "mesh", "stats_exact"))
+                                   "has_cat", "mesh", "stats_exact",
+                                   "record_hists"))
 def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
                   impurity: str, min_instances: float, min_gain: float,
                   n_classes: int = 0, use_pallas: bool = False,
                   max_leaves: int = 0, has_cat: bool = True, mesh=None,
-                  stats_exact: bool = False):
+                  stats_exact: bool = False, record_hists: bool = False,
+                  tail_extra=None, prev_sf=None, prev_lm=None,
+                  valid_upto=None):
     """Whole-tree level-wise growth as ONE jitted program — zero host syncs
     per level (reference ``DTMaster.java:543-600`` level mode; the round-1
     build synced feat/lmask/leaf to host every level).
@@ -429,6 +432,30 @@ def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
     positional complete-binary-tree layout because level l starts at node
     2^l - 1.  ``gain_fi`` accumulates realized split gains per feature
     (gain-weighted FI, reference ``GainInfo`` aggregation).
+
+    ``record_hists=True`` additionally returns (hist_left [depth,
+    2^(depth-1), C, B, S], leaf_raw [S, 2^depth]): the per-level LEFT-child
+    histograms (level 0 = the full root histogram) and the bottom level's
+    raw stat sums, in exactly the accumulator layout
+    :func:`build_path_histograms` emits — a coarse-to-fine tail grow on
+    the resident prefix keeps its own histograms as the resident
+    contribution to the exact totals instead of recomputing them.
+
+    ``tail_extra`` ([depth, 2^(depth-1), C, B, S], optional — with
+    ``prev_sf``/``prev_lm`` [total]/[total, B] and ``valid_upto`` traced
+    int32) is STALE TAIL EVIDENCE for the split DECISIONS only: the
+    previous coarse-to-fine pass's exact tail-only per-level left-child
+    histograms (level 0 slot = the full tail root).  Level l's decision
+    histogram becomes resident + tail_extra-derived WHEN the evidence is
+    routing-compatible: l <= valid_upto (the previous pass confirmed its
+    speculation through level l, so its accumulators are exactly routed
+    there) AND this tree's structure above l bit-matches the previous
+    tree's (checked level-by-level in-graph — GBT trees on smooth
+    objectives repeat their upper structure, so the gate stays open deep
+    and the speculated thresholds pin to near-full-data optima instead
+    of the resident prefix's).  The evidence NEVER enters the recorded
+    histograms or the subtraction chain — it only steers speculation;
+    exactness is enforced downstream by the verify/repair pass.
     """
     n, c = bins.shape
     feats, lmasks, leaves = [], [], []
@@ -436,8 +463,14 @@ def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
     node_idx = jnp.zeros(n, jnp.int32)       # level-local position, -1 done
     leaf_glob = jnp.zeros(n, jnp.int32)      # global node id where row rests
     nodes_cnt = jnp.int32(1)                 # leaf-wise budget state
+    half = max(1 << max(depth - 1, 0), 1)    # record slot width per level
+    rec_left: list = []
+    leaf_raw = None
     hist_prev = None
     feat_prev = None
+    stale = tail_extra is not None
+    prefix_ok = jnp.bool_(True)              # structure matches prev tree
+    tail_full = None                         # prev level's full tail hist
     for level in range(depth + 1):
         n_nodes = 1 << level
         if level == depth:
@@ -447,14 +480,21 @@ def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
             # waste.  Leaf values need only per-node stat sums: one
             # [S, N] x [N, K] dot (HIGHEST precision keeps f32-exact
             # counts; frozen rows mask to no column).
-            leaves.append(_level_leaf_sums(stats, node_idx, n_nodes,
-                                           n_classes))
+            leaf_raw = _level_leaf_raw(stats, node_idx, n_nodes)
+            leaves.append(leaf_values_from_raw(leaf_raw, n_classes))
             feats.append(jnp.full(n_nodes, -1, jnp.int32))
             lmasks.append(jnp.zeros((n_nodes, n_bins), bool))
             break
         if level == 0:
             hist = build_histograms(bins, node_idx, stats, n_nodes, n_bins,
                                     use_pallas, mesh, stats_exact)
+            if record_hists:
+                rec_left.append(_pad_nodes(hist, half))
+            if stale:
+                tail_full = tail_extra[0, :1]     # tail root, routing-free
+                hist_decide = hist + tail_full
+            else:
+                hist_decide = hist
         else:
             # histogram SUBTRACTION (the LightGBM trick the reference's
             # level-wise DTMaster never had): build only the LEFT-child
@@ -466,17 +506,43 @@ def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
             hl = build_histograms(
                 bins, _left_child_index(node_idx), stats, n_nodes // 2,
                 n_bins, use_pallas, mesh, stats_exact)
+            if record_hists:
+                rec_left.append(_pad_nodes(hl, half))
             split_ok = feat_prev >= 0
             hr = jnp.where(split_ok[:, None, None, None],
                            hist_prev - hl, 0.0)
             hist = jnp.stack([hl, hr], axis=1) \
                 .reshape(n_nodes, c, hl.shape[2], hl.shape[3])
+            if stale:
+                # derive the tail's full level hist the same way (the
+                # evidence chain routes along the PREVIOUS tree, so its
+                # subtraction uses prev_sf's split mask), then gate: the
+                # prev pass must have confirmed through this level AND
+                # this tree's prefix must still match the prev tree's
+                t_hl = tail_extra[level][:n_nodes // 2]
+                p_feat = jax.lax.dynamic_slice_in_dim(
+                    prev_sf, n_nodes // 2 - 1, n_nodes // 2)
+                t_hr = jnp.where((p_feat >= 0)[:, None, None, None],
+                                 tail_full - t_hl, 0.0)
+                tail_full = jnp.stack([t_hl, t_hr], axis=1) \
+                    .reshape(n_nodes, c, hl.shape[2], hl.shape[3])
+                gate = (jnp.int32(level) <= valid_upto) & prefix_ok
+                hist_decide = jnp.where(gate, hist + tail_full, hist)
+            else:
+                hist_decide = hist
         gain, feat, lmask, leaf, node_w = best_splits(
-            hist, cat, fa, impurity, min_instances, min_gain, n_classes,
-            has_cat)
+            hist_decide, cat, fa, impurity, min_instances, min_gain,
+            n_classes, has_cat)
         if max_leaves > 0:
             feat, lmask, nodes_cnt = cap_splits_by_leaves(
                 gain, feat, lmask, nodes_cnt, max_leaves)
+        if stale:
+            p_feat = jax.lax.dynamic_slice_in_dim(prev_sf, n_nodes - 1,
+                                                  n_nodes)
+            p_lm = jax.lax.dynamic_slice_in_dim(prev_lm, n_nodes - 1,
+                                                n_nodes, axis=0)
+            prefix_ok = prefix_ok & jnp.all(feat == p_feat) & \
+                jnp.all(lmask == p_lm)
         feats.append(feat)
         lmasks.append(lmask)
         leaves.append(leaf)
@@ -492,8 +558,70 @@ def grow_tree_jit(bins, stats, cat, fa, n_bins: int, depth: int,
         leaf_glob = jnp.where(node_idx >= 0,
                               ((1 << (level + 1)) - 1) + node_idx,
                               leaf_glob)
-    return (jnp.concatenate(feats), jnp.concatenate(lmasks, axis=0),
-            jnp.concatenate(leaves), gain_fi, leaf_glob)
+    out = (jnp.concatenate(feats), jnp.concatenate(lmasks, axis=0),
+           jnp.concatenate(leaves), gain_fi, leaf_glob)
+    if record_hists:
+        return out + (jnp.stack(rec_left), leaf_raw)
+    return out
+
+
+def _pad_nodes(hist, width: int):
+    """Zero-pad a level histogram's node axis to ``width`` so every level
+    shares one accumulator slot shape."""
+    k = hist.shape[0]
+    if k >= width:
+        return hist
+    return jnp.concatenate(
+        [hist, jnp.zeros((width - k,) + hist.shape[1:], hist.dtype)])
+
+
+@partial(jax.jit, static_argnames=("depth", "n_bins", "use_pallas", "mesh",
+                                   "stats_exact"))
+def build_path_histograms(bins, stats, split_feat, left_mask, depth: int,
+                          n_bins: int, use_pallas: bool = False, mesh=None,
+                          stats_exact: bool = False, hist_bins=None):
+    """EVERY level's histograms along a FIXED tree structure in one pass
+    over the rows — the coarse-to-fine disk-tail schedule's core op.
+
+    The per-level tail re-stream exists because level l's node routing
+    depends on level l-1's chosen splits.  Given a *speculated* structure
+    (``split_feat``/``left_mask`` from the resident prefix), the routing
+    of every level is known up front, so ONE pass over a window computes
+    all of them: per level the LEFT-child histogram only (level 0 = the
+    full root histogram; right children derive as parent - left at
+    selection time, the same subtraction :func:`grow_tree_jit` uses) plus
+    the bottom level's raw leaf stat sums.
+
+    Returns (hist_left [depth, 2^(depth-1), C, B, S] — level l occupying
+    the first ``max(2^(l-1), 1)`` node slots, rest zero — and leaf_raw
+    [S, 2^depth]).  Layout matches ``grow_tree_jit(record_hists=True)``
+    exactly so resident and tail contributions add cell-for-cell.
+
+    ``hist_bins`` (optional [N, K]) narrows the HISTOGRAM build to a
+    candidate feature subset while routing still walks the full ``bins``
+    — the bounded-candidate scan of the coarse-to-fine tail.
+    """
+    assert depth >= 1
+    n, c = bins.shape
+    half = max(1 << (depth - 1), 1)
+    node_idx = jnp.zeros(n, jnp.int32)
+    idx_levels = [node_idx]                    # level 0: full root
+    for level in range(1, depth + 1):
+        base = (1 << (level - 1)) - 1
+        feat = jax.lax.dynamic_slice_in_dim(split_feat, base,
+                                            1 << (level - 1))
+        lmask = jax.lax.dynamic_slice_in_dim(left_mask, base,
+                                             1 << (level - 1), axis=0)
+        node_idx = _descend(bins, node_idx, feat, lmask)
+        if level < depth:
+            idx_levels.append(_left_child_index(node_idx))
+    idx_b = jnp.stack(idx_levels)              # [depth, N]
+    stats_b = jnp.broadcast_to(stats[None], (depth,) + stats.shape)
+    hb = bins if hist_bins is None else hist_bins
+    hist_left = build_histograms_batch(hb, idx_b, stats_b, half, n_bins,
+                                       use_pallas, mesh, stats_exact)
+    leaf_raw = _level_leaf_raw(stats, node_idx, 1 << depth)
+    return hist_left, leaf_raw
 
 
 @partial(jax.jit, static_argnames=("n_bins", "depth", "impurity",
@@ -584,16 +712,32 @@ def grow_forest_jit(bins, stats_b, cat, fa_b, n_bins: int, depth: int,
             jnp.concatenate(leaves, axis=1), gain_fi, leaf_glob)
 
 
-def _level_leaf_sums(stats, node_idx, n_nodes: int, n_classes: int = 0):
-    """Per-node leaf values from stat sums alone: [K] ``wy/w`` (binary /
-    regression) or [K, n_classes] class distributions (multiclass)."""
+def _level_leaf_raw(stats, node_idx, n_nodes: int):
+    """Per-node RAW stat sums [S, K] at one level (frozen rows contribute
+    nothing) — the accumulable form of :func:`_level_leaf_sums`: streamed
+    sweeps sum these across windows and divide once at the end, so the
+    bottom level of an out-of-core tree costs a [S, N] x [N, K] dot per
+    window instead of the full [K, C, B, S] histogram."""
     oh = jax.nn.one_hot(node_idx, n_nodes, dtype=jnp.float32)  # -1 -> 0s
-    sums = jax.lax.dot_general(stats, oh, (((0,), (0,)), ((), ())),
-                               precision=jax.lax.Precision.HIGHEST)  # [S, K]
+    return jax.lax.dot_general(stats, oh, (((0,), (0,)), ((), ())),
+                               precision=jax.lax.Precision.HIGHEST)
+
+
+def leaf_values_from_raw(sums, n_classes: int = 0):
+    """``[S, K]`` raw stat sums -> leaf values ([K] ``wy/w`` or [K, S]
+    class distributions) — the ONE place the ratio lives (resident grow,
+    streamed bottom sweeps and the coarse-to-fine tail must agree)."""
     if n_classes > 2:
         w = sums.sum(axis=0)                               # [K]
         return (sums / jnp.maximum(w, EPS)[None, :]).T     # [K, S]
     return sums[1] / jnp.maximum(sums[0], EPS)
+
+
+def _level_leaf_sums(stats, node_idx, n_nodes: int, n_classes: int = 0):
+    """Per-node leaf values from stat sums alone: [K] ``wy/w`` (binary /
+    regression) or [K, n_classes] class distributions (multiclass)."""
+    return leaf_values_from_raw(_level_leaf_raw(stats, node_idx, n_nodes),
+                                n_classes)
 
 
 def _left_child_index(node_idx):

@@ -44,9 +44,11 @@ from .. import faults, obs
 from ..config.model_config import Algorithm
 from ..data.shards import Shards
 from ..models import tree as tree_model
-from ..ops.tree import (TreeArrays, best_splits, build_histograms,
-                        build_histograms_batch, cap_splits_by_leaves,
-                        grow_forest_jit, grow_tree_jit, n_tree_nodes,
+from ..ops.tree import (TreeArrays, _left_child_index, _level_leaf_raw,
+                        best_splits, build_histograms,
+                        build_histograms_batch, build_path_histograms,
+                        cap_splits_by_leaves, grow_forest_jit,
+                        grow_tree_jit, leaf_values_from_raw, n_tree_nodes,
                         node_index_at_level, predict_tree)
 from .early_stop import GBTEarlyStopDecider
 from .sampling import validation_split
@@ -83,6 +85,10 @@ class DTSettings:
     early_stop_check: int = 8            # trees between early-stop
                                          # decisions (device-accumulated
                                          # errors fetch in bulk)
+    tail_tree_batch: int = 0             # RF disk-tail super-batch: trees
+                                         # fed by one tail re-stream; 0 =
+                                         # auto (budget-derived, see
+                                         # _tail_super_batch)
 
 
 def settings_from_params(params: Dict[str, Any], train_conf,
@@ -109,7 +115,8 @@ def settings_from_params(params: Dict[str, Any], train_conf,
         seed=int(p.get("Seed", 0)),
         checkpoint_every=int(p.get("CheckpointInterval", 25)),
         tree_batch=int(p.get("TreeBatch", 0)),
-        early_stop_check=max(1, int(p.get("EarlyStopCheckInterval", 8))))
+        early_stop_check=max(1, int(p.get("EarlyStopCheckInterval", 8))),
+        tail_tree_batch=int(p.get("TailTreeBatch", 0)))
 
 
 def subset_count(strategy: str, c: int) -> int:
@@ -152,6 +159,13 @@ class ForestResult:
     trees_built: int = 0
     history: List[Tuple[float, float]] = field(default_factory=list)
     disk_passes: int = 0                 # streamed mode: cold stream sweeps taken
+    tail_sweeps: int = 0                 # streamed mode: disk-tail re-streams
+                                         # (the super-batch schedule's guard
+                                         # metric; bench extras read it)
+    bytes_read: int = 0                  # streamed mode: bytes this train
+                                         # run pulled off disk (host-side
+                                         # stream accounting, telemetry-
+                                         # independent)
 
 
 # ---------------------------------------------------------------- jitted rounds
@@ -987,14 +1001,21 @@ def train_rf_bagged(bins, y, w_m, n_bins: int, cat_mask,
 
 # ------------------------------------------------------------- streaming
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level", "loss",
-                                   "use_pallas", "mesh"))
+                                   "use_pallas", "mesh", "left"))
 def _gbt_window_hist(hist, bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
                      n_bins: int, level: int, loss: str,
-                     use_pallas: bool = False, mesh=None):
+                     use_pallas: bool = False, mesh=None,
+                     left: bool = False):
     """Streamed level step: window rows find their level-local node by
     walking the partial tree, then scatter residual-gradient stats.  With
     mesh-sharded window rows the [nodes, C, B, S] sum is XLA's psum over
     the data axis — the DTWorker→DTMaster merge on ICI.
+
+    ``left=True`` accumulates only the LEFT-child histograms of the level
+    (parent-slot indexed, ``n_nodes`` halved) — the streamed side of the
+    resident grow's histogram subtraction: right children derive as
+    parent - left once the level's windows are summed
+    (:func:`_derive_level`), halving every re-stream sweep's kernel work.
 
     ``hist`` (the running accumulator) is an INPUT so consecutive window
     programs chain by data dependency: XLA's CPU in-process collectives
@@ -1003,24 +1024,279 @@ def _gbt_window_hist(hist, bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
     rendezvous holding pool threads the other program needs) — chained
     programs can never overlap, on CPU or over a real tunnel."""
     node_idx = node_index_at_level(sf, lm, bins_w, level)
+    if left:
+        node_idx = _left_child_index(node_idx)
     grad = _loss_grad(y_w, f_w, loss)
     stats = jnp.stack([tw_w, tw_w * grad], axis=1).astype(jnp.float32)
     return hist + build_histograms(bins_w, node_idx, stats, n_nodes,
                                    n_bins, use_pallas, mesh)
 
 
+@partial(jax.jit, static_argnames=("n_nodes",))
+def _derive_level(full_prev, hl, feat_prev, n_nodes: int):
+    """Full level histogram from the parent level + accumulated
+    left-child sums: right child = parent - left where the parent split,
+    zero where it froze — the cross-window form of the subtraction in
+    :func:`shifu_tpu.ops.tree.grow_tree_jit`."""
+    split_ok = feat_prev >= 0
+    hr = jnp.where(split_ok[:, None, None, None], full_prev - hl, 0.0)
+    return jnp.stack([hl, hr], axis=1).reshape(
+        n_nodes, hl.shape[1], hl.shape[2], hl.shape[3])
+
+
+@partial(jax.jit, static_argnames=("depth", "loss"))
+def _gbt_window_leaf_raw(acc, bins_w, y_w, tw_w, f_w, sf, lm, depth: int,
+                         loss: str):
+    """Bottom-level raw leaf stat sums for one window — replaces the full
+    [2^depth, C, B, S] histogram sweep of the deepest level with one
+    [S, N] x [N, 2^depth] dot (the resident grow's leaf-sum bottom level,
+    streamed)."""
+    node_idx = node_index_at_level(sf, lm, bins_w, depth)
+    grad = _loss_grad(y_w, f_w, loss)
+    stats = jnp.stack([tw_w, tw_w * grad], axis=1).astype(jnp.float32)
+    return acc + _level_leaf_raw(stats, node_idx, 1 << depth)
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def _set_bottom_leaves(lv, raw, depth: int):
+    return lv.at[(1 << depth) - 1:].set(leaf_values_from_raw(raw))
+
+
+# ------------------------------------------- coarse-to-fine disk tail
+@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
+                                   "use_pallas", "max_leaves", "has_cat",
+                                   "mesh", "has_prev", "cand_k"))
+def _gbt_tail_head(bins, y, tw, vw, f, sf_p, lm_p, lv_p, fa, cat, lr, mi,
+                   mg, tail_extra, valid_upto, n_bins: int, depth: int,
+                   impurity: str, loss: str, use_pallas: bool = False,
+                   max_leaves: int = 0, has_cat: bool = True, mesh=None,
+                   has_prev: bool = True, cand_k: int = 0):
+    """The coarse-to-fine tree's RESIDENT head as ONE executable: apply
+    the previous tree's score update to the coalesced resident block
+    (+ its error sums), then grow the COARSE tree on the resident prefix
+    alone — recording its per-level left histograms and bottom leaf sums,
+    which ARE the resident contribution to the exact totals along the
+    speculated structure (zero recomputation when the speculation holds).
+
+    With ``cand_k > 0`` also picks the top-K candidate features (coarse
+    realized gains, coarse split features forced in, indices sorted so
+    K >= C degenerates to the identity gather) and narrows the recorded
+    histograms to them — the bounded-candidate scan.
+
+    ``tail_extra`` ([depth, half, C, B, S]) is the previous pass's exact
+    tail-only evidence (:func:`_tail_extras`) with ``valid_upto`` = the
+    level through which the previous speculation was confirmed; the
+    coarse grow adds it to each level's split decision while this tree's
+    structure still bit-matches the previous tree's (``sf_p``/``lm_p``
+    double as the structure reference — they ARE the previous tree), so
+    speculated splits pin to near-full-data optima instead of the
+    resident prefix's.  One tree stale; exactness comes from the
+    verify/repair pass, not from the evidence."""
+    if has_prev:
+        f = f + lr * predict_tree(sf_p, lm_p, lv_p, bins, depth)
+        per = _per_row_loss(y, f, loss)
+        sums = jnp.stack([(per * tw).sum(), tw.sum(),
+                          (per * vw).sum(), vw.sum()])
+    else:
+        sums = jnp.zeros(4, jnp.float32)
+    grad = _loss_grad(y, f, loss)
+    stats = jnp.stack([tw, tw * grad], axis=1).astype(jnp.float32)
+    sf_c, lm_c, _, gfi_c, _, hist_left, leaf_raw = grow_tree_jit(
+        bins, stats, cat, fa, n_bins, depth, impurity, mi, mg,
+        use_pallas=use_pallas, max_leaves=max_leaves, has_cat=has_cat,
+        mesh=mesh, record_hists=True, tail_extra=tail_extra,
+        prev_sf=sf_p, prev_lm=lm_p, valid_upto=valid_upto)
+    if cand_k > 0:
+        forced = jnp.zeros(bins.shape[1], jnp.float32).at[
+            jnp.maximum(sf_c, 0)].add(
+            jnp.where(sf_c >= 0, jnp.float32(1e30), jnp.float32(0.0)))
+        _, cand_idx = jax.lax.top_k(gfi_c + forced, cand_k)
+        cand_idx = jnp.sort(cand_idx).astype(jnp.int32)
+        hist_left = jnp.take(hist_left, cand_idx, axis=2)
+    else:
+        cand_idx = jnp.zeros(0, jnp.int32)
+    return sf_c, lm_c, hist_left, leaf_raw, f, sums, cand_idx
+
+
+@partial(jax.jit, static_argnames=("c", "cand"))
+def _tail_extras(hl_acc, hl_res, cand_idx, c: int, cand: bool = False):
+    """The pass's exact TAIL-only evidence ([depth, half, C, B, S], full
+    feature width): accumulated totals minus the resident head's recorded
+    contribution, scattered back from the candidate set when the scan was
+    bounded.  Level 0's slot is the full tail root (routing-free); level
+    l is the tail left-child histograms routed along this pass's
+    speculated structure — valid next pass exactly up to the level this
+    pass CONFIRMED (the caller carries that as ``valid_upto``)."""
+    tail = hl_acc - hl_res
+    if cand:
+        full = jnp.zeros(hl_acc.shape[:2] + (c,) + hl_acc.shape[3:],
+                         hl_acc.dtype)
+        return full.at[:, :, cand_idx].set(tail)
+    return tail
+
+
+@partial(jax.jit, static_argnames=("n_bins", "depth", "loss", "use_pallas",
+                                   "mesh", "has_prev", "cand"))
+def _gbt_tail_window_pass(hist_left, leaf_raw, sums, bins_w, y_w, tw_w,
+                          vw_w, f_w, sf_p, lm_p, lv_p, sf_c, lm_c,
+                          cand_idx, lr, n_bins: int, depth: int, loss: str,
+                          use_pallas: bool = False, mesh=None,
+                          has_prev: bool = True, cand: bool = False):
+    """ONE disk pass feeds everything, per tail window: the previous
+    tree's score update + its error sums + EVERY level's histograms of
+    the current tree along the speculated coarse structure + the bottom
+    leaf sums, in a single executable — the O(depth x trees) tail
+    re-stream schedule collapses to one re-stream per tree."""
+    if has_prev:
+        f_w = f_w + lr * predict_tree(sf_p, lm_p, lv_p, bins_w, depth)
+        per = _per_row_loss(y_w, f_w, loss)
+        sums = sums + jnp.stack([(per * tw_w).sum(), tw_w.sum(),
+                                 (per * vw_w).sum(), vw_w.sum()])
+    grad = _loss_grad(y_w, f_w, loss)
+    stats = jnp.stack([tw_w, tw_w * grad], axis=1).astype(jnp.float32)
+    hist_bins = jnp.take(bins_w, cand_idx, axis=1) if cand else None
+    hl, lraw = build_path_histograms(bins_w, stats, sf_c, lm_c, depth,
+                                     n_bins, use_pallas, mesh,
+                                     hist_bins=hist_bins)
+    return hist_left + hl, leaf_raw + lraw, sums, f_w
+
+
+@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity",
+                                   "max_leaves", "has_cat", "cand"))
+def _gbt_tail_select(hist_left, leaf_raw, sf_c, lm_c, cand_idx, cat, fa,
+                     mi, mg, n_bins: int, depth: int, impurity: str,
+                     max_leaves: int = 0, has_cat: bool = True,
+                     cand: bool = False):
+    """Exact split selection from the accumulated (resident + tail)
+    per-level histograms, verifying the speculation: runs the level steps
+    top-down with right-children derived by subtraction, compares each
+    level's exact choice against the coarse structure, and reports the
+    FIRST level where they diverge (``depth`` = fully confirmed; deeper
+    histograms are mis-routed past a divergence and the caller repairs
+    those levels with exact per-level sweeps).
+
+    Returns (sf, lm, lv, fi_levels [depth, C], cnt_levels [depth],
+    mismatch, full_levels [depth, half, K, B, S]) — per-level FI/
+    leaf-budget state plus the exact FULL per-level histograms, so the
+    caller can resume a repair from the divergence point without
+    trusting the garbage tail AND seed the repair's subtraction chain
+    with the exact level-``mis`` parent (bit-parity with the pure exact
+    schedule requires the repair to derive right children the same way).
+    """
+    c_full = fa.shape[0]
+    cat_h = jnp.take(cat, cand_idx) if cand else cat
+    fa_h = jnp.take(fa, cand_idx) if cand else fa
+    total = n_tree_nodes(depth)
+    sf = jnp.full(total, -1, jnp.int32)
+    lm = jnp.zeros((total, n_bins), bool)
+    lv = jnp.zeros(total, jnp.float32)
+    nodes_cnt = jnp.int32(1)
+    fi_levels, cnt_levels = [], []
+    full_hists = []               # exact FULL per-level hists (padded out;
+                                  # the repair path's subtraction parents)
+    mismatch = jnp.int32(depth)
+    full_prev = None
+    feat_prev = None
+    for level in range(depth):
+        n_nodes = 1 << level
+        if level == 0:
+            hist = hist_left[0][:1]
+        else:
+            hl = hist_left[level][:n_nodes // 2]
+            hist = _derive_level(full_prev, hl, feat_prev, n_nodes)
+        full_hists.append(hist)
+        gain, feat_l, lmask, leaf, _ = best_splits(
+            hist, cat_h, fa_h, impurity, mi, mg, has_cat=has_cat)
+        feat = jnp.where(feat_l >= 0,
+                         cand_idx[jnp.maximum(feat_l, 0)] if cand
+                         else feat_l, -1).astype(jnp.int32)
+        if max_leaves > 0:
+            feat, lmask, nodes_cnt = cap_splits_by_leaves(
+                gain, feat, lmask, nodes_cnt, max_leaves)
+        base = n_nodes - 1
+        sf = sf.at[base:base + n_nodes].set(feat)
+        lm = lm.at[base:base + n_nodes].set(lmask)
+        lv = lv.at[base:base + n_nodes].set(leaf)
+        fi_levels.append(jax.ops.segment_sum(
+            jnp.where(feat >= 0, jnp.maximum(gain, 0.0),
+                      0.0).astype(jnp.float32),
+            jnp.maximum(feat, 0), num_segments=c_full))
+        cnt_levels.append(nodes_cnt)
+        diff = jnp.any(feat != jax.lax.dynamic_slice_in_dim(
+            sf_c, base, n_nodes)) | jnp.any(
+            lmask != jax.lax.dynamic_slice_in_dim(lm_c, base, n_nodes,
+                                                  axis=0))
+        mismatch = jnp.where((mismatch == depth) & diff,
+                             jnp.int32(level), mismatch)
+        full_prev = hist
+        feat_prev = feat
+    lv = _set_bottom_leaves(lv, leaf_raw, depth)
+    half = max(1 << (depth - 1), 1)
+    full_levels = jnp.stack([
+        jnp.concatenate([h, jnp.zeros((half - h.shape[0],) + h.shape[1:],
+                                      h.dtype)]) if h.shape[0] < half
+        else h
+        for h in full_hists])
+    return sf, lm, lv, jnp.stack(fi_levels), jnp.stack(cnt_levels), \
+        mismatch, full_levels
+
+
+@jax.jit
+def _pack_c2f(sf, lm, lv, fi):
+    """[sf, mask-bits, lv, fi] packed fetch for a coarse-to-fine tree —
+    errors travel separately (they land one pass later, fused into the
+    NEXT tree's tail pass)."""
+    return jnp.concatenate([sf.astype(jnp.float32), _pack_mask_bits(lm),
+                            lv, fi])
+
+
+@jax.jit
+def _pack_small(sums, mismatch):
+    """The per-tree tiny fetch: [tr_sum, tw, va_sum, vw, mismatch]."""
+    return jnp.concatenate([sums, mismatch[None].astype(jnp.float32)])
+
+
+def _rf_tail_bags(idx_hi, idx_lo, khi_b, klo_b, thi, tlo, n: int,
+                  poisson: bool):
+    """[TB, n] Poisson bags hashed ON DEVICE for a tail super-batch —
+    bit-identical to the host ``_hash_poisson`` stream
+    (``ops/hashing.py``), so the wire carries two [n] uint32 index halves
+    per window instead of a [TB, n] f32 bag plane (the put that dominated
+    tail prep as TB grew).  Rows past ``n_valid`` need no masking here:
+    the RF prep hook zeroes ``w`` there, and every consumer multiplies or
+    gates by ``w``."""
+    if not poisson:
+        return jnp.ones((khi_b.shape[0], n), jnp.float32)
+    from ..ops.hashing import hash_poisson_traced
+    return jax.vmap(lambda kh, kl: hash_poisson_traced(
+        idx_hi, idx_lo, kh, kl, thi, tlo))(khi_b, klo_b)
+
+
+def _rf_stats_batch(y_w, w_w, bags_b, n_classes: int):
+    bw_b = w_w[None, :] * bags_b
+    if n_classes > 2:      # NATIVE multiclass: per-class weight channels
+        return bw_b[:, :, None] * jax.nn.one_hot(
+            y_w.astype(jnp.int32), n_classes, dtype=jnp.float32)[None]
+    return jnp.stack([bw_b, bw_b * y_w[None, :]], axis=2) \
+        .astype(jnp.float32)
+
+
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level",
                                    "use_pallas", "mesh", "n_classes",
-                                   "stats_exact"))
-def _rf_window_hist_batch(hist_b, bins_w, y_w, w_w, bags_b, sf_b, lm_b,
+                                   "stats_exact", "left", "poisson"))
+def _rf_window_hist_batch(hist_b, bins_w, y_w, w_w, idx_hi, idx_lo,
+                          khi_b, klo_b, thi, tlo, sf_b, lm_b,
                           n_nodes: int, n_bins: int, level: int,
                           use_pallas: bool = False, mesh=None,
-                          n_classes: int = 0, stats_exact: bool = False):
-    """Tail-batch histogram sweep for ONE window as ONE executable — and,
+                          n_classes: int = 0, stats_exact: bool = False,
+                          left: bool = False, poisson: bool = True):
+    """Super-batch histogram sweep for ONE window as ONE executable — and,
     since the multi-tree kernel round, ONE kernel launch: the TB trees'
     level histograms build through :func:`build_histograms_batch` (the
     bins one-hot is shared across the batch) instead of TB stacked
-    single-tree kernels.
+    single-tree kernels.  Bags hash on device (:func:`_rf_tail_bags`);
+    ``left=True`` accumulates left children only for the subtraction
+    derivation (:func:`_derive_level_batch`).
 
     The per-tree histograms of a tail batch are mutually independent, and
     independent mesh programs that overlap deadlock XLA:CPU's in-process
@@ -1028,19 +1304,50 @@ def _rf_window_hist_batch(hist_b, bins_w, y_w, w_w, bags_b, sf_b, lm_b,
     separate programs was the round-4 SIGABRT.  The single program keeps
     every collective in one totally-ordered executable and chains across
     windows via the stacked ``hist_b`` accumulator input."""
+    bags_b = _rf_tail_bags(idx_hi, idx_lo, khi_b, klo_b, thi, tlo,
+                           w_w.shape[0], poisson)
     node_b = jax.vmap(
         lambda sf, lm: node_index_at_level(sf, lm, bins_w, level))(
         sf_b, lm_b)
-    bw_b = w_w[None, :] * bags_b
-    if n_classes > 2:      # NATIVE multiclass: per-class weight channels
-        stats_b = bw_b[:, :, None] * jax.nn.one_hot(
-            y_w.astype(jnp.int32), n_classes, dtype=jnp.float32)[None]
-    else:
-        stats_b = jnp.stack([bw_b, bw_b * y_w[None, :]], axis=2) \
-            .astype(jnp.float32)
+    if left:
+        node_b = jax.vmap(_left_child_index)(node_b)
+    stats_b = _rf_stats_batch(y_w, w_w, bags_b, n_classes)
     return hist_b + build_histograms_batch(bins_w, node_b, stats_b,
                                            n_nodes, n_bins, use_pallas,
                                            mesh, stats_exact)
+
+
+@partial(jax.jit, static_argnames=("n_nodes",))
+def _derive_level_batch(full_prev_b, hl_b, feat_prev_b, n_nodes: int):
+    """Batched :func:`_derive_level` (per-tree parent - left)."""
+    return jax.vmap(
+        lambda fp, hl, f: _derive_level(fp, hl, f, n_nodes))(
+        full_prev_b, hl_b, feat_prev_b)
+
+
+@partial(jax.jit, static_argnames=("depth", "n_classes", "poisson"))
+def _rf_window_leaf_batch(raw_b, bins_w, y_w, w_w, idx_hi, idx_lo, khi_b,
+                          klo_b, thi, tlo, sf_b, lm_b, depth: int,
+                          n_classes: int = 0, poisson: bool = True):
+    """Super-batch bottom-level raw leaf sums for one window — the
+    leaf-sum bottom level, streamed and tree-batched (the deepest, widest
+    histogram sweep of the old schedule becomes one dot per tree)."""
+    bags_b = _rf_tail_bags(idx_hi, idx_lo, khi_b, klo_b, thi, tlo,
+                           w_w.shape[0], poisson)
+    stats_b = _rf_stats_batch(y_w, w_w, bags_b, n_classes)
+    node_b = jax.vmap(
+        lambda sf, lm: node_index_at_level(sf, lm, bins_w, depth))(
+        sf_b, lm_b)
+    return raw_b + jax.vmap(
+        lambda st, ni: _level_leaf_raw(st, ni, 1 << depth))(stats_b,
+                                                            node_b)
+
+
+@partial(jax.jit, static_argnames=("depth", "n_classes"))
+def _set_bottom_leaves_batch(lv_b, raw_b, depth: int, n_classes: int = 0):
+    base = (1 << depth) - 1
+    vals = jax.vmap(lambda r: leaf_values_from_raw(r, n_classes))(raw_b)
+    return lv_b.at[:, base:].set(vals)
 
 
 @partial(jax.jit, static_argnames=("depth", "loss"))
@@ -1095,22 +1402,32 @@ def _rf_window_update(sums_in, bins_w, y_w, w_w, bag_w, oob_sum_w,
     return oob_sum2, oob_cnt2, sums_in + sums
 
 
-@partial(jax.jit, static_argnames=("depth", "loss", "n_classes"))
-def _rf_window_update_batch(sums_b, bins_w, y_w, w_w, bags_b, oob_sum_w,
-                            oob_cnt_w, sf_b, lm_b, lv_b, depth: int,
-                            loss: str, n_classes: int = 0):
-    """Tail-batch oob/error sweep for ONE window as ONE executable — the
+@partial(jax.jit, static_argnames=("depth", "loss", "n_classes",
+                                   "poisson"))
+def _rf_window_update_batch(sums_b, bins_w, y_w, w_w, idx_hi, idx_lo,
+                            khi_b, klo_b, thi, tlo, oob_sum_w, oob_cnt_w,
+                            sf_b, lm_b, lv_b, depth: int, loss: str,
+                            n_classes: int = 0, poisson: bool = True):
+    """Super-batch oob/error sweep for ONE window as ONE executable — the
     oob vote caches chain through the batch in tree order exactly as the
-    per-tree sequence would, and the single program keeps the row-sum
-    AllReduces totally ordered (see :func:`_rf_window_hist_batch`)."""
-    osw, ocw = oob_sum_w, oob_cnt_w
-    sums = []
-    for j in range(sums_b.shape[0]):
-        osw, ocw, s = _rf_window_update(
-            sums_b[j], bins_w, y_w, w_w, bags_b[j], osw, ocw, sf_b[j],
-            lm_b[j], lv_b[j], depth, loss, n_classes)
-        sums.append(s)
-    return osw, ocw, jnp.stack(sums)
+    per-tree sequence would (a ``lax.scan`` over the tree axis, so a
+    budget-sized super-batch doesn't unroll into a giant program), and
+    the single program keeps the row-sum AllReduces totally ordered (see
+    :func:`_rf_window_hist_batch`)."""
+    bags_b = _rf_tail_bags(idx_hi, idx_lo, khi_b, klo_b, thi, tlo,
+                           w_w.shape[0], poisson)
+
+    def body(carry, x):
+        osw, ocw = carry
+        s_j, bag_j, sf_j, lm_j, lv_j = x
+        osw, ocw, s2 = _rf_window_update(
+            s_j, bins_w, y_w, w_w, bag_j, osw, ocw, sf_j, lm_j, lv_j,
+            depth, loss, n_classes)
+        return (osw, ocw), s2
+
+    (osw, ocw), sums = jax.lax.scan(
+        body, (oob_sum_w, oob_cnt_w), (sums_b, bags_b, sf_b, lm_b, lv_b))
+    return osw, ocw, sums
 
 
 
@@ -1168,13 +1485,14 @@ def _tree_level_step_batch(hist_b, cat, fa_b, impurity: str, min_instances,
                            n_classes: int = 0):
     """Tail-batch level step as ONE executable (one dispatch per level
     for the whole batch; see :func:`_rf_window_hist_batch` on why the
-    trees must not run as independent programs)."""
-    outs = [_tree_level_step(hist_b[j], cat, fa_b[j], impurity,
-                             min_instances, min_gain, has_cat, level,
-                             depth, max_leaves, sf_b[j], lm_b[j], lv_b[j],
-                             cnt_b[j], fi_b[j], n_classes)
-            for j in range(hist_b.shape[0])]
-    return tuple(jnp.stack(x) for x in zip(*outs))
+    trees must not run as independent programs).  vmapped over the tree
+    axis so a budget-sized super-batch traces once, not SB times."""
+    def one(h, fa, sf, lm, lv, cnt, fi):
+        return _tree_level_step(h, cat, fa, impurity, min_instances,
+                                min_gain, has_cat, level, depth,
+                                max_leaves, sf, lm, lv, cnt, fi,
+                                n_classes)
+    return jax.vmap(one)(hist_b, fa_b, sf_b, lm_b, lv_b, cnt_b, fi_b)
 
 
 
@@ -1221,10 +1539,91 @@ def _pipeline_depth(mesh) -> Optional[int]:
     return pipeline_depth_for(mesh)
 
 
-# trees grown per disk-tail sweep in streamed RF (histogram state is
-# ~[TB, 2^depth, C, B, S] f32 at the deepest level — 8 stays tens of MB
-# at north-star widths while cutting tail re-streams 8x)
+# floor on trees grown per disk-tail sweep in streamed RF.  The actual
+# super-batch is budget-derived (:func:`_tail_super_batch`): as many
+# trees as the per-level histogram state affords, so disk passes per tree
+# scale as (depth+2)/SB instead of the old fixed /8.
 RF_TAIL_TREE_BATCH = 8
+
+# hard cap on the tail super-batch: past ~128 trees the batched level
+# steps' compile time and the [SB, K, C, B, S] state stop paying for the
+# marginal disk-pass amortization
+RF_TAIL_SUPER_BATCH_MAX = 128
+
+
+def _tail_super_batch(settings: DTSettings, c: int, n_bins: int,
+                      n_stats: int) -> int:
+    """Trees fed by ONE disk pass over the tail in streamed RF — the
+    super-batch SB.  ``TailTreeBatch`` train param / ``SHIFU_TAIL_TREE_
+    BATCH`` env override; auto derives from ``shifu.tree.
+    tailSuperBatchBytes`` (default 256 MiB) against the deepest level's
+    histogram state (~2x [SB, 2^(depth-1), C, B, S] f32 for the running
+    accumulator + the previous level kept for subtraction, plus the
+    per-window [SB, W] bag/stat planes)."""
+    env = os.environ.get("SHIFU_TAIL_TREE_BATCH")
+    if env:
+        return max(1, int(env))
+    if settings.tail_tree_batch > 0:
+        return settings.tail_tree_batch
+    from ..config import environment
+    budget = environment.get_int("shifu.tree.tailSuperBatchBytes", 1 << 28)
+    width = 1 << max(settings.depth - 1, 0)
+    per_tree = 2 * width * c * n_bins * n_stats * 4
+    return int(min(RF_TAIL_SUPER_BATCH_MAX,
+                   max(RF_TAIL_TREE_BATCH, budget // max(per_tree, 1))))
+
+
+def _tail_coarse_to_fine() -> bool:
+    """The disk-tail coarse-to-fine schedule knob: ``SHIFU_TREE_TAIL_C2F``
+    env / ``-Dshifu.tree.tailCoarseToFine`` property.
+
+    Default: ON on accelerator backends, OFF on CPU.  The fused one-pass
+    schedule trades recomputation (repair sweeps re-derive diverged
+    levels) for disk passes — the winning trade exactly when per-pass
+    overhead (H2D puts, dispatch latency, real disk) dominates, i.e. on
+    a TPU/GPU driving an out-of-core tail.  On a CPU backend a "pass"
+    over the mmap spill cache is nearly free while the repair compute is
+    not, so the exact per-level super-batch schedule is faster (measured
+    ~40k vs ~29k rows*trees/s on the CI rig at 50% repair rate).  Both
+    schedules produce bit-identical forests; only the pass/compute mix
+    differs."""
+    env = os.environ.get("SHIFU_TREE_TAIL_C2F")
+    if env is not None:
+        return env.lower() not in ("0", "off", "false")
+    from ..config import environment
+    default = jax.default_backend() != "cpu"
+    return environment.get_bool("shifu.tree.tailCoarseToFine", default)
+
+
+def _tail_candidate_k(c: int) -> int:
+    """Bounded-candidate histogram width for the coarse-to-fine tail
+    pass: ``-Dshifu.tree.tailCandidateK`` picks the top-K features (by
+    the coarse tree's realized gains, coarse split features always
+    included) and the exact tail verification scans only those K columns.
+    0 (default) / K >= C = all features — the EXACT contract; K < C is
+    the approximate bounded scan (the chosen split is exact-best WITHIN
+    the candidate set)."""
+    from ..config import environment
+    k = environment.get_int("shifu.tree.tailCandidateK", 0)
+    if k <= 0 or k >= c:
+        return 0
+    return k
+
+
+def _c2f_feasible(settings: DTSettings, c: int, n_bins: int) -> bool:
+    """Coarse-to-fine holds every level's left-child histograms at once
+    ([depth, 2^(depth-1), K, B, S] f32 x3 live copies: resident head
+    record, running accumulator, stale-tail evidence) — gate on
+    ``shifu.tree.tailHistBudgetBytes`` (default 256 MiB) so deep/wide
+    configs fall back to the exact per-level schedule instead of
+    OOMing."""
+    if settings.depth < 1 or settings.n_classes > 2:
+        return False
+    from ..config import environment
+    budget = environment.get_int("shifu.tree.tailHistBudgetBytes", 1 << 28)
+    k = _tail_candidate_k(c) or c
+    width = 1 << max(settings.depth - 1, 0)
+    return 3 * settings.depth * width * k * n_bins * 2 * 4 <= budget
 
 
 @jax.jit
@@ -1375,6 +1774,7 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
             replay_stopped = True
 
     f_ref: Dict[str, Any] = {"f": None}   # prep-thread view of host scores
+    bytes0 = stream.bytes_read
     cache = ResidentCache(stream,
                           _default_cache_budget() if cache_budget is None
                           else cache_budget,
@@ -1500,6 +1900,342 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
         mega = {k: _concat_rows([it.arrays[k] for it in items])
                 for k in ("bins", "y", "tw", "vw")}
         mega["f"] = _concat_rows([window_f(it) for it in items])
+
+    # ------------------------------------------------------- disk tail
+    # the dataset exceeds the resident budget: one disk pass must feed
+    # everything.  The resident prefix coalesces into ONE device block
+    # (per-window dispatch gone), and trees grow either coarse-to-fine
+    # (speculate the structure on the resident prefix, verify every
+    # level's exact histograms in ONE fused tail pass that also carries
+    # the previous tree's score update — disk passes per tree drop from
+    # depth+2 to ~1, repairs only where the speculation diverges) or, with
+    # the knob off / an over-budget histogram state, by exact per-level
+    # sweeps with subtraction + a leaf-sum bottom (the resident grow's
+    # kernel savings, streamed).
+    if mega is None and cache.tail is not None and not replay_stopped \
+            and len(trees) < settings.n_trees:
+        from ..data.streaming import PreparedWindow
+        res_rows = cache.resident_rows
+        rmega = None
+        mega_it = None
+        if cache.cached:
+            items_r = list(cache.cached)
+            rmega = {k: _concat_rows([it.arrays[k] for it in items_r])
+                     for k in ("bins", "y", "tw", "vw")}
+            rmega["f"] = _concat_rows([window_f(it) for it in items_r])
+            for it in items_r:   # window buffers live on in the block
+                it.arrays.clear()
+            mega_it = PreparedWindow(0, res_rows, res_rows,
+                                     np.arange(res_rows), rmega,
+                                     resident=True)
+
+        def sweep_items():
+            if mega_it is not None:
+                yield mega_it
+            yield from cache.tail_items()
+
+        def exact_levels(fa, sf, lm, lv, nodes_cnt, fi_add,
+                         start_level: int, full_prev, capture=None):
+            """Exact per-level sweeps for levels [start_level..depth-1]
+            plus the leaf-sum bottom — the knob-off schedule AND the
+            coarse-to-fine repair path (one implementation, they must
+            never drift).  Levels with a parent histogram in hand build
+            left children only and derive the right by subtraction.
+
+            ``capture`` (optional dict) receives each LEFT-built level's
+            tail-only left-child histogram (total minus the resident
+            block's prefix sum) — exactly-routed along the FINAL
+            structure, so the repair path can refresh the next tree's
+            stale-tail evidence below the divergence point."""
+            for level in range(start_level, settings.depth):
+                n_nodes = 1 << level
+                left = level > 0 and full_prev is not None
+                width = n_nodes // 2 if left else n_nodes
+                hist = jnp.zeros((width, c, n_bins, 2), jnp.float32)
+                hist_res = None
+                for it in sweep_items():
+                    hist = _gbt_window_hist(
+                        hist, it.arrays["bins"], it.arrays["y"],
+                        it.arrays["tw"], window_f(it), sf, lm, width,
+                        n_bins, level, settings.loss, up,
+                        _hist_mesh(mesh), left)
+                    if it.resident:
+                        hist_res = hist
+                if left:
+                    if capture is not None:
+                        capture[level] = hist - hist_res \
+                            if hist_res is not None else hist
+                    feat_prev = jax.lax.dynamic_slice_in_dim(
+                        sf, width - 1, width)
+                    hist = _derive_level(full_prev, hist, feat_prev,
+                                         n_nodes)
+                sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
+                    hist, cat, fa, imp, settings.min_instances,
+                    settings.min_gain, hc, level, settings.depth,
+                    settings.max_leaves, sf, lm, lv, nodes_cnt, fi_add)
+                full_prev = hist
+            raw = jnp.zeros((2, 1 << settings.depth), jnp.float32)
+            for it in sweep_items():
+                raw = _gbt_window_leaf_raw(
+                    raw, it.arrays["bins"], it.arrays["y"],
+                    it.arrays["tw"], window_f(it), sf, lm,
+                    settings.depth, settings.loss)
+            return sf, lm, _set_bottom_leaves(lv, raw, settings.depth), \
+                fi_add
+
+        def update_sweep(sf, lm, lv, want_scores: bool):
+            """Previous-tree score update + error sums over every window
+            (resident block + tail); tail f slices write back DEFERRED so
+            the fetches overlap the in-flight window programs."""
+            sums_dev = jnp.zeros(4, jnp.float32)
+            wb = []
+            for it in sweep_items():
+                f2, sums_dev = _gbt_window_update(
+                    sums_dev, it.arrays["bins"], it.arrays["y"],
+                    it.arrays["tw"], it.arrays["vw"], window_f(it),
+                    sf, lm, lv, settings.learning_rate, settings.depth,
+                    settings.loss)
+                if it.resident:
+                    it.arrays["f"] = f2
+                else:
+                    wb.append((it.start, it.n_valid, f2))
+            for s, nv, f2 in wb:
+                f[s:s + nv] = np.asarray(f2)[:nv]
+            scores = None
+            if want_scores:
+                scores = tail_scores()
+            return sums_dev, scores
+
+        def tail_scores() -> np.ndarray:
+            """Full per-row scores for a checkpoint: resident slice from
+            the device block, tail rows from the host cache."""
+            scores = np.empty(n_rows, np.float32)
+            if rmega is not None:
+                scores[:res_rows] = np.asarray(rmega["f"])[:res_rows]
+            scores[res_rows:] = f[res_rows:n_rows]
+            return scores
+
+        use_c2f = (rmega is not None and _tail_coarse_to_fine()
+                   and _c2f_feasible(settings, c, n_bins))
+        cand_k = _tail_candidate_k(c) if use_c2f else 0
+        lr_d = jnp.float32(settings.learning_rate)
+        zero_tree = (jnp.zeros(total, jnp.int32),
+                     jnp.zeros((total, n_bins), bool),
+                     jnp.zeros(total, jnp.float32))
+        prev = None                  # device arrays of the last built tree
+        pend: List[Any] = []         # device-packed [sf, bits, lv, fi]
+        drains = 0
+
+        def drain_pend() -> None:
+            nonlocal drains
+            if not pend:
+                return
+            flat = _fetch(jnp.stack(pend))
+            pend.clear()
+            sizes = [total, _mask_nbytes(total, n_bins), total, c]
+            for vec in flat:
+                sf_h, lm_h, lv_h, fi_h = np.split(vec,
+                                                  np.cumsum(sizes)[:-1])
+                trees.append(TreeArrays(
+                    split_feat=sf_h.astype(np.int32),
+                    left_mask=_unpack_mask_bits(lm_h, total, n_bins),
+                    leaf_value=lv_h.astype(np.float32),
+                    depth=settings.depth))
+                fi_parts.append(fi_h.astype(np.float64))
+            drains += 1
+            faults.fire("train", "superbatch", drains)
+
+        built = len(trees)
+        stopped = False
+        f_behind = False             # last built tree's update pending?
+        fell_back = False            # speculation gave up -> exact path
+        if use_c2f:
+            tail_extra = None        # prev pass's exact tail evidence
+            valid_upto = jnp.int32(0)
+            lowmis_run = 0           # consecutive near-root repairs
+            while built < settings.n_trees:
+                ti = built
+                fa = jnp.asarray(_feat_subset(settings, c, ti))
+                has_prev = prev is not None
+                p_sf, p_lm, p_lv = prev if prev is not None else zero_tree
+                (sf_c, lm_c, hl_res, raw_acc, f_res2, sums_d,
+                 cand_idx) = _gbt_tail_head(
+                        rmega["bins"], rmega["y"], rmega["tw"],
+                        rmega["vw"], rmega["f"], p_sf, p_lm, p_lv, fa,
+                        cat, lr_d, settings.min_instances,
+                        settings.min_gain,
+                        tail_extra if has_prev else None,
+                        valid_upto, n_bins,
+                        settings.depth, imp, settings.loss, up,
+                        settings.max_leaves, hc, _hist_mesh(mesh),
+                        has_prev, cand_k)
+                rmega["f"] = f_res2
+                hl_acc = hl_res
+                wb = []
+                for it in cache.tail_items():
+                    hl_acc, raw_acc, sums_d, f2 = _gbt_tail_window_pass(
+                        hl_acc, raw_acc, sums_d, it.arrays["bins"],
+                        it.arrays["y"], it.arrays["tw"],
+                        it.arrays["vw"], window_f(it), p_sf, p_lm, p_lv,
+                        sf_c, lm_c, cand_idx, lr_d, n_bins,
+                        settings.depth, settings.loss, up,
+                        _hist_mesh(mesh), has_prev, cand_k > 0)
+                    wb.append((it.start, it.n_valid, f2))
+                sf_t, lm_t, lv_t, fi_lv, cnt_lv, mism_d, full_lv = \
+                    _gbt_tail_select(
+                        hl_acc, raw_acc, sf_c, lm_c, cand_idx, cat, fa,
+                        settings.min_instances, settings.min_gain,
+                        n_bins, settings.depth, imp, settings.max_leaves,
+                        hc, cand_k > 0)
+                tail_extra = _tail_extras(hl_acc, hl_res, cand_idx, c,
+                                          cand_k > 0)
+                for s, nv, f2 in wb:    # deferred: overlaps the select
+                    f[s:s + nv] = np.asarray(f2)[:nv]
+                small = _fetch(_pack_small(sums_d, mism_d))
+                if has_prev:
+                    tr_e = float(small[0]) / max(float(small[1]), 1e-9)
+                    va_e = float(small[2]) / max(float(small[3]), 1e-9)
+                    history.append((tr_e, va_e))
+                    f_behind = False
+                    if progress:
+                        progress(ti - 1, tr_e, va_e)
+                    if settings.early_stop and stopper.add(va_e):
+                        # the stop decision lands one pass late; the
+                        # in-flight tree ti is exactly the tree the
+                        # per-tree loop would never have grown — drop it
+                        obs.event("early_stop", trainer="gbt_streamed",
+                                  tree=ti)
+                        log.info("GBT early stop after %d trees "
+                                 "(streamed tail)", ti)
+                        drain_pend()
+                        if checkpoint_fn and settings.checkpoint_every:
+                            checkpoint_fn(trees, history, init_host())
+                        stopped = True
+                        break
+                mis = int(small[4])
+                valid_upto = jnp.int32(settings.depth)
+                if mis < settings.depth:
+                    # speculation diverged at `mis`: its own selection is
+                    # exact (routed by confirmed levels), deeper
+                    # histograms are mis-routed — repair them with exact
+                    # per-level sweeps.  Seeding the repair's subtraction
+                    # chain with the select pass's exact level-`mis` FULL
+                    # histogram keeps the repair bit-identical to the
+                    # pure exact schedule (a direct full rebuild would
+                    # round differently than parent-minus-left); the
+                    # repair's tail-only left sums refresh the stale
+                    # evidence below the divergence so the NEXT tree
+                    # speculates from full-depth, exactly-routed
+                    # evidence.
+                    obs.counter("train.tail_repairs").inc()
+                    obs.counter("train.tail_repair_levels").inc(
+                        settings.depth - mis)
+                    fi_base = jnp.sum(fi_lv[:mis + 1], axis=0)
+                    cap: Dict[int, Any] = {} if cand_k == 0 else None
+                    sf_t, lm_t, lv_t, fi_tree = exact_levels(
+                        fa, sf_t, lm_t, lv_t, cnt_lv[mis], fi_base,
+                        mis + 1, full_lv[mis][:1 << mis]
+                        if cand_k == 0 else None, capture=cap)
+                    if cap:
+                        for lvl, h in cap.items():
+                            tail_extra = tail_extra.at[
+                                lvl, :h.shape[0]].set(h)
+                    elif cand_k > 0:
+                        # bounded-candidate mode: deeper evidence stays
+                        # routed by the abandoned speculation — invalid
+                        valid_upto = jnp.int32(mis)
+                else:
+                    fi_tree = jnp.sum(fi_lv, axis=0)
+                # adaptive surrender: with stale-tail evidence in play the
+                # confirmed depth should climb tree over tree; a long run
+                # of near-root repairs means this plane's split landscape
+                # is speculation-hostile (e.g. label noise) and every c2f
+                # tree costs exact + a wasted fused pass — finish the
+                # forest on the exact schedule instead (same forest bits;
+                # only the pass count changes)
+                lowmis_run = lowmis_run + 1 \
+                    if (has_prev and mis <= 1) else 0
+                prev = (sf_t, lm_t, lv_t)
+                pend.append(_pack_c2f(sf_t, lm_t, lv_t, fi_tree))
+                built += 1
+                f_behind = True
+                if len(pend) >= 8:
+                    drain_pend()
+                if checkpoint_fn and settings.checkpoint_every and \
+                        built > 1 and \
+                        (built - 1) % settings.checkpoint_every == 0:
+                    # super-batch drain boundary: commit the prefix whose
+                    # scores are final (the freshly built tree's update
+                    # lands fused into the NEXT tree's tail pass)
+                    drain_pend()
+                    checkpoint_fn(trees[:built - 1],
+                                  history[:built - 1], init_host(),
+                                  tail_scores())
+                if lowmis_run >= 6 and built < settings.n_trees:
+                    obs.counter("train.tail_c2f_fallbacks").inc()
+                    log.info("GBT tail: speculation repaired near the "
+                             "root %d trees running — falling back to "
+                             "the exact per-level schedule at tree %d",
+                             lowmis_run, built)
+                    fell_back = True
+                    break
+            if not stopped and f_behind and prev is not None:
+                # trailing pass: the last tree's update + error sums
+                sums_dev, _ = update_sweep(*prev, want_scores=False)
+                sums_h = _fetch(sums_dev)
+                tr_e = float(sums_h[0]) / max(float(sums_h[1]), 1e-9)
+                va_e = float(sums_h[2]) / max(float(sums_h[3]), 1e-9)
+                history.append((tr_e, va_e))
+                if progress:
+                    progress(built - 1, tr_e, va_e)
+                f_behind = False
+            drain_pend()
+        if not use_c2f or fell_back:
+            while built < settings.n_trees and not stopped:
+                ti = built
+                fa = jnp.asarray(_feat_subset(settings, c, ti))
+                sf = jnp.full(total, -1, jnp.int32)
+                lm = jnp.zeros((total, n_bins), bool)
+                lv = jnp.zeros(total, jnp.float32)
+                sf, lm, lv, fi_add = exact_levels(
+                    fa, sf, lm, lv, jnp.int32(1),
+                    jnp.zeros(c, jnp.float32), 0, None)
+                ckpt_due = bool(
+                    checkpoint_fn and settings.checkpoint_every and
+                    (ti + 1) % settings.checkpoint_every == 0)
+                sums_dev, scores = update_sweep(sf, lm, lv, ckpt_due)
+                absorb_fused([_fetch(jnp.concatenate([
+                    sf.astype(jnp.float32), _pack_mask_bits(lm),
+                    lv, fi_add, sums_dev]))])
+                built += 1
+                tr_err, va_err = history[-1]
+                if progress:
+                    progress(ti, tr_err, va_err)
+                mark_progress()
+                if ckpt_due:
+                    checkpoint_fn(trees, history, init_host(), scores)
+                if settings.early_stop and stopper.add(va_err):
+                    obs.event("early_stop", trainer="gbt_streamed",
+                              tree=ti + 1)
+                    log.info("GBT early stop after %d trees (streamed)",
+                             ti + 1)
+                    if checkpoint_fn and settings.checkpoint_every:
+                        checkpoint_fn(trees, history, init_host())
+                    stopped = True
+        return ForestResult(
+            trees=trees,
+            spec_kwargs={"algorithm": "GBT", "loss": settings.loss,
+                         "learning_rate": settings.learning_rate,
+                         "init_score": init_host()},
+            train_error=history[-1][0] if history else float("nan"),
+            valid_error=history[-1][1] if history else float("nan"),
+            feature_importance=(np.sum(fi_parts, axis=0) if fi_parts
+                                else np.zeros(c)),
+            trees_built=len(trees), history=history,
+            disk_passes=cache.disk_passes,
+            tail_sweeps=cache.tail_sweeps,
+            bytes_read=stream.bytes_read - bytes0)
+
     start_ti = settings.n_trees if replay_stopped \
         else len(trees) + len(pending_fused)
     for ti in range(start_ti, settings.n_trees):
@@ -1550,67 +2286,6 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                 flush_progress()
                 checkpoint_fn(trees, history, init_host(),
                               np.asarray(mega["f"])[:n_rows])
-            continue
-        sf = jnp.full(total, -1, jnp.int32)
-        lm = jnp.zeros((total, n_bins), bool)
-        lv = jnp.zeros(total, jnp.float32)
-        nodes_cnt = jnp.int32(1)
-        fi_add = jnp.zeros(c, jnp.float32)
-        for level in range(settings.depth + 1):
-            n_nodes = 1 << level
-            hist = jnp.zeros((n_nodes, c, n_bins, 2), jnp.float32)
-            for it in cache.items():
-                hist = _gbt_window_hist(
-                    hist, it.arrays["bins"], it.arrays["y"],
-                    it.arrays["tw"], window_f(it), sf, lm,
-                    n_nodes, n_bins, level, settings.loss, up,
-                    _hist_mesh(mesh))
-            sf, lm, lv, nodes_cnt, fi_add = _tree_level_step(
-                hist, cat, fa, imp, settings.min_instances,
-                settings.min_gain, hc, level, settings.depth,
-                settings.max_leaves, sf, lm, lv, nodes_cnt, fi_add)
-        # update pass: f caches + error sums, all device-side; ONE packed
-        # fetch per tree (same layout as the fused path, absorbed by
-        # absorb_fused) — tail windows additionally round-trip their f
-        # slice (they are disk-bound anyway)
-        sums_dev = jnp.zeros(4, jnp.float32)
-        # TreeBatch-boundary checkpoint: on a checkpoint tree the update
-        # pass additionally snapshots every window's post-update scores
-        # (resident windows would otherwise need a second device fetch)
-        ckpt_due = bool(checkpoint_fn and settings.checkpoint_every and
-                        (ti + 1) % min(settings.checkpoint_every, 8) == 0)
-        scores = np.empty(n_rows, np.float32) if ckpt_due else None
-        for it in cache.items():
-            f2, sums_dev = _gbt_window_update(
-                sums_dev, it.arrays["bins"], it.arrays["y"],
-                it.arrays["tw"], it.arrays["vw"], window_f(it),
-                sf, lm, lv, settings.learning_rate, settings.depth,
-                settings.loss)
-            s, e = it.start, it.start + it.n_valid
-            if it.resident:
-                it.arrays["f"] = f2
-                if scores is not None:
-                    scores[s:e] = np.asarray(f2)[:it.n_valid]
-            else:
-                f[s:e] = np.asarray(f2)[:it.n_valid]
-                if scores is not None:
-                    scores[s:e] = f[s:e]
-        absorb_fused([_fetch(jnp.concatenate([
-            sf.astype(jnp.float32), _pack_mask_bits(lm),
-            lv, fi_add, sums_dev]))])
-        tr_err, va_err = history[-1]
-        if progress:
-            progress(ti, tr_err, va_err)
-        mark_progress()
-        es_checked = len(history)      # disk-tail trees feed the stopper
-        if ckpt_due:
-            checkpoint_fn(trees, history, init_host(), scores)
-        if settings.early_stop and stopper.add(va_err):
-            obs.event("early_stop", trainer="gbt_streamed", tree=ti + 1)
-            log.info("GBT early stop after %d trees (streamed)", ti + 1)
-            if checkpoint_fn and settings.checkpoint_every:
-                checkpoint_fn(trees, history, init_host())
-            break
     flush_progress()
     return ForestResult(
         trees=trees,
@@ -1622,7 +2297,9 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
         feature_importance=(np.sum(fi_parts, axis=0) if fi_parts
                             else np.zeros(c)),
         trees_built=len(trees), history=history,
-        disk_passes=cache.disk_passes)
+        disk_passes=cache.disk_passes,
+        tail_sweeps=cache.tail_sweeps,
+        bytes_read=stream.bytes_read - bytes0)
 
 
 @lru_cache(maxsize=None)
@@ -1731,16 +2408,6 @@ def _shard_rows(a: np.ndarray, mesh=None):
     return jax.device_put(a, NamedSharding(mesh, spec))
 
 
-def _shard_rows_batch(a: np.ndarray, mesh=None):
-    """[TB, rows] stacked per-tree row arrays, rows sharded over the mesh
-    data axis (one put for the whole tail batch)."""
-    if mesh is None:
-        return jnp.asarray(a)
-    from jax.sharding import NamedSharding
-    from jax.sharding import PartitionSpec as P
-    return jax.device_put(a, NamedSharding(mesh, P(None, "data")))
-
-
 def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                       progress=None,
                       checkpoint_fn: Optional[Callable] = None,
@@ -1765,6 +2432,7 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
     trees: List[TreeArrays] = list(init_trees or [])
     history: List[Tuple[float, float]] = list(start_history or [])
 
+    bytes0 = stream.bytes_read
     cache = ResidentCache(stream,
                           _default_cache_budget() if cache_budget is None
                           else cache_budget,
@@ -1803,18 +2471,6 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
         dev = bag_cache.get(key)
         if dev is None:
             dev = _shard_rows(host_bag(ti, it), mesh)
-            if it.resident:      # tail bags would grow with the dataset
-                bag_cache[key] = dev
-        return dev
-
-    def window_bags(tis, it):
-        """Stacked [TB, rows] bags for a tail batch — hashed once and put
-        as ONE transfer per (batch, window)."""
-        key = (tis[0], -1 - it.start)     # distinct keyspace from window_bag
-        dev = bag_cache.get(key)
-        if dev is None:
-            dev = _shard_rows_batch(
-                np.stack([host_bag(t, it) for t in tis]), mesh)
             if it.resident:      # tail bags would grow with the dataset
                 bag_cache[key] = dev
         return dev
@@ -1880,6 +2536,8 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
 
     ti = len(trees) + len(pending_rf)
     mega = None                 # fully-resident: ONE coalesced row block
+    thi_tlo = None              # device Poisson thresholds (tail batches)
+    sb_drains = 0               # super-batch drains (faults site ordinal)
     while ti < settings.n_trees:
         bag_cache.clear()
         if mega is None and cache.warmed and cache.tail is None:
@@ -1928,19 +2586,51 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                 checkpoint_fn(trees, history, None)
             ti += 1
             continue
-        # disk-tail regime: grow a BATCH of independent trees per sweep —
-        # the reference's DTMaster grows ALL RF trees simultaneously, one
-        # stats pass per level for the whole forest (``DTMaster.java:91``
-        # toDoQueue spans trees); per-tree sweeps would re-stream the
-        # disk tail TreeNum times per level.  Bit-identical to the
-        # per-tree order: bags are stateless per (tree, row) and oob
-        # votes chain through the batch in tree order per window.
-        TB = min(settings.n_trees - ti, RF_TAIL_TREE_BATCH)
+        # disk-tail regime: grow a SUPER-BATCH of independent trees per
+        # sweep — the reference's DTMaster grows ALL RF trees
+        # simultaneously, one stats pass per level for the whole forest
+        # (``DTMaster.java:91`` toDoQueue spans trees); per-tree sweeps
+        # would re-stream the disk tail TreeNum times per level.  The
+        # batch width is budget-derived (:func:`_tail_super_batch`, the
+        # TailTreeBatch knob) so disk passes per tree scale as
+        # (depth+2)/SB; bags hash ON DEVICE from two [W] uint32 index
+        # halves per window (bit-identical to the host stream, and the
+        # [SB, W] bag plane never rides the wire); levels > 0 accumulate
+        # LEFT children only and derive right = parent - left, and the
+        # bottom level is a leaf-sum dot instead of the deepest
+        # histogram.  Bit-identical to the per-tree order: bags are
+        # stateless per (tree, row) and oob votes chain through the
+        # batch in tree order per window.
+        from ..ops.hashing import row_key_u32, split_index_u32, \
+            thresholds_u32
+        n_stats = K if mc else 2
+        SB = _tail_super_batch(settings, c, n_bins, n_stats)
+        if thi_tlo is None:
+            t_hi, t_lo = thresholds_u32(settings.bagging_rate)
+            thi_tlo = (jnp.asarray(t_hi), jnp.asarray(t_lo))
+        thi_d, tlo_d = thi_tlo
+
+        def window_idx(it):
+            """Device uint32 (hi, lo) halves of the window's global row
+            indices — cached for resident windows, recomputed for tail
+            re-streams (two [W] puts, ~TB x cheaper than bag planes)."""
+            pair = it.arrays.get("idx32") if it.resident else None
+            if pair is None:
+                ih, il = split_index_u32(np.asarray(it.index, np.uint64))
+                pair = (_shard_rows(ih, mesh), _shard_rows(il, mesh))
+                if it.resident:
+                    it.arrays["idx32"] = pair
+            return pair
+
+        TB = min(settings.n_trees - ti, SB)
         if checkpoint_fn and settings.checkpoint_every:
             nxt = ((ti // settings.checkpoint_every) + 1) * \
                 settings.checkpoint_every
             TB = max(1, min(TB, nxt - ti))
         tis = list(range(ti, ti + TB))
+        keys = [row_key_u32(settings.seed, 5000 + t) for t in tis]
+        khi_b = jnp.asarray(np.asarray([k[0] for k in keys], np.uint32))
+        klo_b = jnp.asarray(np.asarray([k[1] for k in keys], np.uint32))
         fa_b = jnp.asarray(np.stack(
             [np.asarray(_feat_subset(settings, c, t)) for t in tis]))
         sf_b = jnp.full((TB, total), -1, jnp.int32)
@@ -1949,32 +2639,57 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                          jnp.float32)
         cnt_b = jnp.ones(TB, jnp.int32)
         fi_b = jnp.zeros((TB, c), jnp.float32)
-        n_stats = K if mc else 2
-        for level in range(settings.depth + 1):
+        hist_prev = None
+        for level in range(settings.depth):
             n_nodes = 1 << level
-            hist_b = jnp.zeros((TB, n_nodes, c, n_bins, n_stats),
+            left = level > 0
+            width = n_nodes // 2 if left else n_nodes
+            hist_b = jnp.zeros((TB, width, c, n_bins, n_stats),
                                jnp.float32)
             for it in cache.items():
+                ih_d, il_d = window_idx(it)
                 hist_b = _rf_window_hist_batch(
                     hist_b, it.arrays["bins"], it.arrays["y"],
-                    it.arrays["w"], window_bags(tis, it), sf_b, lm_b,
-                    n_nodes, n_bins, level, up, _hist_mesh(mesh),
-                    settings.n_classes, settings.stats_exact)
+                    it.arrays["w"], ih_d, il_d, khi_b, klo_b, thi_d,
+                    tlo_d, sf_b, lm_b, width, n_bins, level, up,
+                    _hist_mesh(mesh), settings.n_classes,
+                    settings.stats_exact, left,
+                    settings.poisson_bagging)
+            if left:
+                feat_prev_b = jax.lax.dynamic_slice_in_dim(
+                    sf_b, width - 1, width, axis=1)
+                hist_b = _derive_level_batch(hist_prev, hist_b,
+                                             feat_prev_b, n_nodes)
             sf_b, lm_b, lv_b, cnt_b, fi_b = _tree_level_step_batch(
                 hist_b, cat, fa_b, settings.impurity,
                 settings.min_instances, settings.min_gain, hc, level,
                 settings.depth, settings.max_leaves, sf_b, lm_b, lv_b,
                 cnt_b, fi_b, settings.n_classes)
+            hist_prev = hist_b
+        # bottom level: leaf-sum dots, one sweep
+        raw_b = jnp.zeros((TB, n_stats, 1 << settings.depth),
+                          jnp.float32)
+        for it in cache.items():
+            ih_d, il_d = window_idx(it)
+            raw_b = _rf_window_leaf_batch(
+                raw_b, it.arrays["bins"], it.arrays["y"],
+                it.arrays["w"], ih_d, il_d, khi_b, klo_b, thi_d, tlo_d,
+                sf_b, lm_b, settings.depth, settings.n_classes,
+                settings.poisson_bagging)
+        lv_b = _set_bottom_leaves_batch(lv_b, raw_b, settings.depth,
+                                        settings.n_classes)
         # one more sweep: oob votes + error sums for the whole batch,
         # trees chained in order per window
         sums_b = jnp.zeros((TB, 4), jnp.float32)
         for it in cache.items():
             osw, ocw = window_oob(it)
+            ih_d, il_d = window_idx(it)
             osw, ocw, sums_b = _rf_window_update_batch(
                 sums_b, it.arrays["bins"], it.arrays["y"],
-                it.arrays["w"], window_bags(tis, it), osw, ocw,
-                sf_b, lm_b, lv_b, settings.depth, settings.loss,
-                settings.n_classes)
+                it.arrays["w"], ih_d, il_d, khi_b, klo_b, thi_d, tlo_d,
+                osw, ocw, sf_b, lm_b, lv_b, settings.depth,
+                settings.loss, settings.n_classes,
+                settings.poisson_bagging)
             if it.resident:
                 it.arrays["oob"] = (osw, ocw)
             else:
@@ -1989,8 +2704,10 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                 progress(t, tr_err, va_err)
         mark_progress_rf()
         ti += TB
+        sb_drains += 1
+        faults.fire("train", "superbatch", sb_drains)
         if checkpoint_fn and settings.checkpoint_every:
-            # every tail batch is a TreeBatch boundary — commit it
+            # every super-batch drain is a commit boundary
             checkpoint_fn(trees, history, None)
     flush_progress_rf()
     spec_kwargs: Dict[str, Any] = {"algorithm": "RF"}
@@ -2002,7 +2719,9 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
         valid_error=history[-1][1] if history else float("nan"),
         feature_importance=np.asarray(fi_dev, np.float64),
         trees_built=len(trees), history=history,
-        disk_passes=cache.disk_passes)
+        disk_passes=cache.disk_passes,
+        tail_sweeps=cache.tail_sweeps,
+        bytes_read=stream.bytes_read - bytes0)
 
 
 # -------------------------------------------------------- pipeline driver

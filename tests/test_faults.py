@@ -514,3 +514,116 @@ def test_nn_crash_resume_bit_identical(prepared_set):
         for k in lc:
             assert np.asarray(lc[k]).tobytes() == \
                 np.asarray(lr[k]).tobytes(), k
+
+
+# ----------------------------------------- disk-tail super-batch drains
+def _write_tail_shards(d, n=1024, c=6, n_bins=8, seed=3):
+    from shifu_tpu.data.shards import Shards
+    rng = np.random.default_rng(seed)
+    bins = rng.integers(0, n_bins - 1, size=(n, c)).astype(np.int16)
+    logit = (bins[:, 0] - 3) * 0.8 + (bins[:, 1] == 2) * 1.5 - 0.5
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    w = np.ones(n, np.float32)
+    os.makedirs(d, exist_ok=True)
+    shard = 0
+    for s in range(0, n, 300):
+        e = min(s + 300, n)
+        np.savez(os.path.join(d, f"part-{shard:05d}.npz"),
+                 bins=bins[s:e], y=y[s:e], w=w[s:e])
+        shard += 1
+    with open(os.path.join(d, "schema.json"), "w") as f:
+        json.dump({"columnNums": list(range(c)), "numShards": shard,
+                   "numRows": n}, f)
+    return Shards.open(d)
+
+
+def _tail_forest_equal(a_trees, b_trees):
+    assert len(a_trees) == len(b_trees)
+    for ta, tb in zip(a_trees, b_trees):
+        assert np.asarray(ta.split_feat).tobytes() == \
+            np.asarray(tb.split_feat).tobytes()
+        assert np.asarray(ta.left_mask).tobytes() == \
+            np.asarray(tb.left_mask).tobytes()
+        assert np.asarray(ta.leaf_value).tobytes() == \
+            np.asarray(tb.leaf_value).tobytes()
+
+
+def test_gbt_tail_superbatch_crash_resume_bit_identical(tmp_path,
+                                                        monkeypatch):
+    """Kill the coarse-to-fine tail at a super-batch drain (the new
+    ``train:superbatch`` site); resuming from the drain-boundary
+    checkpoint must reproduce the uninterrupted forest bit-identically
+    (checkpoint commits only trees whose score updates are final)."""
+    from shifu_tpu.data.streaming import ShardStream
+    from shifu_tpu.train.dt_trainer import DTSettings, train_gbt_streamed
+
+    monkeypatch.setenv("SHIFU_TREE_TAIL_C2F", "1")
+    shards = _write_tail_shards(str(tmp_path / "s"))
+    budget = 2 * 256 * (6 * 1 + 3 * 4) + 64
+    mk = lambda: ShardStream(shards, ("bins", "y", "w"), window_rows=256)
+    settings = DTSettings(n_trees=10, depth=3, loss="log", seed=0,
+                          checkpoint_every=3)
+
+    control = train_gbt_streamed(mk(), 8, None, settings,
+                                 cache_budget=budget)
+    assert control.trees_built == 10
+
+    saved = {}
+
+    def ckpt(trees, history, init_score, scores=None):
+        saved.update(trees=list(trees), history=list(history),
+                     init=init_score,
+                     scores=None if scores is None else scores.copy())
+
+    set_faults("train:superbatch=2:ioerror")
+    with pytest.raises(faults.InjectedFault):
+        train_gbt_streamed(mk(), 8, None, settings, cache_budget=budget,
+                           checkpoint_fn=ckpt)
+    assert 0 < len(saved["trees"]) < 10      # a mid-forest drain commit
+
+    set_faults("")
+    resumed = train_gbt_streamed(
+        mk(), 8, None, settings, cache_budget=budget,
+        init_trees=saved["trees"], init_score=saved["init"],
+        start_history=saved["history"], init_scores=saved["scores"])
+    assert resumed.trees_built == 10
+    _tail_forest_equal(control.trees, resumed.trees)
+    np.testing.assert_allclose(np.array(control.history),
+                               np.array(resumed.history), rtol=1e-5)
+
+
+def test_rf_tail_superbatch_crash_resume_bit_identical(tmp_path):
+    """Same site, RF flavor: every tail super-batch is a commit boundary;
+    a crash between drains resumes from the last committed batch and the
+    regrown forest is bit-identical (hash bags are stateless per
+    (tree, row))."""
+    from shifu_tpu.data.streaming import ShardStream
+    from shifu_tpu.train.dt_trainer import DTSettings, train_rf_streamed
+
+    shards = _write_tail_shards(str(tmp_path / "s"))
+    budget = 2 * 256 * (6 * 1 + 2 * 4) + 64
+    mk = lambda: ShardStream(shards, ("bins", "y", "w"), window_rows=256)
+    settings = DTSettings(n_trees=6, depth=3, impurity="entropy",
+                          loss="squared", seed=2, tail_tree_batch=2)
+
+    control = train_rf_streamed(mk(), 8, None, settings,
+                                cache_budget=budget)
+    assert control.trees_built == 6
+
+    saved = {}
+
+    def ckpt(trees, history, init_score, scores=None):
+        saved.update(trees=list(trees), history=list(history))
+
+    set_faults("train:superbatch=2:ioerror")
+    with pytest.raises(faults.InjectedFault):
+        train_rf_streamed(mk(), 8, None, settings, cache_budget=budget,
+                          checkpoint_fn=ckpt)
+    assert len(saved["trees"]) == 2          # batch-1 commit only
+
+    set_faults("")
+    resumed = train_rf_streamed(
+        mk(), 8, None, settings, cache_budget=budget,
+        init_trees=saved["trees"], start_history=saved["history"])
+    assert resumed.trees_built == 6
+    _tail_forest_equal(control.trees, resumed.trees)

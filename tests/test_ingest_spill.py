@@ -308,3 +308,97 @@ def test_bench_tail_plane_schema():
     assert callable(bench_mod.bench_gbt_streamed_tail)
     with pytest.raises(ValueError):
         bench_mod.run_benchmark(plane="nope")
+
+
+def test_tail_super_batch_disk_pass_telemetry_guard(tmp_path, monkeypatch):
+    """Round-9 regression guard, telemetry-backed: under the super-batch
+    tail schedule, passes per tree must stay within the acceptance bound
+    (RF: ceil(depth/SB)+1; GBT exact: depth+2) — any future change that
+    silently reintroduces per-(depth x tree) re-streams fails here."""
+    import math
+
+    from shifu_tpu import obs
+    from shifu_tpu.data.streaming import ShardStream
+    from shifu_tpu.train.dt_trainer import (DTSettings, train_gbt_streamed,
+                                            train_rf_streamed)
+    shards, *_ = _write_shards(str(tmp_path / "s"), 1024, shard_rows=300)
+    budget = 2 * 256 * (6 * 1 + 3 * 4) + 64     # ~2 of 4 windows resident
+    n_trees, depth = 6, 3
+    obs.reset_for_tests()
+    obs.set_enabled(True)
+    try:
+        res = train_rf_streamed(
+            ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+            8, None,
+            DTSettings(n_trees=n_trees, depth=depth, impurity="entropy",
+                       loss="squared", seed=2),
+            cache_budget=budget)
+        names = {m["name"]: m for m in obs.snapshot(reset=True)}
+        sweeps = names["train.tail_sweeps"]["value"]
+        assert sweeps == res.tail_sweeps > 0
+        assert sweeps / n_trees <= math.ceil(depth / n_trees) + 1
+        assert names["ingest.disk_passes"]["value"] == 1 + sweeps
+
+        monkeypatch.setenv("SHIFU_TREE_TAIL_C2F", "0")
+        res_g = train_gbt_streamed(
+            ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+            8, None, DTSettings(n_trees=2, depth=depth, loss="log"),
+            cache_budget=budget)
+        names = {m["name"]: m for m in obs.snapshot()}
+        assert names["train.tail_sweeps"]["value"] == res_g.tail_sweeps \
+            == 2 * (depth + 2)
+    finally:
+        obs.reset_for_tests()
+
+
+def test_report_renders_tail_sweep_line(tmp_path):
+    """The v4 tail-plane line: sweep count, disk passes and speculation
+    repairs surface in `analysis --telemetry`."""
+    from shifu_tpu import obs
+    from shifu_tpu.obs.report import render_telemetry
+    obs.reset_for_tests()
+    obs.set_enabled(True)
+    try:
+        with obs.span("train", kind="step"):
+            obs.counter("train.tail_sweeps").inc(12)
+            obs.counter("ingest.disk_passes").inc(13)
+            obs.counter("train.tail_repairs").inc(2)
+            obs.counter("train.tail_repair_levels").inc(5)
+            obs.counter("ingest.h2d_wait_seconds").inc(0.1)
+        obs.flush(os.path.join(str(tmp_path), "telemetry", "trace.jsonl"),
+                  step="train")
+        text = render_telemetry(str(tmp_path))
+        assert "tail sweeps: 12" in text
+        assert "13 disk passes" in text
+        assert "2 speculation repairs over 5 levels" in text
+        assert "ingest stall fraction" in text
+    finally:
+        obs.reset_for_tests()
+
+
+def test_bench_cli_tail_help_and_schema_exit(monkeypatch):
+    """CI smoke for the tail plane CLI: --help lists it, and a bench/obs
+    schema-version mismatch exits NONZERO (code 2) instead of tracing
+    out — the guard CI keys off."""
+    import importlib.util
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--help"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0
+    assert "tail" in out.stdout
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_cli", os.path.join(repo, "bench.py"))
+    bench_cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_cli)
+    import shifu_tpu.bench as bench_mod
+    monkeypatch.setattr(bench_mod, "BENCH_TELEMETRY_SCHEMA",
+                        bench_mod.BENCH_TELEMETRY_SCHEMA + 1)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--plane", "tail"])
+    with pytest.raises(SystemExit) as ei:
+        bench_cli.main()
+    assert ei.value.code == 2

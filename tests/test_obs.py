@@ -264,13 +264,16 @@ def test_bench_schema_matches_obs():
     """bench.py must fail loudly when its emitted schema version and the
     obs schema diverge — this pin is the loud failure's test double.
     v3 added the varsel_* extras (streamed mask-batched sensitivity
-    plane): the version must be current AND the plane registered, so a
-    schema bump cannot land without the varsel emission being
-    re-validated."""
-    from shifu_tpu.bench import BENCH_TELEMETRY_SCHEMA, bench_varsel
+    plane); v4 the disk-tail super-batch round (tail_* extras +
+    train.tail_sweeps / tail_repairs counters): the version must be
+    current AND the planes registered, so a schema bump cannot land
+    without the emissions being re-validated."""
+    from shifu_tpu.bench import (BENCH_TELEMETRY_SCHEMA,
+                                 bench_gbt_streamed_tail, bench_varsel)
     assert BENCH_TELEMETRY_SCHEMA == obs.SCHEMA_VERSION
-    assert BENCH_TELEMETRY_SCHEMA >= 3          # varsel_* extras era
+    assert BENCH_TELEMETRY_SCHEMA >= 4          # tail_* extras era
     assert callable(bench_varsel)
+    assert callable(bench_gbt_streamed_tail)
 
 
 def test_bench_refuses_schema_mismatch(monkeypatch):

@@ -407,3 +407,174 @@ def test_rf_fused_matches_tail_restream(tmp_path):
                                    rtol=1e-4, atol=1e-5)
     for (a, b), (c_, d) in zip(full.history, tail.history):
         assert abs(a - c_) < 1e-5 and abs(b - d) < 1e-5
+
+
+# ------------------------------------------------ super-batched disk tail
+# (round 9: one disk pass feeds everything — exact super-batch schedule
+# with subtraction + leaf-sum bottom, coarse-to-fine speculation behind
+# SHIFU_TREE_TAIL_C2F, and pass-count guards that fail on any future
+# re-stream regression)
+
+GBT_WIN_BYTES = 256 * (6 * 1 + 3 * 4)     # uint8 bins + y/tw/vw f32
+RF_WIN_BYTES = 256 * (6 * 1 + 2 * 4)      # uint8 bins + y/w f32
+
+
+def _forests_bitwise_equal(a, b):
+    assert len(a.trees) == len(b.trees)
+    for ta, tb in zip(a.trees, b.trees):
+        assert np.asarray(ta.split_feat).tobytes() == \
+            np.asarray(tb.split_feat).tobytes()
+        assert np.asarray(ta.left_mask).tobytes() == \
+            np.asarray(tb.left_mask).tobytes()
+        assert np.asarray(ta.leaf_value).tobytes() == \
+            np.asarray(tb.leaf_value).tobytes()
+
+
+def test_tail_exact_super_batch_matches_resident(tmp_path, monkeypatch):
+    """The exact super-batch tail schedule (c2f off) must reproduce the
+    fully-resident forest: STRUCTURE bit-identical, leaf values
+    f32-equivalent (the resident run sums each histogram in one fused
+    block, the tail run as resident-block + window partials — same
+    associativity class, different f32 grouping)."""
+    from shifu_tpu.data.streaming import ShardStream
+    from shifu_tpu.train.dt_trainer import DTSettings, train_gbt_streamed
+
+    monkeypatch.setenv("SHIFU_TREE_TAIL_C2F", "0")
+    bins, y, w = _tree_data(n=1024)
+    shards = _write_tree_shards(str(tmp_path / "s"), bins, y, w)
+    settings = DTSettings(n_trees=4, depth=3, loss="log", seed=0)
+    full = train_gbt_streamed(
+        ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+        8, None, settings, cache_budget=1 << 30)
+    tail = train_gbt_streamed(
+        ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+        8, None, settings, cache_budget=2 * GBT_WIN_BYTES + 64)
+    for tf, tt in zip(full.trees, tail.trees):
+        np.testing.assert_array_equal(tf.split_feat, tt.split_feat)
+        np.testing.assert_array_equal(tf.left_mask, tt.left_mask)
+        np.testing.assert_allclose(tf.leaf_value, tt.leaf_value,
+                                   rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.array(full.history),
+                               np.array(tail.history), rtol=1e-5)
+
+
+def test_tail_c2f_bitwise_matches_exact(tmp_path, monkeypatch):
+    """Coarse-to-fine speculation (repairs included) is a SCHEDULE, not a
+    model change: the forest must be bit-identical to the exact tail
+    schedule, with strictly fewer tail re-streams."""
+    from shifu_tpu.data.streaming import ShardStream
+    from shifu_tpu.train.dt_trainer import DTSettings, train_gbt_streamed
+
+    bins, y, w = _tree_data(n=1024)
+    shards = _write_tree_shards(str(tmp_path / "s"), bins, y, w)
+    settings = DTSettings(n_trees=5, depth=3, loss="log", seed=0)
+    budget = 2 * GBT_WIN_BYTES + 64
+
+    monkeypatch.setenv("SHIFU_TREE_TAIL_C2F", "0")
+    exact = train_gbt_streamed(
+        ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+        8, None, settings, cache_budget=budget)
+    monkeypatch.setenv("SHIFU_TREE_TAIL_C2F", "1")
+    c2f = train_gbt_streamed(
+        ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+        8, None, settings, cache_budget=budget)
+    _forests_bitwise_equal(exact, c2f)
+    np.testing.assert_allclose(np.array(exact.history),
+                               np.array(c2f.history), rtol=1e-5)
+    # the schedule guarantee: exact pays (depth+2) re-streams per tree,
+    # speculation must beat it (repairs included)
+    assert exact.tail_sweeps == settings.n_trees * (settings.depth + 2)
+    assert c2f.tail_sweeps < exact.tail_sweeps
+
+
+def test_tail_c2f_candidate_k_covering_matches_exact(tmp_path,
+                                                     monkeypatch):
+    """Bounded-candidate scan at K that covers every split the exact
+    trees use (a constant column can never be chosen, so K = C-1 covers
+    all) must stay bit-identical to the exact schedule — the documented
+    exactness contract of -Dshifu.tree.tailCandidateK."""
+    from shifu_tpu.config import environment
+    from shifu_tpu.data.streaming import ShardStream
+    from shifu_tpu.train.dt_trainer import DTSettings, train_gbt_streamed
+
+    bins, y, w = _tree_data(n=1024)
+    bins = bins.copy()
+    bins[:, 5] = 0                     # constant -> zero gain everywhere
+    shards = _write_tree_shards(str(tmp_path / "s"), bins, y, w)
+    settings = DTSettings(n_trees=3, depth=3, loss="log", seed=0)
+    budget = 2 * GBT_WIN_BYTES + 64
+
+    monkeypatch.setenv("SHIFU_TREE_TAIL_C2F", "0")
+    exact = train_gbt_streamed(
+        ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+        8, None, settings, cache_budget=budget)
+    monkeypatch.setenv("SHIFU_TREE_TAIL_C2F", "1")
+    environment.set_property("shifu.tree.tailCandidateK", "5")
+    try:
+        c2f = train_gbt_streamed(
+            ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+            8, None, settings, cache_budget=budget)
+    finally:
+        environment.set_property("shifu.tree.tailCandidateK", "")
+    _forests_bitwise_equal(exact, c2f)
+
+
+def test_tail_disk_passes_relation(tmp_path, monkeypatch):
+    """disk_passes must stay = 1 warm pass + tail_sweeps (no hidden full
+    re-streams), and bytes_read must be accounted per run."""
+    from shifu_tpu.data.streaming import ShardStream
+    from shifu_tpu.train.dt_trainer import DTSettings, train_gbt_streamed
+
+    monkeypatch.setenv("SHIFU_TREE_TAIL_C2F", "0")
+    bins, y, w = _tree_data(n=1024)
+    shards = _write_tree_shards(str(tmp_path / "s"), bins, y, w)
+    settings = DTSettings(n_trees=2, depth=3, loss="log", seed=0)
+    res = train_gbt_streamed(
+        ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+        8, None, settings, cache_budget=2 * GBT_WIN_BYTES + 64)
+    assert res.disk_passes == 1 + res.tail_sweeps
+    assert res.bytes_read > 0
+
+
+def test_rf_tail_super_batch_width_invariance_and_bounds(tmp_path,
+                                                         monkeypatch):
+    """RF: one super-batch feeds (depth+2) tail sweeps for ALL its trees;
+    the batch width must not change the forest (bags are stateless per
+    (tree, row), oob chains in tree order), and passes per tree obey the
+    ceil(depth/SB)+1 acceptance bound."""
+    import math
+
+    from shifu_tpu.data.streaming import ShardStream
+    from shifu_tpu.train.dt_trainer import DTSettings, train_rf_streamed
+
+    bins, y, w = _tree_data(n=1024)
+    shards = _write_tree_shards(str(tmp_path / "s"), bins, y, w)
+    budget = 2 * RF_WIN_BYTES + 64
+    n_trees, depth = 6, 3
+
+    wide = DTSettings(n_trees=n_trees, depth=depth, impurity="entropy",
+                      loss="squared", seed=2)
+    res_w = train_rf_streamed(
+        ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+        8, None, wide, cache_budget=budget)
+    # auto super-batch >= n_trees here: the whole forest is ONE batch —
+    # depth+2 sweeps total, the first (level 0) riding the warm pass
+    assert res_w.tail_sweeps == depth + 1
+    sb = n_trees
+    assert res_w.tail_sweeps / n_trees <= math.ceil(depth / sb) + 1
+
+    narrow = DTSettings(n_trees=n_trees, depth=depth, impurity="entropy",
+                        loss="squared", seed=2, tail_tree_batch=2)
+    res_n = train_rf_streamed(
+        ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+        8, None, narrow, cache_budget=budget)
+    assert res_n.tail_sweeps == (depth + 1) + 2 * (depth + 2)
+    _forests_bitwise_equal(res_w, res_n)
+
+    # env beats auto: SHIFU_TAIL_TREE_BATCH
+    monkeypatch.setenv("SHIFU_TAIL_TREE_BATCH", "3")
+    res_e = train_rf_streamed(
+        ShardStream(shards, ("bins", "y", "w"), window_rows=256),
+        8, None, wide, cache_budget=budget)
+    assert res_e.tail_sweeps == (depth + 1) + (depth + 2)
+    _forests_bitwise_equal(res_w, res_e)
