@@ -8,6 +8,12 @@ Runs on whatever jax.devices() offers (one real TPU chip under the driver).
 out-of-core ingest path) — seconds instead of minutes, for iterating on
 the spill-cache / H2D pipeline in isolation.
 
+``--compare OLD.json NEW.json [--threshold 0.9]`` runs NO benchmark:
+it diffs two recorded payloads (raw bench output or the driver's
+BENCH_r0N wrappers) metric-by-metric, prints a regression table, and
+exits 2 when any tracked throughput metric fell below threshold x old —
+the reader for the in-repo BENCH_r01..r05 trajectory.
+
 With SHIFU_TPU_TELEMETRY=1 the per-plane numbers also land as a telemetry
 JSONL block under ./telemetry/ (same schema as the pipeline steps — the
 schema-version handshake is enforced inside run_benchmark, which fails
@@ -20,9 +26,6 @@ import sys
 
 
 def main() -> None:
-    from shifu_tpu import obs
-    from shifu_tpu.bench import run_benchmark
-
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--plane",
                     choices=("all", "tail", "rf-repeat", "e2e", "resume",
@@ -39,7 +42,25 @@ def main() -> None:
                          "streamed mask-batched SE sensitivity vs the "
                          "single-worker per-column loop at identical "
                          "selections")
+    ap.add_argument("--compare", nargs=2, metavar=("OLD.json", "NEW.json"),
+                    default=None,
+                    help="regression-diff two bench payloads (raw JSON "
+                         "lines or BENCH_r0N wrappers) metric-by-metric; "
+                         "exits 2 when any tracked throughput metric "
+                         "falls below --threshold x old — runs NO "
+                         "benchmark")
+    ap.add_argument("--threshold", type=float, default=0.9,
+                    help="--compare regression threshold (default 0.9: "
+                         "new >= 0.9 x old passes)")
     args = ap.parse_args()
+
+    if args.compare:
+        from shifu_tpu.bench import run_compare
+        sys.exit(run_compare(args.compare[0], args.compare[1],
+                             threshold=args.threshold))
+
+    from shifu_tpu import obs
+    from shifu_tpu.bench import run_benchmark
 
     try:
         result = run_benchmark(plane=args.plane)
@@ -51,6 +72,10 @@ def main() -> None:
             sys.exit(2)
         raise
     if obs.enabled():
+        # the bench gauges land in BOTH formats: the JSONL trace block
+        # and the same OpenMetrics/JSON snapshot the steps export, so an
+        # external scraper and BENCH_r0N consumers read one schema
+        obs.write_metrics_files("telemetry", step="BENCH")
         obs.flush("telemetry/trace.jsonl", step="BENCH",
                   extra_meta={"headline": result["metric"]})
     print(json.dumps(result))

@@ -33,7 +33,11 @@ from . import obs
 # v4: disk-tail super-batch round — tail_* extras (disk passes / tail
 # sweeps / bytes read PER TREE, dual-schedule c2f vs exact rates, RF
 # super-batch width) + train.tail_sweeps / tail_repairs counters.
-BENCH_TELEMETRY_SCHEMA = 4
+# v5: observability plane v2 — span/event records carry tid (ingest
+# track), drift.* gauges, health heartbeats + OpenMetrics snapshots
+# derive from the same registry records; bench gains --compare (the
+# BENCH_r0N regression differ, which parses exactly these payloads).
+BENCH_TELEMETRY_SCHEMA = 5
 
 # measured on this rig (tools/measure_baseline.py); provenance in
 # BASELINE.md — every headline divides by a MEASURED reference-class
@@ -885,6 +889,104 @@ def bench_varsel(n_rows: int = 1 << 15, n_features: int = 256,
         "varsel_shape": f"{n_rows} rows x {n_features} feats, "
                         f"{n_candidates} candidates, top {filter_num}",
     }
+
+
+# --------------------------------------------------------------- compare
+# `bench.py --compare OLD.json NEW.json [--threshold 0.9]`: the
+# BENCH_r01..r05 trajectory exists in-repo but nothing read it — this is
+# the reader.  Diffs two bench payloads metric-by-metric and exits 2
+# when any TRACKED THROUGHPUT metric fell below threshold x old, so a
+# perf regression fails CI instead of quietly becoming the new normal.
+
+def load_bench_file(path: str) -> Dict[str, Any]:
+    """A bench payload from either shape on disk: the raw JSON line
+    ``bench.py`` prints, or the driver's BENCH_r0N wrapper (``{"n", ...,
+    "parsed": {...}}``)."""
+    import json
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if not isinstance(doc, dict) or "metric" not in doc:
+        raise ValueError(f"{path} is not a bench payload "
+                         "(no 'metric' key)")
+    return doc
+
+
+def bench_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
+    """Flatten a payload to {metric: value}: the headline plus every
+    numeric top-level extra."""
+    out: Dict[str, float] = {}
+    if isinstance(doc.get("value"), (int, float)):
+        out[str(doc["metric"])] = float(doc["value"])
+    for k, v in (doc.get("extra") or {}).items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[str(k)] = float(v)
+    return out
+
+
+def is_tracked_throughput(name: str) -> bool:
+    """Throughput metrics gate the compare (higher = better; ratios,
+    shapes, and wall-clock extras inform but never fail)."""
+    if name.endswith("_vs_baseline") or name.endswith("_error"):
+        return False
+    return "throughput" in name or name.endswith("_per_sec")
+
+
+def compare_bench(old: Dict[str, Any], new: Dict[str, Any],
+                  threshold: float = 0.9):
+    """(rows, regressed): per-metric diff rows sorted tracked-first, and
+    the tracked metrics whose new value fell below threshold x old."""
+    om, nm = bench_metrics(old), bench_metrics(new)
+    rows, regressed = [], []
+    for name in sorted(set(om) | set(nm),
+                       key=lambda n: (not is_tracked_throughput(n), n)):
+        ov, nv = om.get(name), nm.get(name)
+        tracked = is_tracked_throughput(name)
+        ratio = (nv / ov) if (ov and nv is not None) else None
+        flag = ""
+        if tracked and ov and nv is not None and nv < threshold * ov:
+            flag = "REGRESSED"
+            regressed.append(name)
+        elif ov is None:
+            flag = "new"
+        elif nv is None:
+            flag = "gone"
+        rows.append({"metric": name, "old": ov, "new": nv, "ratio": ratio,
+                     "tracked": tracked, "flag": flag})
+    return rows, regressed
+
+
+def format_compare_table(rows, threshold: float) -> str:
+    def num(v):
+        return "-" if v is None else f"{v:,.1f}"
+    out = [f"{'metric':<46}{'old':>16}{'new':>16}{'ratio':>8}  flag",
+           "-" * 92]
+    for r in rows:
+        ratio = "-" if r["ratio"] is None else f"{r['ratio']:.3f}"
+        mark = "*" if r["tracked"] else " "
+        out.append(f"{mark}{r['metric']:<45}{num(r['old']):>16}"
+                   f"{num(r['new']):>16}{ratio:>8}  {r['flag']}")
+    out.append(f"(* = tracked throughput metric; REGRESSED = new < "
+               f"{threshold} x old)")
+    return "\n".join(out)
+
+
+def run_compare(old_path: str, new_path: str,
+                threshold: float = 0.9, _print=print) -> int:
+    """The `--compare` entry: print the regression table, return the
+    exit code (0 clean, 2 = tracked throughput regression)."""
+    old, new = load_bench_file(old_path), load_bench_file(new_path)
+    rows, regressed = compare_bench(old, new, threshold=threshold)
+    _print(f"bench compare: {old_path} -> {new_path} "
+           f"(threshold {threshold})")
+    _print(format_compare_table(rows, threshold))
+    if regressed:
+        _print(f"REGRESSION: {len(regressed)} tracked metric(s) below "
+               f"{threshold} x old: {', '.join(regressed)}")
+        return 2
+    _print("no tracked throughput regressions")
+    return 0
 
 
 def _check_schema_handshake() -> None:

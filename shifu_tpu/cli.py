@@ -113,12 +113,30 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("analysis", help="model spec analysis "
                         "(-fi MODEL: tree feature importance; --telemetry: "
-                        "render the last run's span/metric trace)")
+                        "render the last run's span/metric trace; "
+                        "--telemetry --timeline OUT: export a Chrome/"
+                        "Perfetto trace_event timeline)")
     sp.add_argument("-fi", dest="fi_model", metavar="MODELPATH")
     sp.add_argument("-telemetry", "--telemetry", dest="telemetry_report",
                     action="store_true",
                     help="render <modelset>/telemetry/trace.jsonl as a "
                     "per-step span tree with self-time and rows/sec")
+    sp.add_argument("-timeline", "--timeline", dest="timeline_out",
+                    metavar="OUT.json", default=None,
+                    help="with --telemetry: convert the trace to Chrome "
+                    "trace_event JSON (load in chrome://tracing or "
+                    "ui.perfetto.dev; ingest-thread spans get their own "
+                    "track)")
+
+    sp = sub.add_parser("monitor", help="live health monitor: tail "
+                        "<modelset>/telemetry/health/ heartbeats and "
+                        "render per-process step/phase/progress with "
+                        "staleness flags")
+    sp.add_argument("--interval", dest="monitor_interval", type=float,
+                    default=2.0, metavar="S",
+                    help="seconds between frames (default 2)")
+    sp.add_argument("--once", dest="monitor_once", action="store_true",
+                    help="render one frame and exit")
 
     sp = sub.add_parser("test", help="pipeline smoke test on a data sample")
     sp.add_argument("-filter", dest="filter_target", nargs="?", const="",
@@ -243,11 +261,25 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
         return ExportProcessor(args.dir, params=vars(args)).run()
     if cmd == "analysis":
         if getattr(args, "telemetry_report", False):
+            if getattr(args, "timeline_out", None):
+                from .obs.report import NO_TELEMETRY_HINT
+                from .obs.timeline import export_timeline
+                out = export_timeline(args.dir, args.timeline_out)
+                if out is None:
+                    print(NO_TELEMETRY_HINT)
+                else:
+                    print(f"timeline -> {out}  (load in chrome://tracing "
+                          "or https://ui.perfetto.dev)")
+                return 0
             from .obs.report import render_telemetry
             print(render_telemetry(args.dir))
             return 0
         from .pipeline.analysis import analyze_model_fi
         return analyze_model_fi(args.fi_model)
+    if cmd == "monitor":
+        from .obs.monitor import run_monitor
+        return run_monitor(args.dir, interval_s=args.monitor_interval,
+                           once=args.monitor_once)
     if cmd == "test":
         from .pipeline.smoke import SmokeTestProcessor
         return SmokeTestProcessor(args.dir, params=vars(args)).run()

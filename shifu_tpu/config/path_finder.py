@@ -168,6 +168,26 @@ class PathFinder:
     def telemetry_trace_path(self) -> str:
         return os.path.join(self.telemetry_dir, "trace.jsonl")
 
+    @property
+    def health_dir(self) -> str:
+        """Per-process heartbeat files (``obs/health``) — the live
+        progress surface ``shifu-tpu monitor`` tails."""
+        return os.path.join(self.telemetry_dir, "health")
+
+    @property
+    def metrics_prom_path(self) -> str:
+        """OpenMetrics text exposition (``obs/exporter``)."""
+        return os.path.join(self.telemetry_dir, "metrics.prom")
+
+    @property
+    def metrics_json_path(self) -> str:
+        return os.path.join(self.telemetry_dir, "metrics.json")
+
+    @property
+    def drift_path(self) -> str:
+        """Per-column live-PSI table (``obs/drift``)."""
+        return os.path.join(self.telemetry_dir, "drift.json")
+
     # ------------------------------------------------------------- backups
     @property
     def backup_dir(self) -> str:

@@ -303,6 +303,7 @@ class ShardStream:
         mms = {k: rd.memmap(k) for k in self.keys}
         bytes_c = obs.counter("ingest.bytes_read")
         win_c = obs.counter("ingest.windows_emitted")
+        rows_c = obs.counter("ingest.rows_emitted")
         start, g = start_row, g0
         while g < rd.rows:
             e = min(g + W, rd.rows)
@@ -314,6 +315,7 @@ class ShardStream:
             bytes_c.inc(nb)
             self.bytes_read += nb
             win_c.inc()
+            rows_c.inc(nv)
             yield Window(start=start, n_valid=nv, arrays=arrays,
                          src=rd.src_of(g))
             start += W
@@ -340,6 +342,7 @@ class ShardStream:
             W = self.window_rows
             bytes_c = obs.counter("ingest.bytes_read")
             win_c = obs.counter("ingest.windows_emitted")
+            rows_c = obs.counter("ingest.rows_emitted")
 
             def consume(rows: int) -> Tuple[int, int]:
                 """Pop ``rows`` rows off the source list; return the (shard,
@@ -376,6 +379,7 @@ class ShardStream:
                     bytes_c.inc(nb)
                     self.bytes_read += nb
                     win_c.inc()
+                    rows_c.inc(W)
                     yield Window(start=start, n_valid=W, arrays=arrays,
                                  src=consume(W))
                     start += W
@@ -386,6 +390,7 @@ class ShardStream:
                 bytes_c.inc(nb)
                 self.bytes_read += nb
                 win_c.inc()
+                rows_c.inc(buffered)
                 yield Window(start=start, n_valid=buffered,
                              arrays=arrays, src=consume(buffered))
         finally:
@@ -440,10 +445,11 @@ class ShardStream:
             it = self.windows(start_shard, shard_offset, start_row)
             while True:
                 t0 = time.perf_counter()
-                win = next(it, None)
-                if win is None:
-                    return
-                item = _prep(win)
+                with obs.span("ingest.window_prep"):
+                    win = next(it, None)
+                    if win is None:
+                        return
+                    item = _prep(win)
                 wait_c.inc(time.perf_counter() - t0)
                 yield item
             return
@@ -461,22 +467,32 @@ class ShardStream:
             return False
 
         def worker() -> None:
+            # each window's assembly+prep runs under an ingest.window_prep
+            # span — recorded off the main thread, so the timeline export
+            # (obs/timeline) lands them on their own track opposite the
+            # consumer's device-compute spans, making the PR 2/6 overlap
+            # (or the lack of it) visually auditable
             try:
                 for win in self.windows(start_shard, shard_offset,
                                         start_row):
-                    if not put(_prep(win)):
+                    with obs.span("ingest.window_prep", window=win.start,
+                                  rows=win.n_valid):
+                        item = _prep(win)
+                    if not put(item):
                         return
                 put(None)
             except BaseException as e:
                 put(e)
 
-        t = threading.Thread(target=worker, daemon=True)
+        t = threading.Thread(target=worker, daemon=True,
+                             name="shifu-ingest")
         t.start()
         wait_s = 0.0
         try:
             while True:
                 t0 = time.perf_counter()
-                item = q.get()
+                with obs.span("ingest.h2d_wait"):
+                    item = q.get()
                 wait_s += time.perf_counter() - t0
                 if isinstance(item, BaseException):
                     raise item

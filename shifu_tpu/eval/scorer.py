@@ -234,7 +234,7 @@ class ModelRunner:
         tc = self.transformer.transform(chunk)
         res = self.scorer.score(tc.x, bins=tc.bins)
         return {"result": res, "target": tc.target, "weight": tc.weight,
-                "n": tc.n}
+                "n": tc.n, "bins": tc.bins}
 
     def compute_classes(self, chunk) -> Dict[str, np.ndarray]:
         """Multi-class scoring: [n, K] class scores instead of per-model

@@ -33,6 +33,9 @@ Sites/points wired today (grep ``faults.fire`` for the live set):
     spill:append=<k>    spill write-through of shard k
     spill:manifest=0    spill manifest commit
     step:phase=<name>   entering a named processor phase span
+    obs:heartbeat=<b>   before heartbeat b's atomic commit (obs/health) —
+                        a kill here proves a death mid-heartbeat leaves
+                        the previous valid health file, never a torn one
 
 Actions:
 

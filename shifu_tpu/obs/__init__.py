@@ -1,26 +1,43 @@
-"""Pipeline-wide observability: span tracing, metrics, profiler hooks.
+"""Pipeline-wide observability: tracing, metrics, health, drift, export.
 
 The TPU-native replacement for the reference's Hadoop/YARN counters and
-Guagua master logs (``ShifuCLI`` step timing lines, MR job counters): one
-in-process telemetry layer every step processor, trainer, and plane
-reports through, with a JSONL sink under ``<modelset>/telemetry/`` and a
-CLI report surface (``shifu-tpu analysis --telemetry``).
+Guagua master logs (``ShifuCLI`` step timing lines, MR job counters,
+per-worker progress RPC): one in-process telemetry layer every step
+processor, trainer, and plane reports through, with a JSONL sink under
+``<modelset>/telemetry/`` and live + post-hoc CLI surfaces
+(``shifu-tpu monitor``, ``shifu-tpu analysis --telemetry [--timeline]``).
 
-Four modules:
+Modules:
 
 - :mod:`tracer` — nested wall-clock spans (optionally
   ``jax.block_until_ready``-fenced) + point events, thread-safe
-  collector, JSONL sink;
+  collector with a live-span registry, JSONL sink;
 - :mod:`registry` — named counters/gauges/histograms (rows, epochs,
   loss, throughput, device-memory high-water, XLA compile accounting);
+  instruments are thread-safe (ingest prep thread + trainers + the
+  heartbeat/exporter readers share them);
+- :mod:`manifest` — THE declaration of every metric name (a lint test
+  enforces it: a typo'd name cannot silently mint a new metric);
+- :mod:`health` — per-process heartbeat files under
+  ``<modelset>/telemetry/health/`` (atomic, background thread) with
+  live step/phase/progress and a staleness model;
+- :mod:`monitor` — the ``shifu-tpu monitor`` renderer tailing those
+  heartbeats (stale/stalled/straggler flags, quorum summary);
+- :mod:`timeline` — span JSONL -> Chrome/Perfetto ``trace_event`` JSON,
+  ingest-thread spans on their own track;
+- :mod:`exporter` — periodic OpenMetrics-text + JSON registry snapshots
+  (``telemetry/metrics.prom`` / ``metrics.json``);
+- :mod:`drift` — streaming per-column PSI of live binned windows vs the
+  training-time ColumnConfig snapshot (ROADMAP #5's promotion signal);
 - :mod:`profiler` — opt-in ``jax.profiler.trace()`` capture around any
   step (``shifu-tpu <step> --profile [dir]``);
 - :mod:`report` — renders the last run's spans/metrics as a tree with
-  per-step self-time and rows/sec.
+  per-step self-time, rows/sec, ingest-stall / tail / drift sections.
 
 Everything is ZERO-COST when disabled (the default): ``span()`` returns
-a shared no-op singleton, instruments are no-op singletons, no fencing,
-no files.  Enable with env ``SHIFU_TPU_TELEMETRY=1``, property
+a shared no-op singleton, instruments are no-op singletons, heartbeat /
+exporter / drift factories return ``None``, no threads, no fencing, no
+files.  Enable with env ``SHIFU_TPU_TELEMETRY=1``, property
 ``-Dshifu.telemetry=on``, or the per-step ``--telemetry`` flag.
 """
 
@@ -29,4 +46,33 @@ from .registry import (counter, gauge, histogram,             # noqa: F401
                        snapshot, get_registry)
 from .tracer import (SCHEMA_VERSION, enabled, set_enabled,    # noqa: F401
                      fencing_enabled, span, event, fence, flush,
-                     pending_records, reset_for_tests)
+                     pending_records, live_spans, reset_for_tests)
+from .manifest import MANIFEST, PREFIXES, is_declared         # noqa: F401
+from .health import (HeartbeatWriter, start_heartbeat,        # noqa: F401
+                     read_health, classify, health_dir_for,
+                     heartbeat_interval_s)
+from .exporter import (MetricsExporter, start_exporter,       # noqa: F401
+                       render_openmetrics, write_metrics_files,
+                       metric_name)
+from .drift import (DriftMonitor, start_drift_monitor,        # noqa: F401
+                    psi_threshold)
+
+__all__ = [
+    # tracer
+    "SCHEMA_VERSION", "enabled", "set_enabled", "fencing_enabled",
+    "span", "event", "fence", "flush", "pending_records", "live_spans",
+    "reset_for_tests",
+    # registry
+    "counter", "gauge", "histogram", "sample_device_memory",
+    "ensure_compile_listener", "snapshot", "get_registry",
+    # manifest
+    "MANIFEST", "PREFIXES", "is_declared",
+    # health / monitor plane
+    "HeartbeatWriter", "start_heartbeat", "read_health", "classify",
+    "health_dir_for", "heartbeat_interval_s",
+    # exporter
+    "MetricsExporter", "start_exporter", "render_openmetrics",
+    "write_metrics_files", "metric_name",
+    # drift
+    "DriftMonitor", "start_drift_monitor", "psi_threshold",
+]

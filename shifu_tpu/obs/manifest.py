@@ -1,0 +1,97 @@
+"""Metric-name manifest — the ONE registry of declared instrument names.
+
+Every ``obs.counter("...")`` / ``obs.gauge("...")`` /
+``obs.histogram("...")`` call site anywhere in ``shifu_tpu/`` must name a
+metric declared here (or start with a declared dynamic-family prefix).
+A lint-style test (``tests/test_obs_plane.py``) greps the source tree
+and enforces it, because the registry's create-on-first-use convenience
+has a failure mode that is otherwise silent: a typo'd name at one call
+site quietly creates a NEW metric, the dashboards / bench joins keep
+reading the old (now frozen) one, and nothing errors anywhere.
+
+Declaring a metric: ``MANIFEST[name] = (type, help)``.  Families whose
+member names are data-dependent (per-eval-set AUC, bench extras) declare
+a prefix in ``PREFIXES`` instead — f-string call sites must start with
+one of them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+# name -> (instrument type, one-line help)
+MANIFEST: Dict[str, Tuple[str, str]] = {
+    # ---- ingest plane (spill cache / window prep / H2D pipeline)
+    "ingest.bytes_read": ("counter", "bytes materialized into windows"),
+    "ingest.windows_emitted": ("counter", "windows yielded to consumers"),
+    "ingest.rows_emitted": ("counter", "valid rows in emitted windows"),
+    "ingest.h2d_wait_seconds": ("counter",
+                                "consumer time blocked on window prep/H2D"),
+    "ingest.disk_passes": ("counter", "full/tail stream traversals"),
+    "ingest.spill_hits": ("counter", "sweeps served from the mmap spill"),
+    "ingest.spill_misses": ("counter", "sweeps that re-read npz shards"),
+    "ingest.retries": ("counter", "transient IO errors absorbed by retry"),
+    # ---- data hygiene
+    "data.quarantined_rows": ("counter", "rows quarantined as unreadable"),
+    "data.quarantined_shards": ("counter", "shards quarantined as torn"),
+    # ---- stats plane
+    "stats.rows": ("counter", "rows swept by the stats accumulators"),
+    "stats.columns": ("gauge", "columns in the stats sweep"),
+    "stats.rows_per_sec": ("gauge", "stats sweep throughput"),
+    "stats.resumed_chunks": ("counter", "chunks skipped via mid-sweep resume"),
+    # ---- norm plane
+    "norm.rows": ("counter", "rows materialized by norm"),
+    "norm.shards": ("gauge", "shards written by norm"),
+    "norm.rows_per_sec": ("gauge", "norm throughput"),
+    "norm.resumed_shards": ("counter", "committed shards verified on resume"),
+    # ---- train plane
+    "train.epochs": ("counter", "epochs completed (NN/LR/WDL/SVM)"),
+    "train.epoch_s": ("histogram", "per-epoch wall-clock"),
+    "train.trees": ("counter", "trees built (GBT/RF/DT)"),
+    "train.trees_built": ("gauge", "final forest size of the last trainer"),
+    "train.valid_err": ("gauge", "last validation error"),
+    "train.host_syncs": ("counter", "device->host value-forcing fetches"),
+    "train.tail_sweeps": ("counter", "disk-tail re-streams paid"),
+    "train.tail_repairs": ("counter", "c2f speculation repairs"),
+    "train.tail_repair_levels": ("counter", "levels regrown by repairs"),
+    "train.tail_c2f_fallbacks": ("counter",
+                                 "c2f auto-fallbacks to the exact schedule"),
+    # ---- eval plane (per-set AUC gauges ride the eval. prefix)
+    "eval.rows_scored": ("counter", "eval rows scored"),
+    "eval.rows_per_sec": ("gauge", "eval scoring throughput"),
+    # ---- varselect plane
+    "varsel.host_syncs": ("counter", "varselect packed fetches"),
+    "varsel.mask_batches": ("counter", "mask-batched programs dispatched"),
+    "varsel.windows": ("counter", "windows swept by varselect"),
+    "varsel.rows_per_sec": ("gauge", "varselect throughput"),
+    "varsel.candidates": ("gauge", "candidate columns scored"),
+    # ---- device / XLA accounting (registry-internal writers)
+    "device.bytes_in_use": ("gauge", "HBM in use (high-water sampled)"),
+    "device.peak_bytes_in_use": ("gauge", "HBM peak"),
+    "device.bytes_limit": ("gauge", "HBM capacity"),
+    "xla.compile_count": ("counter", "XLA compilations observed"),
+    "xla.compile_time_s": ("counter", "XLA compile wall-clock"),
+    # ---- drift monitor (obs/drift)
+    "drift.rows": ("gauge", "rows folded into the live drift counts"),
+    "drift.columns_tracked": ("gauge", "columns with a training snapshot"),
+    "drift.columns_flagged": ("gauge", "columns with PSI over threshold"),
+    "drift.psi_max": ("gauge", "max per-column PSI vs training snapshot"),
+    "drift.psi_mean": ("gauge", "mean per-column PSI vs training snapshot"),
+}
+
+# dynamic families: f-string names must start with one of these
+PREFIXES: Tuple[str, ...] = (
+    "bench.",        # per-plane bench gauges mirror BENCH_r0N extras
+    "eval.",         # eval.<set>.auc / eval.<set>.pr_auc per eval set
+)
+
+
+def is_declared(name: str) -> bool:
+    return name in MANIFEST or any(name.startswith(p) for p in PREFIXES)
+
+
+def declared_type(name: str) -> str:
+    """Instrument type for an exact declared name ('' for prefix-only)."""
+    if name in MANIFEST:
+        return MANIFEST[name][0]
+    return ""

@@ -1,0 +1,103 @@
+"""``shifu-tpu monitor`` — tail the health directory, render live status.
+
+Reads the heartbeat files :mod:`obs.health` writers commit under
+``<modelset>/telemetry/health/`` and renders one line per process:
+step, state (live / stalled / stale / exited), heartbeat age, the phase
+each thread is in right now, and the progress counters (rows, windows,
+trees, epochs).  The summary line carries the quorum fraction —
+``healthy / total`` — the primitive ROADMAP #3's straggler/quorum logic
+reads.
+
+Stateless by design: every render is a fresh read of the directory, so
+the monitor can attach to (and detach from) a running job at any time,
+from any process, with no coordination.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .health import classify, health_dir_for, read_health
+
+_STATE_FLAGS = {"live": "", "stalled": "  << STALLED (no progress)",
+                "stale": "  << STALE (no heartbeat)", "exited": ""}
+
+
+def _age(rec: Dict[str, Any], now: float) -> float:
+    return max(0.0, now - float(rec.get("ts") or 0.0))
+
+
+def _fmt_count(v: Any) -> str:
+    if v is None:
+        return "-"
+    return f"{v:,.0f}"
+
+
+def status_records(model_set_dir: str, now: Optional[float] = None
+                   ) -> Tuple[List[Dict[str, Any]], Dict[str, int]]:
+    """(records, state counts) for a model set — each record is the
+    health file's content plus ``status`` and ``age_s``."""
+    now = time.time() if now is None else now
+    recs = read_health(health_dir_for(model_set_dir))
+    counts: Dict[str, int] = {}
+    for rec in recs:
+        rec["status"] = classify(rec, now=now)
+        rec["age_s"] = round(_age(rec, now), 3)
+        counts[rec["status"]] = counts.get(rec["status"], 0) + 1
+    return recs, counts
+
+
+def render_status(model_set_dir: str, now: Optional[float] = None) -> str:
+    """One monitor frame: the table + quorum summary."""
+    now = time.time() if now is None else now
+    recs, counts = status_records(model_set_dir, now=now)
+    if not recs:
+        return (f"no health records under "
+                f"{health_dir_for(model_set_dir)}\n"
+                "start a step with telemetry enabled "
+                "(SHIFU_TPU_TELEMETRY=1 / --telemetry) to emit heartbeats")
+    out = [f"{'PROC':<22}{'STEP':<11}{'STATE':<9}{'AGE':>7}  "
+           f"{'ROWS':>12}{'WINDOWS':>9}{'TREES':>7}{'EPOCHS':>7}  PHASE"]
+    for rec in recs:
+        phase = rec.get("phase") or "-"
+        ingest = [f"{t}:{s}" for t, s in (rec.get("spans") or {}).items()
+                  if t != "MainThread"]
+        if ingest:
+            phase += "  [" + " ".join(sorted(ingest)) + "]"
+        out.append(
+            f"{rec.get('proc', '?'):<22}{(rec.get('step') or '-'):<11}"
+            f"{rec['status']:<9}{rec['age_s']:>6.1f}s  "
+            f"{_fmt_count(rec.get('rows')):>12}"
+            f"{_fmt_count(rec.get('windows')):>9}"
+            f"{_fmt_count(rec.get('trees')):>7}"
+            f"{_fmt_count(rec.get('epochs')):>7}  {phase}"
+            f"{_STATE_FLAGS.get(rec['status'], '')}")
+    healthy = counts.get("live", 0) + counts.get("stalled", 0)
+    active = len(recs) - counts.get("exited", 0)
+    parts = [f"{counts.get(k, 0)} {k}" for k in
+             ("live", "stalled", "stale", "exited") if counts.get(k)]
+    quorum = healthy / active if active else 1.0
+    out.append(f"-- {', '.join(parts) or 'no processes'}; "
+               f"quorum {healthy}/{active} ({quorum:.0%}) of active "
+               "processes heartbeating")
+    return "\n".join(out)
+
+
+def run_monitor(model_set_dir: str, interval_s: float = 2.0,
+                once: bool = False, max_frames: Optional[int] = None,
+                _print=print) -> int:
+    """The CLI loop: render a frame every ``interval_s`` until
+    interrupted (``--once`` renders a single frame).  Always exits 0 —
+    an empty health dir is a message, not an error."""
+    frames = 0
+    try:
+        while True:
+            _print(render_status(model_set_dir))
+            frames += 1
+            if once or (max_frames is not None and frames >= max_frames):
+                return 0
+            _print("")
+            time.sleep(interval_s)
+    except KeyboardInterrupt:
+        return 0

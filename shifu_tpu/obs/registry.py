@@ -33,51 +33,67 @@ from typing import Any, Dict, List, Optional
 from . import tracer
 
 
+# Instruments are THREAD-SAFE: ``ingest.*`` counters increment from the
+# ``prepared()`` background prep thread while trainers update ``train.*``
+# on the main thread, and the heartbeat/exporter threads (obs/health,
+# obs/exporter) snapshot the same instruments concurrently.  A bare
+# ``self.value += n`` is a read-modify-write the GIL does NOT make atomic
+# (the interpreter can switch between the load and the store), so every
+# mutation and every read-out takes the instrument's own lock.
 class Counter:
     """Monotonic accumulator (rows processed, epochs, trees built)."""
 
-    __slots__ = ("name", "value")
+    __slots__ = ("name", "value", "_lock")
 
     def __init__(self, name: str):
         self.name = name
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def inc(self, n: float = 1.0) -> None:
-        self.value += float(n)
+        n = float(n)
+        with self._lock:
+            self.value += n
 
     def to_record(self) -> Dict[str, Any]:
-        return {"kind": "metric", "type": "counter", "name": self.name,
-                "value": self.value}
+        with self._lock:
+            return {"kind": "metric", "type": "counter", "name": self.name,
+                    "value": self.value}
 
 
 class Gauge:
     """Last-value instrument with a high-water option (loss, throughput,
     device-memory peak)."""
 
-    __slots__ = ("name", "value")
+    __slots__ = ("name", "value", "_lock")
 
     def __init__(self, name: str):
         self.name = name
         self.value: Optional[float] = None
+        self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
-        self.value = float(v)
+        v = float(v)
+        with self._lock:
+            self.value = v
 
     def set_max(self, v: float) -> None:
         v = float(v)
-        if self.value is None or v > self.value:
-            self.value = v
+        with self._lock:
+            if self.value is None or v > self.value:
+                self.value = v
 
     def to_record(self) -> Dict[str, Any]:
-        return {"kind": "metric", "type": "gauge", "name": self.name,
-                "value": self.value}
+        with self._lock:
+            return {"kind": "metric", "type": "gauge", "name": self.name,
+                    "value": self.value}
 
 
 class Histogram:
     """Streaming summary (count/sum/min/max/last) — enough for epoch
     times and window throughputs without bucket bookkeeping."""
 
-    __slots__ = ("name", "count", "sum", "min", "max", "last")
+    __slots__ = ("name", "count", "sum", "min", "max", "last", "_lock")
 
     def __init__(self, name: str):
         self.name = name
@@ -86,23 +102,27 @@ class Histogram:
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self.last: Optional[float] = None
+        self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
         v = float(v)
-        self.count += 1
-        self.sum += v
-        self.min = v if self.min is None or v < self.min else self.min
-        self.max = v if self.max is None or v > self.max else self.max
-        self.last = v
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None or v < self.min else self.min
+            self.max = v if self.max is None or v > self.max else self.max
+            self.last = v
 
     @property
     def mean(self) -> float:
-        return self.sum / self.count if self.count else 0.0
+        with self._lock:
+            return self.sum / self.count if self.count else 0.0
 
     def to_record(self) -> Dict[str, Any]:
-        return {"kind": "metric", "type": "histogram", "name": self.name,
-                "count": self.count, "sum": round(self.sum, 6),
-                "min": self.min, "max": self.max, "last": self.last}
+        with self._lock:
+            return {"kind": "metric", "type": "histogram", "name": self.name,
+                    "count": self.count, "sum": round(self.sum, 6),
+                    "min": self.min, "max": self.max, "last": self.last}
 
 
 class _NullInstrument:

@@ -12,11 +12,18 @@ snapshot.  The closing line aggregates the whole pipeline.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
 
 TRACE_BASENAME = "trace.jsonl"
+
+NO_TELEMETRY_HINT = ("no telemetry recorded (enable with "
+                     "SHIFU_TPU_TELEMETRY=1, --telemetry, or "
+                     "-Dshifu.telemetry=on)")
 
 
 def trace_path(model_set_dir: str) -> str:
@@ -24,19 +31,27 @@ def trace_path(model_set_dir: str) -> str:
                         TRACE_BASENAME)
 
 
-def load_blocks(path: str) -> List[Dict[str, Any]]:
+def load_blocks(path: str,
+                skipped: Optional[List[str]] = None) -> List[Dict[str, Any]]:
     """Parse the JSONL into flush blocks: ``{"meta", "spans", "events",
-    "metrics"}`` per block, skipping unparseable lines (a crashed run may
-    truncate the tail)."""
+    "metrics"}`` per block.  Unparseable lines — a crash mid-write tears
+    the final line — are SKIPPED with a warning (and appended to
+    ``skipped`` when given), never a parse failure: a crashed run's
+    partial trace is exactly the one you want to read."""
     blocks: List[Dict[str, Any]] = []
     with open(path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
+                log.warning("telemetry trace %s line %d is not valid JSON "
+                            "(torn write from a crashed run?) — skipping",
+                            path, lineno)
+                if skipped is not None:
+                    skipped.append(f"line {lineno}")
                 continue
             kind = rec.get("kind")
             if kind == "meta":
@@ -83,7 +98,12 @@ def _render_block(block: Dict[str, Any], out: List[str]) -> float:
     for e in block["events"]:
         ev_by_parent.setdefault(e.get("parent"), []).append(e)
 
-    total = sum(s["dur_s"] for s in roots)
+    # wall-clock total counts MAIN-THREAD roots only: ingest-thread spans
+    # (the prep pipeline) run CONCURRENTLY with the step and would
+    # double-count the overlap the pipelining exists to create
+    main_roots = [s for s in roots
+                  if s.get("tid") in (None, "MainThread")]
+    total = sum(s["dur_s"] for s in (main_roots or roots))
     ts = meta.get("ts")
     when = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts)) \
         if ts else "?"
@@ -112,11 +132,29 @@ def _render_block(block: Dict[str, Any], out: List[str]) -> float:
                    f"{max(self_s, 0.0):>8.3f}s"
                    f"{_fmt_attrs(s.get('attrs') or {}, s['dur_s'])}")
         _events_line(s["id"], indent + "  ")
-        for k in kids:
-            _walk(k, depth + 1)
+        for group in _grouped(kids):
+            if len(group) == 1:
+                _walk(group[0], depth + 1)
+            else:
+                _agg_line(group, depth + 1)
 
-    for r in sorted(roots, key=lambda s: s["ts"]):
-        _walk(r, 1)
+    def _agg_line(group: List[dict], depth: int) -> None:
+        """Repeated same-name siblings (per-window ingest spans, per-tree
+        spans) collapse to one aggregate line — a 500-window sweep is one
+        line with a count, not 500."""
+        indent = "  " * depth
+        dur = sum(g["dur_s"] for g in group)
+        rows = sum(g.get("attrs", {}).get("rows") or 0 for g in group)
+        label = f"{indent}{group[0]['name']} ×{len(group)}"
+        tail = f"  {rows:,.0f} rows ({rows / dur:,.0f} rows/s)" \
+            if rows and dur > 0 else ""
+        out.append(f"{label:<38}{dur:>10.3f}s  (aggregated){tail}")
+
+    for group in _grouped(sorted(roots, key=lambda s: s["ts"])):
+        if len(group) == 1:
+            _walk(group[0], 1)
+        else:
+            _agg_line(group, 1)
     _events_line(None, "  ")          # events outside any span
     for m in block["metrics"]:
         if m["type"] == "histogram":
@@ -161,23 +199,85 @@ def _num(v: Any) -> Any:
     return v
 
 
+# siblings sharing a name above this count render as one aggregate line
+AGGREGATE_OVER = 3
+
+
+def _grouped(spans: List[dict]) -> List[List[dict]]:
+    """Partition an ordered sibling list: names occurring more than
+    ``AGGREGATE_OVER`` times become one group, everything else stays a
+    singleton in original order."""
+    by_name: Dict[str, int] = {}
+    for s in spans:
+        by_name[s["name"]] = by_name.get(s["name"], 0) + 1
+    groups: List[List[dict]] = []
+    agg: Dict[str, List[dict]] = {}
+    for s in spans:
+        if by_name[s["name"]] > AGGREGATE_OVER:
+            bucket = agg.get(s["name"])
+            if bucket is None:
+                bucket = agg[s["name"]] = []
+                groups.append(bucket)
+            bucket.append(s)
+        else:
+            groups.append([s])
+    return groups
+
+
+def _render_drift(model_set_dir: str, out: List[str]) -> None:
+    """The drift section: the live PSI table ``obs/drift`` emitted as
+    ``telemetry/drift.json`` (absent = no drift monitor ran)."""
+    path = os.path.join(os.path.abspath(model_set_dir), "telemetry",
+                        "drift.json")
+    if not os.path.isfile(path):
+        return
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        out.append(f"drift: {path} unreadable (torn write?)")
+        return
+    out.append(f"drift: {d.get('rows', 0):,} live rows vs training "
+               f"snapshot (threshold {d.get('threshold')})")
+    cols = sorted((d.get("columns") or {}).items(),
+                  key=lambda kv: -kv[1])
+    for name, v in cols[:10]:
+        flag = "  << DRIFTING" if v > (d.get("threshold") or 0.25) else ""
+        out.append(f"  psi {name}: {v:.4f}{flag}")
+    if len(cols) > 10:
+        out.append(f"  ... {len(cols) - 10} more column(s) in {path}")
+    flagged = d.get("flagged") or []
+    out.append(f"  {len(flagged)} column(s) over threshold"
+               + (f": {', '.join(flagged)}" if flagged else ""))
+    out.append("")
+
+
 def render_telemetry(model_set_dir: str) -> str:
-    """The ``analysis --telemetry`` payload for a model-set dir."""
+    """The ``analysis --telemetry`` payload for a model-set dir.  Missing
+    or empty traces render a hint, not an error — the CLI exits 0 either
+    way (a monitoring query on a fresh model set is not a failure)."""
     path = trace_path(model_set_dir)
     if not os.path.isfile(path):
-        return (f"no telemetry trace at {path}\n"
-                "run steps with SHIFU_TPU_TELEMETRY=1 (or --telemetry / "
-                "-Dshifu.telemetry=on) first")
-    blocks = load_blocks(path)
+        return f"{NO_TELEMETRY_HINT}\nexpected trace at {path}"
+    skipped: List[str] = []
+    blocks = load_blocks(path, skipped=skipped)
     if not blocks:
-        return f"telemetry trace {path} is empty"
+        return (f"{NO_TELEMETRY_HINT}\ntrace {path} "
+                + ("holds no parseable records "
+                   f"({len(skipped)} torn line(s) skipped)" if skipped
+                   else "is empty"))
     out: List[str] = [f"telemetry: {path}",
                       f"schema v{blocks[-1]['meta'].get('schema_version')}"
-                      f", {len(blocks)} step record(s)", ""]
+                      f", {len(blocks)} step record(s)"]
+    if skipped:
+        out.append(f"warning: {len(skipped)} torn line(s) skipped "
+                   f"({', '.join(skipped[:5])}) — crashed run mid-write")
+    out.append("")
     grand = 0.0
     for block in blocks:
         grand += _render_block(block, out)
         out.append("")
+    _render_drift(model_set_dir, out)
     out.append(f"pipeline total: {grand:.3f}s across {len(blocks)} "
                "step record(s)")
     return "\n".join(out)

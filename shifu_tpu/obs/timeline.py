@@ -1,0 +1,111 @@
+"""Timeline export — span JSONL -> Chrome/Perfetto ``trace_event`` JSON.
+
+``shifu-tpu analysis --telemetry --timeline out.json`` converts the
+telemetry trace into the Trace Event Format that ``chrome://tracing``
+and https://ui.perfetto.dev load directly: every span becomes a complete
+(``"ph": "X"``) event with microsecond timestamps, every point event an
+instant (``"ph": "i"``), one process per flush block (the step run's
+pid), and — the part that makes the PR 2/6 ingest/compute overlap
+visually auditable — INGEST-THREAD spans (``ingest.window_prep``, the
+background prep/H2D pipeline) land on their own named track, separate
+from the main thread's device-compute spans, so a starved accelerator
+shows up as gaps on the compute track opposite solid bars on the ingest
+track (the runtime-must-expose-timelines argument of the TF paper).
+
+Track assignment: span records carry ``tid`` (the recording thread's
+name, schema v5).  Any span recorded off the main thread — or named
+``ingest.*`` (pre-v5 traces have no ``tid``) — routes to the ingest
+track.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from ..ioutil import atomic_write_text
+from . import tracer
+from .report import load_blocks, trace_path
+
+# fixed tids per process: compute first so it sorts on top in viewers
+TID_MAIN = 1
+TID_INGEST = 2
+TRACK_NAMES = {TID_MAIN: "step / device compute",
+               TID_INGEST: "ingest (window prep + H2D wait)"}
+
+
+def _is_ingest(rec: Dict[str, Any]) -> bool:
+    if str(rec.get("name", "")).startswith("ingest."):
+        return True
+    tid = rec.get("tid")
+    return tid is not None and tid != "MainThread"
+
+
+def _us(seconds: float) -> int:
+    return int(round(float(seconds) * 1e6))
+
+
+def to_trace_events(blocks: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Trace Event Format document (JSON-object flavour) for a parsed
+    trace (see :func:`shifu_tpu.obs.report.load_blocks`)."""
+    events: List[Dict[str, Any]] = []
+    seen_pids: Dict[int, str] = {}
+    for bi, block in enumerate(blocks):
+        meta = block["meta"]
+        pid = int(meta.get("pid") or (100000 + bi))
+        step = meta.get("step") or "(unlabeled)"
+        if pid not in seen_pids:
+            seen_pids[pid] = step
+            events.append({"ph": "M", "name": "process_name", "pid": pid,
+                           "tid": 0, "args": {"name": f"shifu-tpu {step} "
+                                              f"(pid {pid})"}})
+            for tid, label in TRACK_NAMES.items():
+                events.append({"ph": "M", "name": "thread_name",
+                               "pid": pid, "tid": tid,
+                               "args": {"name": label}})
+                events.append({"ph": "M", "name": "thread_sort_index",
+                               "pid": pid, "tid": tid,
+                               "args": {"sort_index": tid}})
+        for s in block["spans"]:
+            events.append({
+                "ph": "X", "name": s["name"], "cat": "span",
+                "pid": pid,
+                "tid": TID_INGEST if _is_ingest(s) else TID_MAIN,
+                "ts": _us(s.get("ts") or 0.0),
+                "dur": max(1, _us(s.get("dur_s") or 0.0)),
+                "args": dict(s.get("attrs") or {}, span_id=s.get("id"),
+                             parent=s.get("parent")),
+            })
+        for e in block["events"]:
+            events.append({
+                "ph": "i", "s": "t", "name": e["name"], "cat": "event",
+                "pid": pid,
+                "tid": TID_INGEST if _is_ingest(e) else TID_MAIN,
+                "ts": _us(e.get("ts") or 0.0),
+                "args": dict(e.get("attrs") or {}),
+            })
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "shifu-tpu telemetry",
+            "schema_version": tracer.SCHEMA_VERSION,
+            "steps": [b["meta"].get("step") for b in blocks],
+        },
+    }
+
+
+def export_timeline(model_set_dir: str, out_path: str) -> Optional[str]:
+    """Convert ``<modelset>/telemetry/trace.jsonl`` to ``out_path``.
+    Returns the output path, or ``None`` (nothing written) when there is
+    no telemetry to convert."""
+    path = trace_path(model_set_dir)
+    if not os.path.isfile(path):
+        return None
+    blocks = load_blocks(path)
+    if not blocks:
+        return None
+    doc = to_trace_events(blocks)
+    atomic_write_text(out_path, json.dumps(doc))
+    return out_path

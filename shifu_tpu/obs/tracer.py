@@ -42,7 +42,12 @@ from typing import Any, Dict, List, Optional
 # "tail sweeps" + ingest-stall lines and bench tail_* extras —
 # disk_passes / bytes_read per tree, dual-schedule rates — derive
 # from them)
-SCHEMA_VERSION = 4
+# v5: observability plane v2 — span/event records carry ``tid`` (the
+# recording thread's name: ingest-prep spans land on their own timeline
+# track), live-span registry for heartbeats (obs/health), ingest.window_
+# prep / ingest.h2d_wait spans, drift.* gauges (streaming PSI monitor),
+# OpenMetrics snapshot names derive from the same registry records
+SCHEMA_VERSION = 5
 
 _TRUE = ("1", "true", "on", "yes")
 
@@ -113,6 +118,10 @@ class _Collector:
         self._records: List[Dict[str, Any]] = []
         self._tls = threading.local()
         self._next_id = 0
+        # id -> (name, thread name, entry ts) for spans currently OPEN —
+        # the heartbeat thread (obs/health) reads this to report what
+        # each thread is doing *right now*, between record flushes
+        self._live: Dict[int, tuple] = {}
 
     def new_id(self) -> int:
         with self._lock:
@@ -134,6 +143,21 @@ class _Collector:
         with self._lock:
             self._records.append(rec)
 
+    def span_opened(self, span_id: int, name: str, ts: float) -> None:
+        with self._lock:
+            self._live[span_id] = (name, threading.current_thread().name,
+                                   ts)
+
+    def span_closed(self, span_id: int) -> None:
+        with self._lock:
+            self._live.pop(span_id, None)
+
+    def live_spans(self) -> List[Dict[str, Any]]:
+        """Currently-open spans, oldest first (heartbeat surface)."""
+        with self._lock:
+            return [{"id": i, "name": n, "thread": t, "ts": ts}
+                    for i, (n, t, ts) in sorted(self._live.items())]
+
     def drain(self) -> List[Dict[str, Any]]:
         with self._lock:
             out, self._records = self._records, []
@@ -146,6 +170,7 @@ class _Collector:
     def clear(self) -> None:
         with self._lock:
             self._records.clear()
+            self._live.clear()
         self._tls = threading.local()
 
 
@@ -172,6 +197,7 @@ class Span:
         _collector.stack.append(self.id)
         self._ts = time.time()
         self._t0 = time.perf_counter()
+        _collector.span_opened(self.id, self.name, self._ts)
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
@@ -179,11 +205,14 @@ class Span:
         st = _collector.stack
         if st and st[-1] == self.id:
             st.pop()
+        _collector.span_closed(self.id)
         if exc_type is not None:
             self.attrs["error"] = exc_type.__name__
         _collector.add({"kind": "span", "name": self.name, "id": self.id,
                         "parent": self.parent, "ts": round(self._ts, 3),
-                        "dur_s": round(dur, 6), "attrs": self.attrs})
+                        "dur_s": round(dur, 6),
+                        "tid": threading.current_thread().name,
+                        "attrs": self.attrs})
         return False
 
     def set(self, **attrs: Any) -> "Span":
@@ -237,7 +266,8 @@ def event(name: str, /, **attrs: Any) -> None:
         return
     _collector.add({"kind": "event", "name": name,
                     "ts": round(time.time(), 3),
-                    "parent": _collector.current_parent(), "attrs": attrs})
+                    "parent": _collector.current_parent(),
+                    "tid": threading.current_thread().name, "attrs": attrs})
 
 
 def fence(value: Any) -> Any:
@@ -251,6 +281,14 @@ def fence(value: Any) -> Any:
 def pending_records() -> List[Dict[str, Any]]:
     """Snapshot of not-yet-flushed records (tests, bench)."""
     return _collector.peek()
+
+
+def live_spans() -> List[Dict[str, Any]]:
+    """Spans currently open across ALL threads (the heartbeat's 'what is
+    this process doing right now' surface).  Empty when disabled."""
+    if not enabled():
+        return []
+    return _collector.live_spans()
 
 
 def flush(path: str, step: Optional[str] = None,

@@ -164,6 +164,10 @@ class EvalProcessor(BasicProcessor):
         all_scores, all_targets, all_weights = [], [], []
         score_path = self.paths.eval_score_path(ev.name)
         n_models = len(scorer.models)
+        # streaming drift monitor: the eval set is the LIVE distribution —
+        # its binned windows accumulate per-column PSI against the
+        # training-time snapshot (None / zero-cost when telemetry is off)
+        drift = obs.start_drift_monitor(runner.transformer.columns)
         score_t0 = time.perf_counter()
         with self.phase(f"score:{ev.name}") as ph, \
                 open(score_path, "w") as sf:
@@ -174,6 +178,8 @@ class EvalProcessor(BasicProcessor):
                 out = runner.compute(chunk)
                 if out["n"] == 0:
                     continue
+                if drift is not None:
+                    drift.update(out["bins"])
                 res = out["result"]
                 chosen = res.select(sel)
                 all_scores.append(chosen)
@@ -201,6 +207,8 @@ class EvalProcessor(BasicProcessor):
             len(scores) / max(time.perf_counter() - score_t0, 1e-9))
         obs.event("eval_set", eval_set=ev.name, rows=len(scores),
                   models=n_models, action=action)
+        if drift is not None:
+            drift.emit(path=self.paths.drift_path)
         log.info("eval %s: scored %d records (%d pos / %d neg) with %d model(s)",
                  ev.name, len(scores), int(targets.sum()),
                  int((1 - targets).sum()), n_models)

@@ -95,12 +95,19 @@ class NormalizeProcessor(BasicProcessor):
         neg_only = mc.normalize.sampleNegOnly
         shard, rows, seen, total_out = 0, 0, 0, 0
         bufx, bufb, bufy, bufw = [], [], [], []
+        # streaming drift monitor (obs/drift): per-column PSI of THIS
+        # run's binned windows vs the training-time binning snapshot in
+        # ColumnConfig — on a refresh over new data windows this is the
+        # drift signal; None (zero per-chunk cost) when telemetry is off
+        drift = obs.start_drift_monitor(transformer.columns)
         t0 = time.perf_counter()
         with self.phase("transform") as ph:
             for chunk in source.iter_chunks():
                 tc = transformer.transform(chunk)
                 if tc.n == 0:
                     continue
+                if drift is not None:
+                    drift.update(tc.bins)
                 keep = sample_mask(tc.n, rate, seed=seen, neg_only=neg_only,
                                    targets=tc.target)
                 seen += tc.n
@@ -129,6 +136,8 @@ class NormalizeProcessor(BasicProcessor):
         obs.gauge("norm.shards").set(shard)
         obs.gauge("norm.rows_per_sec").set(
             total_out / max(time.perf_counter() - t0, 1e-9))
+        if drift is not None:
+            drift.emit(path=self.paths.drift_path)
         schema = {
             "outputNames": transformer.output_names,
             "columnNums": [c.columnNum for c in transformer.columns],

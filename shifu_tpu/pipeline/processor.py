@@ -133,10 +133,21 @@ class BasicProcessor:
         telemetry = obs.enabled()
         if telemetry:
             obs.ensure_compile_listener()
+        heartbeat = exporter = None
+        code: Optional[int] = None
         try:
             with obs.span(self.profile_name, kind="step") as root:
                 with obs.span("setup", kind="phase"):
                     self.setup()
+                # live observability plane: per-process heartbeats under
+                # <modelset>/telemetry/health/ (the `monitor` CLI tails
+                # them) + periodic OpenMetrics/JSON registry snapshots —
+                # both factories return None when telemetry is off, so
+                # the disabled path starts no thread and touches no file
+                heartbeat = obs.start_heartbeat(self.paths.health_dir,
+                                                step=self.profile_name)
+                exporter = obs.start_exporter(self.paths.telemetry_dir,
+                                              step=self.profile_name)
                 # torn-run detection: the journal stays "running" until
                 # the step commits, so a crash anywhere below leaves the
                 # marker the next run (and downstream preconditions) read
@@ -148,9 +159,15 @@ class BasicProcessor:
                 if code == 0:
                     self.journal.complete(exit_code=0)
         finally:
-            # flush even when the step raised: a crashed run's partial
-            # trace (with the error-marked span) is exactly the one you
-            # want to read
+            # retire the live plane, then flush — even when the step
+            # raised: a crashed run's partial trace (with the error-
+            # marked span) is exactly the one you want to read, and the
+            # final heartbeat (state=exited) is how the monitor tells a
+            # clean exit from a silent death
+            if heartbeat is not None:
+                heartbeat.stop(exit_code=code)
+            if exporter is not None:
+                exporter.stop()
             if telemetry:
                 self._flush_telemetry()
         total = time.time() - t0

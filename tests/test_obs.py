@@ -164,9 +164,17 @@ def test_disabled_mode_writes_nothing(telemetry_off, tmp_path):
         obs.histogram("h").observe(1)
     assert obs.pending_records() == []
     assert obs.snapshot() == []
+    assert obs.live_spans() == []
     path = str(tmp_path / "telemetry" / "trace.jsonl")
     assert obs.flush(path) is False
     assert not os.path.exists(os.path.dirname(path))
+    # v2 observability plane: every factory is a None-returning no-op
+    # when disabled — no thread, no file, no directory
+    assert obs.start_heartbeat(str(tmp_path / "health"), step="X") is None
+    assert obs.start_exporter(str(tmp_path / "telemetry")) is None
+    assert obs.start_drift_monitor([]) is None
+    assert not os.path.exists(str(tmp_path / "health"))
+    assert not os.path.exists(str(tmp_path / "telemetry"))
 
 
 def test_disabled_processor_writes_no_telemetry_files(telemetry_off,
@@ -240,7 +248,11 @@ def test_disabled_telemetry_overhead_within_noise(telemetry_off):
     def instrumented(p):
         for i in range(200):
             with obs.span("train_step", i=i) as sp:
-                p = sp.fence(step(p, x))
+                # the v2 plane's per-window hot-path additions: the
+                # ingest prep/wait spans (null singletons when off) —
+                # they must cost one call + one branch, nothing more
+                with obs.span("ingest.window_prep", window=i):
+                    p = sp.fence(step(p, x))
                 obs.counter("steps").inc()
                 obs.histogram("loss").observe(0.0)
         return float(p)
@@ -265,15 +277,25 @@ def test_bench_schema_matches_obs():
     obs schema diverge — this pin is the loud failure's test double.
     v3 added the varsel_* extras (streamed mask-batched sensitivity
     plane); v4 the disk-tail super-batch round (tail_* extras +
-    train.tail_sweeps / tail_repairs counters): the version must be
+    train.tail_sweeps / tail_repairs counters); v5 the observability
+    plane v2 (tid on span records, drift.* gauges, health heartbeats,
+    OpenMetrics snapshots, bench --compare): the version must be
     current AND the planes registered, so a schema bump cannot land
     without the emissions being re-validated."""
     from shifu_tpu.bench import (BENCH_TELEMETRY_SCHEMA,
-                                 bench_gbt_streamed_tail, bench_varsel)
+                                 bench_gbt_streamed_tail, bench_varsel,
+                                 run_compare)
     assert BENCH_TELEMETRY_SCHEMA == obs.SCHEMA_VERSION
-    assert BENCH_TELEMETRY_SCHEMA >= 4          # tail_* extras era
+    assert BENCH_TELEMETRY_SCHEMA >= 5          # observability-plane era
     assert callable(bench_varsel)
     assert callable(bench_gbt_streamed_tail)
+    assert callable(run_compare)                # the BENCH_r0N reader
+    # v5 surfaces exist and share the schema constant
+    from shifu_tpu.obs import drift, exporter, health, timeline
+    assert callable(timeline.to_trace_events)
+    assert callable(exporter.render_openmetrics)
+    assert callable(health.start_heartbeat)
+    assert callable(drift.start_drift_monitor)
 
 
 def test_bench_refuses_schema_mismatch(monkeypatch):
