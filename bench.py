@@ -42,21 +42,28 @@ def main() -> None:
                          "streamed mask-batched SE sensitivity vs the "
                          "single-worker per-column loop at identical "
                          "selections")
-    ap.add_argument("--compare", nargs=2, metavar=("OLD.json", "NEW.json"),
+    ap.add_argument("--compare", nargs="*", metavar="PAYLOAD.json",
                     default=None,
                     help="regression-diff two bench payloads (raw JSON "
                          "lines or BENCH_r0N wrappers) metric-by-metric; "
                          "exits 2 when any tracked throughput metric "
                          "falls below --threshold x old — runs NO "
-                         "benchmark")
+                         "benchmark.  With NO arguments, auto-diffs the "
+                         "two newest BENCH_r*.json in the repo root "
+                         "(errors cleanly when fewer than two exist)")
     ap.add_argument("--threshold", type=float, default=0.9,
                     help="--compare regression threshold (default 0.9: "
                          "new >= 0.9 x old passes)")
     args = ap.parse_args()
 
-    if args.compare:
-        from shifu_tpu.bench import run_compare
-        sys.exit(run_compare(args.compare[0], args.compare[1],
+    if args.compare is not None:
+        from shifu_tpu.bench import resolve_compare_paths, run_compare
+        try:
+            old_path, new_path = resolve_compare_paths(args.compare)
+        except ValueError as e:
+            print(f"bench: {e}", file=sys.stderr)
+            sys.exit(2)
+        sys.exit(run_compare(old_path, new_path,
                              threshold=args.threshold))
 
     from shifu_tpu import obs

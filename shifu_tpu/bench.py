@@ -37,7 +37,13 @@ from . import obs
 # track), drift.* gauges, health heartbeats + OpenMetrics snapshots
 # derive from the same registry records; bench gains --compare (the
 # BENCH_r0N regression differ, which parses exactly these payloads).
-BENCH_TELEMETRY_SCHEMA = 5
+# v6: device cost-attribution plane — "cost" records per named
+# executable (obs/costs), xla.recompiles / xla.launches +
+# ingest.rows_padded counters; bench emits *_mfu / *_achieved_bw extras
+# (XLA cost analysis of the timed executable over the device peak
+# table) and --compare TRACKS them; --compare with no arguments diffs
+# the two newest BENCH_r*.json in the repo root.
+BENCH_TELEMETRY_SCHEMA = 6
 
 # measured on this rig (tools/measure_baseline.py); provenance in
 # BASELINE.md — every headline divides by a MEASURED reference-class
@@ -64,7 +70,7 @@ BASELINE_VARSEL_RATE = (MEASURED_CPU_VARSEL_ROWS_COLS_PER_SEC
 
 def bench_nn(n_rows: int = 1 << 17, n_features: int = 256,
              hidden: tuple = (512, 256), batch: int = 1 << 12,
-             steps: int = 8000) -> float:
+             steps: int = 8000, collect: Dict[str, Any] = None) -> float:
     import jax
     import jax.numpy as jnp
 
@@ -110,6 +116,8 @@ def bench_nn(n_rows: int = 1 << 17, n_features: int = 256,
 
         params, opt_state, loss = run_steps(params, opt_state, steps)
         float(loss)                                  # full warmup sync
+        _collect_window_cost(collect, run_steps, (params, opt_state),
+                             {"n_steps": steps}, steps * batch)
         best = 0.0
         for _ in range(3):
             t0 = time.perf_counter()
@@ -117,6 +125,51 @@ def bench_nn(n_rows: int = 1 << 17, n_features: int = 256,
             float(loss)                              # value-forcing sync
             best = max(best, steps * batch / (time.perf_counter() - t0))
         return best
+
+
+def _collect_window_cost(collect, jitted, args, kwargs, rows: int) -> None:
+    """XLA cost analysis of the timed executable (one lowering, no
+    second compile): flops / bytes per timing window, for the *_mfu /
+    *_achieved_bw extras.  Lowering reads only avals, so donated (dead)
+    buffers from the warmup call are fine."""
+    if collect is None:
+        return
+    try:
+        ca = jitted.lower(*args, **kwargs).cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            collect["flops_per_window"] = float(ca.get("flops") or 0.0)
+            collect["bytes_per_window"] = float(
+                ca.get("bytes accessed") or 0.0)
+            collect["rows_per_window"] = rows
+    except Exception as e:                          # pragma: no cover
+        collect["cost_error"] = str(e)[:120]
+
+
+def _mfu_extras(prefix: str, rows_per_sec: float, col: Dict[str, Any],
+                extras: Dict[str, Any]) -> None:
+    """Fold a collected window cost into *_mfu / *_achieved_bw extras:
+    achieved = window cost / (window rows / best rows-per-sec); MFU =
+    achieved FLOP/s over the device peak (obs.costs table,
+    SHIFU_TPU_PEAK_FLOPS / SHIFU_TPU_PEAK_BW override)."""
+    rows = col.get("rows_per_window")
+    if not rows or not rows_per_sec:
+        return
+    from .obs.costs import resolve_peaks
+    peak_f, peak_b, label = resolve_peaks()
+    wall = rows / rows_per_sec
+    fl, by = col.get("flops_per_window"), col.get("bytes_per_window")
+    if fl:
+        achieved = fl / wall
+        extras[f"{prefix}_achieved_flops"] = round(achieved, 1)
+        extras[f"{prefix}_mfu"] = round(achieved / peak_f, 6)
+    if by:
+        bw = by / wall
+        extras[f"{prefix}_achieved_bw"] = round(bw, 1)
+        extras[f"{prefix}_bw_frac_of_peak"] = round(bw / peak_b, 6)
+    extras.setdefault("peaks_provenance",
+                      f"{label}: {peak_f:.3e} FLOP/s, {peak_b:.3e} B/s")
 
 
 def _bench_forest(train_fn, settings, n_rows: int, n_features: int,
@@ -252,7 +305,7 @@ def bench_rf(n_rows: int = 1 << 17, n_features: int = 64, n_bins: int = 64,
 
 def bench_wdl(n_rows: int = 1 << 17, n_num: int = 64, n_cat: int = 32,
               card: int = 64, batch: int = 1 << 12,
-              steps: int = 2000) -> float:
+              steps: int = 2000, collect: Dict[str, Any] = None) -> float:
     """Wide&deep training-step throughput, same harness shape as
     :func:`bench_nn`: the timing window is ONE scanned executable of
     dual-plane minibatch updates (embedding gathers + wide sparse path +
@@ -308,6 +361,8 @@ def bench_wdl(n_rows: int = 1 << 17, n_num: int = 64, n_cat: int = 32,
 
         params, opt_state, loss = run_steps(params, opt_state, steps)
         float(loss)                                  # full warmup sync
+        _collect_window_cost(collect, run_steps, (params, opt_state),
+                             {"n_steps": steps}, steps * batch)
         best = 0.0
         for _ in range(3):
             t0 = time.perf_counter()
@@ -926,11 +981,15 @@ def bench_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
 
 
 def is_tracked_throughput(name: str) -> bool:
-    """Throughput metrics gate the compare (higher = better; ratios,
-    shapes, and wall-clock extras inform but never fail)."""
+    """Higher-is-better metrics gate the compare: throughputs, plus the
+    v6 utilization extras (*_mfu / *_achieved_bw — a drop means the
+    same plane is doing the same math slower, exactly what the compare
+    exists to catch).  Ratios, shapes and wall-clock extras inform but
+    never fail."""
     if name.endswith("_vs_baseline") or name.endswith("_error"):
         return False
-    return "throughput" in name or name.endswith("_per_sec")
+    return ("throughput" in name or name.endswith("_per_sec")
+            or name.endswith("_mfu") or name.endswith("_achieved_bw"))
 
 
 def compare_bench(old: Dict[str, Any], new: Dict[str, Any],
@@ -970,6 +1029,32 @@ def format_compare_table(rows, threshold: float) -> str:
     out.append(f"(* = tracked throughput metric; REGRESSED = new < "
                f"{threshold} x old)")
     return "\n".join(out)
+
+
+def resolve_compare_paths(paths, root: str = None):
+    """The ``--compare`` arguments resolved to (old, new).  Two explicit
+    paths pass through; NONE switches to auto mode: pick the two newest
+    ``BENCH_r*.json`` in the repo root (zero-padded round number = name
+    order, so "newest" is deterministic regardless of checkout mtimes)
+    and diff older -> newer.  Fewer than two on disk is a clear coded
+    error, never a traceback."""
+    import glob
+    import os
+    paths = list(paths or [])
+    if len(paths) == 2:
+        return paths[0], paths[1]
+    if paths:
+        raise ValueError("--compare takes exactly two payload paths, or "
+                         "none to auto-diff the two newest BENCH_r*.json")
+    root = root or os.path.dirname(os.path.dirname(os.path.abspath(
+        __file__)))
+    cands = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    if len(cands) < 2:
+        raise ValueError(
+            f"--compare auto mode needs at least two BENCH_r*.json under "
+            f"{root} (found {len(cands)}) — run the bench twice or pass "
+            "OLD.json NEW.json explicitly")
+    return cands[-2], cands[-1]
 
 
 def run_compare(old_path: str, new_path: str,
@@ -1099,9 +1184,16 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
         raise ValueError(
             f"unknown bench plane {plane!r} "
             "(tail|rf-repeat|e2e|resume|varsel|all)")
-    nn_rows_per_sec = bench_nn()
+    nn_cost: Dict[str, Any] = {}
+    nn_rows_per_sec = bench_nn(collect=nn_cost)
     obs.gauge("bench.nn_train_throughput").set(nn_rows_per_sec)
     extras: Dict[str, Any] = {}
+    # utilization extras (schema v6): MFU + achieved bandwidth from the
+    # timed executable's own XLA cost analysis — --compare tracks them
+    _mfu_extras("nn_train", nn_rows_per_sec, nn_cost, extras)
+    for k in ("nn_train_mfu", "nn_train_achieved_bw"):
+        if k in extras:
+            obs.gauge(f"bench.{k}").set(float(extras[k]))
 
     def record(key: str, fn, baseline: float) -> None:
         """Every extra carries its own measured-denominator ratio; the
@@ -1138,7 +1230,15 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
     except Exception as e:                      # pragma: no cover
         extras["gbt_train_throughput_streamed_tail_error"] = str(e)[:200]
     record("rf_train_throughput", bench_rf, BASELINE_TREE_RATE)
-    record("wdl_train_throughput", bench_wdl, BASELINE_ROWS_PER_SEC)
+    wdl_cost: Dict[str, Any] = {}
+    record("wdl_train_throughput",
+           lambda: bench_wdl(collect=wdl_cost), BASELINE_ROWS_PER_SEC)
+    if "wdl_train_throughput" in extras:
+        _mfu_extras("wdl_train", extras["wdl_train_throughput"], wdl_cost,
+                    extras)
+        for k in ("wdl_train_mfu", "wdl_train_achieved_bw"):
+            if k in extras:
+                obs.gauge(f"bench.{k}").set(float(extras[k]))
     record("eval_throughput", bench_eval, BASELINE_SCORE_RATE)
     record("stats_throughput", bench_stats, BASELINE_STATS_RATE)
     try:

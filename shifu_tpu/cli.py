@@ -115,7 +115,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(-fi MODEL: tree feature importance; --telemetry: "
                         "render the last run's span/metric trace; "
                         "--telemetry --timeline OUT: export a Chrome/"
-                        "Perfetto trace_event timeline)")
+                        "Perfetto trace_event timeline; --telemetry "
+                        "--utilization: cost-attribution / roofline "
+                        "report)")
     sp.add_argument("-fi", dest="fi_model", metavar="MODELPATH")
     sp.add_argument("-telemetry", "--telemetry", dest="telemetry_report",
                     action="store_true",
@@ -127,6 +129,13 @@ def build_parser() -> argparse.ArgumentParser:
                     "trace_event JSON (load in chrome://tracing or "
                     "ui.perfetto.dev; ingest-thread spans get their own "
                     "track)")
+    sp.add_argument("-utilization", "--utilization", dest="utilization",
+                    action="store_true",
+                    help="with --telemetry: join executable FLOPs/bytes "
+                    "(obs cost records) against span wall times — "
+                    "achieved FLOP/s, bytes/s, percent-of-peak and a "
+                    "roofline verdict per plane (peaks override: "
+                    "SHIFU_TPU_PEAK_FLOPS / SHIFU_TPU_PEAK_BW)")
 
     sp = sub.add_parser("monitor", help="live health monitor: tail "
                         "<modelset>/telemetry/health/ heartbeats and "
@@ -137,6 +146,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="seconds between frames (default 2)")
     sp.add_argument("--once", dest="monitor_once", action="store_true",
                     help="render one frame and exit")
+    sp.add_argument("--json", dest="monitor_json", action="store_true",
+                    help="with --once: print ONE machine-readable JSON "
+                    "doc (per-proc health + quorum summary) instead of "
+                    "the table; exit 0 healthy, 3 when any process is "
+                    "stalled or stale — for CI and cron consumers")
 
     sp = sub.add_parser("test", help="pipeline smoke test on a data sample")
     sp.add_argument("-filter", dest="filter_target", nargs="?", const="",
@@ -260,16 +274,26 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
             args.type = args.type_pos
         return ExportProcessor(args.dir, params=vars(args)).run()
     if cmd == "analysis":
-        if getattr(args, "telemetry_report", False):
+        if getattr(args, "telemetry_report", False) \
+                or getattr(args, "utilization", False):
+            if getattr(args, "utilization", False):
+                from .obs.utilization import render_utilization
+                print(render_utilization(args.dir))
+                return 0
             if getattr(args, "timeline_out", None):
                 from .obs.report import NO_TELEMETRY_HINT
                 from .obs.timeline import export_timeline
-                out = export_timeline(args.dir, args.timeline_out)
+                skipped: list = []
+                out = export_timeline(args.dir, args.timeline_out,
+                                      skipped=skipped)
                 if out is None:
                     print(NO_TELEMETRY_HINT)
                 else:
                     print(f"timeline -> {out}  (load in chrome://tracing "
                           "or https://ui.perfetto.dev)")
+                    if skipped:
+                        print(f"warning: {len(skipped)} torn trace "
+                              "line(s) skipped (crashed run mid-write?)")
                 return 0
             from .obs.report import render_telemetry
             print(render_telemetry(args.dir))
@@ -279,7 +303,8 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
     if cmd == "monitor":
         from .obs.monitor import run_monitor
         return run_monitor(args.dir, interval_s=args.monitor_interval,
-                           once=args.monitor_once)
+                           once=args.monitor_once,
+                           json_mode=getattr(args, "monitor_json", False))
     if cmd == "test":
         from .pipeline.smoke import SmokeTestProcessor
         return SmokeTestProcessor(args.dir, params=vars(args)).run()

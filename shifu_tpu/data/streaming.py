@@ -304,6 +304,7 @@ class ShardStream:
         bytes_c = obs.counter("ingest.bytes_read")
         win_c = obs.counter("ingest.windows_emitted")
         rows_c = obs.counter("ingest.rows_emitted")
+        pad_c = obs.counter("ingest.rows_padded")
         start, g = start_row, g0
         while g < rd.rows:
             e = min(g + W, rd.rows)
@@ -311,6 +312,7 @@ class ShardStream:
             nv = e - g
             if nv < W:
                 arrays = {k: _pad_rows(a, W) for k, a in arrays.items()}
+                pad_c.inc(W - nv)
             nb = sum(a.nbytes for a in arrays.values())
             bytes_c.inc(nb)
             self.bytes_read += nb
@@ -386,6 +388,9 @@ class ShardStream:
             if buffered:
                 arrays, buf, _ = _take(buf, buffered, self.keys)
                 arrays = {k: _pad_rows(a, W) for k, a in arrays.items()}
+                # padding waste surface for the utilization report: rows
+                # the device computes over that carry zero weight
+                obs.counter("ingest.rows_padded").inc(W - buffered)
                 nb = sum(a.nbytes for a in arrays.values())
                 bytes_c.inc(nb)
                 self.bytes_read += nb

@@ -32,7 +32,14 @@ Modules:
 - :mod:`profiler` — opt-in ``jax.profiler.trace()`` capture around any
   step (``shifu-tpu <step> --profile [dir]``);
 - :mod:`report` — renders the last run's spans/metrics as a tree with
-  per-step self-time, rows/sec, ingest-stall / tail / drift sections.
+  per-step self-time, rows/sec, ingest-stall / tail / drift sections;
+- :mod:`costs` — device cost attribution: ``costed_jit`` captures
+  FLOPs / bytes / memory per named executable, counts compiles,
+  launches and RECOMPILES (the shape-churn sentinel), analytic models
+  cover Pallas kernels XLA cannot see through;
+- :mod:`utilization` — joins executable costs against span wall times:
+  achieved FLOP/s, bytes/s, percent-of-peak and a roofline verdict per
+  plane (``analysis --telemetry --utilization``).
 
 Everything is ZERO-COST when disabled (the default): ``span()`` returns
 a shared no-op singleton, instruments are no-op singletons, heartbeat /
@@ -56,6 +63,9 @@ from .exporter import (MetricsExporter, start_exporter,       # noqa: F401
                        metric_name)
 from .drift import (DriftMonitor, start_drift_monitor,        # noqa: F401
                     psi_threshold)
+from .costs import (costed_jit, record_executable,            # noqa: F401
+                    register_cost_model, record_model_launch,
+                    cost_snapshot, resolve_peaks, backend_info)
 
 __all__ = [
     # tracer
@@ -75,4 +85,8 @@ __all__ = [
     "write_metrics_files", "metric_name",
     # drift
     "DriftMonitor", "start_drift_monitor", "psi_threshold",
+    # cost-attribution plane
+    "costed_jit", "record_executable", "register_cost_model",
+    "record_model_launch", "cost_snapshot", "resolve_peaks",
+    "backend_info",
 ]

@@ -31,6 +31,8 @@ MANIFEST: Dict[str, Tuple[str, str]] = {
     "ingest.spill_hits": ("counter", "sweeps served from the mmap spill"),
     "ingest.spill_misses": ("counter", "sweeps that re-read npz shards"),
     "ingest.retries": ("counter", "transient IO errors absorbed by retry"),
+    "ingest.rows_padded": ("counter",
+                           "zero-weight pad rows added to fill windows"),
     # ---- data hygiene
     "data.quarantined_rows": ("counter", "rows quarantined as unreadable"),
     "data.quarantined_shards": ("counter", "shards quarantined as torn"),
@@ -71,6 +73,11 @@ MANIFEST: Dict[str, Tuple[str, str]] = {
     "device.bytes_limit": ("gauge", "HBM capacity"),
     "xla.compile_count": ("counter", "XLA compilations observed"),
     "xla.compile_time_s": ("counter", "XLA compile wall-clock"),
+    # ---- cost-attribution plane (obs/costs)
+    "xla.recompiles": ("counter",
+                       "costed executables rebuilt for a NEW input "
+                       "signature (the shape-churn sentinel)"),
+    "xla.launches": ("counter", "costed executable launches"),
     # ---- drift monitor (obs/drift)
     "drift.rows": ("gauge", "rows folded into the live drift counts"),
     "drift.columns_tracked": ("gauge", "columns with a training snapshot"),

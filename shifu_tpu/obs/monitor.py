@@ -15,10 +15,16 @@ from any process, with no coordination.
 
 from __future__ import annotations
 
+import json
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import tracer
 from .health import classify, health_dir_for, read_health
+
+# `monitor --once --json` exit code when any process is stalled/stale —
+# distinct from generic failure (1) and the bench/schema mismatch (2)
+EXIT_UNHEALTHY = 3
 
 _STATE_FLAGS = {"live": "", "stalled": "  << STALLED (no progress)",
                 "stale": "  << STALE (no heartbeat)", "exited": ""}
@@ -84,20 +90,60 @@ def render_status(model_set_dir: str, now: Optional[float] = None) -> str:
     return "\n".join(out)
 
 
+def status_json(model_set_dir: str, now: Optional[float] = None
+                ) -> Tuple[Dict[str, Any], int]:
+    """(one machine-readable snapshot doc, exit code) — the ``monitor
+    --once --json`` payload CI/cron scripts consume instead of scraping
+    the human table.  Exit 0 when every process is live/exited (or the
+    dir is empty: nothing running is not unhealthy); EXIT_UNHEALTHY (3)
+    when ANY process is stalled or stale."""
+    now = time.time() if now is None else now
+    recs, counts = status_records(model_set_dir, now=now)
+    for rec in recs:
+        rec.pop("_file", None)               # host path, not health state
+    healthy = counts.get("live", 0) + counts.get("stalled", 0)
+    active = len(recs) - counts.get("exited", 0)
+    unhealthy = counts.get("stalled", 0) + counts.get("stale", 0)
+    doc = {
+        "kind": "monitor",
+        "schema_version": tracer.SCHEMA_VERSION,
+        "ts": round(now, 3),
+        "health_dir": health_dir_for(model_set_dir),
+        "procs": recs,
+        "summary": {
+            "total": len(recs),
+            "counts": {k: counts.get(k, 0)
+                       for k in ("live", "stalled", "stale", "exited")},
+            "active": active,
+            "healthy": healthy,
+            "quorum": round(healthy / active, 4) if active else 1.0,
+        },
+    }
+    return doc, (EXIT_UNHEALTHY if unhealthy else 0)
+
+
 def run_monitor(model_set_dir: str, interval_s: float = 2.0,
                 once: bool = False, max_frames: Optional[int] = None,
-                _print=print) -> int:
+                json_mode: bool = False, _print=print) -> int:
     """The CLI loop: render a frame every ``interval_s`` until
-    interrupted (``--once`` renders a single frame).  Always exits 0 —
-    an empty health dir is a message, not an error."""
+    interrupted (``--once`` renders a single frame).  The human table
+    always exits 0 — an empty health dir is a message, not an error;
+    ``json_mode`` prints one JSON doc per frame and carries the health
+    exit code (0 ok / 3 any stalled-or-stale) so scripts can gate on
+    it."""
     frames = 0
+    rc = 0
     try:
         while True:
-            _print(render_status(model_set_dir))
+            if json_mode:
+                doc, rc = status_json(model_set_dir)
+                _print(json.dumps(doc, sort_keys=True))
+            else:
+                _print(render_status(model_set_dir))
             frames += 1
             if once or (max_frames is not None and frames >= max_frames):
-                return 0
+                return rc if json_mode else 0
             _print("")
             time.sleep(interval_s)
     except KeyboardInterrupt:
-        return 0
+        return rc if json_mode else 0

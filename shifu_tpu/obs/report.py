@@ -34,10 +34,10 @@ def trace_path(model_set_dir: str) -> str:
 def load_blocks(path: str,
                 skipped: Optional[List[str]] = None) -> List[Dict[str, Any]]:
     """Parse the JSONL into flush blocks: ``{"meta", "spans", "events",
-    "metrics"}`` per block.  Unparseable lines — a crash mid-write tears
-    the final line — are SKIPPED with a warning (and appended to
-    ``skipped`` when given), never a parse failure: a crashed run's
-    partial trace is exactly the one you want to read."""
+    "metrics", "costs"}`` per block.  Unparseable lines — a crash
+    mid-write tears the final line — are SKIPPED with a warning (and
+    appended to ``skipped`` when given), never a parse failure: a
+    crashed run's partial trace is exactly the one you want to read."""
     blocks: List[Dict[str, Any]] = []
     with open(path) as f:
         for lineno, line in enumerate(f, 1):
@@ -56,17 +56,20 @@ def load_blocks(path: str,
             kind = rec.get("kind")
             if kind == "meta":
                 blocks.append({"meta": rec, "spans": [], "events": [],
-                               "metrics": []})
+                               "metrics": [], "costs": []})
                 continue
             if not blocks:       # tolerate a headerless fragment
                 blocks.append({"meta": {"step": None, "ts": None},
-                               "spans": [], "events": [], "metrics": []})
+                               "spans": [], "events": [], "metrics": [],
+                               "costs": []})
             if kind == "span":
                 blocks[-1]["spans"].append(rec)
             elif kind == "event":
                 blocks[-1]["events"].append(rec)
             elif kind == "metric":
                 blocks[-1]["metrics"].append(rec)
+            elif kind == "cost":
+                blocks[-1]["costs"].append(rec)
     return blocks
 
 

@@ -21,12 +21,15 @@ track.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Any, Dict, List, Optional
 
 from ..ioutil import atomic_write_text
 from . import tracer
 from .report import load_blocks, trace_path
+
+log = logging.getLogger(__name__)
 
 # fixed tids per process: compute first so it sorts on top in viewers
 TID_MAIN = 1
@@ -46,15 +49,28 @@ def _us(seconds: float) -> int:
     return int(round(float(seconds) * 1e6))
 
 
-def to_trace_events(blocks: List[Dict[str, Any]]) -> Dict[str, Any]:
+def to_trace_events(blocks: List[Dict[str, Any]],
+                    skipped: Optional[List[str]] = None) -> Dict[str, Any]:
     """Trace Event Format document (JSON-object flavour) for a parsed
-    trace (see :func:`shifu_tpu.obs.report.load_blocks`)."""
+    trace (see :func:`shifu_tpu.obs.report.load_blocks`).  Cost records
+    (schema v6) annotate the output: every block's ROOT spans carry the
+    block's total flops / bytes_accessed in ``args`` (Perfetto shows
+    them in the span details pane) and each costed executable lands as
+    an instant ``cost:<name>`` event with its per-signature numbers."""
     events: List[Dict[str, Any]] = []
     seen_pids: Dict[int, str] = {}
     for bi, block in enumerate(blocks):
         meta = block["meta"]
         pid = int(meta.get("pid") or (100000 + bi))
         step = meta.get("step") or "(unlabeled)"
+        costs = block.get("costs") or []
+        tot_flops = sum((c.get("flops") or 0.0)
+                        * max(int(c.get("launches") or 0), 1)
+                        for c in costs)
+        tot_bytes = sum((c.get("bytes_accessed") or 0.0)
+                        * max(int(c.get("launches") or 0), 1)
+                        for c in costs)
+        by_id = {s["id"]: s for s in block["spans"]}
         if pid not in seen_pids:
             seen_pids[pid] = step
             events.append({"ph": "M", "name": "process_name", "pid": pid,
@@ -68,14 +84,32 @@ def to_trace_events(blocks: List[Dict[str, Any]]) -> Dict[str, Any]:
                                "pid": pid, "tid": tid,
                                "args": {"sort_index": tid}})
         for s in block["spans"]:
+            args = dict(s.get("attrs") or {}, span_id=s.get("id"),
+                        parent=s.get("parent"))
+            if costs and s.get("parent") not in by_id:
+                # root span: the block's cost totals, visible in the
+                # span-details pane
+                args["flops"] = tot_flops
+                args["bytes_accessed"] = tot_bytes
             events.append({
                 "ph": "X", "name": s["name"], "cat": "span",
                 "pid": pid,
                 "tid": TID_INGEST if _is_ingest(s) else TID_MAIN,
                 "ts": _us(s.get("ts") or 0.0),
                 "dur": max(1, _us(s.get("dur_s") or 0.0)),
-                "args": dict(s.get("attrs") or {}, span_id=s.get("id"),
-                             parent=s.get("parent")),
+                "args": args,
+            })
+        for c in costs:
+            events.append({
+                "ph": "i", "s": "t", "name": f"cost:{c.get('name')}",
+                "cat": "cost", "pid": pid, "tid": TID_MAIN,
+                "ts": _us(meta.get("ts") or 0.0),
+                "args": {"signature": c.get("signature"),
+                         "flops": c.get("flops"),
+                         "bytes_accessed": c.get("bytes_accessed"),
+                         "launches": c.get("launches"),
+                         "compiles": c.get("compiles"),
+                         "analytic": bool(c.get("analytic"))},
             })
         for e in block["events"]:
             events.append({
@@ -92,20 +126,33 @@ def to_trace_events(blocks: List[Dict[str, Any]]) -> Dict[str, Any]:
             "source": "shifu-tpu telemetry",
             "schema_version": tracer.SCHEMA_VERSION,
             "steps": [b["meta"].get("step") for b in blocks],
+            # a crash mid-write tears the final trace line; the export
+            # skips it like report.py does and SURFACES the count here
+            "torn_lines_skipped": len(skipped or []),
         },
     }
 
 
-def export_timeline(model_set_dir: str, out_path: str) -> Optional[str]:
+def export_timeline(model_set_dir: str, out_path: str,
+                    skipped: Optional[List[str]] = None) -> Optional[str]:
     """Convert ``<modelset>/telemetry/trace.jsonl`` to ``out_path``.
     Returns the output path, or ``None`` (nothing written) when there is
-    no telemetry to convert."""
+    no telemetry to convert.  Torn trace lines (crash mid-write) are
+    skipped exactly like ``report.py`` skips them — logged, counted in
+    the output's ``otherData.torn_lines_skipped``, and appended to
+    ``skipped`` when the caller wants to surface them."""
     path = trace_path(model_set_dir)
     if not os.path.isfile(path):
         return None
-    blocks = load_blocks(path)
+    if skipped is None:
+        skipped = []
+    blocks = load_blocks(path, skipped=skipped)
     if not blocks:
         return None
-    doc = to_trace_events(blocks)
+    if skipped:
+        log.warning("timeline export: %d torn trace line(s) skipped "
+                    "(crashed run mid-write?) — the valid prefix was "
+                    "exported", len(skipped))
+    doc = to_trace_events(blocks, skipped=skipped)
     atomic_write_text(out_path, json.dumps(doc))
     return out_path

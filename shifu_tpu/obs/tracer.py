@@ -47,7 +47,14 @@ from typing import Any, Dict, List, Optional
 # track), live-span registry for heartbeats (obs/health), ingest.window_
 # prep / ingest.h2d_wait spans, drift.* gauges (streaming PSI monitor),
 # OpenMetrics snapshot names derive from the same registry records
-SCHEMA_VERSION = 5
+# v6: device cost-attribution plane — ``{"kind": "cost"}`` records per
+# named executable (flops / bytes_accessed / memory / compiles /
+# launches, keyed by abstract input signature; obs/costs), the flush
+# meta carries ``backend`` (platform + device_kind, resolving the peak
+# table for the utilization report), xla.recompiles / xla.launches and
+# ingest.rows_padded counters, timeline span args annotated with
+# flops/bytes
+SCHEMA_VERSION = 6
 
 _TRUE = ("1", "true", "on", "yes")
 
@@ -298,23 +305,27 @@ def flush(path: str, step: Optional[str] = None,
     both.  Returns False (and writes nothing) when telemetry is off."""
     if not enabled():
         return False
-    from . import registry
+    from . import costs, registry
     records = _collector.drain()
     metrics = registry.snapshot(reset=True)
+    cost_recs = costs.cost_snapshot(reset=True)
     meta: Dict[str, Any] = {"kind": "meta", "schema_version": SCHEMA_VERSION,
                             "step": step, "ts": round(time.time(), 3),
-                            "pid": os.getpid()}
+                            "pid": os.getpid(),
+                            "backend": costs.backend_info()}
     if extra_meta:
         meta.update(extra_meta)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "a") as f:
-        for rec in [meta] + records + metrics:
+        for rec in [meta] + records + metrics + cost_recs:
             f.write(json.dumps(rec) + "\n")
     return True
 
 
 def reset_for_tests() -> None:
+    from . import costs
     from .registry import get_registry
     set_enabled(None)
     _collector.clear()
     get_registry().reset()
+    costs.reset_for_tests()

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config.model_config import BinningMethod
+from ..obs import costs as obs_costs
 
 # merged-category group separator (reference uses \u0001 in CategoricalBinInfo)
 CATEGORY_GROUP_SEP = "\x01"
@@ -36,7 +37,11 @@ NEG_INF = float("-inf")
 
 
 # ----------------------------------------------------------------- kernels
-@jax.jit
+# Stats-plane executables are cost-attributed (obs/costs) so the
+# utilization report can say whether the fused sweep is compute- or
+# bandwidth-bound; ``lazy=True`` because these wrap at module import,
+# before the CLI's --telemetry flips the telemetry switch.
+@obs_costs.costed_jit("stats.moments_kernel", lazy=True)
 def _moments_kernel(x: jnp.ndarray, valid: jnp.ndarray):
     """Per-column count/sum/min/max + centered M2/M3/M4 for one chunk.
 
@@ -77,9 +82,9 @@ def _stat_channels(target, weight, unit_weight: bool):
          jnp.where(is_pos, 0.0, w)], axis=1), (True, True, False, False)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("num_buckets", "use_pallas",
-                                    "unit_weight", "expand", "mesh"))
+@obs_costs.costed_jit("stats.histogram_kernel", lazy=True,
+                      static_argnames=("num_buckets", "use_pallas",
+                                       "unit_weight", "expand", "mesh"))
 def _histogram_kernel(x: jnp.ndarray, valid: jnp.ndarray, target: jnp.ndarray,
                       weight: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
                       num_buckets: int, use_pallas: bool = False,
@@ -155,7 +160,8 @@ def _combine_moments(a: dict, b: Tuple[np.ndarray, ...]) -> dict:
             "max": np.maximum(a["max"], mxb)}
 
 
-@functools.partial(jax.jit, static_argnames=("unit_weight", "expand"))
+@obs_costs.costed_jit("stats.missing_agg", lazy=True,
+                      static_argnames=("unit_weight", "expand"))
 def _missing_agg_kernel(valid, target, weight, live=None,
                         unit_weight: bool = False, expand: bool = True):
     """[C, 4] (pos/neg/w_pos/w_neg) sums over INVALID cells — the
@@ -194,7 +200,8 @@ def _method_weight_col(hist, method_value: str, nch: int):
     }.get(method_value, pos + neg)
 
 
-@functools.partial(jax.jit, static_argnames=("num_buckets",))
+@obs_costs.costed_jit("stats.refine_prov", lazy=True,
+                      static_argnames=("num_buckets",))
 def _refine_prov_kernel(prov, plo, phi, lo, hi, num_buckets: int):
     """Re-bin a PROVISIONAL-grid fine histogram onto the exact final grid,
     on device (the fused one-pass sweep's refinement step — see

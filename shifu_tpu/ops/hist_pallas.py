@@ -255,6 +255,39 @@ K_MAX = 64   # per-call node cap: the [C_pad, S, K, B_pad] output must sit
              # under the ~16 MB VMEM scoped-allocation limit
 
 
+# -------------------------------------------------- analytic cost model
+# A pallas_call is an opaque custom call to XLA's cost analysis — the
+# flops/bytes the obs cost plane would read off ``lowered.
+# cost_analysis()`` come back zero.  This hand model of the one-hot MXU
+# formulation registers with obs.costs under ``pallas.hist`` so the
+# utilization report still attributes the kernel's work (the streamed
+# trainers record one model launch per window when the kernel path is
+# on).
+def hist_kernel_cost(rows: int, n_feat: int, n_bins: int, n_nodes: int,
+                     n_stats: int = 2, n_trees: int = 1) -> dict:
+    """FLOPs / bytes of one histogram-kernel launch.
+
+    Dominant term: per (feature, stat channel) the kernel feeds the MXU
+    a [K, N] x [N, B] dot (node one-hot x bin one-hot) — 2*K*N*B MACs —
+    plus the VPU one-hot constructions (~N*B + N*K compares).  Bytes:
+    bins read once per launch (int32 in VMEM after the in-graph widen),
+    stats per tree, and the [K, C, B, S] output written once.
+    """
+    dot = 2.0 * rows * n_nodes * n_bins * n_stats * n_feat * n_trees
+    onehot = float(rows) * (n_bins + n_nodes) * n_feat * n_trees
+    read = 4.0 * rows * n_feat + 4.0 * rows * n_stats * n_trees
+    write = 4.0 * n_trees * n_nodes * n_feat * n_bins * n_stats
+    return {"flops": dot + onehot, "bytes_accessed": read + write}
+
+
+def _register_cost_model() -> None:
+    from ..obs import costs
+    costs.register_cost_model("pallas.hist", hist_kernel_cost)
+
+
+_register_cost_model()
+
+
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "interpret",
                                    "exact"))
 def build_histograms_pallas(bins, node_idx, stats, n_nodes: int,

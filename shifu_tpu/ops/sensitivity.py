@@ -40,6 +40,7 @@ loop's unweighted mean exactly on resident data.
 from __future__ import annotations
 
 import math
+from functools import partial
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -161,7 +162,8 @@ def streamed_sensitivity(stream, spec, params, masks: np.ndarray,
     # default-config TPU rigs
     acc_dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
-    @jax.jit
+    # cost-attributed varsel-plane entry points (obs/costs)
+    @partial(obs.costed_jit, "varsel.base_window")
     def base_window(params, x, y, w, sum_x, stats):
         """Pass 1: feature sums (→ mean_x) + unfrozen base error."""
         per = _per_row_sq_err(nn_model.forward(params, spec, x), y)
@@ -194,7 +196,7 @@ def streamed_sensitivity(stream, spec, params, masks: np.ndarray,
             return (_per_row_sq_err(pred, y) * w).sum()
         return acc_b + jax.vmap(one)(idx_b).astype(acc_b.dtype)
 
-    @jax.jit
+    @partial(obs.costed_jit, "varsel.first_mask_window")
     def first_mask_window(params, idx_b, mean_x, x, y, w, acc_b):
         """The window's FIRST mask batch also emits the shared operands:
         base pre-activation z and the padded frozen-delta matrix dx —
@@ -205,7 +207,7 @@ def streamed_sensitivity(stream, spec, params, masks: np.ndarray,
             axis=1)
         return _mask_scores(params, idx_b, z, dxp, y, w, acc_b), z, dxp
 
-    @jax.jit
+    @partial(obs.costed_jit, "varsel.mask_window")
     def mask_window(params, idx_b, z, dxp, y, w, acc_b):
         return _mask_scores(params, idx_b, z, dxp, y, w, acc_b)
 

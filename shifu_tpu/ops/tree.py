@@ -192,6 +192,27 @@ def _hist_scatter(bins, node_idx, stats, n_nodes: int, n_bins: int):
     return out.reshape(c, n_nodes, n_bins, -1).transpose(1, 0, 2, 3)
 
 
+# -------------------------------------------------- analytic cost model
+# the scatter lowering's hand model, the CPU-side sibling of
+# ``hist_pallas.hist_kernel_cost`` (registered under ``tree.scatter_hist``
+# with obs.costs): segment_sum does one add per (row, feature, stat
+# channel) plus the index arithmetic; output written once
+def scatter_hist_cost(rows: int, n_feat: int, n_bins: int, n_nodes: int,
+                      n_stats: int = 2, n_trees: int = 1) -> dict:
+    flops = float(rows) * n_feat * (n_stats + 2) * n_trees
+    read = 4.0 * rows * n_feat + 4.0 * rows * n_stats * n_trees
+    write = 4.0 * n_trees * n_nodes * n_feat * n_bins * n_stats
+    return {"flops": flops, "bytes_accessed": read + write}
+
+
+def _register_cost_models() -> None:
+    from ..obs import costs
+    costs.register_cost_model("tree.scatter_hist", scatter_hist_cost)
+
+
+_register_cost_models()
+
+
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "use_pallas",
                                    "mesh", "stats_exact"))
 def build_histograms_batch(bins, node_idx_b, stats_b, n_nodes: int,

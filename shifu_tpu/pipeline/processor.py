@@ -192,6 +192,17 @@ class BasicProcessor:
         counter analogue)."""
         try:
             obs.sample_device_memory()
+            # step-level surface of the shape-churn sentinel: recompiles
+            # accumulated during THIS step (the registry resets at flush)
+            # get one loud summary line beside the per-name warn-once
+            rec = next((m.get("value") for m in obs.snapshot()
+                        if m.get("name") == "xla.recompiles"), None)
+            if rec:
+                log.warning(
+                    "step %s rebuilt %d executable(s) for new input "
+                    "signatures (shape churn defeats the compile cache "
+                    "— see `analysis --telemetry --utilization`)",
+                    self.profile_name, int(rec))
             path = self.paths.telemetry_trace_path if self.paths else \
                 os.path.join(self.dir, "telemetry", "trace.jsonl")
             obs.flush(path, step=self.profile_name)

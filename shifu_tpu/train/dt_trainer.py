@@ -232,9 +232,13 @@ def _gbt_forest_impl(bins, y, tw, vw, f, fa_all, cat, lr, min_instances,
     return f_out, packed
 
 
-_gbt_forest = partial(jax.jit, static_argnames=(
+# cost-attributed (obs/costs, lazy: wrapped at import, telemetry flips
+# later): the resident whole-forest executable — the gbt plane's main
+# cost entry for the utilization report
+_gbt_forest = obs.costed_jit("gbt.forest", _gbt_forest_impl, lazy=True,
+                             static_argnames=(
     "n_bins", "depth", "impurity", "loss", "n_trees", "use_pallas",
-    "max_leaves", "has_cat", "mesh"))(_gbt_forest_impl)
+    "max_leaves", "has_cat", "mesh"))
 
 
 @lru_cache(maxsize=None)
@@ -492,10 +496,11 @@ def _rf_forest_impl(bins, y, w, base_key, tree_ids, bag_rate, oob_sum,
     return oob_sum, oob_cnt, packed
 
 
-_rf_forest = partial(jax.jit, static_argnames=(
+_rf_forest = obs.costed_jit("rf.forest", _rf_forest_impl, lazy=True,
+                            static_argnames=(
     "n_bins", "depth", "impurity", "loss", "poisson", "n_classes",
     "n_trees", "use_pallas", "max_leaves", "has_cat",
-    "mesh", "stats_exact", "tree_batch"))(_rf_forest_impl)
+    "mesh", "stats_exact", "tree_batch"))
 
 
 @lru_cache(maxsize=None)
@@ -1000,8 +1005,11 @@ def train_rf_bagged(bins, y, w_m, n_bins: int, cat_mask,
 
 
 # ------------------------------------------------------------- streaming
-@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level", "loss",
-                                   "use_pallas", "mesh", "left"))
+# streamed/tail executables are cost-attributed under the gbt./rf.
+# planes (obs/costs, lazy: module-scope wrap precedes --telemetry)
+@partial(obs.costed_jit, "gbt.window_hist", lazy=True,
+         static_argnames=("n_nodes", "n_bins", "level", "loss",
+                          "use_pallas", "mesh", "left"))
 def _gbt_window_hist(hist, bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
                      n_bins: int, level: int, loss: str,
                      use_pallas: bool = False, mesh=None,
@@ -1044,7 +1052,8 @@ def _derive_level(full_prev, hl, feat_prev, n_nodes: int):
         n_nodes, hl.shape[1], hl.shape[2], hl.shape[3])
 
 
-@partial(jax.jit, static_argnames=("depth", "loss"))
+@partial(obs.costed_jit, "gbt.window_leaf_raw", lazy=True,
+         static_argnames=("depth", "loss"))
 def _gbt_window_leaf_raw(acc, bins_w, y_w, tw_w, f_w, sf, lm, depth: int,
                          loss: str):
     """Bottom-level raw leaf stat sums for one window — replaces the full
@@ -1063,9 +1072,10 @@ def _set_bottom_leaves(lv, raw, depth: int):
 
 
 # ------------------------------------------- coarse-to-fine disk tail
-@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
-                                   "use_pallas", "max_leaves", "has_cat",
-                                   "mesh", "has_prev", "cand_k"))
+@partial(obs.costed_jit, "gbt.tail_head", lazy=True,
+         static_argnames=("n_bins", "depth", "impurity", "loss",
+                          "use_pallas", "max_leaves", "has_cat",
+                          "mesh", "has_prev", "cand_k"))
 def _gbt_tail_head(bins, y, tw, vw, f, sf_p, lm_p, lv_p, fa, cat, lr, mi,
                    mg, tail_extra, valid_upto, n_bins: int, depth: int,
                    impurity: str, loss: str, use_pallas: bool = False,
@@ -1135,8 +1145,9 @@ def _tail_extras(hl_acc, hl_res, cand_idx, c: int, cand: bool = False):
     return tail
 
 
-@partial(jax.jit, static_argnames=("n_bins", "depth", "loss", "use_pallas",
-                                   "mesh", "has_prev", "cand"))
+@partial(obs.costed_jit, "gbt.tail_window_pass", lazy=True,
+         static_argnames=("n_bins", "depth", "loss", "use_pallas",
+                          "mesh", "has_prev", "cand"))
 def _gbt_tail_window_pass(hist_left, leaf_raw, sums, bins_w, y_w, tw_w,
                           vw_w, f_w, sf_p, lm_p, lv_p, sf_c, lm_c,
                           cand_idx, lr, n_bins: int, depth: int, loss: str,
@@ -1161,8 +1172,9 @@ def _gbt_tail_window_pass(hist_left, leaf_raw, sums, bins_w, y_w, tw_w,
     return hist_left + hl, leaf_raw + lraw, sums, f_w
 
 
-@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity",
-                                   "max_leaves", "has_cat", "cand"))
+@partial(obs.costed_jit, "gbt.tail_select", lazy=True,
+         static_argnames=("n_bins", "depth", "impurity",
+                          "max_leaves", "has_cat", "cand"))
 def _gbt_tail_select(hist_left, leaf_raw, sf_c, lm_c, cand_idx, cat, fa,
                      mi, mg, n_bins: int, depth: int, impurity: str,
                      max_leaves: int = 0, has_cat: bool = True,
@@ -1281,9 +1293,10 @@ def _rf_stats_batch(y_w, w_w, bags_b, n_classes: int):
         .astype(jnp.float32)
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "level",
-                                   "use_pallas", "mesh", "n_classes",
-                                   "stats_exact", "left", "poisson"))
+@partial(obs.costed_jit, "rf.window_hist_batch", lazy=True,
+         static_argnames=("n_nodes", "n_bins", "level",
+                          "use_pallas", "mesh", "n_classes",
+                          "stats_exact", "left", "poisson"))
 def _rf_window_hist_batch(hist_b, bins_w, y_w, w_w, idx_hi, idx_lo,
                           khi_b, klo_b, thi, tlo, sf_b, lm_b,
                           n_nodes: int, n_bins: int, level: int,
@@ -1325,7 +1338,8 @@ def _derive_level_batch(full_prev_b, hl_b, feat_prev_b, n_nodes: int):
         full_prev_b, hl_b, feat_prev_b)
 
 
-@partial(jax.jit, static_argnames=("depth", "n_classes", "poisson"))
+@partial(obs.costed_jit, "rf.window_leaf_batch", lazy=True,
+         static_argnames=("depth", "n_classes", "poisson"))
 def _rf_window_leaf_batch(raw_b, bins_w, y_w, w_w, idx_hi, idx_lo, khi_b,
                           klo_b, thi, tlo, sf_b, lm_b, depth: int,
                           n_classes: int = 0, poisson: bool = True):
@@ -1350,7 +1364,8 @@ def _set_bottom_leaves_batch(lv_b, raw_b, depth: int, n_classes: int = 0):
     return lv_b.at[:, base:].set(vals)
 
 
-@partial(jax.jit, static_argnames=("depth", "loss"))
+@partial(obs.costed_jit, "gbt.window_update", lazy=True,
+         static_argnames=("depth", "loss"))
 def _gbt_window_update(sums_in, bins_w, y_w, tw_w, vw_w, f_w, sf, lm, lv,
                        lr, depth: int, loss: str):
     """``sums_in`` accumulator as input — see :func:`_gbt_window_hist` on
@@ -1402,8 +1417,8 @@ def _rf_window_update(sums_in, bins_w, y_w, w_w, bag_w, oob_sum_w,
     return oob_sum2, oob_cnt2, sums_in + sums
 
 
-@partial(jax.jit, static_argnames=("depth", "loss", "n_classes",
-                                   "poisson"))
+@partial(obs.costed_jit, "rf.window_update_batch", lazy=True,
+         static_argnames=("depth", "loss", "n_classes", "poisson"))
 def _rf_window_update_batch(sums_b, bins_w, y_w, w_w, idx_hi, idx_lo,
                             khi_b, klo_b, thi, tlo, oob_sum_w, oob_cnt_w,
                             sf_b, lm_b, lv_b, depth: int, loss: str,
@@ -1959,6 +1974,14 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                         it.arrays["tw"], window_f(it), sf, lm, width,
                         n_bins, level, settings.loss, up,
                         _hist_mesh(mesh), left)
+                    if up:
+                        # the pallas launch inside the program is opaque
+                        # to XLA's cost analysis — record the analytic
+                        # model (ops/hist_pallas) per window launch
+                        obs.record_model_launch(
+                            "pallas.hist",
+                            rows=int(it.arrays["bins"].shape[0]),
+                            n_feat=c, n_bins=n_bins, n_nodes=width)
                     if it.resident:
                         hist_res = hist
                 if left:
@@ -2314,14 +2337,18 @@ def _concat_rows(xs):
     return xs[0] if len(xs) == 1 else _concat_rows_jit(len(xs))(*xs)
 
 
-_gbt_round_streamed = partial(jax.jit, static_argnames=(
-    "n_bins", "depth", "impurity", "loss", "use_pallas", "max_leaves",
-    "has_cat", "mesh"))(
-    lambda bins, y, tw, vw, f, fa, cat, lr, mi, mg, n_bins, depth,
-    impurity, loss, use_pallas, max_leaves, has_cat, mesh:
-    _pack_round_streamed(*_gbt_round_impl(
+def _gbt_round_streamed_impl(bins, y, tw, vw, f, fa, cat, lr, mi, mg,
+                             n_bins, depth, impurity, loss, use_pallas,
+                             max_leaves, has_cat, mesh):
+    return _pack_round_streamed(*_gbt_round_impl(
         bins, y, tw, vw, f, fa, cat, lr, mi, mg, n_bins, depth, impurity,
-        loss, use_pallas, max_leaves, has_cat, mesh)))
+        loss, use_pallas, max_leaves, has_cat, mesh))
+
+
+_gbt_round_streamed = obs.costed_jit(
+    "gbt.round_streamed", _gbt_round_streamed_impl, lazy=True,
+    static_argnames=("n_bins", "depth", "impurity", "loss", "use_pallas",
+                     "max_leaves", "has_cat", "mesh"))
 
 
 def _pack_round_streamed(sf, lm, lv, gfi, f2, tr, va):
@@ -2334,10 +2361,11 @@ def _pack_round_streamed(sf, lm, lv, gfi, f2, tr, va):
         jnp.stack([tr, one, va, one])]), f2
 
 
-@partial(jax.jit, static_argnames=("n_bins", "depth", "impurity", "loss",
-                                   "poisson", "n_classes", "use_pallas",
-                                   "max_leaves", "has_cat", "mesh",
-                                   "stats_exact"))
+@partial(obs.costed_jit, "rf.round_streamed", lazy=True,
+         static_argnames=("n_bins", "depth", "impurity", "loss",
+                          "poisson", "n_classes", "use_pallas",
+                          "max_leaves", "has_cat", "mesh",
+                          "stats_exact"))
 def _rf_round_streamed(bins, y, w, idx_hi, idx_lo, khi, klo, thi, tlo,
                        oob_sum, oob_cnt, fa, cat, mi, mg, n_bins: int,
                        depth: int, impurity: str, loss: str,
@@ -2655,6 +2683,12 @@ def train_rf_streamed(stream, n_bins: int, cat_mask, settings: DTSettings,
                     _hist_mesh(mesh), settings.n_classes,
                     settings.stats_exact, left,
                     settings.poisson_bagging)
+                if up:
+                    obs.record_model_launch(
+                        "pallas.hist",
+                        rows=int(it.arrays["bins"].shape[0]),
+                        n_feat=c, n_bins=n_bins, n_nodes=width,
+                        n_stats=n_stats, n_trees=TB)
             if left:
                 feat_prev_b = jax.lax.dynamic_slice_in_dim(
                     sf_b, width - 1, width, axis=1)

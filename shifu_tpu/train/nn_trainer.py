@@ -349,13 +349,16 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
 
     y_axis = None if ymd is None else 0    # per-member targets vmap over B
 
-    @jax.jit
+    # cost-attributed entry points: the full-batch step, the scanned
+    # epoch sweep and the eval pass are THE nn-plane executables the
+    # utilization report joins against the TRAIN span (obs/costs)
+    @partial(obs.costed_jit, "nn.step")
     def step(stacked, opt_state, xb, yb, tw, rngs, lr_scale):
         return jax.vmap(member_update,
                         in_axes=(0, 0, None, y_axis, 0, 0, 0, None))(
             stacked, opt_state, xb, yb, tw, rngs, hd, lr_scale)
 
-    @jax.jit
+    @partial(obs.costed_jit, "nn.eval_errors")
     def eval_errors(stacked, tw, vw, xe, ys):
         # data arrays enter as ARGUMENTS: closing over a multi-host-sharded
         # array is an error under multiple controllers
@@ -435,7 +438,8 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
                         in_axes=(0, 0, None, y_axis, 0, 0, 0, None))(
             stacked, opt_state, xb, yb, twb, rngs, hd, lr_scale)
 
-    @partial(jax.jit, static_argnames=("blen", "n_b"))
+    @partial(obs.costed_jit, "nn.epoch_steps",
+             static_argnames=("blen", "n_b"))
     def epoch_steps(stacked, opt_state, rngs, lr_scale, xe, ye, twe,
                     blen: int, n_b: int):
         """A whole epoch's minibatch sweep as ONE executable (lax.scan over
@@ -643,7 +647,9 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
     cls_arr = None if member_classes is None else \
         jnp.asarray(member_classes, jnp.float32)
 
-    @jax.jit
+    # streamed nn-plane entry points, cost-attributed (obs/costs): the
+    # per-window grad/eval programs are where streamed NN wall-clock goes
+    @partial(obs.costed_jit, "nn.grad_eval_window")
     def grad_eval_window(stacked, grad_acc, stats_acc, xb, yb, tw, vw, rngs):
         def one(params, mw, vwm, rng, ci):
             ym = yb if cls_arr is None else (yb == ci).astype(yb.dtype)
@@ -654,7 +660,7 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
         grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
         return grad_acc, stats_acc + stats
 
-    @jax.jit
+    @partial(obs.costed_jit, "nn.eval_window")
     def eval_window(stacked, stats_acc, xb, yb, tw, vw):
         def one(params, mw, vwm, ci):
             ym = yb if cls_arr is None else (yb == ci).astype(yb.dtype)
@@ -663,7 +669,7 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
         stats = jax.vmap(one)(stacked, tw, vw, cis)
         return stats_acc + stats
 
-    @jax.jit
+    @partial(obs.costed_jit, "nn.apply_update")
     def apply_update(stacked, opt_state, grad_acc, train_wsum, lr_scale):
         def one(params, ostate, grads, wsum):
             inv = 1.0 / jnp.maximum(wsum, 1e-9)
@@ -677,7 +683,8 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
             return params, ostate
         return jax.vmap(one)(stacked, opt_state, grad_acc, train_wsum)
 
-    @partial(jax.jit, static_argnames=("blen",))
+    @partial(obs.costed_jit, "nn.minibatch_window",
+             static_argnames=("blen",))
     def minibatch_window(stacked, opt_state, xw, yw, tww, rngs, lr_scale,
                          start, blen: int):
         # slice INSIDE jit: dynamic_slice of the sharded window compiles

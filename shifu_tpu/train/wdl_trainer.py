@@ -17,6 +17,7 @@ import logging
 import os
 import time
 from dataclasses import dataclass
+from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -180,12 +181,14 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
         params = jax.tree_util.tree_map(lambda p, d: p + d, params, delta)
         return params, ostate, loss
 
-    @jax.jit
+    # cost-attributed wdl-plane entry points (obs/costs): the utilization
+    # report joins these against the TRAIN span wall-clock
+    @partial(obs.costed_jit, "wdl.step")
     def step(stacked, opt_state, xnb, xcb, yb, tw):
         return jax.vmap(member_update, in_axes=(0, 0, None, None, None, 0))(
             stacked, opt_state, xnb, xcb, yb, tw)
 
-    @jax.jit
+    @partial(obs.costed_jit, "wdl.eval_errors")
     def eval_errors(stacked, tw, vw):
         def one(params, mw):
             p = wdl_model.forward(params, spec, xnd, xcd)
@@ -207,7 +210,7 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
         return jax.vmap(member_update, in_axes=(0, 0, None, None, None, 0))(
             stacked, opt_state, xnb, xcb, yb, twb)
 
-    @partial(jax.jit, static_argnames=("blen",))
+    @partial(obs.costed_jit, "wdl.epoch_steps", static_argnames=("blen",))
     def epoch_steps(stacked, opt_state, starts, blen: int):
         """One epoch's minibatch sweep as ONE executable (lax.scan over the
         permuted batch starts) — see nn_trainer.epoch_steps."""
@@ -371,7 +374,7 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
         return jnp.stack([(per * mw).sum(), mw.sum(),
                           (per * vw).sum(), vw.sum()])
 
-    @jax.jit
+    @partial(obs.costed_jit, "wdl.grad_eval_window")
     def grad_eval_window(stacked, grad_acc, stats_acc, xnb, xcb, yb, tw, vw):
         def one(params, mw, vwm):
             _, grads = jax.value_and_grad(_loss_sum)(params, xnb, xcb, yb, mw)
@@ -380,13 +383,13 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
         grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
         return grad_acc, stats_acc + stats
 
-    @jax.jit
+    @partial(obs.costed_jit, "wdl.eval_window")
     def eval_window(stacked, stats_acc, xnb, xcb, yb, tw, vw):
         stats = jax.vmap(_eval_sums, in_axes=(0, None, None, None, 0, 0))(
             stacked, xnb, xcb, yb, tw, vw)
         return stats_acc + stats
 
-    @jax.jit
+    @partial(obs.costed_jit, "wdl.apply_update")
     def apply_update(stacked, opt_state, grad_acc, train_wsum):
         def one(params, ostate, grads, wsum):
             inv = 1.0 / jnp.maximum(wsum, 1e-9)

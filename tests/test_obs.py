@@ -16,6 +16,8 @@ import jax.numpy as jnp
 
 from shifu_tpu import obs
 
+pytestmark = pytest.mark.obs        # `pytest -m obs` collects this suite
+
 
 @pytest.fixture
 def telemetry():
@@ -175,6 +177,10 @@ def test_disabled_mode_writes_nothing(telemetry_off, tmp_path):
     assert obs.start_drift_monitor([]) is None
     assert not os.path.exists(str(tmp_path / "health"))
     assert not os.path.exists(str(tmp_path / "telemetry"))
+    # v6 cost plane: analytic-model recording is a no-op too
+    obs.record_model_launch("pallas.hist", rows=8, n_feat=2, n_bins=4,
+                            n_nodes=1)
+    assert obs.cost_snapshot() == []
 
 
 def test_disabled_processor_writes_no_telemetry_files(telemetry_off,
@@ -272,6 +278,45 @@ def test_disabled_telemetry_overhead_within_noise(telemetry_off):
     assert obs.pending_records() == []       # and truly recorded nothing
 
 
+def test_disabled_costed_jit_is_bare_jit(telemetry_off):
+    """The cost plane rides the same zero-overhead guarantee: telemetry
+    off at wrap time ⇒ costed_jit returns THE bare jax.jit callable (no
+    wrapper frames), the lazy (module-scope) form costs one branch per
+    call, and neither writes a cost record."""
+    from shifu_tpu.obs import costs
+
+    def f(x):
+        return (x * 2.0).sum()
+
+    bare = costs.costed_jit("test.bare", f)
+    # not a wrapper: the exact type jax.jit returns
+    assert type(bare) is type(jax.jit(f))
+    assert not isinstance(bare, costs.CostedJit)
+    x = jnp.ones((256,))
+    float(bare(x))
+    assert costs.cost_snapshot() == []       # no registry writes
+
+    lz = costs.costed_jit("test.lazy", f, lazy=True)
+    jb = jax.jit(f)
+    float(lz(x)), float(jb(x))               # compile both outside timing
+
+    def best(fn):
+        out = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(200):
+                fn(x)
+            out.append(time.perf_counter() - t0)
+        return min(out)
+
+    t_plain, t_lazy = best(jb), best(lz)
+    assert t_lazy <= t_plain * 1.5 + 1e-3, \
+        (f"disabled lazy costed_jit overhead too high: {t_lazy:.4f}s vs "
+         f"{t_plain:.4f}s bare jit")
+    assert costs.cost_snapshot() == []
+    assert obs.pending_records() == []
+
+
 def test_bench_schema_matches_obs():
     """bench.py must fail loudly when its emitted schema version and the
     obs schema diverge — this pin is the loud failure's test double.
@@ -279,14 +324,18 @@ def test_bench_schema_matches_obs():
     plane); v4 the disk-tail super-batch round (tail_* extras +
     train.tail_sweeps / tail_repairs counters); v5 the observability
     plane v2 (tid on span records, drift.* gauges, health heartbeats,
-    OpenMetrics snapshots, bench --compare): the version must be
-    current AND the planes registered, so a schema bump cannot land
-    without the emissions being re-validated."""
-    from shifu_tpu.bench import (BENCH_TELEMETRY_SCHEMA,
+    OpenMetrics snapshots, bench --compare); v6 the device
+    cost-attribution plane (cost records per executable, *_mfu /
+    *_achieved_bw extras, xla.recompiles sentinel, --compare auto
+    mode): the version must be current AND the planes registered, so a
+    schema bump cannot land without the emissions being
+    re-validated."""
+    from shifu_tpu.bench import (BENCH_TELEMETRY_SCHEMA, _mfu_extras,
                                  bench_gbt_streamed_tail, bench_varsel,
-                                 run_compare)
+                                 is_tracked_throughput,
+                                 resolve_compare_paths, run_compare)
     assert BENCH_TELEMETRY_SCHEMA == obs.SCHEMA_VERSION
-    assert BENCH_TELEMETRY_SCHEMA >= 5          # observability-plane era
+    assert BENCH_TELEMETRY_SCHEMA >= 6          # cost-attribution era
     assert callable(bench_varsel)
     assert callable(bench_gbt_streamed_tail)
     assert callable(run_compare)                # the BENCH_r0N reader
@@ -296,6 +345,17 @@ def test_bench_schema_matches_obs():
     assert callable(exporter.render_openmetrics)
     assert callable(health.start_heartbeat)
     assert callable(drift.start_drift_monitor)
+    # v6 surfaces: the cost plane + its bench emissions
+    from shifu_tpu.obs import costs, utilization
+    assert callable(costs.costed_jit)
+    assert callable(costs.record_executable)
+    assert callable(utilization.render_utilization)
+    assert callable(_mfu_extras)
+    assert callable(resolve_compare_paths)      # --compare auto mode
+    # the compare gates the v6 utilization extras, not just throughputs
+    assert is_tracked_throughput("nn_train_mfu")
+    assert is_tracked_throughput("wdl_train_achieved_bw")
+    assert not is_tracked_throughput("nn_train_mfu_error")
 
 
 def test_bench_refuses_schema_mismatch(monkeypatch):

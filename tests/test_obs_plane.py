@@ -27,6 +27,8 @@ from shifu_tpu.obs import timeline as timeline_mod
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+pytestmark = pytest.mark.obs        # `pytest -m obs` collects this suite
+
 
 @pytest.fixture
 def telemetry():
