@@ -11,8 +11,10 @@ the spill-cache / H2D pipeline in isolation.
 ``--compare OLD.json NEW.json [--threshold 0.9]`` runs NO benchmark:
 it diffs two recorded payloads (raw bench output or the driver's
 BENCH_r0N wrappers) metric-by-metric, prints a regression table, and
-exits 2 when any tracked throughput metric fell below threshold x old —
-the reader for the in-repo BENCH_r01..r05 trajectory.
+exits 2 when any tracked throughput metric fell below threshold x old
+or any tracked latency metric (*_p50*/*_p99* — lower is better) rose
+above old / threshold — the reader for the in-repo BENCH_r01..
+trajectory.
 
 With SHIFU_TPU_TELEMETRY=1 the per-plane numbers also land as a telemetry
 JSONL block under ./telemetry/ (same schema as the pipeline steps — the
@@ -29,7 +31,7 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--plane",
                     choices=("all", "tail", "rf-repeat", "e2e", "resume",
-                             "varsel"),
+                             "varsel", "serve"),
                     default="all",
                     help="'tail' = quick disk-tail streamed-GBT bench; "
                          "'rf-repeat' = RF variance triage (cold-compile "
@@ -41,7 +43,10 @@ def main() -> None:
                          "checkpoint vs cold/warm starts); 'varsel' = "
                          "streamed mask-batched SE sensitivity vs the "
                          "single-worker per-column loop at identical "
-                         "selections")
+                         "selections; 'serve' = online-serving plane "
+                         "(AOT padded-bucket scorer + micro-batcher: "
+                         "sustained QPS, p50/p99 per offered load, "
+                         "zero-recompile guard)")
     ap.add_argument("--compare", nargs="*", metavar="PAYLOAD.json",
                     default=None,
                     help="regression-diff two bench payloads (raw JSON "

@@ -43,7 +43,13 @@ from . import obs
 # (XLA cost analysis of the timed executable over the device peak
 # table) and --compare TRACKS them; --compare with no arguments diffs
 # the two newest BENCH_r*.json in the repo root.
-BENCH_TELEMETRY_SCHEMA = 6
+# v7: online serving plane — serve.* counters/gauges (requests, batches,
+# rows_padded, flush_full/deadline, swaps, bucket_occupancy,
+# batch_latency_ms), serve_* extras (sustained QPS + p50/p99 per offered
+# load, padding waste, zero-recompile guard); --compare learns the
+# LOWER-is-better metric class (*_p50*/*_p99* latency extras regress
+# when new > old / threshold).
+BENCH_TELEMETRY_SCHEMA = 7
 
 # measured on this rig (tools/measure_baseline.py); provenance in
 # BASELINE.md — every headline divides by a MEASURED reference-class
@@ -946,6 +952,221 @@ def bench_varsel(n_rows: int = 1 << 15, n_features: int = 256,
     }
 
 
+# quick-mode catastrophic floor for the serve plane (sustained QPS at the
+# top offered load; SHIFU_BENCH_SERVE_FLOOR overrides) — far below any
+# functioning rig, exists to catch e.g. a silent per-request-tracing
+# regression, not to benchmark the rig
+SERVE_BENCH_FLOOR = 5000.0
+# low-load p99 must stay bounded by the deadline knob; the slop absorbs
+# CI-rig scheduler noise (SHIFU_BENCH_SERVE_P99_SLOP_MS overrides)
+SERVE_P99_SLOP_MS = 50.0
+
+
+def _serve_open_loop(batcher, pool: np.ndarray, qps: float,
+                     duration_s: float):
+    """Offered-load open-loop client: arrivals on an ideal schedule in
+    ~1 ms bursts (each burst = the single-record requests that landed in
+    that tick), stamps = IDEAL arrival times so the latency percentiles
+    are free of coordinated omission.  Returns (achieved_qps,
+    latencies_s)."""
+    clock = batcher.clock
+    n_target = int(qps * duration_s)
+    period = 1.0 / qps
+    pool_n = len(pool)
+    tickets, sent = [], 0
+    t0 = clock()
+    while sent < n_target:
+        due = min(n_target, int((clock() - t0) / period) + 1)
+        if due <= sent:
+            time.sleep(0.0002)
+            continue
+        idx = np.arange(sent, due)
+        tickets.append(batcher.submit_burst(
+            pool[idx % pool_n], stamps=t0 + idx * period))
+        sent = due
+    for t in tickets:
+        t.wait(30.0)
+    wall = clock() - t0
+    lats = np.concatenate([t.latencies() for t in tickets])
+    return sent / wall, lats
+
+
+def _serve_saturation(batcher, pool: np.ndarray, duration_s: float):
+    """Top offered load: keep ~4 top-bucket bursts outstanding so the
+    device never starves — achieved QPS is the plane's sustained
+    capacity.  Returns (achieved_qps, latencies_s)."""
+    clock = batcher.clock
+    top = batcher._top_bucket()
+    pool_n = len(pool)
+    tickets, done, sent = [], 0, 0
+    t0 = clock()
+    while clock() - t0 < duration_s:
+        while len(tickets) - done > 4:
+            tickets[done].wait(30.0)
+            done += 1
+        idx = (np.arange(sent, sent + top)) % pool_n
+        tickets.append(batcher.submit_burst(pool[idx]))
+        sent += top
+    for t in tickets[done:]:
+        t.wait(30.0)
+    wall = clock() - t0
+    lats = np.concatenate([t.latencies() for t in tickets])
+    return sent / wall, lats
+
+
+def _serve_closed_loop(batcher, pool: np.ndarray, n_threads: int,
+                       duration_s: float):
+    """Closed-loop client fleet: N threads each scoring ONE record at a
+    time synchronously — the reference's per-row production pattern.
+    Returns (achieved_qps, latencies_s)."""
+    import threading
+    clock = batcher.clock
+    lats: list = [[] for _ in range(n_threads)]
+    counts = [0] * n_threads
+
+    def worker(i: int) -> None:
+        j = i * 97
+        end = clock() + duration_s
+        while clock() < end:
+            t = batcher.submit(pool[j % len(pool)])
+            t.wait(10.0)
+            lats[i].append(float(t.latencies()[0]))
+            counts[i] += 1
+            j += 1
+
+    t0 = clock()
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = clock() - t0
+    return sum(counts) / wall, np.asarray(
+        [v for ls in lats for v in ls], np.float64)
+
+
+def bench_serve(n_features: int = 32, n_models: int = 5,
+                hidden: tuple = (64,), low_qps: float = 2000.0,
+                mid_qps: float = 20000.0,
+                duration_s: float = 0.8) -> Dict[str, Any]:
+    """Online-serving plane (``bench.py --plane serve``): the AOT
+    device-resident bagged scorer behind the padded-bucket micro-batcher
+    (``shifu_tpu/serve/``), driven by closed-loop and open-loop clients
+    at several offered loads.
+
+    The reference-class denominator is the measured per-row bagged
+    scorer (``MEASURED_CPU_SCORE_ROWS_PER_SEC`` = 1,505.9 rows/s/worker,
+    BASELINE.md) — the production surface this plane replaces.  Reports
+    sustained QPS, p50/p99 per load, bucket occupancy / padding waste,
+    and enforces the plane's two SLO guards: a warmed server performs
+    ZERO recompiles across the sweep (the shape-churn sentinel), and
+    low-load p99 stays bounded by the ``maxDelayMs`` deadline."""
+    import os
+
+    import jax
+
+    from shifu_tpu.models.nn import (IndependentNNModel, NNModelSpec,
+                                     init_params)
+    from shifu_tpu.serve import ServeServer, serve_recompile_count
+
+    spec = NNModelSpec(input_dim=n_features, hidden_nodes=list(hidden),
+                       activations=["relu"] * len(hidden), output_dim=1)
+    models = [IndependentNNModel(spec,
+                                 init_params(jax.random.PRNGKey(i), spec))
+              for i in range(n_models)]
+    server = ServeServer(models=models, key="bench").start()
+    batcher = server.batcher
+    scorer = server.registry.get("bench")
+    deadline_ms = batcher.max_delay_s * 1000.0
+    rng = np.random.default_rng(0)
+    pool = rng.normal(size=(4096, n_features)).astype(np.float32)
+    try:
+        # warm: every bucket compiled + launched, dispatch paths hot
+        for n in (1, 3, *scorer.buckets):
+            batcher.score_sync(pool[:n])
+        recompiles0 = serve_recompile_count()
+        stats0 = dict(batcher.stats)
+
+        # collector pauses land straight in the tail percentiles (20 ms
+        # p99 spikes at low load measured on this rig) — standard
+        # latency-bench hygiene: no GC inside the measured window
+        import gc
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            closed_qps, closed_lats = _serve_closed_loop(
+                batcher, pool, n_threads=8, duration_s=duration_s / 2)
+            low_ach, low_lats = _serve_open_loop(batcher, pool, low_qps,
+                                                 duration_s)
+            mid_ach, mid_lats = _serve_open_loop(batcher, pool, mid_qps,
+                                                 duration_s)
+            max_ach, max_lats = _serve_saturation(batcher, pool,
+                                                  duration_s)
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+        recompiles = serve_recompile_count() - recompiles0
+    finally:
+        server.stop()
+
+    def pct(lats, q):
+        return round(float(np.percentile(lats, q)) * 1000.0, 3)
+
+    rows = batcher.stats["rows"] - stats0["rows"]
+    padded = batcher.stats["rows_padded"] - stats0["rows_padded"]
+    batches = batcher.stats["batches"] - stats0["batches"]
+    rep: Dict[str, Any] = {
+        "serve_qps_sustained": round(max_ach, 1),
+        "serve_deadline_ms": deadline_ms,
+        "serve_low_qps_offered": low_qps,
+        "serve_low_qps": round(low_ach, 1),
+        "serve_low_p50_ms": pct(low_lats, 50),
+        "serve_low_p99_ms": pct(low_lats, 99),
+        "serve_mid_qps_offered": mid_qps,
+        "serve_mid_qps": round(mid_ach, 1),
+        "serve_mid_p50_ms": pct(mid_lats, 50),
+        "serve_mid_p99_ms": pct(mid_lats, 99),
+        "serve_max_p50_ms": pct(max_lats, 50),
+        "serve_max_p99_ms": pct(max_lats, 99),
+        "serve_closed_qps": round(closed_qps, 1),
+        "serve_closed_p50_ms": pct(closed_lats, 50),
+        "serve_closed_p99_ms": pct(closed_lats, 99),
+        "serve_recompiles_after_warm": int(recompiles),
+        "serve_batches": int(batches),
+        "serve_rows_padded": int(padded),
+        "serve_padding_waste_frac": round(
+            padded / max(rows + padded, 1), 4),
+        "serve_bucket_ladder": ",".join(map(str, scorer.buckets)),
+        "serve_bucket_counts": ",".join(
+            f"{b}:{c}" for b, c in sorted(batcher.bucket_counts.items())),
+        "serve_shape": f"{n_models} NN models {n_features}->"
+                       f"{list(hidden)}->1 stacked, pool 4096 rows, "
+                       f"clients: closed 8-thread / open "
+                       f"{low_qps:.0f}+{mid_qps:.0f} QPS / saturation",
+    }
+    # plane guards — fail loudly, like the tail bench's schedule guards
+    if recompiles > 0:
+        raise AssertionError(
+            f"warmed serve plane recompiled {recompiles}x across the "
+            "load sweep — request shapes leaked past the bucket ladder "
+            "(the exact shape-churn hazard xla.recompiles exists for)")
+    slop = float(os.environ.get("SHIFU_BENCH_SERVE_P99_SLOP_MS",
+                                SERVE_P99_SLOP_MS))
+    if rep["serve_low_p99_ms"] > deadline_ms + slop:
+        raise AssertionError(
+            f"low-load p99 {rep['serve_low_p99_ms']:.1f} ms exceeds the "
+            f"deadline bound {deadline_ms:.1f}+{slop:.0f} ms — the "
+            "deadline flush is not bounding tail latency")
+    floor = float(os.environ.get("SHIFU_BENCH_SERVE_FLOOR",
+                                 SERVE_BENCH_FLOOR))
+    if max_ach < floor:
+        raise AssertionError(
+            f"sustained serve QPS {max_ach:.0f} below the catastrophic "
+            f"floor {floor:.0f} (SHIFU_BENCH_SERVE_FLOOR)")
+    return rep
+
+
 # --------------------------------------------------------------- compare
 # `bench.py --compare OLD.json NEW.json [--threshold 0.9]`: the
 # BENCH_r01..r05 trajectory exists in-repo but nothing read it — this is
@@ -981,30 +1202,47 @@ def bench_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
 
 
 def is_tracked_throughput(name: str) -> bool:
-    """Higher-is-better metrics gate the compare: throughputs, plus the
-    v6 utilization extras (*_mfu / *_achieved_bw — a drop means the
-    same plane is doing the same math slower, exactly what the compare
-    exists to catch).  Ratios, shapes and wall-clock extras inform but
-    never fail."""
-    if name.endswith("_vs_baseline") or name.endswith("_error"):
+    """Higher-is-better metrics gate the compare: throughputs, sustained
+    QPS, plus the v6 utilization extras (*_mfu / *_achieved_bw — a drop
+    means the same plane is doing the same math slower, exactly what the
+    compare exists to catch).  Ratios, shapes and wall-clock extras
+    inform but never fail."""
+    if name.endswith("_vs_baseline") or name.endswith("_error") \
+            or name.endswith("_offered"):
         return False
     return ("throughput" in name or name.endswith("_per_sec")
+            or name.endswith("_qps") or name.endswith("_qps_sustained")
             or name.endswith("_mfu") or name.endswith("_achieved_bw"))
+
+
+def is_tracked_latency(name: str) -> bool:
+    """LOWER-is-better metrics (v7): latency percentiles.  A serve p99
+    that grows past old/threshold regresses the compare exactly like a
+    throughput drop — tail latency is the serving plane's contract."""
+    if name.endswith("_error") or name.endswith("_vs_baseline"):
+        return False
+    return "_p50" in name or "_p99" in name
 
 
 def compare_bench(old: Dict[str, Any], new: Dict[str, Any],
                   threshold: float = 0.9):
     """(rows, regressed): per-metric diff rows sorted tracked-first, and
-    the tracked metrics whose new value fell below threshold x old."""
+    the tracked metrics that regressed — higher-is-better metrics when
+    new < threshold x old, LOWER-is-better (latency) metrics when
+    new > old / threshold."""
     om, nm = bench_metrics(old), bench_metrics(new)
     rows, regressed = [], []
     for name in sorted(set(om) | set(nm),
-                       key=lambda n: (not is_tracked_throughput(n), n)):
+                       key=lambda n: (not (is_tracked_throughput(n)
+                                           or is_tracked_latency(n)), n)):
         ov, nv = om.get(name), nm.get(name)
-        tracked = is_tracked_throughput(name)
+        lower_better = is_tracked_latency(name)
+        tracked = is_tracked_throughput(name) or lower_better
         ratio = (nv / ov) if (ov and nv is not None) else None
         flag = ""
-        if tracked and ov and nv is not None and nv < threshold * ov:
+        if tracked and ov and nv is not None and (
+                nv > ov / threshold if lower_better
+                else nv < threshold * ov):
             flag = "REGRESSED"
             regressed.append(name)
         elif ov is None:
@@ -1012,7 +1250,8 @@ def compare_bench(old: Dict[str, Any], new: Dict[str, Any],
         elif nv is None:
             flag = "gone"
         rows.append({"metric": name, "old": ov, "new": nv, "ratio": ratio,
-                     "tracked": tracked, "flag": flag})
+                     "tracked": tracked, "lower_better": lower_better,
+                     "flag": flag})
     return rows, regressed
 
 
@@ -1023,11 +1262,13 @@ def format_compare_table(rows, threshold: float) -> str:
            "-" * 92]
     for r in rows:
         ratio = "-" if r["ratio"] is None else f"{r['ratio']:.3f}"
-        mark = "*" if r["tracked"] else " "
+        mark = "v" if r.get("lower_better") else \
+            ("*" if r["tracked"] else " ")
         out.append(f"{mark}{r['metric']:<45}{num(r['old']):>16}"
                    f"{num(r['new']):>16}{ratio:>8}  {r['flag']}")
-    out.append(f"(* = tracked throughput metric; REGRESSED = new < "
-               f"{threshold} x old)")
+    out.append(f"(* = tracked throughput metric, v = tracked latency "
+               f"metric [lower is better]; REGRESSED = new < "
+               f"{threshold} x old, or latency new > old / {threshold})")
     return "\n".join(out)
 
 
@@ -1180,10 +1421,30 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
                                    "(BASELINE.md)",
             "extra": rep,
         }
+    if plane == "serve":
+        with obs.span("bench.serve", kind="bench"):
+            rep = bench_serve()
+        for k, v in rep.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                obs.gauge(f"bench.{k}").set(float(v))
+        v = rep["serve_qps_sustained"]
+        return {
+            "metric": "serve_qps_sustained",
+            "value": v,
+            "unit": "rows/sec",
+            "plane": "serve",
+            "telemetry_schema_version": BENCH_TELEMETRY_SCHEMA,
+            "vs_baseline": round(v / BASELINE_SCORE_RATE, 3),
+            "baseline_rows_per_sec": BASELINE_SCORE_RATE,
+            "baseline_provenance": "measured 1505.9 rows/s/worker per-row "
+                                   "bagged scorer on this rig x 100 "
+                                   "north-star workers (BASELINE.md)",
+            "extra": rep,
+        }
     if plane not in (None, "all"):
         raise ValueError(
             f"unknown bench plane {plane!r} "
-            "(tail|rf-repeat|e2e|resume|varsel|all)")
+            "(tail|rf-repeat|e2e|resume|varsel|serve|all)")
     nn_cost: Dict[str, Any] = {}
     nn_rows_per_sec = bench_nn(collect=nn_cost)
     obs.gauge("bench.nn_train_throughput").set(nn_rows_per_sec)
@@ -1253,6 +1514,17 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
                 obs.gauge(f"bench.{k}").set(float(v))
     except Exception as e:                      # pragma: no cover
         extras["varsel_throughput_error"] = str(e)[:200]
+    try:
+        with obs.span("bench.serve", kind="bench"):
+            rep = bench_serve()
+        extras.update(rep)
+        extras["serve_qps_vs_baseline"] = round(
+            rep["serve_qps_sustained"] / BASELINE_SCORE_RATE, 3)
+        for k, v in rep.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                obs.gauge(f"bench.{k}").set(float(v))
+    except Exception as e:                      # pragma: no cover
+        extras["serve_qps_error"] = str(e)[:200]
     extras["streamed_bench_shape"] = {
         "resident": "262144 rows x 100 trees (since r5; was x 8 — 100 = "
                     "the default TreeNum, amortizing the one-time ingest "

@@ -152,6 +152,22 @@ def build_parser() -> argparse.ArgumentParser:
                     "the table; exit 0 healthy, 3 when any process is "
                     "stalled or stale — for CI and cron consumers")
 
+    sp = sub.add_parser("serve", help="online scoring server: the trained "
+                        "ensemble AOT-compiled + HBM-pinned behind a "
+                        "padded-bucket micro-batcher (knobs: "
+                        "-Dshifu.serve.buckets, -Dshifu.serve.maxDelayMs)")
+    sp.add_argument("--port", dest="serve_port", type=int, default=8188,
+                    help="HTTP port for POST /score + GET /healthz "
+                    "(default 8188)")
+    sp.add_argument("--max-delay-ms", dest="serve_max_delay_ms",
+                    type=float, default=None, metavar="MS",
+                    help="deadline flush bound (overrides "
+                    "-Dshifu.serve.maxDelayMs; default 2)")
+    sp.add_argument("--selfcheck", dest="serve_selfcheck", type=int,
+                    nargs="?", const=8, default=0, metavar="N",
+                    help="score N synthetic rows in-process and exit "
+                    "(no port; CI smoke)")
+
     sp = sub.add_parser("test", help="pipeline smoke test on a data sample")
     sp.add_argument("-filter", dest="filter_target", nargs="?", const="",
                     default=None, metavar="EVALSET",
@@ -305,6 +321,11 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
         return run_monitor(args.dir, interval_s=args.monitor_interval,
                            once=args.monitor_once,
                            json_mode=getattr(args, "monitor_json", False))
+    if cmd == "serve":
+        from .serve.server import run_serve
+        return run_serve(args.dir, port=args.serve_port,
+                         selfcheck=args.serve_selfcheck,
+                         max_delay_ms=args.serve_max_delay_ms)
     if cmd == "test":
         from .pipeline.smoke import SmokeTestProcessor
         return SmokeTestProcessor(args.dir, params=vars(args)).run()

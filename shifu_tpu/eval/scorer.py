@@ -75,6 +75,7 @@ class Scorer:
         # single-chip layout
         self.mesh = mesh
         self._groups = None          # lazy same-shape NN stacks
+        self._groups_src = None      # models the cache was built from
 
     @classmethod
     def from_dir(cls, models_dir: str, scale: float = SCORE_SCALE,
@@ -102,8 +103,16 @@ class Scorer:
         """Same-shape NN/LR models stacked for ONE vmapped forward — the
         bagged ensemble was trained stacked (``train_ensemble``); scoring it
         unstacked is pure overhead (reference scores each model on its own
-        thread, ``Scorer.java:163-200``)."""
-        if self._groups is not None:
+        thread, ``Scorer.java:163-200``).
+
+        The cache is keyed off model IDENTITY (hot-swap reuses Scorer
+        instances and replaces ``self.models``): any change to the list
+        rebuilds the stacks — a stale cache would silently keep scoring
+        the old ensemble."""
+        if self._groups is not None and self._groups_src is not None \
+                and len(self._groups_src) == len(self.models) \
+                and all(a is b for a, b in zip(self._groups_src,
+                                               self.models)):
             return self._groups
         import jax
         import jax.numpy as jnp
@@ -129,6 +138,7 @@ class Scorer:
             fwd = jax.jit(lambda ps, xv, spec=spec: jax.vmap(
                 lambda p: forward(p, spec, xv))(ps))
             self._groups.append((idxs, stacked, fwd))
+        self._groups_src = list(self.models)
         return self._groups
 
     def score(self, x: np.ndarray,

@@ -36,6 +36,13 @@ Sites/points wired today (grep ``faults.fire`` for the live set):
     obs:heartbeat=<b>   before heartbeat b's atomic commit (obs/health) —
                         a kill here proves a death mid-heartbeat leaves
                         the previous valid health file, never a torn one
+    serve:request=<k>   before serving batch k's device launch — an
+                        ioerror fails exactly that batch's tickets and
+                        must leave the scorer/registry serviceable
+    serve:swap=<key>    after a hot-swap candidate is built+warmed,
+                        before the journal commit and the live flip — a
+                        crash here must leave the PREVIOUS model live,
+                        scoring bit-identically
 
 Actions:
 

@@ -78,6 +78,24 @@ MANIFEST: Dict[str, Tuple[str, str]] = {
                        "costed executables rebuilt for a NEW input "
                        "signature (the shape-churn sentinel)"),
     "xla.launches": ("counter", "costed executable launches"),
+    # ---- serving plane (serve/)
+    "serve.requests": ("counter", "scoring requests accepted"),
+    "serve.rows_scored": ("counter", "request rows scored"),
+    "serve.batches": ("counter", "padded-bucket device launches"),
+    "serve.rows_padded": ("counter",
+                          "pad rows added to fill serve buckets"),
+    "serve.flush_full": ("counter", "flushes triggered by a full bucket"),
+    "serve.flush_deadline": ("counter",
+                             "flushes triggered by the maxDelayMs "
+                             "deadline"),
+    "serve.request_errors": ("counter", "batches failed in-flight"),
+    "serve.swaps": ("counter", "model hot-swaps promoted"),
+    "serve.queue_depth": ("gauge", "rows still queued after a flush"),
+    "serve.bucket_occupancy": ("gauge",
+                               "real rows / bucket size of the last "
+                               "launch"),
+    "serve.batch_latency_ms": ("histogram",
+                               "oldest-request latency per batch"),
     # ---- drift monitor (obs/drift)
     "drift.rows": ("gauge", "rows folded into the live drift counts"),
     "drift.columns_tracked": ("gauge", "columns with a training snapshot"),

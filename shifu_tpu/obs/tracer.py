@@ -54,7 +54,13 @@ from typing import Any, Dict, List, Optional
 # table for the utilization report), xla.recompiles / xla.launches and
 # ingest.rows_padded counters, timeline span args annotated with
 # flops/bytes
-SCHEMA_VERSION = 6
+# v7: online serving plane — serve.* instruments (requests / batches /
+# rows_padded / flush_full / flush_deadline / request_errors / swaps
+# counters, queue_depth / bucket_occupancy gauges, batch_latency_ms
+# histogram) and the per-bucket ``serve.score.<key>.g<gen>.b<bucket>``
+# cost records the AOT scorer registers (the recompile sentinel's
+# serving beat)
+SCHEMA_VERSION = 7
 
 _TRUE = ("1", "true", "on", "yes")
 

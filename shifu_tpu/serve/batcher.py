@@ -1,0 +1,292 @@
+"""Micro-batching front-end: queue, deadline flush, padded-bucket launch.
+
+Requests (single rows or bursts of rows) append to a queue; a worker
+drains it into the smallest covering bucket of the ladder, pads the
+remainder (counted — padding waste is a first-class bench metric), and
+launches the AOT executable.  Flush fires when a full top bucket is
+queued (throughput wins at high load) or when the oldest queued request
+has waited ``max_delay_s`` (p99 stays bounded at low load).
+
+Wall-clock is injectable (``clock=``) and the drain path is callable
+in-process (:meth:`pump`), so unit tests drive deadline semantics with
+a fake clock and zero sleeps; only the real server starts the worker
+thread (:meth:`start`).
+
+Fault site: ``serve:request=<batch#>`` fires before batch ``<batch#>``'s
+device launch — an ``ioerror`` there fails exactly that batch's tickets
+(the error propagates to the waiting callers) and must leave the scorer
+and registry fully serviceable for the next request.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import faults, obs
+from .scorer import AOTScorer, covering_bucket
+
+
+class Ticket:
+    """Completion handle for one submitted burst of rows.  A burst may
+    span several device launches; the event fires when every row has a
+    score (or its batch errored).  One event per BURST, not per row —
+    the per-request cost at high load is an array append."""
+
+    __slots__ = ("n", "stamps", "scores", "done_ts", "_pending", "_event",
+                 "error", "_lock")
+
+    def __init__(self, n: int, stamps: np.ndarray):
+        self.n = n
+        self.stamps = stamps                  # arrival time per row
+        self.scores = np.empty(n, np.float32)
+        self.done_ts = np.empty(n, np.float64)
+        self._pending = n
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self.error: Optional[BaseException] = None
+
+    def _complete(self, sl: slice, scores: Optional[np.ndarray],
+                  now: float, error: Optional[BaseException]) -> None:
+        if error is None:
+            self.scores[sl] = scores
+        else:
+            self.error = error
+        self.done_ts[sl] = now
+        with self._lock:
+            self._pending -= sl.stop - sl.start
+            done = self._pending <= 0
+        if done:
+            self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until every row is scored; raises the batch error if
+        the request died with its batch."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("scoring request timed out")
+        if self.error is not None:
+            raise self.error
+        return self.scores
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def latencies(self) -> np.ndarray:
+        """Per-row completion latency (seconds) — open-loop clients
+        stamp ideal arrival times, so these are coordination-free."""
+        return self.done_ts - self.stamps
+
+
+class MicroBatcher:
+    """See module docs.  ``scorer_provider`` is read once per flush, so
+    a registry hot-swap takes effect at the next batch boundary without
+    dropping queued requests."""
+
+    def __init__(self, scorer_provider: Callable[[], AOTScorer],
+                 max_delay_s: float = 0.002,
+                 clock: Callable[[], float] = time.monotonic):
+        self._provider = scorer_provider
+        self.max_delay_s = float(max_delay_s)
+        self.clock = clock
+        self._cond = threading.Condition()
+        # queue of (ticket, rows, bins, row_offset): row_offset = how many
+        # of this burst's rows earlier flushes already consumed
+        self._queue: deque = deque()
+        self._queued_rows = 0
+        self._batches = 0
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # telemetry-independent accounting (the bench reads this; the
+        # same numbers mirror into obs counters when telemetry is on)
+        self.stats: Dict[str, float] = {
+            "requests": 0, "rows": 0, "batches": 0, "rows_padded": 0,
+            "flush_full": 0, "flush_deadline": 0, "errors": 0}
+        self.bucket_counts: Dict[int, int] = {}
+
+    # ------------------------------------------------------------ submit
+    def submit(self, row: np.ndarray, bins: Optional[np.ndarray] = None,
+               stamp: Optional[float] = None) -> Ticket:
+        """One single-record scoring request."""
+        return self.submit_burst(
+            np.asarray(row, np.float32)[None, :],
+            None if bins is None else np.asarray(bins)[None, :],
+            stamps=None if stamp is None else np.asarray([stamp]))
+
+    def submit_burst(self, rows: np.ndarray,
+                     bins: Optional[np.ndarray] = None,
+                     stamps: Optional[np.ndarray] = None) -> Ticket:
+        """A burst of concurrent single-record requests (an open-loop
+        load generator's arrivals for one tick) — one queue append, one
+        shared ticket.  ``stamps`` lets the generator record IDEAL
+        arrival times so latency percentiles are free of coordinated
+        omission."""
+        n = len(rows)
+        if stamps is None:
+            stamps = np.full(n, self.clock())
+        t = Ticket(n, np.asarray(stamps, np.float64))
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("batcher is stopped")
+            self._queue.append((t, rows, bins, 0))
+            self._queued_rows += n
+            self.stats["requests"] += n
+            self._cond.notify_all()
+        obs.counter("serve.requests").inc(n)
+        return t
+
+    def score_sync(self, rows: np.ndarray,
+                   bins: Optional[np.ndarray] = None,
+                   timeout: Optional[float] = 30.0) -> np.ndarray:
+        """Closed-loop convenience: submit + wait."""
+        return self.submit_burst(np.asarray(rows, np.float32),
+                                 bins).wait(timeout)
+
+    # ------------------------------------------------------------- drain
+    def _top_bucket(self) -> int:
+        return self._provider().buckets[-1]
+
+    def _oldest_stamp(self) -> Optional[float]:
+        return float(self._queue[0][0].stamps[self._queue[0][3]]) \
+            if self._queue else None
+
+    def _take(self, max_rows: int) -> List[Tuple[Ticket, np.ndarray,
+                                                 Optional[np.ndarray],
+                                                 int]]:
+        """Pop up to ``max_rows`` rows off the queue head (splitting a
+        burst when it straddles the boundary).  Caller holds the lock."""
+        out, taken = [], 0
+        while self._queue and taken < max_rows:
+            t, rows, bins, off = self._queue.popleft()
+            room = max_rows - taken
+            avail = len(rows) - off
+            take = min(room, avail)
+            out.append((t, rows[off:off + take],
+                        None if bins is None else bins[off:off + take],
+                        off))
+            taken += take
+            if take < avail:
+                self._queue.appendleft((t, rows, bins, off + take))
+        self._queued_rows -= taken
+        return out
+
+    def pump(self, now: Optional[float] = None, force: bool = False) -> int:
+        """In-process drain: flush ONE batch if a flush condition holds
+        (full top bucket queued, or the oldest request's deadline has
+        passed, or ``force``).  Returns rows flushed (0 = no flush due).
+        This is the testable core the worker thread loops around."""
+        now = self.clock() if now is None else now
+        with self._cond:
+            if not self._queue:
+                return 0
+            full = self._queued_rows >= self._top_bucket()
+            deadline_hit = now - self._oldest_stamp() >= self.max_delay_s
+            if not (full or deadline_hit or force):
+                return 0
+            parts = self._take(self._top_bucket())
+            self.stats["flush_full" if full else "flush_deadline"] += 1
+            obs.counter("serve.flush_full" if full
+                        else "serve.flush_deadline").inc()
+            obs.gauge("serve.queue_depth").set(self._queued_rows)
+        return self._launch(parts)
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Flush everything queued right now (shutdown / tests)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._cond:
+                if not self._queue:
+                    return
+            if self.pump(force=True) == 0 and time.monotonic() > deadline:
+                raise TimeoutError("batcher drain timed out")
+
+    # ------------------------------------------------------------ launch
+    def _launch(self, parts) -> int:
+        n = sum(len(rows) for _, rows, _, _ in parts)
+        if n == 0:
+            return 0
+        scorer = self._provider()
+        bucket = covering_bucket(scorer.buckets, n)
+        rows = np.concatenate([r for _, r, _, _ in parts], axis=0) \
+            if len(parts) > 1 else parts[0][1]
+        bins = None
+        if scorer.needs_bins:
+            bins = np.concatenate([b for _, _, b, _ in parts], axis=0) \
+                if len(parts) > 1 else parts[0][2]
+        batch_index = self._batches
+        self._batches += 1
+        err: Optional[BaseException] = None
+        mean = None
+        try:
+            faults.fire("serve", "request", batch_index)
+            raw = scorer.score_batch(rows, bins)
+            mean = raw.mean(axis=1).astype(np.float32)
+        except BaseException as e:          # noqa: BLE001 — tickets carry it
+            err = e
+        now = self.clock()
+        off = 0
+        for t, r, _, src_off in parts:
+            sl_dst = slice(src_off, src_off + len(r))
+            t._complete(sl_dst,
+                        None if err is not None
+                        else mean[off:off + len(r)], now, err)
+            off += len(r)
+        pad = bucket - n
+        self.stats["batches"] += 1
+        self.stats["rows"] += n
+        self.stats["rows_padded"] += pad
+        self.bucket_counts[bucket] = self.bucket_counts.get(bucket, 0) + 1
+        obs.counter("serve.batches").inc()
+        obs.counter("serve.rows_scored").inc(n)
+        obs.counter("serve.rows_padded").inc(pad)
+        obs.gauge("serve.bucket_occupancy").set(n / bucket)
+        if err is not None:
+            self.stats["errors"] += 1
+            obs.counter("serve.request_errors").inc()
+            if not isinstance(err, (faults.InjectedFault, ValueError,
+                                    RuntimeError)):
+                raise err
+            return n
+        oldest = min(float(t.stamps[so]) for t, _, _, so in parts)
+        obs.histogram("serve.batch_latency_ms").observe(
+            (now - oldest) * 1000.0)
+        return n
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "MicroBatcher":
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="shifu-serve-batcher")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait()
+                if self._stop and not self._queue:
+                    return
+                # coalesce: wait for the top bucket to fill, but never
+                # past the oldest request's deadline
+                while (self._queued_rows < self._top_bucket()
+                       and not self._stop):
+                    remaining = (self._oldest_stamp() + self.max_delay_s
+                                 - self.clock())
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+            self.pump(force=True)
+
+    def stop(self, drain: bool = True) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        if drain:
+            self.drain()

@@ -1,0 +1,134 @@
+"""Live-model registry: modelset-keyed scorers with atomic hot-swap.
+
+A serving process holds one :class:`AOTScorer` per modelset.  Promoting
+a retrained model must never drop requests, so a swap is journal-style:
+
+1. BUILD — load the candidate's models and compile/warm every bucket
+   executable, entirely off-line (the live scorer keeps serving);
+2. JOURNAL — commit ``serving.json`` via :mod:`shifu_tpu.ioutil`'s
+   atomic write (a restart re-resolves to whatever was last promoted —
+   a crash mid-commit leaves the previous journal intact);
+3. FLIP — one reference assignment under the lock.  In-flight batches
+   finish on the old scorer (the batcher reads the provider per flush);
+   the next batch scores on the new one.
+
+Fault site: ``serve:swap=<key>`` fires after BUILD and before
+JOURNAL+FLIP — a crash or injected error there must leave the previous
+model live and serving bit-identical scores.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from .. import faults, obs
+from ..eval.scorer import SCORE_SCALE, Scorer
+from ..ioutil import atomic_write_json
+from .scorer import AOTScorer
+
+log = logging.getLogger(__name__)
+
+SERVING_JOURNAL = "serving.json"
+
+
+class ModelRegistry:
+    """See module docs.  ``state_dir=None`` keeps the journal in-memory
+    only (tests, embedded use)."""
+
+    def __init__(self, state_dir: Optional[str] = None):
+        self.state_dir = state_dir
+        self._lock = threading.Lock()
+        self._live: Dict[str, AOTScorer] = {}
+        self._gen: Dict[str, int] = {}
+        self._dirs: Dict[str, str] = {}
+
+    # ------------------------------------------------------------ lookup
+    def get(self, key: str) -> AOTScorer:
+        with self._lock:
+            try:
+                return self._live[key]
+            except KeyError:
+                raise KeyError(f"no live model under {key!r} — load() or "
+                               "swap() one first") from None
+
+    def provider(self, key: str):
+        """A per-flush scorer resolver for :class:`MicroBatcher`."""
+        return lambda: self.get(key)
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._live)
+
+    def generation(self, key: str) -> int:
+        with self._lock:
+            return self._gen.get(key, 0)
+
+    # ------------------------------------------------------- load / swap
+    def _build(self, key: str, models_or_dir, scale: float,
+               buckets: Optional[Sequence[int]], gen: int,
+               warm: bool) -> AOTScorer:
+        if isinstance(models_or_dir, str):
+            models = Scorer.from_dir(models_or_dir).models
+        else:
+            models = list(models_or_dir)
+        scorer = AOTScorer(models, scale=scale, buckets=buckets,
+                           name=f"serve.score.{key}.g{gen}")
+        if warm:
+            scorer.warm()
+        return scorer
+
+    def load(self, key: str, models_or_dir, scale: float = SCORE_SCALE,
+             buckets: Optional[Sequence[int]] = None,
+             warm: bool = True) -> AOTScorer:
+        """First load of a modelset (no previous model to protect);
+        accepts a models dir or an in-memory model sequence."""
+        scorer = self._build(key, models_or_dir, scale, buckets, 0, warm)
+        with self._lock:
+            self._live[key] = scorer
+            self._gen[key] = 0
+            if isinstance(models_or_dir, str):
+                self._dirs[key] = models_or_dir
+        self._journal()
+        return scorer
+
+    def swap(self, key: str, models_or_dir, scale: float = SCORE_SCALE,
+             buckets: Optional[Sequence[int]] = None,
+             warm: bool = True) -> AOTScorer:
+        """Atomic hot-swap (see module docs).  Raises if the build or
+        journal fails — the previous model stays live in that case."""
+        with self._lock:
+            if key not in self._live:
+                raise KeyError(f"swap({key!r}) before load() — nothing "
+                               "is live to replace")
+            gen = self._gen[key] + 1
+        # BUILD off-line: the expensive part happens while the old
+        # scorer keeps serving
+        scorer = self._build(key, models_or_dir, scale, buckets, gen, warm)
+        # a crash from here to the flip must leave the OLD model live
+        faults.fire("serve", "swap", key)
+        with self._lock:
+            self._live[key] = scorer
+            self._gen[key] = gen
+            if isinstance(models_or_dir, str):
+                self._dirs[key] = models_or_dir
+        self._journal()
+        obs.counter("serve.swaps").inc()
+        log.info("promoted %s generation %d", key, gen)
+        return scorer
+
+    # ------------------------------------------------------------ journal
+    def _journal(self) -> None:
+        if not self.state_dir:
+            return
+        with self._lock:
+            doc = {k: {"models_dir": self._dirs.get(k),
+                       "generation": self._gen.get(k, 0),
+                       "promoted_ts": round(time.time(), 3)}
+                   for k in self._live}
+        os.makedirs(self.state_dir, exist_ok=True)
+        atomic_write_json(os.path.join(self.state_dir, SERVING_JOURNAL),
+                          doc)

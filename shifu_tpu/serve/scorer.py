@@ -1,0 +1,329 @@
+"""AOT device-resident ensemble scorer — one executable per batch bucket.
+
+The eval plane's :class:`~shifu_tpu.eval.scorer.Scorer` dispatches
+per-model on every call (stacked NN groups on device, tree/WDL/SVM
+columns through host ``np.asarray`` round trips).  For serving that
+dispatch is pure per-request overhead, so :class:`AOTScorer` builds ONE
+fused traceable function over the whole ensemble — every model's scores
+as device sub-expressions of a single graph, no host hop between the
+models of a bag — and ``lower()→compile()``s it ONCE per batch bucket at
+startup, with donated input buffers.  A request batch then costs: pad to
+the smallest covering bucket, one compiled launch, trim.
+
+Every bucket executable registers with the cost-attribution plane
+(:func:`shifu_tpu.obs.costs.record_executable`) under its own name
+(``serve.score.<tag>.b<bucket>``), so the shape-churn sentinel
+(``xla.recompiles``) police the central hazard of this design: a warmed
+server must NEVER compile again, whatever request sizes arrive.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..eval.scorer import SCORE_SCALE, Scorer
+from ..obs import costs
+
+log = logging.getLogger(__name__)
+
+# geometric bucket ladder default: one executable per rung; request
+# batches pad to the smallest covering rung (``-Dshifu.serve.buckets``)
+DEFAULT_BUCKETS = (1, 8, 64, 512)
+
+
+def bucket_ladder() -> Tuple[int, ...]:
+    """The configured bucket ladder, ascending and deduplicated
+    (property ``shifu.serve.buckets`` = comma-separated sizes)."""
+    from ..config import environment
+    spec = environment.get_property("shifu.serve.buckets")
+    if not spec:
+        return DEFAULT_BUCKETS
+    try:
+        sizes = sorted({int(s) for s in spec.split(",") if s.strip()})
+        if not sizes or any(s <= 0 for s in sizes):
+            raise ValueError(spec)
+        return tuple(sizes)
+    except ValueError:
+        log.warning("ignoring unparseable shifu.serve.buckets=%r", spec)
+        return DEFAULT_BUCKETS
+
+
+def covering_bucket(buckets: Sequence[int], n: int) -> int:
+    """Smallest rung >= n (the largest rung when n exceeds the ladder —
+    the caller chunks oversize batches)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return buckets[-1]
+
+
+def infer_dims(models: Sequence) -> Tuple[int, int]:
+    """(n_features, n_bin_cols) the ensemble's inputs must provide,
+    derived from the saved specs — what startup warming compiles
+    against.  ``n_bin_cols`` is 0 when no model consumes bins."""
+    n_feat = 0
+    n_bins_cols = 0
+    for m in models:
+        kind = getattr(m, "input_kind", "norm")
+        name = type(m).__name__
+        if name == "IndependentNNModel":
+            n_feat = max(n_feat, int(m.spec.input_dim))
+        elif name == "IndependentSVMModel":
+            n_feat = max(n_feat, int(m.sv_x.shape[1]))
+        elif name == "IndependentTreeModel":
+            feats = max((int(np.max(t.split_feat)) for t in m.trees),
+                        default=-1)
+            n_bins_cols = max(n_bins_cols, feats + 1)
+        elif kind == "both":                       # WDL: index lists
+            nf = (getattr(m.spec, "extra", None) or {}).get(
+                "num_feat_idx") or []
+            cf = (getattr(m.spec, "extra", None) or {}).get(
+                "cat_col_idx") or []
+            if nf:
+                n_feat = max(n_feat, max(nf) + 1)
+            if cf:
+                n_bins_cols = max(n_bins_cols, max(cf) + 1)
+    return n_feat, n_bins_cols
+
+
+def _tree_column(m) -> Callable:
+    """Device-traceable score column for a saved forest — the jnp twin of
+    ``IndependentTreeModel.compute`` (same f32 link math, no host hop)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.tree import predict_forest_stacked, stack_forest
+
+    stacked = stack_forest(m.trees)
+    depth = m.trees[0].depth
+    spec = m.spec
+
+    def col(x, bins):
+        preds = predict_forest_stacked(*stacked, bins, depth)
+        if spec.algorithm == "GBT":
+            f = spec.init_score + spec.learning_rate * preds.sum(axis=0)
+            if spec.loss == "log":
+                return 1.0 / (1.0 + jnp.exp(-f))
+            return jnp.clip(f, 0.0, 1.0)
+        out = preds.mean(axis=0)        # RF mean vote
+        return out[:, 0] if out.ndim > 1 else out
+    return col
+
+
+def _wdl_column(m) -> Callable:
+    """Device-traceable WDL column: the index slicing of
+    ``compute_full`` moved inside the trace."""
+    import jax.numpy as jnp
+
+    from ..models.wdl import forward
+
+    nf = tuple((m.spec.extra or {}).get("num_feat_idx") or ())
+    cf = tuple((m.spec.extra or {}).get("cat_col_idx") or ())
+    spec, params = m.spec, m.params
+
+    def col(x, bins):
+        x_num = x[:, np.asarray(nf, np.int32)] if nf \
+            else jnp.zeros((x.shape[0], 0), jnp.float32)
+        x_cat = bins[:, np.asarray(cf, np.int32)].astype(jnp.int32) if cf \
+            else jnp.zeros((x.shape[0], 0), jnp.int32)
+        return forward(params, spec, x_num, x_cat)[:, 0]
+    return col
+
+
+def build_ensemble_fn(scorer: Scorer) -> Tuple[Callable, bool]:
+    """One pure traceable ``fn(x[, bins]) -> raw [n, M]`` over the whole
+    ensemble (scores already scaled), plus whether it consumes bins.
+
+    Same dispatch rules as :meth:`Scorer.score_device` — same-shape NN
+    models ride the stacked-group vmap, everything else contributes its
+    own device sub-expression — but as ONE graph XLA fuses end to end.
+    """
+    from ..models.nn import forward as nn_forward
+
+    models = scorer.models
+    groups = scorer._stacked_nn_groups()
+    grouped = {i for idxs, _, _ in groups for i in idxs}
+    needs_bins = any(getattr(m, "input_kind", "norm") in ("bins", "both")
+                     for m in models)
+
+    cols: List[Optional[Callable]] = [None] * len(models)
+    for i, m in enumerate(models):
+        if i in grouped:
+            continue
+        kind = getattr(m, "input_kind", "norm")
+        if kind == "bins":
+            cols[i] = _tree_column(m)
+        elif kind == "both":
+            cols[i] = _wdl_column(m)
+        elif type(m).__name__ == "IndependentNNModel":
+            cols[i] = (lambda sp, ps: lambda x, bins:
+                       nn_forward(ps, sp, x)[:, 0])(m.spec, m.params)
+        elif type(m).__name__ == "IndependentSVMModel":
+            cols[i] = (lambda mm: lambda x, bins:
+                       mm._decision(x)[:, 0])(m)
+        else:
+            raise TypeError(f"cannot build a device column for "
+                            f"{type(m).__name__}")
+
+    scale = scorer.scale
+
+    def fn(x, bins=None):
+        import jax.numpy as jnp
+        out = [None] * len(models)
+        for idxs, stacked, fwd in groups:
+            g = fwd(stacked, x)                      # [M, n, out]
+            for pos, i in enumerate(idxs):
+                out[i] = g[pos][:, 0]
+        for i, col in enumerate(cols):
+            if col is not None:
+                out[i] = col(x, bins)
+        return jnp.stack(out, axis=1) * scale
+    return fn, needs_bins
+
+
+def serve_recompile_count(prefix: str = "serve.score") -> int:
+    """Distinct-signature recompiles observed across all serve
+    executables — the telemetry-independent read of the shape-churn
+    sentinel (``record_executable`` feeds the cost registry whether or
+    not telemetry is on).  A warmed server must report 0."""
+    by_name: dict = {}
+    for e in costs.get_cost_registry().entries():
+        if e.name.startswith(prefix):
+            by_name.setdefault(e.name, set()).add(e.signature)
+    return sum(len(sigs) - 1 for sigs in by_name.values())
+
+
+class AOTScorer:
+    """The modelset's ensemble, pinned in HBM, behind per-bucket AOT
+    executables (see module docs).
+
+    ``warm()`` compiles every rung of the ladder up front;
+    :meth:`score_batch` then pads to the covering rung, launches the
+    compiled executable (donated input buffers — the pad copy is the
+    only host-side byte movement), and trims.  Thread-safe: the batcher
+    worker launches while a hot-swap builds the NEXT scorer instance
+    elsewhere; one instance's executables are immutable after warm.
+    """
+
+    def __init__(self, models: Sequence, scale: float = SCORE_SCALE,
+                 buckets: Optional[Sequence[int]] = None,
+                 name: str = "serve.score"):
+        import jax
+        self.scorer = Scorer(models, scale)
+        self.buckets = tuple(sorted(set(buckets or bucket_ladder())))
+        self.name = name
+        self.n_features, self.n_bins_cols = infer_dims(models)
+        fn, self.needs_bins = build_ensemble_fn(self.scorer)
+        # donated input buffers: the padded batch is dead the moment the
+        # launch reads it, so XLA may overwrite it in place (CPU's PJRT
+        # cannot donate — gating avoids a warning per compile there)
+        donate = () if jax.default_backend() == "cpu" \
+            else ((0, 1) if self.needs_bins else (0,))
+        self._jitted = jax.jit(fn, donate_argnums=donate)
+        self._compiled: dict = {}
+        self._lock = threading.Lock()
+        self._pin_params()
+
+    @property
+    def models(self) -> List:
+        return self.scorer.models
+
+    def _pin_params(self) -> None:
+        """Force every param/forest leaf onto the device ONCE — scoring
+        must never pay a lazy host->HBM transfer mid-request."""
+        import jax
+        for idxs, stacked, _ in self.scorer._stacked_nn_groups():
+            jax.block_until_ready(stacked)
+        for m in self.models:
+            for leaf in jax.tree_util.tree_leaves(
+                    getattr(m, "params", None)):
+                jax.block_until_ready(jax.device_put(leaf))
+
+    # ------------------------------------------------------------ compile
+    def _avals(self, bucket: int):
+        import jax
+        x = jax.ShapeDtypeStruct((bucket, self.n_features), np.float32)
+        if not self.needs_bins:
+            return (x,)
+        return (x, jax.ShapeDtypeStruct((bucket, self.n_bins_cols),
+                                        np.int32))
+
+    def _ensure_compiled(self, bucket: int):
+        ent = self._compiled.get(bucket)
+        if ent is not None:
+            return ent
+        with self._lock:
+            ent = self._compiled.get(bucket)
+            if ent is not None:
+                return ent
+            import jax
+            lowered = self._jitted.lower(*self._avals(bucket))
+            exe = lowered.compile()
+            try:
+                sig = ",".join(a.str_short() for a in
+                               jax.tree_util.tree_leaves(lowered.in_avals))
+            except Exception:
+                sig = f"b{bucket}"
+            # per-bucket name: each rung has exactly ONE legal signature,
+            # so ANY second signature under it is real shape churn and
+            # trips the xla.recompiles sentinel
+            costs.record_executable(f"{self.name}.b{bucket}", lowered, exe,
+                                    signature=sig)
+            ent = self._compiled[bucket] = (exe, sig)
+        return ent
+
+    def warm(self, launch: bool = True) -> None:
+        """Compile every rung; ``launch=True`` additionally runs each
+        executable once so first-request latency pays no dispatch-path
+        lazy init either."""
+        for b in self.buckets:
+            exe, _ = self._ensure_compiled(b)
+            if launch:
+                args = [np.zeros((b, self.n_features), np.float32)]
+                if self.needs_bins:
+                    args.append(np.zeros((b, self.n_bins_cols), np.int32))
+                import jax
+                jax.block_until_ready(exe(*args))
+
+    # ------------------------------------------------------------- score
+    def score_batch(self, x: np.ndarray,
+                    bins: Optional[np.ndarray] = None) -> np.ndarray:
+        """raw scaled scores [n, M] for a request batch; pads to the
+        covering bucket, chunks batches beyond the top rung.  Returns a
+        host array (the serving response crosses the link by
+        definition — ONE fetch per launch)."""
+        n = len(x)
+        top = self.buckets[-1]
+        if n > top:
+            return np.concatenate(
+                [self.score_batch(x[s:s + top],
+                                  None if bins is None else bins[s:s + top])
+                 for s in range(0, n, top)], axis=0)
+        bucket = covering_bucket(self.buckets, n)
+        pad = bucket - n
+        if pad:
+            x = np.concatenate(
+                [x, np.zeros((pad, x.shape[1]), x.dtype)], axis=0)
+            if bins is not None:
+                bins = np.concatenate(
+                    [bins, np.zeros((pad, bins.shape[1]), bins.dtype)],
+                    axis=0)
+        exe, sig = self._ensure_compiled(bucket)
+        args = [np.ascontiguousarray(x, np.float32)]
+        if self.needs_bins:
+            if bins is None:
+                raise ValueError("ensemble contains bin-consuming models "
+                                 "— requests must carry bins")
+            args.append(np.ascontiguousarray(bins, np.int32))
+        costs.get_cost_registry().launch(f"{self.name}.b{bucket}", sig)
+        raw = np.asarray(exe(*args))
+        return raw[:n]
+
+    def score_mean(self, x: np.ndarray,
+                   bins: Optional[np.ndarray] = None) -> np.ndarray:
+        """Per-row ensemble mean — the serving response column."""
+        return self.score_batch(x, bins).mean(axis=1)

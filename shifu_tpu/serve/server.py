@@ -1,0 +1,216 @@
+"""ServeServer — registry + batcher + heartbeats behind one object.
+
+``shifu-tpu serve`` loads the modelset's trained ensemble
+(``<dir>/models``), warms every bucket executable, starts the
+micro-batcher worker and the per-process heartbeat
+(:mod:`shifu_tpu.obs.health`, step ``SERVE`` — the same
+``shifu-tpu monitor`` surface every pipeline step reports to), then
+serves scoring requests:
+
+- in-process: :meth:`ServeServer.score` (closed-loop) /
+  :meth:`ServeServer.submit` (async ticket) — what the bench drives;
+- over HTTP (stdlib, zero new deps): ``POST /score`` with
+  ``{"rows": [[...]], "bins": [[...]]}`` -> ``{"scores": [...]}``,
+  ``GET /healthz`` -> live state + bucket/batch accounting;
+- hot-swap: :meth:`ServeServer.swap` re-points the live model between
+  batches without dropping queued requests (``serve:swap`` fault site).
+
+Knobs: ``-Dshifu.serve.buckets`` (bucket ladder),
+``-Dshifu.serve.maxDelayMs`` (deadline flush, default 2 ms).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .. import obs
+from .batcher import MicroBatcher, Ticket
+from .registry import ModelRegistry
+from .scorer import bucket_ladder
+
+log = logging.getLogger(__name__)
+
+DEFAULT_MAX_DELAY_MS = 2.0
+
+
+def max_delay_s(override_ms: Optional[float] = None) -> float:
+    """Deadline-flush bound: explicit override > property
+    ``shifu.serve.maxDelayMs`` > 2 ms."""
+    if override_ms is not None:
+        return max(0.0, float(override_ms)) / 1000.0
+    from ..config import environment
+    return max(0.0, environment.get_float("shifu.serve.maxDelayMs",
+                                          DEFAULT_MAX_DELAY_MS)) / 1000.0
+
+
+class ServeServer:
+    """One serving process for one (or more) modelsets."""
+
+    def __init__(self, model_set_dir: Optional[str] = None,
+                 models: Optional[Sequence] = None,
+                 key: Optional[str] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_delay_ms: Optional[float] = None):
+        import os
+        self.model_set_dir = model_set_dir
+        self.key = key or (os.path.basename(os.path.abspath(model_set_dir))
+                           if model_set_dir else "default")
+        state_dir = (os.path.join(model_set_dir, "serving")
+                     if model_set_dir else None)
+        self.registry = ModelRegistry(state_dir=state_dir)
+        src = models if models is not None \
+            else os.path.join(model_set_dir, "models")
+        self.registry.load(self.key, src,
+                           buckets=tuple(buckets or bucket_ladder()))
+        self.batcher = MicroBatcher(self.registry.provider(self.key),
+                                    max_delay_s=max_delay_s(max_delay_ms))
+        self._heartbeat = None
+        self._started = False
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "ServeServer":
+        if self._started:
+            return self
+        self.batcher.start()
+        if self.model_set_dir:
+            self._heartbeat = obs.start_heartbeat(
+                obs.health_dir_for(self.model_set_dir), step="SERVE",
+                proc=f"serve-{self.key}")
+        self._started = True
+        return self
+
+    def stop(self, exit_code: Optional[int] = 0) -> None:
+        if not self._started:
+            return
+        self.batcher.stop()
+        if self._heartbeat is not None:
+            self._heartbeat.stop(exit_code=exit_code)
+            self._heartbeat = None
+        self._started = False
+
+    # ------------------------------------------------------------- scoring
+    def submit(self, rows: np.ndarray,
+               bins: Optional[np.ndarray] = None) -> Ticket:
+        return self.batcher.submit_burst(np.asarray(rows, np.float32),
+                                         bins)
+
+    def score(self, rows: np.ndarray, bins: Optional[np.ndarray] = None,
+              timeout: float = 30.0) -> np.ndarray:
+        """Closed-loop scoring (mean ensemble score per row, scaled)."""
+        if not self._started:                  # in-process, no worker
+            t = self.batcher.submit_burst(np.asarray(rows, np.float32),
+                                          bins)
+            self.batcher.drain()
+            return t.wait(timeout)
+        return self.batcher.score_sync(rows, bins, timeout=timeout)
+
+    def swap(self, models_or_dir) -> None:
+        """Promote a retrained model without dropping requests."""
+        scorer = self.registry.get(self.key)
+        self.registry.swap(self.key, models_or_dir,
+                           buckets=scorer.buckets)
+
+    def status(self) -> dict:
+        scorer = self.registry.get(self.key)
+        return {
+            "state": "serving" if self._started else "loaded",
+            "key": self.key,
+            "generation": self.registry.generation(self.key),
+            "models": len(scorer.models),
+            "buckets": list(scorer.buckets),
+            "needs_bins": scorer.needs_bins,
+            "n_features": scorer.n_features,
+            "max_delay_ms": self.batcher.max_delay_s * 1000.0,
+            "stats": dict(self.batcher.stats),
+            "bucket_counts": {str(k): v for k, v in
+                              sorted(self.batcher.bucket_counts.items())},
+        }
+
+
+# ------------------------------------------------------------------ HTTP
+def _make_handler(server: ServeServer):
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code: int, doc: dict) -> None:
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):                      # noqa: N802 (stdlib API)
+            if self.path in ("/healthz", "/health", "/status"):
+                self._reply(200, server.status())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):                     # noqa: N802
+            if self.path != "/score":
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(n) or b"{}")
+                rows = np.asarray(doc["rows"], np.float32)
+                bins = doc.get("bins")
+                if bins is not None:
+                    bins = np.asarray(bins, np.int32)
+                scores = server.score(rows, bins)
+                self._reply(200, {"scores": [round(float(s), 6)
+                                             for s in scores]})
+            except Exception as e:             # noqa: BLE001 — HTTP edge
+                self._reply(400, {"error": str(e)})
+
+        def log_message(self, fmt, *args):     # stdlib prints to stderr
+            log.debug("http: " + fmt, *args)
+
+    return Handler
+
+
+def run_serve(model_set_dir: str, port: int = 8188,
+              selfcheck: int = 0, max_delay_ms: Optional[float] = None,
+              buckets: Optional[Sequence[int]] = None) -> int:
+    """The ``shifu-tpu serve`` entry.  ``selfcheck=N`` scores N synthetic
+    rows in-process and exits (CI-friendly, no port); otherwise binds the
+    stdlib HTTP front-end on ``port`` until interrupted."""
+    server = ServeServer(model_set_dir, max_delay_ms=max_delay_ms,
+                         buckets=buckets)
+    server.start()
+    try:
+        scorer = server.registry.get(server.key)
+        if selfcheck:
+            rng = np.random.default_rng(0)
+            rows = rng.normal(size=(selfcheck,
+                                    scorer.n_features)).astype(np.float32)
+            bins = None
+            if scorer.needs_bins:
+                bins = np.zeros((selfcheck, scorer.n_bins_cols), np.int32)
+            scores = server.score(rows, bins)
+            print(json.dumps({"selfcheck_rows": int(selfcheck),
+                              "scores_head": [round(float(s), 4)
+                                              for s in scores[:5]],
+                              **server.status()}))
+            return 0
+        from http.server import ThreadingHTTPServer
+        httpd = ThreadingHTTPServer(("127.0.0.1", port),
+                                    _make_handler(server))
+        bound = httpd.server_address[1]
+        print(f"shifu-tpu serve: {server.key} on http://127.0.0.1:{bound} "
+              f"(buckets {list(scorer.buckets)}, "
+              f"deadline {server.batcher.max_delay_s * 1000:.1f} ms)")
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.server_close()
+        return 0
+    finally:
+        server.stop()

@@ -1,0 +1,458 @@
+"""Online-serving plane suite (tier-1-fast: in-process batcher drains,
+injectable clock, tiny models — zero sleeps, zero ports).
+
+Covers the serve acceptance surface: padded-bucket launches trim to
+bit-identical scores across NN / GBT / WDL model groups, a warmed
+server performs ZERO recompiles over a randomized request-size sweep,
+deadline/full flush semantics, fault sites (a killed in-flight batch
+leaves the registry serviceable; a crashed hot-swap leaves the previous
+model live and bit-identical), and the stacked-NN-group cache
+invalidation regression in ``eval/scorer.py``.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from shifu_tpu import faults, obs
+from shifu_tpu.config import environment
+from shifu_tpu.models.nn import (IndependentNNModel, NNModelSpec,
+                                 init_params)
+from shifu_tpu.serve import (AOTScorer, MicroBatcher, ModelRegistry,
+                             ServeServer, bucket_ladder, covering_bucket,
+                             infer_dims, serve_recompile_count)
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    environment.reset_for_tests()
+    faults.reset_for_tests()
+    yield
+    environment.reset_for_tests()
+    faults.reset_for_tests()
+    obs.set_enabled(False)
+
+
+def _nn_models(n=3, n_features=8, hidden=(8,), seed0=0):
+    spec = NNModelSpec(input_dim=n_features, hidden_nodes=list(hidden),
+                       activations=["relu"] * len(hidden))
+    return [IndependentNNModel(spec, init_params(
+        jax.random.PRNGKey(seed0 + i), spec)) for i in range(n)]
+
+
+def _gbt_model(n_features=6, n_bins=8, n_trees=4, depth=3, seed=0):
+    from shifu_tpu.models.tree import IndependentTreeModel, TreeModelSpec
+    from shifu_tpu.train.dt_trainer import DTSettings, train_gbt
+    rng = np.random.default_rng(seed)
+    bins = rng.integers(0, n_bins, size=(512, n_features)).astype(np.int32)
+    y = (rng.random(512) < 0.4).astype(np.float32)
+    w = np.ones(512, np.float32)
+    settings = DTSettings(n_trees=n_trees, depth=depth, loss="log",
+                          learning_rate=0.1)
+    res = train_gbt(bins, y, w, n_bins, np.zeros(n_features, bool),
+                    settings)
+    spec = TreeModelSpec(n_trees=len(res.trees), depth=depth,
+                         n_bins=n_bins, **res.spec_kwargs)
+    return IndependentTreeModel(spec, res.trees)
+
+
+def _wdl_model(n_features=8, n_bins_cols=6, seed=3):
+    from shifu_tpu.models.wdl import IndependentWDLModel, WDLModelSpec
+    from shifu_tpu.models.wdl import init_params as wdl_init
+    spec = WDLModelSpec(numeric_dim=3, cat_cardinalities=[8, 8],
+                        embed_dim=4, hidden_nodes=[8],
+                        activations=["relu"],
+                        extra={"num_feat_idx": [0, 2, 4],
+                               "cat_col_idx": [1, 3]})
+    return IndependentWDLModel(spec, wdl_init(jax.random.PRNGKey(seed),
+                                              spec))
+
+
+# ----------------------------------------------------------- bucket math
+def test_bucket_ladder_property_and_default():
+    assert bucket_ladder() == (1, 8, 64, 512)
+    environment.set_property("shifu.serve.buckets", "4,1,32,4")
+    assert bucket_ladder() == (1, 4, 32)
+    environment.set_property("shifu.serve.buckets", "junk")
+    assert bucket_ladder() == (1, 8, 64, 512)     # unparseable -> default
+
+
+def test_covering_bucket():
+    b = (1, 8, 64)
+    assert covering_bucket(b, 1) == 1
+    assert covering_bucket(b, 2) == 8
+    assert covering_bucket(b, 8) == 8
+    assert covering_bucket(b, 64) == 64
+    assert covering_bucket(b, 1000) == 64         # caller chunks oversize
+
+
+def test_infer_dims_mixed_ensemble():
+    models = _nn_models(n_features=8) + [_gbt_model(n_features=6)] \
+        + [_wdl_model()]
+    f, c = infer_dims(models)
+    assert f == 8
+    assert c >= 4            # gbt split features + wdl cat cols
+
+
+# ---------------------------------------------------- bucket-pad parity
+def _rand_xb(rng, n, scorer, n_bins=8):
+    x = rng.normal(size=(n, scorer.n_features)).astype(np.float32)
+    b = rng.integers(0, n_bins,
+                     size=(n, scorer.n_bins_cols)).astype(np.int32)
+    return x, (b if scorer.needs_bins else None)
+
+
+@pytest.mark.parametrize("kind", ["nn", "gbt", "wdl", "mixed"])
+def test_padded_bucket_scores_bit_identical(kind):
+    """Scores from a padded bucket launch, after trim, are BIT-identical
+    to an exact-size launch of the same rows — across NN, GBT and WDL
+    model groups (padding must be invisible, not merely close)."""
+    if kind == "nn":
+        models = _nn_models()
+    elif kind == "gbt":
+        models = [_gbt_model(seed=i) for i in range(2)]
+    elif kind == "wdl":
+        models = [_wdl_model()]
+    else:
+        models = _nn_models(2) + [_gbt_model(), _wdl_model()]
+    scorer = AOTScorer(models, buckets=(1, 4, 16))
+    scorer.warm(launch=False)
+    rng = np.random.default_rng(7)
+    x, bins = _rand_xb(rng, 16, scorer)
+    # pad 3 rows -> bucket 4 vs the same executable launched exactly full
+    # with the same leading rows: trimmed scores must match bitwise
+    exact = scorer.score_batch(x[:4], None if bins is None else bins[:4])
+    padded = scorer.score_batch(x[:3], None if bins is None else bins[:3])
+    assert padded.tobytes() == exact[:3].tobytes()
+    # same at the 16 rung: 13 padded vs 16 exact
+    exact16 = scorer.score_batch(x, bins)
+    pad16 = scorer.score_batch(x[:13],
+                               None if bins is None else bins[:13])
+    assert pad16.tobytes() == exact16[:13].tobytes()
+
+
+def test_oversize_batch_chunks_through_top_bucket():
+    models = _nn_models()
+    scorer = AOTScorer(models, buckets=(1, 4))
+    rng = np.random.default_rng(1)
+    x, _ = _rand_xb(rng, 11, scorer)
+    full = scorer.score_batch(x)
+    assert full.shape == (11, len(models))
+    parts = np.concatenate([scorer.score_batch(x[:4]),
+                            scorer.score_batch(x[4:8]),
+                            scorer.score_batch(x[8:])], axis=0)
+    assert full.tobytes() == parts.tobytes()
+
+
+# -------------------------------------------------- recompile sentinel
+def test_warmed_server_zero_recompiles_over_random_sizes():
+    """A warmed server performs ZERO xla.recompiles over a randomized
+    request-size sweep — every request size pads into a pre-compiled
+    rung."""
+    models = _nn_models(2) + [_gbt_model()]
+    scorer = AOTScorer(models, buckets=(1, 4, 16))
+    scorer.warm()
+    obs.set_enabled(True)
+    rng = np.random.default_rng(11)
+    before = serve_recompile_count()
+    ctr = obs.counter("xla.recompiles")
+    xla_before = ctr.value
+    b = MicroBatcher(lambda: scorer, max_delay_s=0.0)
+    for n in rng.integers(1, 17, size=40):
+        x, bins = _rand_xb(rng, int(n), scorer)
+        t = b.submit_burst(x, bins)
+        b.drain()
+        assert t.wait(10.0).shape == (int(n),)
+    assert serve_recompile_count() - before == 0
+    assert ctr.value - xla_before == 0
+
+
+# --------------------------------------------------------- micro-batcher
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_batcher_deadline_flush_with_fake_clock():
+    """No flush before the oldest request's deadline; flush after —
+    driven entirely by an injected clock, no sleeps."""
+    models = _nn_models()
+    scorer = AOTScorer(models, buckets=(1, 4, 16))
+    scorer.warm(launch=False)
+    clk = FakeClock()
+    b = MicroBatcher(lambda: scorer, max_delay_s=0.002, clock=clk)
+    rng = np.random.default_rng(0)
+    t1 = b.submit(rng.normal(size=scorer.n_features))
+    clk.t += 0.001
+    assert b.pump() == 0 and not t1.done()        # deadline not reached
+    t2 = b.submit(rng.normal(size=scorer.n_features))
+    clk.t += 0.0015                               # oldest is now 2.5ms old
+    assert b.pump() == 2                          # deadline flush, both
+    assert t1.done() and t2.done()
+    assert b.stats["flush_deadline"] == 1 and b.stats["flush_full"] == 0
+    # both coalesced into ONE bucket-4 launch, 2 pad rows counted
+    assert b.stats["batches"] == 1
+    assert b.stats["rows_padded"] == 2
+    assert b.bucket_counts == {4: 1}
+
+
+def test_batcher_full_bucket_flushes_without_deadline():
+    models = _nn_models()
+    scorer = AOTScorer(models, buckets=(1, 4))
+    scorer.warm(launch=False)
+    clk = FakeClock()
+    b = MicroBatcher(lambda: scorer, max_delay_s=10.0, clock=clk)
+    rng = np.random.default_rng(0)
+    t = b.submit_burst(rng.normal(size=(4, scorer.n_features))
+                       .astype(np.float32))
+    assert b.pump() == 4                          # full top bucket, no wait
+    assert b.stats["flush_full"] == 1
+    assert t.wait(1.0).shape == (4,)
+
+
+def test_burst_split_across_launches_keeps_row_order():
+    models = _nn_models()
+    scorer = AOTScorer(models, buckets=(1, 4))
+    scorer.warm(launch=False)
+    b = MicroBatcher(lambda: scorer, max_delay_s=0.0)
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(10, scorer.n_features)).astype(np.float32)
+    t = b.submit_burst(x)
+    b.drain()
+    got = t.wait(5.0)
+    want = scorer.score_batch(x).mean(axis=1)
+    assert got.tobytes() == want.astype(np.float32).tobytes()
+    assert b.stats["batches"] == 3                # 4 + 4 + 2(padded)
+
+
+def test_threaded_batcher_serves_closed_loop():
+    """One real-thread smoke: worker flushes on its own (small deadline,
+    bounded wall time)."""
+    models = _nn_models()
+    server = ServeServer(models=models, key="t", buckets=(1, 4, 16),
+                         max_delay_ms=1.0).start()
+    try:
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(5, 8)).astype(np.float32)
+        out = server.score(x, timeout=10.0)
+        assert out.shape == (5,) and np.isfinite(out).all()
+        st = server.status()
+        assert st["state"] == "serving" and st["models"] == 3
+    finally:
+        server.stop()
+
+
+def test_http_front_end_scores_and_reports_health():
+    """POST /score + GET /healthz on an ephemeral loopback port (the
+    stdlib front-end `shifu-tpu serve` binds)."""
+    import threading
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    from shifu_tpu.serve.server import _make_handler
+    server = ServeServer(models=_nn_models(), key="h", buckets=(1, 4),
+                         max_delay_ms=1.0).start()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _make_handler(server))
+    port = httpd.server_address[1]
+    th = threading.Thread(target=httpd.serve_forever, daemon=True)
+    th.start()
+    try:
+        rng = np.random.default_rng(9)
+        rows = rng.normal(size=(3, 8)).round(4).tolist()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/score",
+            data=json.dumps({"rows": rows}).encode(),
+            headers={"Content-Type": "application/json"})
+        doc = json.load(urllib.request.urlopen(req, timeout=15))
+        assert len(doc["scores"]) == 3
+        want = server.score(np.asarray(rows, np.float32), timeout=15.0)
+        assert np.allclose(doc["scores"], want, atol=1e-4)
+        health = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=15))
+        assert health["state"] == "serving" and health["models"] == 3
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        server.stop()
+
+
+# ----------------------------------------------------------- fault sites
+def _set_faults(spec: str) -> None:
+    environment.set_property("shifu.faults", spec)
+    faults.reset_for_tests()
+
+
+def test_killed_inflight_batch_leaves_registry_serviceable():
+    """serve:request ioerror fails exactly that batch's tickets; the
+    next request scores bit-identically to an undisturbed scorer."""
+    models = _nn_models()
+    reg = ModelRegistry()
+    reg.load("m", models, buckets=(1, 4))
+    b = MicroBatcher(reg.provider("m"), max_delay_s=0.0)
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(2, 8)).astype(np.float32)
+    want = reg.get("m").score_batch(x).mean(axis=1)
+    _set_faults("serve:request=0:ioerror")
+    t = b.submit_burst(x)
+    b.drain()
+    with pytest.raises(faults.InjectedFault):
+        t.wait(1.0)
+    assert b.stats["errors"] == 1
+    t2 = b.submit_burst(x)                         # next batch is clean
+    b.drain()
+    got = t2.wait(1.0)
+    assert got.tobytes() == want.astype(np.float32).tobytes()
+    assert reg.generation("m") == 0
+
+
+def test_crashed_swap_leaves_previous_model_live():
+    """serve:swap ioerror after the candidate is built but before the
+    flip: the OLD model stays live and scores bit-identical to the
+    pre-swap scorer."""
+    old_models = _nn_models(seed0=0)
+    new_models = _nn_models(seed0=50)
+    reg = ModelRegistry()
+    reg.load("m", old_models, buckets=(1, 4))
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(3, 8)).astype(np.float32)
+    before = reg.get("m").score_batch(x)
+    _set_faults("serve:swap=m:ioerror")
+    with pytest.raises(faults.InjectedFault):
+        reg.swap("m", new_models, buckets=(1, 4))
+    after = reg.get("m").score_batch(x)
+    assert after.tobytes() == before.tobytes()
+    assert reg.generation("m") == 0
+    # the disarmed site lets the next promote through, and scores change
+    faults.reset_for_tests()
+    environment.reset_for_tests()
+    reg.swap("m", new_models, buckets=(1, 4))
+    assert reg.generation("m") == 1
+    assert reg.get("m").score_batch(x).tobytes() != before.tobytes()
+
+
+def test_swap_journal_is_atomic_and_resolvable(tmp_path):
+    reg = ModelRegistry(state_dir=str(tmp_path))
+    reg.load("m", _nn_models(), buckets=(1, 4))
+    reg.swap("m", _nn_models(seed0=9), buckets=(1, 4))
+    with open(os.path.join(str(tmp_path), "serving.json")) as f:
+        doc = json.load(f)
+    assert doc["m"]["generation"] == 1
+    assert not [f for f in os.listdir(str(tmp_path)) if ".tmp" in f]
+
+
+def test_hot_swap_between_batches_drops_nothing():
+    reg = ModelRegistry()
+    reg.load("m", _nn_models(seed0=0), buckets=(1, 4))
+    b = MicroBatcher(reg.provider("m"), max_delay_s=0.0)
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(2, 8)).astype(np.float32)
+    t1 = b.submit_burst(x)
+    b.drain()
+    reg.swap("m", _nn_models(seed0=77), buckets=(1, 4))
+    t2 = b.submit_burst(x)
+    b.drain()
+    a, c = t1.wait(1.0), t2.wait(1.0)
+    assert np.isfinite(a).all() and np.isfinite(c).all()
+    assert a.tobytes() != c.tobytes()              # new model answered
+
+
+# ------------------------------------------- eval Scorer cache (satellite)
+def test_scorer_stacked_groups_rebuild_when_models_change():
+    """Regression: ``Scorer._stacked_nn_groups`` cached forever — a
+    hot-swap that replaces ``self.models`` on a reused Scorer instance
+    must rebuild the stacks, not keep scoring the old ensemble."""
+    from shifu_tpu.eval.scorer import Scorer
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    old = _nn_models(2, seed0=0)
+    new = _nn_models(2, seed0=123)
+    s = Scorer(old)
+    first = s.score(x).scores
+    s.models = list(new)                          # the hot-swap pattern
+    swapped = s.score(x).scores
+    fresh = Scorer(new).score(x).scores
+    assert swapped.tobytes() == fresh.tobytes()
+    assert swapped.tobytes() != first.tobytes()
+
+
+# --------------------------------------------- bench compare (satellite)
+def test_compare_latency_class_lower_is_better(tmp_path, capsys):
+    """A serve p99 regression exits 2 like a throughput regression;
+    a latency IMPROVEMENT never flags."""
+    from shifu_tpu.bench import (compare_bench, is_tracked_latency,
+                                 is_tracked_throughput, run_compare)
+    assert is_tracked_latency("serve_low_p99_ms")
+    assert is_tracked_latency("serve_closed_p50_ms")
+    assert not is_tracked_latency("serve_qps_sustained")
+    assert not is_tracked_throughput("serve_low_p99_ms")
+    assert is_tracked_throughput("serve_qps_sustained")
+    assert not is_tracked_throughput("serve_low_qps_offered")
+    old = {"metric": "serve_qps_sustained", "value": 100000.0,
+           "extra": {"serve_low_p99_ms": 3.0, "serve_mid_p50_ms": 1.0,
+                     "serve_deadline_ms": 2.0}}
+    new = {"metric": "serve_qps_sustained", "value": 100000.0,
+           "extra": {"serve_low_p99_ms": 9.0,     # 3x worse: regression
+                     "serve_mid_p50_ms": 0.5,     # improvement: fine
+                     "serve_deadline_ms": 2.0}}   # untracked
+    rows, regressed = compare_bench(old, new, threshold=0.9)
+    assert regressed == ["serve_low_p99_ms"]
+    # at exactly old/threshold the latency metric does NOT regress
+    edge = {"metric": "serve_qps_sustained", "value": 100000.0,
+            "extra": {"serve_low_p99_ms": 3.0 / 0.9,
+                      "serve_mid_p50_ms": 1.0, "serve_deadline_ms": 2.0}}
+    _, r2 = compare_bench(old, edge, threshold=0.9)
+    assert r2 == []
+    po, pn = str(tmp_path / "old.json"), str(tmp_path / "new.json")
+    with open(po, "w") as f:
+        json.dump(old, f)
+    with open(pn, "w") as f:
+        json.dump(new, f)
+    assert run_compare(po, pn, threshold=0.9) == 2
+    out = capsys.readouterr().out
+    assert "serve_low_p99_ms" in out and "REGRESSED" in out
+    assert run_compare(po, po, threshold=0.9) == 0
+
+
+# ----------------------------------------------------------- CLI surface
+def test_bench_help_lists_serve_plane():
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py"), "--help"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0 and "serve" in out.stdout
+
+
+def test_cli_serve_selfcheck_on_trained_modelset(prepared_set, capsys):
+    """`shifu-tpu serve --selfcheck` loads the trained ensemble from
+    <dir>/models, warms the buckets, scores synthetic rows in-process
+    and exits 0 — the CI smoke for the production surface."""
+    from shifu_tpu.cli import main as cli_main
+    from shifu_tpu.config import ModelConfig
+    mc = ModelConfig.load(os.path.join(prepared_set, "ModelConfig.json"))
+    mc.train.numTrainEpochs = 3
+    mc.save(os.path.join(prepared_set, "ModelConfig.json"))
+    from shifu_tpu.pipeline.train import TrainProcessor
+    assert TrainProcessor(prepared_set, params={}).run() == 0
+    rc = cli_main(["--dir", prepared_set,
+                   "-Dshifu.serve.buckets=1,4,16", "serve",
+                   "--selfcheck", "4"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["selfcheck_rows"] == 4
+    assert len(doc["scores_head"]) == 4
+    assert doc["buckets"] == [1, 4, 16]
+    # journal-style promote wrote the serving manifest atomically
+    with open(os.path.join(prepared_set, "serving", "serving.json")) as f:
+        j = json.load(f)
+    assert list(j.values())[0]["generation"] == 0
