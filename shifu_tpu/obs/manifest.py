@@ -79,7 +79,9 @@ MANIFEST: Dict[str, Tuple[str, str]] = {
                        "signature (the shape-churn sentinel)"),
     "xla.launches": ("counter", "costed executable launches"),
     # ---- serving plane (serve/)
-    "serve.requests": ("counter", "scoring requests accepted"),
+    "serve.requests": ("counter",
+                       "scoring requests accepted (one per submit; "
+                       "row volume is serve.rows_scored)"),
     "serve.rows_scored": ("counter", "request rows scored"),
     "serve.batches": ("counter", "padded-bucket device launches"),
     "serve.rows_padded": ("counter",

@@ -20,6 +20,7 @@ and registry fully serviceable for the next request.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
@@ -29,6 +30,8 @@ import numpy as np
 
 from .. import faults, obs
 from .scorer import AOTScorer, covering_bucket
+
+log = logging.getLogger(__name__)
 
 
 class Ticket:
@@ -133,9 +136,11 @@ class MicroBatcher:
                 raise RuntimeError("batcher is stopped")
             self._queue.append((t, rows, bins, 0))
             self._queued_rows += n
-            self.stats["requests"] += n
+            # one accepted request per submit call; row volume is the
+            # separate "rows" / serve.rows_scored accounting
+            self.stats["requests"] += 1
             self._cond.notify_all()
-        obs.counter("serve.requests").inc(n)
+        obs.counter("serve.requests").inc()
         return t
 
     def score_sync(self, rows: np.ndarray,
@@ -208,19 +213,24 @@ class MicroBatcher:
         n = sum(len(rows) for _, rows, _, _ in parts)
         if n == 0:
             return 0
-        scorer = self._provider()
-        bucket = covering_bucket(scorer.buckets, n)
-        rows = np.concatenate([r for _, r, _, _ in parts], axis=0) \
-            if len(parts) > 1 else parts[0][1]
-        bins = None
-        if scorer.needs_bins:
-            bins = np.concatenate([b for _, _, b, _ in parts], axis=0) \
-                if len(parts) > 1 else parts[0][2]
-        batch_index = self._batches
-        self._batches += 1
+        with self._cond:
+            batch_index = self._batches
+            self._batches += 1
         err: Optional[BaseException] = None
         mean = None
+        bucket = n
+        # assembly stays INSIDE the try: mismatched row widths across
+        # bursts, a missing bins array, or a provider failure must fail
+        # this batch's tickets, not escape into the worker loop
         try:
+            scorer = self._provider()
+            bucket = covering_bucket(scorer.buckets, n)
+            rows = np.concatenate([r for _, r, _, _ in parts], axis=0) \
+                if len(parts) > 1 else parts[0][1]
+            bins = None
+            if scorer.needs_bins:
+                bins = np.concatenate([b for _, _, b, _ in parts], axis=0) \
+                    if len(parts) > 1 else parts[0][2]
             faults.fire("serve", "request", batch_index)
             raw = scorer.score_batch(rows, bins)
             mean = raw.mean(axis=1).astype(np.float32)
@@ -235,16 +245,19 @@ class MicroBatcher:
                         else mean[off:off + len(r)], now, err)
             off += len(r)
         pad = bucket - n
-        self.stats["batches"] += 1
-        self.stats["rows"] += n
-        self.stats["rows_padded"] += pad
-        self.bucket_counts[bucket] = self.bucket_counts.get(bucket, 0) + 1
+        with self._cond:
+            self.stats["batches"] += 1
+            self.stats["rows"] += n
+            self.stats["rows_padded"] += pad
+            self.bucket_counts[bucket] = \
+                self.bucket_counts.get(bucket, 0) + 1
+            if err is not None:
+                self.stats["errors"] += 1
         obs.counter("serve.batches").inc()
         obs.counter("serve.rows_scored").inc(n)
         obs.counter("serve.rows_padded").inc(pad)
         obs.gauge("serve.bucket_occupancy").set(n / bucket)
         if err is not None:
-            self.stats["errors"] += 1
             obs.counter("serve.request_errors").inc()
             if not isinstance(err, (faults.InjectedFault, ValueError,
                                     RuntimeError)):
@@ -265,21 +278,27 @@ class MicroBatcher:
 
     def _run(self) -> None:
         while True:
-            with self._cond:
-                while not self._queue and not self._stop:
-                    self._cond.wait()
-                if self._stop and not self._queue:
-                    return
-                # coalesce: wait for the top bucket to fill, but never
-                # past the oldest request's deadline
-                while (self._queued_rows < self._top_bucket()
-                       and not self._stop):
-                    remaining = (self._oldest_stamp() + self.max_delay_s
-                                 - self.clock())
-                    if remaining <= 0:
-                        break
-                    self._cond.wait(timeout=remaining)
-            self.pump(force=True)
+            try:
+                with self._cond:
+                    while not self._queue and not self._stop:
+                        self._cond.wait()
+                    if self._stop and not self._queue:
+                        return
+                    # coalesce: wait for the top bucket to fill, but never
+                    # past the oldest request's deadline
+                    while (self._queued_rows < self._top_bucket()
+                           and not self._stop):
+                        remaining = (self._oldest_stamp() + self.max_delay_s
+                                     - self.clock())
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(timeout=remaining)
+                self.pump(force=True)
+            except Exception:               # noqa: BLE001 — worker survives
+                # the failed batch's tickets already carry the error (see
+                # serve:request contract); the server must stay serviceable
+                log.exception("serve batch failed; batcher continues")
+                time.sleep(0.05)            # no hot loop on repeated failure
 
     def stop(self, drain: bool = True) -> None:
         with self._cond:

@@ -7,10 +7,13 @@ a retrained model must never drop requests, so a swap is journal-style:
    executable, entirely off-line (the live scorer keeps serving);
 2. JOURNAL — commit ``serving.json`` via :mod:`shifu_tpu.ioutil`'s
    atomic write (a restart re-resolves to whatever was last promoted —
-   a crash mid-commit leaves the previous journal intact);
+   a crash mid-commit leaves the previous journal intact, and a crash
+   between the commit and the flip re-promotes the candidate on
+   restart: the journal is write-ahead);
 3. FLIP — one reference assignment under the lock.  In-flight batches
    finish on the old scorer (the batcher reads the provider per flush);
-   the next batch scores on the new one.
+   the next batch scores on the new one.  A journal failure (disk full,
+   perms) raises BEFORE the flip, so the previous model stays live.
 
 Fault site: ``serve:swap=<key>`` fires after BUILD and before
 JOURNAL+FLIP — a crash or injected error there must leave the previous
@@ -87,12 +90,13 @@ class ModelRegistry:
         """First load of a modelset (no previous model to protect);
         accepts a models dir or an in-memory model sequence."""
         scorer = self._build(key, models_or_dir, scale, buckets, 0, warm)
+        new_dir = models_or_dir if isinstance(models_or_dir, str) else None
+        self._journal(pending={key: (new_dir, 0)})
         with self._lock:
             self._live[key] = scorer
             self._gen[key] = 0
-            if isinstance(models_or_dir, str):
-                self._dirs[key] = models_or_dir
-        self._journal()
+            if new_dir is not None:
+                self._dirs[key] = new_dir
         return scorer
 
     def swap(self, key: str, models_or_dir, scale: float = SCORE_SCALE,
@@ -110,25 +114,40 @@ class ModelRegistry:
         scorer = self._build(key, models_or_dir, scale, buckets, gen, warm)
         # a crash from here to the flip must leave the OLD model live
         faults.fire("serve", "swap", key)
+        new_dir = models_or_dir if isinstance(models_or_dir, str) else None
+        # JOURNAL before FLIP (module docs): a journal failure raises
+        # while the old model is still live; once committed, the flip is
+        # one infallible reference assignment
+        self._journal(pending={key: (new_dir, gen)})
         with self._lock:
             self._live[key] = scorer
             self._gen[key] = gen
-            if isinstance(models_or_dir, str):
-                self._dirs[key] = models_or_dir
-        self._journal()
+            if new_dir is not None:
+                self._dirs[key] = new_dir
         obs.counter("serve.swaps").inc()
         log.info("promoted %s generation %d", key, gen)
         return scorer
 
     # ------------------------------------------------------------ journal
-    def _journal(self) -> None:
+    def _journal(self, pending: Optional[Dict[str, tuple]] = None) -> None:
+        """Commit the serving journal.  ``pending`` maps key ->
+        ``(models_dir|None, generation)`` for a promotion that is being
+        journalled BEFORE its flip (write-ahead)."""
         if not self.state_dir:
             return
         with self._lock:
-            doc = {k: {"models_dir": self._dirs.get(k),
-                       "generation": self._gen.get(k, 0),
-                       "promoted_ts": round(time.time(), 3)}
-                   for k in self._live}
+            keys = set(self._live)
+            dirs = dict(self._dirs)
+            gens = dict(self._gen)
+        for k, (mdir, gen) in (pending or {}).items():
+            keys.add(k)
+            gens[k] = gen
+            if mdir is not None:
+                dirs[k] = mdir
+        doc = {k: {"models_dir": dirs.get(k),
+                   "generation": gens.get(k, 0),
+                   "promoted_ts": round(time.time(), 3)}
+               for k in sorted(keys)}
         os.makedirs(self.state_dir, exist_ok=True)
         atomic_write_json(os.path.join(self.state_dir, SERVING_JOURNAL),
                           doc)

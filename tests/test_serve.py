@@ -314,6 +314,139 @@ def test_killed_inflight_batch_leaves_registry_serviceable():
     assert reg.generation("m") == 0
 
 
+def test_malformed_burst_fails_batch_not_batcher():
+    """Concurrent bursts with mismatched row widths coalesce into one
+    batch whose ASSEMBLY raises — that error must complete the batch's
+    tickets, and the batcher must stay serviceable for the next
+    request (regression: assembly errors escaped ``_launch``)."""
+    models = _nn_models()
+    scorer = AOTScorer(models, buckets=(1, 4))
+    scorer.warm(launch=False)
+    b = MicroBatcher(lambda: scorer, max_delay_s=0.0)
+    rng = np.random.default_rng(12)
+    good = rng.normal(size=(2, scorer.n_features)).astype(np.float32)
+    bad = rng.normal(size=(2, scorer.n_features - 3)).astype(np.float32)
+    t1 = b.submit_burst(good)
+    t2 = b.submit_burst(bad)          # same batch: concatenate raises
+    b.drain()
+    with pytest.raises(ValueError):
+        t1.wait(1.0)
+    with pytest.raises(ValueError):
+        t2.wait(1.0)
+    assert b.stats["errors"] == 1
+    t3 = b.submit_burst(good)          # batcher is still serviceable
+    b.drain()
+    assert t3.wait(1.0).shape == (2,)
+
+
+def test_missing_bins_burst_fails_batch_not_batcher():
+    """One client sends bins, another omits them (needs_bins scorer):
+    the mixed batch fails its tickets, the next well-formed request
+    scores."""
+    scorer = AOTScorer([_gbt_model()], buckets=(1, 4))
+    scorer.warm(launch=False)
+    b = MicroBatcher(lambda: scorer, max_delay_s=0.0)
+    rng = np.random.default_rng(13)
+    x, bins = _rand_xb(rng, 2, scorer)
+    t1 = b.submit_burst(x, bins)
+    t2 = b.submit_burst(x, None)       # omitted bins
+    b.drain()
+    with pytest.raises((ValueError, TypeError)):
+        t1.wait(1.0)
+    with pytest.raises((ValueError, TypeError)):
+        t2.wait(1.0)
+    t3 = b.submit_burst(x, bins)
+    b.drain()
+    assert t3.wait(1.0).shape == (2,)
+
+
+class _FlakyScorer:
+    """Wraps an AOTScorer; raises an UN-tolerated error type on demand."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.boom = False
+
+    @property
+    def buckets(self):
+        return self.inner.buckets
+
+    @property
+    def needs_bins(self):
+        return self.inner.needs_bins
+
+    def score_batch(self, rows, bins=None):
+        if self.boom:
+            raise KeyError("unexpected per-batch failure")
+        return self.inner.score_batch(rows, bins)
+
+
+def test_worker_thread_survives_unexpected_batch_error():
+    """An error OUTSIDE the tolerated set (here a KeyError) fails its
+    own batch's tickets but must NOT kill the worker thread — the next
+    request still scores (regression: the re-raise propagated through
+    ``_run`` and permanently stopped serving)."""
+    scorer = AOTScorer(_nn_models(), buckets=(1, 4))
+    scorer.warm(launch=False)
+    flaky = _FlakyScorer(scorer)
+    b = MicroBatcher(lambda: flaky, max_delay_s=0.0005).start()
+    try:
+        rng = np.random.default_rng(14)
+        x = rng.normal(size=(2, scorer.n_features)).astype(np.float32)
+        flaky.boom = True
+        t = b.submit_burst(x)
+        with pytest.raises(KeyError):
+            t.wait(10.0)
+        flaky.boom = False
+        t2 = b.submit_burst(x)         # worker thread must still be alive
+        assert t2.wait(10.0).shape == (2,)
+        assert b.stats["errors"] == 1
+    finally:
+        b.stop()
+
+
+def test_requests_counted_per_submit_not_per_row():
+    """``stats['requests']`` counts accepted submit calls; row volume
+    is ``stats['rows']`` (regression: bursts counted rows as
+    requests, duplicating rows_scored)."""
+    scorer = AOTScorer(_nn_models(), buckets=(1, 4))
+    scorer.warm(launch=False)
+    b = MicroBatcher(lambda: scorer, max_delay_s=0.0)
+    rng = np.random.default_rng(16)
+    b.submit_burst(rng.normal(size=(3, scorer.n_features))
+                   .astype(np.float32))
+    b.submit(rng.normal(size=scorer.n_features))
+    b.drain()
+    assert b.stats["requests"] == 2
+    assert b.stats["rows"] == 4
+
+
+def test_failed_journal_leaves_previous_model_live(tmp_path, monkeypatch):
+    """swap() journals BEFORE the flip: if the journal commit fails
+    (disk full, perms) the swap raises and the OLD model is still live,
+    matching the docstring contract."""
+    import shifu_tpu.serve.registry as regmod
+    reg = ModelRegistry(state_dir=str(tmp_path))
+    reg.load("m", _nn_models(seed0=0), buckets=(1, 4))
+    rng = np.random.default_rng(15)
+    x = rng.normal(size=(2, 8)).astype(np.float32)
+    before = reg.get("m").score_batch(x)
+
+    def boom(path, doc):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(regmod, "atomic_write_json", boom)
+    with pytest.raises(OSError):
+        reg.swap("m", _nn_models(seed0=50), buckets=(1, 4))
+    assert reg.generation("m") == 0
+    assert reg.get("m").score_batch(x).tobytes() == before.tobytes()
+    with open(os.path.join(str(tmp_path), "serving.json")) as f:
+        assert json.load(f)["m"]["generation"] == 0
+    monkeypatch.undo()                 # journal healthy again: promote
+    reg.swap("m", _nn_models(seed0=50), buckets=(1, 4))
+    assert reg.generation("m") == 1
+
+
 def test_crashed_swap_leaves_previous_model_live():
     """serve:swap ioerror after the candidate is built but before the
     flip: the OLD model stays live and scores bit-identical to the
