@@ -49,7 +49,14 @@ from . import obs
 # load, padding waste, zero-recompile guard); --compare learns the
 # LOWER-is-better metric class (*_p50*/*_p99* latency extras regress
 # when new > old / threshold).
-BENCH_TELEMETRY_SCHEMA = 7
+# v8: request/SLO observability plane — sampled serve.request /
+# serve.batch span records (per-request queue/pad/launch/device
+# decomposition), slo.* gauges, histogram p50/p99 sketch quantiles; the
+# serve bench runs a 1%-sampled traced pass (serve_traced_qps guarded
+# at >= 0.95x the QPS floor) and emits latency-decomposition extras
+# (serve_queue_frac / serve_device_frac / serve_pad_frac); --compare
+# tracks the queue/pad fracs in the lower-is-better class.
+BENCH_TELEMETRY_SCHEMA = 8
 
 # measured on this rig (tools/measure_baseline.py); provenance in
 # BASELINE.md — every headline divides by a MEASURED reference-class
@@ -960,6 +967,33 @@ SERVE_BENCH_FLOOR = 5000.0
 # low-load p99 must stay bounded by the deadline knob; the slop absorbs
 # CI-rig scheduler noise (SHIFU_BENCH_SERVE_P99_SLOP_MS overrides)
 SERVE_P99_SLOP_MS = 50.0
+# the traced pass head-samples this fraction of requests and must still
+# sustain TRACE_OVERHEAD_FLOOR_FRAC x the QPS floor — the acceptance
+# bound on per-request tracing overhead at load
+TRACE_BENCH_SAMPLE_RATE = 0.01
+TRACE_OVERHEAD_FLOOR_FRAC = 0.95
+
+
+def _trace_decomposition(request_spans) -> Dict[str, float]:
+    """Mean latency-decomposition fractions over sampled
+    ``serve.request`` span records: where a request's end-to-end time
+    went (queue wait / device compute / padding+assembly).  Empty input
+    yields no extras."""
+    fracs = {"serve_queue_frac": [], "serve_device_frac": [],
+             "serve_pad_frac": []}
+    for rec in request_spans:
+        a = rec.get("attrs") or {}
+        e2e = float(a.get("e2e_s") or 0.0)
+        if e2e <= 0:
+            continue
+        fracs["serve_queue_frac"].append(
+            float(a.get("queue_wait_s") or 0.0) / e2e)
+        fracs["serve_device_frac"].append(
+            float(a.get("device_s") or 0.0) / e2e)
+        fracs["serve_pad_frac"].append(
+            float(a.get("pad_s") or 0.0) / e2e)
+    return {k: round(float(np.mean(v)), 4)
+            for k, v in fracs.items() if v}
 
 
 def _serve_open_loop(batcher, pool: np.ndarray, qps: float,
@@ -1107,6 +1141,29 @@ def bench_serve(n_features: int = 32, n_models: int = 5,
             if gc_was_enabled:
                 gc.enable()
         recompiles = serve_recompile_count() - recompiles0
+
+        # traced pass: head-sample 1% of requests (telemetry on) and
+        # re-measure sustained QPS — the per-request-tracing overhead
+        # acceptance — then read the sampled serve.request records for
+        # the latency-decomposition extras
+        prev_enabled = obs.enabled()
+        obs.set_enabled(True)
+        rec_before = len(obs.pending_records())
+        batcher.trace_sample_rate = TRACE_BENCH_SAMPLE_RATE
+        try:
+            traced_qps, _ = _serve_saturation(batcher, pool,
+                                              duration_s / 2)
+            # one explicit-id burst so even a tiny sweep yields a
+            # decomposition sample (an explicit id forces sampling)
+            batcher.submit_burst(pool[:37],
+                                 trace_id="bench-decomp").wait(30.0)
+        finally:
+            batcher.trace_sample_rate = 0.0
+            request_spans = [
+                r for r in obs.pending_records()[rec_before:]
+                if r.get("kind") == "span"
+                and r.get("name") == "serve.request"]
+            obs.set_enabled(True if prev_enabled else None)
     finally:
         server.stop()
 
@@ -1133,6 +1190,10 @@ def bench_serve(n_features: int = 32, n_models: int = 5,
         "serve_closed_p50_ms": pct(closed_lats, 50),
         "serve_closed_p99_ms": pct(closed_lats, 99),
         "serve_recompiles_after_warm": int(recompiles),
+        "serve_traced_qps": round(traced_qps, 1),
+        "serve_trace_sample_rate": TRACE_BENCH_SAMPLE_RATE,
+        "serve_trace_sampled": len(request_spans),
+        **_trace_decomposition(request_spans),
         "serve_batches": int(batches),
         "serve_rows_padded": int(padded),
         "serve_padding_waste_frac": round(
@@ -1164,6 +1225,12 @@ def bench_serve(n_features: int = 32, n_models: int = 5,
         raise AssertionError(
             f"sustained serve QPS {max_ach:.0f} below the catastrophic "
             f"floor {floor:.0f} (SHIFU_BENCH_SERVE_FLOOR)")
+    if traced_qps < TRACE_OVERHEAD_FLOOR_FRAC * floor:
+        raise AssertionError(
+            f"serve QPS with {TRACE_BENCH_SAMPLE_RATE:.0%} request "
+            f"tracing fell to {traced_qps:.0f} — below "
+            f"{TRACE_OVERHEAD_FLOOR_FRAC}x the {floor:.0f} floor; "
+            "head sampling is no longer bounding tracing overhead")
     return rep
 
 
@@ -1216,12 +1283,17 @@ def is_tracked_throughput(name: str) -> bool:
 
 
 def is_tracked_latency(name: str) -> bool:
-    """LOWER-is-better metrics (v7): latency percentiles.  A serve p99
-    that grows past old/threshold regresses the compare exactly like a
-    throughput drop — tail latency is the serving plane's contract."""
+    """LOWER-is-better metrics (v7/v8): latency percentiles plus the
+    serve decomposition's queue/pad fractions (time a request spends
+    waiting or being padded, not scored — growth is a regression).  A
+    serve p99 that grows past old/threshold regresses the compare
+    exactly like a throughput drop — tail latency is the serving
+    plane's contract.  ``*_device_frac`` stays informational: a larger
+    device share usually means LESS overhead, not more."""
     if name.endswith("_error") or name.endswith("_vs_baseline"):
         return False
-    return "_p50" in name or "_p99" in name
+    return ("_p50" in name or "_p99" in name
+            or name.endswith("_queue_frac") or name.endswith("_pad_frac"))
 
 
 def compare_bench(old: Dict[str, Any], new: Dict[str, Any],

@@ -136,6 +136,13 @@ def build_parser() -> argparse.ArgumentParser:
                     "achieved FLOP/s, bytes/s, percent-of-peak and a "
                     "roofline verdict per plane (peaks override: "
                     "SHIFU_TPU_PEAK_FLOPS / SHIFU_TPU_PEAK_BW)")
+    sp.add_argument("-aggregate", "--aggregate", dest="analysis_aggregate",
+                    nargs="+", metavar="DIR", default=None,
+                    help="with --telemetry [--timeline]: merge the "
+                    "telemetry dirs of N processes (replaces --dir) "
+                    "into one report / one trace — per-proc tracks, "
+                    "clock-offset normalization from heartbeats, "
+                    "per-proc step-lag table")
 
     sp = sub.add_parser("monitor", help="live health monitor: tail "
                         "<modelset>/telemetry/health/ heartbeats and "
@@ -151,11 +158,21 @@ def build_parser() -> argparse.ArgumentParser:
                     "doc (per-proc health + quorum summary) instead of "
                     "the table; exit 0 healthy, 3 when any process is "
                     "stalled or stale — for CI and cron consumers")
+    sp.add_argument("--aggregate", dest="monitor_aggregate", nargs="+",
+                    metavar="DIR", default=None,
+                    help="merge the health planes of N process telemetry "
+                    "dirs (replaces --dir) into one report: tagged "
+                    "table, merged quorum, per-proc step-lag table, "
+                    "heartbeat clock-offset normalization")
 
     sp = sub.add_parser("serve", help="online scoring server: the trained "
                         "ensemble AOT-compiled + HBM-pinned behind a "
                         "padded-bucket micro-batcher (knobs: "
-                        "-Dshifu.serve.buckets, -Dshifu.serve.maxDelayMs)")
+                        "-Dshifu.serve.buckets, -Dshifu.serve.maxDelayMs, "
+                        "-Dshifu.serve.traceSampleRate per-request "
+                        "tracing, -Dshifu.serve.sloP99Ms / "
+                        "-Dshifu.serve.sloAvailability SLO objectives; "
+                        "GET /slo serves live burn-rate alerts)")
     sp.add_argument("--port", dest="serve_port", type=int, default=8188,
                     help="HTTP port for POST /score + GET /healthz "
                     "(default 8188)")
@@ -292,16 +309,22 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
     if cmd == "analysis":
         if getattr(args, "telemetry_report", False) \
                 or getattr(args, "utilization", False):
+            agg = getattr(args, "analysis_aggregate", None)
             if getattr(args, "utilization", False):
                 from .obs.utilization import render_utilization
                 print(render_utilization(args.dir))
                 return 0
             if getattr(args, "timeline_out", None):
                 from .obs.report import NO_TELEMETRY_HINT
-                from .obs.timeline import export_timeline
+                from .obs.timeline import (export_merged_timeline,
+                                           export_timeline)
                 skipped: list = []
-                out = export_timeline(args.dir, args.timeline_out,
-                                      skipped=skipped)
+                if agg:
+                    out = export_merged_timeline(agg, args.timeline_out,
+                                                 skipped=skipped)
+                else:
+                    out = export_timeline(args.dir, args.timeline_out,
+                                          skipped=skipped)
                 if out is None:
                     print(NO_TELEMETRY_HINT)
                 else:
@@ -310,6 +333,10 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                     if skipped:
                         print(f"warning: {len(skipped)} torn trace "
                               "line(s) skipped (crashed run mid-write?)")
+                return 0
+            if agg:
+                from .obs.report import render_telemetry_merged
+                print(render_telemetry_merged(agg))
                 return 0
             from .obs.report import render_telemetry
             print(render_telemetry(args.dir))
@@ -320,7 +347,10 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
         from .obs.monitor import run_monitor
         return run_monitor(args.dir, interval_s=args.monitor_interval,
                            once=args.monitor_once,
-                           json_mode=getattr(args, "monitor_json", False))
+                           json_mode=getattr(args, "monitor_json", False),
+                           aggregate_dirs=getattr(args,
+                                                  "monitor_aggregate",
+                                                  None))
     if cmd == "serve":
         from .serve.server import run_serve
         return run_serve(args.dir, port=args.serve_port,

@@ -27,6 +27,12 @@ Modules:
   ingest-thread spans on their own track;
 - :mod:`exporter` — periodic OpenMetrics-text + JSON registry snapshots
   (``telemetry/metrics.prom`` / ``metrics.json``);
+- :mod:`slo` — live SLO plane for the serving path: sliding-window
+  latency quantiles from a fixed-bin log histogram sketch (no
+  per-request storage), availability tracking and multi-window
+  error-budget burn-rate alerts against declared objectives
+  (``-Dshifu.serve.sloP99Ms`` / ``-Dshifu.serve.sloAvailability``),
+  surfaced via ``/slo``, SERVE heartbeats and ``metrics.prom``;
 - :mod:`drift` — streaming per-column PSI of live binned windows vs the
   training-time ColumnConfig snapshot (ROADMAP #5's promotion signal);
 - :mod:`profiler` — opt-in ``jax.profiler.trace()`` capture around any
@@ -53,8 +59,12 @@ from .registry import (counter, gauge, histogram,             # noqa: F401
                        snapshot, get_registry)
 from .tracer import (SCHEMA_VERSION, enabled, set_enabled,    # noqa: F401
                      fencing_enabled, span, event, fence, flush,
-                     pending_records, live_spans, reset_for_tests)
-from .manifest import MANIFEST, PREFIXES, is_declared         # noqa: F401
+                     record_span, pending_records, live_spans,
+                     reset_for_tests)
+from .manifest import (MANIFEST, PREFIXES, SPANS,             # noqa: F401
+                       SPAN_PREFIXES, is_declared, is_declared_span)
+from .slo import (SLOTracker, LogBins, LOG_BINS,              # noqa: F401
+                  quantile_from_counts, slo_objectives)
 from .health import (HeartbeatWriter, start_heartbeat,        # noqa: F401
                      read_health, classify, health_dir_for,
                      heartbeat_interval_s)
@@ -70,13 +80,17 @@ from .costs import (costed_jit, record_executable,            # noqa: F401
 __all__ = [
     # tracer
     "SCHEMA_VERSION", "enabled", "set_enabled", "fencing_enabled",
-    "span", "event", "fence", "flush", "pending_records", "live_spans",
-    "reset_for_tests",
+    "span", "event", "fence", "flush", "record_span", "pending_records",
+    "live_spans", "reset_for_tests",
     # registry
     "counter", "gauge", "histogram", "sample_device_memory",
     "ensure_compile_listener", "snapshot", "get_registry",
     # manifest
-    "MANIFEST", "PREFIXES", "is_declared",
+    "MANIFEST", "PREFIXES", "SPANS", "SPAN_PREFIXES", "is_declared",
+    "is_declared_span",
+    # SLO plane
+    "SLOTracker", "LogBins", "LOG_BINS", "quantile_from_counts",
+    "slo_objectives",
     # health / monitor plane
     "HeartbeatWriter", "start_heartbeat", "read_health", "classify",
     "health_dir_for", "heartbeat_interval_s",

@@ -14,9 +14,12 @@ carries ``shifu_tpu_telemetry_schema_version`` so a scraper can detect a
 layout change instead of silently mis-joining series (the same contract
 as the bench/obs schema handshake).
 
-Histograms export as summaries: ``_count`` + ``_sum`` (counters) and
-``_min`` / ``_max`` / ``_last`` gauges — the registry keeps no buckets
-(see :class:`shifu_tpu.obs.registry.Histogram`).
+Histograms export as summaries: ``_count`` + ``_sum`` (counters),
+``{quantile="0.5"}`` / ``{quantile="0.99"}`` sample lines (the registry
+histogram's fixed-bin log sketch, schema v8 — the OpenMetrics summary
+convention, so a scraper gets p50/p99 without buckets) and ``_min`` /
+``_max`` / ``_last`` gauges (see
+:class:`shifu_tpu.obs.registry.Histogram`).
 
 :class:`MetricsExporter` is the periodic writer: a daemon thread dumping
 both files through :mod:`ioutil` atomic writes every ``interval_s`` (the
@@ -85,6 +88,13 @@ def render_openmetrics(records: Optional[List[Dict[str, Any]]] = None
             lines += [f"# TYPE {name} summary",
                       f"{name}_count {_fmt(rec.get('count'))}",
                       f"{name}_sum {_fmt(rec.get('sum'))}"]
+            # quantile sample lines (summary convention): p50/p99 from
+            # the registry histogram's log sketch; pre-v8 records carry
+            # no quantiles and render the plain summary as before
+            for q, key in (("0.5", "p50"), ("0.99", "p99")):
+                if rec.get(key) is not None:
+                    lines.append(
+                        f'{name}{{quantile="{q}"}} {_fmt(rec.get(key))}')
             for stat in ("min", "max", "last"):
                 sname = f"{name}_{stat}"
                 lines += [f"# TYPE {sname} gauge",

@@ -43,7 +43,7 @@ import os
 import socket
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from .. import faults
 from ..ioutil import atomic_write_json, sweep_orphan_tmp
@@ -96,13 +96,18 @@ class HeartbeatWriter:
 
     def __init__(self, health_dir: str, step: Optional[str] = None,
                  proc: Optional[str] = None,
-                 interval_s: Optional[float] = None):
+                 interval_s: Optional[float] = None,
+                 extras_fn: Optional[Callable[[], Dict[str, Any]]] = None):
         self.health_dir = health_dir
         self.step = step
         self.pid = os.getpid()
         self.proc = proc or f"{(step or 'proc').lower()}-{self.pid}"
         self.interval_s = heartbeat_interval_s(interval_s)
         self.path = os.path.join(health_dir, f"{self.proc}.json")
+        # per-beat extra fields (the serve plane's queue_depth /
+        # queue_buildup / slo summary); failures are swallowed — a
+        # broken extras hook must never stop the heartbeat
+        self._extras_fn = extras_fn
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._started_ts = 0.0
@@ -191,19 +196,29 @@ class HeartbeatWriter:
             rec["device_peak_bytes"] = hbm["value"]
         if exit_code is not None:
             rec["exit_code"] = exit_code
+        if self._extras_fn is not None:
+            try:
+                extras = self._extras_fn() or {}
+            except Exception:
+                log.debug("heartbeat extras hook failed", exc_info=True)
+                extras = {}
+            for k, v in extras.items():      # core fields always win
+                rec.setdefault(k, v)
         return rec
 
 
 def start_heartbeat(health_dir: str, step: Optional[str] = None,
                     proc: Optional[str] = None,
-                    interval_s: Optional[float] = None
+                    interval_s: Optional[float] = None,
+                    extras_fn: Optional[Callable[[], Dict[str, Any]]] = None
                     ) -> Optional[HeartbeatWriter]:
     """Start the per-process heartbeat — ``None`` (no thread, no file, no
     directory) when telemetry is disabled."""
     if not tracer.enabled():
         return None
     return HeartbeatWriter(health_dir, step=step, proc=proc,
-                           interval_s=interval_s).start()
+                           interval_s=interval_s,
+                           extras_fn=extras_fn).start()
 
 
 # ---------------------------------------------------------------- readers

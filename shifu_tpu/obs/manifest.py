@@ -13,6 +13,12 @@ Declaring a metric: ``MANIFEST[name] = (type, help)``.  Families whose
 member names are data-dependent (per-eval-set AUC, bench extras) declare
 a prefix in ``PREFIXES`` instead — f-string call sites must start with
 one of them.
+
+SPAN names get the same treatment (``SPANS`` / ``SPAN_PREFIXES``): the
+timeline/report joins key on span-name literals, so a typo'd span name
+would silently vanish from every report.  Root spans named after the
+step (``obs.span(self.profile_name, ...)``) are variables, not
+literals, and ride outside the lint.
 """
 
 from __future__ import annotations
@@ -92,12 +98,29 @@ MANIFEST: Dict[str, Tuple[str, str]] = {
                              "deadline"),
     "serve.request_errors": ("counter", "batches failed in-flight"),
     "serve.swaps": ("counter", "model hot-swaps promoted"),
-    "serve.queue_depth": ("gauge", "rows still queued after a flush"),
+    "serve.trace_sampled": ("counter",
+                            "requests head-sampled into per-request "
+                            "tracing (shifu.serve.traceSampleRate)"),
+    "serve.queue_depth": ("gauge",
+                          "rows currently queued (set at each flush and "
+                          "sampled into SERVE heartbeats/healthz — the "
+                          "queue-buildup early warning)"),
     "serve.bucket_occupancy": ("gauge",
                                "real rows / bucket size of the last "
                                "launch"),
     "serve.batch_latency_ms": ("histogram",
                                "oldest-request latency per batch"),
+    # ---- live SLO plane (obs/slo; mirrored into metrics.prom each beat)
+    "slo.p50_ms": ("gauge", "sliding-window latency p50 (log sketch)"),
+    "slo.p99_ms": ("gauge", "sliding-window latency p99 (log sketch)"),
+    "slo.availability": ("gauge", "observed availability over the ring"),
+    "slo.burn_rate_short": ("gauge",
+                            "max error-budget burn over the short "
+                            "(current-window) horizon"),
+    "slo.burn_rate_long": ("gauge",
+                           "max error-budget burn over the long "
+                           "(whole-ring) horizon"),
+    "slo.alerts_firing": ("gauge", "burn-rate alert rules currently firing"),
     # ---- drift monitor (obs/drift)
     "drift.rows": ("gauge", "rows folded into the live drift counts"),
     "drift.columns_tracked": ("gauge", "columns with a training snapshot"),
@@ -112,9 +135,32 @@ PREFIXES: Tuple[str, ...] = (
     "eval.",         # eval.<set>.auc / eval.<set>.pr_auc per eval set
 )
 
+# span-name literals (obs.span("...") / obs.record_span("...") call
+# sites) — the timeline tracks, report sections and tests join on these
+SPANS: Dict[str, str] = {
+    "setup": "step scaffolding before process() (processor base)",
+    "process": "step body (processor base)",
+    "varselect.sensitivity": "SE/ST sensitivity scoring phase",
+    "ingest.window_prep": "background window materialization (prep thread)",
+    "ingest.h2d_wait": "consumer blocked on window prep / H2D",
+    "serve.request": ("sampled scoring request: queue-wait / deadline-"
+                      "wait / pad / launch / device decomposition"),
+    "serve.batch": ("sampled padded-bucket launch; links the member "
+                    "requests' trace ids (fan-in causality)"),
+}
+
+# span families whose names embed data (the bench's per-plane spans)
+SPAN_PREFIXES: Tuple[str, ...] = (
+    "bench.",
+)
+
 
 def is_declared(name: str) -> bool:
     return name in MANIFEST or any(name.startswith(p) for p in PREFIXES)
+
+
+def is_declared_span(name: str) -> bool:
+    return name in SPANS or any(name.startswith(p) for p in SPAN_PREFIXES)
 
 
 def declared_type(name: str) -> str:

@@ -30,7 +30,10 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
 from . import tracer
+from .slo import LOG_BINS, quantile_from_counts
 
 
 # Instruments are THREAD-SAFE: ``ingest.*`` counters increment from the
@@ -90,10 +93,12 @@ class Gauge:
 
 
 class Histogram:
-    """Streaming summary (count/sum/min/max/last) — enough for epoch
-    times and window throughputs without bucket bookkeeping."""
+    """Streaming summary (count/sum/min/max/last) plus a fixed-bin LOG
+    sketch (:data:`shifu_tpu.obs.slo.LOG_BINS`) so snapshots carry
+    p50/p99 estimates (schema v8) — still no per-observation storage."""
 
-    __slots__ = ("name", "count", "sum", "min", "max", "last", "_lock")
+    __slots__ = ("name", "count", "sum", "min", "max", "last", "_bins",
+                 "_lock")
 
     def __init__(self, name: str):
         self.name = name
@@ -102,27 +107,40 @@ class Histogram:
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self.last: Optional[float] = None
+        self._bins = np.zeros(LOG_BINS.n, np.int64)
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
         v = float(v)
+        i = LOG_BINS.index(v)
         with self._lock:
             self.count += 1
             self.sum += v
             self.min = v if self.min is None or v < self.min else self.min
             self.max = v if self.max is None or v > self.max else self.max
             self.last = v
+            self._bins[i] += 1
 
     @property
     def mean(self) -> float:
         with self._lock:
             return self.sum / self.count if self.count else 0.0
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Sketch-resolution quantile (~6.6% relative error per bin)."""
+        with self._lock:
+            return quantile_from_counts(self._bins, q, LOG_BINS)
+
+    def _q(self, q: float) -> Optional[float]:
+        v = quantile_from_counts(self._bins, q, LOG_BINS)
+        return None if v is None else round(v, 9)
+
     def to_record(self) -> Dict[str, Any]:
         with self._lock:
             return {"kind": "metric", "type": "histogram", "name": self.name,
                     "count": self.count, "sum": round(self.sum, 6),
-                    "min": self.min, "max": self.max, "last": self.last}
+                    "min": self.min, "max": self.max, "last": self.last,
+                    "p50": self._q(0.50), "p99": self._q(0.99)}
 
 
 class _NullInstrument:

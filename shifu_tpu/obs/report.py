@@ -284,3 +284,31 @@ def render_telemetry(model_set_dir: str) -> str:
     out.append(f"pipeline total: {grand:.3f}s across {len(blocks)} "
                "step record(s)")
     return "\n".join(out)
+
+
+def render_telemetry_merged(dirs: List[str]) -> str:
+    """``analysis --telemetry --aggregate``: N process telemetry dirs as
+    ONE report — each dir's span tree (headed by its clock offset) plus
+    the merged per-proc step-lag table from the health plane."""
+    from .monitor import (aggregate_records, dir_clock_offset,
+                          step_lag_table)
+    out: List[str] = [f"merged telemetry over {len(dirs)} dir(s)"]
+    for d in dirs:
+        off = dir_clock_offset(d)
+        out.append("")
+        out.append(f"==== {os.path.abspath(d)} "
+                   f"(clock offset {off:+.1f}s)")
+        out.append(render_telemetry(d))
+    recs, _counts = aggregate_records(dirs)
+    if recs:
+        out.append("")
+        out.append("==== per-proc step lag (health plane, "
+                   "clock-normalized)")
+        for row in step_lag_table(recs):
+            lag_s = f"{row['lag_s']:.1f}s" \
+                if row["lag_s"] is not None else "-"
+            out.append(f"  {row['step']:<11}{(row['proc'] or '?'):<22}"
+                       f"{(row['dir'] or '?'):<14}"
+                       f"rows {row['rows']:<12,.0f}"
+                       f"lag {row['rows_lag']:<10,.0f}{lag_s}")
+    return "\n".join(out)

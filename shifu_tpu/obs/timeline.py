@@ -13,9 +13,19 @@ shows up as gaps on the compute track opposite solid bars on the ingest
 track (the runtime-must-expose-timelines argument of the TF paper).
 
 Track assignment: span records carry ``tid`` (the recording thread's
-name, schema v5).  Any span recorded off the main thread — or named
-``ingest.*`` (pre-v5 traces have no ``tid``) — routes to the ingest
-track.
+name, schema v5).  Sampled serving spans (``serve.request`` /
+``serve.batch``, schema v8 — tid ``shifu-serve``) land on their own
+``shifu-serve`` track so a request's queue-wait renders opposite the
+batch launches that drained it; any other span recorded off the main
+thread — or named ``ingest.*`` (pre-v5 traces have no ``tid``) —
+routes to the ingest track.
+
+Cross-process merge (:func:`export_merged_timeline`): N telemetry dirs
+combine into ONE trace — every (dir, pid) pair becomes its own process
+row (the per-proc tracks quorum/straggler analysis reads), and each
+dir's span timestamps are normalized by its heartbeat-derived clock
+offset (:func:`shifu_tpu.obs.monitor.dir_clock_offset`) so skewed host
+clocks line up on a common axis.
 """
 
 from __future__ import annotations
@@ -23,7 +33,7 @@ from __future__ import annotations
 import json
 import logging
 import os
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 from ..ioutil import atomic_write_text
 from . import tracer
@@ -34,8 +44,15 @@ log = logging.getLogger(__name__)
 # fixed tids per process: compute first so it sorts on top in viewers
 TID_MAIN = 1
 TID_INGEST = 2
+TID_SERVE = 3
 TRACK_NAMES = {TID_MAIN: "step / device compute",
-               TID_INGEST: "ingest (window prep + H2D wait)"}
+               TID_INGEST: "ingest (window prep + H2D wait)",
+               TID_SERVE: "shifu-serve (sampled request / batch spans)"}
+
+
+def _is_serve(rec: Dict[str, Any]) -> bool:
+    return (rec.get("tid") == "shifu-serve"
+            or str(rec.get("name", "")).startswith("serve."))
 
 
 def _is_ingest(rec: Dict[str, Any]) -> bool:
@@ -43,6 +60,12 @@ def _is_ingest(rec: Dict[str, Any]) -> bool:
         return True
     tid = rec.get("tid")
     return tid is not None and tid != "MainThread"
+
+
+def _tid_for(rec: Dict[str, Any]) -> int:
+    if _is_serve(rec):
+        return TID_SERVE
+    return TID_INGEST if _is_ingest(rec) else TID_MAIN
 
 
 def _us(seconds: float) -> int:
@@ -94,7 +117,7 @@ def to_trace_events(blocks: List[Dict[str, Any]],
             events.append({
                 "ph": "X", "name": s["name"], "cat": "span",
                 "pid": pid,
-                "tid": TID_INGEST if _is_ingest(s) else TID_MAIN,
+                "tid": _tid_for(s),
                 "ts": _us(s.get("ts") or 0.0),
                 "dur": max(1, _us(s.get("dur_s") or 0.0)),
                 "args": args,
@@ -115,7 +138,7 @@ def to_trace_events(blocks: List[Dict[str, Any]],
             events.append({
                 "ph": "i", "s": "t", "name": e["name"], "cat": "event",
                 "pid": pid,
-                "tid": TID_INGEST if _is_ingest(e) else TID_MAIN,
+                "tid": _tid_for(e),
                 "ts": _us(e.get("ts") or 0.0),
                 "args": dict(e.get("attrs") or {}),
             })
@@ -154,5 +177,47 @@ def export_timeline(model_set_dir: str, out_path: str,
                     "(crashed run mid-write?) — the valid prefix was "
                     "exported", len(skipped))
     doc = to_trace_events(blocks, skipped=skipped)
+    atomic_write_text(out_path, json.dumps(doc))
+    return out_path
+
+
+def export_merged_timeline(dirs: Sequence[str], out_path: str,
+                           skipped: Optional[List[str]] = None
+                           ) -> Optional[str]:
+    """Merge N process telemetry dirs into ONE trace_event document (see
+    module docs): per-(dir, pid) process rows, clock-offset-normalized
+    timestamps, dir-labelled process names.  Returns the output path, or
+    None when no dir holds a readable trace."""
+    from .monitor import dir_clock_offset
+    if skipped is None:
+        skipped = []
+    blocks: List[Dict[str, Any]] = []
+    offsets: Dict[str, float] = {}
+    pid_map: Dict[tuple, int] = {}
+    for d in dirs:
+        path = trace_path(d)
+        if not os.path.isfile(path):
+            continue
+        off = dir_clock_offset(d)
+        label = os.path.basename(os.path.abspath(d))
+        offsets[label] = round(off, 3)
+        for b in load_blocks(path, skipped=skipped):
+            meta = b["meta"]
+            key = (d, meta.get("pid"))
+            # distinct pids per (dir, proc): two hosts can share a pid
+            pid_map.setdefault(key, len(pid_map) + 1)
+            meta["pid"] = pid_map[key]
+            meta["step"] = f"{label}/{meta.get('step') or '?'}"
+            if meta.get("ts"):
+                meta["ts"] = float(meta["ts"]) - off
+            for rec in b["spans"] + b["events"]:
+                if rec.get("ts"):
+                    rec["ts"] = float(rec["ts"]) - off
+            blocks.append(b)
+    if not blocks:
+        return None
+    doc = to_trace_events(blocks, skipped=skipped)
+    doc["otherData"]["merged_dirs"] = [os.path.abspath(d) for d in dirs]
+    doc["otherData"]["clock_offsets"] = offsets
     atomic_write_text(out_path, json.dumps(doc))
     return out_path

@@ -60,7 +60,16 @@ from typing import Any, Dict, List, Optional
 # histogram) and the per-bucket ``serve.score.<key>.g<gen>.b<bucket>``
 # cost records the AOT scorer registers (the recompile sentinel's
 # serving beat)
-SCHEMA_VERSION = 7
+# v8: request/SLO observability plane — sampled ``serve.request`` /
+# ``serve.batch`` span records (tid ``shifu-serve``: per-request
+# queue/deadline/pad/launch/device decomposition, batch spans linking
+# member trace ids — the timeline's shifu-serve track), histogram
+# metric records carry ``p50``/``p99`` (fixed-bin log sketch, also the
+# metrics.prom quantile lines), ``slo.*`` gauges + the
+# ``serve.trace_sampled`` counter, SERVE heartbeats may carry
+# ``queue_depth`` / ``queue_buildup`` / ``slo`` extras, and monitor /
+# timeline learn multi-dir (cross-process) aggregation
+SCHEMA_VERSION = 8
 
 _TRUE = ("1", "true", "on", "yes")
 
@@ -281,6 +290,27 @@ def event(name: str, /, **attrs: Any) -> None:
                     "ts": round(time.time(), 3),
                     "parent": _collector.current_parent(),
                     "tid": threading.current_thread().name, "attrs": attrs})
+
+
+def record_span(name: str, ts: float, dur_s: float,
+                attrs: Optional[Dict[str, Any]] = None,
+                tid: Optional[str] = None,
+                parent: Optional[int] = None) -> Optional[int]:
+    """Record an externally-timed span.  Producers whose spans start and
+    end on DIFFERENT threads (the serve plane: a request enters on the
+    caller's thread and completes on the batcher worker) measure with
+    their own perf counters and emit the finished span here; ``tid``
+    overrides the track label (e.g. ``shifu-serve``).  Returns the span
+    id, or None (no allocation) when telemetry is off."""
+    if not enabled():
+        return None
+    sid = _collector.new_id()
+    _collector.add({"kind": "span", "name": name, "id": sid,
+                    "parent": parent, "ts": round(float(ts), 6),
+                    "dur_s": round(float(dur_s), 6),
+                    "tid": tid or threading.current_thread().name,
+                    "attrs": dict(attrs or {})})
+    return sid
 
 
 def fence(value: Any) -> Any:

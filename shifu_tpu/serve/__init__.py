@@ -24,11 +24,21 @@ Modules:
   journal-style promote) so a retrain replaces the live model without
   dropping requests;
 - :mod:`server`  — :class:`ServeServer` + the ``shifu-tpu serve`` CLI
-  entry: heartbeats from :mod:`shifu_tpu.obs.health`, optional stdlib
-  HTTP front-end.
+  entry: heartbeats from :mod:`shifu_tpu.obs.health` (carrying queue
+  depth + the live SLO summary), optional stdlib HTTP front-end
+  (``POST /score``, ``GET /healthz``, ``GET /slo``).
+
+Observability: per-request tracing (head-sampled at
+``-Dshifu.serve.traceSampleRate``, or forced by an ``X-Shifu-Trace``
+header) decomposes each sampled request into queue-wait / deadline-wait
+/ pad / launch / device spans with batch fan-in links (see
+:mod:`batcher`), and every completion feeds the live SLO plane
+(:mod:`shifu_tpu.obs.slo`: sliding-window quantiles, burn-rate alerts
+against ``-Dshifu.serve.sloP99Ms`` / ``-Dshifu.serve.sloAvailability``).
 
 Bench: ``bench.py --plane serve`` (sustained QPS, p50/p99 at several
-offered loads, bucket occupancy / padding waste, zero-recompile guard).
+offered loads, bucket occupancy / padding waste, zero-recompile guard,
+1%-sampled traced pass + latency-decomposition extras).
 """
 
 from .batcher import MicroBatcher, Ticket                     # noqa: F401

@@ -16,11 +16,28 @@ Fault site: ``serve:request=<batch#>`` fires before batch ``<batch#>``'s
 device launch — an ``ioerror`` there fails exactly that batch's tickets
 (the error propagates to the waiting callers) and must leave the scorer
 and registry fully serviceable for the next request.
+
+Per-request tracing (head-sampled, ``-Dshifu.serve.traceSampleRate``,
+default 0 = off): a sampled request carries a trace id from submit
+through batch assembly into the device launch and decomposes into
+queue-wait (submit -> taken off the queue; ``deadline_wait_s`` marks the
+part attributable to the deadline coalescing window), pad (burst
+concatenate + the scorer's pad copy), launch (argument prep + host
+fetch) and device (the executable call) — segments that sum, within
+scheduler noise, to the request's end-to-end latency.  Each sampled
+batch emits a ``serve.batch`` span linking its member requests' trace
+ids (fan-in causality); both land on the ``shifu-serve`` timeline track
+via :func:`shifu_tpu.obs.record_span`.  With sampling off the hot path
+pays ONE float compare per submit and nothing per batch, matching the
+PR 1/8 zero-cost convention; an explicit ``trace_id`` (the
+``X-Shifu-Trace`` header) forces sampling for that request.
 """
 
 from __future__ import annotations
 
 import logging
+import os
+import random
 import threading
 import time
 from collections import deque
@@ -34,6 +51,41 @@ from .scorer import AOTScorer, covering_bucket
 log = logging.getLogger(__name__)
 
 
+def configured_trace_sample_rate() -> float:
+    """Head-sampling probability for per-request tracing: property
+    ``shifu.serve.traceSampleRate`` in [0, 1], default 0 (off)."""
+    from ..config import environment
+    rate = environment.get_float("shifu.serve.traceSampleRate", 0.0)
+    return min(max(rate, 0.0), 1.0)
+
+
+def _mint_trace_id() -> str:
+    return os.urandom(8).hex()
+
+
+class _ReqTrace:
+    """Per-sampled-request trace state carried on the ticket: the trace
+    id, submit timestamps, and the latency decomposition accumulated as
+    the request's rows move through one or more batches."""
+
+    __slots__ = ("trace_id", "ts", "t0", "taken", "queue_wait_s",
+                 "deadline_wait_s", "pad_s", "launch_s", "device_s",
+                 "batches", "flushes")
+
+    def __init__(self, trace_id: str):
+        self.trace_id = trace_id
+        self.ts = time.time()                 # wall clock (span ts)
+        self.t0 = time.perf_counter()         # duration basis
+        self.taken = False
+        self.queue_wait_s = 0.0
+        self.deadline_wait_s = 0.0
+        self.pad_s = 0.0
+        self.launch_s = 0.0
+        self.device_s = 0.0
+        self.batches = 0
+        self.flushes: List[str] = []
+
+
 class Ticket:
     """Completion handle for one submitted burst of rows.  A burst may
     span several device launches; the event fires when every row has a
@@ -41,9 +93,10 @@ class Ticket:
     the per-request cost at high load is an array append."""
 
     __slots__ = ("n", "stamps", "scores", "done_ts", "_pending", "_event",
-                 "error", "_lock")
+                 "error", "_lock", "trace")
 
-    def __init__(self, n: int, stamps: np.ndarray):
+    def __init__(self, n: int, stamps: np.ndarray,
+                 trace: Optional[_ReqTrace] = None):
         self.n = n
         self.stamps = stamps                  # arrival time per row
         self.scores = np.empty(n, np.float32)
@@ -52,6 +105,7 @@ class Ticket:
         self._event = threading.Event()
         self._lock = threading.Lock()
         self.error: Optional[BaseException] = None
+        self.trace = trace                    # sampled requests only
 
     def _complete(self, sl: slice, scores: Optional[np.ndarray],
                   now: float, error: Optional[BaseException]) -> None:
@@ -91,10 +145,19 @@ class MicroBatcher:
 
     def __init__(self, scorer_provider: Callable[[], AOTScorer],
                  max_delay_s: float = 0.002,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 trace_sample_rate: Optional[float] = None,
+                 slo=None):
         self._provider = scorer_provider
         self.max_delay_s = float(max_delay_s)
         self.clock = clock
+        # head-sampled request tracing (property default) + optional SLO
+        # tracker (obs/slo) fed per-row latencies at each completion
+        self.trace_sample_rate = trace_sample_rate \
+            if trace_sample_rate is not None \
+            else configured_trace_sample_rate()
+        self.slo = slo
+        self._trace_rng = random.Random(0x51F0)
         self._cond = threading.Condition()
         # queue of (ticket, rows, bins, row_offset): row_offset = how many
         # of this burst's rows earlier flushes already consumed
@@ -112,25 +175,36 @@ class MicroBatcher:
 
     # ------------------------------------------------------------ submit
     def submit(self, row: np.ndarray, bins: Optional[np.ndarray] = None,
-               stamp: Optional[float] = None) -> Ticket:
+               stamp: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Ticket:
         """One single-record scoring request."""
         return self.submit_burst(
             np.asarray(row, np.float32)[None, :],
             None if bins is None else np.asarray(bins)[None, :],
-            stamps=None if stamp is None else np.asarray([stamp]))
+            stamps=None if stamp is None else np.asarray([stamp]),
+            trace_id=trace_id)
 
     def submit_burst(self, rows: np.ndarray,
                      bins: Optional[np.ndarray] = None,
-                     stamps: Optional[np.ndarray] = None) -> Ticket:
+                     stamps: Optional[np.ndarray] = None,
+                     trace_id: Optional[str] = None) -> Ticket:
         """A burst of concurrent single-record requests (an open-loop
         load generator's arrivals for one tick) — one queue append, one
         shared ticket.  ``stamps`` lets the generator record IDEAL
         arrival times so latency percentiles are free of coordinated
-        omission."""
+        omission.  ``trace_id`` (a propagated ``X-Shifu-Trace`` header)
+        forces request tracing for this burst; otherwise the burst is
+        head-sampled at ``trace_sample_rate`` (minting an id)."""
         n = len(rows)
         if stamps is None:
             stamps = np.full(n, self.clock())
-        t = Ticket(n, np.asarray(stamps, np.float64))
+        trace = None
+        if trace_id is not None or (
+                self.trace_sample_rate > 0.0 and obs.enabled()
+                and self._trace_rng.random() < self.trace_sample_rate):
+            trace = _ReqTrace(trace_id or _mint_trace_id())
+            obs.counter("serve.trace_sampled").inc()
+        t = Ticket(n, np.asarray(stamps, np.float64), trace=trace)
         with self._cond:
             if self._stop:
                 raise RuntimeError("batcher is stopped")
@@ -149,6 +223,13 @@ class MicroBatcher:
         """Closed-loop convenience: submit + wait."""
         return self.submit_burst(np.asarray(rows, np.float32),
                                  bins).wait(timeout)
+
+    @property
+    def queue_depth(self) -> int:
+        """Rows currently queued (sampled into SERVE heartbeats /
+        ``/healthz`` so the monitor can flag buildup before the deadline
+        blows)."""
+        return self._queued_rows
 
     # ------------------------------------------------------------- drain
     def _top_bucket(self) -> int:
@@ -196,7 +277,8 @@ class MicroBatcher:
             obs.counter("serve.flush_full" if full
                         else "serve.flush_deadline").inc()
             obs.gauge("serve.queue_depth").set(self._queued_rows)
-        return self._launch(parts)
+        return self._launch(parts, reason="full" if full
+                            else ("deadline" if deadline_hit else "forced"))
 
     def drain(self, timeout: float = 30.0) -> None:
         """Flush everything queued right now (shutdown / tests)."""
@@ -209,13 +291,20 @@ class MicroBatcher:
                 raise TimeoutError("batcher drain timed out")
 
     # ------------------------------------------------------------ launch
-    def _launch(self, parts) -> int:
+    def _launch(self, parts, reason: str = "forced") -> int:
         n = sum(len(rows) for _, rows, _, _ in parts)
         if n == 0:
             return 0
         with self._cond:
             batch_index = self._batches
             self._batches += 1
+        # sampled members (the common case is NONE: no perf counters, no
+        # timing dict, no record emission — the batch path is unchanged)
+        traced = [t for t, _, _, _ in parts if t.trace is not None]
+        t_take = time.perf_counter() if traced else 0.0
+        tm: Optional[Dict[str, float]] = \
+            {"pad_s": 0.0, "launch_s": 0.0, "device_s": 0.0} if traced \
+            else None
         err: Optional[BaseException] = None
         mean = None
         bucket = n
@@ -225,18 +314,26 @@ class MicroBatcher:
         try:
             scorer = self._provider()
             bucket = covering_bucket(scorer.buckets, n)
+            t_asm = time.perf_counter() if traced else 0.0
             rows = np.concatenate([r for _, r, _, _ in parts], axis=0) \
                 if len(parts) > 1 else parts[0][1]
             bins = None
             if scorer.needs_bins:
                 bins = np.concatenate([b for _, _, b, _ in parts], axis=0) \
                     if len(parts) > 1 else parts[0][2]
+            if tm is not None:
+                tm["pad_s"] += time.perf_counter() - t_asm
             faults.fire("serve", "request", batch_index)
-            raw = scorer.score_batch(rows, bins)
+            if tm is not None and getattr(scorer, "supports_timings",
+                                          False):
+                raw = scorer.score_batch(rows, bins, timings=tm)
+            else:
+                raw = scorer.score_batch(rows, bins)
             mean = raw.mean(axis=1).astype(np.float32)
         except BaseException as e:          # noqa: BLE001 — tickets carry it
             err = e
         now = self.clock()
+        now_pc = time.perf_counter() if traced else 0.0
         off = 0
         for t, r, _, src_off in parts:
             sl_dst = slice(src_off, src_off + len(r))
@@ -257,6 +354,16 @@ class MicroBatcher:
         obs.counter("serve.rows_scored").inc(n)
         obs.counter("serve.rows_padded").inc(pad)
         obs.gauge("serve.bucket_occupancy").set(n / bucket)
+        if self.slo is not None:
+            if err is not None:
+                self.slo.record_errors(n)
+            else:
+                self.slo.observe_batch(np.concatenate(
+                    [now - t.stamps[so:so + len(r)]
+                     for t, r, _, so in parts]))
+        if traced:
+            self._emit_trace_spans(parts, traced, batch_index, bucket, n,
+                                   pad, reason, err, t_take, tm, now_pc)
         if err is not None:
             obs.counter("serve.request_errors").inc()
             if not isinstance(err, (faults.InjectedFault, ValueError,
@@ -267,6 +374,57 @@ class MicroBatcher:
         obs.histogram("serve.batch_latency_ms").observe(
             (now - oldest) * 1000.0)
         return n
+
+    def _emit_trace_spans(self, parts, traced, batch_index: int,
+                          bucket: int, n: int, pad: int, reason: str,
+                          err: Optional[BaseException], t_take: float,
+                          tm: Dict[str, float], now_pc: float) -> None:
+        """Fold this batch's measured decomposition into its sampled
+        members and emit the ``serve.batch`` span plus a
+        ``serve.request`` span for every member that just COMPLETED
+        (split bursts emit once, after their final batch)."""
+        for t in traced:
+            tr = t.trace
+            if not tr.taken:
+                tr.taken = True
+                tr.queue_wait_s = max(t_take - tr.t0, 0.0)
+                if reason == "deadline":
+                    tr.deadline_wait_s = min(tr.queue_wait_s,
+                                             self.max_delay_s)
+            # every member rides the whole batch's pad/launch/device wall
+            tr.pad_s += tm["pad_s"]
+            tr.launch_s += tm["launch_s"]
+            tr.device_s += tm["device_s"]
+            tr.batches += 1
+            tr.flushes.append(reason)
+        batch_wall = now_pc - t_take
+        obs.record_span(
+            "serve.batch", ts=time.time() - batch_wall, dur_s=batch_wall,
+            tid="shifu-serve",
+            attrs={"batch": batch_index, "bucket": bucket, "rows": n,
+                   "pad": pad, "flush": reason,
+                   "links": [t.trace.trace_id for t in traced],
+                   "pad_s": round(tm["pad_s"], 6),
+                   "launch_s": round(tm["launch_s"], 6),
+                   "device_s": round(tm["device_s"], 6),
+                   **({"error": type(err).__name__} if err else {})})
+        for t in traced:
+            if not t.done():
+                continue                     # more launches still due
+            tr = t.trace
+            obs.record_span(
+                "serve.request", ts=tr.ts, dur_s=now_pc - tr.t0,
+                tid="shifu-serve",
+                attrs={"trace": tr.trace_id, "rows": t.n,
+                       "batch": batch_index, "batches": tr.batches,
+                       "flush": ",".join(tr.flushes),
+                       "queue_wait_s": round(tr.queue_wait_s, 6),
+                       "deadline_wait_s": round(tr.deadline_wait_s, 6),
+                       "pad_s": round(tr.pad_s, 6),
+                       "launch_s": round(tr.launch_s, 6),
+                       "device_s": round(tr.device_s, 6),
+                       "e2e_s": round(now_pc - tr.t0, 6),
+                       **({"error": type(err).__name__} if err else {})})
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> "MicroBatcher":

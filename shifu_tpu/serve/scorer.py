@@ -289,20 +289,34 @@ class AOTScorer:
                 import jax
                 jax.block_until_ready(exe(*args))
 
+    # the batcher's request tracer may pass ``timings=`` (duck-checked —
+    # test doubles wrapping this class need not support it)
+    supports_timings = True
+
     # ------------------------------------------------------------- score
     def score_batch(self, x: np.ndarray,
-                    bins: Optional[np.ndarray] = None) -> np.ndarray:
+                    bins: Optional[np.ndarray] = None,
+                    timings: Optional[dict] = None) -> np.ndarray:
         """raw scaled scores [n, M] for a request batch; pads to the
         covering bucket, chunks batches beyond the top rung.  Returns a
         host array (the serving response crosses the link by
-        definition — ONE fetch per launch)."""
+        definition — ONE fetch per launch).
+
+        ``timings`` (sampled request tracing only) accumulates the
+        launch decomposition in place: ``pad_s`` the host pad copy,
+        ``device_s`` the executable call (device compute on the
+        synchronous CPU/TPU-AOT dispatch path), ``launch_s`` argument
+        prep + the host fetch around it."""
+        import time as _time
         n = len(x)
         top = self.buckets[-1]
         if n > top:
             return np.concatenate(
                 [self.score_batch(x[s:s + top],
-                                  None if bins is None else bins[s:s + top])
+                                  None if bins is None else bins[s:s + top],
+                                  timings=timings)
                  for s in range(0, n, top)], axis=0)
+        t0 = _time.perf_counter() if timings is not None else 0.0
         bucket = covering_bucket(self.buckets, n)
         pad = bucket - n
         if pad:
@@ -312,6 +326,9 @@ class AOTScorer:
                 bins = np.concatenate(
                     [bins, np.zeros((pad, bins.shape[1]), bins.dtype)],
                     axis=0)
+        if timings is not None:
+            t1 = _time.perf_counter()
+            timings["pad_s"] = timings.get("pad_s", 0.0) + (t1 - t0)
         exe, sig = self._ensure_compiled(bucket)
         args = [np.ascontiguousarray(x, np.float32)]
         if self.needs_bins:
@@ -320,7 +337,16 @@ class AOTScorer:
                                  "— requests must carry bins")
             args.append(np.ascontiguousarray(bins, np.int32))
         costs.get_cost_registry().launch(f"{self.name}.b{bucket}", sig)
-        raw = np.asarray(exe(*args))
+        if timings is None:
+            return np.asarray(exe(*args))[:n]
+        t2 = _time.perf_counter()
+        out = exe(*args)
+        t3 = _time.perf_counter()
+        raw = np.asarray(out)
+        t4 = _time.perf_counter()
+        timings["device_s"] = timings.get("device_s", 0.0) + (t3 - t2)
+        timings["launch_s"] = timings.get("launch_s", 0.0) \
+            + (t2 - t1) + (t4 - t3)
         return raw[:n]
 
     def score_mean(self, x: np.ndarray,

@@ -11,18 +11,34 @@ serves scoring requests:
   :meth:`ServeServer.submit` (async ticket) — what the bench drives;
 - over HTTP (stdlib, zero new deps): ``POST /score`` with
   ``{"rows": [[...]], "bins": [[...]]}`` -> ``{"scores": [...]}``,
-  ``GET /healthz`` -> live state + bucket/batch accounting;
+  ``GET /healthz`` -> live state + bucket/batch/queue accounting + the
+  compact SLO summary, ``GET /slo`` -> the full SLO/burn-rate payload;
+- request tracing: an ``X-Shifu-Trace`` request header propagates the
+  caller's trace id onto the batch pipeline (forcing sampling for that
+  request); otherwise requests are head-sampled at
+  ``-Dshifu.serve.traceSampleRate`` and ids are minted here;
 - hot-swap: :meth:`ServeServer.swap` re-points the live model between
   batches without dropping queued requests (``serve:swap`` fault site).
 
+The server owns an :class:`shifu_tpu.obs.SLOTracker` (fed per-row
+latencies by the batcher) and, when a model-set dir is given, its SERVE
+heartbeats carry ``queue_depth`` / ``queue_buildup`` / the compact SLO
+summary each beat (``shifu-tpu monitor`` renders and flags them); the
+metrics exporter mirrors the same numbers into ``metrics.prom``, and a
+``stop()`` flushes any sampled request spans to the telemetry trace.
+
 Knobs: ``-Dshifu.serve.buckets`` (bucket ladder),
-``-Dshifu.serve.maxDelayMs`` (deadline flush, default 2 ms).
+``-Dshifu.serve.maxDelayMs`` (deadline flush, default 2 ms),
+``-Dshifu.serve.traceSampleRate`` (head sampling, default 0),
+``-Dshifu.serve.sloP99Ms`` / ``-Dshifu.serve.sloAvailability``
+(objectives; default 2x the deadline and 0.999).
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 from typing import Optional, Sequence
 
@@ -36,6 +52,10 @@ from .scorer import bucket_ladder
 log = logging.getLogger(__name__)
 
 DEFAULT_MAX_DELAY_MS = 2.0
+
+# queue depth at/over this many top buckets flags "buildup" in
+# heartbeats — work queued beyond what the next few flushes can absorb
+QUEUE_BUILDUP_BUCKETS = 4
 
 
 def max_delay_s(override_ms: Optional[float] = None) -> float:
@@ -55,8 +75,10 @@ class ServeServer:
                  models: Optional[Sequence] = None,
                  key: Optional[str] = None,
                  buckets: Optional[Sequence[int]] = None,
-                 max_delay_ms: Optional[float] = None):
-        import os
+                 max_delay_ms: Optional[float] = None,
+                 trace_sample_rate: Optional[float] = None,
+                 slo_p99_ms: Optional[float] = None,
+                 slo_availability: Optional[float] = None):
         self.model_set_dir = model_set_dir
         self.key = key or (os.path.basename(os.path.abspath(model_set_dir))
                            if model_set_dir else "default")
@@ -67,9 +89,18 @@ class ServeServer:
             else os.path.join(model_set_dir, "models")
         self.registry.load(self.key, src,
                            buckets=tuple(buckets or bucket_ladder()))
+        delay_s = max_delay_s(max_delay_ms)
+        p99_obj, avail_obj = obs.slo_objectives(delay_s * 1000.0)
+        self.slo = obs.SLOTracker(
+            p99_ms=slo_p99_ms if slo_p99_ms is not None else p99_obj,
+            availability=slo_availability
+            if slo_availability is not None else avail_obj)
         self.batcher = MicroBatcher(self.registry.provider(self.key),
-                                    max_delay_s=max_delay_s(max_delay_ms))
+                                    max_delay_s=delay_s,
+                                    trace_sample_rate=trace_sample_rate,
+                                    slo=self.slo)
         self._heartbeat = None
+        self._exporter = None
         self._started = False
 
     # ----------------------------------------------------------- lifecycle
@@ -80,7 +111,10 @@ class ServeServer:
         if self.model_set_dir:
             self._heartbeat = obs.start_heartbeat(
                 obs.health_dir_for(self.model_set_dir), step="SERVE",
-                proc=f"serve-{self.key}")
+                proc=f"serve-{self.key}", extras_fn=self._beat_extras)
+            self._exporter = obs.start_exporter(
+                os.path.join(self.model_set_dir, "telemetry"),
+                step="SERVE")
         self._started = True
         return self
 
@@ -88,26 +122,50 @@ class ServeServer:
         if not self._started:
             return
         self.batcher.stop()
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None
         if self._heartbeat is not None:
             self._heartbeat.stop(exit_code=exit_code)
             self._heartbeat = None
+        if self.model_set_dir and obs.enabled():
+            # sampled request/batch spans land in the same trace the
+            # pipeline steps flush to (analysis --telemetry renders it)
+            from ..obs.report import trace_path
+            obs.flush(trace_path(self.model_set_dir), step="SERVE")
         self._started = False
+
+    def _beat_extras(self) -> dict:
+        """Per-beat heartbeat payload: queue depth + the compact SLO
+        summary (the monitor's buildup / burn-rate flags), mirrored
+        into the registry gauges the exporter scrapes."""
+        qd = self.batcher.queue_depth
+        top = self.registry.get(self.key).buckets[-1]
+        self.slo.emit_gauges()
+        obs.gauge("serve.queue_depth").set(qd)
+        return {"queue_depth": int(qd),
+                "queue_buildup": bool(qd >= QUEUE_BUILDUP_BUCKETS * top),
+                "slo": self.slo.compact()}
 
     # ------------------------------------------------------------- scoring
     def submit(self, rows: np.ndarray,
-               bins: Optional[np.ndarray] = None) -> Ticket:
+               bins: Optional[np.ndarray] = None,
+               trace_id: Optional[str] = None) -> Ticket:
         return self.batcher.submit_burst(np.asarray(rows, np.float32),
-                                         bins)
+                                         bins, trace_id=trace_id)
 
     def score(self, rows: np.ndarray, bins: Optional[np.ndarray] = None,
-              timeout: float = 30.0) -> np.ndarray:
+              timeout: float = 30.0,
+              trace_id: Optional[str] = None) -> np.ndarray:
         """Closed-loop scoring (mean ensemble score per row, scaled)."""
         if not self._started:                  # in-process, no worker
             t = self.batcher.submit_burst(np.asarray(rows, np.float32),
-                                          bins)
+                                          bins, trace_id=trace_id)
             self.batcher.drain()
             return t.wait(timeout)
-        return self.batcher.score_sync(rows, bins, timeout=timeout)
+        t = self.batcher.submit_burst(np.asarray(rows, np.float32), bins,
+                                      trace_id=trace_id)
+        return t.wait(timeout)
 
     def swap(self, models_or_dir) -> None:
         """Promote a retrained model without dropping requests."""
@@ -126,10 +184,20 @@ class ServeServer:
             "needs_bins": scorer.needs_bins,
             "n_features": scorer.n_features,
             "max_delay_ms": self.batcher.max_delay_s * 1000.0,
+            "trace_sample_rate": self.batcher.trace_sample_rate,
+            "queue_depth": int(self.batcher.queue_depth),
+            "slo": self.slo.compact(),
             "stats": dict(self.batcher.stats),
             "bucket_counts": {str(k): v for k, v in
                               sorted(self.batcher.bucket_counts.items())},
         }
+
+    def slo_doc(self) -> dict:
+        """The ``GET /slo`` payload: objectives, short/long-horizon
+        quantiles/availability, burn rates and firing alerts."""
+        return {"kind": "slo", "key": self.key,
+                "queue_depth": int(self.batcher.queue_depth),
+                **self.slo.summary()}
 
 
 # ------------------------------------------------------------------ HTTP
@@ -148,6 +216,8 @@ def _make_handler(server: ServeServer):
         def do_GET(self):                      # noqa: N802 (stdlib API)
             if self.path in ("/healthz", "/health", "/status"):
                 self._reply(200, server.status())
+            elif self.path == "/slo":
+                self._reply(200, server.slo_doc())
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -162,9 +232,13 @@ def _make_handler(server: ServeServer):
                 bins = doc.get("bins")
                 if bins is not None:
                     bins = np.asarray(bins, np.int32)
-                scores = server.score(rows, bins)
-                self._reply(200, {"scores": [round(float(s), 6)
-                                             for s in scores]})
+                # propagate the caller's trace id (forces sampling)
+                trace_id = self.headers.get("X-Shifu-Trace")
+                scores = server.score(rows, bins, trace_id=trace_id)
+                out = {"scores": [round(float(s), 6) for s in scores]}
+                if trace_id:
+                    out["trace"] = trace_id
+                self._reply(200, out)
             except Exception as e:             # noqa: BLE001 — HTTP edge
                 self._reply(400, {"error": str(e)})
 

@@ -1,0 +1,195 @@
+"""Cross-process telemetry aggregation suite: merged monitor over N
+telemetry dirs (tagged table, merged quorum, per-proc step-lag table),
+heartbeat-derived clock-offset normalization (a skewed host is neither
+mis-flagged stale nor left on its own time axis), merged timeline
+export (per-(dir,pid) process rows), merged analysis report, and the
+CLI wiring for ``monitor --aggregate`` / ``analysis --telemetry
+--aggregate``."""
+
+import json
+import os
+import time
+
+import pytest
+
+from shifu_tpu import obs
+from shifu_tpu.obs import monitor as monitor_mod
+from shifu_tpu.obs import timeline as timeline_mod
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    obs.reset_for_tests()
+    yield
+    obs.reset_for_tests()
+
+
+def _make_proc_dir(base, name, proc, rows, skew_s=0.0, now=None,
+                   step="TRAIN", state="running", with_trace=True):
+    """One process's telemetry dir: a health record whose embedded ts is
+    ``skew_s`` ahead of the file mtime (a skewed host clock), plus a
+    tiny trace on the same skewed clock."""
+    now = time.time() if now is None else now
+    d = os.path.join(base, name)
+    hd = os.path.join(d, "telemetry", "health")
+    os.makedirs(hd, exist_ok=True)
+    path = os.path.join(hd, f"{proc}.json")
+    with open(path, "w") as f:
+        json.dump({"proc": proc, "step": step, "state": state,
+                   "ts": now + skew_s, "started_ts": now + skew_s - 60,
+                   "last_progress_ts": now + skew_s - 1,
+                   "interval_s": 5.0, "rows": rows, "pid": 4242}, f)
+    os.utime(path, (now, now))            # mtime = the common clock
+    if with_trace:
+        with open(os.path.join(d, "telemetry", "trace.jsonl"), "w") as f:
+            f.write(json.dumps(
+                {"kind": "meta", "schema_version": obs.SCHEMA_VERSION,
+                 "step": step, "ts": now + skew_s, "pid": 4242}) + "\n")
+            f.write(json.dumps(
+                {"kind": "span", "name": "process", "id": 1,
+                 "parent": None, "ts": now + skew_s, "dur_s": 2.0,
+                 "attrs": {"rows": rows}}) + "\n")
+    return d
+
+
+def test_clock_offset_estimation(tmp_path):
+    now = time.time()
+    d0 = _make_proc_dir(str(tmp_path), "w0", "train-0", 100, 0.0, now)
+    d1 = _make_proc_dir(str(tmp_path), "w1", "train-1", 100, 300.0, now)
+    assert monitor_mod.dir_clock_offset(d0) == 0.0
+    assert monitor_mod.dir_clock_offset(d1) == pytest.approx(300.0,
+                                                             abs=2.0)
+    # sub-threshold jitter collapses to zero
+    d2 = _make_proc_dir(str(tmp_path), "w2", "train-2", 1, 0.4, now)
+    assert monitor_mod.dir_clock_offset(d2) == 0.0
+    assert monitor_mod.dir_clock_offset(str(tmp_path / "absent")) == 0.0
+
+
+def test_aggregate_normalizes_skewed_clock(tmp_path):
+    """A host whose clock runs 5 min ahead must read LIVE after
+    normalization (raw classification would call its heartbeat
+    impossibly fresh and its past-self stale) — and a genuinely dead
+    skewed host still reads stale."""
+    now = time.time()
+    d0 = _make_proc_dir(str(tmp_path), "w0", "train-0", 5000, 0.0, now)
+    d1 = _make_proc_dir(str(tmp_path), "w1", "train-1", 3200, 300.0, now)
+    recs, counts = monitor_mod.aggregate_records([d0, d1], now=now)
+    assert counts == {"live": 2}
+    by = {r["proc"]: r for r in recs}
+    assert by["train-1"]["clock_offset_s"] == pytest.approx(300.0,
+                                                            abs=2.0)
+    assert abs(by["train-1"]["age_s"]) < 5.0     # normalized, not -300
+    # dead skewed host: heartbeat 60s old in ITS OWN clock domain
+    d2 = _make_proc_dir(str(tmp_path), "w2", "train-2", 10, 300.0,
+                        now - 60)
+    recs, counts = monitor_mod.aggregate_records([d0, d1, d2], now=now)
+    assert counts == {"live": 2, "stale": 1}
+
+
+def test_aggregate_render_and_step_lag(tmp_path):
+    """ACCEPTANCE: monitor --aggregate over >= 2 process telemetry dirs
+    renders ONE merged report with a per-proc step-lag table."""
+    now = time.time()
+    d0 = _make_proc_dir(str(tmp_path), "w0", "train-0", 5000, 0.0, now)
+    d1 = _make_proc_dir(str(tmp_path), "w1", "train-1", 3200, 0.0, now)
+    text = monitor_mod.render_aggregate([d0, d1], now=now)
+    assert "merged monitor over 2 telemetry dir(s)" in text
+    assert "train-0" in text and "train-1" in text
+    assert "w0" in text and "w1" in text
+    assert "quorum 2/2" in text
+    assert "per-proc step lag" in text
+    lag = monitor_mod.step_lag_table(
+        monitor_mod.aggregate_records([d0, d1], now=now)[0], now=now)
+    by = {r["proc"]: r for r in lag}
+    assert by["train-0"]["rows_lag"] == 0          # the front-runner
+    assert by["train-1"]["rows_lag"] == 1800
+    assert by["train-1"]["step"] == "TRAIN"
+    # empty dirs: a message, not a traceback
+    assert "no health records" in monitor_mod.render_aggregate(
+        [str(tmp_path / "nothing")])
+
+
+def test_aggregate_json_doc_and_exit_code(tmp_path):
+    now = time.time()
+    d0 = _make_proc_dir(str(tmp_path), "w0", "train-0", 100, 0.0, now)
+    d1 = _make_proc_dir(str(tmp_path), "w1", "train-1", 90, 0.0,
+                        now - 60)                  # stale
+    doc, rc = monitor_mod.aggregate_json([d0, d1], now=now)
+    assert rc == monitor_mod.EXIT_UNHEALTHY
+    assert doc["kind"] == "monitor_aggregate"
+    assert doc["schema_version"] == obs.SCHEMA_VERSION
+    assert doc["summary"]["total"] == 2
+    assert doc["summary"]["counts"]["stale"] == 1
+    assert len(doc["step_lag"]) == 2
+    assert set(doc["clock_offsets"]) == {"w0", "w1"}
+    json.dumps(doc)                                # serializable
+    # all healthy -> 0
+    doc, rc = monitor_mod.aggregate_json([d0], now=now)
+    assert rc == 0
+
+
+def test_merged_timeline_normalizes_and_separates_procs(tmp_path):
+    """ACCEPTANCE (timeline half): merged export gives each (dir, pid)
+    its own process row, labels it with the dir, and pulls a skewed
+    dir's spans back onto the common clock axis."""
+    now = time.time()
+    d0 = _make_proc_dir(str(tmp_path), "w0", "train-0", 100, 0.0, now)
+    d1 = _make_proc_dir(str(tmp_path), "w1", "train-1", 90, 300.0, now)
+    out = timeline_mod.export_merged_timeline(
+        [d0, d1], str(tmp_path / "merged.json"))
+    doc = json.load(open(out))
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in spans} == {1, 2}     # distinct per dir
+    # both procs' spans land within seconds on the normalized axis,
+    # not 300s apart
+    assert abs(spans[0]["ts"] - spans[1]["ts"]) < 5_000_000
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert any("w0/" in n for n in names)
+    assert any("w1/" in n for n in names)
+    assert doc["otherData"]["clock_offsets"]["w1"] == pytest.approx(
+        300.0, abs=2.0)
+    # no readable traces -> None
+    assert timeline_mod.export_merged_timeline(
+        [str(tmp_path / "none")], str(tmp_path / "no.json")) is None
+
+
+def test_merged_report_renders_all_dirs(tmp_path):
+    from shifu_tpu.obs.report import render_telemetry_merged
+    now = time.time()
+    d0 = _make_proc_dir(str(tmp_path), "w0", "train-0", 5000, 0.0, now)
+    d1 = _make_proc_dir(str(tmp_path), "w1", "train-1", 3200, 120.0, now)
+    text = render_telemetry_merged([d0, d1])
+    assert "merged telemetry over 2 dir(s)" in text
+    assert text.count("== TRAIN") == 2             # both span trees
+    assert "clock offset +120" in text
+    assert "per-proc step lag" in text
+    assert "train-1" in text
+
+
+def test_cli_monitor_and_analysis_aggregate(tmp_path, capsys):
+    from shifu_tpu.cli import main
+    now = time.time()
+    d0 = _make_proc_dir(str(tmp_path), "w0", "train-0", 5000, 0.0, now)
+    d1 = _make_proc_dir(str(tmp_path), "w1", "train-1", 3200, 0.0, now)
+    assert main(["monitor", "--once", "--aggregate", d0, d1]) == 0
+    out = capsys.readouterr().out
+    assert "merged monitor" in out and "per-proc step lag" in out
+    # --json carries the health exit code; both live -> 0
+    assert main(["monitor", "--once", "--json",
+                 "--aggregate", d0, d1]) == 0
+    doc = json.loads(capsys.readouterr().out.strip())
+    assert doc["kind"] == "monitor_aggregate"
+    # analysis --telemetry --aggregate: one merged report
+    assert main(["analysis", "--telemetry", "--aggregate", d0, d1]) == 0
+    out = capsys.readouterr().out
+    assert "merged telemetry" in out and "per-proc step lag" in out
+    # analysis --telemetry --timeline --aggregate: one merged trace
+    tl = str(tmp_path / "tl.json")
+    assert main(["analysis", "--telemetry", "--timeline", tl,
+                 "--aggregate", d0, d1]) == 0
+    assert "timeline ->" in capsys.readouterr().out
+    assert {e["pid"] for e in json.load(open(tl))["traceEvents"]
+            if e["ph"] == "X"} == {1, 2}

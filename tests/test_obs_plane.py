@@ -392,12 +392,47 @@ def test_openmetrics_rendering(telemetry):
     assert "shifu_tpu_train_epoch_s_count 2" in text
     assert "shifu_tpu_train_epoch_s_sum 2" in text
     assert "shifu_tpu_train_epoch_s_max 1.5" in text
-    # the OpenMetrics charset holds for every exposed name
+    # the OpenMetrics charset holds for every exposed name (quantile
+    # sample lines carry a {quantile="..."} label set, v8)
     for line in text.splitlines():
         if line.startswith("#"):
             continue
-        name = line.split(" ")[0]
+        name = line.split(" ")[0].split("{")[0]
         assert re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", name), name
+
+
+def test_openmetrics_histogram_quantile_lines(telemetry):
+    """Satellite: histogram summaries expose p50/p99 quantile sample
+    lines (the registry's log-sketch estimates), not just count/sum."""
+    h = obs.histogram("serve.batch_latency_ms")
+    for _ in range(99):
+        h.observe(2.0)
+    h.observe(80.0)
+    text = exporter_mod.render_openmetrics()
+    q = {}
+    for line in text.splitlines():
+        if line.startswith("shifu_tpu_serve_batch_latency_ms{quantile="):
+            key = line.split('quantile="')[1].split('"')[0]
+            q[key] = float(line.split("} ")[1])
+    assert set(q) == {"0.5", "0.99"}
+    # sketch resolution is ~6.6%/bin: loose relative bounds
+    assert q["0.5"] == pytest.approx(2.0, rel=0.15)
+    assert q["0.99"] == pytest.approx(2.0, rel=0.15)
+    h.observe(80.0)                          # now >1% of mass is at 80
+    for _ in range(8):
+        h.observe(80.0)
+    text = exporter_mod.render_openmetrics()
+    line = next(l for l in text.splitlines()
+                if l.startswith("shifu_tpu_serve_batch_latency_ms"
+                                '{quantile="0.99"}'))
+    assert float(line.split("} ")[1]) == pytest.approx(80.0, rel=0.15)
+    # pre-v8 snapshot records (no p50/p99 keys) still render summaries
+    legacy = [{"kind": "metric", "type": "histogram", "name": "old.h",
+               "count": 2, "sum": 3.0, "min": 1.0, "max": 2.0,
+               "last": 2.0}]
+    text = exporter_mod.render_openmetrics(legacy)
+    assert "shifu_tpu_old_h_count 2" in text
+    assert 'shifu_tpu_old_h{quantile' not in text
 
 
 def test_exporter_periodic_and_final_write(telemetry, tmp_path):
@@ -700,6 +735,61 @@ def test_every_metric_name_is_declared_in_manifest():
     for name, (kind, help_) in manifest.MANIFEST.items():
         assert kind in ("counter", "gauge", "histogram"), name
         assert help_, name
+
+
+_SPAN_RE = re.compile(
+    r"\b(?:obs|tracer)\s*\.\s*(?:span|record_span)\(\s*(f?)\"([^\"]*)\"")
+
+
+def _span_call_sites():
+    """(path, is_fstring, name_literal) for every string-literal span
+    creation under shifu_tpu/ (obs.span / obs.record_span)."""
+    sites = []
+    pkg = os.path.join(REPO, "shifu_tpu")
+    for dirpath, _, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py") or fn == "manifest.py":
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                src = f.read()
+            for m in _SPAN_RE.finditer(src):
+                fstr, name = m.group(1), m.group(2)
+                if fstr:
+                    name = name.split("{")[0]
+                sites.append((os.path.relpath(path, REPO), bool(fstr),
+                              name))
+    return sites
+
+
+def test_every_span_name_literal_is_declared_in_manifest():
+    """Satellite lint: the timeline tracks / report sections / tests
+    join on span-name literals, so a typo'd span name silently vanishes
+    from every report — every obs.span("...") / obs.record_span("...")
+    literal must be declared in obs.manifest.SPANS (or start with a
+    declared SPAN_PREFIXES family).  Step-root spans named by variable
+    (obs.span(self.profile_name, ...)) ride outside the lint."""
+    from shifu_tpu.obs import manifest
+    sites = _span_call_sites()
+    assert len(sites) > 8                    # the scan really sees the tree
+    problems = []
+    for path, fstr, name in sites:
+        if fstr:
+            if not any(name.startswith(p)
+                       for p in manifest.SPAN_PREFIXES):
+                problems.append(f"{path}: f-string span {name!r} has no "
+                                "declared prefix")
+        elif not manifest.is_declared_span(name):
+            problems.append(f"{path}: span {name!r} not in SPANS")
+    assert not problems, "\n".join(problems)
+    # the declared span set itself is well-formed, and the serve plane's
+    # request/batch spans are present
+    for name, help_ in manifest.SPANS.items():
+        assert help_, name
+    assert "serve.request" in manifest.SPANS
+    assert "serve.batch" in manifest.SPANS
+    assert manifest.is_declared_span("bench.serve")
+    assert not manifest.is_declared_span("serve.requst")   # the typo case
 
 
 def test_obs_reexport_audit():

@@ -1,0 +1,258 @@
+"""Live SLO plane suite (obs/slo): log-bin sketch accuracy, sliding
+windows with an injected clock, availability/error-budget burn-rate
+math, multi-window alert semantics (a forced breach trips within one
+window; a transient blip does not page), gauge emission, knob readers,
+and the ServeServer integration (/slo payload, heartbeat extras,
+monitor flags)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from shifu_tpu import obs
+from shifu_tpu.config import environment
+from shifu_tpu.obs import slo as slo_mod
+from shifu_tpu.obs.slo import (LOG_BINS, LogBins, SLOTracker,
+                               quantile_from_counts, slo_objectives)
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    environment.reset_for_tests()
+    obs.reset_for_tests()
+    yield
+    environment.reset_for_tests()
+    obs.reset_for_tests()
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -------------------------------------------------------------- log bins
+def test_log_bins_index_monotonic_and_bounded():
+    b = LogBins()
+    vals = 10.0 ** np.linspace(-7, 4, 400)
+    idx = [b.index(float(v)) for v in vals]
+    assert idx == sorted(idx)
+    assert idx[0] == 0 and idx[-1] == b.n - 1
+    assert b.index(0.0) == 0 and b.index(-1.0) == 0
+    # vectorized agrees with scalar
+    np.testing.assert_array_equal(b.indices(vals), np.asarray(idx))
+    # a bin's representative value round-trips into the same bin
+    for i in range(1, b.n - 1):
+        assert b.index(b.value(i)) == i
+
+
+def test_quantile_from_counts_accuracy():
+    rng = np.random.default_rng(0)
+    lat = rng.lognormal(mean=-6.0, sigma=0.8, size=20000)   # ~2.5ms-ish
+    counts = np.bincount(LOG_BINS.indices(lat), minlength=LOG_BINS.n)
+    for q in (0.5, 0.9, 0.99):
+        exact = float(np.quantile(lat, q))
+        est = quantile_from_counts(counts, q)
+        assert est == pytest.approx(exact, rel=0.15)
+    assert quantile_from_counts(np.zeros(LOG_BINS.n, np.int64), 0.5) \
+        is None
+
+
+# -------------------------------------------------------------- tracker
+def test_tracker_windows_slide_and_expire():
+    clk = FakeClock()
+    t = SLOTracker(p99_ms=5.0, window_s=1.0, n_windows=3, clock=clk)
+    t.observe_batch(np.full(100, 0.001))
+    assert t.quantile_ms(0.5) == pytest.approx(1.0, rel=0.15)
+    # 2 windows later the data is still inside the 3-window ring
+    clk.t += 2.0
+    assert t.quantile_ms(0.5) is not None
+    # 4 windows later it has expired
+    clk.t += 2.0
+    assert t.quantile_ms(0.5) is None
+    assert t.availability_observed() == 1.0        # empty = healthy
+
+
+def test_tracker_availability_and_burn_math():
+    clk = FakeClock()
+    t = SLOTracker(p99_ms=5.0, availability=0.999, window_s=10.0,
+                   n_windows=6, clock=clk)
+    t.observe_batch(np.full(990, 0.001))
+    t.record_errors(10)
+    assert t.availability_observed() == pytest.approx(0.99)
+    burn = t.burn_rates()
+    # 1% errors against a 0.1% allowance = burn 10
+    assert burn["availability"] == pytest.approx(10.0, rel=0.01)
+    assert burn["latency"] == 0.0
+    # latency budget: 5% of requests over the objective vs 1% allowed
+    t2 = SLOTracker(p99_ms=5.0, window_s=10.0, clock=FakeClock())
+    lat = np.full(1000, 0.001)
+    lat[:50] = 0.050
+    t2.observe_batch(lat)
+    assert t2.burn_rates()["latency"] == pytest.approx(5.0, rel=0.01)
+
+
+def test_forced_breach_alerts_within_one_window():
+    """ACCEPTANCE: a hard SLO breach (every request over the objective)
+    trips the page burn-rate alert within one window."""
+    clk = FakeClock()
+    t = SLOTracker(p99_ms=0.1, window_s=10.0, n_windows=30, clock=clk)
+    assert t.alerts() == []
+    t.observe_batch(np.full(200, 0.005))       # 5ms >> 0.1ms objective
+    alerts = t.alerts()
+    assert alerts and alerts[0]["severity"] == "page"
+    assert alerts[0]["budget"] == "latency"
+    assert alerts[0]["burn_short"] >= 14.4
+    summ = t.summary()
+    assert summ["alerting"] is True
+    assert summ["horizons"]["short"]["over_objective"] == 200
+    compact = t.compact()
+    assert compact["alerting"] and "page:latency" in compact["alerts"]
+
+
+def test_transient_blip_does_not_page():
+    """Multi-window suppression: a short burst of slow requests inside a
+    long healthy history exceeds the short-window burn but NOT the
+    long-window burn — no page."""
+    clk = FakeClock()
+    t = SLOTracker(p99_ms=2.0, window_s=1.0, n_windows=30, clock=clk)
+    for _ in range(29):                        # long healthy history
+        t.observe_batch(np.full(1000, 0.0001))
+        clk.t += 1.0
+    t.observe_batch(np.full(30, 0.050))        # one bad tick
+    burn = t.burn_rates(horizon_s=1.0)
+    assert burn["latency"] >= 14.4             # short window IS burning
+    assert t.alerts() == []                    # long window absorbs it
+
+
+def test_emit_gauges_and_objectives_knobs():
+    obs.set_enabled(True)
+    clk = FakeClock()
+    t = SLOTracker(p99_ms=2.0, window_s=10.0, clock=clk)
+    t.observe_batch(np.full(100, 0.001))
+    t.emit_gauges()
+    snap = {m["name"]: m for m in obs.snapshot()}
+    assert snap["slo.p99_ms"]["value"] == pytest.approx(1.0, rel=0.15)
+    assert snap["slo.availability"]["value"] == 1.0
+    assert snap["slo.alerts_firing"]["value"] == 0
+    assert "slo.burn_rate_short" in snap and "slo.burn_rate_long" in snap
+    # knob readers: defaults derive from the deadline; properties win
+    p99, avail = slo_objectives(max_delay_ms=2.0)
+    assert p99 == 4.0 and avail == slo_mod.DEFAULT_AVAILABILITY
+    environment.set_property("shifu.serve.sloP99Ms", "7.5")
+    environment.set_property("shifu.serve.sloAvailability", "0.99")
+    p99, avail = slo_objectives(max_delay_ms=2.0)
+    assert p99 == 7.5 and avail == 0.99
+
+
+def test_registry_histogram_sketch_quantiles():
+    obs.set_enabled(True)
+    h = obs.histogram("train.epoch_s")
+    for _ in range(99):
+        h.observe(0.5)
+    h.observe(20.0)
+    rec = h.to_record()
+    assert rec["p50"] == pytest.approx(0.5, rel=0.15)
+    assert rec["p99"] == pytest.approx(0.5, rel=0.15)
+    assert h.quantile(0.999) == pytest.approx(20.0, rel=0.15)
+
+
+# -------------------------------------------------- server integration
+def _nn_models(n=2, n_features=8, seed0=0):
+    from shifu_tpu.models.nn import (IndependentNNModel, NNModelSpec,
+                                     init_params)
+    spec = NNModelSpec(input_dim=n_features, hidden_nodes=[8],
+                       activations=["relu"])
+    return [IndependentNNModel(spec, init_params(
+        jax.random.PRNGKey(seed0 + i), spec)) for i in range(n)]
+
+
+def test_server_slo_doc_and_status(tmp_path):
+    from shifu_tpu.serve import ServeServer
+    server = ServeServer(models=_nn_models(), key="s", buckets=(1, 4),
+                         max_delay_ms=1.0, slo_p99_ms=500.0)
+    rng = np.random.default_rng(0)
+    server.score(rng.normal(size=(3, 8)).astype(np.float32))
+    st = server.status()
+    assert st["queue_depth"] == 0
+    assert st["slo"]["objective_p99_ms"] == 500.0
+    assert st["slo"]["alerting"] is False
+    doc = server.slo_doc()
+    assert doc["kind"] == "slo"
+    assert doc["horizons"]["long"]["requests"] == 3
+    assert doc["objectives"]["p99_ms"] == 500.0
+    assert json.loads(json.dumps(doc))          # JSON-serializable
+
+
+def test_server_breach_trips_slo_and_monitor(tmp_path):
+    """ACCEPTANCE: a tiny objective forces a breach; /slo reports the
+    page alert and `monitor` renders the SLO BURN flag from the SERVE
+    heartbeat within one beat."""
+    from shifu_tpu.obs import monitor as monitor_mod
+    from shifu_tpu.serve import ServeServer
+    obs.set_enabled(True)
+    mdir = str(tmp_path)
+    server = ServeServer(model_set_dir=mdir, models=_nn_models(),
+                         key="b", buckets=(1, 4), max_delay_ms=1.0,
+                         slo_p99_ms=1e-6)       # nothing can meet this
+    server.start()
+    try:
+        rng = np.random.default_rng(1)
+        server.score(rng.normal(size=(4, 8)).astype(np.float32),
+                     timeout=15.0)
+        doc = server.slo_doc()
+        assert doc["alerting"] is True
+        assert any(a["severity"] == "page" and a["budget"] == "latency"
+                   for a in doc["alerts"])
+        # force one beat NOW (no interval sleep) and read it back
+        server._heartbeat.beat()
+        (rec,) = obs.read_health(obs.health_dir_for(mdir))
+        assert rec["queue_depth"] == 0
+        assert rec["slo"]["alerting"] is True
+        text = monitor_mod.render_status(mdir)
+        assert "SLO BURN" in text
+        assert "q=0" in text
+    finally:
+        server.stop()
+
+
+def test_serve_heartbeat_queue_depth_sampled(tmp_path):
+    """Satellite: SERVE heartbeats carry queue_depth (and the buildup
+    flag trips when the queue exceeds the buildup threshold)."""
+    from shifu_tpu.obs import monitor as monitor_mod
+    from shifu_tpu.serve import ServeServer
+    from shifu_tpu.serve.server import QUEUE_BUILDUP_BUCKETS
+    obs.set_enabled(True)
+    mdir = str(tmp_path)
+    server = ServeServer(model_set_dir=mdir, models=_nn_models(),
+                         key="q", buckets=(1, 4), max_delay_ms=1.0)
+    # NOT started: no worker drains the queue, so depth is observable
+    rng = np.random.default_rng(2)
+    n = QUEUE_BUILDUP_BUCKETS * 4 + 3
+    server.batcher.submit_burst(
+        rng.normal(size=(n, 8)).astype(np.float32))
+    extras = server._beat_extras()
+    assert extras["queue_depth"] == n
+    assert extras["queue_buildup"] is True
+    snap = {m["name"]: m for m in obs.snapshot()}
+    assert snap["serve.queue_depth"]["value"] == n
+    # monitor renders the buildup flag from a heartbeat carrying it
+    hd = obs.health_dir_for(mdir)
+    os.makedirs(hd)
+    import time
+    with open(os.path.join(hd, "serve-q.json"), "w") as f:
+        json.dump({"proc": "serve-q", "step": "SERVE",
+                   "state": "running", "ts": time.time(),
+                   "last_progress_ts": time.time(), "interval_s": 5.0,
+                   **extras}, f)
+    text = monitor_mod.render_status(mdir)
+    assert "QUEUE BUILDUP" in text and f"q={n}" in text
+    server.batcher.drain()
