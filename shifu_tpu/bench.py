@@ -56,7 +56,13 @@ from . import obs
 # at >= 0.95x the QPS floor) and emits latency-decomposition extras
 # (serve_queue_frac / serve_device_frac / serve_pad_frac); --compare
 # tracks the queue/pad fracs in the lower-is-better class.
-BENCH_TELEMETRY_SCHEMA = 8
+# v9: roofline speed round — serve.bucket_occupancy becomes a histogram
+# (p50/p99 in metrics.prom), serve.bucket_rungs_added counter, and the
+# bench emits nn_train_mixed_* (bf16-ladder training throughput + MFU,
+# tracked beside the f32 rows) and serve_quantized_* (uint8-traversal
+# AOT scorer throughput + bit-parity flag) extras; --compare picks the
+# new *_mfu / *_per_sec / *_qps names up via the existing classes.
+BENCH_TELEMETRY_SCHEMA = 9
 
 # measured on this rig (tools/measure_baseline.py); provenance in
 # BASELINE.md — every headline divides by a MEASURED reference-class
@@ -138,6 +144,68 @@ def bench_nn(n_rows: int = 1 << 17, n_features: int = 256,
             float(loss)                              # value-forcing sync
             best = max(best, steps * batch / (time.perf_counter() - t0))
         return best
+
+
+def bench_nn_mixed(n_rows: int = 1 << 17, n_features: int = 256,
+                   hidden: tuple = (512, 256), batch: int = 1 << 12,
+                   steps: int = 4000,
+                   collect: Dict[str, Any] = None) -> float:
+    """NN training throughput under the MIXED-precision ladder
+    (``shifu.train.precision=mixed``): bf16 params/activations through
+    forward/backward, f32 master copy stepped by the optimizer — the
+    bench twin of the trainer path, same scanned-window harness as
+    :func:`bench_nn` so ``nn_train_mixed_*`` rows compare directly
+    against the f32 ``nn_train_*`` rows."""
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.models.nn import NNModelSpec, init_params, weighted_loss
+    from shifu_tpu.train.optimizers import (cast_tree, make_optimizer,
+                                            mixed_apply, mixed_init)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n_rows, n_features)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(n_features,)) / np.sqrt(n_features),
+                    jnp.float32)
+    y = jnp.asarray(rng.random(n_rows)
+                    < jax.nn.sigmoid(x @ w), jnp.float32)[:, None]
+    wgt = jnp.ones((n_rows, 1), jnp.float32)
+    spec = NNModelSpec(input_dim=n_features, hidden_nodes=list(hidden),
+                       activations=["relu"] * len(hidden), output_dim=1)
+    params = cast_tree(init_params(jax.random.PRNGKey(0), spec),
+                       jnp.bfloat16)
+    opt = make_optimizer("ADAM", 1e-3)
+    state = mixed_init(opt, params)
+    n_batches = n_rows // batch
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("n_steps",), donate_argnums=(0, 1))
+    def run_steps(params, state, n_steps: int):
+        def body(carry, i):
+            p, st = carry
+            b = (i % n_batches) * batch
+            loss, grads = jax.value_and_grad(weighted_loss)(
+                p, spec, jax.lax.dynamic_slice_in_dim(x, b, batch),
+                jax.lax.dynamic_slice_in_dim(y, b, batch),
+                jax.lax.dynamic_slice_in_dim(wgt, b, batch))
+            p, st = mixed_apply(opt, grads, st)
+            return (p, st), loss
+        (p, st), losses = jax.lax.scan(
+            body, (params, state), jnp.arange(n_steps, dtype=jnp.int32))
+        return p, st, losses[-1]
+
+    params, state, loss = run_steps(params, state, steps)
+    float(loss)                                      # full warmup sync
+    _collect_window_cost(collect, run_steps, (params, state),
+                         {"n_steps": steps}, steps * batch)
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        params, state, loss = run_steps(params, state, steps)
+        float(loss)                                  # value-forcing sync
+        best = max(best, steps * batch / (time.perf_counter() - t0))
+    return best
 
 
 def _collect_window_cost(collect, jitted, args, kwargs, rows: int) -> None:
@@ -1080,6 +1148,72 @@ def _serve_closed_loop(batcher, pool: np.ndarray, n_threads: int,
         [v for ls in lats for v in ls], np.float64)
 
 
+def bench_serve_quantized(n_rows_grow: int = 1 << 13, n_feat: int = 32,
+                          n_bins: int = 64, n_trees: int = 50,
+                          depth: int = 6,
+                          bucket: int = 512) -> Dict[str, Any]:
+    """Quantized-traversal serving micro-bench: a GBT forest behind the
+    AOT scorer, scored on uint8 bin batches (``serve_quantized_qps`` =
+    ``score_batch`` rows/s at the top bucket), with the bit-parity
+    guard the quant path is contracted to: AOT quantized scores must be
+    BIT-identical to the classic widened-traversal math."""
+    import jax.numpy as jnp
+
+    from shifu_tpu.models.tree import IndependentTreeModel, TreeModelSpec
+    from shifu_tpu.ops.tree import (grow_tree, predict_forest_stacked,
+                                    stack_forest)
+    from shifu_tpu.serve.scorer import AOTScorer
+
+    rng = np.random.default_rng(7)
+    gbins = rng.integers(0, n_bins,
+                         size=(n_rows_grow, n_feat)).astype(np.int32)
+    y = (rng.random(n_rows_grow) < 0.3).astype(np.float32)
+    w = np.ones(n_rows_grow, np.float32)
+    trees = [grow_tree(gbins, y * (0.8 + 0.4 * rng.random()), w, n_bins,
+                       depth) for _ in range(n_trees)]
+    spec = TreeModelSpec(algorithm="GBT", n_trees=n_trees, depth=depth,
+                         n_bins=n_bins, loss="log", learning_rate=0.1,
+                         init_score=-0.5)
+    model = IndependentTreeModel(spec, trees)
+    scorer = AOTScorer([model], buckets=(bucket,), name="serve.score.quant")
+    scorer.warm()
+    # the AOT signature covers exactly the features the forest reads
+    batch = rng.integers(0, n_bins, size=(bucket, scorer.n_bins_cols)) \
+        .astype(np.uint8)
+    x = np.zeros((bucket, scorer.n_features), np.float32)
+    # classic reference: widened int32 traversal + the same GBT link,
+    # in-graph f32 end to end (a host float64 reference would differ in
+    # rounding, not in routing)
+    import jax
+
+    stacked = stack_forest(trees)
+    scale = scorer.scorer.scale
+
+    @jax.jit
+    def classic(b):
+        preds = predict_forest_stacked(*stacked, b, depth)
+        f = spec.init_score + spec.learning_rate * preds.sum(axis=0)
+        return (1.0 / (1.0 + jnp.exp(-f))) * scale
+
+    ref = np.asarray(classic(jnp.asarray(batch, jnp.int32)))
+    got = scorer.score_batch(x, batch)[:, 0]
+    parity = bool(np.array_equal(ref, got))
+    best = 0.0
+    reps = 5
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(20):
+            scorer.score_batch(x, batch)
+        best = max(best, 20 * bucket / (time.perf_counter() - t0))
+    return {
+        "serve_quantized_qps": round(best, 1),
+        "serve_quantized_parity": parity,
+        "serve_quantized_bins_dtype": str(scorer.bins_dtype),
+        "serve_quantized_shape": f"{n_trees} trees depth {depth} x "
+                                 f"{n_bins} bins, bucket {bucket}",
+    }
+
+
 def bench_serve(n_features: int = 32, n_models: int = 5,
                 hidden: tuple = (64,), low_qps: float = 2000.0,
                 mid_qps: float = 20000.0,
@@ -1206,6 +1340,18 @@ def bench_serve(n_features: int = 32, n_models: int = 5,
                        f"clients: closed 8-thread / open "
                        f"{low_qps:.0f}+{mid_qps:.0f} QPS / saturation",
     }
+    # quantized-traversal serving rows ride beside the NN-plane rows
+    try:
+        rep.update(bench_serve_quantized())
+        if rep.get("serve_quantized_parity") is False:
+            raise AssertionError(
+                "quantized AOT traversal diverged from the classic "
+                "widened-traversal scores — the bit-parity contract of "
+                "ops.tree_quant is broken")
+    except AssertionError:
+        raise
+    except Exception as e:                      # pragma: no cover
+        rep["serve_quantized_error"] = str(e)[:200]
     # plane guards — fail loudly, like the tail bench's schedule guards
     if recompiles > 0:
         raise AssertionError(
@@ -1542,6 +1688,18 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
         except Exception as e:                  # pragma: no cover
             extras[key + "_error"] = str(e)[:200]
 
+    # mixed-precision ladder row (same harness/shape as the f32 row so
+    # the pair reads as one before/after on the compare table)
+    mixed_cost: Dict[str, Any] = {}
+    record("nn_train_mixed_throughput",
+           lambda: bench_nn_mixed(collect=mixed_cost),
+           BASELINE_ROWS_PER_SEC)
+    if "nn_train_mixed_throughput" in extras:
+        _mfu_extras("nn_train_mixed", extras["nn_train_mixed_throughput"],
+                    mixed_cost, extras)
+        for k in ("nn_train_mixed_mfu", "nn_train_mixed_achieved_bw"):
+            if k in extras:
+                obs.gauge(f"bench.{k}").set(float(extras[k]))
     record("gbt_train_throughput_resident", bench_gbt, BASELINE_TREE_RATE)
     record("gbt_train_throughput_streamed", bench_gbt_streamed,
            BASELINE_TREE_RATE)

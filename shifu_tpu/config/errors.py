@@ -52,6 +52,11 @@ class ErrorCode(enum.Enum):
     # --- models (1250s)
     ERROR_MODEL_FILE_NOT_FOUND = (1250, "The model file is not found")
     ERROR_FAIL_TO_LOAD_MODEL_FILE = (1251, "Failed to load the model file")
+    # rebuild-specific: a trainer-state checkpoint was written under a
+    # different shifu.train.precision than the resuming run — silently
+    # casting the master copy / optimizer state would corrupt the resume
+    ERROR_CHECKPOINT_PRECISION_MISMATCH = (
+        1252, "Checkpoint precision does not match shifu.train.precision")
     # --- eval (1300s)
     ERROR_MODEL_EVALSET_DOESNT_EXIST = (1301, "The evalset doesn't exist")
     ERROR_MODEL_EVALSET_ALREADY_EXIST = (1302, "The evalset already exists")

@@ -88,6 +88,12 @@ TRAIN_PARAM_RULES: Dict[str, Rule] = {
     "Precision": Rule("str", allowed=("highest", "float32", "default",
                                       "bfloat16", "tensorfloat32"),
                       algs=NN_FAMILY),
+    # training-precision ladder (round 12): f32 keeps today's math;
+    # bf16 trains fully narrow; mixed keeps an f32 master copy in the
+    # optimizer state with bf16 forward/backward ("" defers to the
+    # -Dshifu.train.precision property)
+    "TrainPrecision": Rule("str", allowed=("f32", "bf16", "mixed"),
+                           algs=NN_FAMILY + ("WDL",)),
     "Loss": Rule("str", allowed=_LOSSES),
     # SVM (reference core/alg/SVMTrainer.java param keys)
     "Kernel": Rule("str", allowed=("linear", "rbf", "radialbasisfunction",

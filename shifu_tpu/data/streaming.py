@@ -193,12 +193,22 @@ class ShardStream:
 
     def __init__(self, shards: Shards, keys: Sequence[str],
                  window_rows: int, prefetch: Optional[int] = None,
-                 spill: Optional[bool] = None):
+                 spill: Optional[bool] = None,
+                 remainder_multiple: int = 0):
         from .spill import spill_enabled
         assert window_rows > 0
         self.shards = shards
         self.keys = tuple(keys)
         self.window_rows = int(window_rows)
+        # shape-stable remainder handling (> 0 enables): the LAST partial
+        # window pads to the smallest W/2^k rung (k <= 3, rungs kept
+        # multiples of ``remainder_multiple`` — the mesh data-axis size —
+        # so sharding still divides) that covers its real rows, instead
+        # of the full W.  At most 3 extra static shapes ever exist (one
+        # per rung, and a given dataset only produces ONE tail shape), so
+        # consumers pay at most one extra compile while ingest.rows_padded
+        # drops by up to 8x on the tail.  0 keeps the old full-W pad.
+        self.remainder_multiple = int(remainder_multiple)
         self.prefetch = stream_prefetch_depth(prefetch)
         self.spill = spill_enabled() if spill is None else bool(spill)
         self._spill_off = False         # sticky: aborted marker / IO error
@@ -293,6 +303,21 @@ class ShardStream:
         obs.counter("ingest.spill_misses").inc()
         yield from self._windows_npz(start_shard, shard_offset, start_row)
 
+    def _tail_rows(self, buffered: int) -> int:
+        """Padded row count for the final partial window: the smallest
+        remainder-ladder rung covering ``buffered`` (see __init__), or
+        the full window when the ladder is off / nothing smaller fits."""
+        w = self.window_rows
+        m = self.remainder_multiple
+        if m <= 0 or buffered >= w:
+            return w
+        rung, r = w, w // 2
+        for _ in range(3):
+            if r < max(m, buffered) or r % m:
+                break
+            rung, r = r, r // 2
+        return rung
+
     def _windows_mmap(self, rd, g0: int, start_row: int) -> Iterator[Window]:
         """Serve windows as raw-file slices — the hot path for every sweep
         after the first (src/start bookkeeping identical to the npz path,
@@ -311,8 +336,9 @@ class ShardStream:
             arrays = {k: np.asarray(mms[k][g:e]) for k in self.keys}
             nv = e - g
             if nv < W:
-                arrays = {k: _pad_rows(a, W) for k, a in arrays.items()}
-                pad_c.inc(W - nv)
+                rows = self._tail_rows(nv)
+                arrays = {k: _pad_rows(a, rows) for k, a in arrays.items()}
+                pad_c.inc(rows - nv)
             nb = sum(a.nbytes for a in arrays.values())
             bytes_c.inc(nb)
             self.bytes_read += nb
@@ -387,10 +413,11 @@ class ShardStream:
                     start += W
             if buffered:
                 arrays, buf, _ = _take(buf, buffered, self.keys)
-                arrays = {k: _pad_rows(a, W) for k, a in arrays.items()}
+                rows = self._tail_rows(buffered)
+                arrays = {k: _pad_rows(a, rows) for k, a in arrays.items()}
                 # padding waste surface for the utilization report: rows
                 # the device computes over that carry zero weight
-                obs.counter("ingest.rows_padded").inc(W - buffered)
+                obs.counter("ingest.rows_padded").inc(rows - buffered)
                 nb = sum(a.nbytes for a in arrays.values())
                 bytes_c.inc(nb)
                 self.bytes_read += nb

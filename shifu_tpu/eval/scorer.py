@@ -76,6 +76,7 @@ class Scorer:
         self.mesh = mesh
         self._groups = None          # lazy same-shape NN stacks
         self._groups_src = None      # models the cache was built from
+        self._bins_dtype = None      # lazy narrowest bins dtype
 
     @classmethod
     def from_dir(cls, models_dir: str, scale: float = SCORE_SCALE,
@@ -148,9 +149,16 @@ class Scorer:
         Same-shape NN models score as one stacked jit call.  Thin host
         wrapper over :meth:`score_device` — ONE [n, M] fetch, aggregates
         on host (the dispatch rules live in one place)."""
+        if self._bins_dtype is None:
+            # bins ride the narrowest dtype the ensemble admits (uint8
+            # wire contract — the quantized traversal consumes it
+            # directly; 1/4 the eval plane's H2D bin bytes)
+            from ..ops.tree_quant import ensemble_bins_dtype, quant_scoring
+            self._bins_dtype = ensemble_bins_dtype(self.models) \
+                if quant_scoring() else np.dtype(np.int32)
         raw_d, _ = self.score_device(
             self._put(x, np.float32),
-            None if bins is None else self._put(bins))
+            None if bins is None else self._put(bins, self._bins_dtype))
         raw = np.asarray(raw_d)[:len(x)]     # drop mesh padding rows
         return CaseScoreResult(scores=raw, mean=raw.mean(axis=1),
                                max=raw.max(axis=1), min=raw.min(axis=1),

@@ -114,9 +114,17 @@ def init_params(key, spec: NNModelSpec, initializer: str = "xavier") -> List[Dic
 def forward(params: List[Dict], spec: NNModelSpec, x, *,
             dropout_rate: float = 0.0, rng=None):
     """MLP forward.  Hidden dropout (inverted scaling) only when a key is
-    given — eval path stays deterministic."""
+    given — eval path stays deterministic.
+
+    The compute dtype follows the WEIGHTS: bf16 params (the mixed/bf16
+    training ladder) pull the input and every hidden activation down to
+    bf16 — matmuls feed the MXU at native rate and activations halve
+    their HBM footprint — while the head logits widen back to f32 so the
+    output activation and loss keep f32 dynamic range.  f32 params leave
+    the graph byte-identical to before."""
     acts = [activation(a) for a in spec.activations]
-    h = x
+    cdt = params[0]["w"].dtype if params else jnp.float32
+    h = x.astype(cdt) if cdt != jnp.float32 else x
     n_hidden = len(params) - 1
     for i, layer in enumerate(params[:-1]):
         h = acts[i % max(1, len(acts))](h @ layer["w"] + layer["b"])
@@ -126,8 +134,12 @@ def forward(params: List[Dict], spec: NNModelSpec, x, *,
             rng, sub = jax.random.split(rng)
             keep_p = 1.0 - dropout_rate
             keep = jax.random.bernoulli(sub, keep_p, h.shape)
-            h = jnp.where(keep, h / keep_p, 0.0)
+            # divide in h's dtype: a strong-typed f32 keep_p (per-member
+            # hyper tracer) would silently widen a bf16 ladder back to f32
+            h = jnp.where(keep, h / jnp.asarray(keep_p, h.dtype), 0.0)
     out = h @ params[-1]["w"] + params[-1]["b"]
+    if out.dtype != jnp.float32:
+        out = out.astype(jnp.float32)
     return activation(spec.output_activation)(out)
 
 

@@ -94,17 +94,40 @@ class IndependentTreeModel:
         self.spec = spec
         self.trees = trees
         self._stacked = None                # lazy same-depth stacked arrays
+        self._quant = None                  # lazy quantized-layout arrays
 
     @classmethod
     def load(cls, path: str) -> "IndependentTreeModel":
         return cls(*load_model(path))
 
-    def compute(self, bins: np.ndarray) -> np.ndarray:
-        b = jnp.asarray(bins, jnp.int32)
+    def _quant_arrays(self):
+        if self._quant is None:
+            from ..ops.tree_quant import stack_forest_quant
+            self._quant = stack_forest_quant(self.trees)
+        return self._quant
+
+    def _forest_preds(self, bins) -> np.ndarray:
+        """[T, N] (or [T, N, K]) raw per-tree predictions.  The quantized
+        traversal is the default: bins stay in the uint8 wire dtype end
+        to end (the classic path widened every scoring call to int32 —
+        4x the bytes of the plane that dominates serving reads), f32
+        appears only at the leaf gather; scores are bit-identical to the
+        classic traversal on every backend."""
+        from ..ops import tree_quant as tq
+        if tq.quant_scoring() and tq.bins_fit_uint8(self.spec.n_bins):
+            b = jnp.asarray(bins)
+            if b.dtype != jnp.uint8:
+                b = b.astype(jnp.uint8)
+            return np.asarray(tq.predict_forest_quant(
+                *self._quant_arrays(), b, self.trees[0].depth))
         if self._stacked is None:
             self._stacked = stack_forest(self.trees)
-        preds = np.asarray(predict_forest_stacked(
-            *self._stacked, b, self.trees[0].depth))
+        return np.asarray(predict_forest_stacked(
+            *self._stacked, jnp.asarray(bins, jnp.int32),
+            self.trees[0].depth))
+
+    def compute(self, bins: np.ndarray) -> np.ndarray:
+        preds = self._forest_preds(bins)
         if self.spec.algorithm == "GBT":
             f = self.spec.init_score + self.spec.learning_rate * preds.sum(axis=0)
             if self.spec.loss == "log":

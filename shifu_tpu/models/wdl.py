@@ -118,11 +118,23 @@ def forward_logits(params: Dict, spec: WDLModelSpec, x_num, x_cat):
     serializes); large (scoring) batches keep the per-column gather."""
     n = x_num.shape[0] if spec.numeric_dim else x_cat.shape[0]
     tabs = params.get("embed") or params.get("wide_cat")
+    # compute dtype follows the weights (the bf16/mixed training ladder
+    # casts the whole param tree): activations run narrow, the logit
+    # accumulates in f32 so the sigmoid/loss keep f32 range.  f32 params
+    # leave the graph unchanged.
+    cdt = tabs[0].dtype if tabs else (
+        params["deep"][0]["w"].dtype if spec.deep_enable else jnp.float32)
+    if cdt != jnp.float32 and spec.numeric_dim:
+        x_num = x_num.astype(cdt)
     use_onehot = bool(tabs) and (
         x_cat.shape[0] * x_cat.shape[1]
         * max(t.shape[0] for t in tabs) <= _ONEHOT_MAX_ELEMS)
     oh = _cat_onehot(params, x_cat) if use_onehot else None
-    logit = jnp.zeros((n, 1)) + params["bias"]
+    if oh is not None and cdt != jnp.float32:
+        # 0/1 one-hot is exact in bf16; keeping it narrow keeps the
+        # lookup einsums' operands (and their grads) narrow too
+        oh = oh.astype(cdt)
+    logit = jnp.zeros((n, 1)) + params["bias"].astype(jnp.float32)
     if spec.deep_enable:
         parts = [x_num] if spec.numeric_dim else []
         if use_onehot:

@@ -105,9 +105,14 @@ MANIFEST: Dict[str, Tuple[str, str]] = {
                           "rows currently queued (set at each flush and "
                           "sampled into SERVE heartbeats/healthz — the "
                           "queue-buildup early warning)"),
-    "serve.bucket_occupancy": ("gauge",
-                               "real rows / bucket size of the last "
-                               "launch"),
+    "serve.bucket_occupancy": ("histogram",
+                               "real rows / bucket size per launch "
+                               "(p50/p99 land in metrics.prom; was a "
+                               "last-batch-only gauge before round 12)"),
+    "serve.bucket_rungs_added": ("counter",
+                                 "ladder rungs added by occupancy-"
+                                 "driven refinement (compiled ahead of "
+                                 "use)"),
     "serve.batch_latency_ms": ("histogram",
                                "oldest-request latency per batch"),
     # ---- live SLO plane (obs/slo; mirrored into metrics.prom each beat)

@@ -69,7 +69,14 @@ from typing import Any, Dict, List, Optional
 # ``serve.trace_sampled`` counter, SERVE heartbeats may carry
 # ``queue_depth`` / ``queue_buildup`` / ``slo`` extras, and monitor /
 # timeline learn multi-dir (cross-process) aggregation
-SCHEMA_VERSION = 8
+# v9: roofline speed round — ``serve.bucket_occupancy`` is a HISTOGRAM
+# (was a last-batch gauge; p50/p99 quantile lines land in metrics.prom),
+# ``serve.bucket_rungs_added`` counter (occupancy-driven ladder
+# refinement), ``pallas.tree_traverse`` analytic cost records (the
+# quantized uint8 traversal kernel is opaque to XLA cost analysis), and
+# the bench emits ``nn_train_mixed_*`` / ``serve_quantized_*`` extras
+# (mixed-precision ladder + quantized serving scorer)
+SCHEMA_VERSION = 9
 
 _TRUE = ("1", "true", "on", "yes")
 

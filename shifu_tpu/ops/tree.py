@@ -869,7 +869,12 @@ def predict_forest(trees, bins, weights=None) -> np.ndarray:
     are built by the caller).  Trees stack per depth group (continuous runs
     may append trees of a different depth).  Multiclass forests (2D
     ``leaf_value`` class distributions) average to [n, K]."""
-    bins = jnp.asarray(bins, jnp.int32)
+    bins = jnp.asarray(bins)
+    if not jnp.issubdtype(bins.dtype, jnp.integer):
+        bins = bins.astype(jnp.int32)
+    # integer bins keep their wire dtype (uint8 since PR 2): the gather
+    # traversal consumes the narrow plane directly — the widen here cost
+    # 4x the bytes of scoring's dominant operand
     k = trees[0].leaf_value.shape[1] if trees[0].leaf_value.ndim == 2 else 0
     shape = (len(trees), bins.shape[0], k) if k \
         else (len(trees), bins.shape[0])

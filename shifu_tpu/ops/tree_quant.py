@@ -1,0 +1,302 @@
+"""Quantized tree-traversal scoring: forests walked directly on uint8
+bin planes, f32 only at the leaf-value accumulate.
+
+Bins have been uint8 on the wire since PR 2 (the spill cache re-emits
+the compact dtype) and stay uint8 in HBM for the trainers — yet every
+SCORING traversal widened them to int32 at entry
+(``IndependentTreeModel.compute``, ``ops.tree.predict_forest``), so the
+serving plane's dominant operand cost 4x the bytes it carried.  This
+module keeps the whole walk narrow:
+
+- routing state is integer end-to-end: feature-index gather (uint8 bins,
+  int32 node ids), bin-subset membership test (uint8 left-mask planes),
+  child-index arithmetic — bit-identical to the f32/one-hot traversal in
+  :mod:`shifu_tpu.ops.tree` by construction (every decision is an exact
+  integer select; the one-hot form was itself exact);
+- f32 appears exactly once, at the terminal leaf-value gather.
+
+Two lowerings, dispatched like the histogram kernel
+(:mod:`shifu_tpu.ops.hist_pallas`):
+
+- a Pallas TPU kernel (``SHIFU_TREE_QUANT`` / property
+  ``shifu.tree.quantKernel``): grid (row-blocks x trees), the bins block
+  loaded into VMEM ONCE per row block and revisited across the whole
+  forest — where the XLA lowering re-streams the [N, C] plane per
+  (tree, level), the kernel pays the HBM read once.  Selects are one-hot
+  matmuls over 0/1 operands (exact at any precision — the
+  ``ops.tree._sel_exact`` argument), so the kernel lowers through the
+  MXU without gathers.  Tests drive it in interpret mode on CPU.
+- a jnp gather fallback (CPU / kernel off) that IS the narrow twin of
+  ``ops.tree.traverse_nodes``'s gather branch — same routing, uint8
+  operands.
+
+The kernel is opaque to XLA's cost analysis, so an analytic model
+registers under ``pallas.tree_traverse`` (the ``hist_kernel_cost``
+pattern) and the serving plane records one model launch per scored
+bucket — serving MFU rows stay honest.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache, partial
+from typing import Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+LANE = 128
+
+
+# ------------------------------------------------------------------ knobs
+@lru_cache(maxsize=None)
+def quant_scoring() -> bool:
+    """Use the quantized (uint8-narrow) scoring path at all.  Default ON —
+    routing is bit-identical to the classic traversal on every backend;
+    ``SHIFU_TREE_QUANT=0`` pins the old path (tests pin both)."""
+    env = os.environ.get("SHIFU_TREE_QUANT", "auto")
+    return env not in ("0", "off")
+
+
+@lru_cache(maxsize=None)
+def quant_kernel() -> bool:
+    """Lower the traversal through the Pallas kernel (TPU only; the
+    fallback serves CPU and kernel-off).  ``SHIFU_TREE_QUANT=force``
+    pins the kernel on (interpret mode off-TPU — tests); ``=0/off``
+    disables with the whole quant path."""
+    env = os.environ.get("SHIFU_TREE_QUANT", "auto")
+    if env in ("0", "off"):
+        return False
+    if env == "force":
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:                                  # pragma: no cover
+        return False
+
+
+def bins_fit_uint8(n_bins: int) -> bool:
+    """Whether a forest's bin ids ride uint8 (ids in [0, n_bins))."""
+    return n_bins <= 256
+
+
+def ensemble_bins_dtype(models: Sequence) -> np.dtype:
+    """The narrowest dtype an ensemble's bins input can ride: uint8 when
+    every bin-consuming model's id space fits a byte (tree forests with
+    n_bins <= 256 — the PR 2 wire contract — and WDL categorical
+    cardinalities <= 256), else int32.  Scoring batches then carry 1/4
+    the bin bytes across H2D and HBM."""
+    for m in models:
+        name = type(m).__name__
+        if name == "IndependentTreeModel":
+            if m.spec.n_bins > 256:
+                return np.dtype(np.int32)
+        elif getattr(m, "input_kind", "norm") == "both":
+            cards = getattr(m.spec, "cat_cardinalities", None) or []
+            if cards and max(cards) > 256:
+                return np.dtype(np.int32)
+    return np.dtype(np.uint8)
+
+
+# ------------------------------------------------------------ forest prep
+def stack_forest_quant(trees) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                       jnp.ndarray]:
+    """Same-depth trees stacked in the quantized layout: split_feat
+    [T, K] int32, left-mask planes [T, K, B] uint8 (1 = bin goes left),
+    leaf values [T, K] (or [T, K, S] multiclass) f32."""
+    sf = jnp.stack([jnp.asarray(t.split_feat, jnp.int32) for t in trees])
+    lm = jnp.stack([jnp.asarray(np.asarray(t.left_mask, np.uint8))
+                    for t in trees])
+    lv = jnp.stack([jnp.asarray(t.leaf_value, jnp.float32) for t in trees])
+    return sf, lm, lv
+
+
+# ------------------------------------------------------- fallback (jnp)
+def traverse_quant(split_feat, left_u8, bins, depth: int):
+    """Terminal global node id per row — the narrow gather walk.  bins
+    [N, C] any integer dtype (uint8 stays uint8: the gather consumes it
+    directly, no widen of the plane); split_feat [K] int32; left_u8
+    [K, B] uint8.  Routing is the gather branch of
+    ``ops.tree.traverse_nodes`` verbatim, so node ids — and therefore
+    scores — are bit-identical to the classic path."""
+    n = bins.shape[0]
+    node = jnp.zeros(n, jnp.int32)
+    for _ in range(depth):
+        feat = split_feat[node]
+        row_bin = jnp.take_along_axis(
+            bins, jnp.maximum(feat, 0)[:, None], axis=1)[:, 0] \
+            .astype(jnp.int32)
+        goes_left = left_u8[node, row_bin] > 0
+        child = jnp.where(goes_left, 2 * node + 1, 2 * node + 2)
+        node = jnp.where(feat >= 0, child, node)
+    return node
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def _predict_quant_ref(split_feats, left_u8s, leaf_values, bins,
+                       depth: int):
+    """[T, N] (or [T, N, S]) fallback forest predict: vmapped narrow
+    walks, one f32 leaf gather at the end."""
+    def one(sf, lm, lv):
+        return lv[traverse_quant(sf, lm, bins, depth)]
+    return jax.vmap(one)(split_feats, left_u8s, leaf_values)
+
+
+# --------------------------------------------------------- pallas kernel
+def _traverse_kernel(bins_ref, sf_ref, lm_ref, lv_ref, out_ref, *,
+                     depth: int, nblk: int, b_pad: int):
+    """One (row block, tree) cell: walk ``depth`` levels with level-local
+    one-hot selects (all 0/1 operands — exact), then the leaf-value dot.
+
+    bins_ref [C_pad, nblk] int32 (features on sublanes, rows on lanes —
+    the block is fetched from HBM once per row block and revisited
+    across the tree sweep); sf_ref/lv_ref [1, K_pad] f32; lm_ref
+    [1, K_pad, b_pad] f32 (0/1)."""
+    binsf = bins_ref[...].astype(jnp.float32)            # [C_pad, nblk]
+    c_pad = binsf.shape[0]
+    node = jnp.zeros((1, nblk), jnp.int32)               # global node ids
+    dims0 = (((0,), (0,)), ((), ()))                     # contract dim 0
+    mm = (((1,), (0,)), ((), ()))                        # plain matmul
+    for level in range(depth):
+        k = 1 << level
+        base = k - 1
+        loc = node - base                                # level-local
+        k_iota = jax.lax.broadcasted_iota(jnp.int32, (k, nblk), 0)
+        oh = (k_iota == loc).astype(jnp.float32)         # [k, nblk]
+        # feature id of each row's node: [1, k] x [k, nblk] one-term dot
+        feat = jax.lax.dot_general(
+            sf_ref[0:1, base:base + k], oh, mm,
+            preferred_element_type=jnp.float32)          # [1, nblk]
+        # row's bin at that feature: one-hot over the feature sublanes
+        c_iota = jax.lax.broadcasted_iota(jnp.float32, (c_pad, nblk), 0)
+        featoh = (c_iota == feat).astype(jnp.float32)
+        rb = (featoh * binsf).sum(axis=0, keepdims=True)  # [1, nblk]
+        # left-mask row select + bin membership, [B, nblk] oriented so
+        # every reduction runs over sublanes (no transposes)
+        lm_lvl = lm_ref[0, base:base + k, :]             # [k, b_pad]
+        lrow = jax.lax.dot_general(
+            lm_lvl, oh, dims0,
+            preferred_element_type=jnp.float32)          # [b_pad, nblk]
+        b_iota = jax.lax.broadcasted_iota(jnp.float32, (b_pad, nblk), 0)
+        binoh = (b_iota == rb).astype(jnp.float32)
+        goes_left = (lrow * binoh).sum(axis=0,
+                                       keepdims=True) > 0.5  # [1, nblk]
+        in_level = loc >= 0                              # frozen earlier?
+        is_split = in_level & (feat >= 0)
+        child = 2 * node + jnp.where(goes_left, 1, 2)
+        node = jnp.where(is_split, child, node)
+    k_total = sf_ref.shape[1]
+    k_iota = jax.lax.broadcasted_iota(jnp.int32, (k_total, nblk), 0)
+    oh = (k_iota == node).astype(jnp.float32)
+    out_ref[...] = jax.lax.dot_general(
+        lv_ref[0:1, :], oh, mm,
+        preferred_element_type=jnp.float32)              # [1, nblk]
+
+
+def _pad_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@partial(jax.jit, static_argnames=("depth", "interpret"))
+def _predict_quant_pallas(split_feats, left_u8s, leaf_values, bins,
+                          depth: int, interpret: bool = False):
+    """Kernel launch wrapper: pads/transposes operands to tile shapes
+    (bins widen to int32 per VMEM block, the ``hist_pallas`` convention —
+    uint8 in HBM, int32 only block-local) and trims the output."""
+    from jax.experimental import pallas as pl
+
+    t, k = split_feats.shape
+    n, c = bins.shape
+    b = left_u8s.shape[2]
+    nblk = LANE if n <= LANE else 4 * LANE
+    n_pad = _pad_to(n, nblk)
+    c_pad = _pad_to(c, 8)
+    k_pad = _pad_to(k, 8)
+    b_pad = _pad_to(b, 8)
+    binst = jnp.zeros((c_pad, n_pad), jnp.int32) \
+        .at[:c, :n].set(bins.astype(jnp.int32).T)
+    # split ids pad with -1 (leaf): pad rows route nowhere
+    sf = jnp.full((t, k_pad), -1.0, jnp.float32) \
+        .at[:, :k].set(split_feats.astype(jnp.float32))
+    lm = jnp.zeros((t, k_pad, b_pad), jnp.float32) \
+        .at[:, :k, :b].set(left_u8s.astype(jnp.float32))
+    lv = jnp.zeros((t, k_pad), jnp.float32).at[:, :k].set(leaf_values)
+    grid = (n_pad // nblk, t)
+    out = pl.pallas_call(
+        partial(_traverse_kernel, depth=depth, nblk=nblk, b_pad=b_pad),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((c_pad, nblk), lambda r, ti: (0, r)),
+            pl.BlockSpec((1, k_pad), lambda r, ti: (ti, 0)),
+            pl.BlockSpec((1, k_pad, b_pad), lambda r, ti: (ti, 0, 0)),
+            pl.BlockSpec((1, k_pad), lambda r, ti: (ti, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nblk), lambda r, ti: (ti, r)),
+        out_shape=jax.ShapeDtypeStruct((t, n_pad), jnp.float32),
+        interpret=interpret,
+    )(binst, sf, lm, lv)
+    return out[:, :n]
+
+
+# ------------------------------------------------------------- dispatch
+def _spans_devices(a) -> bool:
+    """True when ``a`` is sharded across >1 device — a pallas_call is
+    not partitionable, so such inputs must take the jnp fallback (which
+    GSPMD partitions like any other traversal)."""
+    try:
+        sh = getattr(a, "sharding", None)
+        return sh is not None and len(sh.device_set) > 1
+    except Exception:                                  # pragma: no cover
+        return False
+
+
+def predict_forest_quant(split_feats, left_u8s, leaf_values, bins,
+                         depth: int, use_kernel=None,
+                         interpret: bool = False):
+    """[T, N] forest predictions over the narrow plane — kernel on TPU
+    (or forced/interpret), jnp fallback elsewhere.  Multiclass leaf
+    distributions ([T, K, S]) and mesh-sharded bins always take the
+    fallback (the kernel's leaf dot is scalar-leaf shaped, and a
+    pallas_call cannot be partitioned)."""
+    if use_kernel is None:
+        use_kernel = quant_kernel() and not _spans_devices(bins)
+    if use_kernel and leaf_values.ndim == 2:
+        return _predict_quant_pallas(split_feats, left_u8s, leaf_values,
+                                     bins, depth, interpret)
+    return _predict_quant_ref(split_feats, left_u8s, leaf_values, bins,
+                              depth)
+
+
+# -------------------------------------------------- analytic cost model
+def quant_traverse_cost(rows: int, n_feat: int, n_bins: int,
+                        n_nodes: int, depth: int,
+                        n_trees: int = 1) -> dict:
+    """FLOPs / bytes of one traversal-kernel launch.
+
+    Per (tree, level k-wide): the feature dot (2*k*N), the feature
+    one-hot + bin select (~3*C*N), the mask dot (2*k*B*N) and the bin
+    membership reduce (~3*B*N); plus the terminal leaf dot (2*K*N).
+    Bytes: the uint8 bins plane read ONCE (the kernel's point — the XLA
+    lowering reads it per tree), per-tree node arrays and masks once,
+    [T, N] f32 out written once."""
+    lv_flops = 0.0
+    for level in range(depth):
+        k = 1 << level
+        lv_flops += 2.0 * k + 3.0 * n_feat + 2.0 * k * n_bins \
+            + 3.0 * n_bins
+    flops = float(rows) * n_trees * (lv_flops + 2.0 * n_nodes)
+    read = 1.0 * rows * n_feat \
+        + n_trees * (4.0 * n_nodes + 1.0 * n_nodes * n_bins
+                     + 4.0 * n_nodes)
+    write = 4.0 * n_trees * rows
+    return {"flops": flops, "bytes_accessed": read + write}
+
+
+def _register_cost_model() -> None:
+    from ..obs import costs
+    costs.register_cost_model("pallas.tree_traverse", quant_traverse_cost)
+
+
+_register_cost_model()

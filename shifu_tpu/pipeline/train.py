@@ -56,6 +56,9 @@ def settings_from_params(params: Dict[str, Any], train_conf,
         fixed_layers=tuple(int(v) for v in p.get("FixedLayers", []) or []),
         fixed_bias=bool(p.get("FixedBias", False)),
         matmul_precision=str(p.get("Precision", "") or ""),
+        # training-precision ladder (f32 | bf16 | mixed); "" defers to
+        # the -Dshifu.train.precision property, default f32
+        precision=str(p.get("TrainPrecision", "") or ""),
     )
 
 
@@ -517,7 +520,14 @@ class TrainProcessor(BasicProcessor):
                     member_classes = [k for _ in range(b0)
                                       for k in range(K)]
                     n_members = b0 * K
-                stream = ShardStream(shards, ("x", "y", "w"), window_rows)
+                # full-batch streams take the shape-stable remainder
+                # ladder (tail window shrinks instead of padding to W);
+                # the minibatch mode slices windows by fixed W-derived
+                # edges, so it keeps the uniform shape
+                stream = ShardStream(
+                    shards, ("x", "y", "w"), window_rows,
+                    remainder_multiple=data_size
+                    if settings.batch_size == 0 else 0)
                 init_list = self._continuous_init(spec, n_members, alg,
                                                   settings)
                 res = train_ensemble_streamed(

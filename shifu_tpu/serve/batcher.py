@@ -46,7 +46,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import faults, obs
-from .scorer import AOTScorer, covering_bucket
+from .scorer import AOTScorer, covering_bucket, refine_ladder
 
 log = logging.getLogger(__name__)
 
@@ -57,6 +57,17 @@ def configured_trace_sample_rate() -> float:
     from ..config import environment
     rate = environment.get_float("shifu.serve.traceSampleRate", 0.0)
     return min(max(rate, 0.0), 1.0)
+
+
+def configured_refine_every() -> int:
+    """Batches between occupancy-driven ladder refinements (property
+    ``shifu.serve.bucketRefineEvery``; 0 disables).  Default 512: often
+    enough to adapt to a load shift within seconds at serving rates,
+    rare enough that the (background, ahead-of-use) compiles are
+    noise."""
+    from ..config import environment
+    return max(0, environment.get_int("shifu.serve.bucketRefineEvery",
+                                      512))
 
 
 def _mint_trace_id() -> str:
@@ -172,6 +183,12 @@ class MicroBatcher:
             "requests": 0, "rows": 0, "batches": 0, "rows_padded": 0,
             "flush_full": 0, "flush_deadline": 0, "errors": 0}
         self.bucket_counts: Dict[int, int] = {}
+        # real batch row-counts (rows -> batches): the occupancy-driven
+        # ladder refinement's evidence (refine_ladder); keys are bounded
+        # by the top rung
+        self.size_counts: Dict[int, int] = {}
+        self.refine_every = configured_refine_every()
+        self._refining = False
 
     # ------------------------------------------------------------ submit
     def submit(self, row: np.ndarray, bins: Optional[np.ndarray] = None,
@@ -308,6 +325,7 @@ class MicroBatcher:
         err: Optional[BaseException] = None
         mean = None
         bucket = n
+        scorer = None
         # assembly stays INSIDE the try: mismatched row widths across
         # bursts, a missing bins array, or a provider failure must fail
         # this batch's tickets, not escape into the worker loop
@@ -348,12 +366,20 @@ class MicroBatcher:
             self.stats["rows_padded"] += pad
             self.bucket_counts[bucket] = \
                 self.bucket_counts.get(bucket, 0) + 1
+            self.size_counts[n] = self.size_counts.get(n, 0) + 1
+            batches_now = self.stats["batches"]
             if err is not None:
                 self.stats["errors"] += 1
         obs.counter("serve.batches").inc()
         obs.counter("serve.rows_scored").inc(n)
         obs.counter("serve.rows_padded").inc(pad)
-        obs.gauge("serve.bucket_occupancy").set(n / bucket)
+        # histogram, not gauge: a gauge only ever showed the LAST batch's
+        # occupancy — the report now carries the p50/p99 of the whole
+        # distribution (metrics.prom quantile lines, PR 10)
+        obs.histogram("serve.bucket_occupancy").observe(n / bucket)
+        if err is None and self.refine_every \
+                and batches_now % self.refine_every == 0:
+            self._maybe_refine(scorer)
         if self.slo is not None:
             if err is not None:
                 self.slo.record_errors(n)
@@ -374,6 +400,36 @@ class MicroBatcher:
         obs.histogram("serve.batch_latency_ms").observe(
             (now - oldest) * 1000.0)
         return n
+
+    def _maybe_refine(self, scorer) -> None:
+        """Occupancy-driven ladder refinement (every ``refine_every``
+        batches): propose tighter rungs from the observed batch-size
+        distribution and grow the scorer's ladder on a BACKGROUND
+        thread — each new rung compiles and warms before it is
+        published, so the serving loop never waits on a compile and the
+        zero-recompile contract holds.  Test doubles without
+        ``extend_buckets`` are skipped."""
+        if scorer is None or self._refining \
+                or not hasattr(scorer, "extend_buckets"):
+            return
+        with self._cond:
+            counts = dict(self.size_counts)
+        refined = refine_ladder(scorer.buckets, counts)
+        if tuple(refined) == tuple(sorted(scorer.buckets)):
+            return
+        self._refining = True
+
+        def grow() -> None:
+            try:
+                scorer.extend_buckets(refined)
+            except Exception:           # noqa: BLE001 — advisory path
+                log.exception("bucket-ladder refinement failed; ladder "
+                              "unchanged")
+            finally:
+                self._refining = False
+
+        threading.Thread(target=grow, daemon=True,
+                         name="shifu-serve-ladder").start()
 
     def _emit_trace_spans(self, parts, traced, batch_index: int,
                           bucket: int, n: int, pad: int, reason: str,

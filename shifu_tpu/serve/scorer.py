@@ -61,6 +61,52 @@ def covering_bucket(buckets: Sequence[int], n: int) -> int:
     return buckets[-1]
 
 
+def refine_ladder(buckets: Sequence[int], size_counts: dict,
+                  max_extra: int = 2, min_share: float = 0.2,
+                  occupancy_target: float = 0.8,
+                  multiple: int = 8) -> Tuple[int, ...]:
+    """Occupancy-driven rung refinement: given the observed distribution
+    of real batch row-counts (``size_counts``: rows -> batches), propose
+    intermediate rungs under rungs that systematically pad.
+
+    A rung qualifies when it carries at least ``min_share`` of observed
+    batches AND the p95 of its real batch sizes — rounded up to
+    ``multiple`` — lands below ``occupancy_target`` of the rung: most of
+    its traffic then pads to the tighter rung instead.  At most
+    ``max_extra`` rungs are added per refinement (bounded compile
+    budget) and existing rungs are NEVER removed, so every in-flight
+    ``covering_bucket`` decision stays valid and already-compiled
+    executables keep serving — the zero-recompile contract is untouched
+    because a new rung compiles (a NEW executable name, first
+    signature) before any batch pads to it."""
+    buckets = tuple(sorted(set(int(b) for b in buckets)))
+    total = sum(size_counts.values())
+    if not total:
+        return buckets
+    per_rung: dict = {b: [] for b in buckets}
+    for n, cnt in size_counts.items():
+        per_rung[covering_bucket(buckets, int(n))].append((int(n), cnt))
+    proposals = []
+    for b, sizes in per_rung.items():
+        if b == buckets[0]:
+            continue                    # nothing tighter to offer
+        carried = sum(c for _, c in sizes)
+        if carried / total < min_share:
+            continue
+        cum, p95 = 0, b
+        for n, c in sorted(sizes):
+            cum += c
+            if cum >= 0.95 * carried:
+                p95 = n
+                break
+        rung = min(b, ((p95 + multiple - 1) // multiple) * multiple)
+        if 0 < rung < occupancy_target * b and rung not in buckets:
+            proposals.append((carried, rung))
+    extra = sorted(r for _, r in
+                   sorted(proposals, reverse=True)[:max_extra])
+    return tuple(sorted(set(buckets) | set(extra)))
+
+
 def infer_dims(models: Sequence) -> Tuple[int, int]:
     """(n_features, n_bin_cols) the ensemble's inputs must provide,
     derived from the saved specs — what startup warming compiles
@@ -92,18 +138,33 @@ def infer_dims(models: Sequence) -> Tuple[int, int]:
 
 def _tree_column(m) -> Callable:
     """Device-traceable score column for a saved forest — the jnp twin of
-    ``IndependentTreeModel.compute`` (same f32 link math, no host hop)."""
+    ``IndependentTreeModel.compute`` (same f32 link math, no host hop).
+
+    The traversal is the QUANTIZED one by default (``ops.tree_quant``):
+    bins walk in their uint8 wire dtype with f32 only at the leaf
+    accumulate — bit-identical scores, 1/4 the bytes on serving's
+    dominant operand, and the Pallas kernel on TPU loads each row block
+    once for the whole forest instead of once per (tree, level)."""
     import jax
     import jax.numpy as jnp
 
+    from ..ops import tree_quant as tq
     from ..ops.tree import predict_forest_stacked, stack_forest
 
-    stacked = stack_forest(m.trees)
     depth = m.trees[0].depth
     spec = m.spec
+    quant = tq.quant_scoring() and tq.bins_fit_uint8(spec.n_bins)
+    if quant:
+        qarrays = tq.stack_forest_quant(m.trees)
+    else:
+        stacked = stack_forest(m.trees)
 
     def col(x, bins):
-        preds = predict_forest_stacked(*stacked, bins, depth)
+        if quant:
+            b = bins if bins.dtype == jnp.uint8 else bins.astype(jnp.uint8)
+            preds = tq.predict_forest_quant(*qarrays, b, depth)
+        else:
+            preds = predict_forest_stacked(*stacked, bins, depth)
         if spec.algorithm == "GBT":
             f = spec.init_score + spec.learning_rate * preds.sum(axis=0)
             if spec.loss == "log":
@@ -213,10 +274,32 @@ class AOTScorer:
                  buckets: Optional[Sequence[int]] = None,
                  name: str = "serve.score"):
         import jax
+
+        from ..ops import tree_quant as tq
         self.scorer = Scorer(models, scale)
         self.buckets = tuple(sorted(set(buckets or bucket_ladder())))
         self.name = name
         self.n_features, self.n_bins_cols = infer_dims(models)
+        # requests carry bins in the narrowest dtype the ensemble admits
+        # (uint8 wire contract) — quant off pins the old int32 signature
+        self.bins_dtype = tq.ensemble_bins_dtype(models) \
+            if tq.quant_scoring() else np.dtype(np.int32)
+        # analytic kernel launches for the cost plane: the Pallas
+        # traversal is opaque to XLA's cost analysis, so each scored
+        # bucket records one model launch per quant-kernel forest
+        # (serving MFU rows stay honest — the hist_kernel_cost pattern)
+        self._quant_kernel_shapes = []
+        if tq.quant_scoring() and tq.quant_kernel():
+            for m in models:
+                if type(m).__name__ == "IndependentTreeModel" \
+                        and tq.bins_fit_uint8(m.spec.n_bins):
+                    from ..ops.tree import n_tree_nodes
+                    self._quant_kernel_shapes.append(dict(
+                        n_feat=self.n_bins_cols,
+                        n_bins=m.spec.n_bins,
+                        n_nodes=n_tree_nodes(m.trees[0].depth),
+                        depth=m.trees[0].depth,
+                        n_trees=len(m.trees)))
         fn, self.needs_bins = build_ensemble_fn(self.scorer)
         # donated input buffers: the padded batch is dead the moment the
         # launch reads it, so XLA may overwrite it in place (CPU's PJRT
@@ -250,7 +333,7 @@ class AOTScorer:
         if not self.needs_bins:
             return (x,)
         return (x, jax.ShapeDtypeStruct((bucket, self.n_bins_cols),
-                                        np.int32))
+                                        self.bins_dtype))
 
     def _ensure_compiled(self, bucket: int):
         ent = self._compiled.get(bucket)
@@ -281,13 +364,36 @@ class AOTScorer:
         executable once so first-request latency pays no dispatch-path
         lazy init either."""
         for b in self.buckets:
-            exe, _ = self._ensure_compiled(b)
-            if launch:
-                args = [np.zeros((b, self.n_features), np.float32)]
-                if self.needs_bins:
-                    args.append(np.zeros((b, self.n_bins_cols), np.int32))
-                import jax
-                jax.block_until_ready(exe(*args))
+            self._warm_one(b, launch)
+
+    def _warm_one(self, bucket: int, launch: bool = True) -> None:
+        exe, _ = self._ensure_compiled(bucket)
+        if launch:
+            args = [np.zeros((bucket, self.n_features), np.float32)]
+            if self.needs_bins:
+                args.append(np.zeros((bucket, self.n_bins_cols),
+                                     self.bins_dtype))
+            import jax
+            jax.block_until_ready(exe(*args))
+
+    def extend_buckets(self, new_buckets: Sequence[int]) -> int:
+        """Grow the ladder with occupancy-refined rungs (see
+        :func:`refine_ladder`).  Every new rung compiles AND launches
+        once BEFORE it is published, so the first real batch that pads
+        to it pays a warm dispatch — compiling ahead of use is what
+        keeps the zero-recompile contract intact.  Existing rungs are
+        never removed.  Returns the number of rungs added."""
+        add = [int(b) for b in sorted(set(new_buckets))
+               if int(b) > 0 and int(b) not in self.buckets]
+        for b in add:
+            self._warm_one(b)
+        if add:
+            with self._lock:
+                self.buckets = tuple(sorted(set(self.buckets) | set(add)))
+            from .. import obs
+            obs.counter("serve.bucket_rungs_added").inc(len(add))
+            log.info("%s: ladder refined to %s", self.name, self.buckets)
+        return len(add)
 
     # the batcher's request tracer may pass ``timings=`` (duck-checked —
     # test doubles wrapping this class need not support it)
@@ -335,8 +441,11 @@ class AOTScorer:
             if bins is None:
                 raise ValueError("ensemble contains bin-consuming models "
                                  "— requests must carry bins")
-            args.append(np.ascontiguousarray(bins, np.int32))
+            args.append(np.ascontiguousarray(bins, self.bins_dtype))
         costs.get_cost_registry().launch(f"{self.name}.b{bucket}", sig)
+        for kw in self._quant_kernel_shapes:
+            costs.record_model_launch("pallas.tree_traverse",
+                                      rows=bucket, **kw)
         if timings is None:
             return np.asarray(exe(*args))[:n]
         t2 = _time.perf_counter()

@@ -28,6 +28,8 @@ metrics exporter mirrors the same numbers into ``metrics.prom``, and a
 ``stop()`` flushes any sampled request spans to the telemetry trace.
 
 Knobs: ``-Dshifu.serve.buckets`` (bucket ladder),
+``-Dshifu.serve.bucketRefineEvery`` (batches between occupancy-driven
+ladder refinements, 0 = off),
 ``-Dshifu.serve.maxDelayMs`` (deadline flush, default 2 ms),
 ``-Dshifu.serve.traceSampleRate`` (head sampling, default 0),
 ``-Dshifu.serve.sloP99Ms`` / ``-Dshifu.serve.sloAvailability``
@@ -168,10 +170,19 @@ class ServeServer:
         return t.wait(timeout)
 
     def swap(self, models_or_dir) -> None:
-        """Promote a retrained model without dropping requests."""
+        """Promote a retrained model without dropping requests.  The
+        candidate's ladder is the live ladder REFINED against the
+        observed batch-size distribution (:func:`refine_ladder`), so a
+        swap is also the natural point where padding waste learned
+        during this generation's traffic is squeezed out — every rung
+        (inherited and refined) compiles and warms during the swap's
+        BUILD phase, before the flip."""
+        from .scorer import refine_ladder
         scorer = self.registry.get(self.key)
+        with self.batcher._cond:
+            counts = dict(self.batcher.size_counts)
         self.registry.swap(self.key, models_or_dir,
-                           buckets=scorer.buckets)
+                           buckets=refine_ladder(scorer.buckets, counts))
 
     def status(self) -> dict:
         scorer = self.registry.get(self.key)

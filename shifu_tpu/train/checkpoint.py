@@ -29,8 +29,17 @@ log = logging.getLogger(__name__)
 _NAME = re.compile(r"ckpt-(\d+)\.npz$")
 
 
-def save_state(directory: str, epoch: int, state: Any, keep: int = 3) -> str:
-    """state: arbitrary pytree of arrays (params, opt_state, rng key...)."""
+def save_state(directory: str, epoch: int, state: Any, keep: int = 3,
+               precision: Optional[str] = None) -> str:
+    """state: arbitrary pytree of arrays (params, opt_state, rng key...).
+
+    ``precision`` tags the checkpoint with the training-precision mode
+    it was written under (``shifu.train.precision``); restore refuses a
+    mismatched tag with a coded error instead of silently casting.
+    Leaves in dtypes npz cannot round-trip natively (bfloat16 — numpy
+    reloads the ml_dtypes descriptor as a V2 void) are stored as their
+    uint16 bit pattern and viewed back on restore; the per-leaf dtype
+    names ride in the meta record so restore is bit-exact."""
     os.makedirs(directory, exist_ok=True)
     # sweep orphaned tmp files a previous crash left mid-rename — they
     # are never valid checkpoints and would otherwise accumulate forever
@@ -41,9 +50,19 @@ def save_state(directory: str, epoch: int, state: Any, keep: int = 3) -> str:
             except OSError:
                 pass
     leaves, treedef = jax.tree_util.tree_flatten(state)
-    arrays = {f"leaf{i}": np.asarray(l) for i, l in enumerate(leaves)}
-    arrays["__meta__"] = np.frombuffer(json.dumps(
-        {"epoch": epoch, "n_leaves": len(leaves)}).encode(), np.uint8)
+    arrays = {}
+    dtypes = []
+    for i, l in enumerate(leaves):
+        a = np.asarray(l)
+        dtypes.append(str(a.dtype))
+        if a.dtype.kind not in "biufc":
+            # ml_dtypes leaf (bfloat16): same-width integer bit pattern
+            a = a.view(np.uint16 if a.dtype.itemsize == 2 else np.uint8)
+        arrays[f"leaf{i}"] = a
+    meta = {"epoch": epoch, "n_leaves": len(leaves), "dtypes": dtypes}
+    if precision is not None:
+        meta["precision"] = precision
+    arrays["__meta__"] = np.frombuffer(json.dumps(meta).encode(), np.uint8)
     path = os.path.join(directory, f"ckpt-{epoch}.npz")
     buf = io.BytesIO()
     np.savez(buf, **arrays)
@@ -63,19 +82,41 @@ def latest_epoch(directory: str) -> Optional[int]:
     return max(epochs) if epochs else None
 
 
-def restore_state(directory: str, template: Any) -> Optional[Tuple[int, Any]]:
+def restore_state(directory: str, template: Any,
+                  expect_precision: Optional[str] = None
+                  ) -> Optional[Tuple[int, Any]]:
     """Load the latest checkpoint onto ``template``'s structure.  Returns
-    (epoch, state) or None; shape mismatch (config changed) -> None."""
+    (epoch, state) or None; shape mismatch (config changed) -> None.
+
+    ``expect_precision`` enforces the precision-mode handshake: a
+    checkpoint tagged (or implicitly) under a DIFFERENT
+    ``shifu.train.precision`` raises
+    :class:`~shifu_tpu.config.errors.ShifuError`
+    (``ERROR_CHECKPOINT_PRECISION_MISMATCH``) — resuming an f32
+    checkpoint under ``mixed`` (or vice versa) must fail loudly, never
+    silently cast the master copy.  Untagged (pre-round-12) checkpoints
+    count as ``f32``."""
     epoch = latest_epoch(directory)
     if epoch is None:
         return None
     data = np.load(os.path.join(directory, f"ckpt-{epoch}.npz"))
     meta = json.loads(bytes(data["__meta__"]).decode())
+    if expect_precision is not None:
+        found = meta.get("precision") or "f32"
+        if found != expect_precision:
+            from ..config.errors import ErrorCode, ShifuError
+            raise ShifuError(
+                ErrorCode.ERROR_CHECKPOINT_PRECISION_MISMATCH,
+                f"checkpoint {directory}/ckpt-{epoch}.npz was written "
+                f"under precision={found!r} but this run trains under "
+                f"precision={expect_precision!r} — restart from scratch "
+                "or set shifu.train.precision back")
     leaves, treedef = jax.tree_util.tree_flatten(template)
     if meta["n_leaves"] != len(leaves):
         log.warning("checkpoint %d has %d leaves, template %d — ignoring",
                     epoch, meta["n_leaves"], len(leaves))
         return None
+    saved_dtypes = meta.get("dtypes")
     new_leaves = []
     for i, tmpl in enumerate(leaves):
         a = data[f"leaf{i}"]
@@ -85,13 +126,19 @@ def restore_state(directory: str, template: Any) -> Optional[Tuple[int, Any]]:
             return None
         tmpl_dt = np.dtype(getattr(tmpl, "dtype", None)
                            or np.asarray(tmpl).dtype)
-        if a.dtype != tmpl_dt:
+        # the dtype the leaf was SAVED as (pre-round-12 checkpoints have
+        # no dtypes record; the on-disk dtype is then authoritative)
+        saved_dt = saved_dtypes[i] if saved_dtypes else str(a.dtype)
+        if saved_dt != str(tmpl_dt):
             # shape-only acceptance silently CAST the restored leaves
             # (e.g. an f32 checkpoint onto an int opt-state slot) — a
             # config change this subtle must fall back to fresh init
             log.warning("checkpoint leaf %d dtype %s != template %s — "
-                        "ignoring checkpoint", i, a.dtype, tmpl_dt)
+                        "ignoring checkpoint", i, saved_dt, tmpl_dt)
             return None
+        if a.dtype != tmpl_dt:
+            # narrow ml_dtypes leaf stored as its integer bit pattern
+            a = a.view(tmpl_dt)
         new_leaves.append(a)
     return meta["epoch"], jax.tree_util.tree_unflatten(treedef, new_leaves)
 

@@ -655,10 +655,22 @@ def train_gbt(bins, y, w, n_bins: int, cat_mask, settings: DTSettings,
                                np.asarray(init_scores, np.float32))
     else:
         f = jnp.full(bins_d.shape[0], init_score, jnp.float32)
-        for t in trees:  # continuous training: replay existing trees
-            f = f + settings.learning_rate * predict_tree(
-                jnp.asarray(t.split_feat), jnp.asarray(t.left_mask),
-                jnp.asarray(t.leaf_value), bins_d, t.depth)
+        from ..ops import tree_quant as tq
+        if trees and tq.quant_scoring() and tq.bins_fit_uint8(n_bins) \
+                and len({t.depth for t in trees}) == 1:
+            # continuous-training replay: ONE batched quantized traversal
+            # over the uint8-resident plane instead of a per-tree predict
+            # loop; the per-tree adds keep the eager loop's summation
+            # order, so the restored f stays bit-identical to it
+            preds = tq.predict_forest_quant(
+                *tq.stack_forest_quant(trees), bins_d, trees[0].depth)
+            for i in range(len(trees)):
+                f = f + settings.learning_rate * preds[i]
+        else:
+            for t in trees:  # heterogeneous depths: per-tree replay
+                f = f + settings.learning_rate * predict_tree(
+                    jnp.asarray(t.split_feat), jnp.asarray(t.left_mask),
+                    jnp.asarray(t.leaf_value), bins_d, t.depth)
 
     stopper = GBTEarlyStopDecider()
     history: List[Tuple[float, float]] = list(start_history or [])

@@ -33,7 +33,8 @@ from .. import obs
 from ..models import nn as nn_model
 from ..parallel import mesh as meshlib
 from .early_stop import WindowEarlyStop
-from .optimizers import make_optimizer
+from .optimizers import (cast_tree, make_optimizer, mixed_apply,
+                         mixed_init, resolve_precision)
 
 log = logging.getLogger(__name__)
 
@@ -58,7 +59,9 @@ class TrainSettings:
     fixed_layers: Tuple[int, ...] = () # 1-based layer ids frozen during
     fixed_bias: bool = False           # continuous training (NNMaster
     matmul_precision: str = ""         # FIXED_LAYERS); ""=backend default,
-    opt_kwargs: Dict[str, Any] = field(default_factory=dict)  # bfloat16=MXU
+    precision: str = ""                # bfloat16=MXU.  precision: f32|
+    opt_kwargs: Dict[str, Any] = field(default_factory=dict)  # bf16|mixed
+                                       # ("" = shifu.train.precision)
 
 
 @dataclass
@@ -286,8 +289,17 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
                             for k in keys]
     opt = make_optimizer(settings.optimizer, settings.learning_rate,
                          **settings.opt_kwargs)
+    # ---- precision ladder (shifu.train.precision): bf16/mixed cast the
+    # training params narrow; mixed keeps the f32 master in the opt state
+    precision = resolve_precision(settings.precision)
+    if precision != "f32":
+        init_params_list = [cast_tree(p, jnp.bfloat16)
+                            for p in init_params_list]
     stacked = _stack(init_params_list)
-    opt_state = _stack([opt.init(p) for p in init_params_list])
+    if precision == "mixed":
+        opt_state = _stack([mixed_init(opt, p) for p in init_params_list])
+    else:
+        opt_state = _stack([opt.init(p) for p in init_params_list])
 
     from jax.sharding import NamedSharding, PartitionSpec as P
     sh_ens = NamedSharding(mesh, P("ensemble"))
@@ -342,9 +354,20 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
             l1=settings.l1 if uniform else h[2],
             dropout_rate=settings.dropout_rate if uniform else h[3],
             rng=rng if dropout > 0 else None)
+        if precision == "mixed":
+            # bf16 grads widen once; the rule steps the f32 master and
+            # the bf16 training copy is one rounding of it
+            params, opt_state = mixed_apply(opt, grads, opt_state,
+                                            scale=lr_scale * h[0],
+                                            freeze=_freeze)
+            return params, opt_state, loss
         delta, opt_state = opt.update(grads, opt_state, params)
+        # apply in the PARAM dtype: the f32-strong lr_scale tracer would
+        # otherwise silently widen a bf16 ladder back to f32 (no-op for
+        # f32 params)
         params = jax.tree_util.tree_map(
-            lambda p, d: p + d * (lr_scale * h[0]), params, _freeze(delta))
+            lambda p, d: p + (d * (lr_scale * h[0])).astype(p.dtype),
+            params, _freeze(delta))
         return params, opt_state, loss
 
     y_axis = None if ymd is None else 0    # per-member targets vmap over B
@@ -404,7 +427,8 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
         from . import checkpoint as ckpt
         restored = ckpt.restore_state(
             settings.checkpoint_dir,
-            _ckpt_template(stacked, opt_state, key, bags))
+            _ckpt_template(stacked, opt_state, key, bags),
+            expect_precision=precision)
         if restored is not None:
             start_epoch, state = restored
             stacked = jax.device_put(state[0], sh_ens)
@@ -514,7 +538,8 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
             ckpt.save_state(settings.checkpoint_dir, epoch + 1,
                             _ckpt_state(stacked, opt_state, key,
                                         best_valid, best_train,
-                                        best_params, stops))
+                                        best_params, stops),
+                            precision=precision)
         if stop_now:
             obs.event("early_stop", trainer="nn", epoch=epoch,
                       window=settings.early_stop_window)
@@ -616,8 +641,15 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
                             for k in keys]
     opt = make_optimizer(settings.optimizer, settings.learning_rate,
                          **settings.opt_kwargs)
+    precision = resolve_precision(settings.precision)
+    if precision != "f32":
+        init_params_list = [cast_tree(p, jnp.bfloat16)
+                            for p in init_params_list]
     stacked = _stack(init_params_list)
-    opt_state = _stack([opt.init(p) for p in init_params_list])
+    if precision == "mixed":
+        opt_state = _stack([mixed_init(opt, p) for p in init_params_list])
+    else:
+        opt_state = _stack([opt.init(p) for p in init_params_list])
     sh_ens = NamedSharding(mesh, P("ensemble"))
     sh_x = NamedSharding(mesh, P("data", None))
     sh_y = NamedSharding(mesh, P("data"))
@@ -677,9 +709,14 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
                        + l1 * jnp.sign(pl["w"]),
                   "b": gl["b"] * inv}
                  for gl, pl in zip(grads, params)]
+            if precision == "mixed":
+                # accumulated-f32 grads step the f32 master; the bf16
+                # training copy is one rounding of the new master
+                return mixed_apply(opt, g, ostate, scale=lr_scale)
             delta, ostate = opt.update(g, ostate, params)
-            params = jax.tree_util.tree_map(lambda p, d: p + d * lr_scale,
-                                            params, delta)
+            params = jax.tree_util.tree_map(
+                lambda p, d: p + (d * lr_scale).astype(p.dtype),
+                params, delta)
             return params, ostate
         return jax.vmap(one)(stacked, opt_state, grad_acc, train_wsum)
 
@@ -701,16 +738,25 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
                     + l2 * sum((layer["w"] ** 2).sum() for layer in p) \
                     + l1 * sum(jnp.abs(layer["w"]).sum() for layer in p)
             grads = jax.grad(norm_loss)(params)
+            if precision == "mixed":
+                return mixed_apply(opt, grads, ostate, scale=lr_scale)
             delta, ostate = opt.update(grads, ostate, params)
-            params = jax.tree_util.tree_map(lambda p, d: p + d * lr_scale,
-                                            params, delta)
+            params = jax.tree_util.tree_map(
+                lambda p, d: p + (d * lr_scale).astype(p.dtype),
+                params, delta)
             return params, ostate
         cis = jnp.zeros(tw.shape[0]) if cls_arr is None else cls_arr
         return jax.vmap(one, in_axes=(0, 0, 0, 0, 0))(stacked, opt_state,
                                                       tw, rngs, cis)
 
+    # mixed accumulates the cross-window gradient sums in f32 (bf16
+    # accumulation over many windows loses low-order mass); jnp.add's
+    # bf16+f32 promotion keeps the accumulator f32 per window
     zero_grads = jax.device_put(
-        jax.tree_util.tree_map(jnp.zeros_like, stacked), sh_ens)
+        jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape,
+                                jnp.float32 if precision == "mixed"
+                                else a.dtype), stacked), sh_ens)
 
     full_batch = settings.batch_size == 0
     W = stream.window_rows
@@ -736,7 +782,8 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
         from . import checkpoint as ckpt
         restored = ckpt.restore_state(
             settings.checkpoint_dir,
-            _ckpt_template(stacked, opt_state, key, bags))
+            _ckpt_template(stacked, opt_state, key, bags),
+            expect_precision=precision)
         if restored is not None:
             start_epoch, state = restored
             stacked = jax.device_put(state[0], sh_ens)
@@ -839,7 +886,8 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
             ckpt.save_state(settings.checkpoint_dir, epoch + 1,
                             _ckpt_state(stacked, opt_state, key,
                                         best_valid, best_train,
-                                        best_params, stops))
+                                        best_params, stops),
+                            precision=precision)
         if settings.learning_decay > 0:
             lr_scale *= (1.0 - settings.learning_decay)
         if stopped:

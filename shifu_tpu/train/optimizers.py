@@ -181,6 +181,69 @@ def quickprop(learning_rate: float, mu: float = 1.75,
     return Optimizer(init, update)
 
 
+# ------------------------------------------------------ precision ladder
+# the -Dshifu.train.precision knob (ISSUE 11 / ROADMAP #5): "f32" keeps
+# today's math untouched; "bf16" trains entirely in bfloat16 (params,
+# activations, optimizer state — halves HBM and feeds the MXU native
+# rate, lossy); "mixed" is the production ladder: an f32 MASTER copy of
+# the params lives in the optimizer state, forward/backward run on the
+# bf16 cast (activations narrow), gradients cast back to f32 and the
+# update rule applied to the master — one bf16 rounding per step instead
+# of compounding rounding in the weights themselves.
+PRECISIONS = ("f32", "bf16", "mixed")
+
+
+def resolve_precision(setting: str = "") -> str:
+    """The effective training precision: an explicit trainer setting
+    wins, else the ``shifu.train.precision`` property, default ``f32``.
+    Unknown values fail loudly — a typo'd precision silently training
+    f32 would invalidate every bench row claiming otherwise."""
+    if not setting:
+        from ..config import environment
+        setting = environment.get_property("shifu.train.precision", "f32")
+    key = str(setting).lower()
+    if key not in PRECISIONS:
+        raise ValueError(f"unknown shifu.train.precision {setting!r}; "
+                         f"one of {PRECISIONS}")
+    return key
+
+
+def compute_dtype(precision: str):
+    """Param/activation dtype of the forward/backward pass."""
+    return jnp.float32 if precision == "f32" else jnp.bfloat16
+
+
+def cast_tree(tree, dtype):
+    """Cast every floating leaf of a pytree; integer/bool leaves (opt
+    step counters, masks) pass through untouched."""
+    return _tmap(lambda l: l.astype(dtype)
+                 if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+                 else l, tree)
+
+
+def mixed_init(opt: Optimizer, params_bf16):
+    """Mixed-precision optimizer state: the f32 master (exactly equal to
+    the bf16 params at init — the cast up is value-preserving) plus the
+    wrapped rule's own state built over the master."""
+    master = cast_tree(params_bf16, jnp.float32)
+    return {"master": master, "inner": opt.init(master)}
+
+
+def mixed_apply(opt: Optimizer, grads, state, scale=1.0, freeze=None):
+    """One mixed-precision update: bf16 grads widen to f32, the inner
+    rule steps the f32 master (``freeze`` optionally zeroes fixed-layer
+    deltas, ``scale`` is the trainer's lr decay/per-member factor), and
+    the new bf16 training params are ONE rounding of the new master.
+    Returns ``(params_bf16, state)``."""
+    g32 = cast_tree(grads, jnp.float32)
+    delta, inner = opt.update(g32, state["inner"], state["master"])
+    if freeze is not None:
+        delta = freeze(delta)
+    master = _tmap(lambda m, d: m + d * scale, state["master"], delta)
+    return cast_tree(master, jnp.bfloat16), \
+        {"master": master, "inner": inner}
+
+
 # ----------------------------------------------------------------- factory
 _RULES = {
     "ADAM": lambda lr, kw: adam(lr, **kw),

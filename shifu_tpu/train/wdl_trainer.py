@@ -32,7 +32,8 @@ from ..parallel import mesh as meshlib
 from .early_stop import WindowEarlyStop
 from .nn_trainer import (TrainSettings, _ckpt_state, _ckpt_template,
                          _restore_tracking, _stack, _to_host)
-from .optimizers import make_optimizer
+from .optimizers import (cast_tree, make_optimizer, mixed_apply,
+                         mixed_init, resolve_precision)
 from .sampling import member_masks
 
 log = logging.getLogger(__name__)
@@ -159,8 +160,17 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
     init_list = [wdl_model.init_params(k, spec) for k in keys]
     opt = make_optimizer(settings.optimizer, settings.learning_rate,
                          **settings.opt_kwargs)
+    # precision ladder (shifu.train.precision) — same contract as the NN
+    # trainer: bf16/mixed params train narrow, mixed keeps the f32
+    # master in the optimizer state
+    precision = resolve_precision(settings.precision)
+    if precision != "f32":
+        init_list = [cast_tree(p, jnp.bfloat16) for p in init_list]
     stacked = _stack(init_list)
-    opt_state = _stack([opt.init(p) for p in init_list])
+    if precision == "mixed":
+        opt_state = _stack([mixed_init(opt, p) for p in init_list])
+    else:
+        opt_state = _stack([opt.init(p) for p in init_list])
 
     sh_ens = NamedSharding(mesh, P("ensemble"))
     stacked = jax.device_put(stacked, sh_ens)
@@ -177,8 +187,14 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
     def member_update(params, ostate, xnb, xcb, yb, mw):
         loss, grads = jax.value_and_grad(wdl_model.weighted_loss)(
             params, spec, xnb, xcb, yb[:, None], mw, l2)
+        if precision == "mixed":
+            params, ostate = mixed_apply(opt, grads, ostate)
+            return params, ostate, loss
         delta, ostate = opt.update(grads, ostate, params)
-        params = jax.tree_util.tree_map(lambda p, d: p + d, params, delta)
+        # apply in the PARAM dtype (adam's f32 step counter would widen
+        # a bf16 ladder's delta; no-op for f32 params)
+        params = jax.tree_util.tree_map(
+            lambda p, d: p + d.astype(p.dtype), params, delta)
         return params, ostate, loss
 
     # cost-attributed wdl-plane entry points (obs/costs): the utilization
@@ -235,7 +251,8 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
         from . import checkpoint as ckpt
         restored = ckpt.restore_state(
             settings.checkpoint_dir,
-            _ckpt_template(stacked, opt_state, key, bags))
+            _ckpt_template(stacked, opt_state, key, bags),
+            expect_precision=precision)
         if restored is not None:
             start_epoch, state = restored
             stacked = jax.device_put(state[0], sh_ens)
@@ -296,7 +313,8 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
             ckpt.save_state(settings.checkpoint_dir, epoch + 1,
                             _ckpt_state(stacked, opt_state, key,
                                         best_valid, best_train,
-                                        best_params, stops))
+                                        best_params, stops),
+                            precision=precision)
         if stop_now:
             obs.event("early_stop", trainer="wdl", epoch=epoch,
                       window=settings.early_stop_window)
@@ -319,10 +337,14 @@ class ZippedPlanes:
     partitioning, asserted per window."""
 
     def __init__(self, norm_shards: Shards, clean_shards: Shards,
-                 window_rows: int):
+                 window_rows: int, remainder_multiple: int = 0):
         from ..data.streaming import ShardStream
-        self.norm = ShardStream(norm_shards, ("x", "y", "w"), window_rows)
-        self.clean = ShardStream(clean_shards, ("bins",), window_rows)
+        # both planes share one remainder ladder, so the zipped tail
+        # windows agree on their (possibly sub-W) padded shape
+        self.norm = ShardStream(norm_shards, ("x", "y", "w"), window_rows,
+                                remainder_multiple=remainder_multiple)
+        self.clean = ShardStream(clean_shards, ("bins",), window_rows,
+                                 remainder_multiple=remainder_multiple)
         self.window_rows = window_rows
 
     @property
@@ -359,9 +381,16 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
     init_list = [wdl_model.init_params(k, spec) for k in keys]
     opt = make_optimizer(settings.optimizer, settings.learning_rate,
                          **settings.opt_kwargs)
+    precision = resolve_precision(settings.precision)
+    if precision != "f32":
+        init_list = [cast_tree(p, jnp.bfloat16) for p in init_list]
     stacked = jax.device_put(_stack(init_list), sh_ens)
-    opt_state = jax.device_put(_stack([opt.init(p) for p in init_list]),
-                               sh_ens)
+    if precision == "mixed":
+        opt_state = jax.device_put(
+            _stack([mixed_init(opt, p) for p in init_list]), sh_ens)
+    else:
+        opt_state = jax.device_put(
+            _stack([opt.init(p) for p in init_list]), sh_ens)
     l2 = settings.l2
 
     def _loss_sum(params, xnb, xcb, yb, mw):
@@ -399,13 +428,21 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
                 # weights + embeddings only, never bias/wide
                 g = jax.tree_util.tree_map(
                     jnp.add, g, wdl_model.l2_grads(params, l2))
+            if precision == "mixed":
+                return mixed_apply(opt, g, ostate)
             delta, ostate = opt.update(g, ostate, params)
-            params = jax.tree_util.tree_map(lambda p, d: p + d, params, delta)
+            params = jax.tree_util.tree_map(
+                lambda p, d: p + d.astype(p.dtype), params, delta)
             return params, ostate
         return jax.vmap(one)(stacked, opt_state, grad_acc, train_wsum)
 
+    # mixed accumulates cross-window gradient sums in f32 (jnp.add's
+    # bf16+f32 promotion keeps the accumulator wide per window)
     zero_grads = jax.device_put(
-        jax.tree_util.tree_map(jnp.zeros_like, stacked), sh_ens)
+        jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape,
+                                jnp.float32 if precision == "mixed"
+                                else a.dtype), stacked), sh_ens)
 
     def put_window(win):
         x = win.arrays["x"].astype(np.float32)
@@ -463,7 +500,8 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
         from . import checkpoint as ckpt
         restored = ckpt.restore_state(
             settings.checkpoint_dir,
-            _ckpt_template(stacked, opt_state, key, bags))
+            _ckpt_template(stacked, opt_state, key, bags),
+            expect_precision=precision)
         if restored is not None:
             start_epoch, state = restored
             stacked = jax.device_put(state[0], sh_ens)
@@ -502,7 +540,8 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
             ckpt.save_state(settings.checkpoint_dir, epoch + 1,
                             _ckpt_state(stacked, opt_state, key,
                                         best_valid, best_train,
-                                        best_params, stops))
+                                        best_params, stops),
+                            precision=precision)
         if stopped:
             obs.event("early_stop", trainer="wdl_streamed", epoch=epoch,
                       window=settings.early_stop_window)
@@ -535,7 +574,8 @@ def _wdl_settings(mc, p: Dict[str, Any]) -> TrainSettings:
         batch_size=int(p.get("MiniBatchs", 128)),
         early_stop_window=int(p.get("WindowSize", 10))
         if mc.train.earlyStopEnable else 0,
-        seed=int(p.get("Seed", 0)))
+        seed=int(p.get("Seed", 0)),
+        precision=str(p.get("TrainPrecision", "") or ""))
 
 
 def run_wdl_training(proc) -> int:
@@ -576,7 +616,12 @@ def run_wdl_training(proc) -> int:
             d = len(schema.get("outputNames") or [])
             window_rows = stream_window_rows(6 * (d + 2), data_size,
                                              norm)
-            planes = ZippedPlanes(norm, clean, window_rows)
+            # WDL streams full-batch: the remainder ladder shrinks the
+            # tail window instead of padding it to full W (sub-rungs stay
+            # data_size multiples, so sharding divides; at most one extra
+            # compiled shape per run)
+            planes = ZippedPlanes(norm, clean, window_rows,
+                                  remainder_multiple=data_size)
             # plane split derives from schema + ColumnConfig alone — no
             # window read needed
             num_feat_idx, cat_col_idx, num_nums, cat_nums = \

@@ -1,0 +1,216 @@
+"""Mixed-precision training ladder (``shifu.train.precision``) — the
+bounded-AUC and checkpoint contracts of the round-12 speed round.
+
+- ``mixed`` (bf16 forward/backward, f32 master in the optimizer state)
+  must train NN and WDL to within a PINNED |dAUC| of the f32 run on the
+  shared prepared_set fixture — the acceptance bound for every
+  precision change;
+- a ``mixed`` checkpoint resumes BIT-exact (bf16 params + f32 master +
+  optimizer state dtypes all preserved through the uint16-view npz
+  round trip);
+- an f32 checkpoint loaded under ``mixed`` fails with the coded
+  ``ERROR_CHECKPOINT_PRECISION_MISMATCH`` — never a silent cast.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from shifu_tpu.config.errors import ErrorCode, ShifuError
+from shifu_tpu.models.nn import NNModelSpec
+from shifu_tpu.train import checkpoint as ckpt
+from shifu_tpu.train.nn_trainer import TrainSettings, train_ensemble
+from shifu_tpu.train.optimizers import resolve_precision
+from shifu_tpu.train.sampling import member_masks
+
+pytestmark = pytest.mark.perf
+
+# the pinned bounded-AUC epsilon: a mixed run may differ from f32 by
+# bf16 rounding noise, never by model quality
+EPS_AUC = 0.01
+
+
+def _pipeline_auc(model_set: str, alg, params: dict, epochs: int = 8):
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.pipeline.evaluate import EvalProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+
+    mc_path = os.path.join(model_set, "ModelConfig.json")
+    mc = ModelConfig.load(mc_path)
+    mc.train.algorithm = alg
+    mc.train.numTrainEpochs = epochs
+    mc.train.params = params
+    mc.save(mc_path)
+    assert TrainProcessor(model_set, params={}).run() == 0
+    assert EvalProcessor(model_set, params={"run_eval": ""}).run() == 0
+    perf = json.load(open(os.path.join(model_set, "evals", "Eval1",
+                                       "EvalPerformance.json")))
+    return float(perf["areaUnderRoc"])
+
+
+def test_nn_mixed_bounded_auc(prepared_set):
+    from shifu_tpu.config.model_config import Algorithm
+    base = {"NumHiddenNodes": [16], "ActivationFunc": ["relu"],
+            "LearningRate": 0.01, "Propagation": "ADAM",
+            "MiniBatchs": 512}
+    auc_f32 = _pipeline_auc(prepared_set, Algorithm.NN, dict(base))
+    auc_mixed = _pipeline_auc(prepared_set, Algorithm.NN,
+                              dict(base, TrainPrecision="mixed"))
+    assert auc_f32 > 0.7                     # the run actually learned
+    assert abs(auc_f32 - auc_mixed) <= EPS_AUC
+
+
+def test_wdl_mixed_bounded_auc(prepared_set):
+    from shifu_tpu.config.model_config import Algorithm
+    base = {"NumHiddenNodes": [16], "ActivationFunc": ["relu"],
+            "EmbedDim": 4, "LearningRate": 0.01, "MiniBatchs": 512}
+    auc_f32 = _pipeline_auc(prepared_set, Algorithm.WDL, dict(base))
+    auc_mixed = _pipeline_auc(prepared_set, Algorithm.WDL,
+                              dict(base, TrainPrecision="mixed"))
+    assert auc_f32 > 0.7
+    assert abs(auc_f32 - auc_mixed) <= EPS_AUC
+
+
+def _toy(n=1000, d=8, bags=2, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d) / np.sqrt(d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(x @ w)))).astype(np.float32)
+    tw, vw = member_masks(n, bags, valid_rate=0.2, seed=seed)
+    spec = NNModelSpec(input_dim=d, hidden_nodes=[6],
+                       activations=["tanh"])
+    return x, y, tw, vw, spec
+
+
+def _settings(td, name, **kw):
+    return TrainSettings(optimizer="ADAM", learning_rate=0.01,
+                         checkpoint_dir=os.path.join(td, name),
+                         checkpoint_every=4, **kw)
+
+
+def test_mixed_checkpoint_resume_bit_exact(tmp_path):
+    """Crash at epoch 4, resume to 8 — every bf16 param of every member
+    must equal the uninterrupted run's BIT for BIT (master copy + opt
+    state ride the checkpoint, so the resumed trajectory is exact)."""
+    td = str(tmp_path)
+    x, y, tw, vw, spec = _toy()
+    full = train_ensemble(x, y, tw, vw, spec,
+                          _settings(td, "a", epochs=8, precision="mixed"))
+    train_ensemble(x, y, tw, vw, spec,
+                   _settings(td, "b", epochs=4, precision="mixed"))
+    res = train_ensemble(x, y, tw, vw, spec,
+                         _settings(td, "b", epochs=8, precision="mixed",
+                                   resume=True))
+    for pf, pr in zip(full.params, res.params):
+        for lf, lr in zip(pf, pr):
+            assert lf["w"].dtype == np.dtype("bfloat16")
+            assert np.array_equal(np.asarray(lf["w"]), np.asarray(lr["w"]))
+            assert np.array_equal(np.asarray(lf["b"]), np.asarray(lr["b"]))
+    assert np.array_equal(full.valid_errors, res.valid_errors)
+
+
+def test_f32_checkpoint_under_mixed_is_coded_error(tmp_path):
+    td = str(tmp_path)
+    x, y, tw, vw, spec = _toy()
+    train_ensemble(x, y, tw, vw, spec,
+                   _settings(td, "c", epochs=4, precision="f32"))
+    with pytest.raises(ShifuError) as ei:
+        train_ensemble(x, y, tw, vw, spec,
+                       _settings(td, "c", epochs=8, precision="mixed",
+                                 resume=True))
+    assert ei.value.error_code is ErrorCode.ERROR_CHECKPOINT_PRECISION_MISMATCH
+
+
+def test_mixed_checkpoint_under_f32_is_coded_error(tmp_path):
+    """The guard is symmetric: a mixed checkpoint must not silently cast
+    down onto an f32 run either."""
+    td = str(tmp_path)
+    x, y, tw, vw, spec = _toy()
+    train_ensemble(x, y, tw, vw, spec,
+                   _settings(td, "d", epochs=4, precision="mixed"))
+    with pytest.raises(ShifuError):
+        train_ensemble(x, y, tw, vw, spec,
+                       _settings(td, "d", epochs=8, resume=True))
+
+
+def test_bf16_leaves_roundtrip_npz(tmp_path):
+    """The checkpoint layer itself: bfloat16 leaves store as their
+    uint16 bit pattern (numpy reloads the raw ml_dtypes descriptor as a
+    useless V2 void) and restore onto a bf16 template with dtype AND
+    bits preserved."""
+    td = str(tmp_path / "ck")
+    rng = np.random.default_rng(0)
+    state = {"p": jnp.asarray(rng.normal(size=(5, 3)), jnp.bfloat16),
+             "master": jnp.asarray(rng.normal(size=(5, 3)), jnp.float32),
+             "t": jnp.zeros((), jnp.float32)}
+    ckpt.save_state(td, 3, state, precision="mixed")
+    got = ckpt.restore_state(td, state, expect_precision="mixed")
+    assert got is not None and got[0] == 3
+    for k in state:
+        a, b = np.asarray(state[k]), np.asarray(got[1][k])
+        assert a.dtype == b.dtype and np.array_equal(a, b)
+    # precision tag enforced at this layer too
+    with pytest.raises(ShifuError):
+        ckpt.restore_state(td, state, expect_precision="f32")
+    # untagged expectation (legacy callers) still restores
+    assert ckpt.restore_state(td, state) is not None
+
+
+def test_resolve_precision_knob():
+    from shifu_tpu.config import environment
+    assert resolve_precision("") == "f32"
+    assert resolve_precision("MIXED") == "mixed"
+    with pytest.raises(ValueError):
+        resolve_precision("fp8")
+    environment.set_property("shifu.train.precision", "bf16")
+    try:
+        assert resolve_precision("") == "bf16"
+        assert resolve_precision("f32") == "f32"   # explicit wins
+    finally:
+        environment.set_property("shifu.train.precision", "")
+
+
+def test_streamed_mixed_close_to_f32(tmp_path):
+    """The streamed (full-batch, f32 gradient accumulation) mixed path
+    lands within noise of streamed f32 on the same stream."""
+    import tempfile
+
+    from shifu_tpu.data.shards import Shards
+    from shifu_tpu.data.streaming import ShardStream, mask_fn_from_settings
+    from shifu_tpu.train.nn_trainer import train_ensemble_streamed
+
+    rng = np.random.default_rng(0)
+    n, d = 1500, 8
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d) / np.sqrt(d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(x @ w)))).astype(np.float32)
+    wc = np.ones(n, np.float32)
+    td = str(tmp_path / "shards")
+    os.makedirs(td)
+    k = 0
+    for s in range(0, n, 600):
+        e = min(s + 600, n)
+        np.savez(os.path.join(td, f"part-{k:05d}.npz"),
+                 x=x[s:e], y=y[s:e], w=wc[s:e])
+        k += 1
+    json.dump({"columnNums": list(range(d)), "numShards": k,
+               "numRows": n},
+              open(os.path.join(td, "schema.json"), "w"))
+    spec = NNModelSpec(input_dim=d, hidden_nodes=[6],
+                       activations=["tanh"])
+    mask_fn = mask_fn_from_settings(2, valid_rate=0.2, sample_rate=1.0,
+                                    replacement=False,
+                                    up_sample_weight=1.0, seed=0)
+    errs = {}
+    for prec in ("f32", "mixed"):
+        stream = ShardStream(Shards.open(td), ("x", "y", "w"), 512,
+                             spill=False, remainder_multiple=1)
+        s = TrainSettings(optimizer="ADAM", learning_rate=0.01,
+                          epochs=4, precision=prec)
+        errs[prec] = train_ensemble_streamed(stream, spec, s, 2,
+                                             mask_fn).valid_errors
+    assert np.all(np.abs(errs["f32"] - errs["mixed"]) < 0.02)

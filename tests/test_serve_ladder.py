@@ -1,0 +1,205 @@
+"""Padding-waste squeeze — occupancy-driven serve bucket ladder and the
+shape-stable ingest remainder (round 12, ROADMAP #5).
+
+Contracts:
+- :func:`refine_ladder` proposes tighter rungs only under rungs that
+  systematically pad (share + occupancy gates), never removes rungs,
+  and bounds additions;
+- :meth:`AOTScorer.extend_buckets` compiles AND warms a new rung before
+  publishing it — the zero-recompile sentinel must stay at 0 across a
+  refinement;
+- the batcher's auto-refinement grows the ladder from observed batch
+  sizes and subsequent batches pad to the tighter rung;
+- ``serve.bucket_occupancy`` is a HISTOGRAM: p50/p99 quantile lines land
+  in metrics.prom (a gauge only ever showed the last batch);
+- the training-window remainder ladder pads the tail to a W/2^k rung
+  instead of the full window.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from shifu_tpu import obs
+from shifu_tpu.config import environment
+from shifu_tpu.models.nn import (IndependentNNModel, NNModelSpec,
+                                 init_params)
+from shifu_tpu.serve import AOTScorer, MicroBatcher, serve_recompile_count
+from shifu_tpu.serve.scorer import refine_ladder
+
+pytestmark = [pytest.mark.serve, pytest.mark.perf]
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    environment.reset_for_tests()
+    yield
+    environment.reset_for_tests()
+    obs.set_enabled(False)
+
+
+def _nn_models(n=2, n_features=8):
+    spec = NNModelSpec(input_dim=n_features, hidden_nodes=[4],
+                       activations=["relu"])
+    return [IndependentNNModel(spec, init_params(jax.random.PRNGKey(i),
+                                                 spec)) for i in range(n)]
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------------- policy
+def test_refine_ladder_proposes_tight_rung():
+    lad = refine_ladder((1, 8, 64, 512), {40: 100, 44: 50, 2: 5})
+    assert 48 in lad
+    assert set((1, 8, 64, 512)) <= set(lad)      # never removes
+
+
+def test_refine_ladder_share_and_occupancy_gates():
+    # traffic share below min_share: no proposal
+    assert refine_ladder((1, 8, 64), {40: 1, 7: 99}) == (1, 8, 64)
+    # high occupancy already: no proposal
+    assert refine_ladder((1, 8, 64), {60: 100}) == (1, 8, 64)
+    # smallest rung never subdivides
+    assert refine_ladder((8, 64), {2: 100}) == (8, 64)
+    # empty evidence: identity
+    assert refine_ladder((1, 8), {}) == (1, 8)
+
+
+def test_refine_ladder_bounds_additions():
+    counts = {40: 100, 200: 100, 3: 100}
+    lad = refine_ladder((1, 8, 64, 512), counts, max_extra=1)
+    assert len(lad) == 5                          # exactly one added
+
+
+# -------------------------------------------------- extend, ahead of use
+def test_extend_buckets_zero_recompiles():
+    scorer = AOTScorer(_nn_models(), buckets=(8, 64),
+                       name="serve.score.ladder1")
+    scorer.warm()
+    base = serve_recompile_count("serve.score.ladder1")
+    rows = np.random.default_rng(0).normal(size=(40, 8)).astype(np.float32)
+    scorer.score_batch(rows)                      # pads 40 -> 64
+    assert scorer.extend_buckets([48, 64]) == 1   # 64 already present
+    assert scorer.buckets == (8, 48, 64)
+    out = scorer.score_batch(rows)                # now pads 40 -> 48
+    assert out.shape == (40, 2)
+    assert serve_recompile_count("serve.score.ladder1") == base
+
+
+def test_batcher_auto_refine_grows_ladder():
+    """Every ``refine_every`` batches the batcher proposes rungs from
+    its observed batch sizes and grows the scorer's ladder on a
+    background thread; later batches pad to the tighter rung."""
+    environment.set_property("shifu.serve.bucketRefineEvery", 6)
+    scorer = AOTScorer(_nn_models(), buckets=(1, 8, 64),
+                       name="serve.score.ladder2")
+    scorer.warm()
+    clk = FakeClock()
+    b = MicroBatcher(lambda: scorer, max_delay_s=0.002, clock=clk)
+    assert b.refine_every == 6
+    rng = np.random.default_rng(0)
+    rows = rng.normal(size=(40, 8)).astype(np.float32)
+    for _ in range(7):
+        b.submit_burst(rows)
+        assert b.pump(force=True) == 40
+    deadline = time.monotonic() + 10.0
+    while 40 not in scorer.buckets and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert 40 in scorer.buckets
+    b.submit_burst(rows)
+    b.pump(force=True)
+    assert b.bucket_counts.get(40, 0) >= 1        # padded to the new rung
+
+
+def test_server_swap_refines_candidate_ladder():
+    """A hot-swap builds the candidate on the LIVE ladder refined
+    against observed traffic (rungs compiled during BUILD, before the
+    flip)."""
+    from shifu_tpu.serve import ServeServer
+    environment.set_property("shifu.serve.bucketRefineEvery", 0)
+    srv = ServeServer(models=_nn_models(), key="m", buckets=(1, 8, 64))
+    try:
+        srv.batcher.size_counts.update({40: 100, 44: 40})
+        srv.swap(_nn_models(n=2))
+        assert 48 in srv.registry.get("m").buckets
+        assert srv.registry.generation("m") == 1
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------- occupancy distribution
+def test_bucket_occupancy_histogram_quantiles(tmp_path):
+    obs.reset_for_tests()
+    obs.set_enabled(True)
+    try:
+        scorer = AOTScorer(_nn_models(), buckets=(8, 64),
+                           name="serve.score.ladder3")
+        scorer.warm()
+        environment.set_property("shifu.serve.bucketRefineEvery", 0)
+        b = MicroBatcher(lambda: scorer, max_delay_s=0.0)
+        rng = np.random.default_rng(0)
+        for n in (2, 4, 6, 40, 50):
+            b.submit_burst(rng.normal(size=(n, 8)).astype(np.float32))
+            b.pump(force=True)
+        h = obs.histogram("serve.bucket_occupancy")
+        assert h.quantile(0.5) is not None
+        td = str(tmp_path / "t")
+        obs.write_metrics_files(td, step="SERVE")
+        text = open(os.path.join(td, "metrics.prom")).read()
+        assert 'shifu_tpu_serve_bucket_occupancy{quantile="0.5"}' in text
+        assert 'shifu_tpu_serve_bucket_occupancy{quantile="0.99"}' in text
+    finally:
+        obs.reset_for_tests()
+
+
+# ------------------------------------------------- ingest remainder tail
+def test_stream_remainder_ladder_tail(tmp_path):
+    import json
+
+    from shifu_tpu.data.shards import Shards
+    from shifu_tpu.data.streaming import ShardStream
+
+    rng = np.random.default_rng(0)
+    n, d = 1100, 4                                # tail of 76 past 2x512
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    td = str(tmp_path / "sh")
+    os.makedirs(td)
+    k = 0
+    for s in range(0, n, 400):
+        e = min(s + 400, n)
+        np.savez(os.path.join(td, f"part-{k:05d}.npz"), x=x[s:e])
+        k += 1
+    json.dump({"columnNums": list(range(d)), "numShards": k,
+               "numRows": n},
+              open(os.path.join(td, "schema.json"), "w"))
+
+    def shapes(rm):
+        stream = ShardStream(Shards.open(td), ("x",), 512, spill=False,
+                             remainder_multiple=rm)
+        wins = list(stream.windows())
+        assert np.array_equal(
+            np.concatenate([w.arrays["x"][:w.n_valid] for w in wins]), x)
+        return [w.rows for w in wins]
+
+    assert shapes(0) == [512, 512, 512]           # old full-W pad
+    assert shapes(1) == [512, 512, 128]           # W/4 covers the 76 tail
+    # rung must stay a multiple of the mesh data axis
+    assert shapes(3) == [512, 512, 512]           # 512/2 % 3 != 0 -> full
+
+    stream = ShardStream(Shards.open(td), ("x",), 512, spill=False,
+                         remainder_multiple=1)
+    assert stream._tail_rows(76) == 128
+    assert stream._tail_rows(100) == 128
+    assert stream._tail_rows(129) == 256
+    assert stream._tail_rows(512) == 512
+    assert stream._tail_rows(1) == 64             # floor at W/8
