@@ -20,7 +20,7 @@ from typing import Any, Dict
 
 import numpy as np
 
-from . import obs
+from . import ioutil, obs
 
 # the JSONL/metric schema THIS bench emits its per-plane numbers in.
 # Hand-maintained on purpose: if obs/ bumps SCHEMA_VERSION without the
@@ -340,12 +340,14 @@ def bench_gbt_streamed(n_rows: int = 1 << 18, n_features: int = 64,
         n_shards = 0
         for s in range(0, n_rows, shard_rows):
             e = min(s + shard_rows, n_rows)
-            np.savez(os.path.join(td, f"part-{n_shards:05d}.npz"),
-                     bins=bins[s:e], y=y[s:e], w=w[s:e])
+            ioutil.atomic_savez(
+                os.path.join(td, f"part-{n_shards:05d}.npz"),
+                bins=bins[s:e], y=y[s:e], w=w[s:e])
             n_shards += 1
-        with open(os.path.join(td, "schema.json"), "w") as f:
-            json.dump({"columnNums": list(range(n_features)),
-                       "numShards": n_shards, "numRows": n_rows}, f)
+        ioutil.atomic_write_json(
+            os.path.join(td, "schema.json"),
+            {"columnNums": list(range(n_features)),
+             "numShards": n_shards, "numRows": n_rows})
         stream = ShardStream(Shards.open(td), ("bins", "y", "w"),
                              window_rows=16384)
         settings = DTSettings(n_trees=n_trees, depth=depth, loss="log",
@@ -682,12 +684,14 @@ def bench_rf_streamed_tail(n_rows: int = 1 << 16, n_features: int = 64,
         n_shards = 0
         for s in range(0, n_rows, shard_rows):
             e = min(s + shard_rows, n_rows)
-            np.savez(os.path.join(td, f"part-{n_shards:05d}.npz"),
-                     bins=bins[s:e], y=y[s:e], w=w[s:e])
+            ioutil.atomic_savez(
+                os.path.join(td, f"part-{n_shards:05d}.npz"),
+                bins=bins[s:e], y=y[s:e], w=w[s:e])
             n_shards += 1
-        with open(os.path.join(td, "schema.json"), "w") as f:
-            json.dump({"columnNums": list(range(n_features)),
-                       "numShards": n_shards, "numRows": n_rows}, f)
+        ioutil.atomic_write_json(
+            os.path.join(td, "schema.json"),
+            {"columnNums": list(range(n_features)),
+             "numShards": n_shards, "numRows": n_rows})
         stream = ShardStream(Shards.open(td), ("bins", "y", "w"),
                              window_rows=16384)
         train_rf_streamed(stream, n_bins, cat, settings,
@@ -988,14 +992,14 @@ def bench_varsel(n_rows: int = 1 << 15, n_features: int = 256,
         k = 0
         for s in range(0, n_rows, shard_rows):
             e = min(s + shard_rows, n_rows)
-            np.savez(os.path.join(td, f"part-{k:05d}.npz"),
-                     x=x[s:e], y=y[s:e])
+            ioutil.atomic_savez(os.path.join(td, f"part-{k:05d}.npz"),
+                                x=x[s:e], y=y[s:e])
             k += 1
-        with open(os.path.join(td, "schema.json"), "w") as f:
-            json.dump({"outputNames": [f"c{i}" for i in
-                                       range(n_features)],
-                       "columnNums": list(range(n_features)),
-                       "numShards": k, "numRows": n_rows}, f)
+        ioutil.atomic_write_json(
+            os.path.join(td, "schema.json"),
+            {"outputNames": [f"c{i}" for i in range(n_features)],
+             "columnNums": list(range(n_features)),
+             "numShards": k, "numRows": n_rows})
         shards = Shards.open(td)
         mesh = device_mesh()
         window_rows = stream_window_rows(4 * (n_features + 2),

@@ -185,6 +185,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="score N synthetic rows in-process and exit "
                     "(no port; CI smoke)")
 
+    sp = sub.add_parser("lint", help="AST-based convention checker: "
+                        "host-sync/recompile/knob-registry/atomic-write/"
+                        "telemetry-guard/manifest rules over shifu_tpu/ "
+                        "(exit 0 clean, 2 findings; "
+                        "# shifu-lint: disable=RULE suppresses inline; "
+                        "lint-baseline.json grandfathers old debt)")
+    from .lint.cli import add_lint_args
+    add_lint_args(sp)
+
     sp = sub.add_parser("test", help="pipeline smoke test on a data sample")
     sp.add_argument("-filter", dest="filter_target", nargs="?", const="",
                     default=None, metavar="EVALSET",
@@ -356,6 +365,9 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
         return run_serve(args.dir, port=args.serve_port,
                          selfcheck=args.serve_selfcheck,
                          max_delay_ms=args.serve_max_delay_ms)
+    if cmd == "lint":
+        from .lint.cli import run_lint_cli
+        return run_lint_cli(args)
     if cmd == "test":
         from .pipeline.smoke import SmokeTestProcessor
         return SmokeTestProcessor(args.dir, params=vars(args)).run()

@@ -91,7 +91,9 @@ class Shards:
                 if bad_threshold <= 0:
                     raise
                 quarantined += 1
-                obs.counter("data.quarantined_shards").inc()
+                # quarantine is the rare branch by definition —
+                # bounded by shifu.data.badThreshold
+                obs.counter("data.quarantined_shards").inc()  # shifu-lint: disable=telemetry-guard
                 log.warning("quarantined undecodable shard %s: %s", f, e)
                 if quarantined / max(len(self.files), 1) > bad_threshold:
                     from ..config.errors import ErrorCode, ShifuError

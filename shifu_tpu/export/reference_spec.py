@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from .. import ioutil
 from ..config.errors import ErrorCode, ShifuError
 from ..models.nn import NNModelSpec
 from ..models.tree import TreeModelSpec
@@ -128,8 +129,7 @@ def write_encog_nn(path: str, spec: NNModelSpec, params: List[Dict]) -> None:
         "biasActivation=" + ",".join("1" if b else "0" for b in bias_act),
         "[BASIC:ACTIVATION]",
     ] + [f'"{n}"' for n in act_names]
-    with open(path, "w", encoding="utf-8") as f:
-        f.write("\n".join(lines) + "\n")
+    ioutil.atomic_write_text(path, "\n".join(lines) + "\n")
 
 
 # ------------------------------------------- BinaryDTSerializer (.gbt/.rf)
@@ -356,8 +356,7 @@ def write_reference_tree(path: str, spec: TreeModelSpec,
                            else spec.learning_rate)      # learningRate
             d.write_double(0.0)                          # rootWgtCnt (id 1)
             d.write_int(0)                               # per-tree features
-    with open(path, "wb") as f:
-        f.write(gzip.compress(d.getvalue()))
+    ioutil.atomic_write_bytes(path, gzip.compress(d.getvalue()))
 
 
 # --------------------------------------- BinaryWDLSerializer (.wdl)
@@ -531,5 +530,4 @@ def write_reference_wdl(path: str, spec, params: Dict,
         for v in ids:
             d.write_int(int(v))
     d.write_float(0.0)                              # l2reg
-    with open(path, "wb") as f:
-        f.write(gzip.compress(d.getvalue()))
+    ioutil.atomic_write_bytes(path, gzip.compress(d.getvalue()))

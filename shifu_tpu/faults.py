@@ -69,6 +69,33 @@ log = logging.getLogger(__name__)
 
 _ACTIONS = ("ioerror", "kill", "truncate")
 
+# Declared fault sites: (site, point) -> one-line description of the
+# boundary.  The ``fault-site`` lint rule (shifu_tpu/lint) checks every
+# ``faults.fire("site", "point", ...)`` literal against this manifest —
+# an undeclared site would be un-triggerable from a spec that follows
+# the documented grammar, and a typo'd one would silently never fire.
+SITES: dict = {
+    ("norm", "shard"): "before shard k's commit record lands",
+    ("stats", "chunk"): "before chunk ci is absorbed by the accumulators",
+    ("train", "tree"): "after tree ti's progress line (GBT/RF)",
+    ("train", "superbatch"): "after disk-tail super-batch drain k lands",
+    ("train", "epoch"): "after epoch e's progress line (NN/LR/WDL/SVM)",
+    ("train", "bag"): "before kernel-SVM bag b trains",
+    ("reader", "file"): "opening the i-th raw input file",
+    ("shards", "shard"): "decoding the i-th materialized npz shard",
+    ("spill", "append"): "spill write-through of shard k",
+    ("spill", "manifest"): "spill manifest commit",
+    ("step", "phase"): "entering a named processor phase span",
+    ("obs", "heartbeat"): "before heartbeat b's atomic commit",
+    ("serve", "request"): "before serving batch k's device launch",
+    ("serve", "swap"): "after a hot-swap candidate is built+warmed, "
+                       "before the journal commit and the live flip",
+}
+
+
+def is_declared_site(site: str, point: str) -> bool:
+    return (site, point) in SITES
+
 _clauses: Optional[Dict[Tuple[str, str, str], List]] = None  # [action, left]
 
 

@@ -16,13 +16,14 @@ Two small primitives every artifact writer in the pipeline shares:
 
 from __future__ import annotations
 
+import contextlib
 import io
 import json
 import logging
 import os
 import random
 import time
-from typing import Any, Callable, TypeVar
+from typing import Any, Callable, Iterator, TypeVar
 
 import numpy as np
 
@@ -56,7 +57,9 @@ def io_retry(fn: Callable[[], T], what: str, path: str = "") -> T:
                     f"{what} failed after {attempts} attempt(s)"
                     f"{f' [{path}]' if path else ''}: {e}") from e
             from . import obs
-            obs.counter("ingest.retries").inc()
+            # retry loop only spins on transient IO weather — the
+            # factory lookup here is as cold as the backoff sleep
+            obs.counter("ingest.retries").inc()  # shifu-lint: disable=telemetry-guard
             delay = base * (2 ** attempt) * (1.0 + random.random())
             log.warning("transient IO error in %s%s (attempt %d/%d, "
                         "retrying in %.0f ms): %s", what,
@@ -91,6 +94,39 @@ def atomic_savez(path: str, **arrays: np.ndarray) -> None:
     buf = io.BytesIO()
     np.savez(buf, **arrays)
     atomic_write_bytes(path, buf.getvalue())
+
+
+def atomic_save_npy(path: str, arr: np.ndarray) -> None:
+    """Single-array ``.npy`` twin of :func:`atomic_savez`."""
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    atomic_write_bytes(path, buf.getvalue())
+
+
+@contextlib.contextmanager
+def atomic_open(path: str, mode: str = "w", **kwargs) -> Iterator[Any]:
+    """Streaming writer with the tmp+``os.replace`` discipline: yields a
+    file object positioned at a same-directory temp file; a clean exit
+    commits it into place, an exception unlinks the temp (the final path
+    is never observed half-written).  For artifact writers that stream
+    too much to buffer (score CSVs, PMML) — small payloads should use
+    :func:`atomic_write_text`/``_json``/``_bytes`` directly."""
+    if "r" in mode or "a" in mode or "+" in mode:
+        raise ValueError(f"atomic_open is write-only (mode={mode!r})")
+    tmp = _tmp_path(path)
+    f = open(tmp, mode, **kwargs)
+    try:
+        yield f
+    except BaseException:
+        f.close()
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    else:
+        f.close()
+        os.replace(tmp, path)
 
 
 def sweep_orphan_tmp(directory: str) -> int:

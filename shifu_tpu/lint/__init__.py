@@ -1,0 +1,47 @@
+"""``shifu-tpu lint`` — AST-based convention checker for this codebase.
+
+Eleven PRs in, correctness rests on conventions no compiler enforces:
+named hot executables route through ``obs.costed_jit`` so the recompile
+sentinel sees them, artifact writes are atomic via ``ioutil``, telemetry
+is zero-cost when disabled, metric/span/fault-site names resolve against
+their manifests, and every ``-Dshifu.*`` / ``SHIFU_*`` knob is declared
+in ``config/knobs.py``.  This package turns those implicit contracts
+into machine-checked rules:
+
+- :mod:`engine`   — per-file ``ast`` parse, ONE tree walk shared by all
+  rules (rules subscribe to node types), deterministic finding order,
+  ``# shifu-lint: disable=RULE`` inline suppressions;
+- :mod:`rules`    — the rule catalogue (see ``ALL_RULES``);
+- :mod:`baseline` — checked-in grandfather file: new debt fails CI while
+  old debt stays tracked (``lint-baseline.json`` at the repo root);
+- :mod:`cli`      — ``shifu-tpu lint`` (text + ``--json``; exit 0 clean,
+  2 findings, 1 usage/parse trouble).
+
+Suppressing a finding::
+
+    x = forced.item()   # shifu-lint: disable=host-sync-hot-path -- why
+
+A comment line immediately above the flagged line works too.  Whole-file
+opt-outs use ``# shifu-lint: disable-file=RULE`` anywhere in the file.
+"""
+
+from __future__ import annotations
+
+from .baseline import apply_baseline, load_baseline, write_baseline
+from .engine import Finding, LintEngine, Rule, iter_python_files
+from .rules import ALL_RULES, make_rules
+from .cli import main, run_lint
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "LintEngine",
+    "Rule",
+    "apply_baseline",
+    "iter_python_files",
+    "load_baseline",
+    "main",
+    "make_rules",
+    "run_lint",
+    "write_baseline",
+]

@@ -1,0 +1,78 @@
+"""Baseline file: grandfathered findings tracked, new debt fails CI.
+
+The checked-in ``lint-baseline.json`` (repo root) records the findings
+that existed when a rule landed, keyed by the line-independent
+fingerprint ``(rule, path, message)`` with a count (the same message can
+legitimately occur N times in one file).  ``shifu-tpu lint`` subtracts
+the baseline from the current run: up to ``count`` matching findings
+are absorbed per fingerprint, everything else is NEW and exits 2.
+
+The workflow mirrors every grandfathering linter: ``--update-baseline``
+rewrites the file from the current findings (review the diff — a
+GROWING baseline is the smell the rule exists to catch), and fixing old
+debt shrinks it; a stale entry whose finding no longer exists is
+reported so the file can't rot."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+from .engine import Finding
+from .. import ioutil
+
+__all__ = ["load_baseline", "write_baseline", "apply_baseline",
+           "BASELINE_VERSION"]
+
+BASELINE_VERSION = 1
+
+_Key = Tuple[str, str, str]
+
+
+def load_baseline(path: str) -> Dict[_Key, int]:
+    """fingerprint -> grandfathered count.  Missing file = empty."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return {}
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {doc.get('version')!r} "
+            f"(this build reads {BASELINE_VERSION})")
+    out: Dict[_Key, int] = {}
+    for rec in doc.get("findings", []):
+        key = (rec["rule"], rec["path"], rec["message"])
+        out[key] = out.get(key, 0) + int(rec.get("count", 1))
+    return out
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    counts: Dict[_Key, int] = {}
+    for f in findings:
+        counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+    recs = [{"rule": k[0], "path": k[1], "message": k[2], "count": n}
+            for k, n in sorted(counts.items())]
+    ioutil.atomic_write_json(path, {"version": BASELINE_VERSION,
+                                    "findings": recs})
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[_Key, int]
+                   ) -> Tuple[List[Finding], List[Finding], List[_Key]]:
+    """Split into (new, grandfathered) and name stale baseline entries.
+
+    Deterministic: findings arrive sorted; the FIRST ``count`` matches
+    of each fingerprint are absorbed."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        k = f.fingerprint
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = sorted(k for k, n in budget.items() if n > 0)
+    return new, old, stale

@@ -1,0 +1,163 @@
+"""``shifu-tpu lint`` front-end: text + ``--json``, baseline workflow.
+
+Exit codes: 0 clean (or everything grandfathered), 2 new findings or a
+stale baseline, 1 usage trouble (unknown rule, unreadable baseline).
+Output is byte-deterministic for a given tree — the CI guard diffs two
+runs."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .baseline import apply_baseline, load_baseline, write_baseline
+from .engine import Finding, LintEngine
+from .rules import ALL_RULES, make_rules
+
+__all__ = ["add_lint_args", "run_lint", "run_lint_cli", "main",
+           "default_target", "default_baseline_path", "repo_root"]
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_NAME = "lint-baseline.json"
+
+
+def default_target() -> str:
+    """The installed ``shifu_tpu`` package tree."""
+    return _PKG_DIR
+
+
+def repo_root() -> str:
+    return os.path.dirname(_PKG_DIR)
+
+
+def default_baseline_path() -> str:
+    return os.path.join(repo_root(), BASELINE_NAME)
+
+
+def run_lint(paths: Optional[Sequence[str]] = None,
+             rules: Optional[Sequence[str]] = None,
+             root: Optional[str] = None,
+             full_tree: Optional[bool] = None,
+             ) -> Tuple[List[Finding], LintEngine]:
+    """Programmatic entry: lint ``paths`` (default: the whole package)
+    and return the sorted findings.  ``full_tree`` enables cross-file
+    checks (README knob table, dead knob declarations); by default it is
+    on exactly when the scan covers the whole package."""
+    paths = list(paths) if paths else [default_target()]
+    if full_tree is None:
+        tgt = os.path.realpath(default_target())
+        full_tree = any(os.path.realpath(p) == tgt for p in paths)
+    engine = LintEngine(make_rules(rules), root=root or repo_root(),
+                        full_tree=full_tree)
+    return engine.run(paths), engine
+
+
+def add_lint_args(sp: argparse.ArgumentParser) -> None:
+    sp.add_argument("lint_paths", nargs="*", metavar="PATH",
+                    help="files/dirs to lint (default: the shifu_tpu "
+                    "package)")
+    sp.add_argument("--json", dest="lint_json", action="store_true",
+                    help="machine-readable output (one JSON doc)")
+    sp.add_argument("--rules", dest="lint_rules", default=None,
+                    metavar="R1,R2", help="run only these rules")
+    sp.add_argument("--list-rules", dest="lint_list", action="store_true",
+                    help="print the rule catalogue and exit")
+    sp.add_argument("--baseline", dest="lint_baseline", default=None,
+                    metavar="FILE",
+                    help="grandfather file (default: lint-baseline.json "
+                    "at the repo root when present)")
+    sp.add_argument("--no-baseline", dest="lint_no_baseline",
+                    action="store_true",
+                    help="ignore any baseline: report every finding")
+    sp.add_argument("--update-baseline", dest="lint_update",
+                    action="store_true",
+                    help="rewrite the baseline from the current findings "
+                    "(review the diff — a growing baseline is the smell)")
+
+
+def _render_text(new: List[Finding], old: List[Finding],
+                 stale: List[Tuple[str, str, str]],
+                 engine: LintEngine, elapsed_s: float) -> str:
+    out: List[str] = []
+    for f in new:
+        out.append(f.render())
+    if old:
+        out.append(f"({len(old)} grandfathered finding(s) absorbed by "
+                   "the baseline)")
+    for rule, path, message in stale:
+        out.append(f"stale baseline entry: {rule}: {path}: {message}")
+    verdict = "clean" if not (new or stale) else \
+        f"{len(new)} new finding(s)" + \
+        (f", {len(stale)} stale baseline entr(ies)" if stale else "")
+    out.append(f"shifu-tpu lint: {engine.files_scanned} file(s), "
+               f"{verdict}  [{elapsed_s:.2f}s]")
+    return "\n".join(out)
+
+
+def run_lint_cli(args: argparse.Namespace) -> int:
+    if getattr(args, "lint_list", False):
+        for cls in ALL_RULES:
+            print(f"{cls.name}")
+            print(f"    {cls.doc}")
+        return 0
+    rules = None
+    if getattr(args, "lint_rules", None):
+        rules = [r.strip() for r in args.lint_rules.split(",") if r.strip()]
+    t0 = time.perf_counter()
+    try:
+        findings, engine = run_lint(getattr(args, "lint_paths", None),
+                                    rules=rules)
+    except ValueError as e:
+        print(f"shifu-tpu lint: {e}", file=sys.stderr)
+        return 1
+
+    bl_path = getattr(args, "lint_baseline", None) or \
+        default_baseline_path()
+    explicit = getattr(args, "lint_baseline", None) is not None
+    baseline: Dict = {}
+    if not getattr(args, "lint_no_baseline", False) \
+            and not getattr(args, "lint_update", False):
+        try:
+            baseline = load_baseline(bl_path)
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            if explicit or os.path.exists(bl_path):
+                print(f"shifu-tpu lint: bad baseline: {e}",
+                      file=sys.stderr)
+                return 1
+
+    if getattr(args, "lint_update", False):
+        write_baseline(bl_path, findings)
+        print(f"baseline -> {bl_path}  ({len(findings)} finding(s) "
+              "grandfathered)")
+        return 0
+
+    new, old, stale = apply_baseline(findings, baseline)
+    elapsed = time.perf_counter() - t0
+    if getattr(args, "lint_json", False):
+        doc = {
+            "files_scanned": engine.files_scanned,
+            "new": [f.to_json() for f in new],
+            "grandfathered": [f.to_json() for f in old],
+            "stale_baseline": [{"rule": r, "path": p, "message": m}
+                               for r, p, m in stale],
+            "elapsed_s": round(elapsed, 3),
+        }
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        print(_render_text(new, old, stale, engine, elapsed))
+    return 2 if (new or stale) else 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="shifu-tpu lint")
+    add_lint_args(p)
+    return run_lint_cli(p.parse_args(list(argv) if argv is not None
+                                     else None))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

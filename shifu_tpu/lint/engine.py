@@ -1,0 +1,281 @@
+"""Lint engine: one parse, one walk, deterministic findings.
+
+Every rule subscribes to the ``ast`` node types it cares about
+(``interests``); the engine parses each file once and drives a single
+depth-first walk, dispatching each node to the interested rules with the
+ancestor stack (so rules can ask "am I inside a jitted function / a
+loop / an ``if obs.enabled()`` guard" without walking themselves).
+
+Findings are value objects ordered ``(path, line, col, rule, message)``
+— two runs over the same tree are byte-identical, which the CI guard
+test pins.  The *fingerprint* used by the baseline intentionally drops
+the line number: grandfathered debt should not churn every time an
+unrelated edit moves a line.
+
+Suppressions::
+
+    bad()          # shifu-lint: disable=rule-a,rule-b -- justification
+    # shifu-lint: disable=rule-a        (comment-only line: next line)
+    # shifu-lint: disable-file=rule-a   (whole file, any line)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple, Type
+
+__all__ = ["Finding", "FileContext", "Rule", "LintEngine",
+           "iter_python_files"]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*shifu-lint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    path: str            # repo-relative, forward slashes
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    @property
+    def fingerprint(self) -> Tuple[str, str, str]:
+        """Baseline identity — line-independent so grandfathered debt
+        doesn't churn when unrelated edits move lines."""
+        return (self.rule, self.path, self.message)
+
+    def sort_key(self) -> Tuple[str, int, int, str, str]:
+        return (self.path, self.line, self.col, self.rule, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: " \
+               f"{self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "rule": self.rule, "message": self.message}
+
+
+class FileContext:
+    """Per-file state shared by every rule during the walk."""
+
+    def __init__(self, path: str, rel_path: str, source: str):
+        self.path = path
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.AST] = None
+        # line -> rules disabled on that line; rules disabled file-wide
+        self.line_disables: Dict[int, Set[str]] = {}
+        self.file_disables: Set[str] = set()
+        self._scan_suppressions()
+
+    def _scan_suppressions(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            names = {r.strip() for r in m.group("rules").split(",")}
+            if m.group("file"):
+                self.file_disables |= names
+                continue
+            self.line_disables.setdefault(i, set()).update(names)
+            # a comment-only suppression covers the NEXT code line
+            if line.strip().startswith("#"):
+                self.line_disables.setdefault(i + 1, set()).update(names)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_disables:
+            return True
+        return rule in self.line_disables.get(line, set())
+
+    def src(self, node: ast.AST) -> str:
+        """Source segment of a node ('' when unavailable)."""
+        try:
+            return ast.get_source_segment(self.source, node) or ""
+        except Exception:
+            return ""
+
+
+def qualname(node: ast.AST) -> str:
+    """Dotted name of a Name/Attribute chain: ``obs.counter`` /
+    ``np.asarray`` / ``jax.jit`` ('' for anything dynamic)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(node: ast.Call) -> str:
+    return qualname(node.func)
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    """The value of a plain string literal node, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def fstring_head(node: ast.AST) -> Optional[str]:
+    """For an f-string, the constant prefix before the first ``{}``
+    field (None for non-JoinedStr).  A fully-constant JoinedStr returns
+    the whole string."""
+    if not isinstance(node, ast.JoinedStr):
+        return None
+    head: List[str] = []
+    for part in node.values:
+        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+            head.append(part.value)
+        else:
+            break
+    return "".join(head)
+
+
+class Rule:
+    """Base class: subscribe to node types, report findings.
+
+    ``interests`` names the ``ast`` node classes the engine should
+    dispatch to :meth:`visit`; ``finish`` runs once after the walk (only
+    on full-tree scans) for cross-file checks.
+    """
+
+    name: str = ""
+    doc: str = ""
+    interests: Tuple[Type[ast.AST], ...] = ()
+
+    def __init__(self) -> None:
+        self.findings: List[Finding] = []
+
+    # -- hooks -----------------------------------------------------------
+    def begin_file(self, ctx: FileContext) -> None:
+        pass
+
+    def visit(self, node: ast.AST, parents: Sequence[ast.AST],
+              ctx: FileContext) -> None:
+        pass
+
+    def end_file(self, ctx: FileContext) -> None:
+        pass
+
+    def finish(self, engine: "LintEngine") -> None:
+        pass
+
+    # -- reporting -------------------------------------------------------
+    def report(self, ctx: FileContext, node: Optional[ast.AST],
+               message: str, *, line: Optional[int] = None) -> None:
+        ln = line if line is not None else getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0) if node is not None else 0
+        if ctx.suppressed(self.name, ln):
+            return
+        self.findings.append(Finding(ctx.rel_path, ln, col, self.name,
+                                     message))
+
+    def report_project(self, rel_path: str, message: str,
+                       line: int = 1) -> None:
+        """A finding not anchored to a walked node (cross-file checks)."""
+        self.findings.append(Finding(rel_path, line, 0, self.name, message))
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    """Expand files/dirs into a sorted, deterministic .py file list."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, files in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__"
+                                     and not d.startswith("."))
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif p.endswith(".py"):
+            out.append(p)
+    seen: Set[str] = set()
+    for p in sorted(out):
+        rp = os.path.realpath(p)
+        if rp not in seen:
+            seen.add(rp)
+            yield p
+
+
+class LintEngine:
+    """Parse each file once, walk once, dispatch to all rules."""
+
+    def __init__(self, rules: Sequence[Rule], root: str,
+                 full_tree: bool = False):
+        self.rules = list(rules)
+        self.root = os.path.abspath(root)
+        self.full_tree = full_tree
+        self.parse_errors: List[Finding] = []
+        self.files_scanned = 0
+        self._dispatch: Dict[Type[ast.AST], List[Rule]] = {}
+        for r in self.rules:
+            for t in r.interests:
+                self._dispatch.setdefault(t, []).append(r)
+
+    def rel(self, path: str) -> str:
+        ap = os.path.abspath(path)
+        rp = os.path.relpath(ap, self.root)
+        if rp.startswith(".."):          # outside the root: keep absolute
+            return ap.replace(os.sep, "/")
+        return rp.replace(os.sep, "/")
+
+    # -- driving ---------------------------------------------------------
+    def run(self, paths: Iterable[str]) -> List[Finding]:
+        for path in iter_python_files(paths):
+            self._run_file(path)
+        if self.full_tree:
+            for r in self.rules:
+                r.finish(self)
+        found = list(self.parse_errors)
+        for r in self.rules:
+            found.extend(r.findings)
+        return sorted(found, key=Finding.sort_key)
+
+    def _run_file(self, path: str) -> None:
+        rel = self.rel(path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            self.parse_errors.append(
+                Finding(rel, 1, 0, "parse-error", f"unreadable: {e}"))
+            return
+        ctx = FileContext(path, rel, source)
+        try:
+            ctx.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.parse_errors.append(
+                Finding(rel, e.lineno or 1, e.offset or 0, "parse-error",
+                        f"syntax error: {e.msg}"))
+            return
+        self.files_scanned += 1
+        for r in self.rules:
+            r.begin_file(ctx)
+        stack: List[ast.AST] = [ctx.tree]
+        self._walk(ctx.tree, stack, ctx)
+        for r in self.rules:
+            r.end_file(ctx)
+
+    def _walk(self, node: ast.AST, stack: List[ast.AST],
+              ctx: FileContext) -> None:
+        for child in ast.iter_child_nodes(node):
+            rules = self._dispatch.get(type(child))
+            if rules:
+                for r in rules:
+                    r.visit(child, stack, ctx)
+            stack.append(child)
+            self._walk(child, stack, ctx)
+            stack.pop()

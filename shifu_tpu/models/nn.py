@@ -22,6 +22,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import ioutil
+
 import jax
 import jax.numpy as jnp
 
@@ -229,8 +231,7 @@ def save_model(path: str, spec: NNModelSpec, params) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     buf = io.BytesIO()
     np.savez(buf, **arrays)
-    with open(path, "wb") as f:
-        f.write(buf.getvalue())
+    ioutil.atomic_write_bytes(path, buf.getvalue())
 
 
 def load_model(path: str) -> Tuple[NNModelSpec, List[Dict]]:

@@ -17,6 +17,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from .. import ioutil
+
 import jax
 import jax.numpy as jnp
 
@@ -75,8 +77,7 @@ def save_model(path: str, spec: SVMModelSpec, sv_x: np.ndarray,
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     buf = io.BytesIO()
     np.savez(buf, **arrays)
-    with open(path, "wb") as f:
-        f.write(buf.getvalue())
+    ioutil.atomic_write_bytes(path, buf.getvalue())
 
 
 def load_model(path: str):

@@ -19,6 +19,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import ioutil
+
 import jax.numpy as jnp
 
 from ..ops.tree import TreeArrays, predict_forest_stacked, stack_forest
@@ -69,8 +71,7 @@ def save_model(path: str, spec: TreeModelSpec, trees: List[TreeArrays]) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     buf = io.BytesIO()
     np.savez(buf, **arrays)
-    with open(path, "wb") as f:
-        f.write(buf.getvalue())
+    ioutil.atomic_write_bytes(path, buf.getvalue())
 
 
 def load_model(path: str) -> Tuple[TreeModelSpec, List[TreeArrays]]:

@@ -242,7 +242,9 @@ def sample_device_memory() -> None:
                         ("peak_bytes_in_use", "device.peak_bytes_in_use"),
                         ("bytes_limit", "device.bytes_limit")):
         if key in stats:
-            _registry.gauge(metric).set_max(stats[key])
+            # registry-internal writer: three fixed keys per
+            # heartbeat sample, not a hot loop
+            _registry.gauge(metric).set_max(stats[key])  # shifu-lint: disable=telemetry-guard
 
 
 _compile_listener_installed = False

@@ -359,7 +359,9 @@ def flush(path: str, step: Optional[str] = None,
     if extra_meta:
         meta.update(extra_meta)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "a") as f:
+    # append-only trace sink BY DESIGN: each flush appends a block;
+    # every reader (report/timeline) skips a torn final line
+    with open(path, "a") as f:  # shifu-lint: disable=atomic-write
         for rec in [meta] + records + metrics + cost_recs:
             f.write(json.dumps(rec) + "\n")
     return True

@@ -51,13 +51,24 @@ LANE = 128
 
 
 # ------------------------------------------------------------------ knobs
+def _quant_knob() -> str:
+    """``SHIFU_TREE_QUANT`` env, falling back to the documented
+    ``-Dshifu.tree.quantKernel`` property (the docs promised the
+    property form long before it was wired — the knob-registry lint
+    caught the gap)."""
+    env = os.environ.get("SHIFU_TREE_QUANT")
+    if env is not None:
+        return env
+    from ..config import environment
+    return environment.get_property("shifu.tree.quantKernel", "auto")
+
+
 @lru_cache(maxsize=None)
 def quant_scoring() -> bool:
     """Use the quantized (uint8-narrow) scoring path at all.  Default ON —
     routing is bit-identical to the classic traversal on every backend;
     ``SHIFU_TREE_QUANT=0`` pins the old path (tests pin both)."""
-    env = os.environ.get("SHIFU_TREE_QUANT", "auto")
-    return env not in ("0", "off")
+    return _quant_knob() not in ("0", "off")
 
 
 @lru_cache(maxsize=None)
@@ -66,7 +77,7 @@ def quant_kernel() -> bool:
     fallback serves CPU and kernel-off).  ``SHIFU_TREE_QUANT=force``
     pins the kernel on (interpret mode off-TPU — tests); ``=0/off``
     disables with the whole quant path."""
-    env = os.environ.get("SHIFU_TREE_QUANT", "auto")
+    env = _quant_knob()
     if env in ("0", "off"):
         return False
     if env == "force":

@@ -15,6 +15,8 @@ import os
 
 import numpy as np
 
+from .. import ioutil
+
 log = logging.getLogger(__name__)
 
 
@@ -41,7 +43,7 @@ def analyze_model_fi(model_path: str) -> int:
                                    or range(n_feat)]
     out = model_path + ".fi"
     order = np.argsort(-fi)
-    with open(out, "w") as f:
+    with ioutil.atomic_open(out) as f:
         for j in order:
             f.write(f"{names[j]}\t{fi[j]:.6f}\n")
     log.info("feature importance (%d features, %d trees) -> %s",

@@ -15,6 +15,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from .. import ioutil
+
 log = logging.getLogger(__name__)
 
 COMBO_FILE = "combo.json"
@@ -28,8 +30,8 @@ def run_combo(model_set_dir: str, action: str, algs: Optional[str],
             log.error("combo new requires -alg A:B:C")
             return 1
         members = [a.strip().upper() for a in algs.split(":") if a.strip()]
-        with open(os.path.join(d, COMBO_FILE), "w") as f:
-            json.dump({"algorithms": members}, f, indent=2)
+        ioutil.atomic_write_json(os.path.join(d, COMBO_FILE),
+                                 {"algorithms": members})
         log.info("combo: %s", members)
         return 0
 
@@ -183,8 +185,7 @@ def _eval_members(d: str, members: List[str]) -> int:
             per_member.append({"member": f"{i}:{alg}",
                                "areaUnderRoc": m_res.to_dict()["areaUnderRoc"]})
         doc["memberAuc"] = per_member
-        with open(out_path, "w") as f:
-            json.dump(doc, f, indent=2)
+        ioutil.atomic_write_json(out_path, doc)
         log.info("combo eval %s: assembled AUC %.6f (members: %s)", ev.name,
                  res.areaUnderRoc,
                  {p["member"]: round(p["areaUnderRoc"], 4) if p["areaUnderRoc"]

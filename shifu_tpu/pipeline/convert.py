@@ -14,6 +14,8 @@ import os
 
 import numpy as np
 
+from .. import ioutil
+
 log = logging.getLogger(__name__)
 
 
@@ -21,8 +23,9 @@ def model_to_json(path: str, out_path: str) -> None:
     data = np.load(path)
     spec = json.loads(bytes(data["__spec__"]).decode())
     arrays = {k: data[k].tolist() for k in data.files if k != "__spec__"}
-    with open(out_path, "w") as f:
-        json.dump({"spec": spec, "arrays": arrays}, f)
+    ioutil.atomic_write_text(out_path,
+                             json.dumps({"spec": spec,
+                                         "arrays": arrays}))
 
 
 def json_to_model(path: str, out_path: str) -> None:
@@ -43,8 +46,7 @@ def json_to_model(path: str, out_path: str) -> None:
         json.dumps(doc["spec"]).encode(), np.uint8)
     buf = io.BytesIO()
     np.savez(buf, **arrays)
-    with open(out_path, "wb") as f:
-        f.write(buf.getvalue())
+    ioutil.atomic_write_bytes(out_path, buf.getvalue())
 
 
 def run_convert(model_set_dir: str, params: dict) -> int:

@@ -12,6 +12,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from .. import ioutil
 from ..config.validator import ModelStep
 from ..data import DataSource
 from ..data.transform import DatasetTransformer
@@ -116,7 +117,7 @@ class EncodeProcessor(BasicProcessor):
                             header_delimiter=ds.headerDelimiter)
         out_path = os.path.join(self.paths.tmp_dir, out_name)
         n = 0
-        with open(out_path, "w") as f:
+        with ioutil.atomic_open(out_path) as f:
             f.write("target|" + "|".join(
                 f"tree{t}" for t in range(len(model.trees))) + "\n")
             for chunk in source.iter_chunks():

@@ -27,7 +27,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from .. import obs
+from .. import ioutil, obs
 from ..config.model_config import EvalConfig, RawSourceData
 from ..config.validator import ModelStep
 from ..data import DataSource
@@ -75,7 +75,7 @@ class EvalProcessor(BasicProcessor):
             out = self.paths.eval_norm_path(ev.name)
             os.makedirs(os.path.dirname(out), exist_ok=True)
             n_rows = 0
-            with open(out, "w") as f:
+            with ioutil.atomic_open(out, newline="") as f:
                 w = csv.writer(f, delimiter="|")
                 header_written = False
                 for chunk in source.iter_chunks():
@@ -170,7 +170,7 @@ class EvalProcessor(BasicProcessor):
         drift = obs.start_drift_monitor(runner.transformer.columns)
         score_t0 = time.perf_counter()
         with self.phase(f"score:{ev.name}") as ph, \
-                open(score_path, "w") as sf:
+                ioutil.atomic_open(score_path, newline="") as sf:
             w = csv.writer(sf, delimiter="|")
             w.writerow(["tag", "weight", "mean", "max", "min", "median"]
                        + [f"model{i}" for i in range(n_models)])
@@ -221,7 +221,7 @@ class EvalProcessor(BasicProcessor):
                     header = f.readline()
                     rows = f.readlines()
                 order = np.argsort(-scores, kind="stable")
-                with open(score_path, "w") as f:
+                with ioutil.atomic_open(score_path) as f:
                     f.write(header)
                     f.writelines(rows[i] for i in order)
             return 0
@@ -241,8 +241,8 @@ class EvalProcessor(BasicProcessor):
         self._write_confusion(ev.name, result)
         self._write_gains(eval_dir, result)
         from ..eval.report import html_report
-        with open(os.path.join(eval_dir, "report.html"), "w") as f:
-            f.write(html_report(ev.name, curves, result))
+        ioutil.atomic_write_text(os.path.join(eval_dir, "report.html"),
+                                 html_report(ev.name, curves, result))
         obs.gauge(f"eval.{ev.name}.auc").set(result.areaUnderRoc)
         obs.gauge(f"eval.{ev.name}.pr_auc").set(result.areaUnderPr)
         log.info("eval %s: AUC %.6f weighted AUC %.6f PR-AUC %.6f",
@@ -276,7 +276,8 @@ class EvalProcessor(BasicProcessor):
                 f"were trained over {k_models} classes — tag lists must "
                 "match in length and order")
         all_cs, all_t, all_w = [], [], []
-        with open(self.paths.eval_score_path(ev.name), "w") as sf:
+        with ioutil.atomic_open(self.paths.eval_score_path(ev.name),
+                                newline="") as sf:
             w = csv.writer(sf, delimiter="|")
             w.writerow(["tag", "weight", "predictedTag"]
                        + [f"score_{t}" for t in tags])
@@ -314,7 +315,7 @@ class EvalProcessor(BasicProcessor):
                     header = f.readline()
                     rows = f.readlines()
                 order = np.argsort(-cs.max(axis=1), kind="stable")
-                with open(path, "w") as f:
+                with ioutil.atomic_open(path) as f:
                     f.write(header)
                     f.writelines(rows[i] for i in order)
             return 0
@@ -328,7 +329,7 @@ class EvalProcessor(BasicProcessor):
 
     def _write_confusion(self, name: str, result) -> None:
         path = self.paths.eval_confusion_path(name)
-        with open(path, "w") as f:
+        with ioutil.atomic_open(path, newline="") as f:
             w = csv.writer(f)
             cols = ["binLowestScore", "tp", "fp", "fn", "tn", "precision",
                     "recall", "fpr", "actionRate", "liftUnit", "weightedTp",
@@ -339,7 +340,8 @@ class EvalProcessor(BasicProcessor):
                 w.writerow([getattr(pt, c) for c in cols])
 
     def _write_gains(self, eval_dir: str, result) -> None:
-        with open(os.path.join(eval_dir, "gainchart.csv"), "w") as f:
+        with ioutil.atomic_open(os.path.join(eval_dir, "gainchart.csv"),
+                                newline="") as f:
             rows = gain_chart_rows(result)
             if not rows:
                 return

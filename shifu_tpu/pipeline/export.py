@@ -9,6 +9,7 @@ import logging
 import os
 from typing import List
 
+from .. import ioutil
 from ..config.model_config import Algorithm
 from ..config.validator import ModelStep
 from .processor import BasicProcessor
@@ -108,10 +109,10 @@ class ExportProcessor(BasicProcessor):
             members.append(os.path.basename(p))
         sel = self.model_config.evals[0].performanceScoreSelector \
             if self.model_config.evals else "mean"
-        with open(os.path.join(out_dir, "ensemble.json"), "w") as f:
-            _json.dump({"modelSet": self.model_config.basic.name,
-                        "members": members,
-                        "scoreSelector": sel or "mean"}, f, indent=2)
+        ioutil.atomic_write_json(
+            os.path.join(out_dir, "ensemble.json"),
+            {"modelSet": self.model_config.basic.name,
+             "members": members, "scoreSelector": sel or "mean"})
         log.info("bagging export: %d member(s) -> %s", len(members), out_dir)
         return 0
 
@@ -176,7 +177,7 @@ class ExportProcessor(BasicProcessor):
                 "missingPercentage", "totalCount", "distinctCount", "ks",
                 "iv", "woe", "weightedKs", "weightedIv", "weightedWoe", "psi",
                 "skewness", "kurtosis"]
-        with open(out, "w") as f:
+        with ioutil.atomic_open(out, newline="") as f:
             w = csv.writer(f)
             w.writerow(cols)
             for cc in self.column_configs:
@@ -193,7 +194,7 @@ class ExportProcessor(BasicProcessor):
 
     def _export_woe(self) -> int:
         out = os.path.join(self.paths.export_dir, "woemapping.csv")
-        with open(out, "w") as f:
+        with ioutil.atomic_open(out, newline="") as f:
             w = csv.writer(f)
             w.writerow(["columnNum", "columnName", "bin", "binLabel",
                         "countWoe", "weightedWoe"])
@@ -217,7 +218,7 @@ class ExportProcessor(BasicProcessor):
             log.error("no correlation matrix — run `stats -correlation` first")
             return 1
         out = os.path.join(self.paths.export_dir, "correlation.csv")
-        with open(src) as fi, open(out, "w") as fo:
+        with open(src) as fi, ioutil.atomic_open(out) as fo:
             fo.write(fi.read())
         log.info("correlation -> %s", out)
         return 0

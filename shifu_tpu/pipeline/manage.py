@@ -17,6 +17,8 @@ import shutil
 import time
 from typing import List, Optional
 
+from .. import ioutil
+
 log = logging.getLogger(__name__)
 
 VERSIONED = ["ModelConfig.json", "ColumnConfig.json", "models"]
@@ -95,8 +97,7 @@ def _current_file(model_set_dir: str) -> str:
 
 def _note_current(model_set_dir: str, name: str) -> None:
     os.makedirs(_backup_dir(model_set_dir), exist_ok=True)
-    with open(_current_file(model_set_dir), "w") as f:
-        f.write(name + "\n")
+    ioutil.atomic_write_text(_current_file(model_set_dir), name + "\n")
 
 
 def show_current(model_set_dir: str) -> int:
@@ -143,8 +144,7 @@ def copy_model_set(model_set_dir: str, dst: str) -> int:
         mc = json.load(f)
     if isinstance(mc.get("basic"), dict):
         mc["basic"]["name"] = os.path.basename(dst)
-    with open(os.path.join(dst, "ModelConfig.json"), "w") as f:
-        json.dump(mc, f, indent=2)
+    ioutil.atomic_write_json(os.path.join(dst, "ModelConfig.json"), mc)
     cc = os.path.join(d, "ColumnConfig.json")
     if os.path.isfile(cc):
         shutil.copy2(cc, os.path.join(dst, "ColumnConfig.json"))

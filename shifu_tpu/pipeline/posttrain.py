@@ -18,6 +18,7 @@ from typing import Dict, List
 
 import numpy as np
 
+from .. import ioutil
 from ..config.validator import ModelStep
 from ..data.shards import Shards
 from ..eval.scorer import Scorer
@@ -72,10 +73,10 @@ class PostTrainProcessor(BasicProcessor):
 
         os.makedirs(self.paths.post_train_dir, exist_ok=True)
         ranked = sorted(fi.items(), key=lambda kv: -kv[1])
-        with open(self.paths.feature_importance_path, "w") as f:
+        with ioutil.atomic_open(self.paths.feature_importance_path) as f:
             for name, v in ranked:
                 f.write(f"{name}\t{v:.4f}\n")
-        with open(self.paths.bin_avg_score_path, "w") as f:
+        with ioutil.atomic_open(self.paths.bin_avg_score_path) as f:
             for cnum in col_nums:
                 cc = by_num.get(cnum)
                 if cc and cc.columnBinning.binAvgScore:

@@ -15,7 +15,7 @@ import shutil
 import time
 from typing import List, Optional
 
-from .. import faults, obs
+from .. import faults, ioutil, obs
 from ..config import (ColumnConfig, ModelConfig, PathFinder,
                       load_column_configs, save_column_configs)
 from ..config.validator import ModelStep, probe
@@ -238,8 +238,7 @@ class BasicProcessor:
                 "phases_s": {k: round(v, 3)
                              for k, v in self._phases.items()}}
             os.makedirs(self.paths.tmp_dir, exist_ok=True)
-            with open(path, "w") as f:
-                json.dump(doc, f, indent=2)
+            ioutil.atomic_write_json(path, doc)
         except Exception:                       # profiling must never fail
             log.debug("profile write failed", exc_info=True)
 

@@ -258,7 +258,9 @@ class TrainProcessor(BasicProcessor):
         t0 = time.time()
 
         results = []
-        with open(progress_path, "w") as pf:
+        # live progress stream, tailed by operators; a torn tail is
+        # tolerated and resume replays it from the journal (PR 4)
+        with open(progress_path, "w") as pf:  # shifu-lint: disable=atomic-write
             # grid trials group by structural shape: same-shape trials train
             # as ONE vmapped run with per-member hyper arrays; non-grid =
             # one run with all bagging members vmapped together
@@ -472,7 +474,7 @@ class TrainProcessor(BasicProcessor):
         os.makedirs(self.paths.tmp_models_dir, exist_ok=True)
         t0 = time.time()
         results = []
-        with open(self.paths.progress_path, "w") as pf:
+        with open(self.paths.progress_path, "w") as pf:  # shifu-lint: disable=atomic-write
             runs = [[t] for t in range(len(trials))] if is_gs \
                 else [list(range(bags))]
             for run in runs:

@@ -128,7 +128,8 @@ class VarSelectProcessor(BasicProcessor):
         os.makedirs(self.paths.varsel_dir, exist_ok=True)
         entry = {"ts": time.strftime("%Y-%m-%d %H:%M:%S"),
                  "selected": [c.columnNum for c in self._selected()]}
-        with open(self.paths.varsel_history_path, "a") as f:
+        # append-only history ledger: readers tolerate a torn tail
+        with open(self.paths.varsel_history_path, "a") as f:  # shifu-lint: disable=atomic-write
             f.write(json.dumps(entry) + "\n")
 
     # ------------------------------------------------- standalone autofilter
@@ -153,7 +154,8 @@ class VarSelectProcessor(BasicProcessor):
         for c in selected:
             c.finalSelect = c.columnNum in kept
         os.makedirs(self.paths.varsel_dir, exist_ok=True)
-        with open(self._autofilter_history_path(), "a") as f:
+        # append-only history ledger: readers tolerate a torn tail
+        with open(self._autofilter_history_path(), "a") as f:  # shifu-lint: disable=atomic-write
             f.write(json.dumps({"ts": time.strftime("%Y-%m-%d %H:%M:%S"),
                                 "removed": removed}) + "\n")
         self.save_column_configs()

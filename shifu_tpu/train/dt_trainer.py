@@ -40,7 +40,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .. import faults, obs
+from .. import faults, ioutil, obs
 from ..config.model_config import Algorithm
 from ..data.shards import Shards
 from ..models import tree as tree_model
@@ -255,9 +255,10 @@ def _gbt_forest_multi(n_bins: int, depth: int, impurity: str, loss: str,
         return _gbt_forest_impl(bins, y, tw, vw, f, fa_all, cat, lr, mi,
                                 mg, n_bins, depth, impurity, loss, n_trees,
                                 use_pallas, max_leaves, has_cat, mesh)
-    return jax.jit(jax.vmap(one,
-                            in_axes=(None, None, 0, 0, 0, 0, None, 0, 0,
-                                     0)))
+    return obs.costed_jit(
+        "gbt.forest_bagged",
+        jax.vmap(one, in_axes=(None, None, 0, 0, 0, 0, None, 0, 0,
+                               0)))
 
 
 def _stats_bf16_exact(w) -> bool:
@@ -397,7 +398,7 @@ def _pack_tree_impl(sf, lm, lv, gfi, tr, va):
         jnp.stack([tr, va]).astype(jnp.float32)])
 
 
-_pack_tree = jax.jit(_pack_tree_impl)
+_pack_tree = jax.jit(_pack_tree_impl)  # shifu-lint: disable=recompile-hazard
 
 # RF same-round trees grown per batched device program in the RESIDENT
 # path (``grow_forest_jit``): each level's TB histograms build in ONE
@@ -518,9 +519,10 @@ def _rf_forest_multi(n_bins: int, depth: int, impurity: str, loss: str,
                                n_bins, depth, impurity, loss, poisson,
                                n_classes, n_trees, use_pallas, max_leaves,
                                has_cat, mesh, stats_exact)
-    return jax.jit(jax.vmap(one,
-                            in_axes=(None, None, 0, 0, None, 0, 0, 0, 0,
-                                     None, 0, 0)))
+    return obs.costed_jit(
+        "rf.forest_bagged",
+        jax.vmap(one, in_axes=(None, None, 0, 0, None, 0, 0, 0, 0,
+                               None, 0, 0)))
 
 
 def _unpack_tree(vec: np.ndarray, total: int, n_bins: int, c: int,
@@ -1052,7 +1054,8 @@ def _gbt_window_hist(hist, bins_w, y_w, tw_w, f_w, sf, lm, n_nodes: int,
                                    n_bins, use_pallas, mesh)
 
 
-@partial(jax.jit, static_argnames=("n_nodes",))
+@obs.costed_jit("tree.derive_level", lazy=True,
+                static_argnames=("n_nodes",))
 def _derive_level(full_prev, hl, feat_prev, n_nodes: int):
     """Full level histogram from the parent level + accumulated
     left-child sums: right child = parent - left where the parent split,
@@ -1078,7 +1081,8 @@ def _gbt_window_leaf_raw(acc, bins_w, y_w, tw_w, f_w, sf, lm, depth: int,
     return acc + _level_leaf_raw(stats, node_idx, 1 << depth)
 
 
-@partial(jax.jit, static_argnames=("depth",))
+@obs.costed_jit("tree.set_bottom_leaves", lazy=True,
+                static_argnames=("depth",))
 def _set_bottom_leaves(lv, raw, depth: int):
     return lv.at[(1 << depth) - 1:].set(leaf_values_from_raw(raw))
 
@@ -1140,7 +1144,8 @@ def _gbt_tail_head(bins, y, tw, vw, f, sf_p, lm_p, lv_p, fa, cat, lr, mi,
     return sf_c, lm_c, hist_left, leaf_raw, f, sums, cand_idx
 
 
-@partial(jax.jit, static_argnames=("c", "cand"))
+@obs.costed_jit("gbt.tail_extras", lazy=True,
+                static_argnames=("c", "cand"))
 def _tail_extras(hl_acc, hl_res, cand_idx, c: int, cand: bool = False):
     """The pass's exact TAIL-only evidence ([depth, half, C, B, S], full
     feature width): accumulated totals minus the resident head's recorded
@@ -1265,7 +1270,9 @@ def _gbt_tail_select(hist_left, leaf_raw, sf_c, lm_c, cand_idx, cat, fa,
         mismatch, full_levels
 
 
-@jax.jit
+# tiny packed-fetch glue: ~zero FLOPs, one shape per run — cost
+# attribution would only add registry noise
+@jax.jit  # shifu-lint: disable=recompile-hazard
 def _pack_c2f(sf, lm, lv, fi):
     """[sf, mask-bits, lv, fi] packed fetch for a coarse-to-fine tree —
     errors travel separately (they land one pass later, fused into the
@@ -1274,7 +1281,7 @@ def _pack_c2f(sf, lm, lv, fi):
                             lv, fi])
 
 
-@jax.jit
+@jax.jit  # shifu-lint: disable=recompile-hazard
 def _pack_small(sums, mismatch):
     """The per-tree tiny fetch: [tr_sum, tw, va_sum, vw, mismatch]."""
     return jnp.concatenate([sums, mismatch[None].astype(jnp.float32)])
@@ -1342,7 +1349,8 @@ def _rf_window_hist_batch(hist_b, bins_w, y_w, w_w, idx_hi, idx_lo,
                                            mesh, stats_exact)
 
 
-@partial(jax.jit, static_argnames=("n_nodes",))
+@obs.costed_jit("tree.derive_level_batch", lazy=True,
+                static_argnames=("n_nodes",))
 def _derive_level_batch(full_prev_b, hl_b, feat_prev_b, n_nodes: int):
     """Batched :func:`_derive_level` (per-tree parent - left)."""
     return jax.vmap(
@@ -1369,7 +1377,8 @@ def _rf_window_leaf_batch(raw_b, bins_w, y_w, w_w, idx_hi, idx_lo, khi_b,
                                                             node_b)
 
 
-@partial(jax.jit, static_argnames=("depth", "n_classes"))
+@obs.costed_jit("tree.set_bottom_leaves_batch", lazy=True,
+                static_argnames=("depth", "n_classes"))
 def _set_bottom_leaves_batch(lv_b, raw_b, depth: int, n_classes: int = 0):
     base = (1 << depth) - 1
     vals = jax.vmap(lambda r: leaf_values_from_raw(r, n_classes))(raw_b)
@@ -1390,7 +1399,8 @@ def _gbt_window_update(sums_in, bins_w, y_w, tw_w, vw_w, f_w, sf, lm, lv,
     return f2, sums_in + sums
 
 
-@partial(jax.jit, static_argnames=("depth", "loss", "n_classes"))
+@obs.costed_jit("rf.window_oob_update", lazy=True,
+                static_argnames=("depth", "loss", "n_classes"))
 def _rf_window_update(sums_in, bins_w, y_w, w_w, bag_w, oob_sum_w,
                       oob_cnt_w, sf, lm, lv, depth: int, loss: str,
                       n_classes: int = 0):
@@ -1504,8 +1514,9 @@ def _tree_level_step(hist, cat, fa, impurity: str, min_instances,
     return sf, lm, lv, nodes_cnt, fi_add
 
 
-@partial(jax.jit, static_argnames=("impurity", "has_cat", "level", "depth",
-                                   "max_leaves", "n_classes"))
+@obs.costed_jit("tree.level_step_batch", lazy=True,
+                static_argnames=("impurity", "has_cat", "level", "depth",
+                                 "max_leaves", "n_classes"))
 def _tree_level_step_batch(hist_b, cat, fa_b, impurity: str, min_instances,
                            min_gain, has_cat: bool, level: int, depth: int,
                            max_leaves: int, sf_b, lm_b, lv_b, cnt_b, fi_b,
@@ -1526,7 +1537,7 @@ def _tree_level_step_batch(hist_b, cat, fa_b, impurity: str, min_instances,
 
 @lru_cache(maxsize=None)
 def _row_unstack(k: int):
-    return jax.jit(lambda d: tuple(d[i] for i in range(k)))
+    return jax.jit(lambda d: tuple(d[i] for i in range(k)))  # shifu-lint: disable=recompile-hazard
 
 
 def _put_row_floats(mesh, cols: Dict[str, np.ndarray]) -> Dict[str, Any]:
@@ -1653,7 +1664,7 @@ def _c2f_feasible(settings: DTSettings, c: int, n_bins: int) -> bool:
     return 3 * settings.depth * width * k * n_bins * 2 * 4 <= budget
 
 
-@jax.jit
+@jax.jit  # shifu-lint: disable=recompile-hazard
 def _pack_streamed_stacked(sf_b, lm_b, lv_b, fi_b, sums_b):
     """[TB, L] packer for a stacked tail batch — jitted so the
     partitioner reconciles whatever shardings the parts carry (an eager
@@ -1729,7 +1740,7 @@ def _init_score_jit(loss: str):
             p = jnp.clip(prior, 1e-6, 1 - 1e-6)
             return jnp.log(p / (1 - p))
         return prior
-    return jax.jit(f)
+    return jax.jit(f)  # shifu-lint: disable=recompile-hazard
 
 
 @lru_cache(maxsize=None)
@@ -1739,7 +1750,7 @@ def _bcast_rows(rows: int, mesh=None):
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
         kw["out_shardings"] = NamedSharding(mesh, P("data"))
-    return jax.jit(lambda s: jnp.broadcast_to(s, (rows,)), **kw)
+    return jax.jit(lambda s: jnp.broadcast_to(s, (rows,)), **kw)  # shifu-lint: disable=recompile-hazard
 
 
 def _progress_flusher(drain, history, progress, idx_off: int):
@@ -2162,8 +2173,10 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                     # evidence below the divergence so the NEXT tree
                     # speculates from full-depth, exactly-routed
                     # evidence.
-                    obs.counter("train.tail_repairs").inc()
-                    obs.counter("train.tail_repair_levels").inc(
+                    # repair is the speculation MISS branch — rare
+                    # or the schedule auto-falls-back entirely
+                    obs.counter("train.tail_repairs").inc()  # shifu-lint: disable=telemetry-guard
+                    obs.counter("train.tail_repair_levels").inc(  # shifu-lint: disable=telemetry-guard
                         settings.depth - mis)
                     fi_base = jnp.sum(fi_lv[:mis + 1], axis=0)
                     cap: Dict[int, Any] = {} if cand_k == 0 else None
@@ -2207,7 +2220,8 @@ def train_gbt_streamed(stream, n_bins: int, cat_mask,
                                   history[:built - 1], init_host(),
                                   tail_scores())
                 if lowmis_run >= 6 and built < settings.n_trees:
-                    obs.counter("train.tail_c2f_fallbacks").inc()
+                    # fires at most once per train (exits c2f)
+                    obs.counter("train.tail_c2f_fallbacks").inc()  # shifu-lint: disable=telemetry-guard
                     log.info("GBT tail: speculation repaired near the "
                              "root %d trees running — falling back to "
                              "the exact per-level schedule at tree %d",
@@ -2342,7 +2356,7 @@ def _concat_rows_jit(k: int):
     """jitted row-concat — eager concatenation of mesh-sharded window
     arrays aborts XLA:CPU (the known eager-reshard SIGABRT); under jit
     the partitioner inserts the reshard."""
-    return jax.jit(lambda *xs: jnp.concatenate(xs, axis=0))
+    return jax.jit(lambda *xs: jnp.concatenate(xs, axis=0))  # shifu-lint: disable=recompile-hazard
 
 
 def _concat_rows(xs):
@@ -2976,8 +2990,9 @@ def _run_tree_ova_bagged(proc, shards, col_nums, cat_mask, n_bins,
                                               settings.seed, b),
                         y_transform=yt)
                     for b in range(bags)]
-                np.save(fi_path(k), np.sum([r.feature_importance
-                                            for r in results], axis=0))
+                ioutil.atomic_save_npy(
+                    fi_path(k), np.sum([r.feature_importance
+                                        for r in results], axis=0))
                 _save_ova_bag_results(proc, results, alg, k, K, settings,
                                       n_bins, col_nums, feature_names,
                                       ext, pf)
@@ -3000,8 +3015,9 @@ def _run_tree_ova_bagged(proc, shards, col_nums, cat_mask, n_bins,
                 results = train_rf_bagged(
                     bins, yk, tw_m * w[None, :], n_bins, cat_mask,
                     settings_list, mesh=mesh)
-            np.save(fi_path(k), np.sum([r.feature_importance
-                                        for r in results], axis=0))
+            ioutil.atomic_save_npy(
+                fi_path(k), np.sum([r.feature_importance
+                                    for r in results], axis=0))
             _save_ova_bag_results(proc, results, alg, k, K, settings,
                                   n_bins, col_nums, feature_names, ext, pf)
     for k in range(K):      # FI sidecars survive resume-skipped classes
@@ -3123,7 +3139,8 @@ def _run_tree_ova(proc, shards, col_nums, cat_mask, n_bins,
                 feature_names=shards.schema.get("columnNames"),
                 **res.spec_kwargs)
             tree_model.save_model(model_path, spec, res.trees)
-            np.save(fi_path(k), np.asarray(res.feature_importance))
+            ioutil.atomic_save_npy(fi_path(k),
+                                   np.asarray(res.feature_importance))
             log.info("train %s OVA class %d/%d: %d trees, valid err %.6f",
                      alg.name, k + 1, K, res.trees_built, res.valid_error)
     fi_total = np.zeros(len(col_nums))
@@ -3250,7 +3267,8 @@ def _run_tree_multi(proc, shards, col_nums, cat_mask, n_bins, alg,
                                             base.seed, distinct=True)
 
     results: List[Optional[ForestResult]] = [None] * len(settings_list)
-    with open(proc.paths.progress_path, "w") as pf:
+    trees_c = obs.counter("train.trees")
+    with open(proc.paths.progress_path, "w") as pf:  # shifu-lint: disable=atomic-write
         groups = tree_stackable_groups(trials) if is_gs \
             else [list(range(len(settings_list)))]
         for group in groups:
@@ -3261,7 +3279,7 @@ def _run_tree_multi(proc, shards, col_nums, cat_mask, n_bins, alg,
                     pf.write(f"{label} Tree #{ti + 1} Train Error: "
                              f"{tr:.6f} Validation Error: {va:.6f}\n")
                 pf.flush()
-                obs.counter("train.trees").inc(res.trees_built)
+                trees_c.inc(res.trees_built)
                 obs.event("forest_member", trainer=alg.name.lower(),
                           member=j, trees=res.trees_built,
                           valid_err=round(res.valid_error, 6))
@@ -3380,7 +3398,7 @@ def run_tree_training(proc) -> int:
                                     shards)
 
     progress_path = proc.paths.progress_path
-    with open(progress_path, "w") as pf:
+    with open(progress_path, "w") as pf:  # shifu-lint: disable=atomic-write
         def progress(ti, tr, va):
             line = (f"Tree #{ti + 1} Train Error: {tr:.6f} "
                     f"Validation Error: {va:.6f}")

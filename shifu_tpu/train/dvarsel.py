@@ -30,6 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..models import nn as nn_model
 from .optimizers import make_optimizer
 
@@ -106,7 +107,7 @@ def make_population_evaluator(x: np.ndarray, y: np.ndarray,
         return nn_model.weighted_loss(masked_params(params, m), spec,
                                       xj, yj, twj)
 
-    @jax.jit
+    @obs.costed_jit("varsel.genetic.train")
     def train(masks):
         P = masks.shape[0]
         stacked = jax.tree_util.tree_map(
@@ -204,7 +205,7 @@ def make_streamed_population_evaluator(stream, settings: WrapperSettings,
         return [{"w": params[0]["w"] * m[:, None], "b": params[0]["b"]}] \
             + params[1:]
 
-    @jax.jit
+    @obs.costed_jit("varsel.genetic.window_update")
     def window_update(stacked, opt_state, masks, xb, yb, tw):
         """One minibatch (= window) ADAM step for every member at once."""
         def one(params, ostate, m):
@@ -219,7 +220,7 @@ def make_streamed_population_evaluator(stream, settings: WrapperSettings,
             return params, ostate
         return jax.vmap(one)(stacked, opt_state, masks)
 
-    @jax.jit
+    @obs.costed_jit("varsel.genetic.window_fitness")
     def window_fitness(stacked, masks, acc, xb, yb, vw):
         def one(params, m):
             pred = nn_model.forward(masked_params(params, m), spec, xb)
@@ -255,12 +256,13 @@ def make_streamed_population_evaluator(stream, settings: WrapperSettings,
             np.asarray(feat_masks, np.float32),
             NamedSharding(mesh, Spec("ensemble", None)))
         stacked, opt_state = stacked0, opt0
+        win_c = obs.counter("varsel.windows")
         for _ in range(settings.epochs):
             for it in cache.items():
                 stacked, opt_state = window_update(
                     stacked, opt_state, masks, it.arrays["x"],
                     it.arrays["y"], it.arrays["tw"])
-                obs.counter("varsel.windows").inc()
+                win_c.inc()
         acc = jnp.zeros((feat_masks.shape[0], 2))
         for it in cache.items():
             acc = window_fitness(stacked, masks, acc, it.arrays["x"],

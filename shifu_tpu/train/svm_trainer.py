@@ -43,7 +43,8 @@ log = logging.getLogger(__name__)
 MAX_KERNEL_ROWS = 16384
 
 
-@partial(jax.jit, static_argnames=("spec_key", "iters"))
+@obs.costed_jit("svm.solve_dual", lazy=True,
+                static_argnames=("spec_key", "iters"))
 def _solve_dual(x, y_pm, train_mask, c_box, gamma, coef0,
                 spec_key: Tuple, iters: int):
     """Projected gradient ascent on the augmented dual.  ``c_box`` is the

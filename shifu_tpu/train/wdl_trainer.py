@@ -602,7 +602,7 @@ def run_wdl_training(proc) -> int:
     streaming = proc._use_streaming(norm, schema) \
         if hasattr(proc, "_use_streaming") else False
 
-    with open(proc.paths.progress_path, "w") as pf:
+    with open(proc.paths.progress_path, "w") as pf:  # shifu-lint: disable=atomic-write
         def progress(epoch, tr, va):
             pf.write(f"Epoch #{epoch + 1} Train Error: {tr:.6f} "
                      f"Validation Error: {va:.6f}\n")
@@ -702,7 +702,7 @@ def _run_wdl_grid(proc, trials) -> int:
     x_num, x_cat, num_feat_idx, cat_col_idx, num_nums, cat_nums = \
         split_planes(x, bins, schema, proc.column_configs)
     results = []
-    with open(proc.paths.progress_path, "w") as pf:
+    with open(proc.paths.progress_path, "w") as pf:  # shifu-lint: disable=atomic-write
         for ti, p in enumerate(trials):
             spec = _make_spec(x_num.shape[1], by_num, cat_nums, num_nums,
                               num_feat_idx, cat_col_idx, p)

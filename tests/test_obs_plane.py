@@ -679,32 +679,19 @@ def test_bench_compare_cli_exit_codes(tmp_path):
 
 
 # ----------------------------------------------------- manifest lint
-_CALL_RE = re.compile(
-    r"(?:\bobs|\bregistry|_registry)\s*\.\s*"
-    r"(counter|gauge|histogram)\(\s*(f?)\"([^\"]*)\"")
+# The grep-based metric/span scans that lived here through round 12 are
+# now first-class AST rules in shifu_tpu/lint (metric-manifest,
+# span-manifest, fault-site).  These thin tests keep the tier-1
+# coverage — same contract, one framework — and pin the manifests'
+# own well-formedness; rule MECHANICS (seeded violations, suppression,
+# baseline) live in tests/test_lint.py.
 
 
-def _instrument_call_sites():
-    """(path, kind, is_fstring, name_literal) for every string-literal
-    instrument creation under shifu_tpu/."""
-    sites = []
-    pkg = os.path.join(REPO, "shifu_tpu")
-    for dirpath, _, files in os.walk(pkg):
-        for fn in files:
-            # manifest.py is the declaration file — its docstring shows
-            # the call-site syntax it lints
-            if not fn.endswith(".py") or fn == "manifest.py":
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path) as f:
-                src = f.read()
-            for m in _CALL_RE.finditer(src):
-                kind, fstr, name = m.group(1), m.group(2), m.group(3)
-                if fstr:
-                    name = name.split("{")[0]
-                sites.append((os.path.relpath(path, REPO), kind,
-                              bool(fstr), name))
-    return sites
+def _manifest_findings(rule: str):
+    from shifu_tpu.lint import run_lint
+    findings, engine = run_lint(rules=[rule], full_tree=False)
+    assert engine.files_scanned > 60         # the scan really sees the tree
+    return findings
 
 
 def test_every_metric_name_is_declared_in_manifest():
@@ -712,76 +699,26 @@ def test_every_metric_name_is_declared_in_manifest():
     registry creates on first use) — every counter/gauge/histogram name
     used anywhere in shifu_tpu/ must be declared in obs.manifest, with
     the declared instrument type; f-string families must start with a
-    declared prefix."""
+    declared prefix.  Runs the metric-manifest rule through the engine."""
     from shifu_tpu.obs import manifest
-    sites = _instrument_call_sites()
-    assert len(sites) > 40                   # the scan really sees the tree
-    problems = []
-    for path, kind, fstr, name in sites:
-        if fstr:
-            if not any(name.startswith(p) for p in manifest.PREFIXES):
-                problems.append(f"{path}: f-string {kind} {name!r} has no "
-                                "declared prefix")
-            continue
-        if not manifest.is_declared(name):
-            problems.append(f"{path}: {kind} {name!r} not in MANIFEST")
-        elif name in manifest.MANIFEST \
-                and manifest.MANIFEST[name][0] != kind:
-            problems.append(
-                f"{path}: {name!r} used as {kind} but declared "
-                f"{manifest.MANIFEST[name][0]}")
-    assert not problems, "\n".join(problems)
+    problems = _manifest_findings("metric-manifest")
+    assert not problems, "\n".join(f.render() for f in problems)
     # the declared set itself is well-formed
     for name, (kind, help_) in manifest.MANIFEST.items():
         assert kind in ("counter", "gauge", "histogram"), name
         assert help_, name
 
 
-_SPAN_RE = re.compile(
-    r"\b(?:obs|tracer)\s*\.\s*(?:span|record_span)\(\s*(f?)\"([^\"]*)\"")
-
-
-def _span_call_sites():
-    """(path, is_fstring, name_literal) for every string-literal span
-    creation under shifu_tpu/ (obs.span / obs.record_span)."""
-    sites = []
-    pkg = os.path.join(REPO, "shifu_tpu")
-    for dirpath, _, files in os.walk(pkg):
-        for fn in files:
-            if not fn.endswith(".py") or fn == "manifest.py":
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path) as f:
-                src = f.read()
-            for m in _SPAN_RE.finditer(src):
-                fstr, name = m.group(1), m.group(2)
-                if fstr:
-                    name = name.split("{")[0]
-                sites.append((os.path.relpath(path, REPO), bool(fstr),
-                              name))
-    return sites
-
-
 def test_every_span_name_literal_is_declared_in_manifest():
     """Satellite lint: the timeline tracks / report sections / tests
     join on span-name literals, so a typo'd span name silently vanishes
     from every report — every obs.span("...") / obs.record_span("...")
-    literal must be declared in obs.manifest.SPANS (or start with a
-    declared SPAN_PREFIXES family).  Step-root spans named by variable
+    literal must resolve against obs.manifest.SPANS (or a declared
+    SPAN_PREFIXES family).  Step-root spans named by variable
     (obs.span(self.profile_name, ...)) ride outside the lint."""
     from shifu_tpu.obs import manifest
-    sites = _span_call_sites()
-    assert len(sites) > 8                    # the scan really sees the tree
-    problems = []
-    for path, fstr, name in sites:
-        if fstr:
-            if not any(name.startswith(p)
-                       for p in manifest.SPAN_PREFIXES):
-                problems.append(f"{path}: f-string span {name!r} has no "
-                                "declared prefix")
-        elif not manifest.is_declared_span(name):
-            problems.append(f"{path}: span {name!r} not in SPANS")
-    assert not problems, "\n".join(problems)
+    problems = _manifest_findings("span-manifest")
+    assert not problems, "\n".join(f.render() for f in problems)
     # the declared span set itself is well-formed, and the serve plane's
     # request/batch spans are present
     for name, help_ in manifest.SPANS.items():
@@ -790,6 +727,19 @@ def test_every_span_name_literal_is_declared_in_manifest():
     assert "serve.batch" in manifest.SPANS
     assert manifest.is_declared_span("bench.serve")
     assert not manifest.is_declared_span("serve.requst")   # the typo case
+
+
+def test_every_fault_site_literal_is_declared():
+    """Every faults.fire(site, point, ...) literal resolves against the
+    faults.SITES manifest (an undeclared site could never be armed from
+    the documented spec grammar and would silently never fire)."""
+    from shifu_tpu import faults
+    problems = _manifest_findings("fault-site")
+    assert not problems, "\n".join(f.render() for f in problems)
+    for (site, point), help_ in faults.SITES.items():
+        assert site and point and help_, (site, point)
+    assert faults.is_declared_site("serve", "swap")
+    assert not faults.is_declared_site("serve", "swapz")
 
 
 def test_obs_reexport_audit():
