@@ -31,7 +31,7 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--plane",
                     choices=("all", "tail", "rf-repeat", "e2e", "resume",
-                             "varsel", "serve"),
+                             "varsel", "serve", "multihost"),
                     default="all",
                     help="'tail' = quick disk-tail streamed-GBT bench; "
                          "'rf-repeat' = RF variance triage (cold-compile "
@@ -46,7 +46,10 @@ def main() -> None:
                          "selections; 'serve' = online-serving plane "
                          "(AOT padded-bucket scorer + micro-batcher: "
                          "sustained QPS, p50/p99 per offered load, "
-                         "zero-recompile guard)")
+                         "zero-recompile guard); 'multihost' = elastic "
+                         "multi-controller plane (1/2/4-process quorum-"
+                         "gated scaling curve + time-to-recover after a "
+                         "mid-train controller kill)")
     ap.add_argument("--compare", nargs="*", metavar="PAYLOAD.json",
                     default=None,
                     help="regression-diff two bench payloads (raw JSON "

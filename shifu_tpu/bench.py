@@ -62,7 +62,12 @@ from . import ioutil, obs
 # tracked beside the f32 rows) and serve_quantized_* (uint8-traversal
 # AOT scorer throughput + bit-parity flag) extras; --compare picks the
 # new *_mfu / *_per_sec / *_qps names up via the existing classes.
-BENCH_TELEMETRY_SCHEMA = 9
+# v10: elastic multi-controller plane — dcn.* instruments + the
+# quorum_lost monitor field; the bench gains --plane multihost
+# (multihost_{1,2,4}p_rows_per_sec scaling curve, tracked by --compare,
+# and multihost_recover_s time-to-recover-after-kill, tracked in the
+# lower-is-better class via the new *_recover_s suffix).
+BENCH_TELEMETRY_SCHEMA = 10
 
 # measured on this rig (tools/measure_baseline.py); provenance in
 # BASELINE.md — every headline divides by a MEASURED reference-class
@@ -1406,6 +1411,126 @@ def load_bench_file(path: str) -> Dict[str, Any]:
     return doc
 
 
+def bench_multihost(rows: int = 8192, features: int = 16,
+                    epochs: int = 6, kill_step: int = 3
+                    ) -> Dict[str, Any]:
+    """Elastic multi-controller plane (``bench.py --plane multihost``):
+    the quorum-gated streamed NN job (parallel/elastic) measured two
+    ways —
+
+    - **scaling curve**: the SAME global dataset trained by 1, 2 and 4
+      controller processes (each owning 1/N of the rows; the per-epoch
+      combine rides the ``telemetry/steps/`` control plane), reported
+      as global rows*epochs per second of the slowest controller
+      (``multihost_{1,2,4}p_rows_per_sec``, tracked by ``--compare``)
+      plus scaling efficiency vs the 1-process run;
+    - **time-to-recover**: a 2-controller quorum-mode run
+      (quorumFrac 0.97, 2 s step timeout) where one controller is
+      SIGKILL-equivalently killed at an injected ``dcn:step`` boundary;
+      the survivor finishes under quorum, the controller is relaunched,
+      and ``multihost_recover_s`` is relaunch → rejoined-and-finished
+      wall (journal catch-up + the remaining live steps; tracked
+      LOWER-is-better via the ``*_recover_s`` suffix).
+
+    The bench asserts the monitor's verdict of the recover run: every
+    controller's final heartbeat is ``exited`` (no permanent straggler
+    in the step-lag table) and the rejoiner replayed a non-empty
+    committed prefix.  Runs on any backend — the elastic path needs no
+    cross-process collectives, which is its point."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def launch(out: str, proc: int, nproc: int, mode_args, env_extra=None):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        env.setdefault("SHIFU_TPU_HEARTBEAT_S", "0.25")
+        env.update(env_extra or {})
+        cmd = [sys.executable, "-m", "shifu_tpu.parallel.elastic_demo",
+               "--out", out, "--proc", str(proc), "--nproc", str(nproc),
+               "--rows", str(rows), "--features", str(features),
+               "--epochs", str(epochs)] + list(mode_args)
+        return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+
+    def wait_all(procs, what: str):
+        for i, p in enumerate(procs):
+            out_txt, _ = p.communicate(timeout=600)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"multihost bench: {what} controller {i} failed "
+                    f"rc={p.returncode}:\n{out_txt[-2000:]}")
+
+    def result(out: str, proc: int) -> Dict[str, Any]:
+        with open(os.path.join(out, f"result-{proc}.json")) as f:
+            return _json.load(f)
+
+    sync_args = ["--quorum-frac", "1.0", "--timeout-ms", "120000"]
+    extras: Dict[str, Any] = {}
+    rates: Dict[int, float] = {}
+    with tempfile.TemporaryDirectory(prefix="shifu_mh_bench_") as td:
+        # ---- 1 -> 2 -> 4 controller scaling (sync mode: every step
+        # waits for every live member, the worst case for the protocol)
+        for nproc in (1, 2, 4):
+            out = os.path.join(td, f"scale{nproc}")
+            wait_all([launch(out, p, nproc, sync_args)
+                      for p in range(nproc)], f"{nproc}p")
+            slowest = max(result(out, p)["train_s"] for p in range(nproc))
+            rates[nproc] = rows * epochs / slowest
+            extras[f"multihost_{nproc}p_rows_per_sec"] = round(
+                rates[nproc], 1)
+        extras["multihost_scaling_eff_2p"] = round(rates[2] / rates[1], 3)
+        extras["multihost_scaling_eff_4p"] = round(rates[4] / rates[1], 3)
+
+        # ---- kill one controller mid-train, relaunch, time the recover
+        quorum_args = ["--quorum-frac", "0.97", "--timeout-ms", "2000"]
+        out = os.path.join(td, "recover")
+        survivor = launch(out, 0, 2, quorum_args)
+        victim = launch(out, 1, 2, quorum_args,
+                        env_extra={"SHIFU_TPU_FAULTS":
+                                   f"dcn:step={kill_step}:kill"})
+        v_out, _ = victim.communicate(timeout=600)
+        if victim.returncode != 137:
+            raise RuntimeError(
+                "multihost bench: victim controller did not die at the "
+                f"injected dcn:step boundary (rc={victim.returncode}):\n"
+                + v_out[-2000:])
+        t0 = time.perf_counter()
+        rejoiner = launch(out, 1, 2, quorum_args)
+        wait_all([survivor, rejoiner], "recover")
+        recover_s = time.perf_counter() - t0
+        rj = result(out, 1)
+        if not rj["dcn"]["rejoined"] or rj["dcn"]["catchup_steps"] <= 0:
+            raise RuntimeError("multihost bench: relaunched controller "
+                               f"did not rejoin from its journal: {rj}")
+        extras["multihost_recover_s"] = round(recover_s, 3)
+        extras["multihost_recover_catchup_steps"] = \
+            rj["dcn"]["catchup_steps"]
+        extras["multihost_kill_step"] = kill_step
+
+        # ---- the monitor's verdict: no permanent straggler
+        from shifu_tpu.obs.monitor import aggregate_records, step_lag_table
+        recs, counts = aggregate_records([out])
+        lag = step_lag_table(recs)
+        bad = [r["proc"] for r in recs if r["status"] in ("stalled",
+                                                          "stale")]
+        if bad:
+            raise RuntimeError("multihost bench: permanent straggler(s) "
+                               f"after the recover run: {bad}")
+        extras["multihost_recover_controllers_exited"] = \
+            counts.get("exited", 0)
+        extras["multihost_step_lag_rows"] = len(lag)
+    extras["multihost_shape"] = (f"{rows} rows x {features} feats, "
+                                 f"{epochs} epochs, kill at step "
+                                 f"{kill_step}")
+    return extras
+
+
 def bench_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
     """Flatten a payload to {metric: value}: the headline plus every
     numeric top-level extra."""
@@ -1443,7 +1568,8 @@ def is_tracked_latency(name: str) -> bool:
     if name.endswith("_error") or name.endswith("_vs_baseline"):
         return False
     return ("_p50" in name or "_p99" in name
-            or name.endswith("_queue_frac") or name.endswith("_pad_frac"))
+            or name.endswith("_queue_frac") or name.endswith("_pad_frac")
+            or name.endswith("_recover_s"))
 
 
 def compare_bench(old: Dict[str, Any], new: Dict[str, Any],
@@ -1663,10 +1789,25 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
                                    "north-star workers (BASELINE.md)",
             "extra": rep,
         }
+    if plane == "multihost":
+        with obs.span("bench.multihost", kind="bench"):
+            rep = bench_multihost()
+        for k, v in rep.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                obs.gauge(f"bench.{k}").set(float(v))
+        return {
+            "metric": "multihost_2p_rows_per_sec",
+            "value": rep["multihost_2p_rows_per_sec"],
+            "unit": "rows*epochs/sec",
+            "plane": "multihost",
+            "telemetry_schema_version": BENCH_TELEMETRY_SCHEMA,
+            "shape": rep["multihost_shape"],
+            "extra": rep,
+        }
     if plane not in (None, "all"):
         raise ValueError(
             f"unknown bench plane {plane!r} "
-            "(tail|rf-repeat|e2e|resume|varsel|serve|all)")
+            "(tail|rf-repeat|e2e|resume|varsel|serve|multihost|all)")
     nn_cost: Dict[str, Any] = {}
     nn_rows_per_sec = bench_nn(collect=nn_cost)
     obs.gauge("bench.nn_train_throughput").set(nn_rows_per_sec)

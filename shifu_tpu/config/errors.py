@@ -38,6 +38,11 @@ class ErrorCode(enum.Enum):
     # torn/incomplete (crash-consistency layer, pipeline/journal.py)
     ERROR_TORN_ARTIFACT = (
         1062, "A pipeline artifact is torn or incomplete")
+    # rebuild-specific: the multi-controller coordinator connect retry
+    # ladder exhausted (parallel/mesh.initialize_distributed) — raised
+    # coded instead of hanging the launcher on a dead coordinator
+    ERROR_DCN_CONNECT = (
+        1063, "Could not connect to the distributed coordinator")
     # --- data shape (1150s)
     ERROR_EXCEED_COL = (1151, "Input data has more fields than the header")
     ERROR_LESS_COL = (1152, "Input data has fewer fields than the header")

@@ -168,6 +168,19 @@ _DECLS: Tuple[Knob, ...] = (
        "p99 latency SLO (default 2x maxDelayMs)"),
     _k("shifu.serve.sloAvailability", "property", "float", "0.999",
        "availability SLO for error-budget burn alerts"),
+    # ---- multi-host / elastic DCN plane
+    _k("shifu.dcn.elastic", "property", "bool", "false",
+       "quorum-gated elastic multi-controller step protocol (the "
+       "in-mesh psum path stays the fast default)"),
+    _k("shifu.dcn.quorumFrac", "property", "float", "0.97",
+       "fraction of live controllers whose contributions close a step "
+       "(also the monitor's QUORUM LOST threshold)"),
+    _k("shifu.dcn.stepTimeoutMs", "property", "float", "2000",
+       "elastic step timeout: survivors proceed with the partial "
+       "aggregate after this"),
+    _k("shifu.dcn.staleness", "property", "int", "0",
+       "bounded-staleness window: late contributions fold into a close "
+       "within this many steps (0 = quorum mode, drop late)"),
     # ---- multi-host / launcher
     _k("SHIFU_COORDINATOR", "env", "str", "",
        "jax.distributed coordinator address (host:port); unset = "
@@ -176,6 +189,8 @@ _DECLS: Tuple[Knob, ...] = (
        "process count for the multi-controller job"),
     _k("SHIFU_PROCESS_ID", "env", "int", "",
        "this controller's process index"),
+    _k("SHIFU_MH_CACHE", "env", "str", "/tmp/shifu_tpu_mh_cache",
+       "multihost demo/bench workers' own XLA compile-cache dir"),
     _k("SHIFU_TPU_HOME", "env", "str", "",
        "home dir holding conf/shifuconfig global properties"),
     _k("SHIFU_HOME", "env", "str", "",

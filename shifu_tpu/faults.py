@@ -90,6 +90,11 @@ SITES: dict = {
     ("serve", "request"): "before serving batch k's device launch",
     ("serve", "swap"): "after a hot-swap candidate is built+warmed, "
                        "before the journal commit and the live flip",
+    ("dcn", "step"): "at elastic step s's boundary, before this "
+                     "controller's contribution commit — a kill here is "
+                     "the worker-loss drill the quorum must mask",
+    ("train", "rejoin"): "when a rejoined controller starts replaying "
+                         "committed step s from the close journal",
 }
 
 

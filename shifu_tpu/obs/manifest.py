@@ -126,6 +126,36 @@ MANIFEST: Dict[str, Tuple[str, str]] = {
                            "max error-budget burn over the long "
                            "(whole-ring) horizon"),
     "slo.alerts_firing": ("gauge", "burn-rate alert rules currently firing"),
+    # ---- elastic DCN plane (parallel/elastic, parallel/mesh)
+    "dcn.connect_retries": ("counter",
+                            "coordinator connect failures absorbed by "
+                            "the bounded backoff ladder"),
+    "dcn.steps_closed": ("counter", "elastic steps this controller "
+                                    "closed (won the exclusive commit)"),
+    "dcn.step_timeouts": ("counter",
+                          "elastic steps closed on stepTimeoutMs with "
+                          "stragglers outstanding"),
+    "dcn.step_wait_seconds": ("counter",
+                              "time blocked waiting for quorum/close "
+                              "(the straggler-masking cost)"),
+    "dcn.late_applied": ("counter",
+                         "late contributions folded into a later close "
+                         "within the staleness window"),
+    "dcn.late_dropped": ("counter",
+                         "late contributions dropped past the staleness "
+                         "window (quorum mode drops all)"),
+    "dcn.catchup_steps": ("counter",
+                          "steps replayed from the close journal "
+                          "instead of recomputed"),
+    "dcn.rejoins": ("counter",
+                    "controller restarts that rejoined a live job "
+                    "(incarnation > 1)"),
+    "dcn.membership_epoch": ("gauge",
+                             "current membership epoch (bumps on "
+                             "join/leave/rejoin)"),
+    "dcn.live_members": ("gauge",
+                         "controllers the heartbeat staleness rule "
+                         "considers alive"),
     # ---- drift monitor (obs/drift)
     "drift.rows": ("gauge", "rows folded into the live drift counts"),
     "drift.columns_tracked": ("gauge", "columns with a training snapshot"),
@@ -152,6 +182,8 @@ SPANS: Dict[str, str] = {
                       "wait / pad / launch / device decomposition"),
     "serve.batch": ("sampled padded-bucket launch; links the member "
                     "requests' trace ids (fan-in causality)"),
+    "dcn.step": ("elastic quorum step: contribute -> wait for quorum/"
+                 "timeout/peer close -> adopt the committed aggregate"),
 }
 
 # span families whose names embed data (the bench's per-plane spans)

@@ -49,6 +49,26 @@ _STATE_FLAGS = {"live": "", "stalled": "  << STALLED (no progress)",
 CLOCK_OFFSET_MIN_S = 1.0
 
 
+def quorum_objective() -> float:
+    """The QUORUM LOST threshold — the same ``shifu.dcn.quorumFrac``
+    the elastic step protocol closes on (parallel/elastic): when fewer
+    than this fraction of active processes are still heartbeating, the
+    job can no longer close steps by quorum."""
+    from ..config import environment
+    return environment.get_float("shifu.dcn.quorumFrac", 0.97)
+
+
+def _quorum_state(recs: List[Dict[str, Any]], counts: Dict[str, int]
+                  ) -> Tuple[int, int, float, bool]:
+    """(healthy, active, quorum fraction, lost?) — stalled counts as
+    heartbeating (a straggler is alive), stale/dead does not."""
+    healthy = counts.get("live", 0) + counts.get("stalled", 0)
+    active = len(recs) - counts.get("exited", 0)
+    quorum = healthy / active if active else 1.0
+    return healthy, active, quorum, bool(active) and \
+        quorum < quorum_objective()
+
+
 def _age(rec: Dict[str, Any], now: float) -> float:
     return max(0.0, now - float(rec.get("ts") or 0.0))
 
@@ -119,14 +139,17 @@ def _render_table(recs: List[Dict[str, Any]], counts: Dict[str, int],
             f"{_fmt_count(rec.get('trees')):>7}"
             f"{_fmt_count(rec.get('epochs')):>7}  {_row_phase(rec)}"
             f"{_row_flags(rec)}")
-    healthy = counts.get("live", 0) + counts.get("stalled", 0)
-    active = len(recs) - counts.get("exited", 0)
+    healthy, active, quorum, lost = _quorum_state(recs, counts)
     parts = [f"{counts.get(k, 0)} {k}" for k in
              ("live", "stalled", "stale", "exited") if counts.get(k)]
-    quorum = healthy / active if active else 1.0
     out.append(f"-- {', '.join(parts) or 'no processes'}; "
                f"quorum {healthy}/{active} ({quorum:.0%}) of active "
                "processes heartbeating")
+    if lost:
+        out.append(f"-- << QUORUM LOST: {quorum:.0%} heartbeating is "
+                   f"below shifu.dcn.quorumFrac "
+                   f"{quorum_objective():.2f} — elastic steps can only "
+                   "close by timeout; check the stale processes")
     return out
 
 
@@ -153,8 +176,7 @@ def status_json(model_set_dir: str, now: Optional[float] = None
     recs, counts = status_records(model_set_dir, now=now)
     for rec in recs:
         rec.pop("_file", None)               # host path, not health state
-    healthy = counts.get("live", 0) + counts.get("stalled", 0)
-    active = len(recs) - counts.get("exited", 0)
+    healthy, active, quorum, lost = _quorum_state(recs, counts)
     unhealthy = counts.get("stalled", 0) + counts.get("stale", 0)
     doc = {
         "kind": "monitor",
@@ -168,10 +190,11 @@ def status_json(model_set_dir: str, now: Optional[float] = None
                        for k in ("live", "stalled", "stale", "exited")},
             "active": active,
             "healthy": healthy,
-            "quorum": round(healthy / active, 4) if active else 1.0,
+            "quorum": round(quorum, 4),
+            "quorum_lost": lost,
         },
     }
-    return doc, (EXIT_UNHEALTHY if unhealthy else 0)
+    return doc, (EXIT_UNHEALTHY if unhealthy or lost else 0)
 
 
 # ------------------------------------------------- cross-process merge
@@ -298,8 +321,7 @@ def aggregate_json(dirs: Sequence[str], now: Optional[float] = None
     for rec in recs:
         rec.pop("_file", None)
         rec.pop("_dir", None)
-    healthy = counts.get("live", 0) + counts.get("stalled", 0)
-    active = len(recs) - counts.get("exited", 0)
+    healthy, active, quorum, lost = _quorum_state(recs, counts)
     unhealthy = counts.get("stalled", 0) + counts.get("stale", 0)
     doc = {
         "kind": "monitor_aggregate",
@@ -316,10 +338,11 @@ def aggregate_json(dirs: Sequence[str], now: Optional[float] = None
                        for k in ("live", "stalled", "stale", "exited")},
             "active": active,
             "healthy": healthy,
-            "quorum": round(healthy / active, 4) if active else 1.0,
+            "quorum": round(quorum, 4),
+            "quorum_lost": lost,
         },
     }
-    return doc, (EXIT_UNHEALTHY if unhealthy else 0)
+    return doc, (EXIT_UNHEALTHY if unhealthy or lost else 0)
 
 
 def run_monitor(model_set_dir: str, interval_s: float = 2.0,
@@ -328,12 +351,14 @@ def run_monitor(model_set_dir: str, interval_s: float = 2.0,
                 aggregate_dirs: Optional[Sequence[str]] = None,
                 _print=print) -> int:
     """The CLI loop: render a frame every ``interval_s`` until
-    interrupted (``--once`` renders a single frame).  The human table
-    always exits 0 — an empty health dir is a message, not an error;
-    ``json_mode`` prints one JSON doc per frame and carries the health
-    exit code (0 ok / 3 any stalled-or-stale) so scripts can gate on
-    it.  ``aggregate_dirs`` switches to the merged multi-dir view
-    (``--aggregate``; replaces ``--dir``)."""
+    interrupted (``--once`` renders a single frame).  The single-dir
+    human table always exits 0 — an empty health dir is a message, not
+    an error; ``json_mode`` prints one JSON doc per frame and carries
+    the health exit code (0 ok / 3 any stalled-or-stale or QUORUM
+    LOST) so scripts can gate on it.  ``aggregate_dirs`` switches to
+    the merged multi-dir view (``--aggregate``; replaces ``--dir``);
+    its human table ALSO exits 3 when the quorum is lost (live members
+    below ``shifu.dcn.quorumFrac``) — the fleet-level page."""
     frames = 0
     rc = 0
     try:
@@ -344,6 +369,9 @@ def run_monitor(model_set_dir: str, interval_s: float = 2.0,
                     _print(json.dumps(doc, sort_keys=True))
                 else:
                     _print(render_aggregate(aggregate_dirs))
+                    recs, counts = aggregate_records(aggregate_dirs)
+                    rc = EXIT_UNHEALTHY \
+                        if _quorum_state(recs, counts)[3] else 0
             elif json_mode:
                 doc, rc = status_json(model_set_dir)
                 _print(json.dumps(doc, sort_keys=True))
@@ -351,8 +379,8 @@ def run_monitor(model_set_dir: str, interval_s: float = 2.0,
                 _print(render_status(model_set_dir))
             frames += 1
             if once or (max_frames is not None and frames >= max_frames):
-                return rc if json_mode else 0
+                return rc if (json_mode or aggregate_dirs) else 0
             _print("")
             time.sleep(interval_s)
     except KeyboardInterrupt:
-        return rc if json_mode else 0
+        return rc if (json_mode or aggregate_dirs) else 0

@@ -76,7 +76,13 @@ from typing import Any, Dict, List, Optional
 # quantized uint8 traversal kernel is opaque to XLA cost analysis), and
 # the bench emits ``nn_train_mixed_*`` / ``serve_quantized_*`` extras
 # (mixed-precision ladder + quantized serving scorer)
-SCHEMA_VERSION = 9
+# v10: elastic multi-controller plane — ``dcn.*`` instruments
+# (connect_retries / steps_closed / step_timeouts / step_wait_seconds /
+# late_applied / late_dropped / catchup_steps / rejoins counters,
+# membership_epoch / live_members gauges), the ``dcn.step`` span, the
+# monitor's ``quorum_lost`` summary field (aggregate + single-dir), and
+# the bench's ``multihost_*`` extras (1→2→4 scaling + time-to-recover)
+SCHEMA_VERSION = 10
 
 _TRUE = ("1", "true", "on", "yes")
 

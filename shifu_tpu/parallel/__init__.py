@@ -1,3 +1,6 @@
-"""Mesh/sharding substrate (see mesh.py)."""
+"""Mesh/sharding substrate (mesh.py) + the elastic multi-controller
+step protocol (elastic.py)."""
 
+from .elastic import (ElasticConfig, ElasticContext,  # noqa: F401
+                      elastic_context_for, elastic_enabled)
 from .mesh import device_mesh  # noqa: F401

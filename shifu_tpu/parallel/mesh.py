@@ -96,8 +96,17 @@ def initialize_distributed(coordinator: Optional[str] = None,
 
     Args default from SHIFU_COORDINATOR / SHIFU_NUM_PROCESSES /
     SHIFU_PROCESS_ID (set by the launcher, one process per host).
+
+    Coordinator connect rides the same bounded exponential-backoff+jitter
+    ladder as :func:`ioutil.io_retry` (``shifu.io.retries`` attempts,
+    ``shifu.io.retryBaseMs`` base; counter ``dcn.connect_retries``) —
+    a controller restarted into a live job retries while the coordinator
+    re-admits it, and an exhausted ladder raises a CODED error instead
+    of hanging the launcher.
     """
     import os
+    import random
+    import time
 
     coordinator = coordinator or os.environ.get("SHIFU_COORDINATOR")
     if coordinator is None:
@@ -107,8 +116,37 @@ def initialize_distributed(coordinator: Optional[str] = None,
         num_processes = int(os.environ["SHIFU_NUM_PROCESSES"])
     if process_id is None:
         process_id = int(os.environ["SHIFU_PROCESS_ID"])
-    jax.distributed.initialize(coordinator, num_processes=num_processes,
-                               process_id=process_id)
+    from ..config import environment
+    attempts = max(0, environment.get_int("shifu.io.retries", 3)) + 1
+    base = environment.get_int("shifu.io.retryBaseMs", 50) / 1000.0
+    for attempt in range(attempts):
+        try:
+            jax.distributed.initialize(coordinator,
+                                       num_processes=num_processes,
+                                       process_id=process_id)
+            return
+        except (OSError, RuntimeError, ValueError) as e:
+            # jaxlib surfaces connect/handshake failures as RuntimeError
+            # (XlaRuntimeError subclasses it); ValueError covers a
+            # malformed address.  A ladder that ends still raises CODED.
+            if attempt + 1 >= attempts:
+                from ..config.errors import ErrorCode, ShifuError
+                raise ShifuError(
+                    ErrorCode.ERROR_DCN_CONNECT,
+                    f"coordinator {coordinator} (process "
+                    f"{process_id}/{num_processes}) after {attempts} "
+                    f"attempt(s): {e}") from e
+            from .. import obs
+            # retry ladder only spins on coordinator weather — the
+            # factory lookup is as cold as the backoff sleep
+            obs.counter("dcn.connect_retries").inc()  # shifu-lint: disable=telemetry-guard
+            delay = base * (2 ** attempt) * (1.0 + random.random())
+            import logging
+            logging.getLogger(__name__).warning(
+                "jax.distributed.initialize(%s) failed (attempt %d/%d, "
+                "retrying in %.0f ms): %s", coordinator, attempt + 1,
+                attempts, delay * 1000, e)
+            time.sleep(delay)
 
 
 def shard_rows_from_local(mesh, local_rows: "np.ndarray"):

@@ -471,6 +471,17 @@ class TrainProcessor(BasicProcessor):
         log.info("train %s STREAMED: %d rows x %d features, window %d rows",
                  alg.name, n_rows, d, window_rows)
 
+        # elastic multi-controller mode (-Dshifu.dcn.elastic + a stable
+        # SHIFU_PROCESS_ID): the cross-process combine rides the quorum
+        # step protocol instead of the in-mesh psum — grid-search trials
+        # keep the synchronous path (their step namespaces would collide)
+        ectx = None
+        if not is_gs:
+            from ..parallel.elastic import elastic_context_for
+            ectx = elastic_context_for(self.dir, step_name="TRAIN")
+            if ectx is not None:
+                ectx.start()
+
         os.makedirs(self.paths.tmp_models_dir, exist_ok=True)
         t0 = time.time()
         results = []
@@ -532,13 +543,28 @@ class TrainProcessor(BasicProcessor):
                     if settings.batch_size == 0 else 0)
                 init_list = self._continuous_init(spec, n_members, alg,
                                                   settings)
-                res = train_ensemble_streamed(
-                    stream, spec, settings, n_members, mask_fn,
-                    init_params_list=init_list,
-                    progress=self._progress_fn(pf, run),
-                    checkpoint=self._checkpoint_fn(spec, alg), mesh=mesh,
-                    member_classes=member_classes)
+                run_elastic = ectx
+                if ectx is not None and settings.batch_size != 0:
+                    log.warning("elastic mode needs full-batch streaming "
+                                "(MiniBatchs=0); this run stays "
+                                "synchronous")
+                    run_elastic = None
+                try:
+                    res = train_ensemble_streamed(
+                        stream, spec, settings, n_members, mask_fn,
+                        init_params_list=init_list,
+                        progress=self._progress_fn(pf, run),
+                        checkpoint=self._checkpoint_fn(spec, alg),
+                        mesh=mesh, member_classes=member_classes,
+                        elastic=run_elastic)
+                except BaseException:
+                    if ectx is not None:
+                        ectx.stop(exit_code=1)
+                        ectx = None
+                    raise
                 results.append((run, spec, res, run_params))
+        if ectx is not None:
+            ectx.stop(exit_code=0)
 
         self._write_models(results, alg, is_gs)
         log.info("train done in %.1fs (streamed)", time.time() - t0)

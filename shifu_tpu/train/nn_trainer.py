@@ -584,18 +584,18 @@ def train_ensemble_streamed(stream, spec: nn_model.NNModelSpec,
                             checkpoint: Optional[Callable[[int, List[Any]],
                                                           None]] = None,
                             mesh=None,
-                            member_classes: Optional[List[int]] = None
-                            ) -> EnsembleResult:
+                            member_classes: Optional[List[int]] = None,
+                            elastic=None) -> EnsembleResult:
     """See :func:`_train_ensemble_streamed_impl`; precision wrapper as in
     :func:`train_ensemble`."""
     if settings.matmul_precision:
         with jax.default_matmul_precision(settings.matmul_precision):
             return _train_ensemble_streamed_impl(
                 stream, spec, settings, bags, mask_fn, init_params_list,
-                progress, checkpoint, mesh, member_classes)
+                progress, checkpoint, mesh, member_classes, elastic)
     return _train_ensemble_streamed_impl(
         stream, spec, settings, bags, mask_fn, init_params_list,
-        progress, checkpoint, mesh, member_classes)
+        progress, checkpoint, mesh, member_classes, elastic)
 
 
 def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
@@ -604,8 +604,8 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
                             progress: Optional[ProgressFn] = None,
                             checkpoint: Optional[Callable[[int, List[Any]], None]] = None,
                             mesh=None,
-                            member_classes: Optional[List[int]] = None
-                            ) -> EnsembleResult:
+                            member_classes: Optional[List[int]] = None,
+                            elastic=None) -> EnsembleResult:
     """Out-of-core ensemble training: one pass over ``stream.windows()`` per
     epoch, dataset never resident anywhere (the
     ``MemoryDiskFloatMLDataSet.java`` role, done the streaming-SPMD way).
@@ -626,9 +626,23 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
     Reported errors for epoch e are measured during pass e+1 (same params,
     one pass later) so each epoch streams the data once, not twice; a final
     eval-only pass closes the ledger.  Early stop therefore lags one epoch.
+
+    ``elastic`` (a :class:`parallel.elastic.ElasticContext`) switches the
+    CROSS-PROCESS combine from the in-mesh psum to the quorum-gated step
+    protocol: each controller streams its OWN shard set on its LOCAL
+    mesh, per-epoch unnormalized grad sums + eval stat sums post as one
+    contribution, and the epoch's update applies the committed quorum
+    aggregate (summed in sorted-controller order — every survivor steps
+    the same bits).  An epoch whose close record already exists is
+    REPLAYED from the journal without streaming (rejoin catch-up).
+    Elastic transport is f32; full-batch mode only.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    if elastic is not None and settings.batch_size != 0:
+        raise ValueError("elastic multi-controller training requires the "
+                         "full-batch streamed mode (batch_size=0): the "
+                         "quorum step protocol closes once per epoch")
     if mesh is None:
         mesh = meshlib.device_mesh(n_ensemble=bags)
     data_size = mesh.shape["data"]
@@ -760,6 +774,10 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
                                 jnp.float32 if precision == "mixed"
                                 else a.dtype), stacked), sh_ens)
 
+    if elastic is not None:
+        from ..parallel.elastic import grad_codec
+        _ravel_grads, _unravel_grads = grad_codec(zero_grads)
+
     full_batch = settings.batch_size == 0
     W = stream.window_rows
     if not full_batch:
@@ -842,29 +860,51 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
     for epoch in range(start_epoch, settings.epochs):
         key, sub = jax.random.split(key)
         rngs = jax.random.split(sub, bags)
-        stats_acc = jnp.zeros((bags, 4))
-        grad_acc = zero_grads
+        grad_flat = None
         params_entering = stacked   # params the epoch's stats are measured on
-        n_win = 0
-        for win in stream.windows():
-            xb, yb, tw, vw = put_window(win)
-            rngs_w = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
-                rngs, n_win) if dropout > 0 else rngs
-            if full_batch:
-                grad_acc, stats_acc = grad_eval_window(
-                    stacked, grad_acc, stats_acc, xb, yb, tw, vw, rngs_w)
+        replayed = elastic.closed_step(epoch) if elastic is not None \
+            else None
+        if replayed is not None:
+            # rejoin catch-up: this epoch already closed across the job —
+            # apply the committed aggregate (bit-identical to what the
+            # survivors stepped) without streaming a single window
+            stats = np.asarray(replayed.payload["stats"])
+            grad_flat = replayed.payload["grads"]
+        else:
+            stats_acc = jnp.zeros((bags, 4))
+            grad_acc = zero_grads
+            n_win = 0
+            for win in stream.windows():
+                xb, yb, tw, vw = put_window(win)
+                rngs_w = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+                    rngs, n_win) if dropout > 0 else rngs
+                if full_batch:
+                    grad_acc, stats_acc = grad_eval_window(
+                        stacked, grad_acc, stats_acc, xb, yb, tw, vw,
+                        rngs_w)
+                else:
+                    stats_acc = eval_window(stacked, stats_acc, xb, yb,
+                                            tw, vw)
+                    for si, (s, e) in enumerate(slices):
+                        rngs_s = jax.vmap(jax.random.fold_in,
+                                          in_axes=(0, None))(
+                            rngs_w, si) if dropout > 0 else rngs_w
+                        stacked, opt_state = minibatch_window(
+                            stacked, opt_state, xb, yb, tw, rngs_s,
+                            lr_scale, jnp.int32(s), e - s)
+                n_win += 1
+            if n_win == 0:
+                raise RuntimeError("streamed training: empty shard stream")
+            if elastic is not None:
+                # quorum-gated epoch close: local grad/stat sums post to
+                # the control plane; everyone applies the SAME aggregate
+                res = elastic.step(epoch, {
+                    "grads": _ravel_grads(grad_acc),
+                    "stats": np.asarray(stats_acc)})
+                stats = np.asarray(res.payload["stats"])
+                grad_flat = res.payload["grads"]
             else:
-                stats_acc = eval_window(stacked, stats_acc, xb, yb, tw, vw)
-                for si, (s, e) in enumerate(slices):
-                    rngs_s = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
-                        rngs_w, si) if dropout > 0 else rngs_w
-                    stacked, opt_state = minibatch_window(
-                        stacked, opt_state, xb, yb, tw, rngs_s, lr_scale,
-                        jnp.int32(s), e - s)
-            n_win += 1
-        if n_win == 0:
-            raise RuntimeError("streamed training: empty shard stream")
-        stats = np.asarray(stats_acc)
+                stats = np.asarray(stats_acc)
         # stats were measured on the params entering this epoch => they close
         # the ledger of the PREVIOUS epoch (snapshot the matching params, not
         # the post-minibatch-update ones).  ``epoch > 0`` (not
@@ -876,7 +916,9 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
             stopped = bookkeep(epoch - 1, stats, params_entering)
         if full_batch:
             stacked, opt_state = apply_update(
-                stacked, opt_state, grad_acc,
+                stacked, opt_state,
+                grad_acc if grad_flat is None else _unravel_grads(
+                    grad_flat),
                 jnp.asarray(stats[:, 1]), lr_scale)
         epochs_run = epoch + 1
         if checkpoint and settings.tmp_model_every and \
@@ -899,12 +941,24 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
                      epoch, settings.early_stop_window)
             break
 
-    # final eval-only pass: errors of the last params
-    stats_acc = jnp.zeros((bags, 4))
-    for win in stream.windows():
-        xb, yb, tw, vw = put_window(win)
-        stats_acc = eval_window(stacked, stats_acc, xb, yb, tw, vw)
-    bookkeep(epochs_run - 1, np.asarray(stats_acc), stacked)
+    # final eval-only pass: errors of the last params.  Elastic runs it
+    # as one more quorum step (id ``epochs_run`` — past every epoch id,
+    # and identical on all controllers since early stop reads the same
+    # aggregated history) so best-model selection agrees job-wide; a
+    # rejoiner that finds it already closed adopts the committed stats.
+    final_close = elastic.closed_step(epochs_run) if elastic is not None \
+        else None
+    if final_close is None:
+        stats_acc = jnp.zeros((bags, 4))
+        for win in stream.windows():
+            xb, yb, tw, vw = put_window(win)
+            stats_acc = eval_window(stacked, stats_acc, xb, yb, tw, vw)
+        if elastic is not None:
+            final_close = elastic.step(
+                epochs_run, {"stats": np.asarray(stats_acc)})
+    final_stats = np.asarray(final_close.payload["stats"]) \
+        if final_close is not None else np.asarray(stats_acc)
+    bookkeep(epochs_run - 1, final_stats, stacked)
 
     final = _to_host(stacked)
     for i in range(bags):

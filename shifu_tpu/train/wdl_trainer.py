@@ -362,11 +362,19 @@ class ZippedPlanes:
 def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
                        settings: TrainSettings, bags: int, mask_fn,
                        num_feat_idx, cat_col_idx,
-                       mesh=None, progress=None) -> WDLResult:
+                       mesh=None, progress=None,
+                       elastic=None) -> WDLResult:
     """Out-of-core WDL: full-batch gradient accumulation over zipped windows
     (one synchronized update per epoch — the reference's BSP iteration,
     ``WDLMaster`` aggregation), members vmapped on the ensemble axis,
-    windows mesh-sharded over the data axis."""
+    windows mesh-sharded over the data axis.
+
+    ``elastic`` (:class:`parallel.elastic.ElasticContext`) swaps the
+    cross-process combine for the quorum-gated step protocol exactly as
+    in the streamed NN trainer: per-epoch grad/stat sums post as one
+    contribution, the update applies the committed quorum aggregate,
+    and an already-closed epoch replays from the journal (rejoin
+    catch-up) without streaming."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     if mesh is None:
@@ -443,6 +451,9 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
             lambda a: jnp.zeros(a.shape,
                                 jnp.float32 if precision == "mixed"
                                 else a.dtype), stacked), sh_ens)
+    if elastic is not None:
+        from ..parallel.elastic import grad_codec
+        _ravel_grads, _unravel_grads = grad_codec(zero_grads)
 
     def put_window(win):
         x = win.arrays["x"].astype(np.float32)
@@ -516,23 +527,41 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
                 start_epoch = settings.epochs   # already early-stopped
                 stopped = True
     for epoch in range(start_epoch, settings.epochs):
-        stats_acc = jnp.zeros((bags, 4))
-        grad_acc = zero_grads
         params_entering = stacked
-        n_win = 0
-        for win in planes.windows():
-            xnb, xcb, yb, tw, vw = put_window(win)
-            grad_acc, stats_acc = grad_eval_window(
-                stacked, grad_acc, stats_acc, xnb, xcb, yb, tw, vw)
-            n_win += 1
-        if n_win == 0:
-            raise RuntimeError("streamed WDL: empty shard stream")
-        stats = np.asarray(stats_acc)
+        grad_flat = None
+        replayed = elastic.closed_step(epoch) if elastic is not None \
+            else None
+        if replayed is not None:
+            # rejoin catch-up: the job already closed this epoch — apply
+            # the committed aggregate without streaming (see nn_trainer)
+            stats = np.asarray(replayed.payload["stats"])
+            grad_flat = replayed.payload["grads"]
+        else:
+            stats_acc = jnp.zeros((bags, 4))
+            grad_acc = zero_grads
+            n_win = 0
+            for win in planes.windows():
+                xnb, xcb, yb, tw, vw = put_window(win)
+                grad_acc, stats_acc = grad_eval_window(
+                    stacked, grad_acc, stats_acc, xnb, xcb, yb, tw, vw)
+                n_win += 1
+            if n_win == 0:
+                raise RuntimeError("streamed WDL: empty shard stream")
+            if elastic is not None:
+                res = elastic.step(epoch, {
+                    "grads": _ravel_grads(grad_acc),
+                    "stats": np.asarray(stats_acc)})
+                stats = np.asarray(res.payload["stats"])
+                grad_flat = res.payload["grads"]
+            else:
+                stats = np.asarray(stats_acc)
         # stats were measured on params_entering: they close the ledger of
         # the params BEFORE this epoch's update
         stopped = bookkeep(epoch, stats, params_entering)
-        stacked, opt_state = apply_update(stacked, opt_state, grad_acc,
-                                          jnp.asarray(stats[:, 1]))
+        stacked, opt_state = apply_update(
+            stacked, opt_state,
+            grad_acc if grad_flat is None else _unravel_grads(grad_flat),
+            jnp.asarray(stats[:, 1]))
         epochs_run = epoch + 1
         if settings.checkpoint_dir and settings.checkpoint_every and \
                 ((epoch + 1) % settings.checkpoint_every == 0 or stopped):
@@ -549,12 +578,24 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
             break
     if not stopped:
         # final eval-only sweep so the LAST update's params compete for best
-        # (otherwise the last epoch's work is always discarded)
-        stats_acc = jnp.zeros((bags, 4))
-        for win in planes.windows():
-            xnb, xcb, yb, tw, vw = put_window(win)
-            stats_acc = eval_window(stacked, stats_acc, xnb, xcb, yb, tw, vw)
-        bookkeep(epochs_run, np.asarray(stats_acc), stacked)
+        # (otherwise the last epoch's work is always discarded); elastic
+        # closes it as one more quorum step (id ``epochs_run``, past
+        # every epoch id) so best-model selection agrees job-wide
+        final_close = elastic.closed_step(epochs_run) \
+            if elastic is not None else None
+        if final_close is None:
+            stats_acc = jnp.zeros((bags, 4))
+            for win in planes.windows():
+                xnb, xcb, yb, tw, vw = put_window(win)
+                stats_acc = eval_window(stacked, stats_acc, xnb, xcb, yb,
+                                        tw, vw)
+            if elastic is not None:
+                final_close = elastic.step(
+                    epochs_run, {"stats": np.asarray(stats_acc)})
+        bookkeep(epochs_run,
+                 np.asarray(final_close.payload["stats"])
+                 if final_close is not None else np.asarray(stats_acc),
+                 stacked)
     final = _to_host(stacked)
     for i in range(bags):
         if best_params[i] is None:
@@ -640,9 +681,23 @@ def run_wdl_training(proc) -> int:
                 replacement=mc.train.baggingWithReplacement,
                 up_sample_weight=mc.train.upSampleWeight,
                 seed=settings.seed)
-            res = train_wdl_streamed(planes, spec, settings, bags, mask_fn,
-                                     num_feat_idx, cat_col_idx, mesh=mesh,
-                                     progress=progress)
+            # elastic multi-controller combine (same opt-in as the NN
+            # streamed path; WDL streams full-batch, so no gate needed)
+            from ..parallel.elastic import elastic_context_for
+            ectx = elastic_context_for(proc.dir, step_name="TRAIN")
+            if ectx is not None:
+                ectx.start()
+            try:
+                res = train_wdl_streamed(planes, spec, settings, bags,
+                                         mask_fn, num_feat_idx,
+                                         cat_col_idx, mesh=mesh,
+                                         progress=progress, elastic=ectx)
+            except BaseException:
+                if ectx is not None:
+                    ectx.stop(exit_code=1)
+                raise
+            if ectx is not None:
+                ectx.stop(exit_code=0)
         else:
             ndata = norm.load_all()
             cdata = clean.load_all()
