@@ -31,7 +31,7 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--plane",
                     choices=("all", "tail", "rf-repeat", "e2e", "resume",
-                             "varsel", "serve", "multihost"),
+                             "varsel", "serve", "multihost", "refresh"),
                     default="all",
                     help="'tail' = quick disk-tail streamed-GBT bench; "
                          "'rf-repeat' = RF variance triage (cold-compile "
@@ -49,7 +49,11 @@ def main() -> None:
                          "zero-recompile guard); 'multihost' = elastic "
                          "multi-controller plane (1/2/4-process quorum-"
                          "gated scaling curve + time-to-recover after a "
-                         "mid-train controller kill)")
+                         "mid-train controller kill); 'refresh' = "
+                         "continual-refresh plane (drift-triggered warm "
+                         "retrain time-to-promoted vs a cold full-"
+                         "pipeline retrain on the same drifted stream, "
+                         "with a no-SLO-page-during-swap guard)")
     ap.add_argument("--compare", nargs="*", metavar="PAYLOAD.json",
                     default=None,
                     help="regression-diff two bench payloads (raw JSON "

@@ -1531,6 +1531,209 @@ def bench_multihost(rows: int = 8192, features: int = 16,
     return extras
 
 
+def bench_refresh(n_rows: int = None, drift_rows: int = None,
+                  n_trees: int = 24, extra_trees: int = 8
+                  ) -> Dict[str, Any]:
+    """Continual-refresh plane (``bench.py --plane refresh``): the cost
+    of going from "the model is stale" to "a better model is serving".
+
+    One scripted lifecycle on generated fraud data: init→stats→norm→
+    train a GBT incumbent, serve it in-process, append a drifted stream
+    (amounts scaled 2x) and re-norm, feed the controller's drift monitor
+    until PSI breaches, then run ONE warm refresh cycle —
+    checkpoint-resumed trees appended on the new data window, AUC gate,
+    hot-swap, short probation.  A scoring pump drives real traffic
+    through the swap the whole time.
+
+    Reported (``--compare`` tracks the first as LOWER-is-better):
+
+    - ``refresh_time_to_promoted_s``   trigger decision → promote
+      decision (retrain + gate + swap; probation excluded);
+    - ``refresh_cold_pipeline_s``      the alternative the reference
+      pays: stats + norm + train from scratch on the same drifted
+      stream;
+    - ``refresh_warm_vs_cold``         cold / warm speedup;
+    - ``refresh_slo_alerts_during_swap`` MUST be 0 — the serving
+      plane's error budget does not page during a promotion.
+    """
+    import importlib.util
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    # sized so data-proportional work dominates XLA compile on the CPU
+    # rig (CI rigs can shrink it via SHIFU_BENCH_REFRESH_ROWS)
+    n_rows = n_rows or int(os.environ.get("SHIFU_BENCH_REFRESH_ROWS",
+                                          200_000))
+    drift_rows = drift_rows or max(n_rows // 4, 1000)
+
+    spec = importlib.util.spec_from_file_location(
+        "make_fraud_data",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "examples", "make_fraud_data.py"))
+    gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen)
+
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.config.model_config import Algorithm
+    from shifu_tpu.pipeline.create import InitProcessor, create_new_model
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    from shifu_tpu.pipeline.train import TrainProcessor
+    from shifu_tpu.refresh import (RefreshConfig, RefreshController,
+                                   drift_columns_for)
+    from shifu_tpu.serve.server import ServeServer
+
+    def configure(mdir: str, csv: str) -> None:
+        mc = ModelConfig.load(os.path.join(mdir, "ModelConfig.json"))
+        mc.dataSet.dataPath = csv
+        mc.dataSet.dataDelimiter = "|"
+        mc.dataSet.targetColumnName = "tag"
+        mc.dataSet.posTags = ["bad"]
+        mc.dataSet.negTags = ["good"]
+        mc.dataSet.weightColumnName = "weight"
+        mc.dataSet.metaColumnNameFile = os.path.join(
+            os.path.dirname(csv), "meta.names")
+        mc.train.algorithm = Algorithm.GBT
+        mc.train.params = {"TreeNum": n_trees, "MaxDepth": 4,
+                           "Loss": "log", "LearningRate": 0.1,
+                           "CheckpointInterval": 8}
+        mc.train.baggingNum = 1
+        mc.save(os.path.join(mdir, "ModelConfig.json"))
+
+    out: Dict[str, Any] = {"refresh_rows": n_rows,
+                           "refresh_drift_rows": drift_rows}
+    with tempfile.TemporaryDirectory() as td:
+        csv = gen.make(os.path.join(td, "data"), n=n_rows)
+        mdir = create_new_model("refresh", base_dir=td)
+        configure(mdir, csv)
+        assert InitProcessor(mdir).run() == 0
+        assert StatsProcessor(mdir, params={}).run() == 0
+        assert NormalizeProcessor(mdir, params={}).run() == 0
+        assert TrainProcessor(mdir, params={}).run() == 0
+
+        # drifted stream: fresh rows with 2x amounts appended, plane
+        # re-materialized (the refresh loop's "new data window")
+        drift_csv = gen.make(os.path.join(td, "drift"), n=drift_rows,
+                             seed=1234)
+        with open(csv) as f:
+            n_before = sum(1 for _ in f) - 1
+        # appending the drifted stream to the bench's own generated
+        # dataset — an input fixture, not a pipeline artifact
+        with open(drift_csv) as src, \
+                open(csv, "a") as dst:  # shifu-lint: disable=atomic-write
+            next(src)                                   # header
+            for i, line in enumerate(src):
+                parts = line.rstrip("\n").split("|")
+                parts[0] = f"d{i}"
+                if parts[1]:
+                    parts[1] = f"{float(parts[1]) * 2.0:.4f}"
+                dst.write("|".join(parts) + "\n")
+        assert NormalizeProcessor(mdir, params={}).run() == 0
+
+        # p99 objective sized for the CPU rig's launch cost: the guard
+        # is "the SWAP must not burn the budget", not "CPU scoring
+        # meets a TPU-sized latency objective"
+        server = ServeServer(mdir, buckets=(1, 64), max_delay_ms=1.0,
+                             slo_p99_ms=250.0).start()
+        try:
+            ctrl = RefreshController(
+                mdir, server=server,
+                config=RefreshConfig(psi_threshold=0.25, cooldown_s=0.0,
+                                     probation_s=0.3, units=extra_trees,
+                                     canary_rows=32),
+                drift_columns=drift_columns_for(mdir))
+            # earlier training consumed the pre-drift plane
+            from shifu_tpu.data.shards import Shards
+            total = Shards.open(os.path.join(mdir, "tmp",
+                                             "CleanedData")).num_rows
+            cursor = int(total * n_before / (n_before + drift_rows))
+            ctrl.journal.set_cursor(cursor)
+
+            # the drifted serving stream: skewed bin windows until the
+            # live PSI breaches
+            n_cols = len(ctrl._drift.columns)
+            skew = np.zeros((512, n_cols), np.int64)
+            for _ in range(64):
+                ctrl.observe(skew)
+                summ = ctrl._drift.summary()
+                if (summ["psi_max"] or 0) >= 0.25:
+                    break
+            out["refresh_trigger_psi"] = round(
+                float(ctrl._drift.summary()["psi_max"]), 4)
+
+            # real traffic through the swap
+            scorer = server.registry.get(server.key)
+            rng = np.random.default_rng(0)
+            pump_x = rng.normal(size=(32, scorer.n_features)) \
+                .astype(np.float32)
+            pump_b = rng.integers(
+                0, 2, size=(32, scorer.n_bins_cols)).astype(np.int32) \
+                if scorer.needs_bins else None
+            stop_pump = threading.Event()
+
+            def pump():
+                while not stop_pump.is_set():
+                    try:
+                        server.score(pump_x, pump_b, timeout=30.0)
+                    except Exception:       # noqa: BLE001 — bench pump
+                        break
+
+            t = threading.Thread(target=pump, daemon=True)
+            t.start()
+            t0 = time.perf_counter()
+            outcome = ctrl.run_once(poll_s=0.05, timeout_s=600.0)
+            warm_total = time.perf_counter() - t0
+            stop_pump.set()
+            t.join(timeout=10.0)
+            if outcome != "promoted":
+                raise RuntimeError(
+                    f"refresh bench: warm cycle ended {outcome!r}, "
+                    "expected a promotion")
+            by_kind = {}
+            for d in ctrl.journal.decisions():
+                by_kind.setdefault(d["kind"], d)
+            out["refresh_time_to_promoted_s"] = round(
+                by_kind["promote"]["ts"] - by_kind["trigger"]["ts"], 3)
+            out["refresh_warm_cycle_s"] = round(warm_total, 3)
+            out["refresh_resumed_from_trees"] = \
+                by_kind["train"].get("resumed_from", 0)
+            out["refresh_warm_start"] = bool(
+                by_kind["train"].get("warm"))
+            out["refresh_generation"] = server.registry.generation(
+                server.key)
+            alerts = server.slo.alerts()
+            out["refresh_slo_alerts_during_swap"] = len(alerts)
+            if alerts:
+                raise RuntimeError("refresh bench: the serving SLO "
+                                   f"paged during the swap: {alerts}")
+            if not out["refresh_warm_start"]:
+                raise RuntimeError("refresh bench: the retrain cold-"
+                                   "started (no checkpoint restored)")
+        finally:
+            server.stop()
+
+        # the cold alternative: full stats+norm+train from scratch on
+        # the SAME drifted stream (what the reference re-runs)
+        cdir = create_new_model("refresh-cold", base_dir=td)
+        configure(cdir, csv)
+        assert InitProcessor(cdir).run() == 0
+        t0 = time.perf_counter()
+        assert StatsProcessor(cdir, params={}).run() == 0
+        assert NormalizeProcessor(cdir, params={}).run() == 0
+        assert TrainProcessor(cdir, params={}).run() == 0
+        out["refresh_cold_pipeline_s"] = round(
+            time.perf_counter() - t0, 3)
+        shutil.rmtree(cdir, ignore_errors=True)
+    out["refresh_warm_vs_cold"] = round(
+        out["refresh_cold_pipeline_s"]
+        / max(out["refresh_time_to_promoted_s"], 1e-9), 3)
+    out["refresh_shape"] = (f"{n_rows}+{drift_rows} rows, GBT "
+                            f"{n_trees}+{extra_trees} trees depth 4")
+    return out
+
+
 def bench_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
     """Flatten a payload to {metric: value}: the headline plus every
     numeric top-level extra."""
@@ -1569,7 +1772,8 @@ def is_tracked_latency(name: str) -> bool:
         return False
     return ("_p50" in name or "_p99" in name
             or name.endswith("_queue_frac") or name.endswith("_pad_frac")
-            or name.endswith("_recover_s"))
+            or name.endswith("_recover_s")
+            or name.endswith("_time_to_promoted_s"))
 
 
 def compare_bench(old: Dict[str, Any], new: Dict[str, Any],
@@ -1804,10 +2008,26 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
             "shape": rep["multihost_shape"],
             "extra": rep,
         }
+    if plane == "refresh":
+        with obs.span("bench.refresh", kind="bench"):
+            rep = bench_refresh()
+        for k, v in rep.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                obs.gauge(f"bench.{k}").set(float(v))
+        return {
+            "metric": "refresh_time_to_promoted_s",
+            "value": rep["refresh_time_to_promoted_s"],
+            "unit": "seconds",
+            "plane": "refresh",
+            "telemetry_schema_version": BENCH_TELEMETRY_SCHEMA,
+            "shape": rep["refresh_shape"],
+            "extra": rep,
+        }
     if plane not in (None, "all"):
         raise ValueError(
             f"unknown bench plane {plane!r} "
-            "(tail|rf-repeat|e2e|resume|varsel|serve|multihost|all)")
+            "(tail|rf-repeat|e2e|resume|varsel|serve|multihost|refresh|"
+            "all)")
     nn_cost: Dict[str, Any] = {}
     nn_rows_per_sec = bench_nn(collect=nn_cost)
     obs.gauge("bench.nn_train_throughput").set(nn_rows_per_sec)

@@ -185,6 +185,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="score N synthetic rows in-process and exit "
                     "(no port; CI smoke)")
 
+    sp = sub.add_parser("refresh", help="continual refresh: drift-gated "
+                        "warm retrain -> AUC-gated hot-swap promotion -> "
+                        "SLO-observed probation with automatic rollback "
+                        "(knobs: -Dshifu.refresh.psiThreshold, "
+                        "-Dshifu.refresh.intervalS, "
+                        "-Dshifu.refresh.cooldownS, "
+                        "-Dshifu.refresh.minAucDelta, "
+                        "-Dshifu.refresh.probationS, "
+                        "-Dshifu.refresh.units; one cycle attempt by "
+                        "default)")
+    sp.add_argument("--daemon", dest="refresh_daemon", action="store_true",
+                    help="stay resident: poll the drift artifact / "
+                    "schedule forever (the always-on production loop)")
+    sp.add_argument("--poll", dest="refresh_poll", type=float,
+                    default=2.0, metavar="S",
+                    help="seconds between controller ticks (default 2)")
+
     sp = sub.add_parser("lint", help="AST-based convention checker: "
                         "host-sync/recompile/knob-registry/atomic-write/"
                         "telemetry-guard/manifest rules over shifu_tpu/ "
@@ -365,6 +382,11 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
         return run_serve(args.dir, port=args.serve_port,
                          selfcheck=args.serve_selfcheck,
                          max_delay_ms=args.serve_max_delay_ms)
+    if cmd == "refresh":
+        from .pipeline.refresh import RefreshProcessor
+        return RefreshProcessor(args.dir, params={
+            "daemon": getattr(args, "refresh_daemon", False),
+            "poll": getattr(args, "refresh_poll", 2.0)}).run()
     if cmd == "lint":
         from .lint.cli import run_lint_cli
         return run_lint_cli(args)

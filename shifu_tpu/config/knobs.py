@@ -168,6 +168,29 @@ _DECLS: Tuple[Knob, ...] = (
        "p99 latency SLO (default 2x maxDelayMs)"),
     _k("shifu.serve.sloAvailability", "property", "float", "0.999",
        "availability SLO for error-budget burn alerts"),
+    _k("shifu.serve.generations", "property", "int", "3",
+       "previous serving generations kept rollback-able per key"),
+    # ---- continual refresh plane (refresh/)
+    _k("shifu.refresh.psiThreshold", "property", "float", "",
+       "PSI breach that triggers a refresh cycle (default: "
+       "shifu.drift.psiThreshold)"),
+    _k("shifu.refresh.intervalS", "property", "float", "0",
+       "wall-clock refresh schedule in seconds (0 = drift-only)"),
+    _k("shifu.refresh.cooldownS", "property", "float", "300",
+       "minimum seconds between refresh cycles (thrash guard: a "
+       "sustained breach records ONE skip per window)"),
+    _k("shifu.refresh.minAucDelta", "property", "float", "0",
+       "holdout AUC bar a candidate must clear to promote (0 = strict "
+       "non-regression)"),
+    _k("shifu.refresh.probationS", "property", "float", "60",
+       "post-promotion probation window watched for SLO burn / canary "
+       "parity before the promotion is final"),
+    _k("shifu.refresh.units", "property", "int", "0",
+       "extra epochs/trees per warm retrain (0 = the configured "
+       "numTrainEpochs / TreeNum budget, warm-started)"),
+    _k("shifu.refresh.canaryRows", "property", "int", "64",
+       "canary batch size pinned at promotion for probation bit-parity "
+       "checks"),
     # ---- multi-host / elastic DCN plane
     _k("shifu.dcn.elastic", "property", "bool", "false",
        "quorum-gated elastic multi-controller step protocol (the "
@@ -204,6 +227,8 @@ _DECLS: Tuple[Knob, ...] = (
        "bench serve p99-vs-deadline slop allowance"),
     _k("SHIFU_BENCH_E2E_ROWS", "env", "int", "",
        "bench --plane e2e generated row count"),
+    _k("SHIFU_BENCH_REFRESH_ROWS", "env", "int", "200000",
+       "bench --plane refresh base row count (drift stream adds 1/4)"),
 )
 
 KNOBS: Dict[str, Knob] = {k.name: k for k in _DECLS}

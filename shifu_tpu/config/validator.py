@@ -24,6 +24,7 @@ class ModelStep(enum.Enum):
     POSTTRAIN = "POSTTRAIN"
     EVAL = "EVAL"
     EXPORT = "EXPORT"
+    REFRESH = "REFRESH"
 
 
 from .errors import ErrorCode, ShifuError

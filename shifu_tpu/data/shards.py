@@ -150,6 +150,31 @@ class Shards:
     def num_rows(self) -> int:
         return sum(self.shard_rows)
 
+    def from_row(self, row: int) -> "Shards":
+        """A view of this shard set starting at the shard containing
+        global row ``row`` — the refresh loop's data-window cursor
+        (shard-aligned, rounded DOWN so no row is ever skipped).  A
+        cursor at/past the end keeps the LAST shard: with no new data
+        the freshest window is still the right thing to train on."""
+        if row <= 0 or not self.files:
+            return self
+        rows = self.shard_rows
+        cum, k = 0, len(rows) - 1
+        for i, r in enumerate(rows):
+            if cum + r > row:
+                k = i
+                break
+            cum += r
+        kept = [int(x) for x in rows[k:]]
+        schema = dict(self.schema)
+        if "shardRows" in schema:
+            schema["shardRows"] = list(kept)
+        if "numRows" in schema:
+            schema["numRows"] = int(sum(kept))
+        view = Shards(self.directory, schema, list(self.files[k:]))
+        view._shard_rows = kept
+        return view
+
     def source_signature(self) -> List[List]:
         """[(name, size, mtime_ns)] identity of the shard set — the spill
         cache's staleness check (re-running norm rewrites files and

@@ -1,0 +1,131 @@
+"""Old-vs-new holdout gate — the promotion decision in one place.
+
+A retrained candidate only reaches the serving fleet if it is at least
+as good as the incumbent on data neither of them trained on.  This
+module is that gate, reusable by the continual-refresh controller, the
+combo/eval tooling and tests alike:
+
+- :func:`load_holdout` slices the NEWEST window of the materialized
+  plane (the tail shards of ``NormalizedData`` + ``CleanedData`` — the
+  freshest rows, exactly the distribution the candidate claims to fix);
+- :func:`auc_gate` scores BOTH ensembles on that same holdout through
+  the batch :class:`~shifu_tpu.eval.scorer.Scorer` and compares AUC:
+  the candidate passes iff ``new_auc >= old_auc + min_delta``
+  (``-Dshifu.refresh.minAucDelta``, default 0 — strict non-regression).
+
+The result carries both AUCs and the verdict; the refresh journal
+archives it with every promote/reject decision so "why did generation 7
+not ship" is a file, not a guess.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import asdict, dataclass
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+DEFAULT_HOLDOUT_ROWS = 4096
+
+
+@dataclass
+class GateResult:
+    old_auc: float
+    new_auc: float
+    delta: float                 # new - old
+    min_delta: float             # the bar (non-regression at 0)
+    passed: bool
+    rows: int
+
+    def report(self) -> Dict[str, Any]:
+        return {k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in asdict(self).items()}
+
+
+@dataclass
+class Holdout:
+    x: np.ndarray                          # [n, d] normalized floats
+    y: np.ndarray                          # [n] targets
+    w: np.ndarray                          # [n] weights
+    bins: Optional[np.ndarray] = None      # [n, c] binned ints (trees/WDL)
+
+    @property
+    def rows(self) -> int:
+        return int(len(self.y))
+
+
+def min_auc_delta(override: Optional[float] = None) -> float:
+    """The promotion bar: ``shifu.refresh.minAucDelta`` (default 0 =
+    the candidate must not regress AUC; positive demands a real win)."""
+    if override is not None:
+        return float(override)
+    from ..config import environment
+    return environment.get_float("shifu.refresh.minAucDelta", 0.0)
+
+
+def load_holdout(model_set_dir: str,
+                 max_rows: int = DEFAULT_HOLDOUT_ROWS) -> Holdout:
+    """The newest rows of the materialized plane as an eval holdout:
+    tail shards of the norm plane (x/y/w) and, when present, the
+    row-aligned clean plane (bins) — both written by the same ``norm``
+    pass, so shard k covers the same rows in both."""
+    from ..data.shards import Shards
+    norm = Shards.open(os.path.join(model_set_dir, "tmp",
+                                    "NormalizedData"))
+    rows = norm.shard_rows
+    # walk shards back-to-front until max_rows is covered
+    start, have = len(rows), 0
+    while start > 0 and have < max_rows:
+        start -= 1
+        have += rows[start]
+    parts = [p for p in norm.iter_shards(start=start, strict=True)]
+    x = np.concatenate([p["x"] for p in parts])[-max_rows:]
+    y = np.concatenate([p["y"] for p in parts])[-max_rows:]
+    w = np.concatenate([p["w"] for p in parts])[-max_rows:]
+    bins = None
+    clean_dir = os.path.join(model_set_dir, "tmp", "CleanedData")
+    if os.path.isfile(os.path.join(clean_dir, "schema.json")):
+        clean = Shards.open(clean_dir)
+        if len(clean.files) == len(norm.files):
+            cparts = [p for p in clean.iter_shards(start=start,
+                                                   strict=True)]
+            bins = np.concatenate([p["bins"] for p in cparts])[-max_rows:]
+    return Holdout(x=x, y=y, w=w, bins=bins)
+
+
+def holdout_auc(models: Sequence, holdout: Holdout) -> float:
+    """Weighted-mean-ensemble AUC of ``models`` on the holdout (the same
+    mean-score aggregation the serving plane answers with)."""
+    from .metrics import evaluate_scores
+    from .scorer import Scorer
+    scorer = Scorer(list(models))
+    bins = holdout.bins
+    needs_bins = any(getattr(m, "input_kind", "norm") in ("bins", "both")
+                     for m in scorer.models)
+    res = scorer.score(holdout.x, bins if needs_bins else None)
+    perf = evaluate_scores(res.mean, holdout.y, holdout.w)
+    return float(perf.areaUnderRoc)
+
+
+def auc_gate(old_models: Sequence, new_models: Sequence,
+             holdout: Holdout,
+             min_delta: Optional[float] = None) -> GateResult:
+    """Score incumbent and candidate on the SAME holdout; the candidate
+    passes iff its AUC does not regress past ``min_delta``.  A holdout
+    with a degenerate class mix (NaN AUC) fails the gate loudly — an
+    unmeasurable candidate must never ship on a coin flip."""
+    bar = min_auc_delta(min_delta)
+    old_auc = holdout_auc(old_models, holdout)
+    new_auc = holdout_auc(new_models, holdout)
+    measurable = not (np.isnan(old_auc) or np.isnan(new_auc))
+    delta = (new_auc - old_auc) if measurable else float("nan")
+    passed = bool(measurable and delta >= bar)
+    log.info("auc gate: old=%.6f new=%.6f delta=%+.6f bar=%+g -> %s",
+             old_auc, new_auc, delta, bar,
+             "PROMOTE" if passed else "REJECT")
+    return GateResult(old_auc=old_auc, new_auc=new_auc, delta=delta,
+                      min_delta=bar, passed=passed, rows=holdout.rows)

@@ -95,6 +95,16 @@ SITES: dict = {
                      "the worker-loss drill the quorum must mask",
     ("train", "rejoin"): "when a rejoined controller starts replaying "
                          "committed step s from the close journal",
+    ("refresh", "trigger"): "before a refresh trigger decision record "
+                            "commits (the cycle has not started yet)",
+    ("refresh", "promote"): "after the candidate passes the AUC gate, "
+                            "before the registry hot-swap — a crash "
+                            "here must leave the incumbent live and "
+                            "bit-identical, and the refresh journal "
+                            "must resume the cycle at the gate",
+    ("refresh", "rollback"): "before a probation-failure rollback "
+                             "re-flips the registry to the previous "
+                             "generation",
 }
 
 

@@ -98,6 +98,9 @@ MANIFEST: Dict[str, Tuple[str, str]] = {
                              "deadline"),
     "serve.request_errors": ("counter", "batches failed in-flight"),
     "serve.swaps": ("counter", "model hot-swaps promoted"),
+    "serve.rollbacks": ("counter",
+                        "registry re-flips to the previous generation "
+                        "(probation failure or operator rollback)"),
     "serve.trace_sampled": ("counter",
                             "requests head-sampled into per-request "
                             "tracing (shifu.serve.traceSampleRate)"),
@@ -156,6 +159,27 @@ MANIFEST: Dict[str, Tuple[str, str]] = {
     "dcn.live_members": ("gauge",
                          "controllers the heartbeat staleness rule "
                          "considers alive"),
+    # ---- continual refresh plane (refresh/)
+    "refresh.triggers": ("counter",
+                         "refresh cycles started (PSI breach or "
+                         "schedule)"),
+    "refresh.skips": ("counter",
+                      "triggers suppressed by the cooldown guard"),
+    "refresh.retrains": ("counter", "warm retrains run"),
+    "refresh.promotions": ("counter",
+                           "candidates hot-swapped into serving after "
+                           "passing the AUC gate"),
+    "refresh.rejections": ("counter",
+                           "candidates archived on AUC regression "
+                           "(incumbent stays live)"),
+    "refresh.rollbacks": ("counter",
+                          "promotions rolled back in probation (SLO "
+                          "burn / canary parity)"),
+    "refresh.state": ("gauge",
+                      "controller state: 0 idle, 1 training, "
+                      "2 probation"),
+    "refresh.generation": ("gauge", "serving generation under refresh"),
+    "refresh.cycle": ("gauge", "refresh cycles begun (lifetime)"),
     # ---- drift monitor (obs/drift)
     "drift.rows": ("gauge", "rows folded into the live drift counts"),
     "drift.columns_tracked": ("gauge", "columns with a training snapshot"),
@@ -184,6 +208,9 @@ SPANS: Dict[str, str] = {
                     "requests' trace ids (fan-in causality)"),
     "dcn.step": ("elastic quorum step: contribute -> wait for quorum/"
                  "timeout/peer close -> adopt the committed aggregate"),
+    "refresh.retrain": ("warm-start retraining of a refresh candidate "
+                        "(checkpoint resume over the data-window "
+                        "cursor)"),
 }
 
 # span families whose names embed data (the bench's per-plane spans)

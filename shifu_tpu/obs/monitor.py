@@ -139,6 +139,20 @@ def _render_table(recs: List[Dict[str, Any]], counts: Dict[str, int],
             f"{_fmt_count(rec.get('trees')):>7}"
             f"{_fmt_count(rec.get('epochs')):>7}  {_row_phase(rec)}"
             f"{_row_flags(rec)}")
+    for rec in recs:
+        rf = rec.get("refresh")
+        if rf:
+            # the refresh controller's heartbeat extras: lifecycle state,
+            # last journalled decision, serving generation + rollback
+            # window depth
+            out.append(
+                f"-- refresh[{rec.get('proc', '?')}]: "
+                f"{rf.get('state', '?')}"
+                f"  last={rf.get('last_decision') or '-'}"
+                f"  outcome={rf.get('last_outcome') or '-'}"
+                f"  gen={rf.get('generation', 0)}"
+                f" (+{rf.get('generations_held', 0)} held)"
+                f"  cycle={rf.get('cycle', 0)}")
     healthy, active, quorum, lost = _quorum_state(recs, counts)
     parts = [f"{counts.get(k, 0)} {k}" for k in
              ("live", "stalled", "stale", "exited") if counts.get(k)]

@@ -221,7 +221,7 @@ class TrainProcessor(BasicProcessor):
             # ONE guard for both the in-RAM and streamed paths
             raise ValueError("grid search is not supported with "
                              "ONEVSALL multi-class")
-        shards = Shards.open(self.paths.norm_dir)
+        shards = self._open_shards(self.paths.norm_dir)
         if self._use_streaming(shards, shards.schema):
             return self._train_nn_streamed(alg, shards, n_classes=K,
                                            ova=ova)
@@ -282,6 +282,10 @@ class TrainProcessor(BasicProcessor):
                     # cheap; only full runs checkpoint/resume)
                     settings.checkpoint_dir = self.paths.checkpoint_dir
                     settings.resume = bool(self.params.get("resume"))
+                    # refresh warm-start: N MORE epochs past the
+                    # restored state (plain resume keeps the budget)
+                    settings.resume_extra = int(
+                        self.params.get("refresh_extra") or 0)
                 run_kfold = kfold if not is_gs else -1
                 up_w = mc.train.upSampleWeight
                 if K > 2 and up_w != 1.0:
@@ -419,6 +423,21 @@ class TrainProcessor(BasicProcessor):
                 log.info("svm bag %d: %d SVs -> %s", b, n_sv, path)
         return 0
 
+    def _open_shards(self, directory: str) -> Shards:
+        """The step's view of the materialized plane.  The refresh loop
+        passes ``window_cursor`` (rows earlier trainings consumed) so a
+        warm retrain streams only the NEW data windows — shard-aligned,
+        see :meth:`Shards.from_row`."""
+        shards = Shards.open(directory)
+        cur = int(self.params.get("window_cursor") or 0)
+        if cur:
+            view = shards.from_row(cur)
+            log.info("data-window cursor %d: training on %d of %d rows "
+                     "(%d of %d shards)", cur, view.num_rows,
+                     shards.num_rows, len(view.files), len(shards.files))
+            return view
+        return shards
+
     def _use_streaming(self, shards: Shards, schema: dict) -> bool:
         """Out-of-core mode when the materialized data exceeds the memory
         budget (reference ``guagua.data.memoryFraction`` role) or when
@@ -503,6 +522,8 @@ class TrainProcessor(BasicProcessor):
                 if not is_gs:
                     settings.checkpoint_dir = self.paths.checkpoint_dir
                     settings.resume = bool(self.params.get("resume"))
+                    settings.resume_extra = int(
+                        self.params.get("refresh_extra") or 0)
                 run_kfold = kfold if not is_gs else -1
                 n_members = run_kfold if (run_kfold and run_kfold > 1) \
                     else (len(run) if is_gs else bags)

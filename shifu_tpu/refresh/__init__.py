@@ -1,0 +1,23 @@
+"""Continual refresh subsystem — the drift-gated train→gate→promote
+loop that turns the one-shot pipeline into a long-running service.
+
+- :mod:`controller` — the :class:`RefreshController` state machine
+  (trigger on PSI breach / schedule, warm retrain, AUC-gated hot-swap,
+  SLO-observed probation with automatic rollback);
+- :mod:`journal` — crash-consistent cycle state + the immutable
+  decision-record stream under ``<modelset>/refresh/``;
+- :mod:`retrain` — warm-start retraining over the data-window cursor
+  (checkpoint resume, never a cold full re-run).
+"""
+
+from .controller import (RefreshConfig, RefreshController,  # noqa: F401
+                         drift_columns_for)
+from .journal import (IDLE, PROBATION, TRAINED,  # noqa: F401
+                      TRIGGERED, RefreshJournal, refresh_dir_for)
+from .retrain import warm_retrain  # noqa: F401
+
+__all__ = [
+    "RefreshConfig", "RefreshController", "drift_columns_for",
+    "RefreshJournal", "refresh_dir_for", "warm_retrain",
+    "IDLE", "TRIGGERED", "TRAINED", "PROBATION",
+]

@@ -15,18 +15,29 @@ a retrained model must never drop requests, so a swap is journal-style:
    the next batch scores on the new one.  A journal failure (disk full,
    perms) raises BEFORE the flip, so the previous model stays live.
 
+The registry keeps a bounded GENERATION HISTORY per key (previous
+serving docs + their scorers/model dirs, ``-Dshifu.serve.generations``
+deep): :meth:`rollback` re-flips to the prior generation through the
+SAME journal-first path — the continual-refresh controller's escape
+hatch when a promotion burns its probation window.  Generation numbers
+are monotonic (a post-rollback promotion never reuses a number), and
+``serving.json`` records the history so a restarted process can resolve
+*and* roll back.
+
 Fault site: ``serve:swap=<key>`` fires after BUILD and before
-JOURNAL+FLIP — a crash or injected error there must leave the previous
-model live and serving bit-identical scores.
+JOURNAL+FLIP — on the swap AND rollback paths — a crash or injected
+error there must leave the currently-live model serving bit-identical
+scores.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, NamedTuple, Optional, Sequence
 
 from .. import faults, obs
 from ..eval.scorer import SCORE_SCALE, Scorer
@@ -36,6 +47,25 @@ from .scorer import AOTScorer
 log = logging.getLogger(__name__)
 
 SERVING_JOURNAL = "serving.json"
+
+DEFAULT_GENERATIONS = 3
+
+
+def history_limit(override: Optional[int] = None) -> int:
+    """Bounded generation history depth: ``shifu.serve.generations``
+    previous generations kept rollback-able (default 3)."""
+    if override is not None:
+        return max(0, int(override))
+    from ..config import environment
+    return max(0, environment.get_int("shifu.serve.generations",
+                                      DEFAULT_GENERATIONS))
+
+
+class _Generation(NamedTuple):
+    gen: int
+    scorer: Optional[AOTScorer]     # None = rebuildable from models_dir
+    models_dir: Optional[str]
+    promoted_ts: float
 
 
 class ModelRegistry:
@@ -48,6 +78,9 @@ class ModelRegistry:
         self._live: Dict[str, AOTScorer] = {}
         self._gen: Dict[str, int] = {}
         self._dirs: Dict[str, str] = {}
+        self._hist: Dict[str, List[_Generation]] = {}
+        self._peak: Dict[str, int] = {}      # highest gen ever (monotonic)
+        self._buckets: Dict[str, Optional[tuple]] = {}   # last ladder used
 
     # ------------------------------------------------------------ lookup
     def get(self, key: str) -> AOTScorer:
@@ -69,6 +102,19 @@ class ModelRegistry:
     def generation(self, key: str) -> int:
         with self._lock:
             return self._gen.get(key, 0)
+
+    def next_generation(self, key: str) -> int:
+        """The number the NEXT promotion will take — monotonic past the
+        peak, so a rolled-back generation's number is never reused."""
+        with self._lock:
+            return self._peak.get(key, 0) + 1
+
+    def generation_history(self, key: str) -> List[Dict]:
+        """Previous generations (oldest first) still rollback-able."""
+        with self._lock:
+            return [{"generation": g.gen, "models_dir": g.models_dir,
+                     "promoted_ts": g.promoted_ts}
+                    for g in self._hist.get(key, [])]
 
     # ------------------------------------------------------- load / swap
     def _build(self, key: str, models_or_dir, scale: float,
@@ -95,8 +141,45 @@ class ModelRegistry:
         with self._lock:
             self._live[key] = scorer
             self._gen[key] = 0
+            self._peak[key] = max(self._peak.get(key, 0), 0)
+            self._hist.setdefault(key, [])
+            self._buckets[key] = tuple(buckets) if buckets else None
             if new_dir is not None:
                 self._dirs[key] = new_dir
+        return scorer
+
+    def restore(self, key: str, default_models_dir: str,
+                scale: float = SCORE_SCALE,
+                buckets: Optional[Sequence[int]] = None,
+                warm: bool = True) -> AOTScorer:
+        """Resolve the serving journal and load whatever was last
+        promoted under ``key`` (falling back to ``default_models_dir``
+        for a never-promoted set), restoring the recorded generation
+        number and the rollback history (scorers rebuild lazily from
+        their model dirs) — the restart path of a serving/refresh
+        process."""
+        doc = self._read_journal().get(key) or {}
+        mdir = doc.get("models_dir") or default_models_dir
+        gen = int(doc.get("generation") or 0)
+        hist = [h for h in (doc.get("history") or [])
+                if h.get("models_dir")]
+        scorer = self._build(key, mdir, scale, buckets, gen, warm)
+        with self._lock:
+            self._live[key] = scorer
+            self._gen[key] = gen
+            self._dirs[key] = mdir
+            self._hist[key] = [
+                _Generation(int(h["generation"]), None, h["models_dir"],
+                            float(h.get("promoted_ts") or 0.0))
+                for h in hist]
+            self._peak[key] = max([gen] + [int(h["generation"])
+                                           for h in hist])
+            self._buckets[key] = tuple(buckets) if buckets else None
+        # re-commit the resolved doc: a never-promoted set gets its
+        # first journal here, and a pruned history is recorded
+        self._journal()
+        log.info("restored %s at generation %d (%d prior generation(s) "
+                 "rollback-able)", key, gen, len(hist))
         return scorer
 
     def swap(self, key: str, models_or_dir, scale: float = SCORE_SCALE,
@@ -108,7 +191,9 @@ class ModelRegistry:
             if key not in self._live:
                 raise KeyError(f"swap({key!r}) before load() — nothing "
                                "is live to replace")
-            gen = self._gen[key] + 1
+            gen = self._peak.get(key, self._gen[key]) + 1
+            prev = _Generation(self._gen[key], self._live[key],
+                               self._dirs.get(key), round(time.time(), 3))
         # BUILD off-line: the expensive part happens while the old
         # scorer keeps serving
         scorer = self._build(key, models_or_dir, scale, buckets, gen, warm)
@@ -117,36 +202,108 @@ class ModelRegistry:
         new_dir = models_or_dir if isinstance(models_or_dir, str) else None
         # JOURNAL before FLIP (module docs): a journal failure raises
         # while the old model is still live; once committed, the flip is
-        # one infallible reference assignment
-        self._journal(pending={key: (new_dir, gen)})
+        # one infallible reference assignment.  The journal records the
+        # post-flip history (incumbent demoted into it, bounded).
+        limit = history_limit()
         with self._lock:
+            hist_after = (self._hist.get(key, []) + [prev])[-limit:] \
+                if limit else []
+        self._journal(pending={key: (new_dir, gen)},
+                      history={key: hist_after})
+        with self._lock:
+            self._hist[key] = hist_after
             self._live[key] = scorer
             self._gen[key] = gen
+            self._peak[key] = max(self._peak.get(key, 0), gen)
+            self._buckets[key] = tuple(buckets) if buckets else None
             if new_dir is not None:
                 self._dirs[key] = new_dir
         obs.counter("serve.swaps").inc()
         log.info("promoted %s generation %d", key, gen)
         return scorer
 
+    def rollback(self, key: str, warm: bool = True) -> AOTScorer:
+        """Re-flip to the previous generation through the same
+        journal-first path as :meth:`swap`: journal commits the
+        post-rollback doc first, then one reference assignment.  The
+        prior generation's scorer is reused when still held (bit-
+        identical scores by construction) or rebuilt from its recorded
+        model dir.  Raises with the CURRENT model still live when there
+        is no history (or the journal fails)."""
+        with self._lock:
+            if key not in self._live:
+                raise KeyError(f"rollback({key!r}) before load()")
+            hist = list(self._hist.get(key) or [])
+            if not hist:
+                raise LookupError(
+                    f"rollback({key!r}): no previous generation held — "
+                    "the history window (shifu.serve.generations) is "
+                    "empty")
+            prev = hist[-1]
+            cur_gen = self._gen[key]
+        scorer = prev.scorer
+        if scorer is None:
+            # restored-process history entry: rebuild from the dir the
+            # journal recorded (off-line, like a swap's BUILD phase) on
+            # the key's own bucket ladder — same launch shapes, same
+            # bits
+            scorer = self._build(key, prev.models_dir, SCORE_SCALE,
+                                 self._buckets.get(key), prev.gen, warm)
+        # same crash-safety contract as swap: a death here leaves the
+        # CURRENT model live and the journal un-flipped
+        faults.fire("serve", "swap", key)
+        self._journal(pending={key: (prev.models_dir, prev.gen)},
+                      history={key: hist[:-1]})
+        with self._lock:
+            self._hist[key] = hist[:-1]
+            self._live[key] = scorer
+            self._gen[key] = prev.gen
+            if prev.models_dir is not None:
+                self._dirs[key] = prev.models_dir
+        obs.counter("serve.rollbacks").inc()
+        log.info("rolled back %s generation %d -> %d", key, cur_gen,
+                 prev.gen)
+        return scorer
+
     # ------------------------------------------------------------ journal
-    def _journal(self, pending: Optional[Dict[str, tuple]] = None) -> None:
+    def _read_journal(self) -> Dict[str, dict]:
+        if not self.state_dir:
+            return {}
+        try:
+            with open(os.path.join(self.state_dir, SERVING_JOURNAL)) as f:
+                doc = json.load(f)
+            return doc if isinstance(doc, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def _journal(self, pending: Optional[Dict[str, tuple]] = None,
+                 history: Optional[Dict[str, List[_Generation]]] = None
+                 ) -> None:
         """Commit the serving journal.  ``pending`` maps key ->
-        ``(models_dir|None, generation)`` for a promotion that is being
-        journalled BEFORE its flip (write-ahead)."""
+        ``(models_dir|None, generation)`` for a promotion/rollback that
+        is being journalled BEFORE its flip (write-ahead); ``history``
+        carries the post-flip generation history for those keys."""
         if not self.state_dir:
             return
         with self._lock:
             keys = set(self._live)
             dirs = dict(self._dirs)
             gens = dict(self._gen)
+            hists = {k: list(v) for k, v in self._hist.items()}
         for k, (mdir, gen) in (pending or {}).items():
             keys.add(k)
             gens[k] = gen
             if mdir is not None:
                 dirs[k] = mdir
+        for k, h in (history or {}).items():
+            hists[k] = list(h)
         doc = {k: {"models_dir": dirs.get(k),
                    "generation": gens.get(k, 0),
-                   "promoted_ts": round(time.time(), 3)}
+                   "promoted_ts": round(time.time(), 3),
+                   "history": [{"generation": g.gen,
+                                "models_dir": g.models_dir,
+                                "promoted_ts": g.promoted_ts}
+                               for g in hists.get(k, [])]}
                for k in sorted(keys)}
         os.makedirs(self.state_dir, exist_ok=True)
         atomic_write_json(os.path.join(self.state_dir, SERVING_JOURNAL),

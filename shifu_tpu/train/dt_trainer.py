@@ -3334,7 +3334,9 @@ def run_tree_training(proc) -> int:
     """Entry called by TrainProcessor for GBT/RF/DT."""
     mc = proc.model_config
     alg = mc.train.algorithm
-    shards = Shards.open(proc.paths.clean_dir)
+    shards = proc._open_shards(proc.paths.clean_dir) \
+        if hasattr(proc, "_open_shards") \
+        else Shards.open(proc.paths.clean_dir)
     col_nums = shards.schema.get("columnNums", [])
     by_num = {c.columnNum: c for c in proc.column_configs}
     cat_mask = np.array([by_num[cn].is_categorical() if cn in by_num else False
@@ -3413,6 +3415,27 @@ def run_tree_training(proc) -> int:
 
         init_trees, init_score, start_history, init_scores = \
             _restore_or_continuous(proc, alg, settings)
+        refresh_extra = int(proc.params.get("refresh_extra") or 0)
+        if refresh_extra and init_trees:
+            # refresh warm-start: the budget is N MORE trees APPENDED
+            # past the restored forest (a plain resume keeps TreeNum);
+            # on the new data window the restored scores replay unless
+            # the byte-exact sidecar still covers the exact same rows
+            settings.n_trees = len(init_trees) + refresh_extra
+            # an early-stop that tripped on the OLD stream must not veto
+            # appending trees for the new window: don't replay it
+            start_history = None
+            log.info("refresh warm-start: %d restored trees + %d new "
+                     "(target %d)", len(init_trees), refresh_extra,
+                     settings.n_trees)
+        if init_scores is not None and len(init_scores) != shards.num_rows:
+            # the sidecar pinned f for a DIFFERENT plane (data-window
+            # cursor sliced it, or new rows landed) — fall back to
+            # replaying the restored trees over the current rows
+            log.info("checkpoint scores cover %d rows, plane has %d — "
+                     "replaying restored trees instead",
+                     len(init_scores), shards.num_rows)
+            init_scores = None
         from ..parallel.mesh import device_mesh
         mesh = device_mesh(n_ensemble=1)   # trees are sequential: all devices
         if streaming:                      # on the data axis

@@ -56,12 +56,31 @@ class TrainSettings:
     checkpoint_dir: str = ""           # "" disables trainer-state checkpoints
     checkpoint_every: int = 25
     resume: bool = False               # restore latest trainer state
+    resume_extra: int = 0              # refresh warm-start: train N MORE
+                                       # epochs past the restored state
+                                       # (0 = plain resume, keep budget)
     fixed_layers: Tuple[int, ...] = () # 1-based layer ids frozen during
     fixed_bias: bool = False           # continuous training (NNMaster
     matmul_precision: str = ""         # FIXED_LAYERS); ""=backend default,
     precision: str = ""                # bfloat16=MXU.  precision: f32|
     opt_kwargs: Dict[str, Any] = field(default_factory=dict)  # bf16|mixed
                                        # ("" = shifu.train.precision)
+
+
+def _resume_epoch_target(settings: "TrainSettings", start_epoch: int,
+                         stops) -> int:
+    """Epoch budget after a checkpoint restore.  A refresh warm-start
+    (``resume_extra`` > 0) trains that many MORE epochs past the
+    restored state — and re-opens the early-stop patience, because a
+    stopper that tripped on the OLD distribution must not veto learning
+    the new data window (best-model tracking still carries over).  A
+    plain crash resume (``resume_extra`` == 0) keeps the original
+    budget and stop state untouched."""
+    if settings.resume_extra <= 0:
+        return settings.epochs
+    for s in stops:
+        s.since_best = 0
+    return start_epoch + settings.resume_extra
 
 
 @dataclass
@@ -425,6 +444,7 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
     tr = va = np.zeros(bags)
 
     start_epoch = 0
+    epochs_target = settings.epochs
     if settings.resume and settings.checkpoint_dir:
         from . import checkpoint as ckpt
         restored = ckpt.restore_state(
@@ -440,12 +460,15 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
                               stops)
             lr_scale = (1.0 - settings.learning_decay) ** start_epoch \
                 if settings.learning_decay > 0 else 1.0
-            log.info("resumed trainer state at epoch %d", start_epoch)
+            epochs_target = _resume_epoch_target(settings, start_epoch,
+                                                 stops)
+            log.info("resumed trainer state at epoch %d (target %d)",
+                     start_epoch, epochs_target)
             if settings.early_stop_window > 0 and \
                     all(s.since_best >= s.window_size for s in stops):
                 # the interrupted run had already early-stopped — don't
                 # grow past its stop point
-                start_epoch = settings.epochs
+                start_epoch = epochs_target
 
     n_padded = xd.shape[0]
 
@@ -483,7 +506,7 @@ def _train_ensemble_impl(x: np.ndarray, y: np.ndarray,
         return st, os_
 
     obs_on = obs.enabled()
-    for epoch in range(start_epoch, settings.epochs):
+    for epoch in range(start_epoch, epochs_target):
         ep_t0 = time.perf_counter()
         key, sub = jax.random.split(key)
         rngs = jax.random.split(sub, bags)
@@ -798,6 +821,7 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
     history: List[Tuple[float, float]] = []
     lr_scale = 1.0
     start_epoch = 0
+    epochs_target = settings.epochs
     if settings.resume and settings.checkpoint_dir:
         from . import checkpoint as ckpt
         restored = ckpt.restore_state(
@@ -813,10 +837,13 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
                               stops)
             lr_scale = (1.0 - settings.learning_decay) ** start_epoch \
                 if settings.learning_decay > 0 else 1.0
-            log.info("resumed streamed trainer state at epoch %d", start_epoch)
+            epochs_target = _resume_epoch_target(settings, start_epoch,
+                                                 stops)
+            log.info("resumed streamed trainer state at epoch %d "
+                     "(target %d)", start_epoch, epochs_target)
             if settings.early_stop_window > 0 and \
                     all(s.since_best >= s.window_size for s in stops):
-                start_epoch = settings.epochs   # already early-stopped
+                start_epoch = epochs_target     # already early-stopped
 
     def put_window(win):
         xb = jax.device_put(win.arrays["x"].astype(np.float32), sh_x)
@@ -857,7 +884,7 @@ def _train_ensemble_streamed_impl(stream, spec: nn_model.NNModelSpec,
 
     epochs_run = start_epoch
     stopped = False
-    for epoch in range(start_epoch, settings.epochs):
+    for epoch in range(start_epoch, epochs_target):
         key, sub = jax.random.split(key)
         rngs = jax.random.split(sub, bags)
         grad_flat = None

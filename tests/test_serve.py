@@ -498,6 +498,111 @@ def test_hot_swap_between_batches_drops_nothing():
     assert a.tobytes() != c.tobytes()              # new model answered
 
 
+# ------------------------------------- generation history / rollback
+def test_rollback_restores_previous_generation_bit_identical():
+    reg = ModelRegistry()
+    reg.load("m", _nn_models(seed0=0), buckets=(1, 4))
+    rng = np.random.default_rng(21)
+    x = rng.normal(size=(3, 8)).astype(np.float32)
+    gen0 = reg.get("m").score_batch(x).tobytes()
+    reg.swap("m", _nn_models(seed0=50), buckets=(1, 4))
+    assert reg.generation("m") == 1
+    assert reg.get("m").score_batch(x).tobytes() != gen0
+    reg.rollback("m")
+    assert reg.generation("m") == 0
+    assert reg.get("m").score_batch(x).tobytes() == gen0
+    # generation numbers are monotonic: the next promotion is 2, not 1
+    assert reg.next_generation("m") == 2
+    reg.swap("m", _nn_models(seed0=60), buckets=(1, 4))
+    assert reg.generation("m") == 2
+
+
+def test_rollback_without_history_raises_current_stays():
+    reg = ModelRegistry()
+    reg.load("m", _nn_models(seed0=0), buckets=(1, 4))
+    rng = np.random.default_rng(22)
+    x = rng.normal(size=(2, 8)).astype(np.float32)
+    before = reg.get("m").score_batch(x).tobytes()
+    with pytest.raises(LookupError):
+        reg.rollback("m")
+    assert reg.generation("m") == 0
+    assert reg.get("m").score_batch(x).tobytes() == before
+
+
+def test_crashed_rollback_leaves_current_model_live():
+    """serve:swap fires on the rollback path too: an injected error
+    before the journal+flip leaves the CURRENT (promoted) model live
+    and bit-identical; the disarmed site lets the rollback through."""
+    reg = ModelRegistry()
+    reg.load("m", _nn_models(seed0=0), buckets=(1, 4))
+    rng = np.random.default_rng(23)
+    x = rng.normal(size=(3, 8)).astype(np.float32)
+    gen0 = reg.get("m").score_batch(x).tobytes()
+    reg.swap("m", _nn_models(seed0=50), buckets=(1, 4))
+    gen1 = reg.get("m").score_batch(x).tobytes()
+    _set_faults("serve:swap=m:ioerror")
+    with pytest.raises(faults.InjectedFault):
+        reg.rollback("m")
+    assert reg.generation("m") == 1
+    assert reg.get("m").score_batch(x).tobytes() == gen1
+    faults.reset_for_tests()
+    environment.reset_for_tests()
+    reg.rollback("m")
+    assert reg.generation("m") == 0
+    assert reg.get("m").score_batch(x).tobytes() == gen0
+
+
+def test_generation_history_bounded_and_journaled(tmp_path):
+    environment.set_property("shifu.serve.generations", "2")
+    reg = ModelRegistry(state_dir=str(tmp_path))
+    reg.load("m", _nn_models(seed0=0), buckets=(1, 4))
+    for s in (10, 20, 30, 40):
+        reg.swap("m", _nn_models(seed0=s), buckets=(1, 4))
+    hist = reg.generation_history("m")
+    assert [h["generation"] for h in hist] == [2, 3]   # bounded at 2
+    with open(os.path.join(str(tmp_path), "serving.json")) as f:
+        doc = json.load(f)["m"]
+    assert doc["generation"] == 4
+    assert [h["generation"] for h in doc["history"]] == [2, 3]
+
+
+def test_restore_resolves_journal_and_rollback_from_dirs(tmp_path):
+    """A restarted process restores the promoted generation AND the
+    rollback history from serving.json; rollback rebuilds the previous
+    scorer from its recorded model dir."""
+    from shifu_tpu.models.nn import save_model
+
+    def save_dir(name, seed0):
+        d = str(tmp_path / name)
+        os.makedirs(d, exist_ok=True)
+        for i, m in enumerate(_nn_models(seed0=seed0)):
+            save_model(os.path.join(d, f"model{i}.nn"), m.spec, m.params)
+        return d
+
+    d0, d1 = save_dir("g0", 0), save_dir("g1", 50)
+    state = str(tmp_path / "serving")
+    reg = ModelRegistry(state_dir=state)
+    reg.load("m", d0, buckets=(1, 4))
+    reg.swap("m", d1, buckets=(1, 4))
+    rng = np.random.default_rng(24)
+    x = rng.normal(size=(3, 8)).astype(np.float32)
+    gen0 = Scorer_from_dir_scores(d0, x)
+    # fresh process: restore from the journal
+    reg2 = ModelRegistry(state_dir=state)
+    reg2.restore("m", d0, buckets=(1, 4))
+    assert reg2.generation("m") == 1
+    assert [h["generation"] for h in reg2.generation_history("m")] == [0]
+    reg2.rollback("m")
+    assert reg2.generation("m") == 0
+    assert reg2.get("m").score_batch(x).tobytes() == gen0
+
+
+def Scorer_from_dir_scores(d, x):
+    from shifu_tpu.eval.scorer import Scorer
+    s = AOTScorer(Scorer.from_dir(d).models, buckets=(1, 4))
+    return s.score_batch(x).tobytes()
+
+
 # ------------------------------------------- eval Scorer cache (satellite)
 def test_scorer_stacked_groups_rebuild_when_models_change():
     """Regression: ``Scorer._stacked_nn_groups`` cached forever — a
