@@ -31,7 +31,8 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--plane",
                     choices=("all", "tail", "rf-repeat", "e2e", "resume",
-                             "varsel", "serve", "multihost", "refresh"),
+                             "varsel", "serve", "multihost", "refresh",
+                             "quality"),
                     default="all",
                     help="'tail' = quick disk-tail streamed-GBT bench; "
                          "'rf-repeat' = RF variance triage (cold-compile "
@@ -53,7 +54,11 @@ def main() -> None:
                          "continual-refresh plane (drift-triggered warm "
                          "retrain time-to-promoted vs a cold full-"
                          "pipeline retrain on the same drifted stream, "
-                         "with a no-SLO-page-during-swap guard)")
+                         "with a no-SLO-page-during-swap guard); "
+                         "'quality' = model-quality observability plane "
+                         "(scorelog on-vs-off saturation QPS, guarded "
+                         ">= 0.95x, + time-to-detect a synthetic "
+                         "label flip via the live-AUC monitor)")
     ap.add_argument("--compare", nargs="*", metavar="PAYLOAD.json",
                     default=None,
                     help="regression-diff two bench payloads (raw JSON "

@@ -67,7 +67,15 @@ from . import ioutil, obs
 # (multihost_{1,2,4}p_rows_per_sec scaling curve, tracked by --compare,
 # and multihost_recover_s time-to-recover-after-kill, tracked in the
 # lower-is-better class via the new *_recover_s suffix).
-BENCH_TELEMETRY_SCHEMA = 10
+# v11: model-quality observability plane — scorelog.* / quality.*
+# instruments, crash-safe scorelog segments + delayed-label join +
+# posttrain.json / quality.json artifacts, the quality heartbeat extra
+# and the refresh controller's "quality" trigger source; the bench
+# gains --plane quality (serve_scorelog_qps_frac, the on/off saturation
+# ratio guarded >= 0.95 and tracked via the new *_qps_frac throughput
+# suffix, plus quality_label_flip_detect_s, tracked LOWER-is-better via
+# the new *_detect_s suffix).
+BENCH_TELEMETRY_SCHEMA = 11
 
 # measured on this rig (tools/measure_baseline.py); provenance in
 # BASELINE.md — every headline divides by a MEASURED reference-class
@@ -1389,6 +1397,136 @@ def bench_serve(n_features: int = 32, n_models: int = 5,
     return rep
 
 
+# the score-log bench runs the same head-sampling rate as the trace
+# bench; scorelog-on QPS must hold this fraction of the scorelog-off
+# saturation QPS (the v11 overhead acceptance)
+SCORELOG_BENCH_SAMPLE_RATE = 0.01
+SCORELOG_OVERHEAD_FLOOR_FRAC = 0.95
+# detect-phase joined-batch size; min_joined stays the knob default (64)
+QUALITY_DETECT_BATCH = 64
+
+
+def bench_quality(n_features: int = 32, n_models: int = 3,
+                  hidden: tuple = (64,), duration_s: float = 0.6
+                  ) -> Dict[str, Any]:
+    """Model-quality observability plane (``bench.py --plane quality``):
+    two acceptances —
+
+    - **score-log overhead**: saturation QPS with the serve-path score
+      log OFF (the default) vs ON at a 1% head-sampling rate into a
+      scratch model-set dir; ``serve_scorelog_qps_frac`` (on/off,
+      tracked by ``--compare`` via the ``*_qps_frac`` suffix) must stay
+      >= SCORELOG_OVERHEAD_FLOOR_FRAC — sampled logging must not tax
+      the serving plane it observes;
+    - **time-to-detect**: a :class:`~shifu_tpu.obs.quality.
+      QualityMonitor` seeded with a synthetic posttrain snapshot is fed
+      label-FLIPPED joined outcomes in QUALITY_DETECT_BATCH-row batches
+      until its verdict turns degraded; ``quality_label_flip_detect_s``
+      (wall, tracked LOWER-is-better via the ``*_detect_s`` suffix) is
+      the streaming monitor's detection latency at bench scale."""
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+
+    from shifu_tpu.eval.metrics import auc_trapezoid, sweep
+    from shifu_tpu.models.nn import (IndependentNNModel, NNModelSpec,
+                                     init_params)
+    from shifu_tpu.obs.quality import QualityMonitor
+    from shifu_tpu.obs.scorelog import read_score_records, scorelog_dir
+    from shifu_tpu.serve import ServeServer
+
+    spec = NNModelSpec(input_dim=n_features, hidden_nodes=list(hidden),
+                       activations=["relu"] * len(hidden), output_dim=1)
+    models = [IndependentNNModel(spec,
+                                 init_params(jax.random.PRNGKey(i), spec))
+              for i in range(n_models)]
+    rng = np.random.default_rng(0)
+    pool = rng.normal(size=(4096, n_features)).astype(np.float32)
+
+    def saturate(server) -> float:
+        batcher = server.batcher
+        try:
+            # warm every bucket before the measured window
+            for n in (1, 3, *server.registry.get("bench").buckets):
+                batcher.score_sync(pool[:n])
+            qps, _ = _serve_saturation(batcher, pool, duration_s)
+        finally:
+            server.stop()
+        return qps
+
+    off_qps = saturate(ServeServer(models=models, key="bench").start())
+    scratch = tempfile.mkdtemp(prefix="shifu_bench_quality_")
+    try:
+        on_qps = saturate(ServeServer(
+            models=models, key="bench", model_set_dir=scratch,
+            scorelog_sample_rate=SCORELOG_BENCH_SAMPLE_RATE).start())
+        logged = len(read_score_records(scorelog_dir(scratch)))
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+    frac = on_qps / max(off_qps, 1e-9)
+
+    # ---- detect phase: well-separated synthetic baseline, then the
+    # live stream joins the SAME scores against FLIPPED labels
+    n_base = 4096
+    labels = (rng.random(n_base) < 0.5).astype(np.float64)
+    scores = np.clip(np.where(labels > 0.5,
+                              rng.normal(700.0, 120.0, n_base),
+                              rng.normal(300.0, 120.0, n_base)),
+                     0.0, 1000.0)
+    c = sweep(scores, labels)
+    base_auc = float(auc_trapezoid(c.fp / c.neg_total,
+                                   c.tp / c.pos_total))
+    from shifu_tpu.obs.quality import write_posttrain_snapshot
+    snap_dir = tempfile.mkdtemp(prefix="shifu_bench_snap_")
+    try:
+        snap = write_posttrain_snapshot(
+            os.path.join(snap_dir, "posttrain.json"), scores,
+            auc=base_auc)
+    finally:
+        shutil.rmtree(snap_dir, ignore_errors=True)
+    mon = QualityMonitor(snapshot=snap)
+    t0 = time.perf_counter()
+    detect_s = None
+    fed = 0
+    while fed < n_base:
+        sl = slice(fed, fed + QUALITY_DETECT_BATCH)
+        mon.observe_scores(1, scores[sl])
+        mon.update(1, scores[sl], 1.0 - labels[sl])    # the label flip
+        fed += len(scores[sl])
+        if mon.summary()["degraded"]:
+            detect_s = time.perf_counter() - t0
+            break
+    if detect_s is None:
+        raise AssertionError(
+            f"quality monitor never flagged a FULL label flip over "
+            f"{n_base} joined rows (baseline AUC {base_auc:.3f}) — the "
+            "live-AUC trigger is dead")
+
+    rep: Dict[str, Any] = {
+        "serve_scorelog_off_qps": round(off_qps, 1),
+        "serve_scorelog_on_qps": round(on_qps, 1),
+        "serve_scorelog_qps_frac": round(frac, 4),
+        "serve_scorelog_sample_rate": SCORELOG_BENCH_SAMPLE_RATE,
+        "serve_scorelog_records": int(logged),
+        "quality_label_flip_detect_s": round(detect_s, 4),
+        "quality_label_flip_detect_rows": int(fed),
+        "quality_baseline_auc": round(base_auc, 4),
+        "quality_shape": f"{n_models} NN models {n_features}->"
+                         f"{list(hidden)}->1, pool 4096 rows, scorelog "
+                         f"{SCORELOG_BENCH_SAMPLE_RATE:.0%} sampled, "
+                         f"detect batches of {QUALITY_DETECT_BATCH}",
+    }
+    if frac < SCORELOG_OVERHEAD_FLOOR_FRAC:
+        raise AssertionError(
+            f"saturation QPS with the score log on fell to {frac:.3f}x "
+            f"the scorelog-off rate ({on_qps:.0f} vs {off_qps:.0f}) — "
+            f"below {SCORELOG_OVERHEAD_FLOOR_FRAC}x; sampled score "
+            "logging is taxing the serve plane it observes")
+    return rep
+
+
 # --------------------------------------------------------------- compare
 # `bench.py --compare OLD.json NEW.json [--threshold 0.9]`: the
 # BENCH_r01..r05 trajectory exists in-repo but nothing read it — this is
@@ -1757,6 +1895,7 @@ def is_tracked_throughput(name: str) -> bool:
         return False
     return ("throughput" in name or name.endswith("_per_sec")
             or name.endswith("_qps") or name.endswith("_qps_sustained")
+            or name.endswith("_qps_frac")
             or name.endswith("_mfu") or name.endswith("_achieved_bw"))
 
 
@@ -1772,7 +1911,7 @@ def is_tracked_latency(name: str) -> bool:
         return False
     return ("_p50" in name or "_p99" in name
             or name.endswith("_queue_frac") or name.endswith("_pad_frac")
-            or name.endswith("_recover_s")
+            or name.endswith("_recover_s") or name.endswith("_detect_s")
             or name.endswith("_time_to_promoted_s"))
 
 
@@ -2023,11 +2162,26 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
             "shape": rep["refresh_shape"],
             "extra": rep,
         }
+    if plane == "quality":
+        with obs.span("bench.quality", kind="bench"):
+            rep = bench_quality()
+        for k, v in rep.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                obs.gauge(f"bench.{k}").set(float(v))
+        return {
+            "metric": "serve_scorelog_qps_frac",
+            "value": rep["serve_scorelog_qps_frac"],
+            "unit": "ratio",
+            "plane": "quality",
+            "telemetry_schema_version": BENCH_TELEMETRY_SCHEMA,
+            "shape": rep["quality_shape"],
+            "extra": rep,
+        }
     if plane not in (None, "all"):
         raise ValueError(
             f"unknown bench plane {plane!r} "
             "(tail|rf-repeat|e2e|resume|varsel|serve|multihost|refresh|"
-            "all)")
+            "quality|all)")
     nn_cost: Dict[str, Any] = {}
     nn_rows_per_sec = bench_nn(collect=nn_cost)
     obs.gauge("bench.nn_train_throughput").set(nn_rows_per_sec)

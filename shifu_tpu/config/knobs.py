@@ -191,6 +191,26 @@ _DECLS: Tuple[Knob, ...] = (
     _k("shifu.refresh.canaryRows", "property", "int", "64",
        "canary batch size pinned at promotion for probation bit-parity "
        "checks"),
+    # ---- model-quality observability plane (obs/scorelog+outcomes+quality)
+    _k("shifu.scorelog.sampleRate", "property", "float", "0",
+       "serve-path score-log head-sampling rate (0..1; 0 = plane off)"),
+    _k("shifu.scorelog.segmentBytes", "property", "int", "1048576",
+       "score-log segment size before atomic rotation commit"),
+    _k("shifu.scorelog.budgetBytes", "property", "int", "67108864",
+       "score-log disk budget: oldest committed segments pruned over "
+       "this"),
+    _k("shifu.quality.watermarkS", "property", "float", "3600",
+       "delayed-label join window: predictions older than this are "
+       "evicted unjoined"),
+    _k("shifu.quality.aucDelta", "property", "float", "0.05",
+       "live-AUC drop vs the posttrain baseline that marks the model "
+       "degraded (the quality refresh trigger)"),
+    _k("shifu.quality.psiThreshold", "property", "float", "",
+       "score-distribution PSI breach threshold (default: "
+       "shifu.drift.psiThreshold)"),
+    _k("shifu.quality.minJoined", "property", "int", "64",
+       "joined rows per generation before live AUC / calibration / "
+       "score PSI are judged"),
     # ---- multi-host / elastic DCN plane
     _k("shifu.dcn.elastic", "property", "bool", "false",
        "quorum-gated elastic multi-controller step protocol (the "

@@ -188,6 +188,18 @@ class PathFinder:
         """Per-column live-PSI table (``obs/drift``)."""
         return os.path.join(self.telemetry_dir, "drift.json")
 
+    @property
+    def posttrain_snapshot_path(self) -> str:
+        """Training-time score distribution + AUC baseline
+        (``obs/quality``) — what live quality is judged against."""
+        return os.path.join(self.telemetry_dir, "posttrain.json")
+
+    @property
+    def quality_path(self) -> str:
+        """Live model-quality table (``obs/quality``): per-generation
+        live AUC / calibration / score PSI."""
+        return os.path.join(self.telemetry_dir, "quality.json")
+
     # ------------------------------------------------------------- backups
     @property
     def backup_dir(self) -> str:

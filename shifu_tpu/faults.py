@@ -43,6 +43,12 @@ Sites/points wired today (grep ``faults.fire`` for the live set):
                         before the journal commit and the live flip — a
                         crash here must leave the PREVIOUS model live,
                         scoring bit-identically
+    obs:scorelog=<k>    before score-log segment k's atomic rotation
+                        commit (the os.replace that drops the .open torn
+                        marker) — a kill here leaves a torn final
+                        segment readers skip with a surfaced count;
+                        committed segments stay intact and the next
+                        writer sweeps the orphan and continues
 
 Actions:
 
@@ -87,6 +93,10 @@ SITES: dict = {
     ("spill", "manifest"): "spill manifest commit",
     ("step", "phase"): "entering a named processor phase span",
     ("obs", "heartbeat"): "before heartbeat b's atomic commit",
+    ("obs", "scorelog"): "before score-log segment k's atomic rotation "
+                         "commit — a kill leaves a torn .open final "
+                         "segment readers skip; prior segments intact, "
+                         "the next writer recovers",
     ("serve", "request"): "before serving batch k's device launch",
     ("serve", "swap"): "after a hot-swap candidate is built+warmed, "
                        "before the journal commit and the live flip",

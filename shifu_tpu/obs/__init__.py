@@ -35,6 +35,16 @@ Modules:
   surfaced via ``/slo``, SERVE heartbeats and ``metrics.prom``;
 - :mod:`drift` — streaming per-column PSI of live binned windows vs the
   training-time ColumnConfig snapshot (ROADMAP #5's promotion signal);
+- :mod:`scorelog` — sampled, bounded prediction logging from the serve
+  path (crash-safe append-only segments with atomic rotation and a
+  disk budget under ``<modelset>/telemetry/scorelog/``);
+- :mod:`outcomes` — delayed-label join: outcome records (``POST
+  /outcome`` or a drop directory) meet logged predictions by request
+  id inside a bounded watermark window;
+- :mod:`quality` — streaming model-quality monitor: per-generation
+  live AUC / reliability-bin calibration over joined windows +
+  score-distribution PSI vs the ``posttrain.json`` training snapshot
+  (the refresh controller's third trigger source);
 - :mod:`profiler` — opt-in ``jax.profiler.trace()`` capture around any
   step (``shifu-tpu <step> --profile [dir]``);
 - :mod:`report` — renders the last run's spans/metrics as a tree with
@@ -73,6 +83,14 @@ from .exporter import (MetricsExporter, start_exporter,       # noqa: F401
                        metric_name)
 from .drift import (DriftMonitor, start_drift_monitor,        # noqa: F401
                     psi_threshold)
+from .scorelog import (ScoreLog, read_score_records,          # noqa: F401
+                       scorelog_dir, scorelog_sample_rate)
+from .outcomes import (OutcomeJoiner, outcomes_drop_dir,      # noqa: F401
+                       outcome_watermark_s)
+from .quality import (QualityMonitor, start_quality_monitor,  # noqa: F401
+                      write_posttrain_snapshot,
+                      load_posttrain_snapshot,
+                      posttrain_snapshot_path, quality_artifact_path)
 from .costs import (costed_jit, record_executable,            # noqa: F401
                     register_cost_model, record_model_launch,
                     cost_snapshot, resolve_peaks, backend_info)
@@ -99,6 +117,12 @@ __all__ = [
     "write_metrics_files", "metric_name",
     # drift
     "DriftMonitor", "start_drift_monitor", "psi_threshold",
+    # model-quality plane
+    "ScoreLog", "read_score_records", "scorelog_dir",
+    "scorelog_sample_rate", "OutcomeJoiner", "outcomes_drop_dir",
+    "outcome_watermark_s", "QualityMonitor", "start_quality_monitor",
+    "write_posttrain_snapshot", "load_posttrain_snapshot",
+    "posttrain_snapshot_path", "quality_artifact_path",
     # cost-attribution plane
     "costed_jit", "record_executable", "register_cost_model",
     "record_model_launch", "cost_snapshot", "resolve_peaks",

@@ -186,6 +186,41 @@ MANIFEST: Dict[str, Tuple[str, str]] = {
     "drift.columns_flagged": ("gauge", "columns with PSI over threshold"),
     "drift.psi_max": ("gauge", "max per-column PSI vs training snapshot"),
     "drift.psi_mean": ("gauge", "mean per-column PSI vs training snapshot"),
+    # ---- model-quality plane (obs/scorelog, obs/outcomes, obs/quality)
+    "scorelog.records": ("counter",
+                         "sampled prediction records appended to the "
+                         "score log"),
+    "scorelog.segments": ("counter",
+                          "score-log segments committed by atomic "
+                          "rotation"),
+    "scorelog.pruned_segments": ("counter",
+                                 "committed segments pruned by the "
+                                 "disk budget"),
+    "quality.outcomes": ("counter",
+                         "outcome records ingested (POST /outcome + "
+                         "drop directory)"),
+    "quality.outcomes_late": ("counter",
+                              "outcomes dropped: unknown/evicted "
+                              "request id, watermark miss, or length "
+                              "mismatch"),
+    "quality.scored_rows": ("gauge",
+                            "sampled scores folded into the live "
+                            "score histograms"),
+    "quality.joined_rows": ("gauge",
+                            "outcome-joined (score,label) rows in the "
+                            "rolling windows"),
+    "quality.live_auc": ("gauge",
+                         "rolling live AUC of the current serving "
+                         "generation"),
+    "quality.ece": ("gauge",
+                    "reliability-bin expected calibration error "
+                    "(current generation)"),
+    "quality.score_psi": ("gauge",
+                          "PSI of live scores vs the posttrain "
+                          "snapshot (current generation)"),
+    "quality.degraded": ("gauge",
+                         "1 while the quality plane flags live-AUC or "
+                         "score-PSI degradation"),
 }
 
 # dynamic families: f-string names must start with one of these

@@ -4,9 +4,11 @@ Reads the heartbeat files :mod:`obs.health` writers commit under
 ``<modelset>/telemetry/health/`` and renders one line per process:
 step, state (live / stalled / stale / exited), heartbeat age, the phase
 each thread is in right now, and the progress counters (rows, windows,
-trees, epochs).  SERVE heartbeats additionally carry queue depth and
-the compact SLO summary — queue buildup and a firing burn-rate alert
-get their own ``<<`` flags.  The summary line carries the quorum
+trees, epochs).  SERVE heartbeats additionally carry queue depth, the
+compact SLO summary, and (when the score-log plane is on) the compact
+model-quality summary — queue buildup, a firing burn-rate alert and a
+degraded quality verdict get their own ``<<`` flags.  The summary line
+carries the quorum
 fraction — ``healthy / total`` — the primitive ROADMAP #3's
 straggler/quorum logic reads.
 
@@ -79,6 +81,47 @@ def _fmt_count(v: Any) -> str:
     return f"{v:,.0f}"
 
 
+def _fmt_quality(v: Any) -> str:
+    if v is None:
+        return "-"
+    return f"{float(v):.4f}"
+
+
+def fleet_quality(recs: List[Dict[str, Any]]
+                  ) -> Optional[Dict[str, Any]]:
+    """Merge per-process SERVE quality extras into ONE fleet row: the
+    worst (min) live AUC and worst (max) score PSI — per generation and
+    overall — summed joined rows, OR'd degradation.  ``None`` when no
+    record carries quality extras (plane off fleet-wide)."""
+    rows = [r.get("quality") for r in recs if r.get("quality")]
+    if not rows:
+        return None
+    gens: Dict[int, Optional[float]] = {}
+    for q in rows:
+        for g, auc in (q.get("generations") or {}).items():
+            g = int(g)
+            if auc is None:
+                gens.setdefault(g, None)
+            elif gens.get(g) is None:
+                gens[g] = float(auc)
+            else:
+                gens[g] = min(gens[g], float(auc))
+    aucs = [float(q["live_auc"]) for q in rows
+            if q.get("live_auc") is not None]
+    psis = [float(q["score_psi"]) for q in rows
+            if q.get("score_psi") is not None]
+    return {
+        "procs": len(rows),
+        "live_auc": round(min(aucs), 6) if aucs else None,
+        "score_psi": round(max(psis), 6) if psis else None,
+        "joined": sum(int(q.get("joined") or 0) for q in rows),
+        "degraded": any(q.get("degraded") for q in rows),
+        "generations": {g: (round(gens[g], 6)
+                            if gens[g] is not None else None)
+                        for g in sorted(gens)},
+    }
+
+
 def status_records(model_set_dir: str, now: Optional[float] = None
                    ) -> Tuple[List[Dict[str, Any]], Dict[str, int]]:
     """(records, state counts) for a model set — each record is the
@@ -102,6 +145,8 @@ def _row_flags(rec: Dict[str, Any]) -> str:
         flags += f"  << SLO BURN ({burns})"
     if rec.get("queue_buildup"):
         flags += "  << QUEUE BUILDUP"
+    if (rec.get("quality") or {}).get("degraded"):
+        flags += "  << QUALITY DEGRADED"
     return flags
 
 
@@ -153,6 +198,21 @@ def _render_table(recs: List[Dict[str, Any]], counts: Dict[str, int],
                 f"  gen={rf.get('generation', 0)}"
                 f" (+{rf.get('generations_held', 0)} held)"
                 f"  cycle={rf.get('cycle', 0)}")
+    for rec in recs:
+        q = rec.get("quality")
+        if q:
+            # the SERVE heartbeat's compact model-quality summary:
+            # rolling live AUC / score PSI over the joined window
+            gens = " ".join(
+                f"g{g}={_fmt_quality(v)}" for g, v in
+                sorted(((int(g), v) for g, v in
+                        (q.get("generations") or {}).items())))
+            out.append(
+                f"-- quality[{rec.get('proc', '?')}]: "
+                f"auc={_fmt_quality(q.get('live_auc'))}"
+                f"  psi={_fmt_quality(q.get('score_psi'))}"
+                f"  joined={int(q.get('joined') or 0):,}"
+                + (f"  [{gens}]" if gens else ""))
     healthy, active, quorum, lost = _quorum_state(recs, counts)
     parts = [f"{counts.get(k, 0)} {k}" for k in
              ("live", "stalled", "stale", "exited") if counts.get(k)]
@@ -185,12 +245,14 @@ def status_json(model_set_dir: str, now: Optional[float] = None
     --once --json`` payload CI/cron scripts consume instead of scraping
     the human table.  Exit 0 when every process is live/exited (or the
     dir is empty: nothing running is not unhealthy); EXIT_UNHEALTHY (3)
-    when ANY process is stalled or stale."""
+    when ANY process is stalled or stale, or any SERVE process reports
+    a degraded model-quality verdict."""
     now = time.time() if now is None else now
     recs, counts = status_records(model_set_dir, now=now)
     for rec in recs:
         rec.pop("_file", None)               # host path, not health state
     healthy, active, quorum, lost = _quorum_state(recs, counts)
+    fq = fleet_quality(recs)
     unhealthy = counts.get("stalled", 0) + counts.get("stale", 0)
     doc = {
         "kind": "monitor",
@@ -198,6 +260,7 @@ def status_json(model_set_dir: str, now: Optional[float] = None
         "ts": round(now, 3),
         "health_dir": health_dir_for(model_set_dir),
         "procs": recs,
+        "quality": fq,
         "summary": {
             "total": len(recs),
             "counts": {k: counts.get(k, 0)
@@ -208,7 +271,8 @@ def status_json(model_set_dir: str, now: Optional[float] = None
             "quorum_lost": lost,
         },
     }
-    return doc, (EXIT_UNHEALTHY if unhealthy or lost else 0)
+    degraded = bool(fq and fq["degraded"])
+    return doc, (EXIT_UNHEALTHY if unhealthy or lost or degraded else 0)
 
 
 # ------------------------------------------------- cross-process merge
@@ -310,6 +374,17 @@ def render_aggregate(dirs: Sequence[str],
                 + ", ".join(health_dir_for(d) for d in dirs))
     out = [f"== merged monitor over {len(dirs)} telemetry dir(s)"]
     out += _render_table(recs, counts, with_dir=True)
+    fq = fleet_quality(recs)
+    if fq:
+        gens = " ".join(f"g{g}={_fmt_quality(v)}"
+                        for g, v in sorted(fq["generations"].items()))
+        out.append(
+            f"-- fleet quality ({fq['procs']} proc(s)): "
+            f"worst auc={_fmt_quality(fq['live_auc'])}"
+            f"  worst psi={_fmt_quality(fq['score_psi'])}"
+            f"  joined={fq['joined']:,}"
+            + (f"  [{gens}]" if gens else "")
+            + ("  << QUALITY DEGRADED" if fq["degraded"] else ""))
     out.append("")
     out.append("-- per-proc step lag (vs the step's front-runner)")
     out.append(f"{'STEP':<11}{'PROC':<22}{'DIR':<14}{'ROWS':>12}"
@@ -336,6 +411,7 @@ def aggregate_json(dirs: Sequence[str], now: Optional[float] = None
         rec.pop("_file", None)
         rec.pop("_dir", None)
     healthy, active, quorum, lost = _quorum_state(recs, counts)
+    fq = fleet_quality(recs)
     unhealthy = counts.get("stalled", 0) + counts.get("stale", 0)
     doc = {
         "kind": "monitor_aggregate",
@@ -346,6 +422,7 @@ def aggregate_json(dirs: Sequence[str], now: Optional[float] = None
                           round(dir_clock_offset(d), 3) for d in dirs},
         "procs": recs,
         "step_lag": lag,
+        "quality": fq,
         "summary": {
             "total": len(recs),
             "counts": {k: counts.get(k, 0)
@@ -356,7 +433,8 @@ def aggregate_json(dirs: Sequence[str], now: Optional[float] = None
             "quorum_lost": lost,
         },
     }
-    return doc, (EXIT_UNHEALTHY if unhealthy or lost else 0)
+    degraded = bool(fq and fq["degraded"])
+    return doc, (EXIT_UNHEALTHY if unhealthy or lost or degraded else 0)
 
 
 def run_monitor(model_set_dir: str, interval_s: float = 2.0,
@@ -372,7 +450,8 @@ def run_monitor(model_set_dir: str, interval_s: float = 2.0,
     LOST) so scripts can gate on it.  ``aggregate_dirs`` switches to
     the merged multi-dir view (``--aggregate``; replaces ``--dir``);
     its human table ALSO exits 3 when the quorum is lost (live members
-    below ``shifu.dcn.quorumFrac``) — the fleet-level page."""
+    below ``shifu.dcn.quorumFrac``) or the merged fleet quality row is
+    degraded — the fleet-level page."""
     frames = 0
     rc = 0
     try:
@@ -384,8 +463,10 @@ def run_monitor(model_set_dir: str, interval_s: float = 2.0,
                 else:
                     _print(render_aggregate(aggregate_dirs))
                     recs, counts = aggregate_records(aggregate_dirs)
+                    fq = fleet_quality(recs)
                     rc = EXIT_UNHEALTHY \
-                        if _quorum_state(recs, counts)[3] else 0
+                        if (_quorum_state(recs, counts)[3]
+                            or (fq and fq["degraded"])) else 0
             elif json_mode:
                 doc, rc = status_json(model_set_dir)
                 _print(json.dumps(doc, sort_keys=True))

@@ -255,6 +255,44 @@ def _render_drift(model_set_dir: str, out: List[str]) -> None:
     out.append("")
 
 
+def _q(v: Any) -> str:
+    return "-" if v is None else f"{float(v):.4f}"
+
+
+def _render_quality(model_set_dir: str, out: List[str]) -> None:
+    """The model-quality section: the live AUC / calibration / score-PSI
+    table ``obs/quality`` emitted as ``telemetry/quality.json`` (absent
+    = the score-log plane never ran).  Rendering is byte-deterministic
+    for a given artifact: generations sorted newest-first, fixed-width
+    floats."""
+    path = os.path.join(os.path.abspath(model_set_dir), "telemetry",
+                        "quality.json")
+    if not os.path.isfile(path):
+        return
+    try:
+        with open(path) as f:
+            q = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        out.append(f"quality: {path} unreadable (torn write?)")
+        return
+    out.append(f"quality: {int(q.get('joined') or 0):,} joined rows vs "
+               f"posttrain baseline auc {_q(q.get('baseline_auc'))} "
+               f"(delta threshold {_q(q.get('auc_delta'))}, "
+               f"psi threshold {_q(q.get('psi_threshold'))})")
+    gens = sorted(((int(g), row) for g, row in
+                   (q.get("generations") or {}).items()), reverse=True)
+    for g, row in gens:
+        out.append(f"  gen {g}: auc={_q(row.get('live_auc'))} "
+                   f"ece={_q(row.get('ece'))} "
+                   f"psi={_q(row.get('psi'))}  "
+                   f"{int(row.get('joined') or 0):,} joined / "
+                   f"{int(row.get('scored') or 0):,} scored")
+    if q.get("degraded"):
+        out.append("  << QUALITY DEGRADED "
+                   f"({', '.join(q.get('reasons') or [])})")
+    out.append("")
+
+
 def render_telemetry(model_set_dir: str) -> str:
     """The ``analysis --telemetry`` payload for a model-set dir.  Missing
     or empty traces render a hint, not an error — the CLI exits 0 either
@@ -281,6 +319,7 @@ def render_telemetry(model_set_dir: str) -> str:
         grand += _render_block(block, out)
         out.append("")
     _render_drift(model_set_dir, out)
+    _render_quality(model_set_dir, out)
     out.append(f"pipeline total: {grand:.3f}s across {len(blocks)} "
                "step record(s)")
     return "\n".join(out)

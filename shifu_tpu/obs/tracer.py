@@ -82,7 +82,18 @@ from typing import Any, Dict, List, Optional
 # membership_epoch / live_members gauges), the ``dcn.step`` span, the
 # monitor's ``quorum_lost`` summary field (aggregate + single-dir), and
 # the bench's ``multihost_*`` extras (1→2→4 scaling + time-to-recover)
-SCHEMA_VERSION = 10
+# v11: model-quality observability plane — sampled score-log segments
+# under ``telemetry/scorelog/`` (``scorelog.*`` counters), the
+# delayed-label join (``quality.outcomes`` / ``quality.outcomes_late``),
+# the ``telemetry/posttrain.json`` training-time score snapshot eval
+# persists, the ``telemetry/quality.json`` live-quality table
+# (``quality.*`` gauges: per-generation live AUC / ECE / score-PSI),
+# SERVE heartbeats may carry a ``quality`` extra, the refresh
+# controller's third trigger source (``source: "quality"``), and the
+# bench's ``--plane quality`` extras (``serve_scorelog_qps_frac`` +
+# ``quality_label_flip_detect_s``, the lower-is-better ``*_detect_s``
+# compare class)
+SCHEMA_VERSION = 11
 
 _TRUE = ("1", "true", "on", "yes")
 

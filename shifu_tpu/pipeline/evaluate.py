@@ -245,6 +245,12 @@ class EvalProcessor(BasicProcessor):
                                  html_report(ev.name, curves, result))
         obs.gauge(f"eval.{ev.name}.auc").set(result.areaUnderRoc)
         obs.gauge(f"eval.{ev.name}.pr_auc").set(result.areaUnderPr)
+        # training-time quality baseline: score distribution + AUC the
+        # serve-path quality monitor (obs/quality) judges live traffic
+        # against — last eval run wins, matching the serving artifacts
+        from ..obs.quality import write_posttrain_snapshot
+        write_posttrain_snapshot(self.paths.posttrain_snapshot_path,
+                                 scores, auc=result.areaUnderRoc)
         log.info("eval %s: AUC %.6f weighted AUC %.6f PR-AUC %.6f",
                  ev.name, result.areaUnderRoc, result.weightedAuc,
                  result.areaUnderPr)

@@ -11,7 +11,12 @@ in-tree:
   norm/eval re-run emits), or a wall-clock schedule
   (``-Dshifu.refresh.intervalS``).  A cooldown guard
   (``-Dshifu.refresh.cooldownS``) keeps a sustained breach from
-  thrashing the fleet with back-to-back retrains;
+  thrashing the fleet with back-to-back retrains.  A THIRD trigger
+  source is live model quality (PR 16): the attached server's
+  :class:`~shifu_tpu.obs.quality.QualityMonitor` (or the
+  ``telemetry/quality.json`` artifact it emits) reporting live-AUC
+  degradation vs the posttrain snapshot or a score-distribution PSI
+  breach — the model itself went stale, even if the inputs look fine;
 - **warm retrain** — :func:`shifu_tpu.refresh.retrain.warm_retrain`:
   NN/WDL resume (params, opt state, RNG, early-stop state) from the
   PR-4 trainer checkpoints, GBT appends trees on boosted residuals of
@@ -143,8 +148,9 @@ class RefreshController:
     it, and probation reads the fleet's SERVE heartbeats).
 
     Hooks (``retrain_fn(controller, gen)``, ``gate_fn(controller,
-    candidate)``, ``drift_fn()``, ``slo_alerts_fn()``) default to the
-    real pipeline wiring and are injectable for tests/benches."""
+    candidate)``, ``drift_fn()``, ``quality_fn()``,
+    ``slo_alerts_fn()``) default to the real pipeline wiring and are
+    injectable for tests/benches."""
 
     def __init__(self, model_set_dir: str, server=None, registry=None,
                  key: Optional[str] = None,
@@ -153,6 +159,7 @@ class RefreshController:
                  sleep: Callable[[float], None] = time.sleep,
                  retrain_fn=None, gate_fn=None,
                  drift_fn: Optional[Callable[[], Optional[dict]]] = None,
+                 quality_fn: Optional[Callable[[], Optional[dict]]] = None,
                  slo_alerts_fn: Optional[Callable[[], List[dict]]] = None,
                  drift_columns: Optional[Sequence] = None,
                  warm: bool = True):
@@ -172,6 +179,7 @@ class RefreshController:
         self.retrain_fn = retrain_fn or _default_retrain
         self.gate_fn = gate_fn or _default_gate
         self.drift_fn = drift_fn
+        self.quality_fn = quality_fn
         self.slo_alerts_fn = slo_alerts_fn
         self._drift_columns = list(drift_columns) if drift_columns \
             else None
@@ -253,6 +261,23 @@ class RefreshController:
         except (OSError, ValueError):
             return None, True
 
+    def _quality_summary(self):
+        """(summary, from_artifact) — injectable fn > the attached
+        server's live quality monitor > the quality.json artifact a
+        serve process emitted."""
+        if self.quality_fn is not None:
+            return self.quality_fn(), False
+        if self.server is not None \
+                and getattr(self.server, "quality", None) is not None:
+            return self.server.quality.summary(), False
+        path = os.path.join(self.dir, "telemetry", "quality.json")
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            return (doc if isinstance(doc, dict) else None), True
+        except (OSError, ValueError):
+            return None, True
+
     # -------------------------------------------------------------- trigger
     def _check_trigger(self, now: float) -> Optional[Dict[str, Any]]:
         summ, from_artifact = self._drift_summary()
@@ -268,6 +293,20 @@ class RefreshController:
                         "psi_max": round(float(summ["psi_max"]), 6),
                         "rows": int(summ.get("rows") or 0),
                         "flagged": list(summ.get("flagged") or [])[:8]}
+        q, q_from_artifact = self._quality_summary()
+        if q and q.get("degraded"):
+            ts = q.get("ts")
+            # same staleness anchor as the drift artifact: a degraded
+            # table older than the last cycle is that cycle's cause,
+            # not a new signal
+            if not (q_from_artifact and anchor is not None
+                    and ts is not None and float(ts) <= float(anchor)):
+                return {"source": "quality",
+                        "reasons": list(q.get("reasons") or []),
+                        "live_auc": q.get("live_auc"),
+                        "baseline_auc": q.get("baseline_auc"),
+                        "score_psi": q.get("score_psi"),
+                        "joined": int(q.get("joined") or 0)}
         if self.config.interval_s > 0:
             base = anchor if anchor is not None else self._started_ts
             if now - float(base) >= self.config.interval_s:
@@ -556,6 +595,11 @@ class RefreshController:
         # the next cycle drifts against a FRESH live window — a breach
         # the cycle just answered must re-accumulate to re-trigger
         self._drift = self._fresh_drift()
+        # same for live quality: the just-answered degradation must not
+        # re-trigger off the old generation's windows
+        if self.server is not None \
+                and getattr(self.server, "quality", None) is not None:
+            self.server.quality.reset_windows()
 
     # ------------------------------------------------------------ run modes
     def run_once(self, poll_s: float = 0.5,

@@ -31,6 +31,13 @@ via :func:`shifu_tpu.obs.record_span`.  With sampling off the hot path
 pays ONE float compare per submit and nothing per batch, matching the
 PR 1/8 zero-cost convention; an explicit ``trace_id`` (the
 ``X-Shifu-Trace`` header) forces sampling for that request.
+
+Score logging (the quality plane's feed): when the server wires a
+:class:`shifu_tpu.obs.scorelog.ScoreLog` onto ``self.scorelog``, every
+completed launch offers its per-request mean scores to the log's own
+head sampler, keyed by the request id carried on the ticket
+(``req_id=``, the ``X-Shifu-Request`` header).  ``scorelog`` defaults to
+``None`` — one ``is not None`` check per launch, nothing per submit.
 """
 
 from __future__ import annotations
@@ -104,10 +111,11 @@ class Ticket:
     the per-request cost at high load is an array append."""
 
     __slots__ = ("n", "stamps", "scores", "done_ts", "_pending", "_event",
-                 "error", "_lock", "trace")
+                 "error", "_lock", "trace", "req")
 
     def __init__(self, n: int, stamps: np.ndarray,
-                 trace: Optional[_ReqTrace] = None):
+                 trace: Optional[_ReqTrace] = None,
+                 req: Optional[str] = None):
         self.n = n
         self.stamps = stamps                  # arrival time per row
         self.scores = np.empty(n, np.float32)
@@ -117,6 +125,7 @@ class Ticket:
         self._lock = threading.Lock()
         self.error: Optional[BaseException] = None
         self.trace = trace                    # sampled requests only
+        self.req = req                        # score-log join id
 
     def _complete(self, sl: slice, scores: Optional[np.ndarray],
                   now: float, error: Optional[BaseException]) -> None:
@@ -189,29 +198,37 @@ class MicroBatcher:
         self.size_counts: Dict[int, int] = {}
         self.refine_every = configured_refine_every()
         self._refining = False
+        # sampled score logging (obs/scorelog), wired by the server when
+        # -Dshifu.scorelog.sampleRate > 0; None keeps the launch path to
+        # one is-not-None check
+        self.scorelog = None
 
     # ------------------------------------------------------------ submit
     def submit(self, row: np.ndarray, bins: Optional[np.ndarray] = None,
                stamp: Optional[float] = None,
-               trace_id: Optional[str] = None) -> Ticket:
+               trace_id: Optional[str] = None,
+               req_id: Optional[str] = None) -> Ticket:
         """One single-record scoring request."""
         return self.submit_burst(
             np.asarray(row, np.float32)[None, :],
             None if bins is None else np.asarray(bins)[None, :],
             stamps=None if stamp is None else np.asarray([stamp]),
-            trace_id=trace_id)
+            trace_id=trace_id, req_id=req_id)
 
     def submit_burst(self, rows: np.ndarray,
                      bins: Optional[np.ndarray] = None,
                      stamps: Optional[np.ndarray] = None,
-                     trace_id: Optional[str] = None) -> Ticket:
+                     trace_id: Optional[str] = None,
+                     req_id: Optional[str] = None) -> Ticket:
         """A burst of concurrent single-record requests (an open-loop
         load generator's arrivals for one tick) — one queue append, one
         shared ticket.  ``stamps`` lets the generator record IDEAL
         arrival times so latency percentiles are free of coordinated
         omission.  ``trace_id`` (a propagated ``X-Shifu-Trace`` header)
         forces request tracing for this burst; otherwise the burst is
-        head-sampled at ``trace_sample_rate`` (minting an id)."""
+        head-sampled at ``trace_sample_rate`` (minting an id).
+        ``req_id`` (the ``X-Shifu-Request`` header) is the score log's
+        delayed-outcome join key for this burst."""
         n = len(rows)
         if stamps is None:
             stamps = np.full(n, self.clock())
@@ -221,7 +238,8 @@ class MicroBatcher:
                 and self._trace_rng.random() < self.trace_sample_rate):
             trace = _ReqTrace(trace_id or _mint_trace_id())
             obs.counter("serve.trace_sampled").inc()
-        t = Ticket(n, np.asarray(stamps, np.float64), trace=trace)
+        t = Ticket(n, np.asarray(stamps, np.float64), trace=trace,
+                   req=req_id)
         with self._cond:
             if self._stop:
                 raise RuntimeError("batcher is stopped")
@@ -387,6 +405,11 @@ class MicroBatcher:
                 self.slo.observe_batch(np.concatenate(
                     [now - t.stamps[so:so + len(r)]
                      for t, r, _, so in parts]))
+        if self.scorelog is not None and err is None:
+            lo = 0
+            for t, r, b, _ in parts:
+                self.scorelog.log(t.req, mean[lo:lo + len(r)], bins=b)
+                lo += len(r)
         if traced:
             self._emit_trace_spans(parts, traced, batch_index, bucket, n,
                                    pad, reason, err, t_take, tm, now_pc)

@@ -12,7 +12,9 @@ serves scoring requests:
 - over HTTP (stdlib, zero new deps): ``POST /score`` with
   ``{"rows": [[...]], "bins": [[...]]}`` -> ``{"scores": [...]}``,
   ``GET /healthz`` -> live state + bucket/batch/queue accounting + the
-  compact SLO summary, ``GET /slo`` -> the full SLO/burn-rate payload;
+  compact SLO summary, ``GET /slo`` -> the full SLO/burn-rate payload,
+  ``GET /quality`` -> the live model-quality table, ``POST /outcome``
+  -> delayed-label records joined onto logged predictions;
 - request tracing: an ``X-Shifu-Trace`` request header propagates the
   caller's trace id onto the batch pipeline (forcing sampling for that
   request); otherwise requests are head-sampled at
@@ -27,13 +29,26 @@ summary each beat (``shifu-tpu monitor`` renders and flags them); the
 metrics exporter mirrors the same numbers into ``metrics.prom``, and a
 ``stop()`` flushes any sampled request spans to the telemetry trace.
 
+Model-quality plane (``-Dshifu.scorelog.sampleRate`` > 0, default 0 =
+off): the server wires a sampled :class:`shifu_tpu.obs.ScoreLog` onto
+the batcher (crash-safe segments under ``telemetry/scorelog/``), an
+:class:`shifu_tpu.obs.OutcomeJoiner` (``POST /outcome`` +
+``telemetry/outcomes/`` drop directory, swept each heartbeat), and a
+:class:`shifu_tpu.obs.QualityMonitor` seeded from eval's
+``telemetry/posttrain.json`` snapshot — per-generation live AUC /
+calibration / score-PSI, surfaced via ``GET /quality``, a ``quality``
+heartbeat extra, and the atomic ``telemetry/quality.json`` artifact the
+refresh controller and ``analysis --telemetry`` read.
+
 Knobs: ``-Dshifu.serve.buckets`` (bucket ladder),
 ``-Dshifu.serve.bucketRefineEvery`` (batches between occupancy-driven
 ladder refinements, 0 = off),
 ``-Dshifu.serve.maxDelayMs`` (deadline flush, default 2 ms),
 ``-Dshifu.serve.traceSampleRate`` (head sampling, default 0),
 ``-Dshifu.serve.sloP99Ms`` / ``-Dshifu.serve.sloAvailability``
-(objectives; default 2x the deadline and 0.999).
+(objectives; default 2x the deadline and 0.999),
+``-Dshifu.scorelog.sampleRate`` / ``segmentBytes`` / ``budgetBytes``
+and ``-Dshifu.quality.*`` (the quality plane).
 """
 
 from __future__ import annotations
@@ -80,7 +95,8 @@ class ServeServer:
                  max_delay_ms: Optional[float] = None,
                  trace_sample_rate: Optional[float] = None,
                  slo_p99_ms: Optional[float] = None,
-                 slo_availability: Optional[float] = None):
+                 slo_availability: Optional[float] = None,
+                 scorelog_sample_rate: Optional[float] = None):
         self.model_set_dir = model_set_dir
         self.key = key or (os.path.basename(os.path.abspath(model_set_dir))
                            if model_set_dir else "default")
@@ -104,6 +120,29 @@ class ServeServer:
         self._heartbeat = None
         self._exporter = None
         self._started = False
+        # model-quality plane: only exists at sampleRate > 0 (zero-cost
+        # contract — the batcher tap stays one is-not-None check)
+        self.scorelog = None
+        self.outcomes = None
+        self.quality = None
+        self._join_count = 0
+        from ..obs.scorelog import scorelog_sample_rate as _rate_knob
+        rate = _rate_knob(scorelog_sample_rate)
+        if model_set_dir and rate > 0.0:
+            from ..obs.outcomes import OutcomeJoiner, outcomes_drop_dir
+            from ..obs.quality import (quality_artifact_path,
+                                       start_quality_monitor)
+            from ..obs.scorelog import ScoreLog, scorelog_dir
+            self.quality = start_quality_monitor(model_set_dir,
+                                                 sample_rate=rate)
+            self.outcomes = OutcomeJoiner(on_join=self._on_join)
+            self.scorelog = ScoreLog(
+                scorelog_dir(model_set_dir), sample_rate=rate,
+                gen_fn=lambda: self.registry.generation(self.key),
+                on_log=self._on_scored)
+            self.batcher.scorelog = self.scorelog
+            self._quality_path = quality_artifact_path(model_set_dir)
+            self._drop_dir = outcomes_drop_dir(model_set_dir)
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "ServeServer":
@@ -124,6 +163,10 @@ class ServeServer:
         if not self._started:
             return
         self.batcher.stop()
+        if self.scorelog is not None:
+            self.scorelog.close()       # commit the partial tail segment
+        if self.quality is not None:
+            self.quality.emit(path=self._quality_path)
         if self._exporter is not None:
             self._exporter.stop()
             self._exporter = None
@@ -145,28 +188,38 @@ class ServeServer:
         top = self.registry.get(self.key).buckets[-1]
         self.slo.emit_gauges()
         obs.gauge("serve.queue_depth").set(qd)
-        return {"queue_depth": int(qd),
-                "queue_buildup": bool(qd >= QUEUE_BUILDUP_BUCKETS * top),
-                "slo": self.slo.compact()}
+        extras = {"queue_depth": int(qd),
+                  "queue_buildup": bool(qd >= QUEUE_BUILDUP_BUCKETS * top),
+                  "slo": self.slo.compact()}
+        if self.quality is not None:
+            if self.outcomes is not None:
+                self.outcomes.ingest_drop_dir(self._drop_dir)
+            extras["quality"] = self.quality.compact()
+            self.quality.emit(path=self._quality_path)
+        return extras
 
     # ------------------------------------------------------------- scoring
     def submit(self, rows: np.ndarray,
                bins: Optional[np.ndarray] = None,
-               trace_id: Optional[str] = None) -> Ticket:
+               trace_id: Optional[str] = None,
+               req_id: Optional[str] = None) -> Ticket:
         return self.batcher.submit_burst(np.asarray(rows, np.float32),
-                                         bins, trace_id=trace_id)
+                                         bins, trace_id=trace_id,
+                                         req_id=req_id)
 
     def score(self, rows: np.ndarray, bins: Optional[np.ndarray] = None,
               timeout: float = 30.0,
-              trace_id: Optional[str] = None) -> np.ndarray:
+              trace_id: Optional[str] = None,
+              req_id: Optional[str] = None) -> np.ndarray:
         """Closed-loop scoring (mean ensemble score per row, scaled)."""
         if not self._started:                  # in-process, no worker
             t = self.batcher.submit_burst(np.asarray(rows, np.float32),
-                                          bins, trace_id=trace_id)
+                                          bins, trace_id=trace_id,
+                                          req_id=req_id)
             self.batcher.drain()
             return t.wait(timeout)
         t = self.batcher.submit_burst(np.asarray(rows, np.float32), bins,
-                                      trace_id=trace_id)
+                                      trace_id=trace_id, req_id=req_id)
         return t.wait(timeout)
 
     def swap(self, models_or_dir) -> None:
@@ -210,6 +263,57 @@ class ServeServer:
                 "queue_depth": int(self.batcher.queue_depth),
                 **self.slo.summary()}
 
+    # ------------------------------------------------------ quality plane
+    def _on_scored(self, req: str, scores, gen: int, ts: float) -> None:
+        """Score-log hook (every SAMPLED record): feed the PSI
+        histogram and register the prediction for the delayed join."""
+        if self.quality is not None:
+            self.quality.observe_scores(gen, scores)
+        if self.outcomes is not None:
+            self.outcomes.record_prediction(req, scores, gen, ts=ts)
+
+    def _on_join(self, gen: int, scores, labels) -> None:
+        """Outcome-join hook: fold the joined rows into the live
+        AUC/calibration windows; re-emit the artifact periodically so
+        the controller/monitor read fresh numbers between beats."""
+        if self.quality is None:
+            return
+        self.quality.update(gen, scores, labels)
+        self._join_count += 1
+        if self._join_count % 8 == 0:
+            self.quality.emit(path=self._quality_path)
+
+    def add_outcomes(self, doc) -> dict:
+        """The ``POST /outcome`` body: one ``{"req", "labels"}`` record
+        or a ``{"outcomes": [...]}`` batch.  Returns join accounting
+        (``joined_rows`` counts rows joined by THIS call)."""
+        if self.outcomes is None:
+            return {"kind": "outcome", "enabled": False,
+                    "joined_rows": 0}
+        recs = doc.get("outcomes") \
+            if isinstance(doc, dict) and "outcomes" in doc else [doc]
+        joined = 0
+        for rec in recs:
+            got = self.outcomes.add_outcome(
+                str(rec["req"]), rec.get("labels", rec.get("label")))
+            if got is not None:
+                joined += int(len(got[1]))
+        return {"kind": "outcome", "enabled": True,
+                "joined_rows": joined,
+                "pending": self.outcomes.pending,
+                "late": self.outcomes.stats["late"]}
+
+    def quality_doc(self) -> dict:
+        """The ``GET /quality`` payload: the live quality summary (drop
+        directory swept first, so a batch label feed lands before the
+        read)."""
+        if self.quality is None:
+            return {"kind": "quality", "key": self.key, "enabled": False}
+        if self.outcomes is not None:
+            self.outcomes.ingest_drop_dir(self._drop_dir)
+        return {"key": self.key, "enabled": True,
+                **self.quality.summary()}
+
 
 # ------------------------------------------------------------------ HTTP
 def _make_handler(server: ServeServer):
@@ -229,26 +333,40 @@ def _make_handler(server: ServeServer):
                 self._reply(200, server.status())
             elif self.path == "/slo":
                 self._reply(200, server.slo_doc())
+            elif self.path == "/quality":
+                self._reply(200, server.quality_doc())
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
         def do_POST(self):                     # noqa: N802
-            if self.path != "/score":
+            if self.path not in ("/score", "/outcome"):
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 doc = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/outcome":
+                    self._reply(200, server.add_outcomes(doc))
+                    return
                 rows = np.asarray(doc["rows"], np.float32)
                 bins = doc.get("bins")
                 if bins is not None:
                     bins = np.asarray(bins, np.int32)
                 # propagate the caller's trace id (forces sampling)
                 trace_id = self.headers.get("X-Shifu-Trace")
-                scores = server.score(rows, bins, trace_id=trace_id)
+                # the outcome-join key: caller-supplied, or minted here
+                # when the score log is live (sampling decides whether
+                # the id actually becomes joinable)
+                req_id = self.headers.get("X-Shifu-Request")
+                if req_id is None and server.scorelog is not None:
+                    req_id = os.urandom(8).hex()
+                scores = server.score(rows, bins, trace_id=trace_id,
+                                      req_id=req_id)
                 out = {"scores": [round(float(s), 6) for s in scores]}
                 if trace_id:
                     out["trace"] = trace_id
+                if req_id:
+                    out["req"] = req_id
                 self._reply(200, out)
             except Exception as e:             # noqa: BLE001 — HTTP edge
                 self._reply(400, {"error": str(e)})
