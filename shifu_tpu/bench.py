@@ -468,6 +468,92 @@ def bench_wdl(n_rows: int = 1 << 17, n_num: int = 64, n_cat: int = 32,
         return best
 
 
+def bench_wdl_sharded(n_rows: int = 1 << 17, n_num: int = 64,
+                      n_cat: int = 32, card: int = 0, batch: int = 1 << 12,
+                      steps: int = 2000,
+                      collect: Dict[str, Any] = None) -> float:
+    """Sharded-table WDL training-step throughput: the same dual-plane
+    minibatch updates as :func:`bench_wdl`, but with every embed/wide
+    table (and its Adam moments) row-sharded over the data axis and the
+    lookups running the sparse per-minibatch gather
+    (``train/wdl_shard``).  The timing window is ONE scanned epoch
+    executable over pre-batched blocks.
+
+    ``card`` (or ``SHIFU_BENCH_WDL_TABLE_ROWS``) sets the per-table
+    cardinality — raise it past single-device HBM to exercise the
+    oversized-table scenario sharding exists for; the default matches
+    :func:`bench_wdl` so the rows compare the mechanism alone."""
+    import jax
+    import jax.numpy as jnp
+    import os
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from shifu_tpu.models.wdl import WDLModelSpec, init_params
+    from shifu_tpu.parallel import mesh as meshlib
+    from shifu_tpu.train import wdl_shard
+    from shifu_tpu.train.optimizers import make_optimizer
+
+    card = card or int(os.environ.get("SHIFU_BENCH_WDL_TABLE_ROWS",
+                                      0) or 0) or 64
+    if jax.default_backend() == "cpu":
+        # host shard_map collectives run ~1000x slower than ICI; a full
+        # accelerator-sized window would take tens of minutes on the CI
+        # rig for the same steady-state number
+        steps = min(steps, 100)
+        n_rows = min(n_rows, 1 << 14)
+    mesh = meshlib.device_mesh(n_ensemble=1)
+    d = mesh.shape["data"]
+    batch = max(batch - batch % d, d)
+    n_rows = max((n_rows // batch) * batch, batch)
+    nb = n_rows // batch
+
+    rng = np.random.default_rng(0)
+    x_num = rng.normal(size=(n_rows, n_num)).astype(np.float32)
+    x_cat = rng.integers(0, card, (n_rows, n_cat)).astype(np.int32)
+    logit = x_num[:, 0] * 0.8 + (x_cat[:, 0] < card // 2) * 0.7 - 0.3
+    y = (rng.random(n_rows) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    spec = WDLModelSpec(numeric_dim=n_num,
+                        cat_cardinalities=[card] * n_cat, embed_dim=16,
+                        hidden_nodes=[128, 64],
+                        activations=["relu", "relu"])
+    plane = wdl_shard.WDLShardPlane(mesh, spec, 1)
+    member = plane.pad_params(init_params(jax.random.PRNGKey(0), spec))
+    opt = make_optimizer("ADAM", 1e-3)
+    stacked = jax.tree_util.tree_map(lambda a: a[None], member)
+    opt_state = jax.tree_util.tree_map(lambda a: a[None], opt.init(member))
+    stacked, opt_state = plane.put(stacked, opt_state)
+    fns = wdl_shard.build_inram_fns(plane, stacked, opt_state, opt,
+                                    "f32", 0.0)
+
+    sh = lambda s: NamedSharding(mesh, s)          # noqa: E731
+    xn3 = jax.device_put(x_num.reshape(nb, batch, n_num),
+                         sh(P(None, "data", None)))
+    xc3 = jax.device_put(x_cat.reshape(nb, batch, n_cat),
+                         sh(P(None, "data", None)))
+    y3 = jax.device_put(y.reshape(nb, batch), sh(P(None, "data")))
+    tw3 = jax.device_put(np.ones((1, nb, batch), np.float32),
+                         sh(P("ensemble", None, "data")))
+    border = jnp.asarray(np.arange(steps, dtype=np.int32) % nb)
+
+    with jax.default_matmul_precision("bfloat16"):
+        epoch = fns["epoch_steps"]
+        stacked, opt_state = epoch(stacked, opt_state, xn3, xc3, y3, tw3,
+                                   border)
+        jax.block_until_ready(stacked)               # full warmup sync
+        _collect_window_cost(collect, epoch,
+                             (stacked, opt_state, xn3, xc3, y3, tw3,
+                              border), {}, steps * batch)
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            stacked, opt_state = epoch(stacked, opt_state, xn3, xc3, y3,
+                                       tw3, border)
+            jax.block_until_ready(stacked)           # value-forcing sync
+            best = max(best, steps * batch / (time.perf_counter() - t0))
+        return best
+
+
 def bench_eval(n_rows: int = 1 << 20, n_features: int = 256,
                n_models: int = 5) -> float:
     """Eval-stack throughput: a bagged NN scored + confusion-swept (the
@@ -2247,6 +2333,22 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
         _mfu_extras("wdl_train", extras["wdl_train_throughput"], wdl_cost,
                     extras)
         for k in ("wdl_train_mfu", "wdl_train_achieved_bw"):
+            if k in extras:
+                obs.gauge(f"bench.{k}").set(float(extras[k]))
+    wdl_sh_cost: Dict[str, Any] = {}
+    record("wdl_train_sharded_throughput",
+           lambda: bench_wdl_sharded(collect=wdl_sh_cost),
+           BASELINE_ROWS_PER_SEC)
+    if "wdl_train_sharded_throughput" in extras:
+        _mfu_extras("wdl_train_sharded",
+                    extras["wdl_train_sharded_throughput"], wdl_sh_cost,
+                    extras)
+        if "wdl_train_throughput" in extras:
+            extras["wdl_train_sharded_vs_replicated"] = round(
+                extras["wdl_train_sharded_throughput"]
+                / max(extras["wdl_train_throughput"], 1e-9), 3)
+        for k in ("wdl_train_sharded_mfu", "wdl_train_sharded_achieved_bw",
+                  "wdl_train_sharded_vs_replicated"):
             if k in extras:
                 obs.gauge(f"bench.{k}").set(float(extras[k]))
     record("eval_throughput", bench_eval, BASELINE_SCORE_RATE)

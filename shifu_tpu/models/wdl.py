@@ -73,8 +73,13 @@ def init_params(key, spec: WDLModelSpec) -> Dict:
     n_cat = len(spec.cat_cardinalities)
     keys = jax.random.split(key, n_cat + 2)
     if spec.deep_enable:
+        # fan-in scaling: the first dense layer sees embed_dim inputs per
+        # column, so variance 1/embed_dim keeps its pre-activations O(1)
+        # at any embed_dim/hash-bucket count (a fixed 0.05 degrades as
+        # embed_dim grows)
+        scale = spec.embed_dim ** -0.5
         params["embed"] = [
-            jax.random.normal(keys[i], (card, spec.embed_dim)) * 0.05
+            jax.random.normal(keys[i], (card, spec.embed_dim)) * scale
             for i, card in enumerate(spec.cat_cardinalities)]
         deep_in = spec.numeric_dim + n_cat * spec.embed_dim
         deep_spec = NNModelSpec(input_dim=deep_in,
@@ -126,11 +131,24 @@ def forward_logits(params: Dict, spec: WDLModelSpec, x_num, x_cat):
     # leave the graph unchanged.
     cdt = tabs[0].dtype if tabs else (
         params["deep"][0]["w"].dtype if spec.deep_enable else jnp.float32)
-    if cdt != jnp.float32 and spec.numeric_dim:
-        x_num = x_num.astype(cdt)
     use_onehot = bool(tabs) and (
         x_cat.shape[0] * x_cat.shape[1]
         * max(t.shape[0] for t in tabs) <= _ONEHOT_MAX_ELEMS)
+    if tabs and not use_onehot:
+        # gather lowering: do the lookups here, then share the dense half
+        # with the sharded paths so classic-vs-sharded scores stay bitwise
+        emb = wide_rows = None
+        if spec.deep_enable:
+            emb = jnp.stack([
+                t[jnp.clip(x_cat[:, i], 0, t.shape[0] - 1)]
+                for i, t in enumerate(params["embed"])], axis=1)
+        if spec.wide_enable:
+            wide_rows = jnp.stack([
+                v[jnp.clip(x_cat[:, i], 0, v.shape[0] - 1)]
+                for i, v in enumerate(params["wide_cat"])], axis=1)
+        return forward_logits_gathered(params, spec, x_num, emb, wide_rows)
+    if cdt != jnp.float32 and spec.numeric_dim:
+        x_num = x_num.astype(cdt)
     oh = _cat_onehot(params, x_cat) if use_onehot else None
     if oh is not None and cdt != jnp.float32:
         # 0/1 one-hot is exact in bf16; keeping it narrow keeps the
@@ -184,8 +202,141 @@ def forward_logits(params: Dict, spec: WDLModelSpec, x_num, x_cat):
     return logit
 
 
+def _ensure_barrier_batching() -> None:
+    """``optimization_barrier`` has no vmap rule in this jax — the barrier
+    is identity-shaped, so batching is bind-through (installed only when
+    missing; newer jax versions ship their own)."""
+    try:
+        from jax._src.lax.lax import optimization_barrier_p as p
+        from jax.interpreters import batching
+    except ImportError:                           # pragma: no cover
+        return
+    if p in batching.primitive_batchers:
+        return
+
+    def _batch(args, dims):
+        return p.bind(*args), dims
+
+    batching.primitive_batchers[p] = _batch
+
+
+_ensure_barrier_batching()
+
+
+@jax.custom_vjp
+def _lookup_barrier(ops):
+    """Differentiable ``optimization_barrier`` (no autodiff rule upstream):
+    identity that XLA may not fuse across, both directions — the backward
+    barrier keeps the dense half's cotangents identical across paths before
+    they enter the per-path lookup transposes (scatter-add vs all_gather)."""
+    return jax.lax.optimization_barrier(ops)
+
+
+def _lookup_barrier_fwd(ops):
+    return jax.lax.optimization_barrier(ops), None
+
+
+def _lookup_barrier_bwd(_, cts):
+    return (jax.lax.optimization_barrier(cts),)
+
+
+_lookup_barrier.defvjp(_lookup_barrier_fwd, _lookup_barrier_bwd)
+
+
+def forward_logits_gathered(params: Dict, spec: WDLModelSpec, x_num,
+                            emb, wide_rows):
+    """The dense half of the gather lowering with the categorical lookups
+    already done: ``emb`` [N, C, E] embedding rows, ``wide_rows`` [N, C]
+    wide weights (either may be None when that side is off).  The sharded
+    trainer and the sharded serving path both feed their psum-scattered /
+    psum'd lookups through THIS function, so their arithmetic is the
+    replicated gather path's bit for bit.
+
+    The barrier pins that contract: without it XLA fuses the lookup
+    (gather here, psum/psum_scatter in the sharded paths) into the dense
+    half and reassociates the final logit adds differently per caller —
+    a last-ulp drift that breaks bit-parity between the paths."""
+    if emb is not None or wide_rows is not None:
+        emb, wide_rows = _lookup_barrier((emb, wide_rows))
+    if spec.deep_enable and emb is not None:
+        n = emb.shape[0]
+        cdt = emb.dtype
+    elif wide_rows is not None:
+        n = wide_rows.shape[0]
+        cdt = wide_rows.dtype
+    else:
+        n = x_num.shape[0]
+        cdt = params["deep"][0]["w"].dtype if spec.deep_enable \
+            else jnp.float32
+    if cdt != jnp.float32 and spec.numeric_dim:
+        x_num = x_num.astype(cdt)
+    logit = jnp.zeros((n, 1)) + params["bias"].astype(jnp.float32)
+    if spec.deep_enable:
+        parts = [x_num] if spec.numeric_dim else []
+        for i in range(emb.shape[1]):
+            parts.append(emb[:, i, :])
+        h = jnp.concatenate(parts, axis=1)
+        from .nn import ACTIVATIONS
+        acts = [ACTIVATIONS[a.lower()] for a in spec.activations]
+        for li, layer in enumerate(params["deep"][:-1]):
+            h = acts[li % len(acts)](h @ layer["w"] + layer["b"])
+        last = params["deep"][-1]
+        logit = logit + h @ last["w"] + last["b"]
+    if spec.wide_enable:
+        wide = jnp.zeros((n, 1))
+        for i in range(wide_rows.shape[1]):
+            wide = wide + wide_rows[:, i][:, None]
+        if spec.numeric_dim:
+            wide = wide + x_num @ params["wide_num"]
+        logit = logit + wide
+    return logit
+
+
 def forward(params: Dict, spec: WDLModelSpec, x_num, x_cat):
     return jax.nn.sigmoid(forward_logits(params, spec, x_num, x_cat))
+
+
+# ---------------------------------------------------------- hashed IDs
+def hash_plan(spec: WDLModelSpec):
+    """(buckets, [(col_pos, key64), ...]) from the spec's hashed-ID plan,
+    or None when the spec has no hashed columns.  The plan is recorded in
+    ``spec.extra`` at train time so serving replays the identical map."""
+    buckets = int(spec.extra.get("hash_buckets", 0) or 0)
+    cols = spec.extra.get("hashed_cols") or []
+    keys = spec.extra.get("hash_keys") or []
+    if buckets <= 0 or not cols:
+        return None
+    return buckets, [(int(c), int(k)) for c, k in zip(cols, keys)]
+
+
+def apply_hash_host(spec: WDLModelSpec, x_cat: np.ndarray) -> np.ndarray:
+    """Map hashed-ID columns of a host [N, C] bin matrix into bucket
+    space (identity when the spec has no hash plan).  NOT idempotent —
+    exactly one layer owns the call per path (trainers and
+    ``IndependentWDLModel.compute``; ``forward`` consumes bucket ids)."""
+    plan = hash_plan(spec)
+    if plan is None:
+        return x_cat
+    from ..ops import hashing
+    buckets, cols = plan
+    out = np.array(x_cat, np.int32, copy=True)
+    for c, key in cols:
+        out[:, c] = hashing.hash_bucket_host(x_cat[:, c], key, buckets)
+    return out
+
+
+def apply_hash_device(spec: WDLModelSpec, x_cat):
+    """In-graph replay of :func:`apply_hash_host` for the serving path —
+    bit-identical bucket ids (splitmix64 over uint32 limbs)."""
+    plan = hash_plan(spec)
+    if plan is None:
+        return x_cat
+    from ..ops import hashing
+    buckets, cols = plan
+    parts = [x_cat[:, i] for i in range(x_cat.shape[1])]
+    for c, key in cols:
+        parts[c] = hashing.hash_bucket_device(parts[c], key, buckets)
+    return jnp.stack(parts, axis=1)
 
 
 def per_row_bce(p, y):
@@ -277,6 +428,7 @@ class IndependentWDLModel:
         return cls(*load_model(path))
 
     def compute(self, x_num: np.ndarray, x_cat: np.ndarray) -> np.ndarray:
+        x_cat = apply_hash_host(self.spec, np.asarray(x_cat, np.int32))
         return np.asarray(self._fwd(self.params,
                                     jnp.asarray(x_num, jnp.float32),
                                     jnp.asarray(x_cat, jnp.int32)))

@@ -64,6 +64,16 @@ MANIFEST: Dict[str, Tuple[str, str]] = {
     "train.tail_repair_levels": ("counter", "levels regrown by repairs"),
     "train.tail_c2f_fallbacks": ("counter",
                                  "c2f auto-fallbacks to the exact schedule"),
+    # ---- WDL sharded categorical plane (train/wdl_shard)
+    "wdl.shard_devices": ("gauge", "data-axis shards each WDL table "
+                                   "splits over"),
+    "wdl.shard_table_bytes": ("gauge", "per-device bytes of table params "
+                                       "+ optimizer moments"),
+    "wdl.hash_buckets": ("gauge", "hashed-ID bucket space (0 = exact ids)"),
+    "wdl.hashed_cols": ("gauge", "categorical columns on the hashed-ID "
+                                 "path"),
+    "wdl.serve_shard_devices": ("gauge", "devices the serve-time sharded "
+                                         "table copy spans"),
     # ---- eval plane (per-set AUC gauges ride the eval. prefix)
     "eval.rows_scored": ("counter", "eval rows scored"),
     "eval.rows_per_sec": ("gauge", "eval scoring throughput"),

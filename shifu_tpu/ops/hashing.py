@@ -21,7 +21,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-_MASK16 = jnp.uint32(0xFFFF)
+# np scalar, not jnp: a module-level jnp constant would be minted as a
+# tracer (and leak) if this module's FIRST import happens inside a trace
+# — the serving path's in-graph apply_hash_device can be that first
+# importer in a fresh process
+_MASK16 = np.uint32(0xFFFF)
 
 
 def _add64(ahi, alo, bhi, blo):
@@ -158,3 +162,45 @@ def split_index_u32(idx: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     idx = np.asarray(idx, np.uint64)
     return ((idx >> np.uint64(32)).astype(np.uint32),
             (idx & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+
+
+# ---------------------------------------------------------- hash buckets
+# The WDL hashed-ID path folds a high-cardinality categorical column into
+# a fixed bucket space: bucket = high word of (splitmix64(id ^ col_key)
+# >> 32) * buckets — Lemire's multiply-shift range reduction over the top
+# 32 hash bits.  No 64-bit modulo anywhere, so the device replay (uint32
+# limbs) is BIT-IDENTICAL to the host map by construction.
+
+#: seed for per-column hash keys (distinct from the row-bagging streams
+#: so a column never shares a key with a bag draw)
+WDL_HASH_SEED = 0x5D1F00D
+
+
+def column_hash_key(column_num: int, seed: int = WDL_HASH_SEED) -> int:
+    """Stable 64-bit per-column key for the hashed-ID bucket map."""
+    return _row_key(seed, column_num)
+
+
+def hash_bucket_host(idx: np.ndarray, key: int, buckets: int) -> np.ndarray:
+    """[N] int32 bucket ids for host-side (norm/trainer) hashed-ID columns."""
+    z = np.maximum(np.asarray(idx, np.int64), 0).astype(np.uint64)
+    z ^= np.uint64(key)
+    z = (z + np.uint64(0x9E3779B97F4A7C15)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+    z ^= z >> np.uint64(30)
+    z = (z * np.uint64(0xBF58476D1CE4E5B9)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+    z ^= z >> np.uint64(27)
+    z = (z * np.uint64(0x94D049BB133111EB)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+    z ^= z >> np.uint64(31)
+    hi32 = z >> np.uint64(32)
+    return ((hi32 * np.uint64(buckets)) >> np.uint64(32)).astype(np.int32)
+
+
+def hash_bucket_device(idx, key: int, buckets: int):
+    """Device replay of :func:`hash_bucket_host` (uint32 limbs, in-graph
+    for the serving path) — bit-identical to the host map."""
+    ilo = jnp.maximum(idx, 0).astype(jnp.uint32)
+    ihi = jnp.zeros_like(ilo)
+    khi, klo = jnp.uint32(key >> 32), jnp.uint32(key & 0xFFFFFFFF)
+    zhi, zlo = _splitmix64_dev(ihi ^ khi, ilo ^ klo)
+    bhi, _ = _mul32x32(zhi, jnp.uint32(buckets))
+    return bhi.astype(jnp.int32)

@@ -370,6 +370,19 @@ class MicroBatcher:
             err = e
         now = self.clock()
         now_pc = time.perf_counter() if traced else 0.0
+        # SLO record BEFORE ticket completion: a caller unblocked by
+        # _complete may read /slo immediately, and must see this batch's
+        # latencies (guarded so a tracker fault can never hang tickets)
+        if self.slo is not None:
+            try:
+                if err is not None:
+                    self.slo.record_errors(n)
+                else:
+                    self.slo.observe_batch(np.concatenate(
+                        [now - t.stamps[so:so + len(r)]
+                         for t, r, _, so in parts]))
+            except Exception:               # noqa: BLE001
+                log.exception("SLO record failed for batch")
         off = 0
         for t, r, _, src_off in parts:
             sl_dst = slice(src_off, src_off + len(r))
@@ -398,13 +411,6 @@ class MicroBatcher:
         if err is None and self.refine_every \
                 and batches_now % self.refine_every == 0:
             self._maybe_refine(scorer)
-        if self.slo is not None:
-            if err is not None:
-                self.slo.record_errors(n)
-            else:
-                self.slo.observe_batch(np.concatenate(
-                    [now - t.stamps[so:so + len(r)]
-                     for t, r, _, so in parts]))
         if self.scorelog is not None and err is None:
             lo = 0
             for t, r, b, _ in parts:

@@ -177,20 +177,38 @@ def _tree_column(m) -> Callable:
 
 def _wdl_column(m) -> Callable:
     """Device-traceable WDL column: the index slicing of
-    ``compute_full`` moved inside the trace."""
+    ``compute_full`` moved inside the trace.
+
+    The serve copy of the categorical plane is picked ONCE at build time
+    (``shifu.wdl.serveCopy`` — see :func:`train.wdl_shard.
+    build_serve_forward`): tables too big for one device score through a
+    row-sharded gather inside this same traced graph (replicated
+    activations, one psum per lookup plane — never an all-gather of a
+    table), a hot-rows copy squashes the cold tail, and small tables keep
+    the classic replicated forward.  All modes trace to fixed shapes, so
+    the per-bucket AOT contract (zero recompiles) is untouched.  Hashed-ID
+    columns fold in-graph (``apply_hash_device``) — bit-identical to the
+    trainer's host hashing."""
     import jax.numpy as jnp
 
-    from ..models.wdl import forward
+    from ..models.wdl import apply_hash_device, forward
+    from ..train.wdl_shard import build_serve_forward
 
     nf = tuple((m.spec.extra or {}).get("num_feat_idx") or ())
     cf = tuple((m.spec.extra or {}).get("cat_col_idx") or ())
     spec, params = m.spec, m.params
+    mode, sharded_fwd = build_serve_forward(spec, params)
+    if mode != "full":
+        log.info("WDL serve column: %s table copy", mode)
 
     def col(x, bins):
         x_num = x[:, np.asarray(nf, np.int32)] if nf \
             else jnp.zeros((x.shape[0], 0), jnp.float32)
         x_cat = bins[:, np.asarray(cf, np.int32)].astype(jnp.int32) if cf \
             else jnp.zeros((x.shape[0], 0), jnp.int32)
+        x_cat = apply_hash_device(spec, x_cat)
+        if sharded_fwd is not None:
+            return sharded_fwd(x_num, x_cat)[:, 0]
         return forward(params, spec, x_num, x_cat)[:, 0]
     return col
 

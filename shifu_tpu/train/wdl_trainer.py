@@ -124,12 +124,22 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
                        valid_rate: float = 0.2,
                        sample_rate: float = 1.0, replacement: bool = False,
                        stratified: bool = False, up_sample_weight: float = 1.0,
-                       mesh=None, progress=None) -> WDLResult:
+                       mesh=None, progress=None,
+                       shard: Optional[bool] = None) -> WDLResult:
     """B bagging members vmapped over the (ensemble, data) mesh — the NN
-    trainer's SPMD shape with WDL's dual input planes."""
+    trainer's SPMD shape with WDL's dual input planes.
+
+    ``shard`` overrides ``shifu.wdl.shardTables``: True row-shards every
+    embedding/wide table (and its optimizer moments) over the ``data``
+    axis (see train/wdl_shard), False keeps them replicated, None lets
+    the knob's auto gate decide from the table footprint."""
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from . import wdl_shard
 
     n = len(y)
+    # hashed-ID columns fold into bucket space ONCE, on the raw bins
+    # (spec.extra carries the plan; forward consumes bucket ids)
+    x_cat = wdl_model.apply_hash_host(spec, np.asarray(x_cat, np.int32))
     train_w, valid_w = member_masks(
         n, bags, valid_rate=valid_rate, sample_rate=sample_rate,
         replacement=replacement, stratified=stratified,
@@ -167,6 +177,15 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
     precision = resolve_precision(settings.precision)
     if precision != "f32":
         init_list = [cast_tree(p, jnp.bfloat16) for p in init_list]
+    use_shard = wdl_shard.shard_enabled(spec, mesh, bags, precision,
+                                        override=shard)
+    plane = None
+    if use_shard:
+        # row-shard every embed/wide_cat table over the data axis BEFORE
+        # opt.init so the optimizer moments inherit the padded shard shape
+        # — no device ever materializes a full table (train/wdl_shard)
+        plane = wdl_shard.WDLShardPlane(mesh, spec, bags)
+        init_list = [plane.pad_params(m) for m in init_list]
     stacked = _stack(init_list)
     if precision == "mixed":
         opt_state = _stack([mixed_init(opt, p) for p in init_list])
@@ -174,8 +193,11 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
         opt_state = _stack([opt.init(p) for p in init_list])
 
     sh_ens = NamedSharding(mesh, P("ensemble"))
-    stacked = jax.device_put(stacked, sh_ens)
-    opt_state = jax.device_put(opt_state, sh_ens)
+    if use_shard:
+        stacked, opt_state = plane.put(stacked, opt_state)
+    else:
+        stacked = jax.device_put(stacked, sh_ens)
+        opt_state = jax.device_put(opt_state, sh_ens)
     xnd = jax.device_put(xn, NamedSharding(mesh, P("data", None)))
     xcd = jax.device_put(xc, NamedSharding(mesh, P("data", None)))
     yd = jax.device_put(yv, NamedSharding(mesh, P("data")))
@@ -183,11 +205,32 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
     vwd = jax.device_put(valid_w, NamedSharding(mesh, P("ensemble", "data")))
     l2 = settings.l2
 
+    fns = wdl_shard.build_inram_fns(plane, stacked, opt_state, opt,
+                                    precision, l2) if use_shard else None
+    if use_shard:
+        extra = spec.extra or {}
+        wdl_shard.record_shard_gauges(
+            plane, precision, int(extra.get("hash_buckets", 0) or 0),
+            len(extra.get("hashed_cols") or []))
+
     from functools import partial
 
     def member_update(params, ostate, xnb, xcb, yb, mw):
-        loss, grads = jax.value_and_grad(wdl_model.weighted_loss)(
-            params, spec, xnb, xcb, yb[:, None], mw, l2)
+        # normalizer OUTSIDE the grad, L2 added analytically after — the
+        # exact gradient the sharded plane computes, so the replicated and
+        # sharded paths agree bitwise at any device count that keeps the
+        # row reduction order (see train/wdl_shard module docstring)
+        inv = 1.0 / jnp.maximum(mw.sum(), 1e-9)
+
+        def data_loss(p):
+            pr = wdl_model.forward(p, spec, xnb, xcb)
+            per = wdl_model.per_row_bce(pr, yb[:, None])
+            return (per * mw).sum() * inv
+
+        loss, grads = jax.value_and_grad(data_loss)(params)
+        if l2:
+            grads = jax.tree_util.tree_map(
+                jnp.add, grads, wdl_model.l2_grads(params, l2))
         if precision == "mixed":
             params, ostate = mixed_apply(opt, grads, ostate)
             return params, ostate, loss
@@ -199,14 +242,17 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
         return params, ostate, loss
 
     # cost-attributed wdl-plane entry points (obs/costs): the utilization
-    # report joins these against the TRAIN span wall-clock
+    # report joins these against the TRAIN span wall-clock.  Data planes
+    # travel as ARGUMENTS, never closures: a closed-over array becomes an
+    # XLA constant the compiler may fold into differently-fused (last-ulp
+    # different) programs — args keep both trainer paths on one lowering
     @partial(obs.costed_jit, "wdl.step")
     def step(stacked, opt_state, xnb, xcb, yb, tw):
         return jax.vmap(member_update, in_axes=(0, 0, None, None, None, 0))(
             stacked, opt_state, xnb, xcb, yb, tw)
 
     @partial(obs.costed_jit, "wdl.eval_errors")
-    def eval_errors(stacked, tw, vw):
+    def eval_errors(stacked, tw, vw, xnd, xcd, yd):
         def one(params, mw):
             p = wdl_model.forward(params, spec, xnd, xcd)
             per = wdl_model.per_row_bce(p, yd[:, None])
@@ -219,7 +265,7 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
     # compiles into the SPMD program — an EAGER lax.slice on sharded inputs
     # does ad-hoc device-to-device copies on the host backend, which the
     # XLA:CPU runtime intermittently aborts on (observed SIGABRT)
-    def step_batch(stacked, opt_state, start, bs: int):
+    def step_batch(stacked, opt_state, start, bs: int, xnd, xcd, yd, twd):
         xnb = jax.lax.dynamic_slice_in_dim(xnd, start, bs, axis=0)
         xcb = jax.lax.dynamic_slice_in_dim(xcd, start, bs, axis=0)
         yb = jax.lax.dynamic_slice_in_dim(yd, start, bs, axis=0)
@@ -228,15 +274,32 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
             stacked, opt_state, xnb, xcb, yb, twb)
 
     @partial(obs.costed_jit, "wdl.epoch_steps", static_argnames=("blen",))
-    def epoch_steps(stacked, opt_state, starts, blen: int):
+    def epoch_steps(stacked, opt_state, starts, blen: int, xnd, xcd, yd,
+                    twd):
         """One epoch's minibatch sweep as ONE executable (lax.scan over the
         permuted batch starts) — see nn_trainer.epoch_steps."""
         def body(carry, start):
             st, os_ = carry
-            st, os_, _ = step_batch(st, os_, start, blen)
+            st, os_, _ = step_batch(st, os_, start, blen, xnd, xcd, yd, twd)
             return (st, os_), None
         (st, os_), _ = jax.lax.scan(body, (stacked, opt_state), starts)
         return st, os_
+
+    if use_shard and bs and bs < n_padded:
+        # the sharded epoch scan indexes PRE-BATCHED [nb, bs, ...] planes:
+        # a dynamic row-slice of a data-sharded array is not device-local
+        # inside shard_map, while batch-major layout keeps every minibatch
+        # evenly split over the data axis
+        nb = n_padded // bs
+        xn3 = jax.device_put(xn.reshape(nb, bs, xn.shape[1]),
+                             NamedSharding(mesh, P(None, "data", None)))
+        xc3 = jax.device_put(xc.reshape(nb, bs, xc.shape[1]),
+                             NamedSharding(mesh, P(None, "data", None)))
+        y3 = jax.device_put(yv.reshape(nb, bs),
+                            NamedSharding(mesh, P(None, "data")))
+        tw3 = jax.device_put(train_w.reshape(bags, nb, bs),
+                             NamedSharding(mesh, P("ensemble", None,
+                                                   "data")))
 
     stops = [WindowEarlyStop(settings.early_stop_window) for _ in range(bags)]
     best_valid = np.full(bags, np.inf)
@@ -257,8 +320,14 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
             expect_precision=precision)
         if restored is not None:
             start_epoch, state = restored
-            stacked = jax.device_put(state[0], sh_ens)
-            opt_state = jax.device_put(state[1], sh_ens)
+            if use_shard:
+                # checkpoints persist the PADDED shard shapes; re-placing
+                # through the plane restores the row-sharded layout so
+                # resume is bit-exact against an uninterrupted run
+                stacked, opt_state = plane.put(state[0], state[1])
+            else:
+                stacked = jax.device_put(state[0], sh_ens)
+                opt_state = jax.device_put(state[1], sh_ens)
             _restore_tracking(state, best_valid, best_train, best_params,
                               stops)
             # replay the batch-order RNG stream up to the resume point so
@@ -281,16 +350,33 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
             # epoch (cheap host-side; no gather, no recompile)
             starts = order_rng.permutation(
                 np.arange(0, n_padded - bs + 1, bs).astype(np.int32))
-            stacked, opt_state = epoch_steps(stacked, opt_state,
-                                             jnp.asarray(starts), bs)
+            if use_shard:
+                stacked, opt_state = fns["epoch_steps"](
+                    stacked, opt_state, xn3, xc3, y3, tw3,
+                    jnp.asarray(starts // bs, jnp.int32))
+            else:
+                stacked, opt_state = epoch_steps(stacked, opt_state,
+                                                 jnp.asarray(starts), bs,
+                                                 xnd, xcd, yd, twd)
+        elif use_shard:
+            stacked, opt_state, _ = fns["step"](stacked, opt_state, xnd,
+                                                xcd, yd, twd)
         else:
             stacked, opt_state, _ = step(stacked, opt_state, xnd, xcd, yd,
                                          twd)
-        tr, va = eval_errors(stacked, twd, vwd)
+        if use_shard:
+            tr, va = fns["eval_errors"](stacked, twd, vwd, xnd, xcd, yd)
+        else:
+            tr, va = eval_errors(stacked, twd, vwd, xnd, xcd, yd)
         tr, va = np.asarray(jnp.stack([tr, va]))       # one fetch
         history.append((float(tr.mean()), float(va.mean())))
         epochs_run = epoch + 1
         if obs_on:
+            if use_shard:
+                wdl_shard.record_epoch_launches(
+                    plane, n_padded,
+                    (n_padded // bs) if bs and bs < n_padded else 1,
+                    precision)
             dt = time.perf_counter() - ep_t0
             obs.counter("train.epochs").inc()
             obs.histogram("train.epoch_s").observe(dt)
@@ -330,6 +416,10 @@ def train_wdl_ensemble(x_num, x_cat, y, w, spec: wdl_model.WDLModelSpec,
         if best_params[i] is None:
             best_params[i] = jax.tree_util.tree_map(lambda a: a[i], final)
             best_valid[i], best_train[i] = float(va[i]), float(tr[i])
+    if use_shard:
+        # tracking/checkpoints keep the PADDED shard shapes; the models
+        # that leave the trainer are always true-cardinality
+        best_params = [plane.unpad_params(m) for m in best_params]
     return WDLResult(params=best_params, train_errors=best_train,
                      valid_errors=best_valid, epochs_run=epochs_run,
                      history=history)
@@ -368,7 +458,8 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
                        settings: TrainSettings, bags: int, mask_fn,
                        num_feat_idx, cat_col_idx,
                        mesh=None, progress=None,
-                       elastic=None) -> WDLResult:
+                       elastic=None,
+                       shard: Optional[bool] = None) -> WDLResult:
     """Out-of-core WDL: full-batch gradient accumulation over zipped windows
     (one synchronized update per epoch — the reference's BSP iteration,
     ``WDLMaster`` aggregation), members vmapped on the ensemble axis,
@@ -381,6 +472,7 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
     and an already-closed epoch replays from the journal (rejoin
     catch-up) without streaming."""
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from . import wdl_shard
 
     if mesh is None:
         mesh = meshlib.device_mesh(n_ensemble=bags)
@@ -397,14 +489,29 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
     precision = resolve_precision(settings.precision)
     if precision != "f32":
         init_list = [cast_tree(p, jnp.bfloat16) for p in init_list]
-    stacked = jax.device_put(_stack(init_list), sh_ens)
+    use_shard = wdl_shard.shard_enabled(spec, mesh, bags, precision,
+                                        override=shard)
+    plane = None
+    if use_shard:
+        plane = wdl_shard.WDLShardPlane(mesh, spec, bags)
+        init_list = [plane.pad_params(m) for m in init_list]
+    stacked = _stack(init_list)
     if precision == "mixed":
-        opt_state = jax.device_put(
-            _stack([mixed_init(opt, p) for p in init_list]), sh_ens)
+        opt_state = _stack([mixed_init(opt, p) for p in init_list])
     else:
-        opt_state = jax.device_put(
-            _stack([opt.init(p) for p in init_list]), sh_ens)
+        opt_state = _stack([opt.init(p) for p in init_list])
+    if use_shard:
+        stacked, opt_state = plane.put(stacked, opt_state)
+        extra = spec.extra or {}
+        wdl_shard.record_shard_gauges(
+            plane, precision, int(extra.get("hash_buckets", 0) or 0),
+            len(extra.get("hashed_cols") or []))
+    else:
+        stacked = jax.device_put(stacked, sh_ens)
+        opt_state = jax.device_put(opt_state, sh_ens)
     l2 = settings.l2
+    sfns = wdl_shard.build_streamed_fns(plane, stacked, opt_state, opt,
+                                        precision, l2) if use_shard else None
 
     def _loss_sum(params, xnb, xcb, yb, mw):
         p = wdl_model.forward(params, spec, xnb, xcb)
@@ -455,7 +562,8 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
         jax.tree_util.tree_map(
             lambda a: jnp.zeros(a.shape,
                                 jnp.float32 if precision == "mixed"
-                                else a.dtype), stacked), sh_ens)
+                                else a.dtype), stacked),
+        plane.param_shardings() if use_shard else sh_ens)
     if elastic is not None:
         from ..parallel.elastic import grad_codec
         _ravel_grads, _unravel_grads = grad_codec(zero_grads)
@@ -467,7 +575,8 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
             x[:, num_feat_idx] if num_feat_idx
             else np.zeros((len(x), 0), np.float32), sh_row)
         xcb = jax.device_put(
-            bins[:, cat_col_idx] if cat_col_idx
+            wdl_model.apply_hash_host(spec, bins[:, cat_col_idx])
+            if cat_col_idx
             else np.zeros((len(x), 0), np.int32), sh_row)
         yb = jax.device_put(win.arrays["y"].astype(np.float32), sh_y)
         tm, vm = mask_fn(win.index, win.arrays["y"])
@@ -521,8 +630,11 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
             expect_precision=precision)
         if restored is not None:
             start_epoch, state = restored
-            stacked = jax.device_put(state[0], sh_ens)
-            opt_state = jax.device_put(state[1], sh_ens)
+            if use_shard:
+                stacked, opt_state = plane.put(state[0], state[1])
+            else:
+                stacked = jax.device_put(state[0], sh_ens)
+                opt_state = jax.device_put(state[1], sh_ens)
             _restore_tracking(state, best_valid, best_train, best_params,
                               stops)
             epochs_target = _resume_epoch_target(settings, start_epoch,
@@ -550,7 +662,8 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
             n_win = 0
             for win in planes.windows():
                 xnb, xcb, yb, tw, vw = put_window(win)
-                grad_acc, stats_acc = grad_eval_window(
+                grad_acc, stats_acc = (sfns["grad_eval_window"]
+                                       if use_shard else grad_eval_window)(
                     stacked, grad_acc, stats_acc, xnb, xcb, yb, tw, vw)
                 n_win += 1
             if n_win == 0:
@@ -566,10 +679,15 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
         # stats were measured on params_entering: they close the ledger of
         # the params BEFORE this epoch's update
         stopped = bookkeep(epoch, stats, params_entering)
-        stacked, opt_state = apply_update(
-            stacked, opt_state,
-            grad_acc if grad_flat is None else _unravel_grads(grad_flat),
-            jnp.asarray(stats[:, 1]))
+        grads_in = grad_acc if grad_flat is None \
+            else _unravel_grads(grad_flat)
+        if use_shard and grad_flat is not None:
+            # the elastic codec round-trips grads through a flat host
+            # vector — restore the row-shard layout before the update
+            grads_in = jax.device_put(grads_in, plane.param_shardings())
+        stacked, opt_state = (sfns["apply_update"]
+                              if use_shard else apply_update)(
+            stacked, opt_state, grads_in, jnp.asarray(stats[:, 1]))
         epochs_run = epoch + 1
         if settings.checkpoint_dir and settings.checkpoint_every and \
                 ((epoch + 1) % settings.checkpoint_every == 0 or stopped):
@@ -595,8 +713,9 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
             stats_acc = jnp.zeros((bags, 4))
             for win in planes.windows():
                 xnb, xcb, yb, tw, vw = put_window(win)
-                stats_acc = eval_window(stacked, stats_acc, xnb, xcb, yb,
-                                        tw, vw)
+                stats_acc = (sfns["eval_window"]
+                             if use_shard else eval_window)(
+                    stacked, stats_acc, xnb, xcb, yb, tw, vw)
             if elastic is not None:
                 final_close = elastic.step(
                     epochs_run, {"stats": np.asarray(stats_acc)})
@@ -608,6 +727,8 @@ def train_wdl_streamed(planes: ZippedPlanes, spec: wdl_model.WDLModelSpec,
     for i in range(bags):
         if best_params[i] is None:
             best_params[i] = jax.tree_util.tree_map(lambda a: a[i], final)
+    if use_shard:
+        best_params = [plane.unpad_params(m) for m in best_params]
     return WDLResult(params=best_params, train_errors=best_train,
                      valid_errors=best_valid, epochs_run=epochs_run,
                      history=history)
@@ -812,7 +933,26 @@ def _run_wdl_grid(proc, trials) -> int:
 
 def _make_spec(numeric_dim: int, by_num, cat_nums, num_nums,
                num_feat_idx, cat_col_idx, p: Dict[str, Any]):
+    from ..config import environment
+    from ..ops.hashing import column_hash_key
     cards = [by_num[cn].num_bins() + 1 for cn in cat_nums]
+    extra: Dict[str, Any] = {"num_feat_idx": num_feat_idx,
+                             "cat_col_idx": cat_col_idx}
+    # hashed-ID path (shifu.wdl.hashBuckets / params.HashBuckets): any
+    # categorical column WIDER than the bucket space maps its raw ids
+    # through splitmix64 into [0, buckets) and its table shrinks to the
+    # bucket count; narrower columns keep exact ids.  The plan lives in
+    # spec.extra so train, checkpoint, and serve all hash identically.
+    buckets = int(p.get("HashBuckets", 0) or 0) or \
+        environment.get_int("shifu.wdl.hashBuckets", 0)
+    if buckets > 0:
+        hashed = [i for i, c in enumerate(cards) if c > buckets]
+        if hashed:
+            extra.update(
+                hash_buckets=int(buckets), hashed_cols=hashed,
+                hash_keys=[column_hash_key(cat_nums[i]) for i in hashed])
+            cards = [buckets if i in hashed else c
+                     for i, c in enumerate(cards)]
     return wdl_model.WDLModelSpec(
         numeric_dim=numeric_dim, cat_cardinalities=cards,
         embed_dim=int(p.get("EmbedColumnNum", p.get("EmbedDim", 8))),
@@ -822,4 +962,4 @@ def _make_spec(numeric_dim: int, by_num, cat_nums, num_nums,
         wide_enable=bool(p.get("WideEnable", True)),
         deep_enable=bool(p.get("DeepEnable", True)),
         column_nums=num_nums, cat_column_nums=cat_nums,
-        extra={"num_feat_idx": num_feat_idx, "cat_col_idx": cat_col_idx})
+        extra=extra)

@@ -1,0 +1,437 @@
+"""Sharded WDL categorical plane (``train/wdl_shard``).
+
+What the suite pins down:
+
+- **Parity**: the sharded trainer (row-sharded tables + moments, sparse
+  per-minibatch row gather, psum-scatter update) produces BIT-identical
+  params to the replicated trainer on a 1-device mesh, both full-batch
+  and minibatched, in-RAM and streamed; streamed parity stays bitwise at
+  2/4 devices (full-batch accumulation has one reduction order), and the
+  in-RAM path stays within last-ulp accumulation noise there (data-axis
+  psum reassociates the row reduction — the replicated GSPMD program's
+  own numerics change identically with device count).
+- **Hashed-ID path**: host and device hashing agree bitwise; the plan in
+  ``spec.extra`` survives save/load; training consumes bucket ids.
+- **Checkpoint resume**: interrupted sharded training resumes bit-exact.
+- **Serving**: the sharded serve copy scores bit-identically to the
+  classic replicated forward through the AOT bucket scorer with ZERO
+  recompiles (the padded-bucket contract).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from shifu_tpu.config import environment
+from shifu_tpu.models import wdl as wdl_model
+from shifu_tpu.train import wdl_shard
+from shifu_tpu.train.nn_trainer import TrainSettings
+from shifu_tpu.train.wdl_trainer import (_make_spec, train_wdl_ensemble,
+                                         train_wdl_streamed)
+
+pytestmark = pytest.mark.wdl_shard
+
+N = 64
+CARDS = [10, 7]          # non-divisible by 2 and 4: padding always active
+
+
+@pytest.fixture(autouse=True)
+def _knob_hygiene(monkeypatch):
+    # the replicated reference must take the GATHER lowering (the one-hot
+    # einsum branch is a different dense program — parity there is only
+    # approximate by design)
+    monkeypatch.setattr(wdl_model, "_ONEHOT_MAX_ELEMS", 0)
+    yield
+    for k in ("shifu.wdl.shardTables", "shifu.wdl.shardMinBytes",
+              "shifu.wdl.hashBuckets", "shifu.wdl.serveCopy",
+              "shifu.wdl.serveHotRows"):
+        environment.set_property(k, "")     # "" = unset, default returns
+
+
+def _mesh(d):
+    devs = np.asarray(jax.devices()[:d]).reshape(1, d)
+    return Mesh(devs, ("ensemble", "data"))
+
+
+def _spec(extra=None):
+    return wdl_model.WDLModelSpec(
+        numeric_dim=3, cat_cardinalities=list(CARDS), embed_dim=4,
+        hidden_nodes=[8], activations=["relu"], extra=extra or {})
+
+
+def _data(seed=0, n=N):
+    rng = np.random.default_rng(seed)
+    xn = rng.normal(size=(n, 3)).astype(np.float32)
+    xc = np.stack([rng.integers(0, CARDS[0], n),
+                   rng.integers(0, CARDS[1], n)], axis=1).astype(np.int32)
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    return xn, xc, y, np.ones(n, np.float32)
+
+
+def _settings(**kw):
+    base = dict(optimizer="ADAM", learning_rate=0.05, l2=1e-4, epochs=3,
+                batch_size=0, early_stop_window=0, seed=7)
+    base.update(kw)
+    return TrainSettings(**base)
+
+
+def _leaves(tree):
+    return jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, tree))
+
+
+def _assert_bitwise(a, b):
+    for x, z in zip(_leaves(a), _leaves(b)):
+        assert x.dtype == z.dtype and x.shape == z.shape
+        assert x.tobytes() == z.tobytes()
+
+
+def _assert_close(a, b, atol):
+    for x, z in zip(_leaves(a), _leaves(b)):
+        np.testing.assert_allclose(x, z, rtol=0, atol=atol)
+
+
+# ------------------------------------------------------- in-RAM parity
+@pytest.mark.parametrize("bs", [0, 16])
+def test_inram_sharded_matches_replicated_bitwise_1dev(bs):
+    xn, xc, y, w = _data()
+    spec = _spec()
+    rep = train_wdl_ensemble(xn, xc, y, w, spec, _settings(batch_size=bs),
+                             bags=2, mesh=_mesh(1), shard=False)
+    sh = train_wdl_ensemble(xn, xc, y, w, spec, _settings(batch_size=bs),
+                            bags=2, mesh=_mesh(1), shard=True)
+    _assert_bitwise(rep.params, sh.params)
+    assert np.array_equal(rep.valid_errors, sh.valid_errors)
+    assert np.array_equal(rep.train_errors, sh.train_errors)
+
+
+@pytest.mark.parametrize("d", [2, 4])
+@pytest.mark.parametrize("bs", [0, 16])
+def test_inram_sharded_multi_device_last_ulp(d, bs):
+    """At D>1 the data-axis psum reassociates the row reduction (the
+    replicated GSPMD all-reduce does the same), so parity is pinned to
+    last-ulp accumulation noise rather than bytes."""
+    xn, xc, y, w = _data()
+    spec = _spec()
+    rep = train_wdl_ensemble(xn, xc, y, w, spec, _settings(batch_size=bs),
+                             bags=2, mesh=_mesh(1), shard=False)
+    sh = train_wdl_ensemble(xn, xc, y, w, spec, _settings(batch_size=bs),
+                            bags=2, mesh=_mesh(d), shard=True)
+    _assert_close(rep.params, sh.params, atol=1e-5)
+
+
+def test_sharded_tables_are_actually_sharded():
+    """No device may hold a full table row-range: each table leaf's
+    per-device shard is 1/D of its padded rows."""
+    spec = _spec()
+    mesh = _mesh(4)
+    plane = wdl_shard.WDLShardPlane(mesh, spec, 2)
+    member = plane.pad_params(
+        wdl_model.init_params(jax.random.PRNGKey(0), spec))
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[member, member])
+    from shifu_tpu.train.optimizers import make_optimizer
+    opt = make_optimizer("ADAM", 0.05)
+    ostate = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[opt.init(member)] * 2)
+    stacked, ostate = plane.put(stacked, ostate)
+    for i, t in enumerate(stacked["embed"]):
+        vp = plane.vs[i] * plane.d
+        assert t.shape[1] == vp
+        for sh_piece in t.addressable_shards:
+            assert sh_piece.data.shape[1] == plane.vs[i]
+    # moments follow the same layout: any optimizer leaf living under an
+    # "embed"/"wide_cat" path is row-sharded like its parameter
+    flat, _ = jax.tree_util.tree_flatten_with_path(ostate)
+    checked = 0
+    for path, leaf in flat:
+        keys = [getattr(k, "key", getattr(k, "name", None)) for k in path]
+        if "embed" not in keys and "wide_cat" not in keys:
+            continue
+        if leaf.ndim < 2:
+            continue
+        idx = next(getattr(k, "idx", None) for k in path
+                   if hasattr(k, "idx"))
+        for sh_piece in leaf.addressable_shards:
+            assert sh_piece.data.shape[1] == plane.vs[idx]
+        checked += 1
+    assert checked >= 2 * len(CARDS)   # m and v per table at least
+
+
+def test_pad_unpad_roundtrip_non_divisible():
+    spec = _spec()
+    plane = wdl_shard.WDLShardPlane(_mesh(4), spec, 1)
+    assert plane.vs == [-(-c // 4) for c in CARDS]
+    member = wdl_model.init_params(jax.random.PRNGKey(1), spec)
+    padded = plane.pad_params(member)
+    for i, c in enumerate(CARDS):
+        assert padded["embed"][i].shape[0] == plane.vs[i] * 4
+        assert np.all(np.asarray(padded["embed"][i][c:]) == 0)
+    _assert_bitwise(plane.unpad_params(padded), member)
+
+
+# ------------------------------------------------------ streamed parity
+class _Win:
+    def __init__(self, i, s, arrays, rows):
+        self.index, self.start = i, s
+        self.rows = self.n_valid = rows
+        self.arrays = arrays
+
+
+class _FakePlanes:
+    def __init__(self, x, bins, y, w, wrows):
+        self.x, self.bins, self.y, self.w = x, bins, y, w
+        self.window_rows = wrows
+        self.num_rows = len(y)
+
+    def windows(self):
+        wr = self.window_rows
+        for i, s in enumerate(range(0, self.num_rows, wr)):
+            yield _Win(i, s, {"x": self.x[s:s + wr], "y": self.y[s:s + wr],
+                              "w": self.w[s:s + wr],
+                              "bins": self.bins[s:s + wr]}, wr)
+
+
+def _streamed(d, shard, spec=None, seed=0):
+    xn, xc, y, w = _data(seed)
+    spec = spec or _spec(extra={"num_feat_idx": [0, 1, 2],
+                                "cat_col_idx": [0, 1]})
+    planes = _FakePlanes(xn, xc, y, w, 16)
+    masks = (np.random.default_rng(5).random((2, N)) > 0.3) \
+        .astype(np.float32)
+
+    def mask_fn(idx, yw):
+        tm = masks[:, idx * 16:(idx + 1) * 16]
+        return tm, 1.0 - tm
+
+    return train_wdl_streamed(planes, spec, _settings(), 2, mask_fn,
+                              [0, 1, 2], [0, 1], mesh=_mesh(d),
+                              shard=shard)
+
+
+def test_streamed_sharded_bitwise_1dev():
+    rep = _streamed(1, False)
+    sh = _streamed(1, True)
+    _assert_bitwise(rep.params, sh.params)
+    # the error scalars come from a different float64 summation tree
+    # (per-shard partial sums + psum) — pinned to last-ulp, params above
+    # carry the bitwise claim
+    np.testing.assert_allclose(rep.valid_errors, sh.valid_errors,
+                               rtol=0, atol=1e-12)
+
+
+@pytest.mark.parametrize("d", [2, 4])
+def test_streamed_sharded_multi_device_last_ulp(d):
+    """Multi-device psum reassociates the window reduction, so D>1 is
+    pinned to last-ulp accumulation noise (same physics as in-RAM)."""
+    rep = _streamed(1, False)
+    sh = _streamed(d, True)
+    _assert_close(rep.params, sh.params, atol=1e-5)
+
+
+# ------------------------------------------------------- hashed-ID path
+def test_hash_host_device_bitwise():
+    from shifu_tpu.ops.hashing import (column_hash_key, hash_bucket_device,
+                                       hash_bucket_host)
+    ids = np.concatenate([
+        np.arange(0, 64, dtype=np.int32),
+        np.asarray([2 ** 31 - 1, 12345678, 999983], np.int32)])
+    for col in (0, 3, 17):
+        key = column_hash_key(col)
+        host = hash_bucket_host(ids, key, 1 << 20)
+        dev = np.asarray(jax.jit(
+            lambda a: hash_bucket_device(a, key, 1 << 20))(
+            jnp.asarray(ids)))
+        assert np.array_equal(host, dev)
+        assert host.min() >= 0 and host.max() < (1 << 20)
+
+
+class _FakeCC:
+    def __init__(self, nbins):
+        self._n = nbins
+
+    def num_bins(self):
+        return self._n
+
+
+def test_make_spec_hash_plan():
+    by_num = {11: _FakeCC(999), 22: _FakeCC(4)}
+    spec = _make_spec(2, by_num, [11, 22], [], [0, 1], [2, 3],
+                      {"HashBuckets": 16})
+    # 999+1 > 16 buckets -> hashed; 4+1 stays exact
+    assert spec.cat_cardinalities == [16, 5]
+    assert spec.extra["hash_buckets"] == 16
+    assert spec.extra["hashed_cols"] == [0]
+    from shifu_tpu.ops.hashing import column_hash_key
+    assert spec.extra["hash_keys"] == [column_hash_key(11)]
+    # knob form drives the same plan
+    environment.set_property("shifu.wdl.hashBuckets", "16")
+    spec2 = _make_spec(2, by_num, [11, 22], [], [0, 1], [2, 3], {})
+    assert spec2.cat_cardinalities == [16, 5]
+    assert spec2.extra["hashed_cols"] == [0]
+    # no plan at all without the knob
+    environment.set_property("shifu.wdl.hashBuckets", "")
+    spec3 = _make_spec(2, by_num, [11, 22], [], [0, 1], [2, 3], {})
+    assert "hash_buckets" not in spec3.extra
+    assert spec3.cat_cardinalities == [1000, 5]
+
+
+def test_hashed_training_scores_consistently(tmp_path):
+    """Train on hashed ids, save, reload: the standalone scorer hashing
+    raw ids host-side matches forward() on pre-hashed ids bitwise, and
+    the plan survives the model file."""
+    from shifu_tpu.ops.hashing import column_hash_key
+    buckets = 6
+    spec = _spec(extra={"num_feat_idx": [0, 1, 2], "cat_col_idx": [0, 1],
+                        "hash_buckets": buckets, "hashed_cols": [0],
+                        "hash_keys": [column_hash_key(0)]})
+    spec = wdl_model.WDLModelSpec(
+        numeric_dim=3, cat_cardinalities=[buckets, CARDS[1]], embed_dim=4,
+        hidden_nodes=[8], activations=["relu"], extra=spec.extra)
+    xn, xc, y, w = _data()        # raw ids in [0, 10) for the hashed col
+    res = train_wdl_ensemble(xn, xc, y, w, spec, _settings(epochs=2),
+                             bags=1, mesh=_mesh(2), shard=True)
+    path = str(tmp_path / "model0.wdl")
+    wdl_model.save_model(path, spec, res.params[0])
+    m = wdl_model.IndependentWDLModel.load(path)
+    assert wdl_model.hash_plan(m.spec) is not None
+    got = m.compute(xn, xc)
+    hashed = wdl_model.apply_hash_host(m.spec, xc)
+    assert hashed[:, 0].max() < buckets
+    # params must be a jit ARGUMENT (closed-over arrays become XLA
+    # constants and const-fold into a slightly different program)
+    want = np.asarray(jax.jit(lambda p, a, b: wdl_model.forward(
+        p, m.spec, a, b))(m.params, jnp.asarray(xn), jnp.asarray(hashed)))
+    assert got.tobytes() == want.tobytes()
+
+
+# ------------------------------------------------- checkpoint / resume
+def test_sharded_checkpoint_resume_bit_exact(tmp_path):
+    xn, xc, y, w = _data()
+    spec = _spec()
+
+    def run(ckdir, epochs, resume):
+        s = _settings(epochs=epochs, batch_size=16)
+        s.checkpoint_dir = ckdir
+        s.checkpoint_every = 2
+        s.resume = resume
+        return train_wdl_ensemble(xn, xc, y, w, spec, s, bags=2,
+                                  mesh=_mesh(4), shard=True)
+
+    full = run(None, 4, False)
+    ckdir = str(tmp_path / "ck")
+    run(ckdir, 2, False)                      # interrupted at epoch 2
+    resumed = run(ckdir, 4, True)             # restores + 2 more epochs
+    _assert_bitwise(full.params, resumed.params)
+    assert np.array_equal(full.valid_errors, resumed.valid_errors)
+
+
+# --------------------------------------------------------------- serve
+def test_serve_sharded_bit_identical_zero_recompiles():
+    """Same scorer machinery, classic full copy vs sharded serve copy:
+    every score byte matches, and the padded-bucket contract holds —
+    zero recompiles after warm()."""
+    from shifu_tpu.serve.scorer import AOTScorer, serve_recompile_count
+    spec = _spec(extra={"num_feat_idx": [0, 2, 4], "cat_col_idx": [1, 3]})
+    m = wdl_model.IndependentWDLModel(
+        spec, wdl_model.init_params(jax.random.PRNGKey(3), spec))
+
+    def build(copy_mode, name):
+        environment.set_property("shifu.wdl.serveCopy", copy_mode)
+        s = AOTScorer([m], buckets=(1, 4, 16), name=name)
+        s.warm(launch=True)
+        return s
+
+    classic = build("full", "serve.score.wdlclassic")
+    sharded = build("sharded", "serve.score.wdlsharded")
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(16, classic.n_features)).astype(np.float32)
+    bins = rng.integers(0, 7, size=(16, classic.n_bins_cols)) \
+        .astype(np.int32)
+    for n in (1, 3, 4, 11, 16):
+        got = sharded.score_batch(x[:n], bins[:n])
+        want = classic.score_batch(x[:n], bins[:n])
+        assert got.tobytes() == want.tobytes()
+    assert serve_recompile_count("serve.score.wdlsharded") == 0
+
+
+def test_serve_copy_mode_resolution():
+    spec = _spec()
+    params = wdl_model.init_params(jax.random.PRNGKey(0), spec)
+    host = jax.tree_util.tree_map(np.asarray, params)
+    # tiny tables, auto -> full (classic forward, fn is None)
+    mode, fwd = wdl_shard.build_serve_forward(spec, host)
+    assert mode == "full" and fwd is None
+    # forced sharded
+    environment.set_property("shifu.wdl.serveCopy", "sharded")
+    mode, fwd = wdl_shard.build_serve_forward(spec, host)
+    assert mode == "sharded" and fwd is not None
+    xn, xc, _, _ = _data()
+    got = np.asarray(jax.jit(fwd)(jnp.asarray(xn), jnp.asarray(xc)))
+    want = np.asarray(jax.jit(lambda a, b: wdl_model.forward(
+        params, spec, a, b))(jnp.asarray(xn), jnp.asarray(xc)))
+    assert got.tobytes() == want.tobytes()
+    # hot copy: head rows score exactly, shape contract holds
+    environment.set_property("shifu.wdl.serveCopy", "hot")
+    environment.set_property("shifu.wdl.serveHotRows", "4")
+    mode, fwd = wdl_shard.build_serve_forward(spec, host)
+    assert mode == "hot" and fwd is not None
+    hot = np.asarray(jax.jit(fwd)(jnp.asarray(xn), jnp.asarray(xc)))
+    assert hot.shape == want.shape
+    head = (xc < 4).all(axis=1)
+    assert head.any()
+    assert np.array_equal(hot[head], want[head])
+
+
+# ------------------------------------------------------ gating & costs
+def test_shard_gating():
+    spec = _spec()
+    mesh = _mesh(2)
+    # explicit override wins both ways
+    assert wdl_shard.shard_enabled(spec, mesh, 2, "f32", override=True)
+    assert not wdl_shard.shard_enabled(spec, mesh, 2, "f32",
+                                       override=False)
+    # knob off beats auto sizing
+    environment.set_property("shifu.wdl.shardTables", "off")
+    assert not wdl_shard.shard_enabled(spec, mesh, 2, "f32")
+    environment.set_property("shifu.wdl.shardTables", "on")
+    assert wdl_shard.shard_enabled(spec, mesh, 2, "f32")
+    # auto: tiny tables stay replicated; a zero threshold shards them
+    environment.set_property("shifu.wdl.shardTables", "auto")
+    assert not wdl_shard.shard_enabled(spec, mesh, 2, "f32")
+    environment.set_property("shifu.wdl.shardMinBytes", "0")
+    assert wdl_shard.shard_enabled(spec, mesh, 2, "f32")
+    # single-device data axis never shards
+    assert not wdl_shard.shard_enabled(spec, _mesh(1), 2, "f32")
+
+
+def test_cost_models_registered():
+    from shifu_tpu.obs.costs import cost_models
+    models = cost_models()
+    for name in ("wdl.sparse_gather", "wdl.shard_update"):
+        assert name in models
+    got = models["wdl.sparse_gather"](rows=128, cols=2, embed=4,
+                                      members=2, devices=4, bytes_per=4)
+    assert got["flops"] > 0 and got["bytes_accessed"] > 0
+    got = models["wdl.shard_update"](table_elems=1000, members=2,
+                                     steps=3, bytes_per=4)
+    assert got["flops"] > 0 and got["bytes_accessed"] > 0
+
+
+def test_fan_in_scaled_embedding_init():
+    """Embedding init scales by embed_dim**-0.5 (fan-in), wide tables
+    seed identically (zeros) on every path — replicated, padded-sharded,
+    and hashed specs all start from the same math."""
+    spec = _spec()
+    params = wdl_model.init_params(jax.random.PRNGKey(0), spec)
+    emb = np.concatenate([np.asarray(t).ravel() for t in params["embed"]])
+    assert abs(emb.std() - spec.embed_dim ** -0.5) < 0.2 * emb.std()
+    for t in params["wide_cat"]:
+        assert np.all(np.asarray(t) == 0)
+    plane = wdl_shard.WDLShardPlane(_mesh(4), spec, 1)
+    _assert_bitwise(plane.unpad_params(plane.pad_params(params)), params)
