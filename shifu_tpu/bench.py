@@ -75,7 +75,16 @@ from . import ioutil, obs
 # ratio guarded >= 0.95 and tracked via the new *_qps_frac throughput
 # suffix, plus quality_label_flip_detect_s, tracked LOWER-is-better via
 # the new *_detect_s suffix).
-BENCH_TELEMETRY_SCHEMA = 11
+#
+# v12: raw-record serving + fleet — serve_raw_qps_frac (fused-transform
+# saturation vs the pre-binned path on the same warmed bucket, guarded
+# >= 0.8), and --plane fleet: subprocess replica fleets behind
+# serve.router.ServeRouter (serve_fleet_{1,2,4}r_qps aggregate QPS,
+# serve_fleet_scaling_frac tracked via the new *_scaling_frac
+# throughput suffix, and the replica-SIGKILL drill whose p99 rides the
+# lower-is-better latency class while every accepted request completes
+# by requeue).
+BENCH_TELEMETRY_SCHEMA = 12
 
 # measured on this rig (tools/measure_baseline.py); provenance in
 # BASELINE.md — every headline divides by a MEASURED reference-class
@@ -1455,6 +1464,13 @@ def bench_serve(n_features: int = 32, n_models: int = 5,
         raise
     except Exception as e:                      # pragma: no cover
         rep["serve_quantized_error"] = str(e)[:200]
+    # fused raw-record rows: the in-graph transform's overhead acceptance
+    try:
+        rep.update(bench_serve_raw())
+    except AssertionError:
+        raise
+    except Exception as e:                      # pragma: no cover
+        rep["serve_raw_error"] = str(e)[:200]
     # plane guards — fail loudly, like the tail bench's schedule guards
     if recompiles > 0:
         raise AssertionError(
@@ -1480,6 +1496,274 @@ def bench_serve(n_features: int = 32, n_models: int = 5,
             f"tracing fell to {traced_qps:.0f} — below "
             f"{TRACE_OVERHEAD_FLOOR_FRAC}x the {floor:.0f} floor; "
             "head sampling is no longer bounding tracing overhead")
+    return rep
+
+
+# fused raw-record acceptance: the raw path runs the WHOLE norm
+# transform in-graph ahead of the ensemble inside one executable, and
+# must hold this fraction of the pre-binned saturation rate — the
+# transform must stay a fused prelude, not a second model
+SERVE_RAW_FLOOR_FRAC = 0.8
+
+
+def _raw_bench_configs(n_features: int):
+    """Synthetic ZSCALE ColumnConfigs for the raw/fleet serving rows."""
+    from shifu_tpu.config import ColumnConfig
+    ccs = []
+    for j in range(n_features):
+        cc = ColumnConfig(columnNum=j, columnName=f"f{j}",
+                          finalSelect=True)
+        cc.columnBinning.binBoundary = [float("-inf"), -0.5, 0.0, 0.5]
+        cc.columnBinning.binCountNeg = [10, 10, 10, 10]
+        cc.columnBinning.binCountPos = [2, 4, 6, 8]
+        cc.columnBinning.binPosRate = [1 / 6., 2 / 7., 3 / 8., 4 / 9.]
+        cc.columnBinning.binCountWoe = [0.1, -0.1, 0.2, -0.2, 0.0]
+        cc.columnStats.mean = 0.0
+        cc.columnStats.stdDev = 1.0
+        ccs.append(cc)
+    return ccs
+
+
+def bench_serve_raw(n_features: int = 32, n_models: int = 5,
+                    hidden: tuple = (128, 64), batch: int = 512,
+                    duration_s: float = 0.5) -> Dict[str, Any]:
+    """Fused raw-record rows (merged into the serve plane): device
+    throughput of ``score_batch_raw`` — searchsorted binning + table
+    gathers + z-score clip fused AHEAD of the ensemble in the same
+    executable — vs the pre-binned ``score_batch`` on the same warmed
+    bucket.  ``serve_raw_qps_frac`` (tracked via the ``*_qps_frac``
+    throughput suffix) must hold SERVE_RAW_FLOOR_FRAC."""
+    import os
+
+    import jax
+
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.models.nn import (IndependentNNModel, NNModelSpec,
+                                     init_params)
+    from shifu_tpu.serve.scorer import AOTScorer
+    from shifu_tpu.serve.transform import FusedTransform
+
+    tf = FusedTransform(ModelConfig(), _raw_bench_configs(n_features))
+    spec = NNModelSpec(input_dim=n_features, hidden_nodes=list(hidden),
+                       activations=["relu"] * len(hidden), output_dim=1)
+    models = [IndependentNNModel(spec,
+                                 init_params(jax.random.PRNGKey(i), spec))
+              for i in range(n_models)]
+    scorer = AOTScorer(models, buckets=(batch,), transform=tf,
+                       name="bench.serve.raw")
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(batch, n_features)).astype(np.float32)
+    c = tf.n_columns
+    packed = np.zeros((batch, tf.wire_width), tf.wire_dtype)
+    packed[:, :c] = x
+    packed[:, c:2 * c] = 1.0
+
+    def rate(fn, arg):
+        fn(arg)                             # compile + warm off the clock
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < duration_s:
+            fn(arg)
+            n += batch
+        return n / (time.perf_counter() - t0)
+
+    pre = rate(scorer.score_batch, x)
+    raw = rate(scorer.score_batch_raw, packed)
+    frac = raw / max(pre, 1e-9)
+    rep = {
+        "serve_raw_qps": round(raw, 1),
+        "serve_prebinned_qps": round(pre, 1),
+        "serve_raw_qps_frac": round(frac, 4),
+    }
+    floor = float(os.environ.get("SHIFU_BENCH_SERVE_RAW_FLOOR",
+                                 SERVE_RAW_FLOOR_FRAC))
+    if frac < floor:
+        raise AssertionError(
+            f"fused raw-record scoring holds only {frac:.2f}x the "
+            f"pre-binned rate (floor {floor}, "
+            "SHIFU_BENCH_SERVE_RAW_FLOOR) — the in-graph transform "
+            "prelude is taxing the scorer it was fused into")
+    return rep
+
+
+# the fleet's closed-loop clients are deadline-bound ON PURPOSE: each
+# client thread keeps exactly one request in flight, so most of every
+# request is maxDelayMs deadline wait and aggregate QPS measures how
+# many replicas the router keeps concurrently busy — near-linear
+# replica scaling is observable without N cores
+FLEET_DEADLINE_MS = 40.0
+FLEET_SCALING_FLOOR = 0.8
+
+
+def _fleet_modelset(n_features: int, n_models: int, hidden: tuple) -> str:
+    """Scratch model-set dir (config snapshot + models) fleet workers
+    load — the raw path end to end, subprocess boundary included."""
+    import os
+    import tempfile
+
+    import jax
+
+    from shifu_tpu.config import save_column_configs
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.models.nn import NNModelSpec, init_params, save_model
+
+    d = tempfile.mkdtemp(prefix="shifu-bench-fleet-")
+    ModelConfig().save(os.path.join(d, "ModelConfig.json"))
+    save_column_configs(_raw_bench_configs(n_features),
+                        os.path.join(d, "ColumnConfig.json"))
+    spec = NNModelSpec(input_dim=n_features, hidden_nodes=list(hidden),
+                       activations=["relu"] * len(hidden), output_dim=1)
+    os.makedirs(os.path.join(d, "models"))
+    for i in range(n_models):
+        save_model(os.path.join(d, "models", f"model{i}.nn"), spec,
+                   init_params(jax.random.PRNGKey(i), spec))
+    return d
+
+
+def _fleet_up(model_set_dir: str, n: int):
+    """n subprocess serve workers + a router balancing over them."""
+    import os
+
+    from shifu_tpu.serve.router import (ServeRouter, spawn_worker,
+                                        wait_for_announce)
+
+    fleet_dir = os.path.join(model_set_dir, "serving", "fleet")
+    os.makedirs(fleet_dir, exist_ok=True)
+    router = ServeRouter(poll_ms=250.0, stale_s=10.0)
+    started = []
+    for i in range(n):
+        ann = os.path.join(fleet_dir, f"bench-{n}r-{i}.json")
+        if os.path.exists(ann):
+            os.unlink(ann)
+        started.append((f"r{i}", ann,
+                        spawn_worker(model_set_dir, f"r{i}", ann,
+                                     max_delay_ms=FLEET_DEADLINE_MS)))
+    for name, ann, p in started:
+        doc = wait_for_announce(ann, p, timeout=300.0)
+        router.add_backend(name, doc["port"], proc=p)
+    router.poll_once()
+    router.ensure_uniform()
+    return router, [p for _, _, p in started]
+
+
+def _fleet_closed_loop(router, record: dict, n_threads: int,
+                       duration_s: float, kill=None):
+    """Closed-loop clients through the router; returns
+    ``(qps, latencies, failures)``.  ``kill=(proc, at_frac)`` SIGKILLs
+    that worker mid-window — the replica-death drill: the router must
+    requeue, so ``failures`` staying empty IS the acceptance."""
+    import threading
+
+    lats: list = []
+    failures: list = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                router.score({"records": [record]}, timeout=30.0)
+            except RuntimeError as e:
+                with lock:
+                    failures.append(str(e))
+                continue
+            dt = time.perf_counter() - t0
+            with lock:
+                lats.append(dt)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    if kill is not None:
+        proc, at_frac = kill
+        time.sleep(duration_s * at_frac)
+        proc.kill()
+        time.sleep(duration_s * (1.0 - at_frac))
+    else:
+        time.sleep(duration_s)
+    wall = time.perf_counter() - t0
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    return len(lats) / wall, lats, failures
+
+
+def bench_fleet(n_features: int = 8, n_models: int = 3,
+                hidden: tuple = (16,), duration_s: float = 4.0
+                ) -> Dict[str, Any]:
+    """Serving-fleet plane (``bench.py --plane fleet``): subprocess
+    worker fleets of 1/2/4 replicas behind
+    :class:`~shifu_tpu.serve.router.ServeRouter`, each driven by one
+    closed-loop raw-record client per replica (deadline-bound — see
+    FLEET_DEADLINE_MS).  Reports aggregate QPS per fleet width, the
+    2-replica scaling acceptance ``serve_fleet_scaling_frac`` =
+    qps_2r / (2 x qps_1r) (tracked via the ``*_scaling_frac`` suffix;
+    floor FLEET_SCALING_FLOOR == the >=1.6x aggregate criterion), and
+    the replica-death drill on the widest fleet: one worker SIGKILLed
+    mid-window, EVERY accepted request completes by requeue and the
+    p99 under the kill rides the lower-is-better latency class."""
+    import os
+    import shutil
+
+    d = _fleet_modelset(n_features, n_models, hidden)
+    record = {f"f{j}": round(float(j) / n_features - 0.4, 3)
+              for j in range(n_features)}
+    rep: Dict[str, Any] = {}
+    qps: Dict[int, float] = {}
+    try:
+        for n in (1, 2, 4):
+            router, procs = _fleet_up(d, n)
+            try:
+                q, lats, failures = _fleet_closed_loop(
+                    router, record, n_threads=n, duration_s=duration_s)
+                if failures:
+                    raise AssertionError(
+                        f"{len(failures)} fleet request(s) failed with "
+                        f"every replica live: {failures[0]}")
+                qps[n] = q
+                rep[f"serve_fleet_{n}r_qps"] = round(q, 1)
+                rep[f"serve_fleet_{n}r_p99_ms"] = round(
+                    float(np.percentile(lats, 99)) * 1000.0, 3)
+                if n == 4:
+                    kq, klats, kfail = _fleet_closed_loop(
+                        router, record, n_threads=n,
+                        duration_s=duration_s, kill=(procs[0], 0.4))
+                    if kfail:
+                        raise AssertionError(
+                            f"{len(kfail)} request(s) lost across the "
+                            "replica SIGKILL — requeue-on-replica-death "
+                            f"failed: {kfail[0]}")
+                    survivors = router.poll_once()["up"]
+                    rep["serve_fleet_kill_qps"] = round(kq, 1)
+                    rep["serve_fleet_kill_p99_ms"] = round(
+                        float(np.percentile(klats, 99)) * 1000.0, 3)
+                    rep["serve_fleet_kill_survivors"] = int(survivors)
+                    if survivors >= n:
+                        raise AssertionError(
+                            "SIGKILLed replica still counted up — the "
+                            "router never noticed the death")
+            finally:
+                router.stop()
+        scaling = qps[2] / max(2.0 * qps[1], 1e-9)
+        rep["serve_fleet_scaling_frac"] = round(scaling, 4)
+        rep["serve_fleet_shape"] = (
+            f"{n_models} NN models {n_features}->{list(hidden)}->1, "
+            f"subprocess workers, deadline {FLEET_DEADLINE_MS:.0f} ms, "
+            f"1 closed-loop raw-record client/replica, "
+            f"{duration_s:.0f}s windows")
+        floor = float(os.environ.get("SHIFU_BENCH_FLEET_SCALING",
+                                     FLEET_SCALING_FLOOR))
+        if scaling < floor:
+            raise AssertionError(
+                f"2-replica fleet holds {qps[2]:.0f} QPS vs {qps[1]:.0f} "
+                f"single-replica — scaling {scaling:.2f} below {floor} "
+                "(SHIFU_BENCH_FLEET_SCALING; the >=1.6x aggregate-QPS "
+                "acceptance)")
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
     return rep
 
 
@@ -1982,6 +2266,7 @@ def is_tracked_throughput(name: str) -> bool:
     return ("throughput" in name or name.endswith("_per_sec")
             or name.endswith("_qps") or name.endswith("_qps_sustained")
             or name.endswith("_qps_frac")
+            or name.endswith("_scaling_frac")
             or name.endswith("_mfu") or name.endswith("_achieved_bw"))
 
 
@@ -2218,6 +2503,21 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
                                    "north-star workers (BASELINE.md)",
             "extra": rep,
         }
+    if plane == "fleet":
+        with obs.span("bench.fleet", kind="bench"):
+            rep = bench_fleet()
+        for k, v in rep.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                obs.gauge(f"bench.{k}").set(float(v))
+        return {
+            "metric": "serve_fleet_2r_qps",
+            "value": rep["serve_fleet_2r_qps"],
+            "unit": "requests/sec",
+            "plane": "fleet",
+            "telemetry_schema_version": BENCH_TELEMETRY_SCHEMA,
+            "shape": rep["serve_fleet_shape"],
+            "extra": rep,
+        }
     if plane == "multihost":
         with obs.span("bench.multihost", kind="bench"):
             rep = bench_multihost()
@@ -2266,8 +2566,8 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
     if plane not in (None, "all"):
         raise ValueError(
             f"unknown bench plane {plane!r} "
-            "(tail|rf-repeat|e2e|resume|varsel|serve|multihost|refresh|"
-            "quality|all)")
+            "(tail|rf-repeat|e2e|resume|varsel|serve|fleet|multihost|"
+            "refresh|quality|all)")
     nn_cost: Dict[str, Any] = {}
     nn_rows_per_sec = bench_nn(collect=nn_cost)
     obs.gauge("bench.nn_train_throughput").set(nn_rows_per_sec)

@@ -184,6 +184,20 @@ def build_parser() -> argparse.ArgumentParser:
                     nargs="?", const=8, default=0, metavar="N",
                     help="score N synthetic rows in-process and exit "
                     "(no port; CI smoke)")
+    sp.add_argument("--replicas", dest="serve_replicas", type=int,
+                    default=1, metavar="N",
+                    help="fleet mode: spawn N serve workers behind a "
+                    "health-/SLO-aware routing front on --port — "
+                    "POST /swap coordinates a fleet-wide hot-swap with "
+                    "no mixed-model window (knobs: "
+                    "-Dshifu.serve.fleetPollMs health-poll cadence, "
+                    "-Dshifu.serve.fleetStaleS stale-replica cutoff, "
+                    "-Dshifu.serve.canaryFrac canary commit slice)")
+    # internal fleet-worker flags (run_fleet passes them when spawning)
+    sp.add_argument("--replica", dest="serve_replica", default=None,
+                    help=argparse.SUPPRESS)
+    sp.add_argument("--announce", dest="serve_announce", default=None,
+                    help=argparse.SUPPRESS)
 
     sp = sub.add_parser("refresh", help="continual refresh: drift-gated "
                         "warm retrain -> AUC-gated hot-swap promotion -> "
@@ -378,10 +392,17 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                                                   "monitor_aggregate",
                                                   None))
     if cmd == "serve":
+        if getattr(args, "serve_replicas", 1) > 1:
+            from .serve.router import run_fleet
+            return run_fleet(args.dir, replicas=args.serve_replicas,
+                             port=args.serve_port,
+                             max_delay_ms=args.serve_max_delay_ms)
         from .serve.server import run_serve
         return run_serve(args.dir, port=args.serve_port,
                          selfcheck=args.serve_selfcheck,
-                         max_delay_ms=args.serve_max_delay_ms)
+                         max_delay_ms=args.serve_max_delay_ms,
+                         replica=getattr(args, "serve_replica", None),
+                         announce=getattr(args, "serve_announce", None))
     if cmd == "refresh":
         from .pipeline.refresh import RefreshProcessor
         return RefreshProcessor(args.dir, params={

@@ -186,6 +186,13 @@ _DECLS: Tuple[Knob, ...] = (
        "availability SLO for error-budget burn alerts"),
     _k("shifu.serve.generations", "property", "int", "3",
        "previous serving generations kept rollback-able per key"),
+    _k("shifu.serve.fleetPollMs", "property", "float", "500",
+       "fleet router health-poll cadence across replicas"),
+    _k("shifu.serve.fleetStaleS", "property", "float", "10",
+       "replica unreachable this long is declared dead and drained"),
+    _k("shifu.serve.canaryFrac", "property", "float", "0",
+       "coordinated-swap canary slice: commit ceil(frac*N) replicas, "
+       "abort the rest (0 = commit the whole fleet)"),
     # ---- continual refresh plane (refresh/)
     _k("shifu.refresh.psiThreshold", "property", "float", "",
        "PSI breach that triggers a refresh cycle (default: "
@@ -268,6 +275,12 @@ _DECLS: Tuple[Knob, ...] = (
     _k("SHIFU_BENCH_WDL_TABLE_ROWS", "env", "int", "",
        "bench wdl_shard: per-table cardinality for the oversized-table "
        "scenario (default fits the replicated baseline)"),
+    _k("SHIFU_BENCH_SERVE_RAW_FLOOR", "env", "float", "0.8",
+       "bench serve: raw-record QPS floor as a fraction of the "
+       "pre-binned rate (the fused transform must stay nearly free)"),
+    _k("SHIFU_BENCH_FLEET_SCALING", "env", "float", "0.8",
+       "bench --plane fleet: 2-replica aggregate-QPS scaling floor "
+       "(qps_2r / (2 * qps_1r))"),
 )
 
 KNOBS: Dict[str, Knob] = {k.name: k for k in _DECLS}

@@ -339,6 +339,20 @@ class DataSource:
 
 
 # ------------------------------------------------------------------ parsing
+def record_field_str(v) -> str:
+    """A JSON field value as the string cell the offline CSV reader would
+    have produced — the raw-record serving path (`serve.transform`) and the
+    offline parity oracle (`pipeline.evaluate.score_records_offline`) both
+    stringify through HERE, then parse through the same
+    :func:`parse_numeric` / ``ColumnBinner`` code, so missing markers and
+    number grammar agree bit-for-bit between the two pipelines."""
+    if v is None:
+        return ""
+    if isinstance(v, bool):
+        return str(v)
+    return v if isinstance(v, str) else repr(v)
+
+
 def parse_numeric(values: np.ndarray, missing_values: Sequence[str] = ()) -> tuple:
     """Vectorized string->float parse.
 

@@ -43,6 +43,11 @@ Sites/points wired today (grep ``faults.fire`` for the live set):
                         before the journal commit and the live flip — a
                         crash here must leave the PREVIOUS model live,
                         scoring bit-identically
+    serve:replica=<name>  in a fleet worker's HTTP /score path, before
+                        the request enqueues — a kill here is the
+                        replica-death drill: the router must drain the
+                        dead backend and requeue un-launched tickets on
+                        a peer so every accepted request completes
     obs:scorelog=<k>    before score-log segment k's atomic rotation
                         commit (the os.replace that drops the .open torn
                         marker) — a kill here leaves a torn final
@@ -100,6 +105,9 @@ SITES: dict = {
     ("serve", "request"): "before serving batch k's device launch",
     ("serve", "swap"): "after a hot-swap candidate is built+warmed, "
                        "before the journal commit and the live flip",
+    ("serve", "replica"): "in a fleet worker's /score path before the "
+                          "request enqueues — a kill is the replica-"
+                          "death drill (router drains + requeues)",
     ("dcn", "step"): "at elastic step s's boundary, before this "
                      "controller's contribution commit — a kill here is "
                      "the worker-loss drill the quorum must mask",

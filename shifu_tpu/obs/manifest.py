@@ -128,6 +128,30 @@ MANIFEST: Dict[str, Tuple[str, str]] = {
                                  "use)"),
     "serve.batch_latency_ms": ("histogram",
                                "oldest-request latency per batch"),
+    # ---- raw-record serving (serve/transform fused into the scorer)
+    "serve.raw_requests": ("counter",
+                           "raw-record scoring requests accepted "
+                           "(POST /score with records)"),
+    "serve.raw_rows": ("counter",
+                       "raw records parsed and scored through the "
+                       "fused-transform executable"),
+    "serve.raw_rejects": ("counter",
+                          "malformed raw records rejected per-record "
+                          "with a coded error (the rest of the request "
+                          "still scores)"),
+    # ---- serving fleet (serve/router)
+    "serve.fleet_replicas_up": ("gauge",
+                                "replicas in rotation after the last "
+                                "health sweep"),
+    "serve.fleet_requeues": ("counter",
+                             "requests requeued on a peer after a "
+                             "replica died mid-flight"),
+    "serve.fleet_drains": ("counter",
+                           "replicas pulled from rotation (SLO burn, "
+                           "stale heartbeat, or death)"),
+    "serve.fleet_swaps": ("counter",
+                          "coordinated fleet-wide hot-swaps driven "
+                          "through the router"),
     # ---- live SLO plane (obs/slo; mirrored into metrics.prom each beat)
     "slo.p50_ms": ("gauge", "sliding-window latency p50 (log sketch)"),
     "slo.p99_ms": ("gauge", "sliding-window latency p99 (log sketch)"),

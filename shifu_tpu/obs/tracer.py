@@ -93,7 +93,17 @@ from typing import Any, Dict, List, Optional
 # bench's ``--plane quality`` extras (``serve_scorelog_qps_frac`` +
 # ``quality_label_flip_detect_s``, the lower-is-better ``*_detect_s``
 # compare class)
-SCHEMA_VERSION = 11
+# v12: raw-record serving + fleet — ``serve.raw_requests`` /
+# ``serve.raw_rows`` / ``serve.raw_rejects`` counters (the fused
+# transform's ingest beat: per-record coded rejection, never the
+# batch), per-bucket ``serve.score.<key>.raw.b<bucket>`` cost records
+# (the raw family of AOT executables under the same recompile
+# sentinel), ``serve.fleet_replicas_up`` gauge + ``serve.fleet_drains``
+# / ``serve.fleet_requeues`` / ``serve.fleet_swaps`` counters (the
+# router's balancing/death/coordinated-swap beat), fleet worker
+# heartbeats ride proc ``serve-<key>-<replica>``, and the bench's
+# ``serve_raw_qps_frac`` + ``--plane fleet`` extras
+SCHEMA_VERSION = 12
 
 _TRUE = ("1", "true", "on", "yes")
 

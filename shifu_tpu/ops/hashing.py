@@ -9,6 +9,15 @@ bags in-graph instead of hashing + transferring [N] floats per tree over
 the host link.  Poisson counts compare the 53-bit uniform against
 integer CDF thresholds, so device bags are BIT-IDENTICAL to the host's
 (``tests/test_ops_hardening.py::test_device_hash_bags_match_host``).
+
+The hashed-ID bucket map rides the same limbs: :func:`hash_bucket_host`
+feeds the offline norm/trainer path while :func:`hash_bucket_device`
+(via ``models.wdl.apply_hash_device``) folds the identical map into the
+serving executable — raw-record ``POST /score`` requests hash their ID
+columns in-graph inside the fused transform prelude, and the
+host/device pair staying bit-identical is what keeps the raw serving
+path's parity guarantee alive for hashed WDL models
+(``tests/test_serve.py`` drives both paths over the same records).
 """
 
 from __future__ import annotations

@@ -115,6 +115,22 @@ class NormalizedColumn:
         bn = self.cc.columnBinning
         return _nan_to(bn.binWeightedWoe if weighted else bn.binCountWoe, 0.0)
 
+    def bin_value_table(self, num_bins: int) -> np.ndarray:
+        """``bin index -> normalized value`` as ONE f64 table, evaluated by
+        the offline transform itself over every index a binner can emit
+        (``0..num_bins+1``: real bins, the missing bin, and the clip
+        sentinel).  Any bin-index-only norm family collapses to this
+        gather, so the fused serving prelude (``serve.transform``) replays
+        the offline values verbatim from a device constant — the public
+        contract behind its bit-parity guarantee.  Value-carrying numeric
+        families (ZSCALE/ZSCORE/HYBRID/ASIS) do NOT collapse; callers
+        handle those with the clip/affine path instead."""
+        dom = np.arange(num_bins + 2)
+        if self.cc.is_categorical():
+            return np.asarray(self._transform_categorical(dom), np.float64)
+        return np.asarray(self._transform_numeric(
+            np.zeros(len(dom)), np.ones(len(dom), bool), dom), np.float64)
+
     # --------------------------------------------------------- transform
     def transform(self, values: np.ndarray, valid: np.ndarray,
                   bin_idx: np.ndarray) -> np.ndarray:

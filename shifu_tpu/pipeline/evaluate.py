@@ -355,3 +355,36 @@ class EvalProcessor(BasicProcessor):
             w.writeheader()
             w.writerows(rows)
 
+
+
+# ---------------------------------------------------------- parity oracle
+def score_records_offline(model_set_dir: str, records,
+                          selector: str = "mean") -> np.ndarray:
+    """Raw JSON records through the OFFLINE norm + score pipeline.
+
+    This is the parity oracle for raw-record serving: the fused transform
+    inside ``serve.AOTScorer`` (``POST /score`` with ``records``) must
+    reproduce these float32 scores BIT-identically — same stringification
+    (:func:`data.reader.record_field_str`), same ``parse_numeric`` missing
+    grammar, same ``NormalizedColumn``/``ColumnBinner`` math, same
+    ensemble reduction.  tests/test_serve.py drives both paths over the
+    same records and asserts byte equality.
+    """
+    import pandas as pd
+
+    from ..config import ModelConfig, load_column_configs
+    from ..data.reader import RawChunk, record_field_str
+    from ..data.transform import DatasetTransformer
+
+    mc = ModelConfig.load(os.path.join(model_set_dir, "ModelConfig.json"))
+    ccs = load_column_configs(os.path.join(model_set_dir,
+                                           "ColumnConfig.json"))
+    tf = DatasetTransformer(mc, ccs)
+    names = [c.columnName for c in tf.columns]
+    data = pd.DataFrame(
+        {n: [record_field_str(r.get(n)) for r in records] for n in names},
+        dtype=object)
+    tc = tf.transform(RawChunk(columns=names, data=data))
+    scorer = Scorer.from_dir(os.path.join(model_set_dir, "models"))
+    res = scorer.score(tc.x, bins=tc.bins)
+    return np.asarray(res.select(selector), np.float32)

@@ -26,7 +26,17 @@ Modules:
 - :mod:`server`  — :class:`ServeServer` + the ``shifu-tpu serve`` CLI
   entry: heartbeats from :mod:`shifu_tpu.obs.health` (carrying queue
   depth + the live SLO summary), optional stdlib HTTP front-end
-  (``POST /score``, ``GET /healthz``, ``GET /slo``).
+  (``POST /score``, ``GET /healthz``, ``GET /slo``, ``POST /swap``);
+- :mod:`transform` — :class:`FusedTransform`: the offline norm pipeline
+  (binning, WoE/zscore maps, missing handling) compiled as a jnp
+  prelude INSIDE the scorer executable, so ``POST /score`` accepts raw
+  ``{field: value}`` records bit-identical to the offline norm+eval
+  path;
+- :mod:`router`  — :class:`ServeRouter` + ``shifu-tpu serve
+  --replicas N``: N worker processes behind a health-/SLO-aware
+  balancing front with requeue-on-replica-death and coordinated
+  no-mixed-window fleet hot-swap (``-Dshifu.serve.canaryFrac`` commits
+  an explicit canary slice instead).
 
 Observability: per-request tracing (head-sampled at
 ``-Dshifu.serve.traceSampleRate``, or forced by an ``X-Shifu-Trace``
@@ -43,13 +53,16 @@ offered loads, bucket occupancy / padding waste, zero-recompile guard,
 
 from .batcher import MicroBatcher, Ticket                     # noqa: F401
 from .registry import ModelRegistry                           # noqa: F401
+from .router import ServeRouter, run_fleet                    # noqa: F401
 from .scorer import (AOTScorer, bucket_ladder,                # noqa: F401
                      covering_bucket, infer_dims,
                      serve_recompile_count)
 from .server import ServeServer, max_delay_s                  # noqa: F401
+from .transform import FusedTransform                         # noqa: F401
 
 __all__ = [
     "AOTScorer", "bucket_ladder", "covering_bucket", "infer_dims",
     "serve_recompile_count", "MicroBatcher", "Ticket", "ModelRegistry",
-    "ServeServer", "max_delay_s",
+    "ServeServer", "max_delay_s", "FusedTransform", "ServeRouter",
+    "run_fleet",
 ]

@@ -179,8 +179,11 @@ class MicroBatcher:
         self.slo = slo
         self._trace_rng = random.Random(0x51F0)
         self._cond = threading.Condition()
-        # queue of (ticket, rows, bins, row_offset): row_offset = how many
-        # of this burst's rows earlier flushes already consumed
+        # queue of (ticket, rows, bins, row_offset, raw): row_offset = how
+        # many of this burst's rows earlier flushes already consumed; raw
+        # marks packed raw-record bursts (serve/transform.py wire format)
+        # — a launch never mixes raw and pre-binned rows, the two ride
+        # different executables
         self._queue: deque = deque()
         self._queued_rows = 0
         self._batches = 0
@@ -219,7 +222,8 @@ class MicroBatcher:
                      bins: Optional[np.ndarray] = None,
                      stamps: Optional[np.ndarray] = None,
                      trace_id: Optional[str] = None,
-                     req_id: Optional[str] = None) -> Ticket:
+                     req_id: Optional[str] = None,
+                     raw: bool = False) -> Ticket:
         """A burst of concurrent single-record requests (an open-loop
         load generator's arrivals for one tick) — one queue append, one
         shared ticket.  ``stamps`` lets the generator record IDEAL
@@ -228,7 +232,10 @@ class MicroBatcher:
         forces request tracing for this burst; otherwise the burst is
         head-sampled at ``trace_sample_rate`` (minting an id).
         ``req_id`` (the ``X-Shifu-Request`` header) is the score log's
-        delayed-outcome join key for this burst."""
+        delayed-outcome join key for this burst.  ``raw=True`` marks
+        ``rows`` as PACKED raw-record wire rows (``serve/transform.py``)
+        — they flush through the fused transform+score executable and
+        never share a launch with pre-binned rows."""
         n = len(rows)
         if stamps is None:
             stamps = np.full(n, self.clock())
@@ -243,7 +250,7 @@ class MicroBatcher:
         with self._cond:
             if self._stop:
                 raise RuntimeError("batcher is stopped")
-            self._queue.append((t, rows, bins, 0))
+            self._queue.append((t, rows, bins, 0, raw))
             self._queued_rows += n
             # one accepted request per submit call; row volume is the
             # separate "rows" / serve.rows_scored accounting
@@ -276,21 +283,29 @@ class MicroBatcher:
 
     def _take(self, max_rows: int) -> List[Tuple[Ticket, np.ndarray,
                                                  Optional[np.ndarray],
-                                                 int]]:
+                                                 int, bool]]:
         """Pop up to ``max_rows`` rows off the queue head (splitting a
-        burst when it straddles the boundary).  Caller holds the lock."""
+        burst when it straddles the boundary).  Stops at a raw/pre-binned
+        kind boundary — one launch, one executable family.  Caller holds
+        the lock."""
         out, taken = [], 0
+        kind: Optional[bool] = None
         while self._queue and taken < max_rows:
-            t, rows, bins, off = self._queue.popleft()
+            t, rows, bins, off, raw = self._queue[0]
+            if kind is None:
+                kind = raw
+            elif raw != kind:
+                break
+            self._queue.popleft()
             room = max_rows - taken
             avail = len(rows) - off
             take = min(room, avail)
             out.append((t, rows[off:off + take],
                         None if bins is None else bins[off:off + take],
-                        off))
+                        off, raw))
             taken += take
             if take < avail:
-                self._queue.appendleft((t, rows, bins, off + take))
+                self._queue.appendleft((t, rows, bins, off + take, raw))
         self._queued_rows -= taken
         return out
 
@@ -327,15 +342,16 @@ class MicroBatcher:
 
     # ------------------------------------------------------------ launch
     def _launch(self, parts, reason: str = "forced") -> int:
-        n = sum(len(rows) for _, rows, _, _ in parts)
+        n = sum(len(rows) for _, rows, _, _, _ in parts)
         if n == 0:
             return 0
+        raw_kind = parts[0][4]
         with self._cond:
             batch_index = self._batches
             self._batches += 1
         # sampled members (the common case is NONE: no perf counters, no
         # timing dict, no record emission — the batch path is unchanged)
-        traced = [t for t, _, _, _ in parts if t.trace is not None]
+        traced = [t for t, _, _, _, _ in parts if t.trace is not None]
         t_take = time.perf_counter() if traced else 0.0
         tm: Optional[Dict[str, float]] = \
             {"pad_s": 0.0, "launch_s": 0.0, "device_s": 0.0} if traced \
@@ -351,17 +367,27 @@ class MicroBatcher:
             scorer = self._provider()
             bucket = covering_bucket(scorer.buckets, n)
             t_asm = time.perf_counter() if traced else 0.0
-            rows = np.concatenate([r for _, r, _, _ in parts], axis=0) \
+            rows = np.concatenate([r for _, r, _, _, _ in parts], axis=0) \
                 if len(parts) > 1 else parts[0][1]
             bins = None
-            if scorer.needs_bins:
-                bins = np.concatenate([b for _, _, b, _ in parts], axis=0) \
+            if not raw_kind and scorer.needs_bins:
+                bins = np.concatenate([b for _, _, b, _, _ in parts],
+                                      axis=0) \
                     if len(parts) > 1 else parts[0][2]
             if tm is not None:
                 tm["pad_s"] += time.perf_counter() - t_asm
             faults.fire("serve", "request", batch_index)
-            if tm is not None and getattr(scorer, "supports_timings",
-                                          False):
+            if raw_kind:
+                if not getattr(scorer, "accepts_raw", False):
+                    raise ValueError("raw-record request but the live "
+                                     "scorer has no fused transform")
+                if tm is not None and getattr(scorer, "supports_timings",
+                                              False):
+                    raw = scorer.score_batch_raw(rows, timings=tm)
+                else:
+                    raw = scorer.score_batch_raw(rows)
+            elif tm is not None and getattr(scorer, "supports_timings",
+                                            False):
                 raw = scorer.score_batch(rows, bins, timings=tm)
             else:
                 raw = scorer.score_batch(rows, bins)
@@ -380,11 +406,11 @@ class MicroBatcher:
                 else:
                     self.slo.observe_batch(np.concatenate(
                         [now - t.stamps[so:so + len(r)]
-                         for t, r, _, so in parts]))
+                         for t, r, _, so, _ in parts]))
             except Exception:               # noqa: BLE001
                 log.exception("SLO record failed for batch")
         off = 0
-        for t, r, _, src_off in parts:
+        for t, r, _, src_off, _ in parts:
             sl_dst = slice(src_off, src_off + len(r))
             t._complete(sl_dst,
                         None if err is not None
@@ -413,7 +439,7 @@ class MicroBatcher:
             self._maybe_refine(scorer)
         if self.scorelog is not None and err is None:
             lo = 0
-            for t, r, b, _ in parts:
+            for t, r, b, _, _ in parts:
                 self.scorelog.log(t.req, mean[lo:lo + len(r)], bins=b)
                 lo += len(r)
         if traced:
@@ -425,7 +451,7 @@ class MicroBatcher:
                                     RuntimeError)):
                 raise err
             return n
-        oldest = min(float(t.stamps[so]) for t, _, _, so in parts)
+        oldest = min(float(t.stamps[so]) for t, _, _, so, _ in parts)
         obs.histogram("serve.batch_latency_ms").observe(
             (now - oldest) * 1000.0)
         return n

@@ -28,6 +28,19 @@ Fault site: ``serve:swap=<key>`` fires after BUILD and before
 JOURNAL+FLIP — on the swap AND rollback paths — a crash or injected
 error there must leave the currently-live model serving bit-identical
 scores.
+
+For a FLEET-coordinated swap the two phases are exposed separately:
+:meth:`prepare` runs BUILD and holds the warmed candidate pending
+(the live model keeps serving, nothing is journalled), then
+:meth:`commit` runs JOURNAL+FLIP, or :meth:`abort` discards the
+candidate.  A router prepares every replica before committing any, so
+no request ever sees a mixed-model fleet; :meth:`swap` is simply
+prepare+commit in one call.
+
+A registry may carry a :class:`~shifu_tpu.serve.transform.FusedTransform`
+per key (``load(..., transform=...)``): it is threaded into every
+scorer the registry builds — swap, rollback rebuild, restore — so the
+raw-record path survives promotion.
 """
 
 from __future__ import annotations
@@ -68,6 +81,15 @@ class _Generation(NamedTuple):
     promoted_ts: float
 
 
+class _Pending(NamedTuple):
+    """A prepared-but-uncommitted swap candidate (see :meth:`prepare`)."""
+    gen: int
+    scorer: AOTScorer
+    models_dir: Optional[str]
+    buckets: Optional[tuple]
+    transform: Optional[object]
+
+
 class ModelRegistry:
     """See module docs.  ``state_dir=None`` keeps the journal in-memory
     only (tests, embedded use)."""
@@ -81,6 +103,8 @@ class ModelRegistry:
         self._hist: Dict[str, List[_Generation]] = {}
         self._peak: Dict[str, int] = {}      # highest gen ever (monotonic)
         self._buckets: Dict[str, Optional[tuple]] = {}   # last ladder used
+        self._transforms: Dict[str, Optional[object]] = {}  # FusedTransform
+        self._pending: Dict[str, _Pending] = {}   # prepared, uncommitted
 
     # ------------------------------------------------------------ lookup
     def get(self, key: str) -> AOTScorer:
@@ -119,23 +143,27 @@ class ModelRegistry:
     # ------------------------------------------------------- load / swap
     def _build(self, key: str, models_or_dir, scale: float,
                buckets: Optional[Sequence[int]], gen: int,
-               warm: bool) -> AOTScorer:
+               warm: bool, transform=None) -> AOTScorer:
         if isinstance(models_or_dir, str):
             models = Scorer.from_dir(models_or_dir).models
         else:
             models = list(models_or_dir)
         scorer = AOTScorer(models, scale=scale, buckets=buckets,
-                           name=f"serve.score.{key}.g{gen}")
+                           name=f"serve.score.{key}.g{gen}",
+                           transform=transform)
         if warm:
             scorer.warm()
         return scorer
 
     def load(self, key: str, models_or_dir, scale: float = SCORE_SCALE,
              buckets: Optional[Sequence[int]] = None,
-             warm: bool = True) -> AOTScorer:
+             warm: bool = True, transform=None) -> AOTScorer:
         """First load of a modelset (no previous model to protect);
-        accepts a models dir or an in-memory model sequence."""
-        scorer = self._build(key, models_or_dir, scale, buckets, 0, warm)
+        accepts a models dir or an in-memory model sequence.  A
+        ``transform`` (:class:`FusedTransform`) enables the raw-record
+        executable family and is carried into every later rebuild."""
+        scorer = self._build(key, models_or_dir, scale, buckets, 0, warm,
+                             transform)
         new_dir = models_or_dir if isinstance(models_or_dir, str) else None
         self._journal(pending={key: (new_dir, 0)})
         with self._lock:
@@ -144,6 +172,7 @@ class ModelRegistry:
             self._peak[key] = max(self._peak.get(key, 0), 0)
             self._hist.setdefault(key, [])
             self._buckets[key] = tuple(buckets) if buckets else None
+            self._transforms[key] = transform
             if new_dir is not None:
                 self._dirs[key] = new_dir
         return scorer
@@ -151,7 +180,7 @@ class ModelRegistry:
     def restore(self, key: str, default_models_dir: str,
                 scale: float = SCORE_SCALE,
                 buckets: Optional[Sequence[int]] = None,
-                warm: bool = True) -> AOTScorer:
+                warm: bool = True, transform=None) -> AOTScorer:
         """Resolve the serving journal and load whatever was last
         promoted under ``key`` (falling back to ``default_models_dir``
         for a never-promoted set), restoring the recorded generation
@@ -163,11 +192,13 @@ class ModelRegistry:
         gen = int(doc.get("generation") or 0)
         hist = [h for h in (doc.get("history") or [])
                 if h.get("models_dir")]
-        scorer = self._build(key, mdir, scale, buckets, gen, warm)
+        scorer = self._build(key, mdir, scale, buckets, gen, warm,
+                             transform)
         with self._lock:
             self._live[key] = scorer
             self._gen[key] = gen
             self._dirs[key] = mdir
+            self._transforms[key] = transform
             self._hist[key] = [
                 _Generation(int(h["generation"]), None, h["models_dir"],
                             float(h.get("promoted_ts") or 0.0))
@@ -182,24 +213,50 @@ class ModelRegistry:
                  "rollback-able)", key, gen, len(hist))
         return scorer
 
-    def swap(self, key: str, models_or_dir, scale: float = SCORE_SCALE,
-             buckets: Optional[Sequence[int]] = None,
-             warm: bool = True) -> AOTScorer:
-        """Atomic hot-swap (see module docs).  Raises if the build or
-        journal fails — the previous model stays live in that case."""
+    def prepare(self, key: str, models_or_dir, scale: float = SCORE_SCALE,
+                buckets: Optional[Sequence[int]] = None,
+                warm: bool = True, transform=None) -> int:
+        """Phase 1 of a swap: BUILD the candidate (load, compile and
+        warm every bucket executable) and hold it PENDING — the live
+        model keeps serving and nothing is journalled, so a fleet
+        router can prepare EVERY replica before committing any.
+        Returns the generation the candidate will take on
+        :meth:`commit`; :meth:`abort` discards it.  The number is not
+        reserved until commit, so an aborted or failed prepare lets the
+        next promotion take the same number."""
         with self._lock:
             if key not in self._live:
-                raise KeyError(f"swap({key!r}) before load() — nothing "
-                               "is live to replace")
+                raise KeyError(f"prepare({key!r}) before load() — "
+                               "nothing is live to replace")
             gen = self._peak.get(key, self._gen[key]) + 1
-            prev = _Generation(self._gen[key], self._live[key],
-                               self._dirs.get(key), round(time.time(), 3))
+            if transform is None:
+                transform = self._transforms.get(key)
         # BUILD off-line: the expensive part happens while the old
         # scorer keeps serving
-        scorer = self._build(key, models_or_dir, scale, buckets, gen, warm)
+        scorer = self._build(key, models_or_dir, scale, buckets, gen,
+                             warm, transform)
+        new_dir = models_or_dir if isinstance(models_or_dir, str) else None
+        with self._lock:
+            self._pending[key] = _Pending(
+                gen, scorer, new_dir,
+                tuple(buckets) if buckets else None, transform)
+        return gen
+
+    def commit(self, key: str) -> AOTScorer:
+        """Phase 2 of a swap: JOURNAL then FLIP the PENDING candidate
+        (module docs — a failure before the flip leaves the previous
+        model live and the candidate discarded)."""
+        with self._lock:
+            if key not in self._pending:
+                raise KeyError(f"commit({key!r}) without a prepare()")
+            pend = self._pending.pop(key)
+            # interleaved promotions may have moved the peak since
+            # prepare: never reuse a taken number
+            gen = max(pend.gen, self._peak.get(key, 0) + 1)
+            prev = _Generation(self._gen[key], self._live[key],
+                               self._dirs.get(key), round(time.time(), 3))
         # a crash from here to the flip must leave the OLD model live
         faults.fire("serve", "swap", key)
-        new_dir = models_or_dir if isinstance(models_or_dir, str) else None
         # JOURNAL before FLIP (module docs): a journal failure raises
         # while the old model is still live; once committed, the flip is
         # one infallible reference assignment.  The journal records the
@@ -208,19 +265,42 @@ class ModelRegistry:
         with self._lock:
             hist_after = (self._hist.get(key, []) + [prev])[-limit:] \
                 if limit else []
-        self._journal(pending={key: (new_dir, gen)},
+        self._journal(pending={key: (pend.models_dir, gen)},
                       history={key: hist_after})
         with self._lock:
             self._hist[key] = hist_after
-            self._live[key] = scorer
+            self._live[key] = pend.scorer
             self._gen[key] = gen
             self._peak[key] = max(self._peak.get(key, 0), gen)
-            self._buckets[key] = tuple(buckets) if buckets else None
-            if new_dir is not None:
-                self._dirs[key] = new_dir
+            self._buckets[key] = pend.buckets
+            self._transforms[key] = pend.transform
+            if pend.models_dir is not None:
+                self._dirs[key] = pend.models_dir
         obs.counter("serve.swaps").inc()
         log.info("promoted %s generation %d", key, gen)
-        return scorer
+        return pend.scorer
+
+    def abort(self, key: str) -> bool:
+        """Discard a PENDING candidate (canary losers, a fleet-mate's
+        failed prepare).  The live model never moved; returns whether
+        anything was pending."""
+        with self._lock:
+            return self._pending.pop(key, None) is not None
+
+    def pending_generation(self, key: str) -> Optional[int]:
+        """The generation a PENDING candidate will take, or None."""
+        with self._lock:
+            pend = self._pending.get(key)
+            return None if pend is None else pend.gen
+
+    def swap(self, key: str, models_or_dir, scale: float = SCORE_SCALE,
+             buckets: Optional[Sequence[int]] = None,
+             warm: bool = True, transform=None) -> AOTScorer:
+        """Atomic hot-swap (see module docs): :meth:`prepare` +
+        :meth:`commit` in one call.  Raises if the build or journal
+        fails — the previous model stays live in that case."""
+        self.prepare(key, models_or_dir, scale, buckets, warm, transform)
+        return self.commit(key)
 
     def rollback(self, key: str, warm: bool = True) -> AOTScorer:
         """Re-flip to the previous generation through the same
@@ -248,7 +328,8 @@ class ModelRegistry:
             # the key's own bucket ladder — same launch shapes, same
             # bits
             scorer = self._build(key, prev.models_dir, SCORE_SCALE,
-                                 self._buckets.get(key), prev.gen, warm)
+                                 self._buckets.get(key), prev.gen, warm,
+                                 self._transforms.get(key))
         # same crash-safety contract as swap: a death here leaves the
         # CURRENT model live and the journal un-flipped
         faults.fire("serve", "swap", key)

@@ -290,7 +290,7 @@ class AOTScorer:
 
     def __init__(self, models: Sequence, scale: float = SCORE_SCALE,
                  buckets: Optional[Sequence[int]] = None,
-                 name: str = "serve.score"):
+                 name: str = "serve.score", transform=None):
         import jax
 
         from ..ops import tree_quant as tq
@@ -328,6 +328,37 @@ class AOTScorer:
         # executable registers with record_executable in _ensure_compiled
         self._jitted = jax.jit(fn, donate_argnums=donate)  # shifu-lint: disable=recompile-hazard
         self._compiled: dict = {}
+        self._compiled_raw: dict = {}
+        # raw-record family: the norm transform fused as a jnp prelude of
+        # the SAME ensemble graph — one executable per rung, wire format
+        # [n, 3C] (serve/transform.py), bins minted in-graph in the
+        # narrow wire dtype so tree_quant stays uint8
+        self.transform = transform
+        self.accepts_raw = transform is not None
+        self._jitted_raw = None
+        if transform is not None:
+            if transform.width < self.n_features:
+                raise ValueError(
+                    f"transform emits {transform.width} features but the "
+                    f"ensemble consumes {self.n_features} — the ColumnConfig "
+                    "snapshot does not match the models")
+            if transform.n_columns < self.n_bins_cols:
+                raise ValueError(
+                    f"transform emits {transform.n_columns} bin columns but "
+                    f"the ensemble consumes {self.n_bins_cols}")
+            nfeat, nbc = self.n_features, self.n_bins_cols
+            bdt, needs_bins = self.bins_dtype, self.needs_bins
+
+            def raw_fn(packed):
+                xx, bb = transform.apply_device(packed)
+                xx = xx[:, :nfeat]
+                if not needs_bins:
+                    return fn(xx)
+                return fn(xx, bb[:, :nbc].astype(bdt))
+            donate_raw = () if jax.default_backend() == "cpu" else (0,)
+            # AOT template only — per-bucket executables register below
+            self._jitted_raw = jax.jit(  # shifu-lint: disable=recompile-hazard
+                raw_fn, donate_argnums=donate_raw)
         self._lock = threading.Lock()
         self._pin_params()
 
@@ -355,16 +386,24 @@ class AOTScorer:
         return (x, jax.ShapeDtypeStruct((bucket, self.n_bins_cols),
                                         self.bins_dtype))
 
-    def _ensure_compiled(self, bucket: int):
-        ent = self._compiled.get(bucket)
+    def _avals_raw(self, bucket: int):
+        import jax
+        return (jax.ShapeDtypeStruct((bucket, self.transform.wire_width),
+                                     self.transform.wire_dtype),)
+
+    def _ensure_compiled(self, bucket: int, raw: bool = False):
+        cache = self._compiled_raw if raw else self._compiled
+        ent = cache.get(bucket)
         if ent is not None:
             return ent
         with self._lock:
-            ent = self._compiled.get(bucket)
+            ent = cache.get(bucket)
             if ent is not None:
                 return ent
             import jax
-            lowered = self._jitted.lower(*self._avals(bucket))
+            jitted = self._jitted_raw if raw else self._jitted
+            avals = self._avals_raw(bucket) if raw else self._avals(bucket)
+            lowered = jitted.lower(*avals)
             exe = lowered.compile()
             try:
                 sig = ",".join(a.str_short() for a in
@@ -376,9 +415,10 @@ class AOTScorer:
             # trips the xla.recompiles sentinel
             # bounded shape-keyed family: ONE name per ladder rung by
             # design, so the per-name dedup stays meaningful
-            costs.record_executable(f"{self.name}.b{bucket}",  # shifu-lint: disable=recompile-hazard
+            suffix = ".raw" if raw else ""
+            costs.record_executable(f"{self.name}{suffix}.b{bucket}",  # shifu-lint: disable=recompile-hazard
                                     lowered, exe, signature=sig)
-            ent = self._compiled[bucket] = (exe, sig)
+            ent = cache[bucket] = (exe, sig)
         return ent
 
     def warm(self, launch: bool = True) -> None:
@@ -397,6 +437,15 @@ class AOTScorer:
                                      self.bins_dtype))
             import jax
             jax.block_until_ready(exe(*args))
+        if not self.accepts_raw:
+            return
+        rexe, _ = self._ensure_compiled(bucket, raw=True)
+        if launch:
+            import jax
+            # a zero wire row decodes as all-missing — a legal record
+            jax.block_until_ready(rexe(np.zeros(
+                (bucket, self.transform.wire_width),
+                self.transform.wire_dtype)))
 
     def extend_buckets(self, new_buckets: Sequence[int]) -> int:
         """Grow the ladder with occupancy-refined rungs (see
@@ -472,6 +521,52 @@ class AOTScorer:
             return np.asarray(exe(*args))[:n]
         t2 = _time.perf_counter()
         out = exe(*args)
+        t3 = _time.perf_counter()
+        raw = np.asarray(out)
+        t4 = _time.perf_counter()
+        timings["device_s"] = timings.get("device_s", 0.0) + (t3 - t2)
+        timings["launch_s"] = timings.get("launch_s", 0.0) \
+            + (t2 - t1) + (t4 - t3)
+        return raw[:n]
+
+    def score_batch_raw(self, packed: np.ndarray,
+                        timings: Optional[dict] = None) -> np.ndarray:
+        """raw scaled scores [n, M] for PACKED raw-record rows (the
+        ``serve/transform.py`` wire format): the fused executable norms
+        in-graph and scores in one launch.  Same pad/chunk/trim contract
+        as :meth:`score_batch`; pad rows are all-missing and cost
+        nothing beyond the rung."""
+        import time as _time
+        if not self.accepts_raw:
+            raise ValueError("this scorer was built without a norm "
+                             "transform — raw records need the "
+                             "ColumnConfig snapshot")
+        n = len(packed)
+        top = self.buckets[-1]
+        if n > top:
+            return np.concatenate(
+                [self.score_batch_raw(packed[s:s + top], timings=timings)
+                 for s in range(0, n, top)], axis=0)
+        t0 = _time.perf_counter() if timings is not None else 0.0
+        bucket = covering_bucket(self.buckets, n)
+        pad = bucket - n
+        if pad:
+            packed = np.concatenate(
+                [packed, np.zeros((pad, packed.shape[1]), packed.dtype)],
+                axis=0)
+        if timings is not None:
+            t1 = _time.perf_counter()
+            timings["pad_s"] = timings.get("pad_s", 0.0) + (t1 - t0)
+        exe, sig = self._ensure_compiled(bucket, raw=True)
+        arg = np.ascontiguousarray(packed, self.transform.wire_dtype)
+        costs.get_cost_registry().launch(f"{self.name}.raw.b{bucket}", sig)
+        for kw in self._quant_kernel_shapes:
+            costs.record_model_launch("pallas.tree_traverse",
+                                      rows=bucket, **kw)
+        if timings is None:
+            return np.asarray(exe(arg))[:n]
+        t2 = _time.perf_counter()
+        out = exe(arg)
         t3 = _time.perf_counter()
         raw = np.asarray(out)
         t4 = _time.perf_counter()

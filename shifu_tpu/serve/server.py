@@ -10,11 +10,19 @@ serves scoring requests:
 - in-process: :meth:`ServeServer.score` (closed-loop) /
   :meth:`ServeServer.submit` (async ticket) — what the bench drives;
 - over HTTP (stdlib, zero new deps): ``POST /score`` with
-  ``{"rows": [[...]], "bins": [[...]]}`` -> ``{"scores": [...]}``,
-  ``GET /healthz`` -> live state + bucket/batch/queue accounting + the
-  compact SLO summary, ``GET /slo`` -> the full SLO/burn-rate payload,
+  ``{"rows": [[...]], "bins": [[...]]}`` -> ``{"scores": [...]}``, or
+  RAW records ``{"records": [{field: value, ...}]}`` when the modelset
+  dir carries its ColumnConfig snapshot (the norm transform runs fused
+  inside the scorer executable — :mod:`shifu_tpu.serve.transform`; a
+  malformed record fails alone with a coded error, its ``scores`` slot
+  null), ``GET /healthz`` -> live state (``accepts_raw`` next to
+  ``needs_bins``) + bucket/batch/queue accounting + the compact SLO
+  summary, ``GET /slo`` -> the full SLO/burn-rate payload,
   ``GET /quality`` -> the live model-quality table, ``POST /outcome``
-  -> delayed-label records joined onto logged predictions;
+  -> delayed-label records joined onto logged predictions,
+  ``POST /swap`` -> promotion phases (``prepare``/``commit``/``abort``
+  or a one-shot full swap) the fleet router drives for a coordinated,
+  no-mixed-window hot-swap;
 - request tracing: an ``X-Shifu-Trace`` request header propagates the
   caller's trace id onto the batch pipeline (forcing sampling for that
   request); otherwise requests are head-sampled at
@@ -61,7 +69,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from .. import obs
+from .. import faults, obs
 from .batcher import MicroBatcher, Ticket
 from .registry import ModelRegistry
 from .scorer import bucket_ladder
@@ -85,6 +93,21 @@ def max_delay_s(override_ms: Optional[float] = None) -> float:
                                           DEFAULT_MAX_DELAY_MS)) / 1000.0
 
 
+def _load_transform(model_set_dir: str):
+    """The modelset's :class:`FusedTransform` when its config snapshot
+    (ModelConfig.json + ColumnConfig.json) is on disk — pre-binned-only
+    sets serve fine without one, they just refuse raw records."""
+    if not all(os.path.isfile(os.path.join(model_set_dir, f))
+               for f in ("ModelConfig.json", "ColumnConfig.json")):
+        return None
+    from .transform import FusedTransform
+    try:
+        return FusedTransform.from_dir(model_set_dir)
+    except (OSError, ValueError, KeyError) as e:
+        log.warning("raw-record path disabled (%s)", e)
+        return None
+
+
 class ServeServer:
     """One serving process for one (or more) modelsets."""
 
@@ -96,17 +119,23 @@ class ServeServer:
                  trace_sample_rate: Optional[float] = None,
                  slo_p99_ms: Optional[float] = None,
                  slo_availability: Optional[float] = None,
-                 scorelog_sample_rate: Optional[float] = None):
+                 scorelog_sample_rate: Optional[float] = None,
+                 transform=None, replica: Optional[str] = None):
         self.model_set_dir = model_set_dir
         self.key = key or (os.path.basename(os.path.abspath(model_set_dir))
                            if model_set_dir else "default")
+        self.replica = replica
         state_dir = (os.path.join(model_set_dir, "serving")
                      if model_set_dir else None)
         self.registry = ModelRegistry(state_dir=state_dir)
         src = models if models is not None \
             else os.path.join(model_set_dir, "models")
+        if transform is None and model_set_dir:
+            transform = _load_transform(model_set_dir)
+        self.transform = transform
         self.registry.load(self.key, src,
-                           buckets=tuple(buckets or bucket_ladder()))
+                           buckets=tuple(buckets or bucket_ladder()),
+                           transform=transform)
         delay_s = max_delay_s(max_delay_ms)
         p99_obj, avail_obj = obs.slo_objectives(delay_s * 1000.0)
         self.slo = obs.SLOTracker(
@@ -150,9 +179,11 @@ class ServeServer:
             return self
         self.batcher.start()
         if self.model_set_dir:
+            proc = f"serve-{self.key}" + \
+                (f"-{self.replica}" if self.replica else "")
             self._heartbeat = obs.start_heartbeat(
                 obs.health_dir_for(self.model_set_dir), step="SERVE",
-                proc=f"serve-{self.key}", extras_fn=self._beat_extras)
+                proc=proc, extras_fn=self._beat_extras)
             self._exporter = obs.start_exporter(
                 os.path.join(self.model_set_dir, "telemetry"),
                 step="SERVE")
@@ -222,6 +253,81 @@ class ServeServer:
                                       trace_id=trace_id, req_id=req_id)
         return t.wait(timeout)
 
+    def score_raw(self, records: Sequence, timeout: float = 30.0,
+                  trace_id: Optional[str] = None,
+                  req_id: Optional[str] = None) -> dict:
+        """Raw-record scoring: parse + categorical binning on host, the
+        whole norm transform in-graph (fused into the scorer
+        executable).  PER-RECORD rejection: a malformed record (non-
+        object, non-scalar field) gets a coded error and a null
+        ``scores`` slot while its neighbours still score — the
+        ``-Dshifu.data.badThreshold`` philosophy applied to serving."""
+        scorer = self.registry.get(self.key)
+        if not getattr(scorer, "accepts_raw", False):
+            raise ValueError(
+                "this modelset serves pre-binned rows only — raw "
+                "records need the ModelConfig/ColumnConfig snapshot "
+                "next to models/")
+        obs.counter("serve.raw_requests").inc()
+        packed, kept, errors = scorer.transform.parse_records(records)
+        if errors:
+            obs.counter("serve.raw_rejects").inc(len(errors))
+        scores: list = [None] * len(records)
+        if len(packed):
+            obs.counter("serve.raw_rows").inc(int(len(packed)))
+            t = self.batcher.submit_burst(packed, raw=True,
+                                          trace_id=trace_id,
+                                          req_id=req_id)
+            if not self._started:              # in-process, no worker
+                self.batcher.drain()
+            got = t.wait(timeout)
+            for i, s in zip(kept, got):
+                scores[int(i)] = float(s)
+        return {"scores": scores, "errors": errors,
+                "generation": self.registry.generation(self.key)}
+
+    def swap_phase(self, doc: dict) -> dict:
+        """The ``POST /swap`` body: ``{"phase": ..., "dir": ...}``.
+
+        ``prepare`` BUILDs + warms the candidate from ``dir`` and holds
+        it pending (live model untouched); ``commit`` journals + flips
+        it; ``abort`` discards it; ``swap`` (the default) does
+        prepare+commit in one call.  The fleet router drives
+        prepare-everywhere THEN commit-everywhere so no request ever
+        sees a mixed-model fleet."""
+        phase = str(doc.get("phase") or "swap")
+        if phase in ("prepare", "swap"):
+            mdir = doc.get("dir") or doc.get("models_dir")
+            if not mdir:
+                raise ValueError(
+                    'swap phase %r needs a models dir ({"dir": ...})'
+                    % phase)
+            if phase == "swap":
+                self.swap(str(mdir))
+            else:
+                gen = self.registry.prepare(
+                    self.key, str(mdir), buckets=self._refined_ladder())
+                return {"kind": "swap", "phase": phase,
+                        "prepared_generation": gen,
+                        "generation": self.registry.generation(self.key)}
+        elif phase == "commit":
+            self.registry.commit(self.key)
+        elif phase == "abort":
+            self.registry.abort(self.key)
+        else:
+            raise ValueError(f"unknown swap phase {phase!r}")
+        return {"kind": "swap", "phase": phase,
+                "generation": self.registry.generation(self.key)}
+
+    def _refined_ladder(self) -> tuple:
+        """The live ladder refined against observed batch sizes — the
+        candidate compiles/warms on it during BUILD."""
+        from .scorer import refine_ladder
+        scorer = self.registry.get(self.key)
+        with self.batcher._cond:
+            counts = dict(self.batcher.size_counts)
+        return refine_ladder(scorer.buckets, counts)
+
     def swap(self, models_or_dir) -> None:
         """Promote a retrained model without dropping requests.  The
         candidate's ladder is the live ladder REFINED against the
@@ -230,12 +336,8 @@ class ServeServer:
         during this generation's traffic is squeezed out — every rung
         (inherited and refined) compiles and warms during the swap's
         BUILD phase, before the flip."""
-        from .scorer import refine_ladder
-        scorer = self.registry.get(self.key)
-        with self.batcher._cond:
-            counts = dict(self.batcher.size_counts)
         self.registry.swap(self.key, models_or_dir,
-                           buckets=refine_ladder(scorer.buckets, counts))
+                           buckets=self._refined_ladder())
 
     def status(self) -> dict:
         scorer = self.registry.get(self.key)
@@ -246,6 +348,8 @@ class ServeServer:
             "models": len(scorer.models),
             "buckets": list(scorer.buckets),
             "needs_bins": scorer.needs_bins,
+            "accepts_raw": bool(getattr(scorer, "accepts_raw", False)),
+            "replica": self.replica,
             "n_features": scorer.n_features,
             "max_delay_ms": self.batcher.max_delay_s * 1000.0,
             "trace_sample_rate": self.batcher.trace_sample_rate,
@@ -339,7 +443,7 @@ def _make_handler(server: ServeServer):
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
         def do_POST(self):                     # noqa: N802
-            if self.path not in ("/score", "/outcome"):
+            if self.path not in ("/score", "/outcome", "/swap"):
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
             try:
@@ -348,10 +452,13 @@ def _make_handler(server: ServeServer):
                 if self.path == "/outcome":
                     self._reply(200, server.add_outcomes(doc))
                     return
-                rows = np.asarray(doc["rows"], np.float32)
-                bins = doc.get("bins")
-                if bins is not None:
-                    bins = np.asarray(bins, np.int32)
+                if self.path == "/swap":
+                    self._reply(200, server.swap_phase(doc))
+                    return
+                # a kill here models a replica dying mid-request — the
+                # router requeues the un-launched ticket on a peer
+                faults.fire("serve", "replica",
+                            server.replica or server.key)
                 # propagate the caller's trace id (forces sampling)
                 trace_id = self.headers.get("X-Shifu-Trace")
                 # the outcome-join key: caller-supplied, or minted here
@@ -360,9 +467,33 @@ def _make_handler(server: ServeServer):
                 req_id = self.headers.get("X-Shifu-Request")
                 if req_id is None and server.scorelog is not None:
                     req_id = os.urandom(8).hex()
-                scores = server.score(rows, bins, trace_id=trace_id,
-                                      req_id=req_id)
-                out = {"scores": [round(float(s), 6) for s in scores]}
+                if "records" in doc:           # raw-record path
+                    recs = doc["records"]
+                    if not isinstance(recs, list):
+                        self._reply(400, {"error": "records must be a "
+                                          "list of objects"})
+                        return
+                    got = server.score_raw(recs, trace_id=trace_id,
+                                           req_id=req_id)
+                    if got["errors"] and not any(
+                            s is not None for s in got["scores"]):
+                        self._reply(400, {**got, "error":
+                                          "no parseable records"})
+                        return
+                    out = {**got, "scores":
+                           [None if s is None else round(float(s), 6)
+                            for s in got["scores"]]}
+                else:
+                    rows = np.asarray(doc["rows"], np.float32)
+                    bins = doc.get("bins")
+                    if bins is not None:
+                        bins = np.asarray(bins, np.int32)
+                    scores = server.score(rows, bins, trace_id=trace_id,
+                                          req_id=req_id)
+                    out = {"scores": [round(float(s), 6)
+                                      for s in scores],
+                           "generation":
+                               server.registry.generation(server.key)}
                 if trace_id:
                     out["trace"] = trace_id
                 if req_id:
@@ -379,12 +510,18 @@ def _make_handler(server: ServeServer):
 
 def run_serve(model_set_dir: str, port: int = 8188,
               selfcheck: int = 0, max_delay_ms: Optional[float] = None,
-              buckets: Optional[Sequence[int]] = None) -> int:
+              buckets: Optional[Sequence[int]] = None,
+              replica: Optional[str] = None,
+              announce: Optional[str] = None) -> int:
     """The ``shifu-tpu serve`` entry.  ``selfcheck=N`` scores N synthetic
     rows in-process and exits (CI-friendly, no port); otherwise binds the
-    stdlib HTTP front-end on ``port`` until interrupted."""
+    stdlib HTTP front-end on ``port`` until interrupted.  A fleet worker
+    runs with ``replica`` (its fleet name, stamped on heartbeats) and
+    ``announce`` (a JSON file written after the bind with the actual
+    port + pid — ``port=0`` binds ephemeral, the router reads the file
+    to learn where)."""
     server = ServeServer(model_set_dir, max_delay_ms=max_delay_ms,
-                         buckets=buckets)
+                         buckets=buckets, replica=replica)
     server.start()
     try:
         scorer = server.registry.get(server.key)
@@ -405,7 +542,13 @@ def run_serve(model_set_dir: str, port: int = 8188,
         httpd = ThreadingHTTPServer(("127.0.0.1", port),
                                     _make_handler(server))
         bound = httpd.server_address[1]
-        print(f"shifu-tpu serve: {server.key} on http://127.0.0.1:{bound} "
+        if announce:
+            from ..ioutil import atomic_write_json
+            atomic_write_json(announce, {"port": int(bound),
+                                         "pid": os.getpid(),
+                                         "name": replica or server.key})
+        who = f"{server.key}/{replica}" if replica else server.key
+        print(f"shifu-tpu serve: {who} on http://127.0.0.1:{bound} "
               f"(buckets {list(scorer.buckets)}, "
               f"deadline {server.batcher.max_delay_s * 1000:.1f} ms)")
         try:

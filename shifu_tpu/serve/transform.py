@@ -1,0 +1,411 @@
+"""Fused raw-record transform — the norm pipeline as a jnp prelude.
+
+The offline path norms on host (``data/transform.DatasetTransformer`` →
+``ops/normalize.NormalizedColumn``) and ships pre-binned matrices to the
+serving plane, so no production caller can actually POST a raw record.
+This module compiles the SAME transform into the scorer's fused
+executable: per-column device constant tables are built once from the
+ColumnConfig snapshot, and the per-request work collapses to
+string→float parsing on host plus searchsorted/gather/affine math
+in-graph (the large-fused-graph argument: one XLA program instead of a
+Python pass per request).
+
+Bit-parity contract: for every norm type the device prelude reproduces
+``DatasetTransformer.transform`` EXACTLY —
+
+- every bin-index-only norm family (WoE, posrate/zscale categoricals,
+  DISCRETE, INDEX) is collapsed to ONE fused f64 table evaluated on host
+  by the offline code itself (``NormalizedColumn`` over the full bin-index
+  domain), so the device op is a plain gather of offline-produced values;
+- value-carrying families (ZSCALE/ZSCORE/HYBRID numerics, ASIS) run the
+  identical clip/affine in-graph with host-precomputed f64 bounds;
+- numeric binning is ``searchsorted(boundaries, v, side="right") - 1``
+  with the same clip and missing→num_bins fill as ``ColumnBinner``;
+- categorical string→index runs on host via the SAME ``ColumnBinner``
+  (strings cannot enter the graph), riding the packed wire format.
+
+Under x64 (the test/CI configuration) the prelude computes in float64
+and the output is bit-identical to the offline f64→f32 pipeline; on
+accelerators without x64 it computes in f32.
+
+Tables are held as NUMPY arrays and minted into the graph at trace time
+— a module-level jnp constant would leak as a tracer if the first import
+happens inside a trace (see ``ops/hashing._MASK16``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config.model_config import NormType, PrecisionType
+
+#: coded per-record rejection reasons (the ``-Dshifu.data.badThreshold``
+#: philosophy: one malformed record fails ITS OWN ticket, never the batch)
+ERR_BAD_RECORD = "bad_record"
+ERR_BAD_FIELD = "bad_field"
+
+_TABLE_TYPES = (
+    NormType.WOE, NormType.WEIGHT_WOE, NormType.WOE_INDEX,
+    NormType.WOE_ZSCORE, NormType.WOE_ZSCALE,
+    NormType.WEIGHT_WOE_ZSCORE, NormType.WEIGHT_WOE_ZSCALE,
+    NormType.WOE_ZSCALE_INDEX,
+    NormType.DISCRETE_ZSCORE, NormType.DISCRETE_ZSCALE,
+)
+
+
+@dataclass
+class _ColumnPlan:
+    """One input column's host+device recipe."""
+    name: str
+    categorical: bool
+    mode: str                      # onehot | table | asis | zscore
+    width: int
+    num_bins: int                  # binner bins; invalid/missing -> num_bins
+    binner: Any = None             # ColumnBinner (host side)
+    boundaries: Optional[np.ndarray] = None   # numeric split points (f64)
+    table: Optional[np.ndarray] = None        # fused bin->value map (f64)
+    mean: float = 0.0
+    std: float = 1.0
+    lo: float = 0.0                # z-score clip bounds (host f64 math,
+    hi: float = 0.0                # identical rounding to the offline pass)
+    zero: bool = False             # std ~ 0: the offline path emits zeros
+
+
+class FusedTransform:
+    """ColumnConfig snapshot -> packed wire format -> in-graph (x, bins).
+
+    Wire format: one ``[n, 3*C]`` float array per request —
+    ``vals | valid | bin-idx`` column triples — so the micro-batcher's
+    split/pad/concat machinery handles raw tickets unchanged and a
+    zero row (the pad filler) decodes as all-missing.
+    """
+
+    def __init__(self, model_config, column_configs,
+                 columns: Optional[Sequence] = None):
+        from ..data.transform import model_input_columns
+        from ..ops.binning import ColumnBinner
+        from ..ops.normalize import NormalizedColumn
+
+        import jax
+        self.mc = model_config
+        self.norm_type = model_config.normalize.normType
+        self.cutoff = model_config.normalize.stdDevCutOff
+        self.precision = model_config.normalize.precisionType
+        self.missing_values = list(
+            model_config.dataSet.missingOrInvalidValues or [])
+        self._x64 = bool(jax.config.jax_enable_x64)
+        cols = list(columns) if columns is not None else \
+            model_input_columns(model_config, column_configs)
+        if not cols:
+            raise ValueError("no input columns with binning stats — the "
+                             "raw path needs the stats+norm snapshot")
+        self.plan: List[_ColumnPlan] = [self._plan_column(
+            cc, NormalizedColumn(cc, self.norm_type, self.cutoff),
+            ColumnBinner) for cc in cols]
+        self.width = sum(p.width for p in self.plan)
+        # onehot columns emit >1 output column; the vectorized device
+        # path assumes width 1 everywhere, so their presence routes
+        # apply_device through the per-column fallback
+        self._has_onehot = any(p.mode == "onehot" for p in self.plan)
+        self._build_groups()
+
+    # ------------------------------------------------------------- build
+    def _plan_column(self, cc, nc, ColumnBinner) -> _ColumnPlan:
+        cat = cc.is_categorical()
+        t = self.norm_type
+        if cat:
+            binner = ColumnBinner(categories=cc.bin_category or [])
+            boundaries = None
+        else:
+            binner = ColumnBinner(boundaries=np.asarray(cc.bin_boundary)) \
+                if cc.bin_boundary else None
+            boundaries = None if binner is None else binner.boundaries
+        nb = binner.num_bins if binner is not None else 1
+        onehot = t == NormType.ONEHOT or \
+            (t == NormType.ZSCALE_ONEHOT and cat)
+        p = _ColumnPlan(name=cc.columnName, categorical=cat, mode="zscore",
+                        width=nc.width, num_bins=nb, binner=binner,
+                        boundaries=boundaries)
+        if onehot:
+            p.mode = "onehot"
+        elif cat or t in _TABLE_TYPES:
+            # the offline transform itself, evaluated over every index the
+            # binner can emit — the device gather replays it verbatim
+            p.mode = "table"
+            p.table = nc.bin_value_table(nb)
+        elif t in (NormType.ASIS_WOE, NormType.ASIS_PR):
+            p.mode = "asis"
+            p.mean = float(cc.mean())
+        else:
+            # ZSCALE/ZSCORE/OLD_*/HYBRID*/ZSCALE_ONEHOT-numeric/*_INDEX-numeric
+            mean, std = float(cc.mean()), cc.std_dev()
+            p.mean = mean
+            if std is None or std < 1e-5:
+                p.zero = True
+            else:
+                p.std = float(std)
+                p.lo = mean - self.cutoff * float(std)
+                p.hi = mean + self.cutoff * float(std)
+        return p
+
+    @classmethod
+    def from_dir(cls, model_set_dir: str) -> "FusedTransform":
+        """Build from a model-set directory's config snapshot (the same
+        files `norm`/`eval` read)."""
+        from ..config import ModelConfig, load_column_configs
+        mc = ModelConfig.load(os.path.join(model_set_dir,
+                                           "ModelConfig.json"))
+        ccs = load_column_configs(os.path.join(model_set_dir,
+                                               "ColumnConfig.json"))
+        return cls(mc, ccs)
+
+    # -------------------------------------------------------------- wire
+    @property
+    def n_columns(self) -> int:
+        return len(self.plan)
+
+    @property
+    def wire_width(self) -> int:
+        return 3 * len(self.plan)
+
+    @property
+    def wire_dtype(self) -> np.dtype:
+        return np.dtype(np.float64 if self._x64 else np.float32)
+
+    def parse_records(self, records: Sequence[Any]
+                      ) -> Tuple[np.ndarray, np.ndarray, List[Dict]]:
+        """JSON records -> (packed [m, 3C], kept row indices, errors).
+
+        A malformed record (non-object, or a non-scalar field value) is
+        rejected ALONE with a coded error; parseable records around it
+        still score.  Unparseable numeric STRINGS are not malformed —
+        they are the offline pipeline's missing/invalid values and norm
+        to the missing semantics, bit-identically.
+        """
+        from ..data.reader import parse_numeric
+        errors: List[Dict] = []
+        kept: List[int] = []
+        for i, rec in enumerate(records):
+            if not isinstance(rec, dict):
+                errors.append({"index": i, "code": ERR_BAD_RECORD,
+                               "error": "record must be an object of "
+                                        "{field: value}"})
+                continue
+            bad = next((k for k, v in rec.items() if v is not None and
+                        not isinstance(v, (str, int, float, bool))), None)
+            if bad is not None:
+                errors.append({"index": i, "code": ERR_BAD_FIELD,
+                               "error": f"field {bad!r} must be a scalar "
+                                        "value"})
+                continue
+            kept.append(i)
+        c = len(self.plan)
+        packed = np.zeros((len(kept), 3 * c), self.wire_dtype)
+        if kept:
+            for j, p in enumerate(self.plan):
+                vals = np.array([_field_str(records[i].get(p.name))
+                                 for i in kept], dtype=object)
+                if p.categorical:
+                    packed[:, 2 * c + j] = p.binner.bin_categorical(vals)
+                    packed[:, c + j] = 1.0
+                else:
+                    f, valid = parse_numeric(vals, self.missing_values)
+                    packed[:, j] = np.where(valid, f, 0.0)
+                    packed[:, c + j] = valid
+        return packed, np.asarray(kept, np.int64), errors
+
+    def _build_groups(self) -> None:
+        """Host-side column groups for the vectorized device path: the
+        per-column graph loop emits O(C) tiny ops XLA CPU fuses poorly
+        (measured 0.4-0.8x the pre-binned rate); grouping same-mode
+        columns collapses the transform to a handful of batched ops —
+        one vmapped searchsorted over padded boundaries, one padded
+        table gather, one broadcast z-score — with bit-identical
+        values (same elementwise IEEE ops, value-preserving column
+        permutation at the end).  All constants stay NUMPY here (the
+        tracer-leak rule, see the module docstring)."""
+        z_idx: List[int] = []    # zscore/zero columns (width 1)
+        t_idx: List[int] = []    # non-empty fused tables
+        t0_idx: List[int] = []   # empty tables -> zeros
+        a_idx: List[int] = []    # asis passthrough
+        bc_idx: List[int] = []   # bins: categorical (wire passthrough)
+        bn_idx: List[int] = []   # bins: numeric with boundaries
+        bu_idx: List[int] = []   # bins: numeric without a binner
+        for j, p in enumerate(self.plan):
+            (bc_idx if p.categorical else
+             bn_idx if p.boundaries is not None else bu_idx).append(j)
+            if p.mode == "onehot":
+                continue
+            if p.mode == "table":
+                (t_idx if len(p.table) else t0_idx).append(j)
+            elif p.mode == "asis":
+                a_idx.append(j)
+            else:
+                z_idx.append(j)
+        pl = self.plan
+        self._z_idx = np.asarray(z_idx, np.int32)
+        self._z_mean = np.asarray([pl[j].mean for j in z_idx], np.float64)
+        self._z_std = np.asarray([pl[j].std for j in z_idx], np.float64)
+        self._z_lo = np.asarray([pl[j].lo for j in z_idx], np.float64)
+        self._z_hi = np.asarray([pl[j].hi for j in z_idx], np.float64)
+        self._z_zero = np.asarray([pl[j].zero for j in z_idx], bool)
+        self._t_idx = np.asarray(t_idx, np.int32)
+        self._t_len = np.asarray([len(pl[j].table) for j in t_idx],
+                                 np.int32)
+        tmax = int(self._t_len.max()) if t_idx else 0
+        self._t_tab = np.zeros((len(t_idx), tmax), np.float64)
+        for k, j in enumerate(t_idx):
+            self._t_tab[k, :len(pl[j].table)] = pl[j].table
+        self._t0_idx = np.asarray(t0_idx, np.int32)
+        self._a_idx = np.asarray(a_idx, np.int32)
+        self._a_mean = np.asarray([pl[j].mean for j in a_idx], np.float64)
+        self._bc_idx = np.asarray(bc_idx, np.int32)
+        self._bn_idx = np.asarray(bn_idx, np.int32)
+        self._bn_nb = np.asarray([pl[j].num_bins for j in bn_idx],
+                                 np.int32)
+        bmax = max((len(pl[j].boundaries) for j in bn_idx), default=0)
+        # +inf pad: finite values always insert before the pad, so the
+        # padded searchsorted returns the unpadded column's index
+        self._bn_bounds = np.full((len(bn_idx), bmax), np.inf, np.float64)
+        for k, j in enumerate(bn_idx):
+            self._bn_bounds[k, :len(pl[j].boundaries)] = pl[j].boundaries
+        self._bu_idx = np.asarray(bu_idx, np.int32)
+        if not self._has_onehot:
+            self._x_inv = np.argsort(
+                np.concatenate([self._z_idx, self._t_idx, self._t0_idx,
+                                self._a_idx]))
+        self._bin_inv = np.argsort(
+            np.concatenate([self._bc_idx, self._bn_idx, self._bu_idx]))
+
+    # ------------------------------------------------------------ device
+    def apply_device(self, packed):
+        """TRACED: packed wire rows -> (x [n, width] f32, bins [n, C]
+        int32) — the whole norm transform as graph ops, fused by XLA
+        into the scorer executable that consumes it.  Same-mode columns
+        run as single batched ops (see :meth:`_build_groups`); onehot
+        plans take the per-column fallback."""
+        import jax
+        import jax.numpy as jnp
+        if self._has_onehot:
+            return self._apply_device_cols(packed)
+        cd = jnp.float64 if self._x64 else jnp.float32
+        c = len(self.plan)
+        n = packed.shape[0]
+        vals = packed[:, :c].astype(cd)
+        valid = packed[:, c:2 * c] != 0
+        cats = packed[:, 2 * c:3 * c].astype(jnp.int32)
+
+        bin_blocks = []
+        if len(self._bc_idx):
+            bin_blocks.append(cats[:, self._bc_idx])
+        if len(self._bn_idx):
+            v, ok = vals[:, self._bn_idx], valid[:, self._bn_idx]
+            bounds = jnp.asarray(self._bn_bounds, cd)
+            idx = jax.vmap(
+                lambda b, col: jnp.searchsorted(b, col, side="right"),
+                in_axes=(0, 1), out_axes=1)(bounds, v) - 1
+            nb = jnp.asarray(self._bn_nb)
+            idx = jnp.clip(idx, 0, nb[None, :] - 1)
+            bin_blocks.append(
+                jnp.where(ok, idx, nb[None, :]).astype(jnp.int32))
+        if len(self._bu_idx):
+            bin_blocks.append(
+                jnp.where(valid[:, self._bu_idx], 0, 1).astype(jnp.int32))
+        binm = bin_blocks[0] if len(bin_blocks) == 1 else \
+            jnp.concatenate(bin_blocks, axis=1)
+        bins = binm[:, self._bin_inv]
+
+        x_blocks = []
+        if len(self._z_idx):
+            v, ok = vals[:, self._z_idx], valid[:, self._z_idx]
+            mean = jnp.asarray(self._z_mean, cd)
+            filled = jnp.where(ok, v, mean[None, :])
+            lo = jnp.asarray(self._z_lo, cd)[None, :]
+            hi = jnp.asarray(self._z_hi, cd)[None, :]
+            std = jnp.asarray(self._z_std, cd)[None, :]
+            z = (jnp.clip(filled, lo, hi) - mean[None, :]) / std
+            x_blocks.append(jnp.where(self._z_zero[None, :], 0.0, z))
+        if len(self._t_idx):
+            idx = jnp.clip(bins[:, self._t_idx], 0,
+                           jnp.asarray(self._t_len)[None, :] - 1)
+            tab = jnp.asarray(self._t_tab, cd)
+            x_blocks.append(tab[jnp.arange(len(self._t_idx))[None, :],
+                                idx])
+        if len(self._t0_idx):
+            x_blocks.append(jnp.zeros((n, len(self._t0_idx)), cd))
+        if len(self._a_idx):
+            v, ok = vals[:, self._a_idx], valid[:, self._a_idx]
+            mean = jnp.asarray(self._a_mean, cd)
+            x_blocks.append(jnp.where(ok, v, mean[None, :]))
+        xm = x_blocks[0] if len(x_blocks) == 1 else \
+            jnp.concatenate(x_blocks, axis=1)
+        x = self._apply_precision(xm[:, self._x_inv], cd)
+        return x.astype(jnp.float32), bins
+
+    def _apply_device_cols(self, packed):
+        """Per-column fallback (onehot plans: output widths vary)."""
+        import jax
+        import jax.numpy as jnp
+        cd = jnp.float64 if self._x64 else jnp.float32
+        c = len(self.plan)
+        n = packed.shape[0]
+        vals = packed[:, :c].astype(cd)
+        valid = packed[:, c:2 * c] != 0
+        cats = packed[:, 2 * c:3 * c].astype(jnp.int32)
+        outs, bin_cols = [], []
+        for j, p in enumerate(self.plan):
+            v, ok = vals[:, j], valid[:, j]
+            if p.categorical:
+                bidx = cats[:, j]
+            elif p.boundaries is not None:
+                idx = jnp.searchsorted(jnp.asarray(p.boundaries, cd), v,
+                                       side="right") - 1
+                idx = jnp.clip(idx, 0, p.num_bins - 1)
+                bidx = jnp.where(ok, idx, p.num_bins).astype(jnp.int32)
+            else:
+                bidx = jnp.where(ok, 0, 1).astype(jnp.int32)
+            bin_cols.append(bidx)
+            if p.mode == "onehot":
+                idx = jnp.clip(bidx, 0, p.width - 1)
+                outs.append(jax.nn.one_hot(idx, p.width, dtype=cd))
+            elif p.mode == "table":
+                if len(p.table) == 0:
+                    outs.append(jnp.zeros((n, 1), cd))
+                else:
+                    tab = jnp.asarray(p.table, cd)
+                    outs.append(tab[jnp.clip(bidx, 0, len(p.table) - 1)]
+                                [:, None])
+            elif p.mode == "asis":
+                outs.append(jnp.where(ok, v, p.mean)[:, None])
+            elif p.zero:
+                outs.append(jnp.zeros((n, 1), cd))
+            else:            # zscore: clip to host-precomputed bounds
+                filled = jnp.where(ok, v, p.mean)
+                z = (jnp.clip(filled, p.lo, p.hi) - p.mean) / p.std
+                outs.append(z[:, None])
+        x = jnp.concatenate(outs, axis=1)
+        x = self._apply_precision(x, cd)
+        return x.astype(jnp.float32), jnp.stack(bin_cols, axis=1)
+
+    def _apply_precision(self, x, cd):
+        """In-graph twin of ``ops.normalize.apply_precision``."""
+        import jax.numpy as jnp
+        if self.precision == PrecisionType.FLOAT7:
+            return jnp.round(x, 7)
+        if self.precision == PrecisionType.FLOAT16:
+            return x.astype(jnp.float16).astype(cd)
+        if self.precision == PrecisionType.FLOAT32:
+            return x.astype(jnp.float32).astype(cd)
+        return x
+
+
+def _field_str(v) -> str:
+    """A JSON field value as the string the offline CSV reader would have
+    seen — the shared rule lives in :func:`data.reader.record_field_str`
+    so the offline parity oracle stringifies identically."""
+    from ..data.reader import record_field_str
+    return record_field_str(v)

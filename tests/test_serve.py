@@ -634,6 +634,13 @@ def test_compare_latency_class_lower_is_better(tmp_path, capsys):
     assert not is_tracked_throughput("serve_low_p99_ms")
     assert is_tracked_throughput("serve_qps_sustained")
     assert not is_tracked_throughput("serve_low_qps_offered")
+    # raw-serving + fleet extras: QPS-class metrics (and the scaling
+    # fraction) gate as throughput; the kill-drill p99 as latency
+    assert is_tracked_throughput("serve_raw_qps_frac")
+    assert is_tracked_throughput("serve_fleet_2r_qps")
+    assert is_tracked_throughput("serve_fleet_scaling_frac")
+    assert is_tracked_latency("serve_fleet_kill_p99_ms")
+    assert not is_tracked_throughput("serve_fleet_kill_p99_ms")
     old = {"metric": "serve_qps_sustained", "value": 100000.0,
            "extra": {"serve_low_p99_ms": 3.0, "serve_mid_p50_ms": 1.0,
                      "serve_deadline_ms": 2.0}}
@@ -694,3 +701,264 @@ def test_cli_serve_selfcheck_on_trained_modelset(prepared_set, capsys):
     with open(os.path.join(prepared_set, "serving", "serving.json")) as f:
         j = json.load(f)
     assert list(j.values())[0]["generation"] == 0
+
+
+# ------------------------------------------------- raw-record serving
+def _raw_configs():
+    """2 numeric ZSCALE columns + 1 categorical: the minimal mixed
+    ColumnConfig snapshot the fused transform has to replay exactly."""
+    from shifu_tpu.config import ColumnConfig
+    ccs = []
+    for j, name in enumerate(("a", "b")):
+        cc = ColumnConfig(columnNum=j, columnName=name, finalSelect=True)
+        cc.columnBinning.binBoundary = [float("-inf"), 0.0, 1.0]
+        cc.columnBinning.binCountNeg = [5, 5, 5]
+        cc.columnBinning.binCountPos = [2, 3, 4]
+        cc.columnBinning.binPosRate = [2 / 7., 3 / 8., 4 / 9.]
+        cc.columnBinning.binCountWoe = [0.1, -0.2, 0.3, 0.0]
+        cc.columnStats.mean = 0.4 + j
+        cc.columnStats.stdDev = 1.3
+        ccs.append(cc)
+    cc = ColumnConfig(columnNum=2, columnName="c", finalSelect=True)
+    cc.columnBinning.binCategory = ["red", "green", "blue"]
+    cc.columnBinning.binCountNeg = [4, 4, 4]
+    cc.columnBinning.binCountPos = [1, 2, 3]
+    cc.columnBinning.binPosRate = [.2, 1 / 3., 3 / 7.]
+    cc.columnBinning.binCountWoe = [0.05, -0.1, 0.2, 0.0]
+    ccs.append(cc)
+    return ccs
+
+
+#: raw records exercising every parse edge the offline reader has:
+#: missing field, unparseable numeric, unknown category, empty record,
+#: string-typed number, int-typed number
+_RAW_RECORDS = [
+    {"a": 0.5, "b": 1.5, "c": "green"},
+    {"a": None, "b": "not-a-number", "c": "chartreuse"},
+    {"a": -3, "b": 0.0, "c": "red"},
+    {},
+    {"a": "2.25", "b": 7, "c": "blue"},
+]
+
+
+def _offline_oracle(mc, ccs, models, records):
+    """The offline norm+eval pipeline over JSON records: stringify the
+    fields exactly as the CSV reader would, run the host
+    DatasetTransformer, score with the batch Scorer, mean-reduce in f32
+    — the bit-parity reference for ``score_raw``."""
+    import pandas as pd
+
+    from shifu_tpu.data.reader import RawChunk, record_field_str
+    from shifu_tpu.data.transform import DatasetTransformer
+    from shifu_tpu.eval.scorer import Scorer
+    tf = DatasetTransformer(mc, ccs)
+    names = [c.columnName for c in tf.columns]
+    data = pd.DataFrame({n: [record_field_str(r.get(n)) for r in records]
+                         for n in names}, dtype=object)
+    tc = tf.transform(RawChunk(columns=names, data=data))
+    res = Scorer(models).score(tc.x, bins=tc.bins)
+    return np.asarray(res.select("mean"), np.float32)
+
+
+def _raw_models(kind):
+    """A tiny ensemble over the 3-column transform output (x width 3,
+    bins width 3) for each model family the serve plane hosts."""
+    if kind == "nn":
+        return _nn_models(n=2, n_features=3)
+    if kind == "gbt":
+        from shifu_tpu.models.tree import (IndependentTreeModel,
+                                           TreeModelSpec)
+        from shifu_tpu.train.dt_trainer import DTSettings, train_gbt
+        rng = np.random.default_rng(7)
+        bins = rng.integers(0, 4, size=(256, 3)).astype(np.int32)
+        y = (rng.random(256) < 0.4).astype(np.float32)
+        res = train_gbt(bins, y, np.ones(256, np.float32), 5,
+                        np.zeros(3, bool),
+                        DTSettings(n_trees=3, depth=3, loss="log",
+                                   learning_rate=0.1))
+        spec = TreeModelSpec(n_trees=len(res.trees), depth=3, n_bins=5,
+                             **res.spec_kwargs)
+        return [IndependentTreeModel(spec, res.trees)]
+    from shifu_tpu.models.wdl import (IndependentWDLModel, WDLModelSpec)
+    from shifu_tpu.models.wdl import init_params as wdl_init
+    extra = {"num_feat_idx": [0, 1], "cat_col_idx": [2]}
+    cards = [6]
+    if kind == "wdl_hashed":
+        from shifu_tpu.ops.hashing import column_hash_key
+        extra = {**extra, "hash_buckets": 4, "hashed_cols": [0],
+                 "hash_keys": [column_hash_key(2)]}
+        cards = [4]
+    spec = WDLModelSpec(numeric_dim=2, cat_cardinalities=cards,
+                        embed_dim=4, hidden_nodes=[8],
+                        activations=["relu"], extra=extra)
+    return [IndependentWDLModel(spec, wdl_init(jax.random.PRNGKey(5),
+                                               spec))]
+
+
+@pytest.mark.parametrize("kind", ["nn", "gbt", "wdl", "wdl_hashed"])
+def test_raw_records_score_bit_identical_to_offline(kind):
+    """``score_raw`` over the fused transform is BIT-identical to the
+    offline norm+eval pipeline — across NN, GBT, WDL and hashed-ID WDL
+    ensembles, including missing/invalid/unknown-category records."""
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.serve.transform import FusedTransform
+    mc, ccs = ModelConfig(), _raw_configs()
+    models = _raw_models(kind)
+    want = _offline_oracle(mc, ccs, models, _RAW_RECORDS)
+    server = ServeServer(models=models, key="raw", buckets=(8,),
+                         transform=FusedTransform(mc, ccs))
+    out = server.score_raw(_RAW_RECORDS)
+    assert out["errors"] == []
+    got = np.asarray(out["scores"], np.float32)
+    assert got.tobytes() == want.tobytes()
+
+
+def test_raw_modelset_dir_parity_and_offline_oracle(tmp_path):
+    """End-to-end from a modelset DIRECTORY: ``ServeServer(dir)`` wires
+    the fused transform from the ModelConfig/ColumnConfig snapshot and
+    ``score_records_offline`` (the module-level oracle) agrees bitwise."""
+    from shifu_tpu.config import save_column_configs
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.models.nn import NNModelSpec, init_params, save_model
+    from shifu_tpu.pipeline.evaluate import score_records_offline
+    d = str(tmp_path)
+    ModelConfig().save(os.path.join(d, "ModelConfig.json"))
+    save_column_configs(_raw_configs(), os.path.join(d,
+                                                     "ColumnConfig.json"))
+    spec = NNModelSpec(input_dim=3, hidden_nodes=[4],
+                       activations=["tanh"])
+    os.makedirs(os.path.join(d, "models"))
+    for i in range(2):
+        save_model(os.path.join(d, "models", f"model{i}.nn"), spec,
+                   init_params(jax.random.PRNGKey(i), spec))
+    want = score_records_offline(d, _RAW_RECORDS)
+    server = ServeServer(d, key="m", buckets=(8,)).start()
+    try:
+        assert server.status()["accepts_raw"] is True
+        out = server.score_raw(_RAW_RECORDS)
+        got = np.asarray(out["scores"], np.float32)
+        assert got.tobytes() == want.tobytes()
+    finally:
+        server.stop()
+
+
+def test_raw_warmed_server_zero_recompiles():
+    """A warmed raw server performs ZERO recompiles over a randomized
+    record-count sweep — the fused-transform signature is part of the
+    warmed executable set, not a per-request compile."""
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.serve.transform import FusedTransform
+    server = ServeServer(models=_nn_models(n=2, n_features=3),
+                         key="raw", buckets=(1, 4, 16),
+                         transform=FusedTransform(ModelConfig(),
+                                                  _raw_configs()),
+                         max_delay_ms=0.0).start()
+    try:
+        rng = np.random.default_rng(13)
+        obs.set_enabled(True)
+        before = serve_recompile_count()
+        ctr = obs.counter("xla.recompiles")
+        xla_before = ctr.value
+        for n in rng.integers(1, 17, size=25):
+            recs = [{"a": float(rng.normal()), "b": float(rng.normal()),
+                     "c": ["red", "green", "blue", "?"][int(rng.integers(4))]}
+                    for _ in range(int(n))]
+            out = server.score_raw(recs)
+            assert all(s is not None for s in out["scores"])
+        assert serve_recompile_count() - before == 0
+        assert ctr.value - xla_before == 0
+    finally:
+        server.stop()
+
+
+def test_raw_malformed_records_rejected_per_record():
+    """One bad record never poisons its neighbours: non-object records
+    and non-scalar fields get coded errors + null score slots while the
+    parseable records around them score BIT-identically to a clean
+    batch (the ``-Dshifu.data.badThreshold`` philosophy, per request)."""
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.serve.transform import (ERR_BAD_FIELD, ERR_BAD_RECORD,
+                                           FusedTransform)
+    server = ServeServer(models=_nn_models(n=2, n_features=3),
+                         key="raw", buckets=(4,),
+                         transform=FusedTransform(ModelConfig(),
+                                                  _raw_configs()))
+    good = [{"a": 0.5, "b": 1.5, "c": "green"},
+            {"a": -1.0, "b": 0.25, "c": "red"}]
+    mixed = [good[0], 123, {"a": [1, 2], "b": 0.0, "c": "red"}, good[1]]
+    out = server.score_raw(mixed)
+    assert out["scores"][1] is None and out["scores"][2] is None
+    codes = {e["index"]: e["code"] for e in out["errors"]}
+    assert codes == {1: ERR_BAD_RECORD, 2: ERR_BAD_FIELD}
+    clean = server.score_raw(good)
+    assert clean["errors"] == []
+    got = np.asarray([out["scores"][0], out["scores"][3]], np.float32)
+    assert got.tobytes() == np.asarray(clean["scores"],
+                                       np.float32).tobytes()
+    # an all-bad request still answers (every slot null, every error
+    # coded) — the HTTP front-end maps this shape to a 400
+    allbad = server.score_raw([None, 7])
+    assert allbad["scores"] == [None, None]
+    assert len(allbad["errors"]) == 2
+
+
+def test_raw_http_records_healthz_and_all_bad_400(tmp_path):
+    """``POST /score {"records": ...}`` end-to-end on a loopback port:
+    partial rejection answers 200 with null slots + coded errors,
+    an all-bad payload answers 400, and ``GET /healthz`` advertises
+    ``accepts_raw`` (the bit the fleet router refuses to mix)."""
+    import threading
+    import urllib.error
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    from shifu_tpu.config import save_column_configs
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.models.nn import NNModelSpec, init_params, save_model
+    from shifu_tpu.serve.server import _make_handler
+    d = str(tmp_path)
+    ModelConfig().save(os.path.join(d, "ModelConfig.json"))
+    save_column_configs(_raw_configs(), os.path.join(d,
+                                                     "ColumnConfig.json"))
+    spec = NNModelSpec(input_dim=3, hidden_nodes=[4],
+                       activations=["tanh"])
+    os.makedirs(os.path.join(d, "models"))
+    save_model(os.path.join(d, "models", "model0.nn"), spec,
+               init_params(jax.random.PRNGKey(0), spec))
+    server = ServeServer(d, key="m", buckets=(4,),
+                         max_delay_ms=1.0).start()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _make_handler(server))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    def post(body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/score",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        return json.load(urllib.request.urlopen(req, timeout=15))
+    try:
+        doc = post({"records": [{"a": 0.5, "b": 1.5, "c": "green"},
+                                17]})
+        assert doc["scores"][0] is not None and doc["scores"][1] is None
+        assert doc["errors"][0]["index"] == 1
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"records": [17, None]})
+        assert ei.value.code == 400
+        health = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=15))
+        assert health["accepts_raw"] is True
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        server.stop()
+
+
+def test_prebinned_modelset_refuses_raw_and_reports_it():
+    """A models-only server (no ColumnConfig snapshot) advertises
+    ``accepts_raw: false`` and refuses ``score_raw`` with a pointed
+    error instead of scoring garbage."""
+    server = ServeServer(models=_nn_models(), key="pb", buckets=(4,))
+    assert server.status()["accepts_raw"] is False
+    with pytest.raises(ValueError, match="pre-binned"):
+        server.score_raw([{"a": 1.0}])

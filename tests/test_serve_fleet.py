@@ -1,0 +1,343 @@
+"""Multi-replica serving fleet suite (``pytest -m fleet``).
+
+The in-process tests run real ``ServeServer`` workers behind real
+loopback ``ThreadingHTTPServer`` listeners — the router sees genuine
+HTTP transport (connection refused on death, real concurrency during a
+coordinated swap) without subprocess boot cost, so they stay tier-1
+fast.  The subprocess SIGKILL drill (``spawn_worker`` + the
+``serve:replica`` fault site) is additionally marked ``slow``.
+
+Covers the fleet acceptance surface: health-aware balancing, requeue on
+replica death (every accepted request completes while any replica
+lives), the coordinated hot-swap's NO-mixed-model-window invariant
+under concurrent load, the ``-Dshifu.serve.canaryFrac`` slice, the
+mixed raw/pre-binned fleet refusal, and burial of an unreachable
+DRAINING replica at swap-prepare time.
+"""
+
+import json
+import os
+import threading
+import time
+from http.server import ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import jax
+
+from shifu_tpu import faults, obs
+from shifu_tpu.config import (ColumnConfig, environment,
+                              save_column_configs)
+from shifu_tpu.config.model_config import ModelConfig
+from shifu_tpu.models.nn import NNModelSpec, init_params, save_model
+from shifu_tpu.serve.router import (DEAD, DRAINING, UP, ServeRouter,
+                                    spawn_worker, wait_for_announce)
+from shifu_tpu.serve.server import ServeServer, _make_handler
+
+pytestmark = [pytest.mark.serve, pytest.mark.fleet]
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    environment.reset_for_tests()
+    faults.reset_for_tests()
+    yield
+    environment.reset_for_tests()
+    faults.reset_for_tests()
+    obs.set_enabled(False)
+
+
+def _modelset(d, n_models=2, seed0=0, subdir="models"):
+    """A raw-capable modelset on disk: 2 numeric ZSCALE columns + a tiny
+    NN ensemble (every fleet worker loads the same snapshot)."""
+    if not os.path.exists(os.path.join(d, "ModelConfig.json")):
+        ccs = []
+        for j, name in enumerate(("a", "b")):
+            cc = ColumnConfig(columnNum=j, columnName=name,
+                              finalSelect=True)
+            cc.columnBinning.binBoundary = [float("-inf"), 0.0, 1.0]
+            cc.columnBinning.binCountNeg = [5, 5, 5]
+            cc.columnBinning.binCountPos = [2, 3, 4]
+            cc.columnBinning.binPosRate = [2 / 7., 3 / 8., 4 / 9.]
+            cc.columnBinning.binCountWoe = [0.1, -0.2, 0.3, 0.0]
+            cc.columnStats.mean = 0.4 + j
+            cc.columnStats.stdDev = 1.3
+            ccs.append(cc)
+        ModelConfig().save(os.path.join(d, "ModelConfig.json"))
+        save_column_configs(ccs, os.path.join(d, "ColumnConfig.json"))
+    spec = NNModelSpec(input_dim=2, hidden_nodes=[4],
+                       activations=["tanh"])
+    md = os.path.join(d, subdir)
+    os.makedirs(md, exist_ok=True)
+    for i in range(n_models):
+        save_model(os.path.join(md, f"model{i}.nn"), spec,
+                   init_params(jax.random.PRNGKey(seed0 + i), spec))
+    return md
+
+
+class _Fleet:
+    """In-process workers behind real loopback HTTP listeners."""
+
+    def __init__(self):
+        self.workers = []        # (srv, httpd)
+        self.router = ServeRouter(poll_ms=100, stale_s=2)
+
+    def add(self, model_set_dir, name):
+        srv = ServeServer(model_set_dir, key="m", buckets=(4, 16),
+                          replica=name, max_delay_ms=1.0)
+        srv.registry.state_dir = None    # in-memory journal per worker
+        srv.start()
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                    _make_handler(srv))
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        self.workers.append((srv, httpd))
+        self.router.add_backend(name, httpd.server_address[1])
+        return srv, httpd
+
+    def up(self):
+        self.router.poll_once()
+        self.router.ensure_uniform()
+        return self.router.fleet_doc()
+
+    def kill_listener(self, httpd):
+        httpd.shutdown()
+        httpd.server_close()
+
+    def stop(self):
+        self.router.stop(kill_workers=False)
+        for srv, httpd in self.workers:
+            try:
+                httpd.shutdown()
+                httpd.server_close()
+            except OSError:
+                pass
+            srv.stop()
+
+
+@pytest.fixture
+def fleet():
+    f = _Fleet()
+    yield f
+    f.stop()
+
+
+_RECORDS = [{"a": 0.5, "b": 1.5}, {"a": None, "b": "?"}]
+
+
+# -------------------------------------------------------- basic routing
+def test_router_balances_and_reports_uniform_fleet(fleet, tmp_path):
+    d = str(tmp_path)
+    _modelset(d)
+    fleet.add(d, "r0")
+    fleet.add(d, "r1")
+    doc = fleet.up()
+    assert doc["up"] == 2 and doc["accepts_raw"] is True
+    base = fleet.router.score({"records": _RECORDS})["scores"]
+    assert base[0] is not None
+    for _ in range(9):
+        out = fleet.router.score({"records": _RECORDS})
+        assert out["scores"] == base      # same snapshot everywhere
+    reqs = {r.name: r.requests for r in fleet.router.replicas.values()}
+    assert all(v > 0 for v in reqs.values()), reqs
+
+
+def test_requeue_on_replica_death_completes_request(fleet, tmp_path):
+    """A replica whose transport dies mid-fleet never fails a request:
+    the router requeues on a peer and the answer is identical."""
+    d = str(tmp_path)
+    _modelset(d)
+    fleet.add(d, "r0")
+    _, h1 = fleet.add(d, "r1")
+    fleet.up()
+    base = fleet.router.score({"records": _RECORDS})["scores"]
+    obs.set_enabled(True)
+    before = obs.counter("serve.fleet_requeues").value
+    fleet.kill_listener(h1)
+    # r1 will be picked eventually; every request must still complete
+    for _ in range(6):
+        out = fleet.router.score({"records": _RECORDS})
+        assert out["replica"] == "r0" or out["scores"] == base
+    assert obs.counter("serve.fleet_requeues").value > before
+    assert fleet.router.replicas["r1"].state in (DRAINING, DEAD)
+
+
+def test_mixed_raw_prebinned_fleet_refused(fleet, tmp_path):
+    """``ensure_uniform`` refuses a fleet where one replica lacks the
+    transform snapshot — a raw request must never depend on which
+    replica it lands on."""
+    d = str(tmp_path)
+    _modelset(d)
+    naked = str(tmp_path / "naked")
+    os.makedirs(naked)
+    _modelset(naked)                       # then strip the snapshot
+    os.remove(os.path.join(naked, "ModelConfig.json"))
+    os.remove(os.path.join(naked, "ColumnConfig.json"))
+    fleet.add(d, "r0")
+    fleet.add(naked, "naked")
+    fleet.router.poll_once()
+    with pytest.raises(ValueError, match="accepts_raw"):
+        fleet.router.ensure_uniform()
+
+
+# ------------------------------------------------------ coordinated swap
+def test_coordinated_swap_has_no_mixed_model_window(fleet, tmp_path):
+    """Under concurrent load, for any two requests where a finished
+    before b started, gen(a) <= gen(b) — and both generations are
+    observed, so the invariant is tested against real traffic."""
+    d = str(tmp_path)
+    _modelset(d)
+    _modelset(d, seed0=100, subdir="models2")
+    fleet.add(d, "r0")
+    fleet.add(d, "r1")
+    fleet.up()
+    results, stop = [], threading.Event()
+
+    def pound():
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                o = fleet.router.score({"records": _RECORDS},
+                                       timeout=60)
+            except RuntimeError:
+                continue
+            results.append((t0, time.monotonic(), o["generation"]))
+
+    threads = [threading.Thread(target=pound, daemon=True)
+               for _ in range(3)]
+    [t.start() for t in threads]
+    time.sleep(0.25)
+    doc = fleet.router.coordinated_swap(os.path.join(d, "models2"))
+    time.sleep(0.25)
+    stop.set()
+    [t.join(timeout=30) for t in threads]
+    assert sorted(doc["committed"]) == ["r0", "r1"]
+    assert not doc.get("errors")
+    gens = {g for _, _, g in results}
+    assert gens == {0, 1}, gens
+    bad = [(a, b) for a in results for b in results
+           if a[1] < b[0] and a[2] > b[2]]
+    assert bad == [], f"{len(bad)} mixed-window pairs"
+
+
+def test_canary_swap_commits_only_a_slice(fleet, tmp_path):
+    d = str(tmp_path)
+    _modelset(d)
+    _modelset(d, seed0=100, subdir="models2")
+    fleet.add(d, "r0")
+    fleet.add(d, "r1")
+    fleet.up()
+    doc = fleet.router.coordinated_swap(os.path.join(d, "models2"),
+                                        canary=0.5)
+    assert len(doc["committed"]) == 1 and len(doc["aborted"]) == 1
+    gens = {r.generation for r in fleet.router.replicas.values()
+            if r.state == UP}
+    assert gens == {0, 1}                  # the explicit mixed slice
+
+
+def test_canary_frac_property_drives_default(fleet, tmp_path):
+    d = str(tmp_path)
+    _modelset(d)
+    _modelset(d, seed0=100, subdir="models2")
+    fleet.add(d, "r0")
+    fleet.add(d, "r1")
+    fleet.up()
+    environment.set_property("shifu.serve.canaryFrac", "0.5")
+    doc = fleet.router.coordinated_swap(os.path.join(d, "models2"))
+    assert len(doc["committed"]) == 1 and len(doc["aborted"]) == 1
+
+
+def test_swap_buries_unreachable_draining_replica(fleet, tmp_path):
+    """An unreachable DRAINING replica cannot veto the fleet's swap: it
+    is buried DEAD and skipped (it serves nothing, so no mixed window),
+    while the reachable fleet commits."""
+    d = str(tmp_path)
+    _modelset(d)
+    _modelset(d, seed0=100, subdir="models2")
+    fleet.add(d, "r0")
+    _, h1 = fleet.add(d, "r1")
+    fleet.up()
+    fleet.kill_listener(h1)
+    fleet.router.replicas["r1"].state = DRAINING
+    doc = fleet.router.coordinated_swap(os.path.join(d, "models2"))
+    assert doc["committed"] == ["r0"]
+    assert fleet.router.replicas["r1"].state == DEAD
+    out = fleet.router.score({"records": _RECORDS})
+    assert out["generation"] == 1
+
+
+def test_swap_prepare_failure_on_live_replica_aborts_all(fleet,
+                                                         tmp_path):
+    """A live replica failing PREPARE aborts the whole swap — the old
+    fleet keeps serving generation 0 everywhere (no partial commit)."""
+    d = str(tmp_path)
+    _modelset(d)
+    fleet.add(d, "r0")
+    fleet.add(d, "r1")
+    fleet.up()
+    with pytest.raises(RuntimeError, match="prepare"):
+        fleet.router.coordinated_swap(str(tmp_path / "nonexistent"))
+    out = fleet.router.score({"records": _RECORDS})
+    assert out["generation"] == 0
+    assert fleet.router.fleet_doc()["up"] == 2
+
+
+# ------------------------------------------------------------ fault site
+def test_serve_replica_fault_site_declared_and_scoped():
+    """The replica-death drill site exists and its point key is the
+    replica name — arming r0 must not touch r1's path."""
+    assert faults.is_declared_site("serve", "replica")
+    environment.set_property("shifu.faults", "serve:replica=r0:ioerror")
+    faults.reset_for_tests()
+    with pytest.raises(OSError):
+        faults.fire("serve", "replica", "r0")
+    faults.fire("serve", "replica", "r1")   # different replica: no-op
+    faults.fire("serve", "replica", "r0")   # fired once, now disarmed
+
+
+# ------------------------------------------------- subprocess kill drill
+@pytest.mark.slow
+def test_replica_sigkill_drill_requeues_and_buries(tmp_path):
+    """The real drill: two ``spawn_worker`` subprocesses, the
+    ``serve:replica`` fault hard-kills r0 on its first scoring request
+    (os._exit — a SIGKILL-equivalent), and the router requeues the
+    in-flight request on r1 so it still completes; the next poll
+    buries r0."""
+    d = str(tmp_path)
+    _modelset(d)
+    # forwarded to every worker as -Dshifu.faults; the point key scopes
+    # the kill to r0 only
+    environment.set_property("shifu.faults", "serve:replica=r0:kill")
+    fdir = os.path.join(d, "serving", "fleet")
+    os.makedirs(fdir, exist_ok=True)
+    router = ServeRouter(poll_ms=200, stale_s=5)
+    procs = {}
+    try:
+        for name in ("r0", "r1"):
+            ann = os.path.join(fdir, f"{name}.json")
+            p = spawn_worker(d, name, ann,
+                             extra_env={"JAX_PLATFORMS": "cpu"})
+            procs[name] = (p, ann)
+        for name, (p, ann) in procs.items():
+            doc = wait_for_announce(ann, p, timeout=240)
+            router.add_backend(name, doc["port"], proc=p)
+        router.poll_once()
+        router.ensure_uniform()
+        assert router.fleet_doc()["up"] == 2
+        # drive until r0 is picked and dies mid-request; every request
+        # must nevertheless complete (requeued on r1)
+        outs = [router.score({"records": [{"a": 0.5, "b": 1.5}]},
+                             timeout=120) for _ in range(4)]
+        assert all(o["scores"][0] is not None for o in outs)
+        assert procs["r0"][0].poll() is not None     # hard-died
+        assert {o["replica"] for o in outs} <= {"r0", "r1"}
+        router.poll_once()
+        assert router.replicas["r0"].state == DEAD
+        out = router.score({"records": [{"a": 0.5, "b": 1.5}]})
+        assert out["replica"] == "r1"
+    finally:
+        router.stop()
+        for p, _ in procs.values():
+            if p.poll() is None:
+                p.kill()
