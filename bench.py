@@ -31,8 +31,8 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--plane",
                     choices=("all", "tail", "rf-repeat", "e2e", "resume",
-                             "varsel", "serve", "multihost", "refresh",
-                             "quality"),
+                             "varsel", "serve", "fleet", "overload",
+                             "multihost", "refresh", "quality"),
                     default="all",
                     help="'tail' = quick disk-tail streamed-GBT bench; "
                          "'rf-repeat' = RF variance triage (cold-compile "
@@ -47,7 +47,15 @@ def main() -> None:
                          "selections; 'serve' = online-serving plane "
                          "(AOT padded-bucket scorer + micro-batcher: "
                          "sustained QPS, p50/p99 per offered load, "
-                         "zero-recompile guard); 'multihost' = elastic "
+                         "zero-recompile guard); 'fleet' = subprocess "
+                         "replica fleets behind the HTTP router "
+                         "(1/2/4-replica aggregate QPS + the replica-"
+                         "SIGKILL requeue drill); 'overload' = overload-"
+                         "protection plane (bounded-admission server at "
+                         "1x/2x/4x of measured saturation: goodput "
+                         "guarded >= 0.8x saturation at 2x offered "
+                         "load, coded sheds, zero hung clients); "
+                         "'multihost' = elastic "
                          "multi-controller plane (1/2/4-process quorum-"
                          "gated scaling curve + time-to-recover after a "
                          "mid-train controller kill); 'refresh' = "

@@ -84,7 +84,17 @@ from . import ioutil, obs
 # throughput suffix, and the replica-SIGKILL drill whose p99 rides the
 # lower-is-better latency class while every accepted request completes
 # by requeue).
-BENCH_TELEMETRY_SCHEMA = 12
+#
+# v13: overload protection — --plane overload drives a bounded-queue,
+# deadline-propagating server at 1x/2x/4x of its measured saturation
+# with an open-loop shed-tolerant client: serve_overload_goodput (the
+# 2x headline, tracked via the new *_goodput throughput suffix and
+# guarded >= SHIFU_BENCH_OVERLOAD_FLOOR x saturation QPS),
+# serve_overload_shed_frac, and serve_overload_p99_ms of ADMITTED
+# requests (lower-is-better latency class) — under overload the right
+# p99 is the one clients who got answers saw, sheds are coded
+# fast-fails counted separately.
+BENCH_TELEMETRY_SCHEMA = 13
 
 # measured on this rig (tools/measure_baseline.py); provenance in
 # BASELINE.md — every headline divides by a MEASURED reference-class
@@ -1767,6 +1777,202 @@ def bench_fleet(n_features: int = 8, n_models: int = 3,
     return rep
 
 
+# overload-plane acceptance: goodput at 2x the measured saturation must
+# hold this fraction of the saturation QPS (SHIFU_BENCH_OVERLOAD_FLOOR
+# overrides) — bounded admission + deadline sheds exist precisely so
+# excess offered load costs ~nothing, instead of collapsing throughput
+OVERLOAD_GOODPUT_FLOOR = 0.8
+# per-request budget while the overload windows run; the admission cap
+# is sized so queue wait alone cannot eat more than ~half of it
+OVERLOAD_DEADLINE_MS = 150.0
+
+
+def _serve_overload_load(batcher, pool: np.ndarray, qps: float,
+                         duration_s: float) -> Dict[str, Any]:
+    """Shed-tolerant open-loop client: same ideal-schedule arrivals as
+    :func:`_serve_open_loop`, but admission rejects (429-class) are
+    counted instead of fatal and deadline sheds surface as coded
+    :class:`DeadlineExceededError` at ``wait()``.  A ``TimeoutError``
+    is a HUNG client — the failure mode the overload plane exists to
+    rule out — and is counted separately so the guard can demand zero."""
+    from shifu_tpu.serve.overload import (DeadlineExceededError,
+                                          OverloadedError)
+    clock = batcher.clock
+    n_target = int(qps * duration_s)
+    period = 1.0 / qps
+    pool_n = len(pool)
+    tickets, sent, rejected = [], 0, 0
+    t0 = clock()
+    while sent < n_target:
+        due = min(n_target, int((clock() - t0) / period) + 1)
+        if due <= sent:
+            time.sleep(0.0002)
+            continue
+        idx = np.arange(sent, due)
+        try:
+            tickets.append(batcher.submit_burst(pool[idx % pool_n],
+                                                stamps=t0 + idx * period))
+        except OverloadedError:
+            rejected += len(idx)
+        sent = due
+    ok_lats, expired, hung = [], 0, 0
+    for t in tickets:
+        try:
+            t.wait(30.0)
+            ok_lats.append(t.latencies())
+        except DeadlineExceededError:
+            expired += t.n
+        except TimeoutError:
+            hung += t.n
+    wall = clock() - t0
+    completed = int(sum(len(ls) for ls in ok_lats))
+    return {
+        "offered": n_target, "rejected": int(rejected),
+        "expired": int(expired), "hung": int(hung),
+        "completed": completed, "goodput": completed / wall,
+        "lats": (np.concatenate(ok_lats) if ok_lats
+                 else np.zeros(0, np.float64)),
+    }
+
+
+def bench_overload(n_features: int = 32, n_models: int = 5,
+                   hidden: tuple = (64,),
+                   duration_s: float = 0.8) -> Dict[str, Any]:
+    """Overload-protection plane (``bench.py --plane overload``): the
+    serve plane's saturation QPS is measured unprotected, then the
+    admission cap (``maxQueueRows`` sized to ~half the deadline of queue
+    runway) and a per-request deadline are armed and the SAME server is
+    driven at 1x / 2x / 4x of that saturation by shed-tolerant open-loop
+    clients.
+
+    Saturation is measured with the SAME open-loop client the windows
+    use (unprotected, overdriven at the pipelined ceiling), so the
+    denominator isolates the protection penalty from client-pattern
+    differences.  Headline ``serve_overload_goodput`` = completed-
+    request QPS at the 2x window, tracked via the ``*_goodput``
+    throughput suffix and guarded >= ``SHIFU_BENCH_OVERLOAD_FLOOR`` x
+    the saturation QPS — under bounded admission, doubling offered
+    load may shed half the requests but must NOT collapse the rate of
+    answered ones.
+    ``serve_overload_p99_ms`` is the p99 of ADMITTED requests (the
+    lower-is-better latency class): under overload the meaningful tail
+    is the one clients who got answers saw; shed requests fast-fail
+    with coded errors and are counted in ``serve_overload_shed_frac``.
+    Three more guards: zero hung clients (every ticket resolves with a
+    score or a coded error), zero recompiles after warm, and the 4x
+    window must actually shed (a cap that never binds tests nothing)."""
+    import os
+
+    import jax
+
+    from shifu_tpu.models.nn import (IndependentNNModel, NNModelSpec,
+                                     init_params)
+    from shifu_tpu.serve import ServeServer, serve_recompile_count
+
+    spec = NNModelSpec(input_dim=n_features, hidden_nodes=list(hidden),
+                       activations=["relu"] * len(hidden), output_dim=1)
+    models = [IndependentNNModel(spec,
+                                 init_params(jax.random.PRNGKey(i), spec))
+              for i in range(n_models)]
+    server = ServeServer(models=models, key="bench").start()
+    batcher = server.batcher
+    scorer = server.registry.get("bench")
+    rng = np.random.default_rng(0)
+    pool = rng.normal(size=(4096, n_features)).astype(np.float32)
+    try:
+        for n in (1, 3, *scorer.buckets):
+            batcher.score_sync(pool[:n])
+        # pipelined ceiling (4 bursts outstanding, client blocked in
+        # wait): only the OVERDRIVE rate for the saturation window below
+        pipe_qps, _ = _serve_saturation(batcher, pool, duration_s / 2)
+        # the real denominator: what the SAME open-loop client drains
+        # with no deadline, overdriven past the pipelined ceiling.  A
+        # small queue bound (8 flushes of runway) keeps the client
+        # shedding and submitting for the WHOLE window — an unbounded
+        # queue would absorb the excess as backlog and then drain it
+        # after the client went quiet, inflating the denominator with
+        # interference-free QPS the protected windows never see
+        batcher.max_queue_rows = 8 * batcher._top_bucket()
+        batcher.default_deadline_s = 0.0
+        sat = _serve_overload_load(batcher, pool, pipe_qps,
+                                   duration_s)["goodput"]
+        recompiles0 = serve_recompile_count()
+        sheds0 = batcher.stats["shed_overload"] + \
+            batcher.stats["shed_expired"]
+        # arm the protection on the live batcher: queue runway = half
+        # the deadline at the measured drain rate (so queue wait alone
+        # can never eat the whole budget), deadline = the window knob
+        deadline_s = OVERLOAD_DEADLINE_MS / 1000.0
+        batcher.max_queue_rows = max(batcher._top_bucket(),
+                                     int(sat * deadline_s / 2.0))
+        batcher.default_deadline_s = deadline_s
+        import gc
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            res = {m: _serve_overload_load(batcher, pool, m * sat,
+                                           duration_s)
+                   for m in (1, 2, 4)}
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+        recompiles = serve_recompile_count() - recompiles0
+        sheds = batcher.stats["shed_overload"] + \
+            batcher.stats["shed_expired"] - sheds0
+    finally:
+        server.stop()
+
+    def shed_frac(r):
+        return (r["rejected"] + r["expired"]) / max(r["offered"], 1)
+
+    r2 = res[2]
+    rep: Dict[str, Any] = {
+        "serve_overload_sat_qps_offered": round(sat, 1),
+        "serve_overload_pipeline_qps_offered": round(pipe_qps, 1),
+        "serve_overload_goodput": round(r2["goodput"], 1),
+        "serve_overload_goodput_1x": round(res[1]["goodput"], 1),
+        "serve_overload_goodput_4x": round(res[4]["goodput"], 1),
+        "serve_overload_shed_frac": round(shed_frac(r2), 4),
+        "serve_overload_shed_frac_4x": round(shed_frac(res[4]), 4),
+        "serve_overload_p99_ms": round(
+            float(np.percentile(r2["lats"], 99)) * 1000.0, 3)
+        if len(r2["lats"]) else 0.0,
+        "serve_overload_hung": sum(r["hung"] for r in res.values()),
+        "serve_overload_deadline_ms": OVERLOAD_DEADLINE_MS,
+        "serve_overload_max_queue_rows": int(batcher.max_queue_rows),
+        "serve_recompiles_after_warm": int(recompiles),
+        "serve_overload_sheds": int(sheds),
+        "serve_overload_shape": f"{n_models} NN models {n_features}->"
+                                f"{list(hidden)}->1, open-loop 1x/2x/4x "
+                                f"of saturation, deadline "
+                                f"{OVERLOAD_DEADLINE_MS:.0f} ms, "
+                                f"{duration_s:.1f}s windows",
+    }
+    if rep["serve_overload_hung"]:
+        raise AssertionError(
+            f"{rep['serve_overload_hung']} overload-window request(s) "
+            "hung past the 30s client timeout — a shed MUST resolve its "
+            "ticket with a coded error, never leave the client waiting")
+    if recompiles > 0:
+        raise AssertionError(
+            f"warmed serve plane recompiled {recompiles}x across the "
+            "overload windows — shedding must not perturb the bucket "
+            "ladder")
+    if shed_frac(res[4]) <= 0.0:
+        raise AssertionError(
+            "4x offered load shed nothing — the admission cap never "
+            "bound, so the overload plane measured a no-op")
+    floor = float(os.environ.get("SHIFU_BENCH_OVERLOAD_FLOOR",
+                                 OVERLOAD_GOODPUT_FLOOR))
+    if r2["goodput"] < floor * sat:
+        raise AssertionError(
+            f"goodput at 2x offered load is {r2['goodput']:.0f} QPS vs "
+            f"{sat:.0f} saturation — below the {floor} floor "
+            "(SHIFU_BENCH_OVERLOAD_FLOOR); overload is collapsing "
+            "throughput instead of shedding it")
+    return rep
+
+
 # the score-log bench runs the same head-sampling rate as the trace
 # bench; scorelog-on QPS must hold this fraction of the scorelog-off
 # saturation QPS (the v11 overhead acceptance)
@@ -2267,6 +2473,7 @@ def is_tracked_throughput(name: str) -> bool:
             or name.endswith("_qps") or name.endswith("_qps_sustained")
             or name.endswith("_qps_frac")
             or name.endswith("_scaling_frac")
+            or name.endswith("_goodput")
             or name.endswith("_mfu") or name.endswith("_achieved_bw"))
 
 
@@ -2518,6 +2725,21 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
             "shape": rep["serve_fleet_shape"],
             "extra": rep,
         }
+    if plane == "overload":
+        with obs.span("bench.overload", kind="bench"):
+            rep = bench_overload()
+        for k, v in rep.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                obs.gauge(f"bench.{k}").set(float(v))
+        return {
+            "metric": "serve_overload_goodput",
+            "value": rep["serve_overload_goodput"],
+            "unit": "requests/sec",
+            "plane": "overload",
+            "telemetry_schema_version": BENCH_TELEMETRY_SCHEMA,
+            "shape": rep["serve_overload_shape"],
+            "extra": rep,
+        }
     if plane == "multihost":
         with obs.span("bench.multihost", kind="bench"):
             rep = bench_multihost()
@@ -2566,8 +2788,8 @@ def run_benchmark(plane: str = None) -> Dict[str, Any]:
     if plane not in (None, "all"):
         raise ValueError(
             f"unknown bench plane {plane!r} "
-            "(tail|rf-repeat|e2e|resume|varsel|serve|fleet|multihost|"
-            "refresh|quality|all)")
+            "(tail|rf-repeat|e2e|resume|varsel|serve|fleet|overload|"
+            "multihost|refresh|quality|all)")
     nn_cost: Dict[str, Any] = {}
     nn_rows_per_sec = bench_nn(collect=nn_cost)
     obs.gauge("bench.nn_train_throughput").set(nn_rows_per_sec)

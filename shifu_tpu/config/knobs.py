@@ -193,6 +193,26 @@ _DECLS: Tuple[Knob, ...] = (
     _k("shifu.serve.canaryFrac", "property", "float", "0",
        "coordinated-swap canary slice: commit ceil(frac*N) replicas, "
        "abort the rest (0 = commit the whole fleet)"),
+    _k("shifu.serve.maxQueueRows", "property", "int", "0",
+       "admission cap: queued rows beyond this fast-fail with a coded "
+       "429/overloaded (0 = auto, 128x the top bucket rung)"),
+    _k("shifu.serve.requestDeadlineMs", "property", "float", "0",
+       "default per-request deadline; expired tickets are shed before "
+       "pad/launch with a coded 504 (0 = none; X-Shifu-Deadline-Ms "
+       "overrides per request)"),
+    _k("shifu.serve.retryBudgetFrac", "property", "float", "0.1",
+       "router retry budget: requeues allowed per recent success "
+       "(token bucket; 0 = no retries)"),
+    _k("shifu.serve.hedgeMs", "property", "float", "0",
+       "hedged second dispatch after the router-observed p99 (this "
+       "value is the floor/fallback delay; 0 = hedging off)"),
+    _k("shifu.serve.breakerFailures", "property", "int", "3",
+       "consecutive transport/5xx failures that open a replica's "
+       "circuit breaker (half-open probe after cooldown; 0 = off)"),
+    _k("shifu.serve.brownout", "property", "bool", "true",
+       "brownout degradation: sustained SLO burn or queue buildup "
+       "flips the worker into a degraded mode (shrunk flush deadline, "
+       "sampling/refinement off) with hysteresis on recovery"),
     # ---- continual refresh plane (refresh/)
     _k("shifu.refresh.psiThreshold", "property", "float", "",
        "PSI breach that triggers a refresh cycle (default: "
@@ -281,6 +301,9 @@ _DECLS: Tuple[Knob, ...] = (
     _k("SHIFU_BENCH_FLEET_SCALING", "env", "float", "0.8",
        "bench --plane fleet: 2-replica aggregate-QPS scaling floor "
        "(qps_2r / (2 * qps_1r))"),
+    _k("SHIFU_BENCH_OVERLOAD_FLOOR", "env", "float", "0.8",
+       "bench --plane overload: goodput floor at 2x offered load as a "
+       "fraction of the measured saturation QPS"),
 )
 
 KNOBS: Dict[str, Knob] = {k.name: k for k in _DECLS}

@@ -48,6 +48,10 @@ Sites/points wired today (grep ``faults.fire`` for the live set):
                         replica-death drill: the router must drain the
                         dead backend and requeue un-launched tickets on
                         a peer so every accepted request completes
+    serve:admit=<k>     while the k-th shed submit is being rejected at
+                        the admission cap — an ioerror there must leave
+                        the queue depth and SLO shed accounting
+                        consistent; a kill is the die-during-shed drill
     obs:scorelog=<k>    before score-log segment k's atomic rotation
                         commit (the os.replace that drops the .open torn
                         marker) — a kill here leaves a torn final
@@ -108,6 +112,11 @@ SITES: dict = {
     ("serve", "replica"): "in a fleet worker's /score path before the "
                           "request enqueues — a kill is the replica-"
                           "death drill (router drains + requeues)",
+    ("serve", "admit"): "while shed #k is being rejected at the "
+                        "admission cap (queue at maxQueueRows) — an "
+                        "ioerror must leave the queue depth and the "
+                        "SLO shed accounting consistent; a kill is the "
+                        "die-during-shed drill",
     ("dcn", "step"): "at elastic step s's boundary, before this "
                      "controller's contribution commit — a kill here is "
                      "the worker-loss drill the quorum must mask",

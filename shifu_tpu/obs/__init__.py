@@ -74,7 +74,8 @@ from .tracer import (SCHEMA_VERSION, enabled, set_enabled,    # noqa: F401
 from .manifest import (MANIFEST, PREFIXES, SPANS,             # noqa: F401
                        SPAN_PREFIXES, is_declared, is_declared_span)
 from .slo import (SLOTracker, LogBins, LOG_BINS,              # noqa: F401
-                  quantile_from_counts, slo_objectives)
+                  quantile_from_counts, slo_objectives,
+                  BrownoutGovernor)
 from .health import (HeartbeatWriter, start_heartbeat,        # noqa: F401
                      read_health, classify, health_dir_for,
                      heartbeat_interval_s)
@@ -108,7 +109,7 @@ __all__ = [
     "is_declared_span",
     # SLO plane
     "SLOTracker", "LogBins", "LOG_BINS", "quantile_from_counts",
-    "slo_objectives",
+    "slo_objectives", "BrownoutGovernor",
     # health / monitor plane
     "HeartbeatWriter", "start_heartbeat", "read_health", "classify",
     "health_dir_for", "heartbeat_interval_s",

@@ -128,6 +128,21 @@ MANIFEST: Dict[str, Tuple[str, str]] = {
                                  "use)"),
     "serve.batch_latency_ms": ("histogram",
                                "oldest-request latency per batch"),
+    # ---- overload protection (serve/overload; bounded admission +
+    # deadline shedding + brownout)
+    "serve.shed_overload": ("counter",
+                            "submits rejected at the maxQueueRows "
+                            "admission cap (coded 429/overloaded)"),
+    "serve.shed_expired": ("counter",
+                           "queued requests shed because their deadline "
+                           "passed before pad/launch (coded 504)"),
+    "serve.cancelled": ("counter",
+                        "client-abandoned tickets (wait timed out) shed "
+                        "from the queue before launch"),
+    "serve.mode": ("gauge",
+                   "serving mode: 0 normal, 1 brownout (degraded under "
+                   "sustained burn/queue stress)"),
+    "serve.brownouts": ("counter", "brownout-mode entries (lifetime)"),
     # ---- raw-record serving (serve/transform fused into the scorer)
     "serve.raw_requests": ("counter",
                            "raw-record scoring requests accepted "
@@ -152,6 +167,15 @@ MANIFEST: Dict[str, Tuple[str, str]] = {
     "serve.fleet_swaps": ("counter",
                           "coordinated fleet-wide hot-swaps driven "
                           "through the router"),
+    "serve.fleet_hedges": ("counter",
+                           "hedged second dispatches fired after the "
+                           "p99 hedge delay (first response wins)"),
+    "serve.fleet_breaker_opens": ("counter",
+                                  "replica circuit breakers opened on "
+                                  "consecutive transport/5xx failures"),
+    "serve.fleet_retry_denied": ("counter",
+                                 "requeues shed because the retry "
+                                 "budget was exhausted (coded 429)"),
     # ---- live SLO plane (obs/slo; mirrored into metrics.prom each beat)
     "slo.p50_ms": ("gauge", "sliding-window latency p50 (log sketch)"),
     "slo.p99_ms": ("gauge", "sliding-window latency p99 (log sketch)"),

@@ -145,6 +145,8 @@ def _row_flags(rec: Dict[str, Any]) -> str:
         flags += f"  << SLO BURN ({burns})"
     if rec.get("queue_buildup"):
         flags += "  << QUEUE BUILDUP"
+    if rec.get("mode") == "brownout":
+        flags += "  << BROWNOUT"
     if (rec.get("quality") or {}).get("degraded"):
         flags += "  << QUALITY DEGRADED"
     return flags

@@ -154,6 +154,12 @@ class SLOTracker:
         self._err = np.zeros(self.n_windows, np.int64)
         self._over = np.zeros(self.n_windows, np.int64)
         self._win_no = np.full(self.n_windows, -1, np.int64)
+        # load-shed accounting (overload protection): sheds are counted
+        # SEPARATELY from errors — a coded fast-fail is the designed
+        # response to overload, and folding it into availability burn
+        # would drain every replica exactly when the fleet most needs
+        # them serving (congestion collapse by alerting)
+        self._shed = 0
 
     # ------------------------------------------------------------ writes
     def _slot(self, now: float) -> int:
@@ -187,6 +193,17 @@ class SLOTracker:
         now = self.clock() if now is None else now
         with self._lock:
             self._err[self._slot(now)] += int(n)
+
+    def record_shed(self, n: int = 1) -> None:
+        """Count load-shed requests (admission rejects, deadline drops,
+        client cancels) — deliberately OUTSIDE the availability budget;
+        see the constructor comment."""
+        with self._lock:
+            self._shed += int(n)
+
+    @property
+    def shed_total(self) -> int:
+        return self._shed
 
     # ------------------------------------------------------------- reads
     def _merged(self, horizon_s: Optional[float],
@@ -279,6 +296,7 @@ class SLOTracker:
             }
         doc["alerts"] = self.alerts(now=now)
         doc["alerting"] = bool(doc["alerts"])
+        doc["shed"] = self._shed
         return doc
 
     def compact(self, now: Optional[float] = None) -> Dict[str, Any]:
@@ -316,3 +334,55 @@ class SLOTracker:
         registry.gauge("slo.burn_rate_short").set(max(burn_s.values()))
         registry.gauge("slo.burn_rate_long").set(max(burn_l.values()))
         registry.gauge("slo.alerts_firing").set(len(self.alerts(now=now)))
+
+
+# hysteresis: consecutive stressed evaluations before brownout engages,
+# consecutive healthy ones before it lifts.  Exit is slower than entry
+# on purpose — flapping in and out of degraded mode is worse than
+# staying degraded one beat too long.
+BROWNOUT_ENTER_CHECKS = 2
+BROWNOUT_EXIT_CHECKS = 3
+
+NORMAL, BROWNOUT = "normal", "brownout"
+
+
+class BrownoutGovernor:
+    """Hysteresis state machine behind the serving brownout mode.
+
+    The worker evaluates one boolean per heartbeat — *stressed* =
+    sustained burn-rate alert OR queue buildup — and feeds it to
+    :meth:`check`; the governor debounces it into a ``normal`` <->
+    ``brownout`` mode with asymmetric hysteresis (enter after
+    ``enter_checks`` consecutive stressed beats, exit after
+    ``exit_checks`` consecutive healthy ones).  The POLICY of what
+    brownout suspends lives in the server (shrink the flush deadline,
+    stop trace/score-log sampling and ladder refinement); this class
+    only decides WHEN."""
+
+    def __init__(self, enter_checks: int = BROWNOUT_ENTER_CHECKS,
+                 exit_checks: int = BROWNOUT_EXIT_CHECKS):
+        self.enter_checks = max(1, int(enter_checks))
+        self.exit_checks = max(1, int(exit_checks))
+        self.mode = NORMAL
+        self.entries = 0                 # lifetime brownout entries
+        self._stressed_run = 0
+        self._healthy_run = 0
+
+    def check(self, stressed: bool) -> bool:
+        """Fold one evaluation in; True when the MODE just changed."""
+        if stressed:
+            self._stressed_run += 1
+            self._healthy_run = 0
+        else:
+            self._healthy_run += 1
+            self._stressed_run = 0
+        if self.mode == NORMAL \
+                and self._stressed_run >= self.enter_checks:
+            self.mode = BROWNOUT
+            self.entries += 1
+            return True
+        if self.mode == BROWNOUT \
+                and self._healthy_run >= self.exit_checks:
+            self.mode = NORMAL
+            return True
+        return False

@@ -103,7 +103,18 @@ from typing import Any, Dict, List, Optional
 # router's balancing/death/coordinated-swap beat), fleet worker
 # heartbeats ride proc ``serve-<key>-<replica>``, and the bench's
 # ``serve_raw_qps_frac`` + ``--plane fleet`` extras
-SCHEMA_VERSION = 12
+# v13: overload protection — ``serve.shed_overload`` /
+# ``serve.shed_expired`` / ``serve.cancelled`` counters (every shed is
+# a coded fast-fail, never a silent drop), ``serve.mode`` gauge +
+# ``serve.brownouts`` counter (brownout degradation, also a SERVE
+# heartbeat ``mode`` extra and the monitor's ``<< BROWNOUT`` flag),
+# ``serve.fleet_hedges`` / ``serve.fleet_breaker_opens`` /
+# ``serve.fleet_retry_denied`` counters (the router's hedged-dispatch /
+# circuit-breaker / retry-budget beat), SLO summaries carry a ``shed``
+# total OUTSIDE availability burn, and the bench's ``--plane overload``
+# extras (``serve_overload_goodput`` tracked via the new ``*_goodput``
+# throughput suffix, ``serve_overload_p99_ms``, shed fractions)
+SCHEMA_VERSION = 13
 
 _TRUE = ("1", "true", "on", "yes")
 

@@ -16,6 +16,20 @@ Fault site: ``serve:request=<batch#>`` fires before batch ``<batch#>``'s
 device launch — an ``ioerror`` there fails exactly that batch's tickets
 (the error propagates to the waiting callers) and must leave the scorer
 and registry fully serviceable for the next request.
+``serve:admit=<shed#>`` fires while the <shed#>-th submit is being
+rejected at the admission cap — the die-during-shed drill.
+
+Overload protection (:mod:`shifu_tpu.serve.overload`): admission is
+BOUNDED — ``-Dshifu.serve.maxQueueRows`` (0 = auto, 128x the top rung)
+caps queued rows, and a submit that would exceed it fast-fails with a
+coded :class:`OverloadedError` carrying a ``Retry-After`` derived from
+the drain-rate EWMA the launch path maintains.  Requests carry a
+DEADLINE (``deadline_ms=`` / ``-Dshifu.serve.requestDeadlineMs``,
+measured from the ideal arrival stamp); :meth:`pump` sheds tickets
+whose deadline already passed — and tickets the client abandoned via a
+:meth:`Ticket.wait` timeout — BEFORE pad/launch, so dead work never
+reaches the device and the shed caller gets a coded
+:class:`DeadlineExceededError`, never a silently-dropped result.
 
 Per-request tracing (head-sampled, ``-Dshifu.serve.traceSampleRate``,
 default 0 = off): a sampled request carries a trace id from submit
@@ -53,6 +67,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import faults, obs
+from .overload import (AUTO_QUEUE_BUCKETS, DeadlineExceededError,
+                       OverloadedError, configured_deadline_s,
+                       configured_max_queue_rows)
 from .scorer import AOTScorer, covering_bucket, refine_ladder
 
 log = logging.getLogger(__name__)
@@ -111,11 +128,12 @@ class Ticket:
     the per-request cost at high load is an array append."""
 
     __slots__ = ("n", "stamps", "scores", "done_ts", "_pending", "_event",
-                 "error", "_lock", "trace", "req")
+                 "error", "_lock", "trace", "req", "deadline", "cancelled")
 
     def __init__(self, n: int, stamps: np.ndarray,
                  trace: Optional[_ReqTrace] = None,
-                 req: Optional[str] = None):
+                 req: Optional[str] = None,
+                 deadline: Optional[float] = None):
         self.n = n
         self.stamps = stamps                  # arrival time per row
         self.scores = np.empty(n, np.float32)
@@ -126,6 +144,8 @@ class Ticket:
         self.error: Optional[BaseException] = None
         self.trace = trace                    # sampled requests only
         self.req = req                        # score-log join id
+        self.deadline = deadline              # absolute batcher-clock time
+        self.cancelled = False                # client abandoned the wait
 
     def _complete(self, sl: slice, scores: Optional[np.ndarray],
                   now: float, error: Optional[BaseException]) -> None:
@@ -140,10 +160,19 @@ class Ticket:
         if done:
             self._event.set()
 
+    def cancel(self) -> None:
+        """Mark the ticket abandoned: ``pump()`` sheds its still-queued
+        rows through the expired-ticket path instead of scoring work
+        whose result nobody will read (counted as ``serve.cancelled``)."""
+        self.cancelled = True
+
     def wait(self, timeout: Optional[float] = None) -> np.ndarray:
         """Block until every row is scored; raises the batch error if
-        the request died with its batch."""
+        the request died with its batch.  A timeout CANCELS the ticket —
+        the client is gone, so its queued rows shed instead of being
+        scored into the void."""
         if not self._event.wait(timeout):
+            self.cancel()
             raise TimeoutError("scoring request timed out")
         if self.error is not None:
             raise self.error
@@ -189,11 +218,19 @@ class MicroBatcher:
         self._batches = 0
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+        # overload protection: bounded admission (0 = auto at submit
+        # time, AUTO_QUEUE_BUCKETS x the top rung) + default deadline
+        # (0 = none) + the drain-rate EWMA behind Retry-After
+        self.max_queue_rows = configured_max_queue_rows()
+        self.default_deadline_s = configured_deadline_s()
+        self._drain_rate = 0.0            # rows/s EWMA across launches
+        self._last_launch_t: Optional[float] = None
         # telemetry-independent accounting (the bench reads this; the
         # same numbers mirror into obs counters when telemetry is on)
         self.stats: Dict[str, float] = {
             "requests": 0, "rows": 0, "batches": 0, "rows_padded": 0,
-            "flush_full": 0, "flush_deadline": 0, "errors": 0}
+            "flush_full": 0, "flush_deadline": 0, "errors": 0,
+            "shed_overload": 0, "shed_expired": 0, "cancelled": 0}
         self.bucket_counts: Dict[int, int] = {}
         # real batch row-counts (rows -> batches): the occupancy-driven
         # ladder refinement's evidence (refine_ladder); keys are bounded
@@ -210,20 +247,22 @@ class MicroBatcher:
     def submit(self, row: np.ndarray, bins: Optional[np.ndarray] = None,
                stamp: Optional[float] = None,
                trace_id: Optional[str] = None,
-               req_id: Optional[str] = None) -> Ticket:
+               req_id: Optional[str] = None,
+               deadline_ms: Optional[float] = None) -> Ticket:
         """One single-record scoring request."""
         return self.submit_burst(
             np.asarray(row, np.float32)[None, :],
             None if bins is None else np.asarray(bins)[None, :],
             stamps=None if stamp is None else np.asarray([stamp]),
-            trace_id=trace_id, req_id=req_id)
+            trace_id=trace_id, req_id=req_id, deadline_ms=deadline_ms)
 
     def submit_burst(self, rows: np.ndarray,
                      bins: Optional[np.ndarray] = None,
                      stamps: Optional[np.ndarray] = None,
                      trace_id: Optional[str] = None,
                      req_id: Optional[str] = None,
-                     raw: bool = False) -> Ticket:
+                     raw: bool = False,
+                     deadline_ms: Optional[float] = None) -> Ticket:
         """A burst of concurrent single-record requests (an open-loop
         load generator's arrivals for one tick) — one queue append, one
         shared ticket.  ``stamps`` lets the generator record IDEAL
@@ -235,29 +274,69 @@ class MicroBatcher:
         delayed-outcome join key for this burst.  ``raw=True`` marks
         ``rows`` as PACKED raw-record wire rows (``serve/transform.py``)
         — they flush through the fused transform+score executable and
-        never share a launch with pre-binned rows."""
+        never share a launch with pre-binned rows.  ``deadline_ms``
+        (the ``X-Shifu-Deadline-Ms`` header; default the
+        ``requestDeadlineMs`` property, 0 = none) is the request's
+        budget measured from its ideal arrival stamp — an expired
+        ticket sheds in :meth:`pump` with a coded error.
+
+        Raises :class:`OverloadedError` (coded 429 + Retry-After) when
+        the queue is at the admission cap — a burst larger than the cap
+        is still admitted into an EMPTY queue, so oversized requests
+        stay serviceable."""
         n = len(rows)
         if stamps is None:
             stamps = np.full(n, self.clock())
+        st = np.asarray(stamps, np.float64)
+        dl_s = (self.default_deadline_s if deadline_ms is None
+                else max(0.0, float(deadline_ms)) / 1000.0)
+        deadline = float(st.min()) + dl_s if dl_s > 0.0 else None
         trace = None
         if trace_id is not None or (
                 self.trace_sample_rate > 0.0 and obs.enabled()
                 and self._trace_rng.random() < self.trace_sample_rate):
             trace = _ReqTrace(trace_id or _mint_trace_id())
             obs.counter("serve.trace_sampled").inc()
-        t = Ticket(n, np.asarray(stamps, np.float64), trace=trace,
-                   req=req_id)
+        t = Ticket(n, st, trace=trace, req=req_id, deadline=deadline)
+        shed_no = None
         with self._cond:
             if self._stop:
                 raise RuntimeError("batcher is stopped")
-            self._queue.append((t, rows, bins, 0, raw))
-            self._queued_rows += n
-            # one accepted request per submit call; row volume is the
-            # separate "rows" / serve.rows_scored accounting
-            self.stats["requests"] += 1
-            self._cond.notify_all()
+            cap = self.max_queue_rows \
+                or AUTO_QUEUE_BUCKETS * self._top_bucket()
+            if self._queued_rows and self._queued_rows + n > cap:
+                self.stats["shed_overload"] += 1
+                shed_no = int(self.stats["shed_overload"])
+                retry_after = self._retry_after_s()
+            else:
+                self._queue.append((t, rows, bins, 0, raw))
+                self._queued_rows += n
+                # one accepted request per submit call; row volume is
+                # the separate "rows" / serve.rows_scored accounting
+                self.stats["requests"] += 1
+                self._cond.notify_all()
+        if shed_no is not None:
+            obs.counter("serve.shed_overload").inc()
+            if self.slo is not None:
+                self.slo.record_shed()
+            # the die-during-shed drill: an ioerror here surfaces
+            # INSTEAD of the coded rejection and must leave the queue
+            # depth and SLO shed accounting exactly as recorded above
+            faults.fire("serve", "admit", shed_no)
+            raise OverloadedError(
+                f"queue at admission cap ({cap} rows); retry in "
+                f"{retry_after:.3f}s", retry_after_s=retry_after)
         obs.counter("serve.requests").inc()
         return t
+
+    def _retry_after_s(self) -> float:
+        """Time for the drain-rate EWMA to absorb the current queue —
+        the 429 Retry-After hint.  Caller holds the lock."""
+        if self._drain_rate > 0.0:
+            est = self._queued_rows / self._drain_rate
+        else:
+            est = max(self.max_delay_s * 2.0, 0.01)
+        return min(max(est, 0.001), 30.0)
 
     def score_sync(self, rows: np.ndarray,
                    bins: Optional[np.ndarray] = None,
@@ -281,17 +360,30 @@ class MicroBatcher:
         return float(self._queue[0][0].stamps[self._queue[0][3]]) \
             if self._queue else None
 
-    def _take(self, max_rows: int) -> List[Tuple[Ticket, np.ndarray,
-                                                 Optional[np.ndarray],
-                                                 int, bool]]:
+    def _take(self, max_rows: int, now: Optional[float] = None
+              ) -> Tuple[List[Tuple[Ticket, np.ndarray,
+                                    Optional[np.ndarray], int, bool]],
+                         List[Tuple[Ticket, int, int]]]:
         """Pop up to ``max_rows`` rows off the queue head (splitting a
         burst when it straddles the boundary).  Stops at a raw/pre-binned
-        kind boundary — one launch, one executable family.  Caller holds
-        the lock."""
-        out, taken = [], 0
+        kind boundary — one launch, one executable family.  Expired or
+        client-cancelled tickets met on the way are SHED, not taken —
+        returned as ``(ticket, offset, remaining_rows)`` so the caller
+        can complete them with a coded error OUTSIDE the lock, before
+        any pad/launch work is spent on them.  Caller holds the lock."""
+        out, shed, taken = [], [], 0
         kind: Optional[bool] = None
         while self._queue and taken < max_rows:
             t, rows, bins, off, raw = self._queue[0]
+            if t.cancelled or (now is not None and t.deadline is not None
+                               and t.deadline <= now):
+                self._queue.popleft()
+                remaining = len(rows) - off
+                self._queued_rows -= remaining
+                shed.append((t, off, remaining))
+                key = "cancelled" if t.cancelled else "shed_expired"
+                self.stats[key] += 1
+                continue
             if kind is None:
                 kind = raw
             elif raw != kind:
@@ -307,7 +399,7 @@ class MicroBatcher:
             if take < avail:
                 self._queue.appendleft((t, rows, bins, off + take, raw))
         self._queued_rows -= taken
-        return out
+        return out, shed
 
     def pump(self, now: Optional[float] = None, force: bool = False) -> int:
         """In-process drain: flush ONE batch if a flush condition holds
@@ -322,11 +414,31 @@ class MicroBatcher:
             deadline_hit = now - self._oldest_stamp() >= self.max_delay_s
             if not (full or deadline_hit or force):
                 return 0
-            parts = self._take(self._top_bucket())
-            self.stats["flush_full" if full else "flush_deadline"] += 1
-            obs.counter("serve.flush_full" if full
-                        else "serve.flush_deadline").inc()
+            parts, shed = self._take(self._top_bucket(), now=now)
+            if parts:
+                self.stats["flush_full" if full else "flush_deadline"] += 1
             obs.gauge("serve.queue_depth").set(self._queued_rows)
+        if shed:
+            # coded fast-fail BEFORE pad/launch: the device never sees
+            # expired/abandoned work, the client never sees silence
+            n_cancelled = sum(1 for t, _, _ in shed if t.cancelled)
+            if n_cancelled:
+                obs.counter("serve.cancelled").inc(n_cancelled)
+            if len(shed) > n_cancelled:
+                obs.counter("serve.shed_expired").inc(
+                    len(shed) - n_cancelled)
+            if self.slo is not None:
+                self.slo.record_shed(len(shed))
+            err = DeadlineExceededError(
+                "request deadline passed before its rows launched")
+            for t, off, remaining in shed:
+                t._complete(slice(off, off + remaining), None, now, err)
+        if not parts:
+            return 0
+        if full:
+            obs.counter("serve.flush_full").inc()
+        else:
+            obs.counter("serve.flush_deadline").inc()
         return self._launch(parts, reason="full" if full
                             else ("deadline" if deadline_hit else "forced"))
 
@@ -418,6 +530,15 @@ class MicroBatcher:
             off += len(r)
         pad = bucket - n
         with self._cond:
+            # drain-rate EWMA (rows/s across launch completions): the
+            # admission path's Retry-After estimate
+            if self._last_launch_t is not None:
+                dt = now - self._last_launch_t
+                if dt > 0:
+                    inst = n / dt
+                    self._drain_rate = inst if self._drain_rate == 0.0 \
+                        else 0.7 * self._drain_rate + 0.3 * inst
+            self._last_launch_t = now
             self.stats["batches"] += 1
             self.stats["rows"] += n
             self.stats["rows_padded"] += pad

@@ -17,6 +17,23 @@ router in front:
   request whose connection dies mid-flight (the worker was SIGKILLed —
   the ``serve:replica`` fault site's drill) is requeued on a peer; every
   accepted request completes as long as one replica survives.
+- **Overload resilience** (:mod:`shifu_tpu.serve.overload`): requeues
+  spend a token-bucket RETRY BUDGET (``-Dshifu.serve.retryBudgetFrac``
+  of recent successes) — an exhausted budget sheds the request with a
+  coded 429 instead of amplifying a dying fleet's load; each replica
+  carries a CIRCUIT BREAKER (``-Dshifu.serve.breakerFailures``
+  consecutive transport/5xx failures open it, a half-open probe after a
+  cooldown closes it) so dispatch stops hammering a sick backend before
+  the health poll notices; with ``-Dshifu.serve.hedgeMs`` > 0 a request
+  still unanswered after the router-observed p99 delay is HEDGED onto a
+  second replica — first response wins, the loser is ignored (scoring
+  is idempotent).  A caller deadline (``deadline_ms`` /
+  ``X-Shifu-Deadline-Ms``) rides every dispatch to the worker so its
+  batcher can shed expired work before pad/launch.
+- **Connection reuse**: a small per-replica connection pool backs
+  ``_http`` (health polls AND scoring); a transport error on a pooled
+  connection recycles it and retries once on a fresh one, so a stale
+  keep-alive socket never surfaces as a replica failure.
 - **Coordinated hot-swap** (``POST /swap`` on the router): phase one
   PREPAREs the candidate on every replica (each builds + warms off-line,
   old model keeps serving), phase two pauses dispatch, waits for
@@ -43,19 +60,26 @@ import json
 import logging
 import math
 import os
+import queue
 import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 from .. import obs
+from .overload import (CircuitBreaker, OverloadedError, RetryBudget,
+                       configured_hedge_s)
 
 log = logging.getLogger(__name__)
 
 DEFAULT_POLL_MS = 500.0
 DEFAULT_STALE_S = 10.0
 DEFAULT_CANARY_FRAC = 0.0
+
+#: idle keep-alive connections pooled per replica
+CONN_POOL_SIZE = 4
 
 #: replica lifecycle: starting -> up <-> draining -> dead
 STARTING, UP, DRAINING, DEAD = "starting", "up", "draining", "dead"
@@ -106,12 +130,46 @@ class Replica:
         self.needs_bins: Optional[bool] = None
         self.generation: Optional[int] = None
         self.requests = 0
+        # per-replica circuit breaker (consecutive transport/5xx ->
+        # open -> half-open probe) — replaces bury-on-first-error
+        self.breaker = CircuitBreaker()
+        # small keep-alive connection pool (health polls + scoring)
+        self._conns: deque = deque()
+        self._conn_lock = threading.Lock()
+
+    def take_conn(self, timeout: float):
+        """(connection, was_pooled): a pooled keep-alive connection when
+        one is idle, else a fresh one."""
+        with self._conn_lock:
+            conn = self._conns.popleft() if self._conns else None
+        if conn is not None:
+            conn.timeout = timeout
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+            return conn, True
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout), False
+
+    def put_conn(self, conn) -> None:
+        with self._conn_lock:
+            if len(self._conns) < CONN_POOL_SIZE:
+                self._conns.append(conn)
+                return
+        conn.close()
+
+    def drop_conns(self) -> None:
+        """Close every pooled connection (replica died / shutdown)."""
+        with self._conn_lock:
+            conns, self._conns = list(self._conns), deque()
+        for c in conns:
+            c.close()
 
     def doc(self) -> dict:
         return {"name": self.name, "port": self.port, "state": self.state,
                 "inflight": int(self.inflight),
                 "requests": int(self.requests),
                 "generation": self.generation,
+                "breaker": self.breaker.state,
                 "accepts_raw": self.accepts_raw,
                 "needs_bins": self.needs_bins}
 
@@ -129,6 +187,13 @@ class ServeRouter:
         self.clock = clock
         self.poll_s = fleet_poll_s(poll_ms)
         self.stale_s = fleet_stale_s(stale_s)
+        # overload resilience: the fleet-wide retry budget, the hedge
+        # floor (0 = off), and the router-side latency tracker whose
+        # observed p99 sets the actual hedge delay
+        self.retry_budget = RetryBudget()
+        self._hedge_s = configured_hedge_s()
+        self.latency = obs.SLOTracker(
+            p99_ms=max(self._hedge_s * 1000.0, 1000.0), clock=clock)
         self._lock = threading.Lock()
         self._gate = threading.Event()      # cleared = dispatch paused
         self._gate.set()
@@ -146,25 +211,44 @@ class ServeRouter:
         return r
 
     def _http(self, r: Replica, method: str, path: str,
-              doc: Optional[dict] = None, timeout: float = 30.0) -> dict:
-        """One HTTP exchange with a worker.  Raises ``OSError`` for
-        transport failures (the requeue trigger); a worker-side error
-        status raises ``RuntimeError`` (the request REACHED the worker,
-        so it is not blindly requeued)."""
-        conn = http.client.HTTPConnection(r.host, r.port, timeout=timeout)
-        try:
-            body = None if doc is None else json.dumps(doc).encode()
-            headers = {"Content-Type": "application/json"} if body else {}
-            conn.request(method, path, body=body, headers=headers)
-            resp = conn.getresponse()
-            payload = json.loads(resp.read() or b"{}")
-            if resp.status >= 500:
-                raise RuntimeError(f"{r.name}{path} -> {resp.status}: "
-                                   f"{payload.get('error')}")
-            payload["_status"] = resp.status
-            return payload
-        finally:
+              doc: Optional[dict] = None, timeout: float = 30.0,
+              headers: Optional[dict] = None) -> dict:
+        """One HTTP exchange with a worker over its pooled keep-alive
+        connection (a transport error on a POOLED connection recycles
+        it and retries once fresh — a stale socket is not a replica
+        failure).  Raises ``OSError`` for transport failures (the
+        requeue trigger); a worker-side 5xx raises ``RuntimeError``
+        (the request REACHED the worker, so it is not blindly
+        requeued) — except 504, the worker's coded deadline shed, which
+        passes through like 429 for the caller to see."""
+        body = None if doc is None else json.dumps(doc).encode()
+        hdrs = {"Content-Type": "application/json"} if body else {}
+        hdrs.update(headers or {})
+        conn, pooled = r.take_conn(timeout)
+        resp = data = None
+        for attempt in (0, 1):
+            try:
+                conn.request(method, path, body=body, headers=hdrs)
+                resp = conn.getresponse()
+                data = resp.read()
+                break
+            except OSError:
+                conn.close()
+                if pooled and attempt == 0:
+                    conn, pooled = http.client.HTTPConnection(
+                        r.host, r.port, timeout=timeout), False
+                    continue
+                raise
+        payload = json.loads(data or b"{}")
+        if resp.will_close:
             conn.close()
+        else:
+            r.put_conn(conn)
+        if resp.status >= 500 and resp.status != 504:
+            raise RuntimeError(f"{r.name}{path} -> {resp.status}: "
+                               f"{payload.get('error')}")
+        payload["_status"] = resp.status
+        return payload
 
     def poll_once(self) -> dict:
         """One health sweep: refresh every replica's state from its
@@ -198,6 +282,7 @@ class ServeRouter:
                         if obs.enabled():
                             obs.counter("serve.fleet_drains").inc()
                     r.state = DEAD
+                    r.drop_conns()
                 elif r.state == UP:
                     log.warning("draining %s: unreachable (%s)", r.name, e)
                     if obs.enabled():
@@ -234,15 +319,23 @@ class ServeRouter:
                                    if r["state"] == UP) if reps else False}
 
     # ----------------------------------------------------------- dispatch
-    def _pick(self) -> Optional[Replica]:
+    def _pick(self, exclude: Optional[Replica] = None
+              ) -> Optional[Replica]:
+        """Least-inflight live replica whose circuit breaker allows
+        dispatch (an open breaker hides the replica; a half-open one
+        admits exactly the probe request).  ``exclude`` keeps a hedged
+        second dispatch off the primary's replica."""
+        now = self.clock()
         with self._lock:
-            up = [r for r in self.replicas.values() if r.state == UP]
-            if not up:
-                return None
-            r = min(up, key=lambda x: (x.inflight, x.requests))
-            r.inflight += 1
-            r.requests += 1
-            return r
+            up = [r for r in self.replicas.values()
+                  if r.state == UP and r is not exclude]
+            up.sort(key=lambda x: (x.inflight, x.requests))
+            for r in up:
+                if r.breaker.allow(now):
+                    r.inflight += 1
+                    r.requests += 1
+                    return r
+            return None
 
     def _done(self, r: Replica) -> None:
         with self._idle:
@@ -253,12 +346,99 @@ class ServeRouter:
     def _total_inflight(self) -> int:
         return sum(r.inflight for r in self.replicas.values())
 
-    def score(self, doc: dict, timeout: float = 30.0) -> dict:
+    def _dispatch(self, r: Replica, doc: dict, timeout: float,
+                  headers: Optional[dict] = None) -> dict:
+        """One replica dispatch with inflight + breaker bookkeeping.
+        Transport errors and 5xx feed the breaker; the replica stays in
+        rotation unless its process exited (the breaker — not instant
+        burial — decides when to stop dispatching to a flaky one)."""
+        t0 = self.clock()
+        try:
+            out = self._http(r, "POST", "/score", doc, timeout=timeout,
+                             headers=headers)
+            r.breaker.record_success()
+            if out.get("_status", 200) < 400:
+                self.latency.observe_batch([self.clock() - t0])
+            out["replica"] = r.name
+            return out
+        except (OSError, RuntimeError) as e:
+            if r.breaker.record_failure(self.clock()):
+                log.warning("breaker OPEN for %s (%s)", r.name, e)
+                if obs.enabled():
+                    obs.counter("serve.fleet_breaker_opens").inc()
+            if isinstance(e, OSError) and r.proc is not None \
+                    and r.proc.poll() is not None:
+                r.state = DEAD
+                r.drop_conns()
+            raise
+        finally:
+            self._done(r)
+
+    def _hedge_delay_s(self) -> float:
+        """The hedged-dispatch trigger delay: the router-observed p99
+        when the latency tracker has data, never below the ``hedgeMs``
+        floor; 0 = hedging off."""
+        if self._hedge_s <= 0.0:
+            return 0.0
+        p99 = self.latency.quantile_ms(0.99)
+        return self._hedge_s if p99 is None \
+            else max(self._hedge_s, p99 / 1000.0)
+
+    def _dispatch_hedged(self, r: Replica, doc: dict, timeout: float,
+                         headers: Optional[dict] = None) -> dict:
+        """Dispatch with tail-shaving: when the primary has not
+        answered within the p99-derived hedge delay, fire the SAME
+        request at a second replica — first response wins, the loser's
+        answer is dropped (scoring is idempotent).  A first ERROR does
+        not win: while another dispatch is still in flight, its answer
+        gets the remaining budget."""
+        delay = self._hedge_delay_s()
+        if delay <= 0.0 or timeout <= delay:
+            return self._dispatch(r, doc, timeout, headers)
+        results: queue.Queue = queue.Queue()
+
+        def run(rep: Replica) -> None:
+            try:
+                results.put(("ok", self._dispatch(rep, doc, timeout,
+                                                  headers)))
+            except BaseException as e:      # noqa: BLE001 — relayed
+                results.put(("err", e))
+
+        threading.Thread(target=run, args=(r,), daemon=True,
+                         name="fleet-dispatch").start()
+        launched = 1
+        try:
+            kind, val = results.get(timeout=delay)
+        except queue.Empty:
+            r2 = self._pick(exclude=r)
+            if r2 is not None:
+                launched = 2
+                if obs.enabled():
+                    obs.counter("serve.fleet_hedges").inc()
+                threading.Thread(target=run, args=(r2,), daemon=True,
+                                 name="fleet-hedge").start()
+            kind, val = results.get(timeout=max(0.05, timeout))
+        if kind == "err" and launched == 2:
+            try:
+                kind, val = results.get(timeout=max(0.05, timeout))
+            except queue.Empty:
+                pass                        # fall through to the error
+        if kind == "err":
+            raise val
+        return val
+
+    def score(self, doc: dict, timeout: float = 30.0,
+              deadline_ms: Optional[float] = None) -> dict:
         """Route one ``POST /score`` body to the best live replica.
-        A transport failure (replica died before replying) marks the
-        replica and REQUEUES the request on a peer — scoring is
-        idempotent, so the retry is safe; every accepted request
-        completes while any replica lives."""
+        A transport failure (replica died before replying) REQUEUES the
+        request on a peer — scoring is idempotent, so the retry is safe
+        — but each requeue spends the retry budget: exhausted, the
+        request sheds with a coded 429 instead of amplifying overload.
+        ``deadline_ms`` (the ``X-Shifu-Deadline-Ms`` header) bounds the
+        whole attempt and propagates to the worker, shrinking, on every
+        dispatch."""
+        if deadline_ms is not None:
+            timeout = min(timeout, max(0.001, float(deadline_ms) / 1000.0))
         deadline = self.clock() + timeout
         attempts = 0
         while True:
@@ -269,6 +449,16 @@ class ServeRouter:
                                    "held the dispatch gate")
             r = self._pick()
             if r is None:
+                with self._lock:
+                    live = [x for x in self.replicas.values()
+                            if x.state == UP]
+                if live:
+                    # replicas are live but every breaker refuses the
+                    # dispatch: shed coded instead of spinning on the
+                    # poller until the cooldown elapses
+                    raise OverloadedError(
+                        f"all {len(live)} live replica breaker(s) open",
+                        retry_after_s=self.poll_s)
                 if self.clock() >= deadline:
                     raise RuntimeError("no live replicas")
                 self.poll_once()
@@ -277,26 +467,34 @@ class ServeRouter:
                     raise RuntimeError("no live replicas")
                 time.sleep(min(0.05, self.poll_s))
                 continue
+            left = max(0.1, deadline - self.clock())
+            headers = None
+            if deadline_ms is not None:
+                headers = {"X-Shifu-Deadline-Ms":
+                           f"{max(1.0, left * 1000.0):.1f}"}
             try:
-                out = self._http(r, "POST", "/score", doc,
-                                 timeout=max(0.1, deadline - self.clock()))
-                out["replica"] = r.name
+                out = self._dispatch_hedged(r, doc, left, headers)
+                if out.get("_status", 200) < 400:
+                    self.retry_budget.on_success()
                 return out
             except OSError as e:
                 # transport death: the worker never answered — requeue
                 attempts += 1
                 if obs.enabled():
                     obs.counter("serve.fleet_requeues").inc()
-                exited = r.proc is not None and r.proc.poll() is not None
-                r.state = DEAD if exited else DRAINING
                 log.warning("requeue after %s failed (%s), attempt %d",
                             r.name, e, attempts)
                 if self.clock() >= deadline:
                     raise RuntimeError(
                         f"request failed on {attempts} replica(s): {e}"
                         ) from e
-            finally:
-                self._done(r)
+                if not self.retry_budget.try_retry():
+                    if obs.enabled():
+                        obs.counter("serve.fleet_retry_denied").inc()
+                    raise OverloadedError(
+                        f"retry budget exhausted after {attempts} "
+                        f"transport failure(s): {e}",
+                        retry_after_s=self.poll_s) from e
 
     # --------------------------------------------------- coordinated swap
     def coordinated_swap(self, models_dir: str,
@@ -422,6 +620,8 @@ class ServeRouter:
         if self._poll_thread is not None:
             self._poll_thread.join(timeout=2.0)
             self._poll_thread = None
+        for r in self.replicas.values():
+            r.drop_conns()
         if kill_workers:
             for r in self.replicas.values():
                 if r.proc is not None and r.proc.poll() is None:
@@ -439,11 +639,19 @@ def _make_router_handler(router: ServeRouter):
     from http.server import BaseHTTPRequestHandler
 
     class Handler(BaseHTTPRequestHandler):
-        def _reply(self, code: int, doc: dict) -> None:
+        # HTTP/1.1 keep-alive: replies always carry Content-Length, so
+        # clients (and the fleet's own pooled connections) can reuse
+        # the socket across requests
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, code: int, doc: dict,
+                   headers: Optional[dict] = None) -> None:
             body = json.dumps(doc).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -458,7 +666,10 @@ def _make_router_handler(router: ServeRouter):
                 n = int(self.headers.get("Content-Length", 0))
                 doc = json.loads(self.rfile.read(n) or b"{}")
                 if self.path == "/score":
-                    out = router.score(doc)
+                    hdr = self.headers.get("X-Shifu-Deadline-Ms")
+                    out = router.score(
+                        doc, deadline_ms=None if hdr is None
+                        else float(hdr))
                     self._reply(out.pop("_status", 200), out)
                 elif self.path == "/swap":
                     mdir = doc.get("dir") or doc.get("models_dir")
@@ -468,6 +679,13 @@ def _make_router_handler(router: ServeRouter):
                         str(mdir), canary=doc.get("canary_frac")))
                 else:
                     self._reply(404, {"error": f"unknown {self.path}"})
+            except OverloadedError as e:       # coded fast-fail: the
+                # retry budget shed this request, do not mask it as 500
+                self._reply(429, {"error": e.code,
+                                  "retry_after_ms":
+                                      round(e.retry_after_s * 1000.0, 3)},
+                            headers={"Retry-After":
+                                     str(max(1, round(e.retry_after_s)))})
             except Exception as e:             # noqa: BLE001 — HTTP edge
                 self._reply(500, {"error": str(e)})
 

@@ -71,6 +71,8 @@ import numpy as np
 
 from .. import faults, obs
 from .batcher import MicroBatcher, Ticket
+from .overload import (DeadlineExceededError, OverloadedError,
+                       configured_brownout_enabled)
 from .registry import ModelRegistry
 from .scorer import bucket_ladder
 
@@ -81,6 +83,11 @@ DEFAULT_MAX_DELAY_MS = 2.0
 # queue depth at/over this many top buckets flags "buildup" in
 # heartbeats — work queued beyond what the next few flushes can absorb
 QUEUE_BUILDUP_BUCKETS = 4
+
+# brownout policy: the flush deadline shrinks to this fraction of its
+# configured value while degraded (smaller batches, lower queue wait —
+# throughput for latency, the right trade under overload)
+BROWNOUT_DELAY_FACTOR = 0.25
 
 
 def max_delay_s(override_ms: Optional[float] = None) -> float:
@@ -146,6 +153,12 @@ class ServeServer:
                                     max_delay_s=delay_s,
                                     trace_sample_rate=trace_sample_rate,
                                     slo=self.slo)
+        # brownout governor (overload tentpole): evaluated each beat —
+        # or directly via check_brownout() — against burn-rate alerts
+        # and queue buildup; None when -Dshifu.serve.brownout=false
+        self.brownout = obs.BrownoutGovernor() \
+            if configured_brownout_enabled() else None
+        self._normal_settings: Optional[dict] = None
         self._heartbeat = None
         self._exporter = None
         self._started = False
@@ -211,16 +224,78 @@ class ServeServer:
             obs.flush(trace_path(self.model_set_dir), step="SERVE")
         self._started = False
 
+    # -------------------------------------------------- brownout mode
+    @property
+    def mode(self) -> str:
+        """``normal`` or ``brownout`` (the ``serve.mode`` gauge /
+        heartbeat extra / ``<< BROWNOUT`` monitor flag)."""
+        return self.brownout.mode if self.brownout is not None \
+            else "normal"
+
+    def check_brownout(self, now: Optional[float] = None) -> str:
+        """One governor evaluation (rides each heartbeat; tests call it
+        directly): *stressed* = a firing burn-rate alert OR queue
+        buildup.  Applies/reverts the degradation policy on a mode
+        flip and returns the current mode."""
+        if self.brownout is None:
+            return "normal"
+        qd = self.batcher.queue_depth
+        top = self.registry.get(self.key).buckets[-1]
+        stressed = bool(self.slo.alerts(now=now)) \
+            or qd >= QUEUE_BUILDUP_BUCKETS * top
+        if self.brownout.check(stressed):
+            if self.brownout.mode == "brownout":
+                self._enter_brownout()
+            else:
+                self._exit_brownout()
+        obs.gauge("serve.mode").set(
+            1.0 if self.brownout.mode == "brownout" else 0.0)
+        return self.brownout.mode
+
+    def _enter_brownout(self) -> None:
+        """Shed everything optional: shrink the flush deadline (smaller
+        batches, bounded queue wait), stop trace and score-log sampling,
+        freeze ladder refinement.  Settings are saved for the exit."""
+        b = self.batcher
+        self._normal_settings = {
+            "max_delay_s": b.max_delay_s,
+            "trace_sample_rate": b.trace_sample_rate,
+            "refine_every": b.refine_every,
+            "scorelog": b.scorelog,
+        }
+        b.max_delay_s = b.max_delay_s * BROWNOUT_DELAY_FACTOR
+        b.trace_sample_rate = 0.0
+        b.refine_every = 0
+        b.scorelog = None
+        obs.counter("serve.brownouts").inc()
+        log.warning("serve %s: BROWNOUT engaged (deadline %.2f ms, "
+                    "sampling/refinement suspended)", self.key,
+                    b.max_delay_s * 1000.0)
+
+    def _exit_brownout(self) -> None:
+        saved, self._normal_settings = self._normal_settings, None
+        if saved is None:
+            return
+        b = self.batcher
+        b.max_delay_s = saved["max_delay_s"]
+        b.trace_sample_rate = saved["trace_sample_rate"]
+        b.refine_every = saved["refine_every"]
+        b.scorelog = saved["scorelog"]
+        log.warning("serve %s: brownout lifted, normal service restored",
+                    self.key)
+
     def _beat_extras(self) -> dict:
-        """Per-beat heartbeat payload: queue depth + the compact SLO
-        summary (the monitor's buildup / burn-rate flags), mirrored
-        into the registry gauges the exporter scrapes."""
+        """Per-beat heartbeat payload: queue depth + serving mode + the
+        compact SLO summary (the monitor's buildup / burn-rate /
+        brownout flags), mirrored into the registry gauges the exporter
+        scrapes."""
         qd = self.batcher.queue_depth
         top = self.registry.get(self.key).buckets[-1]
         self.slo.emit_gauges()
         obs.gauge("serve.queue_depth").set(qd)
         extras = {"queue_depth": int(qd),
                   "queue_buildup": bool(qd >= QUEUE_BUILDUP_BUCKETS * top),
+                  "mode": self.check_brownout(),
                   "slo": self.slo.compact()}
         if self.quality is not None:
             if self.outcomes is not None:
@@ -233,29 +308,35 @@ class ServeServer:
     def submit(self, rows: np.ndarray,
                bins: Optional[np.ndarray] = None,
                trace_id: Optional[str] = None,
-               req_id: Optional[str] = None) -> Ticket:
+               req_id: Optional[str] = None,
+               deadline_ms: Optional[float] = None) -> Ticket:
         return self.batcher.submit_burst(np.asarray(rows, np.float32),
                                          bins, trace_id=trace_id,
-                                         req_id=req_id)
+                                         req_id=req_id,
+                                         deadline_ms=deadline_ms)
 
     def score(self, rows: np.ndarray, bins: Optional[np.ndarray] = None,
               timeout: float = 30.0,
               trace_id: Optional[str] = None,
-              req_id: Optional[str] = None) -> np.ndarray:
+              req_id: Optional[str] = None,
+              deadline_ms: Optional[float] = None) -> np.ndarray:
         """Closed-loop scoring (mean ensemble score per row, scaled)."""
         if not self._started:                  # in-process, no worker
             t = self.batcher.submit_burst(np.asarray(rows, np.float32),
                                           bins, trace_id=trace_id,
-                                          req_id=req_id)
+                                          req_id=req_id,
+                                          deadline_ms=deadline_ms)
             self.batcher.drain()
             return t.wait(timeout)
         t = self.batcher.submit_burst(np.asarray(rows, np.float32), bins,
-                                      trace_id=trace_id, req_id=req_id)
+                                      trace_id=trace_id, req_id=req_id,
+                                      deadline_ms=deadline_ms)
         return t.wait(timeout)
 
     def score_raw(self, records: Sequence, timeout: float = 30.0,
                   trace_id: Optional[str] = None,
-                  req_id: Optional[str] = None) -> dict:
+                  req_id: Optional[str] = None,
+                  deadline_ms: Optional[float] = None) -> dict:
         """Raw-record scoring: parse + categorical binning on host, the
         whole norm transform in-graph (fused into the scorer
         executable).  PER-RECORD rejection: a malformed record (non-
@@ -277,7 +358,8 @@ class ServeServer:
             obs.counter("serve.raw_rows").inc(int(len(packed)))
             t = self.batcher.submit_burst(packed, raw=True,
                                           trace_id=trace_id,
-                                          req_id=req_id)
+                                          req_id=req_id,
+                                          deadline_ms=deadline_ms)
             if not self._started:              # in-process, no worker
                 self.batcher.drain()
             got = t.wait(timeout)
@@ -354,6 +436,7 @@ class ServeServer:
             "max_delay_ms": self.batcher.max_delay_s * 1000.0,
             "trace_sample_rate": self.batcher.trace_sample_rate,
             "queue_depth": int(self.batcher.queue_depth),
+            "mode": self.mode,
             "slo": self.slo.compact(),
             "stats": dict(self.batcher.stats),
             "bucket_counts": {str(k): v for k, v in
@@ -424,11 +507,19 @@ def _make_handler(server: ServeServer):
     from http.server import BaseHTTPRequestHandler
 
     class Handler(BaseHTTPRequestHandler):
-        def _reply(self, code: int, doc: dict) -> None:
+        # HTTP/1.1 keep-alive: every reply carries Content-Length, so
+        # the router's per-replica connection pool can reuse sockets
+        # across health polls and scoring requests
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, code: int, doc: dict,
+                   headers: Optional[dict] = None) -> None:
             body = json.dumps(doc).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -467,6 +558,12 @@ def _make_handler(server: ServeServer):
                 req_id = self.headers.get("X-Shifu-Request")
                 if req_id is None and server.scorelog is not None:
                     req_id = os.urandom(8).hex()
+                # the propagated request budget (router -> worker):
+                # remaining milliseconds; absent = the property default
+                deadline_ms = None
+                hdr = self.headers.get("X-Shifu-Deadline-Ms")
+                if hdr is not None:
+                    deadline_ms = float(hdr)
                 if "records" in doc:           # raw-record path
                     recs = doc["records"]
                     if not isinstance(recs, list):
@@ -474,7 +571,8 @@ def _make_handler(server: ServeServer):
                                           "list of objects"})
                         return
                     got = server.score_raw(recs, trace_id=trace_id,
-                                           req_id=req_id)
+                                           req_id=req_id,
+                                           deadline_ms=deadline_ms)
                     if got["errors"] and not any(
                             s is not None for s in got["scores"]):
                         self._reply(400, {**got, "error":
@@ -489,7 +587,8 @@ def _make_handler(server: ServeServer):
                     if bins is not None:
                         bins = np.asarray(bins, np.int32)
                     scores = server.score(rows, bins, trace_id=trace_id,
-                                          req_id=req_id)
+                                          req_id=req_id,
+                                          deadline_ms=deadline_ms)
                     out = {"scores": [round(float(s), 6)
                                       for s in scores],
                            "generation":
@@ -499,6 +598,14 @@ def _make_handler(server: ServeServer):
                 if req_id:
                     out["req"] = req_id
                 self._reply(200, out)
+            except OverloadedError as e:       # coded admission shed
+                self._reply(429, {"error": e.code,
+                                  "retry_after_ms":
+                                      round(e.retry_after_s * 1000.0, 3)},
+                            headers={"Retry-After":
+                                     str(max(1, round(e.retry_after_s)))})
+            except DeadlineExceededError as e:  # coded deadline shed
+                self._reply(504, {"error": e.code, "detail": str(e)})
             except Exception as e:             # noqa: BLE001 — HTTP edge
                 self._reply(400, {"error": str(e)})
 

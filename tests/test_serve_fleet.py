@@ -76,6 +76,36 @@ def _modelset(d, n_models=2, seed0=0, subdir="models"):
     return md
 
 
+class _TrackingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that can sever ESTABLISHED connections too —
+    ``shutdown()`` only stops the accept loop, which no longer simulates
+    transport death now that the router pools keep-alive connections."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def kill_connections(self):
+        import socket as _socket
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for s in conns:
+            try:
+                s.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
 class _Fleet:
     """In-process workers behind real loopback HTTP listeners."""
 
@@ -88,7 +118,7 @@ class _Fleet:
                           replica=name, max_delay_ms=1.0)
         srv.registry.state_dir = None    # in-memory journal per worker
         srv.start()
-        httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+        httpd = _TrackingHTTPServer(("127.0.0.1", 0),
                                     _make_handler(srv))
         threading.Thread(target=httpd.serve_forever,
                          daemon=True).start()
@@ -104,6 +134,7 @@ class _Fleet:
     def kill_listener(self, httpd):
         httpd.shutdown()
         httpd.server_close()
+        httpd.kill_connections()
 
     def stop(self):
         self.router.stop(kill_workers=False)
@@ -160,7 +191,10 @@ def test_requeue_on_replica_death_completes_request(fleet, tmp_path):
         out = fleet.router.score({"records": _RECORDS})
         assert out["replica"] == "r0" or out["scores"] == base
     assert obs.counter("serve.fleet_requeues").value > before
-    assert fleet.router.replicas["r1"].state in (DRAINING, DEAD)
+    # the router noticed: either the health poll drained/buried r1 or
+    # its circuit breaker opened and hides it from dispatch
+    r1 = fleet.router.replicas["r1"]
+    assert r1.state in (DRAINING, DEAD) or r1.breaker.state == "open"
 
 
 def test_mixed_raw_prebinned_fleet_refused(fleet, tmp_path):
@@ -336,6 +370,80 @@ def test_replica_sigkill_drill_requeues_and_buries(tmp_path):
         assert router.replicas["r0"].state == DEAD
         out = router.score({"records": [{"a": 0.5, "b": 1.5}]})
         assert out["replica"] == "r1"
+    finally:
+        router.stop()
+        for p, _ in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+
+# ------------------------------------------------- overload chaos drill
+@pytest.mark.slow
+def test_fleet_chaos_sigkill_under_double_load_no_hung_clients(tmp_path):
+    """Overload chaos drill: two subprocess replicas under ~2x the
+    client concurrency the earlier drills use, r0 SIGKILLed mid-window.
+    EVERY request resolves — a score or a CODED fast-fail
+    (``OverloadedError`` when the retry budget sheds) — zero hung
+    client threads, and the shed fraction stays bounded while r1
+    lives."""
+    from shifu_tpu.serve.overload import OverloadedError
+    d = str(tmp_path)
+    _modelset(d)
+    fdir = os.path.join(d, "serving", "fleet")
+    os.makedirs(fdir, exist_ok=True)
+    router = ServeRouter(poll_ms=200, stale_s=5)
+    procs = {}
+    try:
+        for name in ("r0", "r1"):
+            ann = os.path.join(fdir, f"{name}.json")
+            p = spawn_worker(d, name, ann,
+                             extra_env={"JAX_PLATFORMS": "cpu"})
+            procs[name] = (p, ann)
+        for name, (p, ann) in procs.items():
+            doc = wait_for_announce(ann, p, timeout=240)
+            router.add_backend(name, doc["port"], proc=p)
+        router.poll_once()
+        router.ensure_uniform()
+        assert router.fleet_doc()["up"] == 2
+
+        ok, shed, uncoded = [], [], []
+        stop = threading.Event()
+
+        def pound(i):
+            while not stop.is_set():
+                try:
+                    out = router.score(
+                        {"records": [{"a": 0.5, "b": 1.5}]},
+                        timeout=30.0, deadline_ms=30000.0)
+                    ok.append(out["replica"])
+                except OverloadedError:
+                    shed.append(i)      # coded fast-fail: acceptable
+                except RuntimeError as e:
+                    uncoded.append(str(e))
+
+        threads = [threading.Thread(target=pound, args=(i,),
+                                    daemon=True) for i in range(4)]
+        [t.start() for t in threads]
+        time.sleep(1.0)
+        procs["r0"][0].kill()           # the real SIGKILL, mid-load
+        time.sleep(2.0)
+        stop.set()
+        [t.join(timeout=60) for t in threads]
+        # zero hung clients: every thread exited its loop
+        assert not any(t.is_alive() for t in threads)
+        total = len(ok) + len(shed) + len(uncoded)
+        assert total > 0 and len(ok) > 0
+        # every failure is a coded shed; nothing died un-coded while
+        # r1 served on
+        assert uncoded == [], uncoded[:3]
+        # bounded shed rate: the kill may burn the retry budget
+        # briefly, but r1 absorbs the fleet — most requests score
+        assert len(shed) / total < 0.5, (len(shed), total)
+        router.poll_once()
+        assert router.replicas["r0"].state == DEAD
+        # r1 survived the drill; SLO-burn draining under doubled load is
+        # the router doing its job, so only rule out DEAD
+        assert router.replicas["r1"].state in (UP, DRAINING)
     finally:
         router.stop()
         for p, _ in procs.values():

@@ -1,0 +1,546 @@
+"""Overload-protection suite (tier-1-fast: in-process batcher pumps,
+injectable clocks, loopback stub backends — zero real sleeps on the
+state-machine paths).
+
+Covers the overload tentpole's acceptance surface: bounded admission
+(coded 429 + drain-rate Retry-After, oversized bursts still admitted
+into an EMPTY queue), deadline propagation (expired tickets shed in
+``pump()`` BEFORE pad/launch with a coded 504, ``wait(timeout)``
+cancels so abandoned work is never scored), the router's retry budget
+(exhaustion propagates a coded 429 instead of amplifying overload),
+the per-replica circuit breaker (open -> half-open single probe ->
+close), hedged dispatch (first response wins, a first ERROR does not),
+brownout degradation (asymmetric hysteresis; policy applied and fully
+restored), and the ``serve:admit`` die-during-shed drill (queue depth
+and SLO shed accounting stay consistent when the shed path itself
+dies).
+"""
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import jax
+
+from shifu_tpu import faults, obs
+from shifu_tpu.config import environment
+from shifu_tpu.models.nn import (IndependentNNModel, NNModelSpec,
+                                 init_params)
+from shifu_tpu.serve import AOTScorer, MicroBatcher, ServeServer
+from shifu_tpu.serve.overload import (CircuitBreaker,
+                                      DeadlineExceededError,
+                                      OverloadedError, RetryBudget)
+from shifu_tpu.serve.router import UP, ServeRouter
+from shifu_tpu.serve.server import (BROWNOUT_DELAY_FACTOR,
+                                    QUEUE_BUILDUP_BUCKETS, _make_handler)
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    environment.reset_for_tests()
+    faults.reset_for_tests()
+    yield
+    environment.reset_for_tests()
+    faults.reset_for_tests()
+    obs.set_enabled(False)
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _nn_models(n=3, n_features=8, hidden=(8,), seed0=0):
+    spec = NNModelSpec(input_dim=n_features, hidden_nodes=list(hidden),
+                       activations=["relu"] * len(hidden))
+    return [IndependentNNModel(spec, init_params(
+        jax.random.PRNGKey(seed0 + i), spec)) for i in range(n)]
+
+
+def _batcher(clk, max_delay_s=0.002, slo=None, **props):
+    for k, v in props.items():
+        environment.set_property(k, str(v))
+    scorer = AOTScorer(_nn_models(), buckets=(1, 4))
+    scorer.warm(launch=False)
+    return MicroBatcher(lambda: scorer, max_delay_s=max_delay_s,
+                        clock=clk, slo=slo), scorer
+
+
+# -------------------------------------------------------- bounded admission
+def test_admission_cap_rejects_with_coded_retry_after():
+    """At the cap, submit fast-fails with a coded ``OverloadedError``
+    carrying a positive Retry-After; the queue is untouched and already
+    queued work still completes."""
+    clk = FakeClock()
+    b, _ = _batcher(clk, **{"shifu.serve.maxQueueRows": 4})
+    rng = np.random.default_rng(0)
+    t_ok = b.submit_burst(rng.normal(size=(4, 8)).astype(np.float32))
+    with pytest.raises(OverloadedError) as ei:
+        b.submit_burst(rng.normal(size=(1, 8)).astype(np.float32))
+    assert ei.value.code == "overloaded"
+    assert ei.value.retry_after_s > 0.0
+    assert b.queue_depth == 4
+    assert b.stats["shed_overload"] == 1
+    assert b.pump() == 4 and t_ok.wait(1.0).shape == (4,)
+    # queue drained: admission opens again
+    assert b.submit_burst(rng.normal(size=(2, 8))
+                          .astype(np.float32)).n == 2
+
+
+def test_oversized_burst_admitted_into_empty_queue():
+    """A burst larger than the cap is still serviceable when the queue
+    is EMPTY (it chunks through the top bucket) — the cap bounds queue
+    WAIT, it must not make big requests unservable."""
+    clk = FakeClock()
+    b, _ = _batcher(clk, **{"shifu.serve.maxQueueRows": 4})
+    rng = np.random.default_rng(1)
+    t = b.submit_burst(rng.normal(size=(9, 8)).astype(np.float32))
+    while b.queue_depth:
+        clk.t += 0.01               # age the remnant past max_delay
+        b.pump()
+    assert t.wait(1.0).shape == (9,)
+    assert b.stats["shed_overload"] == 0
+
+
+def test_retry_after_tracks_drain_rate():
+    """Once launches establish a drain-rate EWMA, Retry-After ~=
+    queued_rows / drain_rate instead of the max-delay fallback."""
+    clk = FakeClock()
+    b, _ = _batcher(clk, **{"shifu.serve.maxQueueRows": 4})
+    rng = np.random.default_rng(2)
+    # two spaced launches: 4 rows per 0.01s -> ~400 rows/s drain
+    for _ in range(2):
+        b.submit_burst(rng.normal(size=(4, 8)).astype(np.float32))
+        clk.t += 0.01
+        b.pump()
+    b.submit_burst(rng.normal(size=(4, 8)).astype(np.float32))
+    with pytest.raises(OverloadedError) as ei:
+        b.submit_burst(rng.normal(size=(1, 8)).astype(np.float32))
+    assert ei.value.retry_after_s == pytest.approx(4 / 400.0, rel=0.6)
+
+
+# ------------------------------------------------------ deadline propagation
+def test_expired_ticket_sheds_before_launch_with_coded_error():
+    """A ticket whose deadline passed before its rows launched is shed
+    in ``pump()`` with a coded ``DeadlineExceededError`` — never scored,
+    never silent — while fresh work in the same pump still launches."""
+    slo = obs.SLOTracker(p99_ms=50.0)
+    clk = FakeClock()
+    b, _ = _batcher(clk, slo=slo,
+                    **{"shifu.serve.requestDeadlineMs": 5})
+    rng = np.random.default_rng(3)
+    t_old = b.submit_burst(rng.normal(size=(2, 8)).astype(np.float32))
+    clk.t += 0.006                      # past the 5 ms deadline
+    t_new = b.submit_burst(rng.normal(size=(2, 8)).astype(np.float32))
+    batches0 = b.stats["batches"]
+    clk.t += 0.003                      # t_new aged past max_delay only
+    assert b.pump() == 2                # t_new launches, t_old sheds
+    with pytest.raises(DeadlineExceededError) as ei:
+        t_old.wait(1.0)
+    assert ei.value.code == "deadline_exceeded"
+    assert t_new.wait(1.0).shape == (2,)
+    assert b.stats["shed_expired"] == 1
+    assert b.stats["batches"] == batches0 + 1   # expired rows: NO launch
+    assert slo.shed_total == 1
+    assert b.queue_depth == 0
+
+
+def test_deadline_ms_argument_overrides_property_default():
+    clk = FakeClock()
+    b, _ = _batcher(clk, **{"shifu.serve.requestDeadlineMs": 10000})
+    rng = np.random.default_rng(4)
+    t = b.submit_burst(rng.normal(size=(1, 8)).astype(np.float32),
+                       deadline_ms=2.0)
+    assert t.deadline == pytest.approx(clk.t + 0.002)
+    clk.t += 0.004
+    b.pump()
+    with pytest.raises(DeadlineExceededError):
+        t.wait(1.0)
+
+
+def test_wait_timeout_cancels_and_pump_sheds():
+    """Satellite: ``Ticket.wait(timeout)`` marks the ticket cancelled —
+    the client is gone, so ``pump()`` sheds its rows instead of scoring
+    into the void (counted ``serve.cancelled``)."""
+    slo = obs.SLOTracker(p99_ms=50.0)
+    clk = FakeClock()
+    b, _ = _batcher(clk, slo=slo)
+    rng = np.random.default_rng(5)
+    t = b.submit_burst(rng.normal(size=(2, 8)).astype(np.float32))
+    with pytest.raises(TimeoutError):
+        t.wait(0.005)                   # nobody pumping: times out
+    assert t.cancelled
+    clk.t += 0.01
+    assert b.pump() == 0                # shed, not scored
+    assert b.stats["cancelled"] == 1 and b.stats["batches"] == 0
+    assert slo.shed_total == 1
+    assert b.queue_depth == 0
+
+
+# ------------------------------------------------------------- retry budget
+def test_retry_budget_spends_and_refills_on_success():
+    rb = RetryBudget(frac=0.5, initial=1.0, cap=2.0)
+    assert rb.try_retry() is True
+    assert rb.try_retry() is False      # drained
+    for _ in range(2):
+        rb.on_success()                 # 2 x 0.5 = one token back
+    assert rb.try_retry() is True
+    assert rb.try_retry() is False
+    for _ in range(100):
+        rb.on_success()
+    assert rb.tokens == 2.0             # capped
+
+
+def test_retry_budget_frac_zero_disables_retries():
+    environment.set_property("shifu.serve.retryBudgetFrac", "0")
+    rb = RetryBudget()
+    assert rb.try_retry() is False      # no cold-start allowance either
+
+
+# ----------------------------------------------------------- circuit breaker
+def test_breaker_open_halfopen_close_cycle():
+    brk = CircuitBreaker(threshold=2, cooldown_s=1.0)
+    assert brk.allow(0.0)
+    assert brk.record_failure(0.0) is False
+    assert brk.record_failure(0.0) is True      # threshold: OPEN edge
+    assert brk.state == "open" and brk.opens == 1
+    assert not brk.allow(0.5)                   # cooling down
+    assert brk.allow(1.5)                       # the half-open probe
+    assert brk.state == "half_open"
+    assert not brk.allow(1.6)                   # ONE probe at a time
+    brk.record_success()
+    assert brk.state == "closed" and brk.allow(1.7)
+
+
+def test_breaker_failed_probe_reopens():
+    brk = CircuitBreaker(threshold=1, cooldown_s=1.0)
+    assert brk.record_failure(0.0) is True
+    assert brk.allow(1.5)                       # probe
+    assert brk.record_failure(1.5) is True      # failed probe: re-OPEN
+    assert brk.state == "open" and brk.opens == 2
+    assert not brk.allow(2.0)                   # fresh cooldown from 1.5
+    assert brk.allow(2.6)
+
+
+def test_breaker_threshold_zero_never_opens():
+    brk = CircuitBreaker(threshold=0)
+    for _ in range(10):
+        assert brk.record_failure(0.0) is False
+    assert brk.state == "closed" and brk.allow(0.0)
+
+
+# ------------------------------------------------- router overload behavior
+def _stub_backend(name, delay_s=0.0, status=200):
+    """A loopback worker stub: /healthz + /score (optionally slow or
+    erroring) — real HTTP transport without a real model."""
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, code, doc):
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):                       # noqa: N802
+            self._reply(200, {"state": "serving", "accepts_raw": False,
+                              "needs_bins": False, "generation": 0,
+                              "alerting": False})
+
+        def do_POST(self):                      # noqa: N802
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            if delay_s:
+                time.sleep(delay_s)
+            self._reply(status, {"scores": [0.5], "replica": name})
+
+        def log_message(self, fmt, *args):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def test_retry_budget_exhaustion_propagates_coded_429():
+    """With every replica's transport dead, the router spends its retry
+    budget then sheds with a coded ``OverloadedError`` (HTTP 429 at the
+    front door) instead of retrying forever — retry amplification is
+    the collapse mechanism the budget caps."""
+    environment.set_property("shifu.serve.breakerFailures", "0")
+    obs.set_enabled(True)
+    router = ServeRouter(poll_ms=100, stale_s=60)
+    dead = _stub_backend("dead")
+    port = dead.server_address[1]
+    dead.shutdown()
+    dead.server_close()                 # connection refused from now on
+    r = router.add_backend("dead", port)
+    r.state = UP
+    before = obs.counter("serve.fleet_retry_denied").value
+    try:
+        with pytest.raises(OverloadedError) as ei:
+            router.score({"records": [{}]}, timeout=30.0)
+        assert ei.value.code == "overloaded"
+        assert obs.counter("serve.fleet_retry_denied").value == before + 1
+    finally:
+        router.stop(kill_workers=False)
+
+
+def test_breaker_opens_after_transport_failures_and_probes_later():
+    """Consecutive transport failures open the dead replica's breaker
+    (counted ``serve.fleet_breaker_opens``); ``_pick`` then refuses it
+    until the cooldown, after which exactly one half-open probe goes
+    through."""
+    from shifu_tpu.serve.overload import DEFAULT_BREAKER_COOLDOWN_S
+    obs.set_enabled(True)
+    clk = FakeClock()
+    router = ServeRouter(poll_ms=100, stale_s=60, clock=clk)
+    dead = _stub_backend("dead")
+    port = dead.server_address[1]
+    dead.shutdown()
+    dead.server_close()
+    r = router.add_backend("dead", port)
+    r.state = UP
+    before = obs.counter("serve.fleet_breaker_opens").value
+    try:
+        with pytest.raises((RuntimeError, OverloadedError)):
+            router.score({"records": [{}]}, timeout=5.0)
+        assert r.breaker.state == "open"
+        assert r.doc()["breaker"] == "open"
+        assert obs.counter("serve.fleet_breaker_opens").value \
+            == before + 1
+        assert router._pick() is None           # refused while open
+        clk.t += DEFAULT_BREAKER_COOLDOWN_S + 0.1
+        assert router._pick() is r              # the half-open probe
+        assert r.breaker.state == "half_open"
+        assert router._pick() is None           # one probe at a time
+        r.breaker.record_success()
+        assert router._pick() is r
+    finally:
+        router.stop(kill_workers=False)
+
+
+def test_hedged_dispatch_fires_and_first_response_wins():
+    """With the hedge armed and the primary slow past the hedge delay,
+    a second dispatch fires on a peer and the FAST answer wins (counted
+    ``serve.fleet_hedges``); the slow primary's answer is dropped."""
+    environment.set_property("shifu.serve.hedgeMs", "40")
+    obs.set_enabled(True)
+    router = ServeRouter(poll_ms=100, stale_s=60)
+    slow = _stub_backend("slow", delay_s=0.5)
+    fast = _stub_backend("fast", delay_s=0.0)
+    rs = router.add_backend("slow", slow.server_address[1])
+    rf = router.add_backend("fast", fast.server_address[1])
+    rs.state = rf.state = UP
+    rs.requests = 0
+    rf.requests = 1                     # tie-break: slow picked first
+    before = obs.counter("serve.fleet_hedges").value
+    try:
+        t0 = time.monotonic()
+        out = router.score({"records": [{}]}, timeout=10.0)
+        assert out["replica"] == "fast"
+        assert time.monotonic() - t0 < 0.45     # did not wait for slow
+        assert obs.counter("serve.fleet_hedges").value == before + 1
+    finally:
+        router.stop(kill_workers=False)
+        for httpd in (slow, fast):
+            httpd.shutdown()
+            httpd.server_close()
+
+
+def test_hedge_error_does_not_win_while_peer_in_flight():
+    """A first ERROR must not beat a good in-flight hedge: the 500 from
+    the sick primary is held and the healthy peer's answer returns."""
+    environment.set_property("shifu.serve.hedgeMs", "40")
+    environment.set_property("shifu.serve.breakerFailures", "0")
+    router = ServeRouter(poll_ms=100, stale_s=60)
+    sick = _stub_backend("sick", delay_s=0.1, status=500)
+    ok = _stub_backend("ok", delay_s=0.15)
+    r0 = router.add_backend("sick", sick.server_address[1])
+    r1 = router.add_backend("ok", ok.server_address[1])
+    r0.state = r1.state = UP
+    r0.requests, r1.requests = 0, 1
+    try:
+        out = router.score({"records": [{}]}, timeout=10.0)
+        assert out["replica"] == "ok"
+    finally:
+        router.stop(kill_workers=False)
+        for httpd in (sick, ok):
+            httpd.shutdown()
+            httpd.server_close()
+
+
+# ------------------------------------------------------------ HTTP surface
+def test_http_429_retry_after_and_504_deadline_coded():
+    """The worker front door maps the coded errors: admission reject ->
+    429 + Retry-After header, expired-before-launch -> 504 — both carry
+    machine-readable ``error`` codes."""
+    import http.client
+    environment.set_property("shifu.serve.maxQueueRows", "4")
+    srv = ServeServer(models=_nn_models(), key="o", buckets=(1, 4),
+                      max_delay_ms=1.0)
+    rng = np.random.default_rng(6)
+    # NOT started: the queue holds, so the cap binds deterministically
+    srv.batcher.submit_burst(rng.normal(size=(4, 8)).astype(np.float32))
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _make_handler(srv))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    conn = http.client.HTTPConnection("127.0.0.1",
+                                      httpd.server_address[1], timeout=10)
+    try:
+        body = json.dumps({"rows": [[0.0] * 8]})
+        conn.request("POST", "/score", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        doc = json.loads(resp.read())
+        assert resp.status == 429
+        assert doc["error"] == "overloaded"
+        assert doc["retry_after_ms"] > 0
+        assert int(resp.getheader("Retry-After")) >= 1
+        # deadline shed: start the worker, send an already-hopeless
+        # budget — the pump sheds it before launch, coded 504
+        srv.batcher.drain()
+        srv.start()
+        conn.request("POST", "/score", body=body,
+                     headers={"Content-Type": "application/json",
+                              "X-Shifu-Deadline-Ms": "0.001"})
+        resp = conn.getresponse()
+        doc = json.loads(resp.read())
+        assert resp.status == 504
+        assert doc["error"] == "deadline_exceeded"
+    finally:
+        conn.close()
+        httpd.shutdown()
+        httpd.server_close()
+        srv.stop()
+
+
+# ------------------------------------------------------------ brownout mode
+def _brownout_server(tmp_path):
+    return ServeServer(model_set_dir=str(tmp_path), models=_nn_models(),
+                       key="b", buckets=(1, 4), max_delay_ms=2.0)
+
+
+def test_brownout_enter_exit_hysteresis_applies_and_restores(tmp_path):
+    """Queue buildup sustained for 2 checks flips brownout (shrunk
+    flush deadline, sampling/refinement suspended); 3 healthy checks
+    restore every saved setting — asymmetric hysteresis, no flapping."""
+    obs.set_enabled(True)
+    srv = _brownout_server(tmp_path)
+    b = srv.batcher
+    b.trace_sample_rate = 0.25
+    b.refine_every = 500
+    delay0 = b.max_delay_s
+    rng = np.random.default_rng(7)
+    n = QUEUE_BUILDUP_BUCKETS * 4 + 1
+    b.submit_burst(rng.normal(size=(n, 8)).astype(np.float32))
+    assert srv.check_brownout() == "normal"     # 1 stressed check: hold
+    assert srv.check_brownout() == "brownout"   # 2nd: flip
+    assert b.max_delay_s == pytest.approx(delay0 * BROWNOUT_DELAY_FACTOR)
+    assert b.trace_sample_rate == 0.0 and b.refine_every == 0
+    snap = {m["name"]: m for m in obs.snapshot()}
+    assert snap["serve.mode"]["value"] == 1.0
+    assert snap["serve.brownouts"]["value"] == 1.0
+    b.drain()
+    assert srv.check_brownout() == "brownout"   # healthy x1
+    assert srv.check_brownout() == "brownout"   # healthy x2
+    assert srv.check_brownout() == "normal"     # healthy x3: restore
+    assert b.max_delay_s == pytest.approx(delay0)
+    assert b.trace_sample_rate == 0.25 and b.refine_every == 500
+    snap = {m["name"]: m for m in obs.snapshot()}
+    assert snap["serve.mode"]["value"] == 0.0
+    # one stressed blip never flaps the mode back
+    b.submit_burst(rng.normal(size=(n, 8)).astype(np.float32))
+    assert srv.check_brownout() == "normal"
+    b.drain()
+    assert srv.check_brownout() == "normal"
+
+
+def test_brownout_property_disables_governor(tmp_path):
+    environment.set_property("shifu.serve.brownout", "false")
+    srv = _brownout_server(tmp_path)
+    rng = np.random.default_rng(8)
+    n = QUEUE_BUILDUP_BUCKETS * 4 + 1
+    srv.batcher.submit_burst(rng.normal(size=(n, 8)).astype(np.float32))
+    for _ in range(5):
+        assert srv.check_brownout() == "normal"
+    assert srv.brownout is None
+    srv.batcher.drain()
+
+
+def test_brownout_rides_heartbeat_and_monitor_flag(tmp_path):
+    """The mode is operator-visible end to end: heartbeat extras carry
+    ``mode`` and the fleet monitor renders ``<< BROWNOUT``."""
+    from shifu_tpu.obs import monitor as monitor_mod
+    obs.set_enabled(True)
+    srv = _brownout_server(tmp_path)
+    rng = np.random.default_rng(9)
+    n = QUEUE_BUILDUP_BUCKETS * 4 + 1
+    srv.batcher.submit_burst(rng.normal(size=(n, 8)).astype(np.float32))
+    srv.check_brownout()
+    extras = srv._beat_extras()                 # 2nd stressed check
+    assert extras["mode"] == "brownout"
+    hd = obs.health_dir_for(str(tmp_path))
+    os.makedirs(hd)
+    with open(os.path.join(hd, "serve-b.json"), "w") as f:
+        json.dump({"proc": "serve-b", "step": "SERVE",
+                   "state": "running", "ts": time.time(),
+                   "last_progress_ts": time.time(), "interval_s": 5.0,
+                   **extras}, f)
+    text = monitor_mod.render_status(str(tmp_path))
+    assert "<< BROWNOUT" in text
+    srv.batcher.drain()
+
+
+# --------------------------------------------------- die-during-shed drill
+def test_serve_admit_fault_drill_keeps_accounting_consistent():
+    """``serve:admit=1:ioerror`` dies WHILE shed #1 is being rejected:
+    the injected fault surfaces instead of the coded 429, but the queue
+    depth and the SLO shed accounting must read exactly as if the shed
+    had completed — and the NEXT shed (fault disarmed) is again the
+    coded rejection."""
+    assert faults.is_declared_site("serve", "admit")
+    environment.set_property("shifu.faults", "serve:admit=1:ioerror")
+    faults.reset_for_tests()
+    slo = obs.SLOTracker(p99_ms=50.0)
+    clk = FakeClock()
+    b, _ = _batcher(clk, slo=slo, **{"shifu.serve.maxQueueRows": 4})
+    rng = np.random.default_rng(10)
+    t_ok = b.submit_burst(rng.normal(size=(4, 8)).astype(np.float32))
+    with pytest.raises(OSError):
+        b.submit_burst(rng.normal(size=(1, 8)).astype(np.float32))
+    # the drill's contract: death mid-shed corrupted nothing
+    assert b.queue_depth == 4
+    assert b.stats["shed_overload"] == 1
+    assert slo.shed_total == 1
+    with pytest.raises(OverloadedError):        # disarmed: coded again
+        b.submit_burst(rng.normal(size=(1, 8)).astype(np.float32))
+    assert b.stats["shed_overload"] == 2 and slo.shed_total == 2
+    b.pump()
+    assert t_ok.wait(1.0).shape == (4,)         # queued work unharmed
+
+
+def test_slo_sheds_counted_outside_availability_burn():
+    """Sheds ride ``shed`` in the SLO summary, NOT the availability
+    error count — folding load-shedding into burn would drain replicas
+    exactly when the fleet is overloaded (congestion collapse by
+    alerting)."""
+    clk = FakeClock()
+    t = obs.SLOTracker(p99_ms=50.0, clock=clk)
+    t.observe_batch(np.full(100, 0.001))
+    t.record_shed(40)
+    doc = t.summary()
+    assert doc["shed"] == 40
+    assert t.shed_total == 40
+    assert not t.alerts(now=clk.t)              # no availability burn
