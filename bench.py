@@ -32,7 +32,8 @@ def main() -> None:
     ap.add_argument("--plane",
                     choices=("all", "tail", "rf-repeat", "e2e", "resume",
                              "varsel", "serve", "fleet", "overload",
-                             "multihost", "refresh", "quality"),
+                             "multihost", "refresh", "quality",
+                             "ingest"),
                     default="all",
                     help="'tail' = quick disk-tail streamed-GBT bench; "
                          "'rf-repeat' = RF variance triage (cold-compile "
@@ -66,7 +67,13 @@ def main() -> None:
                          "'quality' = model-quality observability plane "
                          "(scorelog on-vs-off saturation QPS, guarded "
                          ">= 0.95x, + time-to-detect a synthetic "
-                         "label flip via the live-AUC monitor)")
+                         "label flip via the live-AUC monitor); "
+                         "'ingest' = one-parse offline pipeline "
+                         "(serial-vs-pooled stats+norm wall-clock on "
+                         "the same generated shards: stats_throughput/"
+                         "norm_throughput are the pooled raw-rows/sec, "
+                         "SHIFU_BENCH_INGEST_ROWS sets the row count, "
+                         "default 2M)")
     ap.add_argument("--compare", nargs="*", metavar="PAYLOAD.json",
                     default=None,
                     help="regression-diff two bench payloads (raw JSON "

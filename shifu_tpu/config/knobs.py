@@ -100,6 +100,14 @@ _DECLS: Tuple[Knob, ...] = (
        "prepared-window pipeline depth (H2D double-buffering)"),
     _k("SHIFU_TPU_PREFETCH", "env", "int", "2",
        "env form of shifu.stream.prefetch"),
+    _k("shifu.ingest.parseWorkers", "property", "int", "-1",
+       "raw-shard parse pool threads (-1 auto min(cores,8); 0 inline)"),
+    _k("shifu.ingest.rawCache", "property", "bool", "true",
+       "columnar raw-parse cache shared across pipeline steps"),
+    _k("shifu.ingest.rawCacheBudgetBytes", "property", "int", "8589934592",
+       "raw cache size budget (bytes; overflow aborts permanently)"),
+    _k("shifu.norm.wireOnly", "property", "bool", "true",
+       "norm emits the clean plane direct-to-wire (no clean npz)"),
     # ---- stats plane
     _k("shifu.stats.onePass", "property", "bool", "true",
        "one-pass fused stats sweep (false restores two-pass)"),
@@ -290,6 +298,8 @@ _DECLS: Tuple[Knob, ...] = (
        "bench serve p99-vs-deadline slop allowance"),
     _k("SHIFU_BENCH_E2E_ROWS", "env", "int", "",
        "bench --plane e2e generated row count"),
+    _k("SHIFU_BENCH_INGEST_ROWS", "env", "int", "2000000",
+       "bench --plane ingest generated row count (serial vs pooled legs)"),
     _k("SHIFU_BENCH_REFRESH_ROWS", "env", "int", "200000",
        "bench --plane refresh base row count (drift stream adds 1/4)"),
     _k("SHIFU_BENCH_WDL_TABLE_ROWS", "env", "int", "",

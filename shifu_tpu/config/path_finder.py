@@ -42,6 +42,12 @@ class PathFinder:
         return os.path.join(self.tmp_dir, "stats")
 
     @property
+    def raw_cache_dir(self) -> str:
+        """Columnar raw-parse cache root (``data/rawcache``) — one
+        subdirectory per (source signature, row identity)."""
+        return os.path.join(self.tmp_dir, "RawCache")
+
+    @property
     def prebin_path(self) -> str:
         """Sketch/quantile output of the binning pass."""
         return os.path.join(self.stats_dir, "prebinning.json")

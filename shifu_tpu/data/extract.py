@@ -28,6 +28,14 @@ class ExtractedChunk:
     categorical: Dict[str, np.ndarray]  # name -> [R] str values
     categorical_cols: List[ColumnConfig]
     raw: Optional[RawChunk] = None
+    # replay provenance (raw cache): positional raw-row index of each kept
+    # row and the chunk's pre-filter row count — every row-wise op in this
+    # extractor commutes with row subsetting, so a cached full extraction
+    # plus (raw_rows, kept_idx) replays any pre-parse Bernoulli sample
+    # bit-identically (sample_mask[kept_idx] selects the same rows the
+    # sample-then-extract order would have kept)
+    kept_idx: Optional[np.ndarray] = None   # [R] int64
+    raw_rows: int = 0
 
 
 class ChunkExtractor:
@@ -56,8 +64,24 @@ class ChunkExtractor:
         self.multiclass = len(self.pos_tags) > 1 and not self.neg_tags
         self.weight_name = ds.weightColumnName
 
+    def row_identity(self) -> dict:
+        """Everything that decides WHICH rows survive extraction and how
+        the shared target/weight columns parse — the raw cache's row-plane
+        staleness key.  Column-independent on purpose: a cache written by
+        one extractor serves any other whose row identity matches exactly
+        and whose numeric/categorical columns are a SUBSET of the cached
+        set (per-column parses are row-wise and independent)."""
+        return {"filters": self.ds.filterExpressions,
+                "missing": sorted(m for m in (self.missing_values or [])),
+                "target": self.target_name,
+                "posTags": [str(t) for t in self.pos_tags],
+                "negTags": [str(t) for t in self.neg_tags],
+                "multiclass": bool(self.multiclass),
+                "weight": self.weight_name}
+
     def extract(self, chunk: RawChunk, keep_raw: bool = False) -> ExtractedChunk:
         df = chunk.data
+        raw_rows = len(df)
         keep = self.purifier.mask(df)
         if self.target_name and self.target_name in df.columns:
             raw_tags = df[self.target_name].to_numpy()
@@ -69,6 +93,7 @@ class ChunkExtractor:
             keep &= ~np.isnan(y)  # drop rows with unknown tags
         else:
             y = np.zeros(len(df))
+        kept_idx = np.flatnonzero(np.asarray(keep, dtype=bool))
         df = df[keep]
         y = y[keep]
         n = len(df)
@@ -92,4 +117,5 @@ class ChunkExtractor:
             n=n, target=y, weight=w, numeric=numeric, numeric_valid=numeric_valid,
             numeric_cols=self.numeric_cols, categorical=categorical,
             categorical_cols=self.categorical_cols,
-            raw=RawChunk(chunk.columns, df) if keep_raw else None)
+            raw=RawChunk(chunk.columns, df) if keep_raw else None,
+            kept_idx=kept_idx, raw_rows=raw_rows)

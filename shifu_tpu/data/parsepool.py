@@ -1,0 +1,253 @@
+"""Parse pool — the offline pipeline's one shared parse stage.
+
+Every raw consumer (stats sweep, norm, correlation, PSI, eval scoring)
+previously ran ``source.iter_chunks()`` + ``extractor.extract()`` inline
+on one host thread — read, then parse, then compute, strictly serial,
+once PER STEP.  :func:`iter_extracted` replaces that pattern with one
+producer/consumer stage shared by all of them:
+
+* **Pool** (``-Dshifu.ingest.parseWorkers``, default ``min(cores, 8)``;
+  ``0`` = the inline seed path): one producer thread streams raw chunks
+  in order (quarantine accounting and provenance byte-identical to the
+  serial loop — it IS the serial loop), N workers run the vectorized
+  parse concurrently (``pd.read_csv``'s C engine and the ``to_numeric``
+  parses release the GIL, so read, parse and the caller's device compute
+  overlap), and emission is strictly in chunk order behind a bounded
+  queue — callers observe the exact serial sequence.
+* **Raw cache** (:mod:`shifu_tpu.data.rawcache`): with a ``cache_root``,
+  the first full-rate pass write-throughs the decoded columns; later
+  passes stream memmap slices and never touch the string plane (no
+  ``iter_chunks`` call at all — ``ingest.disk_passes`` stays flat).
+
+Bit-parity contract: every extractor op is row-wise, so sample-then-
+parse (the serial order) and parse-then-subset (the pooled/cached order)
+produce identical arrays; pre-parse Bernoulli sampling uses one
+deterministic per-chunk substream (``rng([977, chunk_idx])``, the
+convention ``pipeline.stats`` established) in both orders.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from .extract import ChunkExtractor, ExtractedChunk
+from .rawcache import (RawCacheWriter, open_raw_cache, raw_cache_budget_bytes,
+                       raw_cache_enabled, source_signature)
+
+RAW_SAMPLE_SEED = 977          # pre-parse sample substream (stats plane)
+
+# default raw chunk geometry (rows per chunk) — one module-level source
+# of truth so the cache's chunkRows pin and the reader's chunking can
+# never disagree (tests shrink it to force multi-chunk/multi-shard runs)
+CHUNK_ROWS = 262144
+
+
+def parse_workers() -> int:
+    """``shifu.ingest.parseWorkers``: <0 (default) = auto ``min(cores,
+    8)``; 0 = inline serial seed path; N = that many parse threads."""
+    from ..config import environment
+    w = environment.get_int("shifu.ingest.parseWorkers", -1)
+    if w < 0:
+        w = min(os.cpu_count() or 1, 8)
+    return w
+
+
+def sample_raw_mask(raw_rows: int, rate: float, chunk_idx: int) -> np.ndarray:
+    """The deterministic pre-parse Bernoulli mask over a chunk's raw
+    rows — identical across passes and across the serial / pooled /
+    cache-replay orders (seeded per chunk over the RAW row count)."""
+    return np.random.default_rng(
+        [RAW_SAMPLE_SEED, chunk_idx]).random(raw_rows) < rate
+
+
+def _sample_chunk(chunk, rate: float, chunk_idx: int):
+    """Serial order: subset the raw rows BEFORE parsing (skips the parse
+    cost of dropped rows) — the reference samples in its stats mappers
+    (``ModelStatsConf`` sampleRate, ``MapReducerStatsWorker``)."""
+    if rate >= 1.0 or len(chunk.data) == 0:
+        return chunk
+    from .reader import RawChunk
+    keep = sample_raw_mask(len(chunk.data), rate, chunk_idx)
+    return RawChunk(chunk.columns, chunk.data[keep])
+
+
+def subsample_extracted(ex: ExtractedChunk, rate: float,
+                        chunk_idx: int) -> ExtractedChunk:
+    """Cached/pooled order: replay the same pre-parse sample AFTER the
+    full parse — ``mask[kept_idx]`` selects exactly the rows the
+    sample-then-parse order would have kept, and row-wise parses commute
+    with the subset, so the arrays match bit-for-bit."""
+    if rate >= 1.0:
+        return ex
+    smask = sample_raw_mask(ex.raw_rows, rate, chunk_idx)
+    sel = smask[ex.kept_idx] if ex.kept_idx is not None else \
+        np.zeros(ex.n, dtype=bool)
+    return ExtractedChunk(
+        n=int(sel.sum()), target=ex.target[sel], weight=ex.weight[sel],
+        numeric=ex.numeric[sel], numeric_valid=ex.numeric_valid[sel],
+        numeric_cols=ex.numeric_cols,
+        categorical={k: v[sel] for k, v in ex.categorical.items()},
+        categorical_cols=ex.categorical_cols, raw=None,
+        kept_idx=ex.kept_idx[sel] if ex.kept_idx is not None else None,
+        raw_rows=ex.raw_rows)
+
+
+def cache_dir_for(cache_root: str, source_sig,
+                  extractor: ChunkExtractor) -> str:
+    """One cache per (source files, row identity): the training source
+    and each eval source key separate subdirectories, so a pass over one
+    never clobbers the other's cache."""
+    import hashlib
+    import json
+    key = hashlib.md5(json.dumps(
+        [source_sig, extractor.row_identity()],
+        sort_keys=True).encode()).hexdigest()[:16]
+    return os.path.join(cache_root, key)
+
+
+def iter_extracted(source, extractor: ChunkExtractor, *,
+                   rate: float = 1.0, keep_raw: bool = False,
+                   cache_root: Optional[str] = None, start_chunk: int = 0,
+                   chunk_rows: Optional[int] = None
+                   ) -> Iterator[Tuple[int, ExtractedChunk]]:
+    """Yield ``(chunk_idx, ExtractedChunk)`` in strict chunk order.
+
+    Drop-in for the ``enumerate(source.iter_chunks())`` + ``extract()``
+    loops: same chunk indices, same arrays, same quarantine/threshold
+    behavior.  ``start_chunk`` skips extraction of the resumed prefix
+    (the raw rows still stream past, exactly like the serial resume
+    loop's ``continue``).  ``keep_raw`` passes (PSI) parse through the
+    pool but never touch the cache — raw strings are not cached.
+    """
+    from .. import obs
+    if chunk_rows is None:
+        chunk_rows = CHUNK_ROWS
+    rd = None
+    cdir = sig = None
+    writable = False
+    if cache_root and not keep_raw and raw_cache_enabled():
+        sig = source_signature(source.files)
+        cdir = cache_dir_for(cache_root, sig, extractor)
+        rd, writable = open_raw_cache(cdir, sig, extractor, chunk_rows)
+    if rd is not None:                 # serve: zero string-plane touch
+        obs.counter("rawcache.hits").inc()
+        for ci in range(start_chunk, rd.n_chunks):
+            yield ci, subsample_extracted(rd.chunk(ci, extractor), rate, ci)
+        return
+    if cdir is not None:
+        obs.counter("rawcache.misses").inc()
+    writer = None
+    if cdir is not None and writable and start_chunk == 0:
+        writer = RawCacheWriter(cdir, extractor, sig, chunk_rows,
+                                raw_cache_budget_bytes())
+    workers = parse_workers()
+
+    def work(ci, chunk):
+        # cache-writing passes parse at FULL rate (the cache must cover
+        # every row); the consumer view re-applies the sample from the
+        # replay provenance.  Plain passes sample first — serial order.
+        if writer is not None:
+            return extractor.extract(chunk)
+        return extractor.extract(_sample_chunk(chunk, rate, ci),
+                                 keep_raw=keep_raw)
+
+    def emit(ci, ex):
+        if writer is not None:
+            writer.append(ex)          # abandons itself on budget/IO
+            return ci, subsample_extracted(ex, rate, ci)
+        return ci, ex
+
+    done = False
+    try:
+        if workers <= 0:
+            for ci, chunk in enumerate(source.iter_chunks(chunk_rows)):
+                if ci < start_chunk and writer is None:
+                    continue
+                yield emit(ci, work(ci, chunk))
+        else:
+            yield from _pooled(source, extractor, work, emit, writer,
+                               start_chunk, chunk_rows, workers)
+        if writer is not None:
+            writer.finish()
+        done = True
+    finally:
+        if not done and writer is not None:
+            writer.abort()
+
+
+def _pooled(source, extractor, work, emit, writer, start_chunk, chunk_rows,
+            workers):
+    """Producer thread streams chunks in order; a thread pool parses;
+    emission is strictly FIFO behind a bounded future queue."""
+    import queue
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from .. import obs
+    pend: "queue.Queue" = queue.Queue(maxsize=max(2 * workers, 2))
+    stop = threading.Event()
+    exc: list = []
+
+    def produce(pool):
+        try:
+            for ci, chunk in enumerate(source.iter_chunks(chunk_rows)):
+                if ci < start_chunk and writer is None:
+                    continue           # resumed prefix: stream past
+                item = (ci, pool.submit(work, ci, chunk))
+                while not stop.is_set():
+                    try:
+                        pend.put(item, timeout=0.05)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    item[1].cancel()
+                    return
+        except BaseException as e:     # incl. bad-threshold ShifuError
+            exc.append(e)
+        finally:
+            while not stop.is_set():
+                try:
+                    pend.put(None, timeout=0.05)
+                    break
+                except queue.Full:
+                    continue
+
+    stall, t0 = 0.0, time.perf_counter()
+    with ThreadPoolExecutor(max_workers=workers,
+                            thread_name_prefix="parse") as pool:
+        prod = threading.Thread(target=produce, args=(pool,), daemon=True,
+                                name="parse-producer")
+        prod.start()
+        try:
+            while True:
+                item = pend.get()
+                if item is None:
+                    break
+                ci, fut = item
+                tw = time.perf_counter()
+                ex = fut.result()
+                stall += time.perf_counter() - tw
+                yield emit(ci, ex)
+        finally:
+            stop.set()
+            while True:                # unblock a put-blocked producer
+                try:
+                    item = pend.get_nowait()
+                    if item is not None:
+                        item[1].cancel()
+                except queue.Empty:
+                    break
+            prod.join(timeout=10)
+            wall = time.perf_counter() - t0
+            # fraction of the consumer loop spent waiting on parse
+            # futures: ~0 = parse fully hidden behind compute/IO, ~1 =
+            # parse-bound (more workers or a raw cache would help)
+            obs.gauge("ingest.parse_stall_frac").set(
+                stall / max(wall, 1e-9))
+    if exc:
+        raise exc[0]

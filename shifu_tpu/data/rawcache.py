@@ -1,0 +1,395 @@
+"""Columnar raw cache — parse the string plane ONCE per pipeline run.
+
+stats, norm, varselect re-runs, posttrain and eval all stream the same
+raw delimited text and re-run the same string→typed parse
+(``extract.ChunkExtractor``) serially per step — the re-read-everything
+shape of the reference's Pig chain.  This module is the spill-cache idea
+(:mod:`shifu_tpu.data.spill`) applied one plane earlier: the FIRST full
+extraction writes the decoded columns into flat raw files next to a
+``manifest.json`` commit point, and every later step streams ``np.memmap``
+slices instead of touching the string plane at all.
+
+Layout under ``<tmp>/RawCache/``::
+
+    manifest.json      commit point (version, row identity, columns,
+                       per-chunk row counts, categorical vocabularies,
+                       source signature, bytes; ``aborted`` marker on a
+                       permanent budget abort)
+    target.raw         float64 [rows]
+    weight.raw         float64 [rows]
+    numeric.raw        float64 [rows, C_num]     (NaN = missing)
+    numeric_valid.raw  bool    [rows, C_num]
+    kept_idx.raw       int64   [rows]   positional raw-row index of each
+                                        kept row within its chunk
+    cat-<j>.raw        int32   [rows]   vocabulary codes, column j
+
+Cached payload is the FULL (unsampled) extraction plus per-chunk
+``raw_rows`` — every row-wise op in the extractor commutes with row
+subsetting, so a consumer's pre-parse Bernoulli sample replays from
+``kept_idx`` bit-identically (see ``parsepool.subsample_extracted``).
+Categorical values store as vocabulary codes (the reader decodes back to
+the exact string arrays the extractor produced — the raw plane is pure
+strings by construction, ``reader.DataSource``).
+
+Semantics mirror the spill cache: staleness pins the source-file
+``(name, size, mtime_ns)`` signature plus the extractor's row identity;
+writers append under a process-unique tmp suffix and commit raw renames
+then the manifest (``faults rawcache:commit`` fires at that boundary), so
+readers never observe a torn cache — a crash mid-commit leaves only tmp
+files the next writer sweeps; ``shifu.ingest.rawCacheBudgetBytes``
+overflow aborts once and leaves a permanent ``aborted`` marker.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import mmap
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .extract import ChunkExtractor, ExtractedChunk
+from .spill import _tmp_suffix
+
+log = logging.getLogger(__name__)
+
+MANIFEST = "manifest.json"
+RAWCACHE_FORMAT_VERSION = 1
+
+_FIXED = (("target", np.dtype(np.float64), 0),
+          ("weight", np.dtype(np.float64), 0),
+          ("kept_idx", np.dtype(np.int64), 0))
+
+
+def raw_cache_enabled() -> bool:
+    from ..config import environment
+    return environment.get_bool("shifu.ingest.rawCache", True)
+
+
+def raw_cache_budget_bytes() -> int:
+    from ..config import environment
+    return environment.get_int("shifu.ingest.rawCacheBudgetBytes", 1 << 33)
+
+
+def source_signature(files: Sequence[str]) -> List[List]:
+    """[(name, size, mtime_ns)] identity of the raw input files — same
+    convention as the spill cache / norm journal signatures."""
+    out: List[List] = []
+    for f in files:
+        try:
+            st = os.stat(f)
+            out.append([os.path.basename(f), st.st_size, st.st_mtime_ns])
+        except OSError:                        # remote URL: pin by name
+            out.append([f, None, None])
+    return out
+
+
+def _sweep_tmp(directory: str) -> None:
+    """Remove torn tmp segments a killed writer left behind (never
+    half-read: absent manifest == absent cache)."""
+    try:
+        for f in os.listdir(directory):
+            if ".tmp-" in f:
+                try:
+                    os.remove(os.path.join(directory, f))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+
+
+class RawCacheWriter:
+    """Write-through raw cache built during one full-extraction pass.
+
+    Unlike ``SpillWriter`` the dtypes are FIXED by the extraction contract
+    (f64 numerics, i64 kept_idx, i32 codes) — no first-shard narrowing, no
+    mid-stream outgrow abort; only the budget abort is shared."""
+
+    def __init__(self, directory: str, extractor: ChunkExtractor,
+                 source_sig, chunk_rows: int, budget_bytes: int):
+        self.directory = directory
+        self.sig = source_sig
+        self.chunk_rows = int(chunk_rows)
+        self.budget = int(budget_bytes)
+        self.row_identity = extractor.row_identity()
+        self.numeric_names = [c.columnName for c in extractor.numeric_cols]
+        self.cat_names = [c.columnName for c in extractor.categorical_cols]
+        self._suffix = _tmp_suffix()
+        self._files: Dict[str, object] = {}
+        self._vocab_maps: List[Dict[str, int]] = [
+            {} for _ in self.cat_names]
+        self._chunk_kept: List[int] = []
+        self._chunk_raw: List[int] = []
+        self._rows = 0
+        self._bytes = 0
+        self._dead = False
+        os.makedirs(directory, exist_ok=True)
+        _sweep_tmp(directory)
+
+    def _keys(self) -> List[str]:
+        return ([k for k, _, _ in _FIXED] + ["numeric", "numeric_valid"]
+                + [f"cat-{j}" for j in range(len(self.cat_names))])
+
+    def _raw_path(self, key: str) -> str:
+        return os.path.join(self.directory, key + ".raw")
+
+    def append(self, ex: ExtractedChunk) -> bool:
+        """Append one chunk's full extraction.  Returns False once the
+        cache is abandoned (budget / IO error) — the caller keeps
+        parsing, unaffected."""
+        if self._dead:
+            return False
+        try:
+            if not self._files:
+                for k in self._keys():
+                    self._files[k] = open(self._raw_path(k) + self._suffix,
+                                          "wb")
+            import pandas as pd
+            parts: Dict[str, np.ndarray] = {
+                "target": np.ascontiguousarray(ex.target, np.float64),
+                "weight": np.ascontiguousarray(ex.weight, np.float64),
+                "kept_idx": np.ascontiguousarray(ex.kept_idx, np.int64),
+                "numeric": np.ascontiguousarray(ex.numeric, np.float64),
+                "numeric_valid": np.ascontiguousarray(
+                    ex.numeric_valid, np.bool_)}
+            for j, name in enumerate(self.cat_names):
+                vmap = self._vocab_maps[j]
+                s = pd.Series(ex.categorical[name], dtype=object)
+                codes = s.map(vmap)
+                na = codes.isna()
+                if bool(na.any()):
+                    for v in pd.unique(s[na]):
+                        vmap[v] = len(vmap)
+                    codes = s.map(vmap)
+                parts[f"cat-{j}"] = np.ascontiguousarray(
+                    codes.to_numpy(np.int64), np.int32)
+            nb = sum(a.nbytes for a in parts.values())
+            if self._bytes + nb > self.budget:
+                self.abort(mark=f"budget {self.budget} bytes exceeded at "
+                                f"row {self._rows}")
+                return False
+            for k, a in parts.items():
+                a.tofile(self._files[k])
+            self._rows += ex.n
+            self._bytes += nb
+            self._chunk_kept.append(int(ex.n))
+            self._chunk_raw.append(int(ex.raw_rows))
+            return True
+        except OSError:
+            self.abort()
+            return False
+
+    def finish(self) -> bool:
+        """Commit: raw renames, then the manifest (the commit point)."""
+        if self._dead:
+            return False
+        try:
+            from .. import faults, obs
+            from ..ioutil import io_retry
+            for f in self._files.values():
+                f.close()
+            for k in self._files:
+                os.replace(self._raw_path(k) + self._suffix,
+                           self._raw_path(k))
+            man = {"version": RAWCACHE_FORMAT_VERSION,
+                   "rowIdentity": self.row_identity,
+                   "numericCols": self.numeric_names,
+                   "categoricalCols": self.cat_names,
+                   "vocabs": [sorted(m, key=m.get)
+                              for m in self._vocab_maps],
+                   "rows": self._rows,
+                   "chunkKept": self._chunk_kept,
+                   "chunkRaw": self._chunk_raw,
+                   "chunkRows": self.chunk_rows,
+                   "bytes": self._bytes,
+                   "source": self.sig}
+            tmp = os.path.join(self.directory, MANIFEST + self._suffix)
+
+            def write():
+                faults.fire("rawcache", "commit", 0, path=tmp)
+                with open(tmp, "w") as f:
+                    json.dump(man, f)
+                os.replace(tmp, os.path.join(self.directory, MANIFEST))
+            io_retry(write, "raw cache manifest commit", self.directory)
+            obs.counter("rawcache.bytes_written").inc(self._bytes)
+            self._dead = True
+            return True
+        except OSError:
+            self.abort()
+            return False
+
+    def abort(self, mark: Optional[str] = None) -> None:
+        """Drop the half-written cache; ``mark`` records a permanent
+        reason (budget) so later passes don't re-attempt."""
+        if self._dead:
+            return
+        self._dead = True
+        for f in self._files.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        for k in self._files:
+            try:
+                os.remove(self._raw_path(k) + self._suffix)
+            except OSError:
+                pass
+        if mark:
+            try:
+                from ..ioutil import io_retry
+                man = {"version": RAWCACHE_FORMAT_VERSION,
+                       "rowIdentity": self.row_identity,
+                       "aborted": mark,
+                       "source": self.sig}
+                tmp = os.path.join(self.directory, MANIFEST + self._suffix)
+
+                def write():
+                    with open(tmp, "w") as f:
+                        json.dump(man, f)
+                    os.replace(tmp, os.path.join(self.directory, MANIFEST))
+                io_retry(write, "raw cache abort marker", self.directory)
+            except OSError:
+                pass
+
+
+class RawCacheReader:
+    """mmap view over a committed raw cache; serves ``ExtractedChunk``s
+    for any extractor whose columns are a subset of the cached set."""
+
+    def __init__(self, directory: str, man: dict):
+        self.directory = directory
+        self.man = man
+        self.rows = int(man["rows"])
+        self.chunk_kept = [int(x) for x in man["chunkKept"]]
+        self.chunk_raw = [int(x) for x in man["chunkRaw"]]
+        self.numeric_names = list(man["numericCols"])
+        self.cat_names = list(man["categoricalCols"])
+        self.vocab_arrays = [np.asarray(v, dtype=object)
+                             for v in man["vocabs"]]
+        self.cum = np.concatenate(
+            [[0], np.cumsum(self.chunk_kept)]).astype(np.int64)
+        self._mms: Dict[str, np.memmap] = {}
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self.chunk_kept)
+
+    def _memmap(self, key: str, dtype: np.dtype, trailing: int) -> np.memmap:
+        mm = self._mms.get(key)
+        if mm is None:
+            from ..ioutil import io_retry
+            shape = (self.rows, trailing) if trailing else (self.rows,)
+            path = os.path.join(self.directory, key + ".raw")
+            mm = io_retry(
+                lambda: np.memmap(path, dtype=dtype, mode="r", shape=shape),
+                "raw cache mmap open", path)
+            try:
+                mm._mmap.madvise(mmap.MADV_SEQUENTIAL)
+            except (AttributeError, ValueError, OSError):
+                pass
+            self._mms[key] = mm
+        return mm
+
+    def serves(self, extractor: ChunkExtractor) -> bool:
+        """True when this cache can stand in for ``extractor``'s parse:
+        row identity matches exactly and the requested columns are a
+        subset of the cached set."""
+        if self.man.get("rowIdentity") != extractor.row_identity():
+            return False
+        cached_num = set(self.numeric_names)
+        cached_cat = set(self.cat_names)
+        return (all(c.columnName in cached_num
+                    for c in extractor.numeric_cols)
+                and all(c.columnName in cached_cat
+                        for c in extractor.categorical_cols))
+
+    def chunk(self, ci: int, extractor: ChunkExtractor) -> ExtractedChunk:
+        """Rebuild chunk ``ci``'s full extraction for ``extractor`` —
+        values bit-identical to a fresh parse (parses are element-wise;
+        codes decode to the exact cached strings)."""
+        s, e = int(self.cum[ci]), int(self.cum[ci + 1])
+        n = e - s
+        C = len(self.numeric_names)
+        target = np.asarray(self._memmap(
+            "target", np.dtype(np.float64), 0)[s:e])
+        weight = np.asarray(self._memmap(
+            "weight", np.dtype(np.float64), 0)[s:e])
+        kept_idx = np.asarray(self._memmap(
+            "kept_idx", np.dtype(np.int64), 0)[s:e])
+        if extractor.numeric_cols:
+            cols = [self.numeric_names.index(c.columnName)
+                    for c in extractor.numeric_cols]
+            num_all = np.asarray(self._memmap(
+                "numeric", np.dtype(np.float64), C)[s:e])
+            val_all = np.asarray(self._memmap(
+                "numeric_valid", np.dtype(np.bool_), C)[s:e])
+            numeric = np.ascontiguousarray(num_all[:, cols])
+            numeric_valid = np.ascontiguousarray(val_all[:, cols])
+        else:
+            numeric = np.zeros((n, 0))
+            numeric_valid = np.zeros((n, 0), dtype=bool)
+        categorical: Dict[str, np.ndarray] = {}
+        for cc in extractor.categorical_cols:
+            j = self.cat_names.index(cc.columnName)
+            codes = np.asarray(self._memmap(
+                f"cat-{j}", np.dtype(np.int32), 0)[s:e])
+            categorical[cc.columnName] = self.vocab_arrays[j][codes] \
+                if len(self.vocab_arrays[j]) else \
+                np.empty(n, dtype=object)
+        return ExtractedChunk(
+            n=n, target=target, weight=weight, numeric=numeric,
+            numeric_valid=numeric_valid,
+            numeric_cols=extractor.numeric_cols, categorical=categorical,
+            categorical_cols=extractor.categorical_cols, raw=None,
+            kept_idx=kept_idx, raw_rows=int(self.chunk_raw[ci]))
+
+
+def open_raw_cache(directory: str, source_sig,
+                   extractor: ChunkExtractor,
+                   chunk_rows: int) -> Tuple[Optional[RawCacheReader], bool]:
+    """(reader, writable): ``reader`` is a committed cache that serves
+    ``extractor``, or None; ``writable`` says whether a cold pass should
+    (re)build one — False when a marker records a permanent abort for
+    this exact source, or when a valid cache exists for this source that
+    just doesn't cover the requested columns (rebuilding would thrash)."""
+    path = os.path.join(directory, MANIFEST)
+
+    def read():
+        if not os.path.isfile(path):   # absence is final, not transient
+            return None
+        with open(path) as f:
+            return json.load(f)
+    try:
+        from ..ioutil import io_retry
+        man = io_retry(read, "raw cache manifest read", path)
+        if man is None:
+            return None, True
+    except (OSError, ValueError):
+        return None, True
+    if man.get("version") != RAWCACHE_FORMAT_VERSION \
+            or man.get("source") != source_sig:
+        return None, True                      # stale source
+    if man.get("aborted"):
+        return None, False
+    try:
+        if int(man.get("chunkRows", 0)) != int(chunk_rows):
+            return None, True
+        rd = RawCacheReader(directory, man)
+        rows, C = rd.rows, len(rd.numeric_names)
+        sizes = [("target", 8), ("weight", 8), ("kept_idx", 8),
+                 ("numeric", 8 * max(C, 0)), ("numeric_valid", max(C, 0))]
+        sizes += [(f"cat-{j}", 4) for j in range(len(rd.cat_names))]
+        for key, row_bytes in sizes:
+            if rows and row_bytes and os.path.getsize(
+                    os.path.join(directory, key + ".raw")) \
+                    < rows * row_bytes:
+                return None, True              # torn raw file
+        if not rd.serves(extractor):
+            # committed + fresh but the column set doesn't cover this
+            # consumer: don't rebuild over a cache other steps still use
+            return None, False
+        return rd, False
+    except (OSError, KeyError, ValueError, TypeError):
+        return None, True

@@ -228,6 +228,10 @@ class DataSource:
         from ..config import environment
         from ..config.errors import ErrorCode, ShifuError
         from ..ioutil import io_retry
+        # each call is one full raw-plane traversal — the e2e "how many
+        # times did the pipeline re-read its input" metric (cache-served
+        # passes never get here)
+        obs.counter("ingest.disk_passes").inc()
         bytes_c = obs.counter("ingest.bytes_read")
         if self.parquet:
             yield from self._iter_parquet(chunk_rows)

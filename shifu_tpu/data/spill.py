@@ -241,6 +241,168 @@ class SpillWriter:
         io_retry(write, "spill manifest commit", self.directory)
 
 
+def wire_dir(shards_dir: str, keys: Sequence[str]) -> str:
+    """The direct-to-wire norm output directory.  Deliberately NOT
+    routed through ``spill_base_dir``'s ``shifu.stream.spillDir``
+    override: the wire plane IS the materialized dataset (norm's
+    output), not a cache placement choice — it lives with its schema."""
+    return os.path.join(shards_dir, ".spill_cache",
+                        "spill-" + "-".join(keys))
+
+
+class WireWriter:
+    """Per-shard durable spill writer — norm's direct-to-wire output.
+
+    ``SpillWriter`` commits once at ``finish``; this writer re-commits
+    the manifest after EVERY shard append, so the committed wire prefix
+    always matches the norm journal's committed-shard prefix and a crash
+    never loses a committed shard (a torn append leaves raw-file tail
+    bytes past the manifest's row count — harmless, and :meth:`resume`
+    truncates them).  Dtypes/shapes are fixed up front (norm knows the
+    bins wire dtype before the first row), so none of ``SpillWriter``'s
+    first-shard narrowing or mid-stream outgrow aborts apply.  Write
+    failures raise — the wire plane is the dataset, not an optimization
+    a caller can shrug off."""
+
+    def __init__(self, directory: str, keys: Sequence[str],
+                 dtypes: Dict[str, np.dtype], trailing: Dict[str, tuple],
+                 source_sig):
+        self.directory = directory
+        self.keys = tuple(keys)
+        self._dtypes = {k: np.dtype(dtypes[k]) for k in self.keys}
+        self._shapes = {k: tuple(trailing.get(k, ())) for k in self.keys}
+        self.sig = source_sig
+        self._suffix = _tmp_suffix()
+        self._files: Dict[str, object] = {}
+        self._shard_rows: List[int] = []
+        self._rows = 0
+        os.makedirs(directory, exist_ok=True)
+
+    def _raw_path(self, key: str) -> str:
+        return os.path.join(self.directory, key + ".raw")
+
+    def _row_bytes(self, key: str) -> int:
+        return int(np.prod(self._shapes[key] or (1,), dtype=np.int64)) \
+            * self._dtypes[key].itemsize
+
+    @property
+    def n_shards(self) -> int:
+        return len(self._shard_rows)
+
+    @classmethod
+    def resume(cls, directory: str, keys: Sequence[str],
+               dtypes: Dict[str, np.dtype], trailing: Dict[str, tuple],
+               source_sig, n_shards: int) -> Optional["WireWriter"]:
+        """Adopt the committed prefix of an interrupted wire plane: the
+        manifest must cover >= ``n_shards`` shards of this exact source/
+        layout; raw files truncate to exactly those rows (dropping any
+        tail bytes a mid-append crash left) and the returned writer is
+        positioned after them.  None = unusable, rebuild from scratch."""
+        try:
+            with open(os.path.join(directory, MANIFEST)) as f:
+                man = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if (man.get("version") != SPILL_FORMAT_VERSION or man.get("aborted")
+                or list(man.get("keys") or []) != list(keys)
+                or man.get("source") != source_sig
+                or len(man.get("shard_rows") or []) < n_shards):
+            return None
+        w = cls(directory, keys, dtypes, trailing, source_sig)
+        try:
+            for k in keys:
+                if np.dtype(man["dtypes"][k]) != w._dtypes[k] or \
+                        tuple(man["shapes"][k]) != w._shapes[k]:
+                    return None
+        except KeyError:
+            return None
+        w._shard_rows = [int(x) for x in man["shard_rows"][:n_shards]]
+        w._rows = sum(w._shard_rows)
+        try:
+            for k in keys:
+                need = w._rows * w._row_bytes(k)
+                path = w._raw_path(k)
+                if os.path.getsize(path) < need:
+                    w.close()
+                    return None
+                with open(path, "r+b") as f:
+                    f.truncate(need)
+                w._files[k] = open(path, "ab")
+            w._commit_manifest()       # re-pin to the adopted prefix
+        except OSError:
+            w.close()
+            return None
+        return w
+
+    def append(self, part: Dict[str, np.ndarray]) -> None:
+        """Append one shard's columns and durably commit the manifest."""
+        if not self._files:
+            for k in self.keys:
+                self._files[k] = open(self._raw_path(k), "wb")
+        n = int(len(next(iter(part.values()))))
+        for k in self.keys:
+            a = np.asarray(part[k])
+            if a.shape[1:] != self._shapes[k]:
+                raise ValueError(f"wire column {k!r}: shard shape "
+                                 f"{a.shape[1:]} != {self._shapes[k]}")
+            if a.dtype != self._dtypes[k]:
+                a = a.astype(self._dtypes[k])
+            np.ascontiguousarray(a).tofile(self._files[k])
+        self._rows += n
+        self._shard_rows.append(n)
+        self._commit_manifest()
+
+    def truncate_to(self, n_shards: int) -> None:
+        """Drop every shard past ``n_shards`` (a resumed shard's replay
+        diverged from the journal — it and everything after re-run)."""
+        self._shard_rows = self._shard_rows[:n_shards]
+        self._rows = sum(self._shard_rows)
+        for k in self.keys:
+            f = self._files.get(k)
+            if f is not None:
+                f.close()
+            with open(self._raw_path(k), "r+b") as g:
+                g.truncate(self._rows * self._row_bytes(k))
+            self._files[k] = open(self._raw_path(k), "ab")
+        self._commit_manifest()
+
+    def finish(self) -> None:
+        """Close out; zero-shard planes still land an (empty) manifest so
+        readers see a committed-but-empty wire plane, not a torn one."""
+        if not self._shard_rows:
+            self._commit_manifest()
+        self.close()
+
+    def close(self) -> None:
+        for f in self._files.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._files = {}
+
+    def _commit_manifest(self) -> None:
+        from ..ioutil import io_retry
+        man = {"version": SPILL_FORMAT_VERSION,
+               "keys": list(self.keys),
+               "dtypes": {k: self._dtypes[k].str for k in self.keys},
+               "shapes": {k: list(self._shapes[k]) for k in self.keys},
+               "rows": self._rows,
+               "shard_rows": list(self._shard_rows),
+               "bytes": sum(self._rows * self._row_bytes(k)
+                            for k in self.keys),
+               "source": self.sig}
+        tmp = os.path.join(self.directory, MANIFEST + self._suffix)
+
+        def write():
+            for f in self._files.values():
+                f.flush()
+            with open(tmp, "w") as f:
+                json.dump(man, f)
+            os.replace(tmp, os.path.join(self.directory, MANIFEST))
+        io_retry(write, "wire manifest commit", self.directory)
+
+
 class SpillReader:
     """mmap view over a committed spill."""
 

@@ -223,10 +223,18 @@ class ShardStream:
         return spill_dir_for(self.shards.directory, self.keys)
 
     def _spill_reader(self):
-        if not self.spill or self._spill_off:
-            return None
         if self._spill_rd is not None:
             return self._spill_rd
+        # direct-to-wire shard sets ARE a spill: serve them mmap-first,
+        # regardless of the spill knob (there are no npz to stream and
+        # nothing to write through — the wire is the dataset)
+        wire = self.shards.wire_reader(self.keys) \
+            if hasattr(self.shards, "wire_reader") else None
+        if wire is not None:
+            self._spill_rd = wire
+            return wire
+        if not self.spill or self._spill_off:
+            return None
         from .spill import open_spill
         try:
             rd, writable = open_spill(self._spill_dir(), self.keys,

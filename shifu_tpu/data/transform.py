@@ -74,7 +74,11 @@ class DatasetTransformer:
     def width(self) -> int:
         return len(self.output_names)
 
-    def transform(self, chunk: RawChunk) -> TransformedChunk:
+    def transform(self, chunk) -> TransformedChunk:
+        """Raw chunk OR already-extracted chunk (the parse pool / raw
+        cache hand out :class:`ExtractedChunk` directly) -> transformed."""
+        if isinstance(chunk, ExtractedChunk):
+            return self.transform_extracted(chunk)
         ex = self.extractor.extract(chunk)
         return self.transform_extracted(ex)
 

@@ -90,7 +90,7 @@ def load_holdout(model_set_dir: str,
     clean_dir = os.path.join(model_set_dir, "tmp", "CleanedData")
     if os.path.isfile(os.path.join(clean_dir, "schema.json")):
         clean = Shards.open(clean_dir)
-        if len(clean.files) == len(norm.files):
+        if clean.n_shards == norm.n_shards:
             cparts = [p for p in clean.iter_shards(start=start,
                                                    strict=True)]
             bins = np.concatenate([p["bins"] for p in cparts])[-max_rows:]

@@ -19,6 +19,11 @@ Spec grammar (env ``SHIFU_TPU_FAULTS`` or property ``-Dshifu.faults``)::
 Sites/points wired today (grep ``faults.fire`` for the live set):
 
     norm:shard=<k>      before shard k's commit record lands
+    norm:wire=<k>       before shard k's rows append to the direct-to-
+                        wire plane (a kill leaves truncatable tail
+                        bytes past the last committed wire manifest)
+    rawcache:commit=0   raw-cache manifest commit (a kill leaves only
+                        tmp files — absent manifest == absent cache)
     stats:chunk=<ci>    before chunk ci is absorbed by the accumulators
     train:tree=<ti>     after tree ti's progress line (GBT/RF)
     train:superbatch=<k>  after disk-tail super-batch drain k lands its
@@ -132,6 +137,14 @@ SITES: dict = {
     ("refresh", "rollback"): "before a probation-failure rollback "
                              "re-flips the registry to the previous "
                              "generation",
+    ("rawcache", "commit"): "before the raw-cache manifest commit — a "
+                            "kill/truncate here must leave only tmp "
+                            "files (absent manifest == absent cache) "
+                            "the next writer sweeps and rebuilds",
+    ("norm", "wire"): "before shard k's rows append to the wire plane "
+                      "— a kill here leaves raw-file tail bytes past "
+                      "the last committed manifest; the journal resume "
+                      "truncates them and re-lands the shard",
 }
 
 

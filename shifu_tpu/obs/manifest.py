@@ -39,6 +39,20 @@ MANIFEST: Dict[str, Tuple[str, str]] = {
     "ingest.retries": ("counter", "transient IO errors absorbed by retry"),
     "ingest.rows_padded": ("counter",
                            "zero-weight pad rows added to fill windows"),
+    "ingest.parse_stall_frac": ("gauge",
+                                "fraction of the parse-pool consumer "
+                                "loop spent blocked on parse futures "
+                                "(~0 = parse hidden, ~1 = parse-bound)"),
+    # ---- one-parse raw cache (data/rawcache)
+    "rawcache.hits": ("counter",
+                      "raw passes served from the columnar raw cache "
+                      "(zero string-plane touch)"),
+    "rawcache.misses": ("counter",
+                        "raw passes that parsed the string plane with "
+                        "a cache root configured"),
+    "rawcache.bytes_written": ("counter",
+                               "decoded-column bytes committed into "
+                               "the raw cache"),
     # ---- data hygiene
     "data.quarantined_rows": ("counter", "rows quarantined as unreadable"),
     "data.quarantined_shards": ("counter", "shards quarantined as torn"),

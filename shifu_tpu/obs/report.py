@@ -178,10 +178,24 @@ def _render_block(block: Dict[str, Any], out: List[str]) -> float:
         out.append(f"  ingest stall fraction: {frac:.1%} "
                    f"({float(wait):.3f}s blocked on ingest of "
                    f"{total:.3f}s wall)")
+    # parse stall: fraction of the step's wall-clock the consumer spent
+    # blocked on the raw-shard parse pool (0 when the pool keeps ahead
+    # of the accumulators or the pass was served from the raw cache)
+    mvals = {m.get("name"): m.get("value") for m in block["metrics"]}
+    pstall = mvals.get("ingest.parse_stall_frac")
+    if pstall is not None:
+        out.append(f"  parse stall fraction: {float(pstall):.1%} "
+                   "(consumer blocked on the parse pool)")
+    hits, misses = (mvals.get("rawcache.hits"),
+                    mvals.get("rawcache.misses"))
+    if hits or misses:
+        mb = (mvals.get("rawcache.bytes_written") or 0) / 1e6
+        out.append(f"  raw cache: {int(hits or 0)} pass(es) served "
+                   f"decoded, {int(misses or 0)} parsed from text"
+                   + (f", {mb:,.1f} MB written" if mb else ""))
     # disk-tail plane: how often the out-of-core remainder re-streamed
     # (the super-batch schedule's cost driver — passes, not rows, are
     # what the one-pass-feeds-everything restructure bounds)
-    mvals = {m.get("name"): m.get("value") for m in block["metrics"]}
     sweeps = mvals.get("train.tail_sweeps")
     if sweeps:
         passes = mvals.get("ingest.disk_passes") or 0

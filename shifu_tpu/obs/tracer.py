@@ -114,7 +114,17 @@ from typing import Any, Dict, List, Optional
 # total OUTSIDE availability burn, and the bench's ``--plane overload``
 # extras (``serve_overload_goodput`` tracked via the new ``*_goodput``
 # throughput suffix, ``serve_overload_p99_ms``, shed fractions)
-SCHEMA_VERSION = 13
+# v14: one-parse offline pipeline — ``rawcache.hits`` / ``rawcache.
+# misses`` / ``rawcache.bytes_written`` counters (the columnar raw-parse
+# cache shared across stats/norm/eval), the ``ingest.parse_stall_frac``
+# gauge (parse-pool consumer stall; the report's parse-stall line),
+# ``ingest.disk_passes`` now also counts raw string-plane traversals
+# (``DataSource.iter_chunks``) so the cold-vs-cached e2e delta is
+# telemetry-backed, and the bench's ``--plane ingest`` extras
+# (``stats_throughput`` / ``norm_throughput`` serial-vs-pooled) +
+# ``pipeline_e2e_wall_s`` / ``pipeline_e2e_disk_passes`` on ``--plane
+# e2e``
+SCHEMA_VERSION = 14
 
 _TRUE = ("1", "true", "on", "yes")
 

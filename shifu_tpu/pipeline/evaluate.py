@@ -31,6 +31,7 @@ from .. import ioutil, obs
 from ..config.model_config import EvalConfig, RawSourceData
 from ..config.validator import ModelStep
 from ..data import DataSource
+from ..data.parsepool import iter_extracted
 from ..eval.metrics import evaluate_scores, gain_chart_rows
 from ..eval.scorer import ModelRunner, Scorer
 from .processor import BasicProcessor
@@ -78,8 +79,10 @@ class EvalProcessor(BasicProcessor):
             with ioutil.atomic_open(out, newline="") as f:
                 w = csv.writer(f, delimiter="|")
                 header_written = False
-                for chunk in source.iter_chunks():
-                    tc = tf.transform(chunk)
+                for _ci, ex in iter_extracted(
+                        source, tf.extractor,
+                        cache_root=self.paths.raw_cache_dir):
+                    tc = tf.transform_extracted(ex)
                     if tc.n == 0:
                         continue
                     if not header_written:
@@ -174,8 +177,10 @@ class EvalProcessor(BasicProcessor):
             w = csv.writer(sf, delimiter="|")
             w.writerow(["tag", "weight", "mean", "max", "min", "median"]
                        + [f"model{i}" for i in range(n_models)])
-            for chunk in source.iter_chunks():
-                out = runner.compute(chunk)
+            for _ci, ex in iter_extracted(
+                    source, runner.transformer.extractor,
+                    cache_root=self.paths.raw_cache_dir):
+                out = runner.compute(ex)
                 if out["n"] == 0:
                     continue
                 if drift is not None:
@@ -287,8 +292,10 @@ class EvalProcessor(BasicProcessor):
             w = csv.writer(sf, delimiter="|")
             w.writerow(["tag", "weight", "predictedTag"]
                        + [f"score_{t}" for t in tags])
-            for chunk in source.iter_chunks():
-                out = runner.compute_classes(chunk)
+            for _ci, ex in iter_extracted(
+                    source, runner.transformer.extractor,
+                    cache_root=self.paths.raw_cache_dir):
+                out = runner.compute_classes(ex)
                 if out["n"] == 0:
                     continue
                 cs = out["class_scores"]

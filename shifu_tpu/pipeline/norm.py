@@ -32,7 +32,9 @@ import numpy as np
 from .. import faults, obs
 from ..config.validator import ModelStep
 from ..data import DataSource, sample_mask
+from ..data.parsepool import iter_extracted
 from ..data.shards import bins_wire_dtype
+from ..data.spill import WireWriter, wire_dir
 from ..data.transform import DatasetTransformer
 from ..ioutil import atomic_savez, atomic_write_json
 from .processor import BasicProcessor
@@ -40,6 +42,7 @@ from .processor import BasicProcessor
 log = logging.getLogger(__name__)
 
 SHARD_ROWS = 1 << 18
+WIRE_KEYS = ("bins", "y", "w")
 
 
 class NormalizeProcessor(BasicProcessor):
@@ -57,8 +60,15 @@ class NormalizeProcessor(BasicProcessor):
         # -shuffle rewrites every shard at the end, so mid-step resume
         # is meaningless there (the journal resets and the run is clean).
         do_shuffle = bool(self.params.get("shuffle"))
-        items = self.journal.arm(self._signature(source),
-                                 resume=not do_shuffle)
+        from ..config import environment
+        # direct-to-wire: the clean plane lands as the flat spill layout
+        # train consumes (no clean npz at all) — the cold train sweep
+        # does zero zip decode and zero write-through pass.  -shuffle
+        # falls back to npz (it rewrites every shard at the end anyway).
+        wire_only = environment.get_bool("shifu.norm.wireOnly", True) \
+            and not do_shuffle
+        sig = self._signature(source, wire_only)
+        items = self.journal.arm(sig, resume=not do_shuffle)
         committed: Dict[int, dict] = {}
         for name, meta in items.items():
             if name.startswith("shard-"):
@@ -66,19 +76,6 @@ class NormalizeProcessor(BasicProcessor):
         resume_upto = 0                 # first uncommitted shard index
         while resume_upto in committed:
             resume_upto += 1
-        keep_names = {f"part-{k:05d}.npz" for k in range(resume_upto)}
-        for d in (norm_dir, clean_dir):
-            os.makedirs(d, exist_ok=True)
-            for f in os.listdir(d):
-                if f in keep_names:
-                    continue
-                p = os.path.join(d, f)
-                # subdirs too: a previous train left its .spill_cache here
-                shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
-        if resume_upto:
-            obs.counter("norm.resumed_shards").inc(resume_upto)
-            log.info("norm: resuming — %d committed shard(s) verified, "
-                     "restart at shard %d", resume_upto, resume_upto)
 
         # compact bins storage: the narrowest dtype the ColumnConfig bin
         # space fits (uint8 for <=256 bins) — the same wire format the
@@ -87,9 +84,56 @@ class NormalizeProcessor(BasicProcessor):
         n_bins = max((c.num_bins() + 1 for c in transformer.columns),
                      default=2)
         self._bins_dtype = bins_wire_dtype(n_bins)
+        wire_sig = {"norm": hashlib.md5(
+            json.dumps(sig, sort_keys=True).encode()).hexdigest()}
+        wdir = wire_dir(clean_dir, WIRE_KEYS)
+        wire_dtypes = {"bins": self._bins_dtype,
+                       "y": np.dtype(np.float32), "w": np.dtype(np.float32)}
+        wire_trailing = {"bins": (len(transformer.columns),),
+                         "y": (), "w": ()}
+        wire: Optional[WireWriter] = None
+        if wire_only and resume_upto:
+            # adopt the committed wire prefix (truncating any torn tail);
+            # unusable wire state ⇒ the resume is void — restart clean so
+            # npz journal records never point at missing wire rows
+            wire = WireWriter.resume(wdir, WIRE_KEYS, wire_dtypes,
+                                     wire_trailing, wire_sig, resume_upto)
+            if wire is None:
+                log.warning("norm: journal offers %d committed shard(s) "
+                            "but the wire plane does not cover them — "
+                            "restarting from shard 0", resume_upto)
+                committed, resume_upto = {}, 0
+        keep_names = {f"part-{k:05d}.npz" for k in range(resume_upto)}
+        for d in (norm_dir, clean_dir):
+            os.makedirs(d, exist_ok=True)
+            for f in os.listdir(d):
+                if f in keep_names:
+                    continue
+                p = os.path.join(d, f)
+                if wire is not None and d == clean_dir \
+                        and f == ".spill_cache":
+                    # the adopted wire prefix lives here — clear only its
+                    # siblings (stale spills over the old npz)
+                    for g in os.listdir(p):
+                        gp = os.path.join(p, g)
+                        if gp != wdir:
+                            shutil.rmtree(gp) if os.path.isdir(gp) \
+                                else os.remove(gp)
+                    continue
+                # subdirs too: a previous train left its .spill_cache here
+                shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
+        if wire_only and wire is None:
+            wire = WireWriter(wdir, WIRE_KEYS, wire_dtypes, wire_trailing,
+                              wire_sig)
+        if resume_upto:
+            obs.counter("norm.resumed_shards").inc(resume_upto)
+            log.info("norm: resuming — %d committed shard(s) verified, "
+                     "restart at shard %d", resume_upto, resume_upto)
+
         self._shard_counts: List[int] = []
         self._resume_upto = resume_upto
         self._committed = committed
+        self._wire = wire
 
         rate = mc.normalize.sampleRate
         neg_only = mc.normalize.sampleNegOnly
@@ -102,8 +146,13 @@ class NormalizeProcessor(BasicProcessor):
         drift = obs.start_drift_monitor(transformer.columns)
         t0 = time.perf_counter()
         with self.phase("transform") as ph:
-            for chunk in source.iter_chunks():
-                tc = transformer.transform(chunk)
+            # one-parse plane: pooled parallel parse on a cold raw plane,
+            # mmap replay of the columnar raw cache when stats already
+            # paid for the parse (zero string-plane touch)
+            for ci, ex in iter_extracted(
+                    source, transformer.extractor,
+                    cache_root=self.paths.raw_cache_dir):
+                tc = transformer.transform_extracted(ex)
                 if tc.n == 0:
                     continue
                 if drift is not None:
@@ -125,6 +174,8 @@ class NormalizeProcessor(BasicProcessor):
                             bufw)
                 shard += 1
             ph.set(rows=total_out)
+        if wire is not None:
+            wire.finish()
         if do_shuffle:
             with self.phase("shuffle"):
                 self._shard_counts = self._shuffle(norm_dir) \
@@ -152,12 +203,20 @@ class NormalizeProcessor(BasicProcessor):
             "width": transformer.width,
         }
         atomic_write_json(os.path.join(norm_dir, "schema.json"), schema)
-        atomic_write_json(os.path.join(clean_dir, "schema.json"), schema)
+        clean_schema = dict(schema)
+        if wire is not None:
+            # the clean plane is wire-backed: Shards.open serves it as
+            # mmap slices; the signature pins schema <-> spill manifest
+            clean_schema.update(wire=True, wireKeys=list(WIRE_KEYS),
+                                wireSignature=wire_sig)
+        atomic_write_json(os.path.join(clean_dir, "schema.json"),
+                          clean_schema)
         log.info("norm: %d shards, %d input cols -> %d features",
                  shard, len(transformer.columns), transformer.width)
         return 0
 
-    def _signature(self, source: DataSource) -> dict:
+    def _signature(self, source: DataSource,
+                   wire_only: bool = False) -> dict:
         """Identity of the run's inputs + transform config — a resume is
         only valid when the replayed stream produces the same bytes."""
         mc = self.model_config
@@ -178,7 +237,10 @@ class NormalizeProcessor(BasicProcessor):
                 "normType": mc.normalize.normType.name,
                 "sampleRate": mc.normalize.sampleRate,
                 "sampleNegOnly": bool(mc.normalize.sampleNegOnly),
-                "shardRows": SHARD_ROWS}
+                "shardRows": SHARD_ROWS,
+                # npz-committed shards cannot resume into a wire run (or
+                # vice versa) — mode flips reset the journal
+                "wireOnly": bool(wire_only)}
 
     def _flush(self, norm_dir: str, clean_dir: str, shard: int,
                bufx: List[np.ndarray], bufb, bufy, bufw) -> None:
@@ -194,6 +256,12 @@ class NormalizeProcessor(BasicProcessor):
             # bytes on disk are the bytes this flush would write — skip
             # the write, keep the commit record
             self._shard_counts.append(int(len(y)))
+            if self._wire is not None and self._wire.n_shards <= shard:
+                # an earlier divergence truncated the wire behind the
+                # journal — re-land this committed shard's rows
+                faults.fire("norm", "wire", shard, path=cl_path)
+                self._wire.append({"bins": b.astype(self._bins_dtype),
+                                   "y": y, "w": w})
             return
         if prev is not None:
             log.warning("norm resume: shard %d row count diverged "
@@ -201,9 +269,19 @@ class NormalizeProcessor(BasicProcessor):
                         shard, prev.get("rows"), len(y))
         faults.fire("norm", "shard", shard, path=np_path)
         atomic_savez(np_path, x=x, y=y, w=w)
-        atomic_savez(cl_path, bins=b.astype(self._bins_dtype), y=y, w=w)
+        if self._wire is not None:
+            if self._wire.n_shards > shard:
+                # divergent resumed shard: it and everything after re-run
+                self._wire.truncate_to(shard)
+            faults.fire("norm", "wire", shard, path=cl_path)
+            self._wire.append({"bins": b.astype(self._bins_dtype),
+                               "y": y, "w": w})
+            files = [np_path]
+        else:
+            atomic_savez(cl_path, bins=b.astype(self._bins_dtype), y=y, w=w)
+            files = [np_path, cl_path]
         self.journal.commit_item(f"shard-{shard:05d}",
-                                 files=[np_path, cl_path], rows=int(len(y)))
+                                 files=files, rows=int(len(y)))
         self._shard_counts.append(int(len(y)))
 
     def _shuffle(self, d: str) -> Optional[List[int]]:

@@ -26,6 +26,7 @@ from ..ioutil import atomic_savez, atomic_write_text
 from ..config.validator import ModelStep
 from ..data import DataSource
 from ..data.extract import ChunkExtractor
+from ..data.parsepool import iter_extracted
 from ..ops.binning import (CategoricalAccumulator, ColumnBinner,
                            NumericAccumulator)
 from ..ops.correlation import CorrelationAccumulator
@@ -145,11 +146,11 @@ class StatsProcessor(BasicProcessor):
         sweep_t0 = time.perf_counter()
         if fused:
             with self.phase("fused_sweep") as ph:
-                for ci, chunk in enumerate(source.iter_chunks()):
-                    if ci < resume_chunk:
-                        continue       # restored partial covers this chunk
+                for ci, ex in iter_extracted(
+                        source, extractor, rate=rate,
+                        cache_root=self.paths.raw_cache_dir,
+                        start_chunk=resume_chunk):
                     faults.fire("stats", "chunk", ci)
-                    ex = extractor.extract(_sample_raw(chunk, rate, ci))
                     if ex.n == 0:
                         continue
                     total_rows += ex.n
@@ -185,9 +186,10 @@ class StatsProcessor(BasicProcessor):
         else:
             # ---------------- pass 1: moments/min/max (numeric)
             with self.phase("pass1_moments") as ph:
-                for ci, chunk in enumerate(source.iter_chunks()):
+                for ci, ex in iter_extracted(
+                        source, extractor, rate=rate,
+                        cache_root=self.paths.raw_cache_dir):
                     faults.fire("stats", "chunk", ci)
-                    ex = extractor.extract(_sample_raw(chunk, rate, ci))
                     if ex.n == 0:
                         continue
                     total_rows += ex.n
@@ -209,8 +211,9 @@ class StatsProcessor(BasicProcessor):
                     n_cols=len(num_cols), offset=num_acc.moments["mean"],
                     mesh=mesh)
             with self.phase("pass2_histograms").set(rows=total_rows):
-                for ci, chunk in enumerate(source.iter_chunks()):
-                    ex = extractor.extract(_sample_raw(chunk, rate, ci))
+                for ci, ex in iter_extracted(
+                        source, extractor, rate=rate,
+                        cache_root=self.paths.raw_cache_dir):
                     if ex.n == 0:
                         continue
                     tgt = binarized(ex)
@@ -421,8 +424,8 @@ class StatsProcessor(BasicProcessor):
             offset=np.asarray(num_means + [0.5] * len(cat_cols)),
             mesh=device_mesh())
         miss = {m.strip().lower() for m in extractor.missing_values}
-        for ci, chunk in enumerate(source.iter_chunks()):
-            ex = extractor.extract(_sample_raw(chunk, rate, ci))
+        for ci, ex in iter_extracted(source, extractor, rate=rate,
+                                     cache_root=self.paths.raw_cache_dir):
             if ex.n == 0:
                 continue
             x = np.zeros((ex.n, len(cols)))
@@ -477,13 +480,14 @@ class StatsProcessor(BasicProcessor):
         unit_ids: Dict[str, int] = {}
         acc = np.zeros((0, total_bins), np.float64)   # [units, packed bins]
         rate = float(self.model_config.stats.sampleRate)
-        for ci, chunk in enumerate(source.iter_chunks()):
-            df = chunk.data
-            if psi_col not in df.columns:
-                log.warning("psi column %s not found; skipping PSI", psi_col)
-                return
-            ex = extractor.extract(_sample_raw(chunk, rate, ci),
-                                   keep_raw=True)
+        if psi_col not in source.header:
+            log.warning("psi column %s not found; skipping PSI", psi_col)
+            return
+        # keep_raw: the unit column rides the raw string plane, so this
+        # pass parses through the pool but never serves from/writes the
+        # raw cache (raw strings are not cached)
+        for ci, ex in iter_extracted(source, extractor, rate=rate,
+                                     keep_raw=True):
             if ex.n == 0:
                 continue
             units = ex.raw.data[psi_col].to_numpy()  # raw values: numeric
@@ -544,21 +548,6 @@ def _load_partial(path: str, sig_hash: str):
         return meta, {k: data[k] for k in data.files if k != "__meta__"}
     except (OSError, ValueError, KeyError, zipfile.BadZipFile):
         return None
-
-
-def _sample_raw(chunk, rate: float, chunk_idx: int):
-    """Apply ``stats.sampleRate`` BEFORE parsing: deterministic Bernoulli
-    sample of the raw rows, IDENTICAL across all stats passes (per-chunk
-    substream seed over the raw row count) — the reference samples in its
-    stats mappers (``ModelStatsConf`` sampleRate,
-    ``MapReducerStatsWorker``); sampling pre-extract also skips the parse
-    cost of the dropped rows."""
-    if rate >= 1.0 or len(chunk.data) == 0:
-        return chunk
-    from ..data.reader import RawChunk
-    keep = np.random.default_rng([977, chunk_idx]) \
-        .random(len(chunk.data)) < rate
-    return RawChunk(chunk.columns, chunk.data[keep])
 
 
 def _f(x) -> Optional[float]:

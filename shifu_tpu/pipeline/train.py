@@ -434,7 +434,7 @@ class TrainProcessor(BasicProcessor):
             view = shards.from_row(cur)
             log.info("data-window cursor %d: training on %d of %d rows "
                      "(%d of %d shards)", cur, view.num_rows,
-                     shards.num_rows, len(view.files), len(shards.files))
+                     shards.num_rows, view.n_shards, shards.n_shards)
             return view
         return shards
 

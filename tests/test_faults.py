@@ -52,6 +52,11 @@ def _small_chunks_and_shards(monkeypatch, chunk_rows=500, shard_rows=1024):
     monkeypatch.setattr(
         DataSource, "iter_chunks",
         lambda self, cr=chunk_rows: orig(self, chunk_rows))
+    # keep the one-parse plane's chunk geometry in lockstep: the raw
+    # cache pins chunkRows, and a cache written at the default geometry
+    # would otherwise serve ONE big chunk and defeat the multi-shard
+    # setup these tests rely on
+    monkeypatch.setattr("shifu_tpu.data.parsepool.CHUNK_ROWS", chunk_rows)
     monkeypatch.setattr("shifu_tpu.pipeline.norm.SHARD_ROWS", shard_rows)
 
 
@@ -627,3 +632,95 @@ def test_rf_tail_superbatch_crash_resume_bit_identical(tmp_path):
         init_trees=saved["trees"], start_history=saved["history"])
     assert resumed.trees_built == 6
     _tail_forest_equal(control.trees, resumed.trees)
+
+# ----------------------------------- raw cache: torn commit, wire plane
+def _rawcache_manifests(mdir: str):
+    """Every committed raw-cache manifest path under tmp/RawCache."""
+    root = os.path.join(mdir, "tmp", "RawCache")
+    if not os.path.isdir(root):
+        return []
+    return [os.path.join(root, d, "manifest.json")
+            for d in sorted(os.listdir(root))
+            if os.path.isfile(os.path.join(root, d, "manifest.json"))]
+
+
+def _clean_plane_arrays(mdir: str):
+    """Per-shard arrays of the clean plane via Shards — transparent to
+    npz vs direct-to-wire storage."""
+    from shifu_tpu.data.shards import Shards
+    s = Shards.open(os.path.join(mdir, "tmp", "CleanedData"))
+    return [{k: np.asarray(v).copy() for k, v in d.items()}
+            for d in s.iter_shards()]
+
+
+def test_rawcache_commit_fault_retries_then_lands(model_set):
+    """One transient ioerror at the raw-cache manifest commit rides the
+    io_retry ladder — the step succeeds AND the cache commits."""
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    assert InitProcessor(model_set).run() == 0
+    set_faults("rawcache:commit=0:ioerror")
+    assert StatsProcessor(model_set, params={}).run() == 0
+    assert _rawcache_manifests(model_set)
+
+
+def test_rawcache_commit_exhaustion_absent_cache_then_rebuilt(model_set):
+    """Retry exhaustion at the commit point abandons the cache WITHOUT
+    failing the step (the cache is an optimization, not the output);
+    absent manifest == absent cache, and the next pass rebuilds it."""
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    assert InitProcessor(model_set).run() == 0
+    set_faults("rawcache:commit=0:ioerror@99")
+    assert StatsProcessor(model_set, params={}).run() == 0
+    assert _rawcache_manifests(model_set) == []   # commit never landed
+
+    set_faults("")
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    assert _rawcache_manifests(model_set)         # cold norm rebuilt it
+
+
+def test_norm_wire_fault_resume_bit_identical(model_set, monkeypatch):
+    """An injected failure at the wire append (plus manufactured torn
+    tail bytes past the committed wire manifest) resumes from the
+    journal: the adopted prefix is kept, the tail re-lands, and the
+    final wire plane is bit-identical to an uninterrupted run's."""
+    from shifu_tpu.data.spill import wire_dir
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    _init_stats(model_set)
+    control = model_set + "_ctl"
+    shutil.copytree(model_set, control)
+    _small_chunks_and_shards(monkeypatch)
+
+    set_faults("norm:wire=2:ioerror")
+    with pytest.raises(faults.InjectedFault):
+        NormalizeProcessor(model_set, params={}).run()
+
+    jpath = os.path.join(model_set, "tmp", "journal", "NORMALIZE.json")
+    with open(jpath) as f:
+        doc = json.load(f)
+    assert "shard-00001" in doc["items"]
+    assert "shard-00002" not in doc["items"]
+
+    # manufacture the mid-append crash shape: tail bytes past the last
+    # committed wire manifest — resume must truncate, not trust them
+    wdir = wire_dir(os.path.join(model_set, "tmp", "CleanedData"),
+                    ("bins", "y", "w"))
+    with open(os.path.join(wdir, "y.raw"), "ab") as f:
+        f.write(b"\xff" * 12)
+
+    set_faults("")
+    assert NormalizeProcessor(model_set, params={}).run() == 0
+    assert NormalizeProcessor(control, params={}).run() == 0
+
+    a, b = _clean_plane_arrays(model_set), _clean_plane_arrays(control)
+    assert len(a) == len(b) and len(a) > 2
+    for sa, sb in zip(a, b):
+        assert sa.keys() == sb.keys()
+        for k in sa:
+            assert sa[k].dtype == sb[k].dtype
+            assert sa[k].tobytes() == sb[k].tobytes(), k
+    _assert_same_shards(
+        _shard_arrays(os.path.join(model_set, "tmp", "NormalizedData")),
+        _shard_arrays(os.path.join(control, "tmp", "NormalizedData")))

@@ -1,0 +1,343 @@
+"""One-parse offline pipeline suite (``pytest -m ingest``).
+
+The tentpole contracts of the parse-pool / raw-cache / direct-to-wire
+round, each tested against the serial seed path it replaced:
+
+* pooled parse == serial parse, bit-for-bit (ColumnConfig stats, norm
+  shards, quarantine accounting) — including sub-1.0 sample rates,
+  where the pooled/cached order parses-then-subsets while the serial
+  order subsets-then-parses;
+* the columnar raw cache obeys spill-cache semantics: staleness pins
+  the source signature, a budget overflow aborts PERMANENTLY, and a
+  cache-served pass never touches the string plane
+  (``ingest.disk_passes`` stays flat — the disk-pass regression guard);
+* wire-only norm output trains bit-identical models to the npz path.
+"""
+
+import hashlib
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from shifu_tpu import obs
+from shifu_tpu.config import environment
+
+pytestmark = pytest.mark.ingest
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    environment.reset_for_tests()
+    yield
+    environment.reset_for_tests()
+    obs.set_enabled(False)
+
+
+def _serial_knobs():
+    environment.set_property("shifu.ingest.parseWorkers", "0")
+    environment.set_property("shifu.ingest.rawCache", "false")
+    environment.set_property("shifu.norm.wireOnly", "false")
+
+
+def _run_init_stats_norm(mdir: str) -> None:
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.norm import NormalizeProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    assert InitProcessor(mdir).run() == 0
+    assert StatsProcessor(mdir, params={}).run() == 0
+    assert NormalizeProcessor(mdir, params={}).run() == 0
+
+
+def _set_sample_rates(mdir: str, stats_rate: float, norm_rate: float) -> None:
+    from shifu_tpu.config import ModelConfig
+    p = os.path.join(mdir, "ModelConfig.json")
+    mc = ModelConfig.load(p)
+    mc.stats.sampleRate = stats_rate
+    mc.normalize.sampleRate = norm_rate
+    mc.save(p)
+
+
+def _clean_plane(mdir: str):
+    """Per-shard arrays of the clean plane via Shards — transparent to
+    npz vs wire storage, so serial and wire-only planes compare."""
+    from shifu_tpu.data.shards import Shards
+    s = Shards.open(os.path.join(mdir, "tmp", "CleanedData"))
+    return [{k: np.asarray(v).copy() for k, v in d.items()}
+            for d in s.iter_shards()]
+
+
+# --------------------------------------------- pooled == serial bit-parity
+@pytest.fixture
+def parity_pair(tmp_path, fraud_csv):
+    """(serial_dir, pooled_dir): the same scaffold, sub-1.0 sample rates
+    (exercising the sample-order-commutes contract), not yet run."""
+    from tests.conftest import _scaffold_model_set
+    a = _scaffold_model_set(str(tmp_path / "serial"), fraud_csv)
+    b = _scaffold_model_set(str(tmp_path / "pooled"), fraud_csv)
+    for d in (a, b):
+        _set_sample_rates(d, 0.7, 0.8)
+    return a, b
+
+
+def test_pool_and_cache_bit_parity(parity_pair):
+    """stats + norm under the pooled/cached defaults reproduce the
+    serial path's ColumnConfig and shard bytes exactly."""
+    serial_dir, pooled_dir = parity_pair
+    _serial_knobs()
+    _run_init_stats_norm(serial_dir)
+    environment.reset_for_tests()
+    _run_init_stats_norm(pooled_dir)
+
+    # the pooled leg actually engaged the one-parse plane
+    assert os.path.isdir(os.path.join(pooled_dir, "tmp", "RawCache"))
+    assert not os.path.isdir(os.path.join(serial_dir, "tmp", "RawCache"))
+
+    with open(os.path.join(serial_dir, "ColumnConfig.json")) as f:
+        cc_serial = f.read()
+    with open(os.path.join(pooled_dir, "ColumnConfig.json")) as f:
+        assert cc_serial == f.read()
+
+    ndir_a = os.path.join(serial_dir, "tmp", "NormalizedData")
+    ndir_b = os.path.join(pooled_dir, "tmp", "NormalizedData")
+    files = sorted(f for f in os.listdir(ndir_a) if f.endswith(".npz"))
+    assert files == sorted(f for f in os.listdir(ndir_b)
+                           if f.endswith(".npz")) and files
+    for f in files:
+        da = dict(np.load(os.path.join(ndir_a, f)))
+        db = dict(np.load(os.path.join(ndir_b, f)))
+        assert da.keys() == db.keys()
+        for k in da:
+            assert da[k].tobytes() == db[k].tobytes(), (f, k)
+
+    # clean plane: serial wrote npz, pooled wrote direct-to-wire — the
+    # Shards reader views must still be bit-identical
+    a, b = _clean_plane(serial_dir), _clean_plane(pooled_dir)
+    assert len(a) == len(b) and a
+    for sa, sb in zip(a, b):
+        for k in ("bins", "y", "w"):
+            assert sa[k].dtype == sb[k].dtype, k
+            assert sa[k].tobytes() == sb[k].tobytes(), k
+
+
+def test_wire_trained_model_bit_identical(parity_pair):
+    """A GBT trained from the wire-only clean plane serializes byte-
+    identically to one trained from the serial npz plane."""
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.pipeline.train import TrainProcessor
+    serial_dir, pooled_dir = parity_pair
+    _serial_knobs()
+    _run_init_stats_norm(serial_dir)
+    environment.reset_for_tests()
+    _run_init_stats_norm(pooled_dir)
+
+    digests = []
+    for d in (serial_dir, pooled_dir):
+        p = os.path.join(d, "ModelConfig.json")
+        mc = ModelConfig.load(p)
+        mc.train.algorithm = "GBT"
+        mc.train.params = {"TreeNum": 3, "MaxDepth": 3, "Loss": "log"}
+        mc.save(p)
+        assert TrainProcessor(d, params={}).run() == 0
+        mdir = os.path.join(d, "models")
+        blobs = []
+        for f in sorted(os.listdir(mdir)):
+            with open(os.path.join(mdir, f), "rb") as fh:
+                blobs.append(fh.read())
+        digests.append(hashlib.md5(b"".join(blobs)).hexdigest())
+    assert digests[0] == digests[1]
+
+
+def test_pooled_quarantine_accounting_matches_serial(tmp_path):
+    """The pooled producer IS the serial read loop: bad-input quarantine
+    counts and the yielded row stream match the serial path exactly."""
+    from shifu_tpu.data.reader import DataSource
+    d = tmp_path / "data"
+    d.mkdir()
+    with open(d / "part-aaa.csv", "w") as f:
+        for i in range(50):
+            f.write(f"{i}|{i * 2}|good\n")
+    with open(d / "part-bbb.csv.gz", "wb") as f:
+        f.write(b"this is not gzip data\n" * 5)
+    environment.set_property("shifu.data.badThreshold", "0.6")
+    obs.set_enabled(True)
+
+    def quarantined_after(workers: int):
+        environment.set_property("shifu.ingest.parseWorkers", str(workers))
+        obs.get_registry().reset()
+        ds = DataSource(str(d), "|", header=["a", "b", "tag"])
+        rows = sum(len(c) for c in ds.iter_chunks())
+        return rows, obs.get_registry().counter(
+            "data.quarantined_shards").value
+
+    assert quarantined_after(0) == quarantined_after(4) == (50, 1.0)
+
+
+# ------------------------------------------------- raw cache semantics
+def _source_and_extractor(mdir: str):
+    from shifu_tpu.config import ModelConfig
+    from shifu_tpu.config.column_config import load_column_configs
+    from shifu_tpu.data import DataSource
+    from shifu_tpu.data.transform import DatasetTransformer
+    mc = ModelConfig.load(os.path.join(mdir, "ModelConfig.json"))
+    ccs = load_column_configs(os.path.join(mdir, "ColumnConfig.json"))
+    tf = DatasetTransformer(mc, ccs)
+    src = DataSource(mc.dataSet.dataPath, mc.dataSet.dataDelimiter)
+    return src, tf.extractor
+
+
+@pytest.fixture
+def inited_set(tmp_path, fraud_csv):
+    """init+stats WITHOUT the raw cache — cache behavior under test."""
+    from tests.conftest import _scaffold_model_set
+    from shifu_tpu.pipeline.create import InitProcessor
+    from shifu_tpu.pipeline.stats import StatsProcessor
+    mdir = _scaffold_model_set(str(tmp_path), fraud_csv)
+    environment.set_property("shifu.ingest.rawCache", "false")
+    assert InitProcessor(mdir).run() == 0
+    assert StatsProcessor(mdir, params={}).run() == 0
+    environment.reset_for_tests()
+    return mdir
+
+
+def test_cached_pass_never_touches_disk(inited_set):
+    """Disk-pass regression guard: the first full pass parses the string
+    plane (one ``ingest.disk_passes`` tick) and writes the cache; the
+    second pass serves from mmap and ticks NOTHING but rawcache.hits."""
+    from shifu_tpu.data.parsepool import iter_extracted
+    src, ex = _source_and_extractor(inited_set)
+    croot = os.path.join(inited_set, "tmp", "RawCache")
+    obs.set_enabled(True)
+    obs.get_registry().reset()
+    reg = obs.get_registry()
+
+    cold = [e.n for _, e in iter_extracted(src, ex, cache_root=croot)]
+    assert reg.counter("ingest.disk_passes").value == 1.0
+    assert reg.counter("rawcache.misses").value == 1.0
+    assert reg.counter("rawcache.bytes_written").value > 0
+
+    warm = [e.n for _, e in iter_extracted(src, ex, cache_root=croot)]
+    assert warm == cold
+    assert reg.counter("ingest.disk_passes").value == 1.0  # unchanged
+    assert reg.counter("rawcache.hits").value == 1.0
+
+
+def test_cache_served_chunks_bit_identical(inited_set):
+    """Cache replay returns the exact arrays a fresh parse produces,
+    including at a sub-1.0 sample rate (subset replayed post-parse)."""
+    from shifu_tpu.data.parsepool import iter_extracted
+    src, ex = _source_and_extractor(inited_set)
+    croot = os.path.join(inited_set, "tmp", "RawCache")
+    list(iter_extracted(src, ex, cache_root=croot))      # build cache
+    for rate in (1.0, 0.6):
+        environment.set_property("shifu.ingest.parseWorkers", "0")
+        environment.set_property("shifu.ingest.rawCache", "false")
+        serial = list(iter_extracted(src, ex, rate=rate))
+        environment.reset_for_tests()
+        cached = list(iter_extracted(src, ex, rate=rate,
+                                     cache_root=croot))
+        assert [ci for ci, _ in serial] == [ci for ci, _ in cached]
+        for (_, a), (_, b) in zip(serial, cached):
+            # provenance fields legitimately differ at rate < 1: the
+            # serial order samples BEFORE parsing (raw_rows shrinks to
+            # the sampled count), the replay keeps raw provenance — the
+            # payload arrays are the bit-parity contract
+            assert a.n == b.n
+            if rate >= 1.0:
+                assert a.raw_rows == b.raw_rows
+                assert a.kept_idx.tobytes() == b.kept_idx.tobytes()
+            assert a.target.tobytes() == b.target.tobytes()
+            assert a.weight.tobytes() == b.weight.tobytes()
+            assert a.numeric.tobytes() == b.numeric.tobytes()
+            assert a.numeric_valid.tobytes() == b.numeric_valid.tobytes()
+            assert a.categorical.keys() == b.categorical.keys()
+            for k in a.categorical:
+                assert list(a.categorical[k]) == list(b.categorical[k]), k
+
+
+def test_cache_staleness_on_source_change(inited_set):
+    """Rewriting the source invalidates the cache (signature pins name/
+    size/mtime) — the next pass re-parses and re-commits."""
+    from shifu_tpu.data.parsepool import cache_dir_for, iter_extracted
+    from shifu_tpu.data.rawcache import open_raw_cache, source_signature
+    src, ex = _source_and_extractor(inited_set)
+    croot = os.path.join(inited_set, "tmp", "RawCache")
+    list(iter_extracted(src, ex, cache_root=croot))
+    sig = source_signature(src.files)
+    cdir = cache_dir_for(croot, sig, ex)
+    rd, writable = open_raw_cache(cdir, sig, ex, 262144)
+    assert rd is not None
+
+    # a stale signature (the source moved on) must refuse to serve
+    stale = [list(s) for s in sig]
+    stale[0][1] = (stale[0][1] or 0) + 1
+    rd2, writable2 = open_raw_cache(cdir, stale, ex, 262144)
+    assert rd2 is None and writable2
+
+
+def test_cache_budget_abort_is_permanent(inited_set):
+    """Overflowing ``rawCacheBudgetBytes`` abandons the cache mid-write
+    and leaves a PERMANENT aborted marker: later passes neither serve
+    nor re-attempt the build — but still stream correct chunks."""
+    from shifu_tpu.data.parsepool import cache_dir_for, iter_extracted
+    from shifu_tpu.data.rawcache import open_raw_cache, source_signature
+    src, ex = _source_and_extractor(inited_set)
+    croot = os.path.join(inited_set, "tmp", "RawCache")
+    environment.set_property("shifu.ingest.rawCacheBudgetBytes", "64")
+    first = [e.n for _, e in iter_extracted(src, ex, cache_root=croot)]
+    assert first and sum(first) > 0
+
+    sig = source_signature(src.files)
+    cdir = cache_dir_for(croot, sig, ex)
+    with open(os.path.join(cdir, "manifest.json")) as f:
+        assert "budget" in json.load(f)["aborted"]
+    rd, writable = open_raw_cache(cdir, sig, ex, 262144)
+    assert rd is None and not writable
+
+    # even with the budget raised, the marker pins the abort for this
+    # exact source — no rebuild thrash, chunks still stream correctly
+    environment.reset_for_tests()
+    again = [e.n for _, e in iter_extracted(src, ex, cache_root=croot)]
+    assert again == first
+    with open(os.path.join(cdir, "manifest.json")) as f:
+        assert json.load(f).get("aborted")
+
+
+# ---------------------------------------------- e2e disk-pass regression
+def test_cold_pipeline_saves_a_full_disk_pass(tmp_path, fraud_csv):
+    """Telemetry-backed acceptance: a cold init→stats→norm under the
+    one-parse defaults touches the raw string plane FEWER times than the
+    serial seed path (stats pays the only parse; norm rides the cache),
+    and the wire-only clean plane skips the npz write-through."""
+    from tests.conftest import _scaffold_model_set
+
+    from shifu_tpu.obs.report import load_blocks, trace_path
+
+    def passes(leg: str, serial: bool) -> float:
+        mdir = _scaffold_model_set(str(tmp_path / leg), fraud_csv)
+        if serial:
+            _serial_knobs()
+        obs.set_enabled(True)
+        obs.get_registry().reset()
+        _run_init_stats_norm(mdir)
+        # each step's flush snapshots-and-RESETS the registry — total
+        # passes are summed from the per-step trace records
+        v = sum(float(m.get("value") or 0)
+                for block in load_blocks(trace_path(mdir))
+                for m in block["metrics"]
+                if m.get("name") == "ingest.disk_passes")
+        obs.set_enabled(False)
+        environment.reset_for_tests()
+        if serial:
+            assert os.path.exists(os.path.join(
+                mdir, "tmp", "CleanedData", "part-00000.npz"))
+        else:
+            assert not os.path.exists(os.path.join(
+                mdir, "tmp", "CleanedData", "part-00000.npz"))
+        return v
+
+    serial_passes = passes("serial", True)
+    pooled_passes = passes("pooled", False)
+    assert pooled_passes <= serial_passes - 1
